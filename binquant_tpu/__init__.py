@@ -1,0 +1,27 @@
+"""binquant_tpu — a TPU-native market signal engine.
+
+A ground-up JAX/XLA re-design of the capabilities of carkod/binquant:
+instead of a per-symbol asyncio/pandas pipeline, the engine keeps a resident
+``(S symbols × W bars × F fields)`` device ring buffer and evaluates every
+indicator, market-regime score, and strategy trigger for all symbols in one
+jit'd batched step per tick. Python remains only at the I/O edges (websocket
+ingest, Telegram/REST emission).
+
+Layout:
+    ops/        rolling-window + indicator kernels (vmapped, pallas hot ops)
+    regime/     market context, regime classification, routing, scoring
+    strategies/ strategy kernels as pure functions + registry
+    engine/     ring buffer, carried state pytree, the jit'd tick step
+    parallel/   device mesh + sharding of the symbol axis
+    io/         websocket ingest, sinks (telegram/autotrade/analytics), replay
+"""
+
+__version__ = "0.1.0"
+
+from binquant_tpu.config import Config  # noqa: F401
+from binquant_tpu.enums import (  # noqa: F401
+    Direction,
+    KlineInterval,
+    MarketRegimeCode,
+    MicroRegimeCode,
+)

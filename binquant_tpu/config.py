@@ -1,0 +1,147 @@
+"""Environment configuration.
+
+Equivalent surface to the reference's env-var singleton
+(``shared/config.py:15-180``): validate required variables once at startup,
+expose typed properties, and bypass validation entirely under CI
+(``ENV=CI``) so tests never need real credentials.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import cached_property
+
+
+class ConfigurationError(Exception):
+    pass
+
+
+_REQUIRED_VARS = (
+    "ENV",
+    "BINBOT_API_URL",
+    "TELEGRAM_BOT_TOKEN",
+    "TELEGRAM_USER_ID",
+    "BINANCE_API_KEY",
+    "BINANCE_API_SECRET",
+    "KUCOIN_API_KEY",
+    "KUCOIN_API_SECRET",
+    "KUCOIN_API_PASSPHRASE",
+    "POSTGRES_HOST",
+    "POSTGRES_PORT",
+    "POSTGRES_DB",
+    "POSTGRES_USER",
+    "POSTGRES_PASSWORD",
+    "BINANCE_KEY_ID",
+    "AUTOTRADE",
+    "LOG_LEVEL",
+)
+
+
+class Config:
+    """Process-wide configuration singleton.
+
+    ``Config()`` always returns the same instance; ``Config.reset()`` clears
+    it (used by tests to re-read patched environments).
+    """
+
+    _instance: "Config | None" = None
+
+    def __new__(cls) -> "Config":
+        if cls._instance is None:
+            inst = super().__new__(cls)
+            inst._validate()
+            cls._instance = inst
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+    # -- validation ---------------------------------------------------------
+
+    @property
+    def env(self) -> str:
+        return os.environ.get("ENV", "CI")
+
+    @property
+    def is_ci(self) -> bool:
+        return self.env.upper() == "CI"
+
+    def _validate(self) -> None:
+        if self.is_ci:
+            return
+        missing = [v for v in _REQUIRED_VARS if not os.environ.get(v)]
+        if missing:
+            raise ConfigurationError(
+                f"Missing required environment variables: {', '.join(sorted(missing))}"
+            )
+
+    # -- typed accessors ----------------------------------------------------
+
+    def _get(self, key: str, default: str = "") -> str:
+        return os.environ.get(key, default)
+
+    @cached_property
+    def binbot_api_url(self) -> str:
+        return self._get("BINBOT_API_URL", "http://localhost:8008")
+
+    @cached_property
+    def telegram_bot_token(self) -> str:
+        return self._get("TELEGRAM_BOT_TOKEN")
+
+    @cached_property
+    def telegram_user_id(self) -> str:
+        return self._get("TELEGRAM_USER_ID")
+
+    @cached_property
+    def binance_api_key(self) -> str:
+        return self._get("BINANCE_API_KEY")
+
+    @cached_property
+    def binance_api_secret(self) -> str:
+        return self._get("BINANCE_API_SECRET")
+
+    @cached_property
+    def kucoin_api_key(self) -> str:
+        return self._get("KUCOIN_API_KEY")
+
+    @cached_property
+    def kucoin_api_secret(self) -> str:
+        return self._get("KUCOIN_API_SECRET")
+
+    @cached_property
+    def kucoin_api_passphrase(self) -> str:
+        return self._get("KUCOIN_API_PASSPHRASE")
+
+    @cached_property
+    def postgres_dsn(self) -> str:
+        host = self._get("POSTGRES_HOST", "localhost")
+        port = self._get("POSTGRES_PORT", "5432")
+        db = self._get("POSTGRES_DB", "binquant")
+        user = self._get("POSTGRES_USER", "postgres")
+        pwd = self._get("POSTGRES_PASSWORD", "")
+        return f"postgresql://{user}:{pwd}@{host}:{port}/{db}"
+
+    @cached_property
+    def autotrade_enabled(self) -> bool:
+        return self._get("AUTOTRADE", "false").lower() in {"1", "true", "yes"}
+
+    @cached_property
+    def log_level(self) -> str:
+        return self._get("LOG_LEVEL", "INFO")
+
+    # -- engine tunables (new in the TPU framework) -------------------------
+
+    @cached_property
+    def max_symbols(self) -> int:
+        """Static symbol-batch capacity S of the device ring buffer."""
+        return int(self._get("BQT_MAX_SYMBOLS", "2048"))
+
+    @cached_property
+    def window_bars(self) -> int:
+        """Rolling history depth W per symbol/interval (reference: 400)."""
+        return int(self._get("BQT_WINDOW_BARS", "400"))
+
+    @cached_property
+    def heartbeat_path(self) -> str:
+        return self._get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")

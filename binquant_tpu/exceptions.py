@@ -1,0 +1,33 @@
+"""Framework exception hierarchy (reference shared/exceptions.py:1-37)."""
+
+
+class BinquantError(Exception):
+    """Base class for all framework errors."""
+
+
+class ConfigurationError(BinquantError):
+    pass
+
+
+class WebSocketError(BinquantError):
+    pass
+
+
+class RestartStreaming(BinquantError):
+    """Raised to force a websocket reconnect-and-resubscribe cycle."""
+
+
+class AutotradeError(BinquantError):
+    pass
+
+
+class BinbotError(BinquantError):
+    pass
+
+
+class InvalidSymbol(BinquantError):
+    pass
+
+
+class BufferCapacityError(BinquantError):
+    """Symbol registry is full — raise S (BQT_MAX_SYMBOLS) or evict."""

@@ -1,0 +1,5 @@
+"""Batched rolling-window and indicator kernels (last-axis = time)."""
+
+from binquant_tpu.ops import indicators, rolling  # noqa: F401
+from binquant_tpu.ops.indicators import *  # noqa: F401,F403
+from binquant_tpu.ops.rolling import *  # noqa: F401,F403

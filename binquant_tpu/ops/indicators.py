@@ -1,0 +1,380 @@
+"""Technical-indicator kernels, batched along the last axis.
+
+Replaces the reference's per-symbol pandas pipeline: pybinbot ``Indicators``
+(moving_averages/macd/rsi/mfi/ma_spreads/bollinguer_spreads/set_twap/atr/
+set_supertrend — consumed at ``/root/reference/producers/context_evaluator.py:237-249``)
+plus the strategies' inline kernels (Wilder RSI at
+``strategies/mean_reversion_fade.py:79-100``, ADX at
+``strategies/range_bb_rsi_mean_reversion.py:100-129``, Connors RSI at
+``strategies/coinrule/bb_extreme_reversion.py``).
+
+All functions take/return ``(..., W)`` arrays; a batched ``(S, W)`` market
+buffer flows through with no vmap. NaN marks warm-up, as in pandas.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.ops.rolling import (
+    diff,
+    ewm_mean,
+    rolling_max,
+    rolling_mean,
+    rolling_min,
+    rolling_std,
+    rolling_sum,
+    rolling_var,
+    shift,
+)
+from binquant_tpu.utils import jsafe_div
+
+__all__ = [
+    "sma",
+    "ema",
+    "true_range",
+    "atr",
+    "atr_wilder",
+    "rsi_wilder",
+    "rsi_sma",
+    "macd",
+    "mfi",
+    "bollinger",
+    "twap",
+    "typical_price",
+    "supertrend",
+    "adx",
+    "connors_rsi",
+    "zscore",
+    "rolling_beta_corr",
+    "log_returns",
+    "ma_spreads",
+    "bb_spreads",
+]
+
+
+def sma(close: jnp.ndarray, window: int, min_periods: int | None = None) -> jnp.ndarray:
+    return rolling_mean(close, window, min_periods)
+
+
+def ema(close: jnp.ndarray, span: float, min_periods: int = 1) -> jnp.ndarray:
+    return ewm_mean(close, span=span, min_periods=min_periods)
+
+
+def typical_price(high: jnp.ndarray, low: jnp.ndarray, close: jnp.ndarray) -> jnp.ndarray:
+    return (high + low + close) / 3.0
+
+
+def true_range(
+    high: jnp.ndarray, low: jnp.ndarray, close: jnp.ndarray
+) -> jnp.ndarray:
+    """max(h-l, |h-prev_c|, |l-prev_c|); first bar falls back to h-l."""
+    prev_close = shift(close, 1)
+    hl = high - low
+    hc = jnp.abs(high - prev_close)
+    lc = jnp.abs(low - prev_close)
+    tr = jnp.maximum(hl, jnp.maximum(hc, lc))
+    return jnp.where(jnp.isfinite(prev_close), tr, hl)
+
+
+def atr(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    window: int = 14,
+    min_periods: int | None = None,
+) -> jnp.ndarray:
+    """SMA-of-true-range ATR (the variant the reference's market context uses:
+    ``live_market_context_accumulator.py:268``)."""
+    return rolling_mean(true_range(high, low, close), window, min_periods)
+
+
+def atr_wilder(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    window: int = 14,
+) -> jnp.ndarray:
+    """Wilder-smoothed ATR (ewm alpha=1/window)."""
+    return ewm_mean(true_range(high, low, close), alpha=1.0 / window, min_periods=window)
+
+
+def rsi_wilder(close: jnp.ndarray, window: int = 14) -> jnp.ndarray:
+    """Wilder/EWM RSI; 100*avg_gain/(avg_gain+avg_loss) with a 50.0 flat-case
+    override, matching the backtested variant at
+    ``strategies/mean_reversion_fade.py:79-100``."""
+    delta = diff(close, 1)
+    gain = jnp.maximum(delta, 0.0)
+    loss = jnp.maximum(-delta, 0.0)
+    a = 1.0 / window
+    avg_gain = ewm_mean(gain, alpha=a, min_periods=window)
+    avg_loss = ewm_mean(loss, alpha=a, min_periods=window)
+    denom = avg_gain + avg_loss
+    out = jnp.where(denom != 0, 100.0 * avg_gain / jnp.where(denom != 0, denom, 1.0), 50.0)
+    return jnp.where(jnp.isfinite(avg_gain) & jnp.isfinite(avg_loss), out, jnp.nan)
+
+
+def rsi_sma(close: jnp.ndarray, window: int = 14) -> jnp.ndarray:
+    """Simple-rolling-mean RSI (the pybinbot Indicators.rsi variant — the
+    mean_reversion_fade docstring pins the difference)."""
+    delta = diff(close, 1)
+    gain = jnp.maximum(delta, 0.0)
+    loss = jnp.maximum(-delta, 0.0)
+    avg_gain = rolling_mean(gain, window)
+    avg_loss = rolling_mean(loss, window)
+    denom = avg_gain + avg_loss
+    out = jnp.where(denom != 0, 100.0 * avg_gain / jnp.where(denom != 0, denom, 1.0), 50.0)
+    return jnp.where(jnp.isfinite(avg_gain) & jnp.isfinite(avg_loss), out, jnp.nan)
+
+
+class MACDResult(NamedTuple):
+    macd: jnp.ndarray
+    signal: jnp.ndarray
+    histogram: jnp.ndarray
+
+
+def macd(
+    close: jnp.ndarray, fast: int = 12, slow: int = 26, signal: int = 9
+) -> MACDResult:
+    line = ema(close, fast) - ema(close, slow)
+    sig = ewm_mean(line, span=signal)
+    return MACDResult(line, sig, line - sig)
+
+
+def mfi(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    volume: jnp.ndarray,
+    window: int = 14,
+) -> jnp.ndarray:
+    tp = typical_price(high, low, close)
+    flow = tp * volume
+    up = diff(tp, 1) > 0
+    down = diff(tp, 1) < 0
+    pos = rolling_sum(jnp.where(up, flow, 0.0), window)
+    neg = rolling_sum(jnp.where(down, flow, 0.0), window)
+    total = pos + neg
+    out = jnp.where(total != 0, 100.0 * pos / jnp.where(total != 0, total, 1.0), 50.0)
+    return jnp.where(jnp.isfinite(pos) & jnp.isfinite(neg), out, jnp.nan)
+
+
+class BollingerResult(NamedTuple):
+    upper: jnp.ndarray
+    mid: jnp.ndarray
+    lower: jnp.ndarray
+
+
+def bollinger(
+    close: jnp.ndarray,
+    window: int = 20,
+    num_std: float = 2.0,
+    min_periods: int | None = None,
+    ddof: int = 0,
+) -> BollingerResult:
+    mid = rolling_mean(close, window, min_periods)
+    sd = rolling_std(close, window, min_periods, ddof=ddof)
+    sd = jnp.where(jnp.isfinite(sd), sd, 0.0)
+    return BollingerResult(mid + num_std * sd, mid, mid - num_std * sd)
+
+
+def twap(
+    open_: jnp.ndarray,
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    window: int = 20,
+) -> jnp.ndarray:
+    """Rolling time-weighted average price over OHLC bar means."""
+    bar_avg = (open_ + high + low + close) / 4.0
+    return rolling_mean(bar_avg, window, min_periods=1)
+
+
+class SupertrendResult(NamedTuple):
+    supertrend: jnp.ndarray
+    direction: jnp.ndarray  # +1 uptrend, -1 downtrend
+
+
+def supertrend(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    window: int = 10,
+    multiplier: float = 3.0,
+) -> SupertrendResult:
+    """Supertrend bands. Genuinely sequential (band ratchet + flip state), so
+    this is the one indicator implemented with lax.scan over the window axis.
+    """
+    import jax
+
+    a = atr_wilder(high, low, close, window)
+    hl2 = (high + low) / 2.0
+    upper = hl2 + multiplier * a
+    lower = hl2 - multiplier * a
+    W = close.shape[-1]
+    batch_shape = close.shape[:-1]
+
+    flat = lambda z: jnp.reshape(z, (-1, W)).T  # (W, B)
+    u, lo, c = flat(upper), flat(lower), flat(close)
+
+    def step(carry, inputs):
+        prev_upper, prev_lower, prev_dir, prev_close = carry
+        ub, lb, cl = inputs
+        ub = jnp.where(jnp.isfinite(ub), ub, jnp.inf)
+        lb = jnp.where(jnp.isfinite(lb), lb, -jnp.inf)
+        # band ratchet: final bands only move in the trend's favour
+        fu = jnp.where((ub < prev_upper) | (prev_close > prev_upper), ub, prev_upper)
+        fl = jnp.where((lb > prev_lower) | (prev_close < prev_lower), lb, prev_lower)
+        d = jnp.where(cl > fu, 1.0, jnp.where(cl < fl, -1.0, prev_dir))
+        return (fu, fl, d, cl), (jnp.where(d > 0, fl, fu), d)
+
+    B = u.shape[1]
+    init = (
+        jnp.full((B,), jnp.inf),
+        jnp.full((B,), -jnp.inf),
+        jnp.ones((B,)),
+        jnp.zeros((B,)),
+    )
+    _, (st, dirn) = jax.lax.scan(step, init, (u, lo, c))
+    unflat = lambda z: jnp.reshape(z.T, batch_shape + (W,))
+    st, dirn = unflat(st), unflat(dirn)
+    valid = jnp.isfinite(a)
+    return SupertrendResult(jnp.where(valid, st, jnp.nan), jnp.where(valid, dirn, jnp.nan))
+
+
+def adx(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    window: int = 14,
+) -> jnp.ndarray:
+    """Wilder ADX from +DM/−DM/TR ewm smoothing."""
+    up_move = diff(high, 1)
+    down_move = -diff(low, 1)
+    plus_dm = jnp.where((up_move > down_move) & (up_move > 0), up_move, 0.0)
+    minus_dm = jnp.where((down_move > up_move) & (down_move > 0), down_move, 0.0)
+    a = 1.0 / window
+    tr_s = ewm_mean(true_range(high, low, close), alpha=a, min_periods=window)
+    plus_di = 100.0 * jsafe_div(ewm_mean(plus_dm, alpha=a, min_periods=window), tr_s)
+    minus_di = 100.0 * jsafe_div(ewm_mean(minus_dm, alpha=a, min_periods=window), tr_s)
+    dx = 100.0 * jsafe_div(jnp.abs(plus_di - minus_di), plus_di + minus_di)
+    dx = jnp.where(jnp.isfinite(tr_s), dx, jnp.nan)
+    return ewm_mean(dx, alpha=a, min_periods=window)
+
+
+def _percent_rank(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Percent of the previous `window` values strictly below the current."""
+    from binquant_tpu.ops.rolling import _windowed_view
+
+    win = _windowed_view(shift(x, 1), window)
+    cur = x[..., None]
+    below = jnp.sum(jnp.where(jnp.isfinite(win), (win < cur).astype(x.dtype), 0.0), axis=-1)
+    cnt = jnp.sum(jnp.isfinite(win), axis=-1)
+    return jnp.where(cnt >= window, 100.0 * below / jnp.maximum(cnt, 1), jnp.nan)
+
+
+def connors_rsi(
+    close: jnp.ndarray,
+    rsi_window: int = 3,
+    streak_window: int = 2,
+    rank_window: int = 100,
+) -> jnp.ndarray:
+    """Connors RSI = mean(RSI(close,3), RSI(streak,2), PercentRank(ret,100))."""
+    d = diff(close, 1)
+    sign = jnp.sign(d)
+    # streak: consecutive same-sign run length, signed — sequential, via scan
+    import jax
+
+    W = close.shape[-1]
+    flat_sign = jnp.reshape(sign, (-1, W)).T
+
+    def step(carry, s):
+        streak = jnp.where(
+            s > 0,
+            jnp.where(carry > 0, carry + 1, 1.0),
+            jnp.where(s < 0, jnp.where(carry < 0, carry - 1, -1.0), 0.0),
+        )
+        return streak, streak
+
+    _, streaks = jax.lax.scan(step, jnp.zeros((flat_sign.shape[1],)), flat_sign)
+    streak = jnp.reshape(streaks.T, close.shape)
+    ret = jsafe_div(d, shift(close, 1))
+    r1 = rsi_wilder(close, rsi_window)
+    r2 = rsi_wilder(streak, streak_window)
+    r3 = _percent_rank(ret, rank_window)
+    return (r1 + r2 + r3) / 3.0
+
+
+def zscore(x: jnp.ndarray, window: int = 20, ddof: int = 0) -> jnp.ndarray:
+    mu = rolling_mean(x, window)
+    sd = rolling_std(x, window, ddof=ddof)
+    return jsafe_div(x - mu, sd)
+
+
+def log_returns(close: jnp.ndarray) -> jnp.ndarray:
+    prev = shift(close, 1)
+    ok = (close > 0) & (prev > 0)
+    return jnp.where(ok, jnp.log(jnp.where(ok, close / jnp.where(prev > 0, prev, 1.0), 1.0)), jnp.nan)
+
+
+class BetaCorrResult(NamedTuple):
+    beta: jnp.ndarray
+    corr: jnp.ndarray
+
+
+def rolling_beta_corr(
+    asset_returns: jnp.ndarray,
+    bench_returns: jnp.ndarray,
+    window: int = 50,
+) -> BetaCorrResult:
+    """Rolling OLS beta and Pearson correlation of asset vs benchmark returns
+    (reference ``producers/context_evaluator.py:144-184``). `bench_returns`
+    broadcasts against the leading axes of `asset_returns`."""
+    b = jnp.broadcast_to(bench_returns, asset_returns.shape)
+    both = jnp.isfinite(asset_returns) & jnp.isfinite(b)
+    x = jnp.where(both, asset_returns, jnp.nan)
+    y = jnp.where(both, b, jnp.nan)
+    mx = rolling_mean(x, window)
+    my = rolling_mean(y, window)
+    mxy = rolling_mean(x * y, window)
+    myy = rolling_mean(y * y, window)
+    vx = rolling_var(x, window, ddof=0)
+    cov = mxy - mx * my
+    var_b = myy - my * my
+    beta = jsafe_div(cov, var_b)
+    corr = jsafe_div(cov, jnp.sqrt(jnp.maximum(vx * var_b, 0.0)))
+    return BetaCorrResult(beta, jnp.clip(corr, -1.0, 1.0))
+
+
+class MASpreads(NamedTuple):
+    ma_7_25: jnp.ndarray
+    ma_25_100: jnp.ndarray
+    ma_7_100: jnp.ndarray
+
+
+def ma_spreads(close: jnp.ndarray) -> MASpreads:
+    """Relative spreads between the 7/25/100 moving averages."""
+    ma7 = rolling_mean(close, 7, min_periods=1)
+    ma25 = rolling_mean(close, 25, min_periods=1)
+    ma100 = rolling_mean(close, 100, min_periods=1)
+    return MASpreads(
+        jsafe_div(ma7 - ma25, ma25),
+        jsafe_div(ma25 - ma100, ma100),
+        jsafe_div(ma7 - ma100, ma100),
+    )
+
+
+class BBSpreads(NamedTuple):
+    band_spread: jnp.ndarray  # (upper-lower)/mid
+    top_spread: jnp.ndarray  # (upper-mid)/mid
+    bottom_spread: jnp.ndarray  # (mid-lower)/mid
+
+
+def bb_spreads(bb: BollingerResult) -> BBSpreads:
+    return BBSpreads(
+        jsafe_div(bb.upper - bb.lower, bb.mid),
+        jsafe_div(bb.upper - bb.mid, bb.mid),
+        jsafe_div(bb.mid - bb.lower, bb.mid),
+    )

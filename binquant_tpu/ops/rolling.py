@@ -1,0 +1,271 @@
+"""Rolling-window primitives along the last axis, pandas-parity semantics.
+
+Every function takes ``(..., W)`` arrays and is jit-safe with static window
+params, so a batched ``(S, W)`` market buffer needs no vmap. NaN encodes
+"missing/warm-up" exactly as pandas does: rolling reducers are NaN-aware and
+honour ``min_periods``.
+
+TPU-first choices:
+
+* **EWM is a matmul, not a scan.** ``y = A @ x`` with a cached lower-triangular
+  decay matrix runs on the MXU in one pass; an exact per-row correction term
+  reproduces pandas' ``adjust=False`` recursion (first valid sample seeds the
+  carry) without any sequential dependency. The reference computes every EMA
+  with ``pandas.ewm`` per symbol per tick
+  (``/root/reference/market_regime/live_market_context_accumulator.py:266-267``,
+  ``/root/reference/strategies/mean_reversion_fade.py:85-90``).
+* **Moments via cumsum** on row-centered data (stable in float32 even for
+  BTC-scale prices), one pass for sum/mean/std.
+* **Extrema via lax.reduce_window**, XLA's native sliding-window lowering.
+* **Quantiles via windowed sort** (see rolling_quantile) with a pallas
+  alternative in ops/pallas_rolling.py for the hot path.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "shift",
+    "diff",
+    "rolling_sum",
+    "rolling_mean",
+    "rolling_std",
+    "rolling_var",
+    "rolling_max",
+    "rolling_min",
+    "rolling_quantile",
+    "rolling_median",
+    "ewm_mean",
+    "cummax",
+    "cummin",
+]
+
+
+def _finite(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.isfinite(x)
+
+
+def shift(x: jnp.ndarray, n: int = 1, fill_value: float = jnp.nan) -> jnp.ndarray:
+    """pandas .shift(n) along the last axis (n may be negative)."""
+    if n == 0:
+        return x
+    W = x.shape[-1]
+    if abs(n) >= W:
+        return jnp.full_like(x, fill_value)
+    pad = jnp.full(x.shape[:-1] + (abs(n),), fill_value, dtype=x.dtype)
+    if n > 0:
+        return jnp.concatenate([pad, x[..., :-n]], axis=-1)
+    return jnp.concatenate([x[..., -n:], pad], axis=-1)
+
+
+def diff(x: jnp.ndarray, n: int = 1) -> jnp.ndarray:
+    return x - shift(x, n)
+
+
+def _window_sums(x: jnp.ndarray, window: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """NaN-aware (windowed sum, windowed finite-count) via cumsum."""
+    m = _finite(x)
+    xf = jnp.where(m, x, 0.0)
+    cs = jnp.cumsum(xf, axis=-1)
+    cn = jnp.cumsum(m.astype(x.dtype), axis=-1)
+    cs_lag = shift(cs, window, 0.0)
+    cn_lag = shift(cn, window, 0.0)
+    return cs - cs_lag, cn - cn_lag
+
+
+def _resolve_min_periods(window: int, min_periods: int | None) -> int:
+    return window if min_periods is None else min_periods
+
+
+def rolling_sum(
+    x: jnp.ndarray, window: int, min_periods: int | None = None
+) -> jnp.ndarray:
+    wsum, cnt = _window_sums(x, window)
+    mp = _resolve_min_periods(window, min_periods)
+    return jnp.where(cnt >= mp, wsum, jnp.nan)
+
+
+def rolling_mean(
+    x: jnp.ndarray, window: int, min_periods: int | None = None
+) -> jnp.ndarray:
+    wsum, cnt = _window_sums(x, window)
+    mp = max(_resolve_min_periods(window, min_periods), 1)
+    ok = cnt >= mp
+    return jnp.where(ok, wsum / jnp.where(cnt > 0, cnt, 1.0), jnp.nan)
+
+
+def rolling_var(
+    x: jnp.ndarray, window: int, min_periods: int | None = None, ddof: int = 1
+) -> jnp.ndarray:
+    # Center each row by its global nanmean first: windowed sum-of-squares on
+    # centered values keeps float32 exact even when prices are O(1e4-1e5).
+    m = _finite(x)
+    row_cnt = jnp.sum(m, axis=-1, keepdims=True)
+    row_mean = jnp.sum(jnp.where(m, x, 0.0), axis=-1, keepdims=True) / jnp.maximum(
+        row_cnt, 1
+    )
+    xc = x - row_mean
+    wsum, cnt = _window_sums(xc, window)
+    wsq, _ = _window_sums(xc * xc, window)
+    mp = max(_resolve_min_periods(window, min_periods), 1)
+    safe_cnt = jnp.maximum(cnt, 1.0)
+    var = (wsq - wsum * wsum / safe_cnt) / jnp.maximum(cnt - ddof, 1.0)
+    var = jnp.maximum(var, 0.0)
+    ok = (cnt >= mp) & (cnt > ddof)
+    return jnp.where(ok, var, jnp.nan)
+
+
+def rolling_std(
+    x: jnp.ndarray, window: int, min_periods: int | None = None, ddof: int = 1
+) -> jnp.ndarray:
+    return jnp.sqrt(rolling_var(x, window, min_periods, ddof))
+
+
+def _rolling_extremum(
+    x: jnp.ndarray, window: int, min_periods: int | None, largest: bool
+) -> jnp.ndarray:
+    mp = max(_resolve_min_periods(window, min_periods), 1)
+    neutral = -jnp.inf if largest else jnp.inf
+    m = _finite(x)
+    xf = jnp.where(m, x, neutral).astype(jnp.float32)
+    orig_shape = xf.shape
+    W = orig_shape[-1]
+    flat = xf.reshape((-1, W))
+    op = jax.lax.max if largest else jax.lax.min
+    out = jax.lax.reduce_window(
+        flat,
+        jnp.float32(neutral),
+        op,
+        window_dimensions=(1, window),
+        window_strides=(1, 1),
+        padding=((0, 0), (window - 1, 0)),
+    ).reshape(orig_shape)
+    _, cnt = _window_sums(jnp.where(m, 1.0, jnp.nan), window)
+    return jnp.where(cnt >= mp, out, jnp.nan)
+
+
+def rolling_max(
+    x: jnp.ndarray, window: int, min_periods: int | None = None
+) -> jnp.ndarray:
+    return _rolling_extremum(x, window, min_periods, largest=True)
+
+
+def rolling_min(
+    x: jnp.ndarray, window: int, min_periods: int | None = None
+) -> jnp.ndarray:
+    return _rolling_extremum(x, window, min_periods, largest=False)
+
+
+def _windowed_view(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """(..., W) -> (..., W, window): trailing window ending at each position.
+
+    Positions before the window start are filled with NaN.
+    """
+    W = x.shape[-1]
+    pos = jnp.arange(W)[:, None]
+    off = jnp.arange(window)[None, :]
+    idx = pos - (window - 1) + off
+    valid = idx >= 0
+    gathered = jnp.take(x, jnp.clip(idx, 0, W - 1), axis=-1)
+    return jnp.where(valid, gathered, jnp.nan)
+
+
+def rolling_quantile(
+    x: jnp.ndarray,
+    window: int,
+    q: float,
+    min_periods: int | None = None,
+) -> jnp.ndarray:
+    """pandas rolling(...).quantile(q, interpolation='linear'), NaN-aware.
+
+    Strategy thresholds in the reference lean on shifted rolling quantiles
+    (e.g. ``/root/reference/strategies/activity_burst_pump.py:123-139``,
+    ``spike_hunter_v3_kucoin.py:334-346``); XLA has no native sliding
+    quantile, so we sort explicit trailing windows. O(W·window·log(window))
+    but embarrassingly parallel over (S, W).
+    """
+    mp = max(_resolve_min_periods(window, min_periods), 1)
+    win = _windowed_view(x, window)  # (..., W, window)
+    # NaNs sort to the end; count finite values per window for interpolation.
+    cnt = jnp.sum(jnp.isfinite(win), axis=-1)
+    s = jnp.sort(jnp.where(jnp.isfinite(win), win, jnp.inf), axis=-1)
+    # linear interpolation at rank q*(cnt-1)
+    rank = q * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, window - 1)
+    hi = jnp.clip(lo + 1, 0, window - 1)
+    frac = (rank - lo.astype(x.dtype))[..., None]
+    v_lo = jnp.take_along_axis(s, lo[..., None], axis=-1)
+    v_hi = jnp.take_along_axis(s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[..., None], axis=-1)
+    out = (v_lo + (v_hi - v_lo) * frac)[..., 0]
+    return jnp.where(cnt >= mp, out, jnp.nan)
+
+
+def rolling_median(
+    x: jnp.ndarray, window: int, min_periods: int | None = None
+) -> jnp.ndarray:
+    return rolling_quantile(x, window, 0.5, min_periods)
+
+
+@lru_cache(maxsize=64)
+def _decay_matrix(alpha: float, length: int) -> np.ndarray:
+    """Lower-triangular A with A[t, s] = alpha * (1-alpha)^(t-s), s <= t."""
+    d = 1.0 - alpha
+    t = np.arange(length)
+    expo = t[:, None] - t[None, :]
+    with np.errstate(over="ignore"):
+        mat = alpha * np.power(d, np.maximum(expo, 0), dtype=np.float64)
+    mat = np.where(expo >= 0, mat, 0.0)
+    return mat.astype(np.float32)
+
+
+def ewm_mean(
+    x: jnp.ndarray,
+    alpha: float | None = None,
+    span: float | None = None,
+    min_periods: int = 0,
+) -> jnp.ndarray:
+    """pandas ``ewm(alpha|span, adjust=False).mean()`` as an MXU matmul.
+
+    Exact for the leading-NaN case (the only NaN pattern the ring buffer
+    produces): the recursion seeded at the first valid sample ``s0`` equals
+    the uniform decay matmul plus a closed-form correction
+    ``(1-alpha)^(t-s0+1) * x[s0]``.
+    """
+    if alpha is None:
+        if span is None:
+            raise ValueError("ewm_mean requires alpha or span")
+        alpha = 2.0 / (span + 1.0)
+    W = x.shape[-1]
+    d = 1.0 - alpha
+    A = jnp.asarray(_decay_matrix(float(alpha), W))
+
+    m = _finite(x)
+    xf = jnp.where(m, x, 0.0).astype(jnp.float32)
+    base = jnp.einsum("ts,...s->...t", A, xf, preferred_element_type=jnp.float32)
+
+    # warm-start correction: locate first valid sample per row
+    s0 = jnp.argmax(m, axis=-1)  # first True (0 if none — masked below)
+    any_valid = jnp.any(m, axis=-1)
+    x0 = jnp.take_along_axis(x, s0[..., None], axis=-1)[..., 0]
+    t_idx = jnp.arange(W)
+    rel = t_idx - s0[..., None]  # (..., W)
+    corr = jnp.power(jnp.float32(d), (rel + 1).astype(jnp.float32)) * x0[..., None]
+    y = base + jnp.where(rel >= 0, corr, 0.0)
+
+    # valid only from s0 onward, with >= min_periods valid samples seen
+    seen = rel + 1
+    ok = (rel >= 0) & (seen >= max(min_periods, 1)) & any_valid[..., None]
+    return jnp.where(ok, y, jnp.nan)
+
+
+def cummax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.maximum, x, axis=-1)
+
+
+def cummin(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.minimum, x, axis=-1)

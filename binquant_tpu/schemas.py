@@ -1,0 +1,496 @@
+"""Pydantic wire/domain models.
+
+Covers both the reference's own models (``market_regime/models.py``,
+``models/bot.py``, ``models/strategies.py``) and the pybinbot SDK schema
+surface binquant consumes (``SURVEY.md`` §2.8): ``SignalsConsumer``,
+``KlineProduceModel``, ``BotBase``, ``GridDeploymentRequest``,
+``HABollinguerSpread``, ``SymbolModel``, ``AutotradeSettingsSchema``,
+``MarketBreadthSeries``, ``BotResponse`` — so a reference user finds the
+same emitted payload shapes here.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from enum import Enum
+from typing import Any
+from uuid import UUID, uuid4
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator, model_validator
+
+from binquant_tpu.enums import (
+    MarketRegime,
+    MarketRegimeTransition,
+    MarketType,
+    MicroRegime,
+    MicroRegimeTransition,
+    SignalKind,
+    Status,
+)
+
+
+class Position(str, Enum):
+    long = "long"
+    short = "short"
+
+
+class CloseConditions(str, Enum):
+    dynamic_trailing = "dynamic_trailing"
+    timestamp = "timestamp"
+    market_reversal = "market_reversal"
+
+
+def _normalize_direction(value: str) -> str:
+    return value.upper().strip()
+
+
+def _canonicalize_symbol(value: str) -> str:
+    return value.upper().strip().replace("-", "").replace("_", "")
+
+
+# ---------------------------------------------------------------------------
+# Kline ingest payloads (reference producers/klines_connector.py:154-164)
+# ---------------------------------------------------------------------------
+
+
+class KlineProduceModel(BaseModel):
+    """One closed candle as produced by the websocket connector."""
+
+    symbol: str
+    open_time: str
+    close_time: str
+    open_price: str
+    high_price: str
+    low_price: str
+    close_price: str
+    volume: str
+
+
+class ExtendedKline(BaseModel):
+    """Full closed-candle payload kept by the TPU ring buffer.
+
+    Superset of ``KlineProduceModel`` carrying the extra Binance kline fields
+    (quote volume, trade count, taker-buy splits) that several strategies'
+    features need (quote-volume spike ratios, trade-count floors).
+    """
+
+    symbol: str
+    open_time: int
+    close_time: int
+    open: float
+    high: float
+    low: float
+    close: float
+    volume: float
+    quote_asset_volume: float = 0.0
+    number_of_trades: float = 0.0
+    taker_buy_base_volume: float = 0.0
+    taker_buy_quote_volume: float = 0.0
+
+    @classmethod
+    def from_produce_model(cls, m: KlineProduceModel | dict[str, Any]) -> "ExtendedKline":
+        if isinstance(m, dict):
+            m = KlineProduceModel.model_validate(m)
+        return cls(
+            symbol=m.symbol,
+            open_time=int(float(m.open_time)),
+            close_time=int(float(m.close_time)),
+            open=float(m.open_price),
+            high=float(m.high_price),
+            low=float(m.low_price),
+            close=float(m.close_price),
+            volume=float(m.volume),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Market regime models (reference market_regime/models.py)
+# ---------------------------------------------------------------------------
+
+
+class SymbolMarketFeatures(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    symbol: str
+    timestamp: int
+    close: float
+    return_pct: float
+    ema20: float
+    ema50: float
+    above_ema20: bool
+    above_ema50: bool
+    trend_score: float
+    relative_strength_vs_btc: float
+    atr_pct: float
+    bb_width: float
+    micro_regime: MicroRegime | None = None
+    micro_regime_strength: float = Field(default=0.0, ge=0.0, le=1.0)
+    micro_regime_transition: MicroRegimeTransition | None = None
+    micro_regime_transition_strength: float = Field(default=0.0, ge=0.0, le=1.0)
+
+    @field_validator("symbol")
+    @classmethod
+    def validate_symbol(cls, value: str) -> str:
+        return value.strip().upper()
+
+    @field_validator("timestamp")
+    @classmethod
+    def validate_timestamp(cls, value: int) -> int:
+        if value < 0:
+            raise ValueError("timestamp must be non-negative")
+        return value
+
+
+class LiveMarketContext(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    timestamp: int
+    fresh_count: int
+    total_tracked_symbols: int
+    coverage_ratio: float = Field(ge=0.0, le=1.0)
+    btc_symbol: str
+    btc_present: bool
+    confidence: float = Field(ge=0.0, le=1.0)
+    is_provisional: bool
+    advancers: int
+    decliners: int
+    advancers_ratio: float = Field(ge=0.0, le=1.0)
+    decliners_ratio: float = Field(ge=0.0, le=1.0)
+    advancers_decliners_ratio: float = Field(ge=0.0)
+    average_return: float
+    average_relative_strength_vs_btc: float
+    pct_above_ema20: float = Field(ge=0.0, le=1.0)
+    pct_above_ema50: float = Field(ge=0.0, le=1.0)
+    average_trend_score: float
+    average_atr_pct: float = Field(ge=0.0)
+    average_bb_width: float = Field(ge=0.0)
+    btc_return: float
+    btc_trend_score: float
+    btc_regime_score: float = Field(ge=-1.0, le=1.0)
+    market_stress_score: float = Field(ge=0.0, le=1.0)
+    long_tailwind: float = Field(ge=-1.0, le=1.0)
+    short_tailwind: float = Field(ge=-1.0, le=1.0)
+    market_regime: MarketRegime | None = None
+    previous_market_regime: MarketRegime | None = None
+    market_regime_transition: MarketRegimeTransition | None = None
+    market_regime_transition_strength: float = Field(default=0.0, ge=0.0, le=1.0)
+    long_regime_score: float = Field(default=0.0, ge=0.0, le=1.0)
+    short_regime_score: float = Field(default=0.0, ge=0.0, le=1.0)
+    range_regime_score: float = Field(default=0.0, ge=0.0, le=1.0)
+    stress_regime_score: float = Field(default=0.0, ge=0.0, le=1.0)
+    regime_is_transitioning: bool = False
+    regime_stable_since: int | None = Field(
+        default=None,
+        description="Timestamp (ms) when the current market_regime was first entered.",
+    )
+    symbol_features: dict[str, SymbolMarketFeatures] = Field(default_factory=dict)
+    metadata: dict[str, Any] = Field(default_factory=dict)
+
+    @field_validator("btc_symbol")
+    @classmethod
+    def validate_btc_symbol(cls, value: str) -> str:
+        return value.strip().upper()
+
+    @field_validator(
+        "timestamp", "fresh_count", "total_tracked_symbols", "advancers", "decliners"
+    )
+    @classmethod
+    def validate_non_negative_ints(cls, value: int) -> int:
+        if value < 0:
+            raise ValueError("value must be non-negative")
+        return value
+
+    @property
+    def is_full(self) -> bool:
+        return not self.is_provisional
+
+    @model_validator(mode="after")
+    def validate_consistency(self) -> "LiveMarketContext":
+        if self.fresh_count > self.total_tracked_symbols:
+            raise ValueError("fresh_count cannot exceed total_tracked_symbols")
+        if self.advancers + self.decliners > self.fresh_count:
+            raise ValueError("advancers plus decliners cannot exceed fresh_count")
+        return self
+
+    def get_symbol_features(self, symbol: str) -> SymbolMarketFeatures | None:
+        normalized = symbol.strip().upper()
+        direct = self.symbol_features.get(normalized)
+        if direct is not None:
+            return direct
+        canonical = _canonicalize_symbol(normalized)
+        for known_symbol, features in self.symbol_features.items():
+            if _canonicalize_symbol(known_symbol) == canonical:
+                return features
+        return None
+
+
+class MarketContextScore(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    symbol: str
+    direction: str
+    context_timestamp: int | None
+    confidence: float = Field(ge=0.0, le=1.0)
+    long_tailwind: float = Field(ge=-1.0, le=1.0)
+    short_tailwind: float = Field(ge=-1.0, le=1.0)
+    breadth_score: float = Field(ge=-1.0, le=1.0)
+    btc_alignment_score: float = Field(ge=-1.0, le=1.0)
+    cross_asset_confirmation: float = Field(ge=-1.0, le=1.0)
+    market_stress_score: float = Field(ge=0.0, le=1.0)
+    followthrough_score: float = Field(ge=-1.0, le=1.0)
+    adverse_excursion_risk: float = Field(ge=0.0, le=1.0)
+    override_strength: float = Field(ge=0.0, le=1.0)
+    supportiveness_score: float = Field(ge=-1.0, le=1.0)
+    metadata: dict[str, Any] = Field(default_factory=dict)
+
+    @field_validator("symbol")
+    @classmethod
+    def validate_symbol(cls, value: str) -> str:
+        return value.strip().upper()
+
+    @field_validator("direction")
+    @classmethod
+    def validate_direction(cls, value: str) -> str:
+        return _normalize_direction(value)
+
+
+class SignalContextEvaluation(BaseModel):
+    model_config = ConfigDict(arbitrary_types_allowed=True, extra="forbid")
+
+    symbol: str
+    direction: str
+    local_score: float
+    local_features: dict[str, float]
+    adjusted_score: float
+    emit: bool = Field(default=True)
+    context_score: MarketContextScore
+
+
+# ---------------------------------------------------------------------------
+# Bot / trade payloads (pybinbot BotBase surface, shared/autotrade.py:73-89)
+# ---------------------------------------------------------------------------
+
+
+class RecoveryParams(BaseModel):
+    enabled: bool = True
+    max_recovery_attempts: int = 1
+    recovery_margin_pct: float = 0.0
+
+
+class OrderBase(BaseModel):
+    order_id: int = 0
+    order_type: str = ""
+    time_in_force: str = ""
+    timestamp: float = 0
+    order_side: str = ""
+    pair: str = ""
+    qty: float = 0
+    status: str = ""
+    price: float = 0
+    deal_type: str = "base_order"
+
+
+class DealBase(BaseModel):
+    current_price: float = 0
+    take_profit_price: float = 0
+    trailling_stop_loss_price: float = 0
+    trailling_profit_price: float = 0
+    stop_loss_price: float = 0
+    total_commissions: float = 0
+    margin_loan_id: int = 0
+    margin_short_loan_principal: float = 0
+    opening_price: float = 0
+    opening_qty: float = 0
+    opening_timestamp: float = 0
+    closing_price: float = 0
+    closing_qty: float = 0
+    closing_timestamp: float = 0
+
+
+class BotBase(BaseModel):
+    """Bot creation payload sent to the binbot REST API."""
+
+    model_config = ConfigDict(use_enum_values=True)
+
+    pair: str
+    name: str = "terminal"
+    fiat: str = "USDT"
+    quote_asset: str = ""
+    fiat_order_size: float = 15.0
+    candlestick_interval: str = "15m"
+    close_condition: CloseConditions = CloseConditions.dynamic_trailing
+    cooldown: int = 0
+    dynamic_trailing: bool = False
+    logs: list[str] = Field(default_factory=list)
+    mode: str = "manual"
+    status: Status = Status.inactive
+    stop_loss: float = 0.0
+    take_profit: float = 2.3
+    trailing: bool = True
+    trailing_deviation: float = 0.63
+    trailing_profit: float = 2.3
+    margin_short_reversal: bool = False
+    position: Position = Position.long
+    market_type: MarketType = MarketType.SPOT
+    leverage: float = 1.0
+    recovery_params: RecoveryParams | None = None
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+
+class OrderModel(OrderBase):
+    pass
+
+
+class DealModel(DealBase):
+    @field_validator("margin_loan_id", mode="before")
+    @classmethod
+    def validate_margin_loan_id(cls, value: Any) -> Any:
+        if isinstance(value, float):
+            return int(value)
+        return value
+
+
+class BotModel(BotBase):
+    """Bot as returned by the binbot API (id + deal + orders filled in)."""
+
+    id: UUID = Field(default_factory=uuid4)
+    deal: DealModel = Field(default_factory=DealModel)
+    orders: list[OrderModel] = Field(default_factory=list)
+
+    model_config = ConfigDict(from_attributes=True, use_enum_values=True)
+
+
+class BotResponse(BaseModel):
+    message: str = ""
+    error: int = 0
+    data: BotModel | None = None
+
+
+# ---------------------------------------------------------------------------
+# Grid deployment (pybinbot GridDeploymentRequest surface,
+# strategies/grid/ladder_deployer.py:116-130)
+# ---------------------------------------------------------------------------
+
+
+class GridDeploymentRequest(BaseModel):
+    symbol: str
+    fiat: str
+    exchange: str
+    market_type: MarketType
+    algorithm_name: str
+    generated_at: datetime
+    range_low: float
+    range_high: float
+    breakout_low: float
+    breakout_high: float
+    total_margin: float
+    level_count: int
+    leverage: float = 1.0
+    metadata: dict[str, Any] = Field(default_factory=dict)
+
+    model_config = ConfigDict(use_enum_values=True)
+
+
+# ---------------------------------------------------------------------------
+# The Signal object (pybinbot SignalsConsumer surface)
+# ---------------------------------------------------------------------------
+
+
+class HABollinguerSpread(BaseModel):
+    bb_high: float = 0.0
+    bb_mid: float = 0.0
+    bb_low: float = 0.0
+
+
+class SignalsConsumer(BaseModel):
+    """The Signal emitted to all three sinks (telegram/analytics/autotrade)."""
+
+    autotrade: bool = False
+    current_price: float = 0.0
+    direction: str = "LONG"
+    score: float = 0.0
+    volume: float = 0.0
+    signal_kind: SignalKind = SignalKind.standard
+    algorithm_name: str = ""
+    symbol: str = ""
+    bot_params: BotBase | None = None
+    grid_params: GridDeploymentRequest | None = None
+    bb_spreads: HABollinguerSpread | None = None
+    metadata: dict[str, Any] = Field(default_factory=dict)
+
+    model_config = ConfigDict(use_enum_values=True)
+
+
+# ---------------------------------------------------------------------------
+# Symbols & settings (pybinbot SymbolModel / AutotradeSettingsSchema surface)
+# ---------------------------------------------------------------------------
+
+
+class SymbolModel(BaseModel):
+    id: str
+    base_asset: str = ""
+    quote_asset: str = "USDT"
+    active: bool = True
+    is_margin_trading_allowed: bool = False
+    price_precision: int = 6
+    qty_precision: int = 6
+    min_notional: float = 5.0
+    cooldown: int = 0
+    cooldown_start_ts: int = 0
+    leverage: float = 1.0
+    blacklist_reason: str = ""
+
+
+class AutotradeSettingsSchema(BaseModel):
+    autotrade: bool = False
+    exchange_id: str = "binance"
+    market_type: MarketType = MarketType.SPOT
+    candlestick_interval: str = "15m"
+    fiat: str = "USDT"
+    base_order_size: float = 15.0
+    stop_loss: float = 3.0
+    take_profit: float = 2.3
+    trailing: bool = True
+    trailing_deviation: float = 0.63
+    trailing_profit: float = 2.3
+    autoswitch: bool = False
+    max_active_autotrade_bots: int = 10
+    grid_total_margin: float = 10.0
+    grid_level_count: int = 7
+    max_active_grid_ladders: int = 3
+    test_autotrade: bool = False
+
+    model_config = ConfigDict(use_enum_values=True)
+
+
+class TestAutotradeSettingsSchema(AutotradeSettingsSchema):
+    test_autotrade: bool = True
+
+
+class MarketBreadthSeries(BaseModel):
+    """Rolling market-breadth time series from the binbot analytics API."""
+
+    timestamp: list[int] = Field(default_factory=list)
+    market_breadth: list[float] = Field(default_factory=list)
+    market_breadth_ma: list[float] = Field(default_factory=list)
+    adp: list[float] = Field(default_factory=list)
+    adp_ma: list[float] = Field(default_factory=list)
+    advancers: list[float] = Field(default_factory=list)
+    decliners: list[float] = Field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Structured strategy decisions (reference models/strategies.py:4-15)
+# ---------------------------------------------------------------------------
+
+
+class BBExtremeReversionDecision(BaseModel):
+    fired: bool
+    direction: str | None = None
+    reason: str = ""
+    connors_rsi: float | None = None
+    close: float | None = None
+    bb_high: float | None = None
+    bb_low: float | None = None
+    score: float = 0.0

@@ -1,0 +1,62 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh *before* jax is imported so that
+multi-chip sharding paths (binquant_tpu.parallel) are exercised on any host,
+mirroring how the driver dry-runs the multichip path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("ENV", "CI")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_ohlcv(
+    rng: np.random.Generator,
+    n: int = 400,
+    start_price: float = 100.0,
+    vol: float = 0.01,
+    drift: float = 0.0,
+    interval_ms: int = 900_000,
+    t0: int = 1_700_000_000_000,
+):
+    """Random-walk OHLCV arrays shaped like one symbol's window."""
+    rets = rng.normal(drift, vol, size=n)
+    close = start_price * np.exp(np.cumsum(rets))
+    open_ = np.concatenate([[start_price], close[:-1]])
+    spread = np.abs(rng.normal(0, vol / 2, size=n)) * close
+    high = np.maximum(open_, close) + spread
+    low = np.minimum(open_, close) - spread
+    volume = np.abs(rng.normal(1000, 250, size=n))
+    open_time = t0 + interval_ms * np.arange(n, dtype=np.int64)
+    return {
+        "open_time": open_time,
+        "close_time": open_time + interval_ms - 1,
+        "open": open_,
+        "high": high,
+        "low": low,
+        "close": close,
+        "volume": volume,
+        "quote_asset_volume": volume * close,
+        "number_of_trades": np.abs(rng.normal(500, 100, size=n)),
+        "taker_buy_base_volume": volume * 0.5,
+        "taker_buy_quote_volume": volume * close * 0.5,
+    }
+
+
+@pytest.fixture
+def ohlcv(rng):
+    return make_ohlcv(rng)

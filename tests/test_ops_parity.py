@@ -1,0 +1,218 @@
+"""Numerical parity of JAX kernels vs a pandas oracle.
+
+This is the correctness gate SURVEY.md §7 prescribes: the reference is
+explicit that indicator-variant drift silently shifts strategy thresholds
+(``/root/reference/strategies/mean_reversion_fade.py:44-49``), so every
+kernel is pinned against the exact pandas expression the reference uses.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from binquant_tpu.ops import indicators as ind
+from binquant_tpu.ops import rolling as roll
+
+ATOL = 2e-3
+RTOL = 2e-4
+
+
+def assert_close(jax_out, pandas_out, atol=ATOL, rtol=RTOL, tail_only=None):
+    a = np.asarray(jax_out, dtype=np.float64)
+    b = np.asarray(pandas_out, dtype=np.float64)
+    if tail_only:
+        a, b = a[-tail_only:], b[-tail_only:]
+    mask_a, mask_b = np.isfinite(a), np.isfinite(b)
+    np.testing.assert_array_equal(mask_a, mask_b, err_msg="NaN mask mismatch")
+    np.testing.assert_allclose(a[mask_a], b[mask_b], atol=atol, rtol=rtol)
+
+
+@pytest.fixture
+def series(ohlcv):
+    return {k: pd.Series(v) for k, v in ohlcv.items()}
+
+
+class TestRolling:
+    def test_shift(self, ohlcv):
+        x = jnp.asarray(ohlcv["close"])
+        assert_close(roll.shift(x, 3), pd.Series(ohlcv["close"]).shift(3))
+        assert_close(roll.shift(x, -2), pd.Series(ohlcv["close"]).shift(-2))
+
+    @pytest.mark.parametrize("window,mp", [(20, None), (14, 1), (96, 48)])
+    def test_rolling_mean(self, ohlcv, window, mp):
+        x = jnp.asarray(ohlcv["close"])
+        expected = pd.Series(ohlcv["close"]).rolling(window, min_periods=mp).mean()
+        assert_close(roll.rolling_mean(x, window, mp), expected)
+
+    def test_rolling_mean_with_leading_nan(self, ohlcv):
+        c = ohlcv["close"].copy()
+        c[:37] = np.nan
+        expected = pd.Series(c).rolling(20, min_periods=1).mean()
+        assert_close(roll.rolling_mean(jnp.asarray(c), 20, 1), expected)
+
+    @pytest.mark.parametrize("ddof", [0, 1])
+    def test_rolling_std(self, ohlcv, ddof):
+        x = jnp.asarray(ohlcv["close"])
+        expected = pd.Series(ohlcv["close"]).rolling(20).std(ddof=ddof)
+        assert_close(roll.rolling_std(x, 20, ddof=ddof), expected)
+
+    def test_rolling_std_large_prices(self, rng):
+        # float32 stability at BTC-scale magnitudes
+        c = 68_000.0 + np.cumsum(rng.normal(0, 30, size=400))
+        expected = pd.Series(c).rolling(20).std(ddof=0)
+        assert_close(roll.rolling_std(jnp.asarray(c), 20, ddof=0), expected, atol=0.5, rtol=1e-3)
+
+    def test_rolling_max_min(self, ohlcv):
+        x = jnp.asarray(ohlcv["high"])
+        assert_close(roll.rolling_max(x, 48), pd.Series(ohlcv["high"]).rolling(48).max())
+        assert_close(roll.rolling_min(x, 48), pd.Series(ohlcv["high"]).rolling(48).min())
+
+    @pytest.mark.parametrize("q", [0.5, 0.8, 0.92])
+    def test_rolling_quantile(self, ohlcv, q):
+        x = jnp.asarray(ohlcv["volume"])
+        expected = pd.Series(ohlcv["volume"]).rolling(48).quantile(q)
+        assert_close(roll.rolling_quantile(x, 48, q), expected)
+
+    def test_rolling_median_shifted(self, ohlcv):
+        # shifted rolling median — the activity_burst_pump baseline pattern
+        x = roll.shift(jnp.asarray(ohlcv["volume"]), 1)
+        expected = pd.Series(ohlcv["volume"]).shift(1).rolling(24).median()
+        assert_close(roll.rolling_median(x, 24), expected)
+
+    @pytest.mark.parametrize("span", [7, 20, 26, 50, 100])
+    def test_ewm_span(self, ohlcv, span):
+        x = jnp.asarray(ohlcv["close"])
+        expected = pd.Series(ohlcv["close"]).ewm(span=span, adjust=False, min_periods=1).mean()
+        assert_close(roll.ewm_mean(x, span=span, min_periods=1), expected)
+
+    def test_ewm_alpha_with_min_periods(self, ohlcv):
+        x = jnp.asarray(ohlcv["close"])
+        expected = (
+            pd.Series(ohlcv["close"]).ewm(alpha=1 / 14, adjust=False, min_periods=14).mean()
+        )
+        assert_close(roll.ewm_mean(x, alpha=1 / 14, min_periods=14), expected)
+
+    def test_ewm_with_leading_nan(self, ohlcv):
+        c = ohlcv["close"].copy()
+        c[:53] = np.nan
+        expected = pd.Series(c).ewm(span=20, adjust=False, min_periods=1).mean()
+        assert_close(roll.ewm_mean(jnp.asarray(c), span=20, min_periods=1), expected)
+
+    def test_batched_matches_single(self, rng):
+        xs = np.stack([rng.normal(100, 5, 200) for _ in range(8)])
+        batched = roll.rolling_mean(jnp.asarray(xs), 20)
+        for i in range(8):
+            single = roll.rolling_mean(jnp.asarray(xs[i]), 20)
+            np.testing.assert_allclose(
+                np.asarray(batched[i]), np.asarray(single), atol=1e-5, equal_nan=True
+            )
+
+
+class TestIndicators:
+    def test_rsi_wilder(self, series, ohlcv):
+        # exact expression from the reference backtest kernel
+        closes = series["close"]
+        delta = closes.diff()
+        gain = delta.clip(lower=0)
+        loss = -delta.clip(upper=0)
+        avg_gain = gain.ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+        avg_loss = loss.ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+        denom = avg_gain + avg_loss
+        expected = (100 * avg_gain / denom).where(denom != 0, 50.0)
+        assert_close(ind.rsi_wilder(jnp.asarray(ohlcv["close"]), 14), expected, atol=0.05)
+
+    def test_rsi_sma(self, series, ohlcv):
+        closes = series["close"]
+        delta = closes.diff()
+        gain = delta.clip(lower=0).rolling(14).mean()
+        loss = (-delta.clip(upper=0)).rolling(14).mean()
+        denom = gain + loss
+        expected = (100 * gain / denom).where(denom != 0, 50.0)
+        assert_close(ind.rsi_sma(jnp.asarray(ohlcv["close"]), 14), expected, atol=0.05)
+
+    def test_true_range_and_atr(self, series, ohlcv):
+        h, low, c = series["high"], series["low"], series["close"]
+        prev = c.shift(1)
+        tr = pd.concat([h - low, (h - prev).abs(), (low - prev).abs()], axis=1).max(axis=1)
+        expected_atr = tr.rolling(14, min_periods=1).mean()
+        got = ind.atr(
+            jnp.asarray(ohlcv["high"]), jnp.asarray(ohlcv["low"]), jnp.asarray(ohlcv["close"]),
+            14, min_periods=1,
+        )
+        assert_close(got, expected_atr)
+
+    def test_macd(self, series, ohlcv):
+        c = series["close"]
+        line = (
+            c.ewm(span=12, adjust=False).mean() - c.ewm(span=26, adjust=False).mean()
+        )
+        sig = line.ewm(span=9, adjust=False).mean()
+        got = ind.macd(jnp.asarray(ohlcv["close"]))
+        assert_close(got.macd, line, atol=5e-3)
+        assert_close(got.signal, sig, atol=5e-3)
+
+    def test_bollinger(self, series, ohlcv):
+        c = series["close"]
+        mid = c.rolling(20, min_periods=1).mean()
+        std = c.rolling(20, min_periods=1).std(ddof=0).fillna(0.0)
+        got = ind.bollinger(jnp.asarray(ohlcv["close"]), 20, 2.0, min_periods=1)
+        assert_close(got.upper, mid + 2 * std)
+        assert_close(got.lower, mid - 2 * std)
+
+    def test_mfi_bounds_and_direction(self, ohlcv):
+        got = np.asarray(
+            ind.mfi(
+                jnp.asarray(ohlcv["high"]),
+                jnp.asarray(ohlcv["low"]),
+                jnp.asarray(ohlcv["close"]),
+                jnp.asarray(ohlcv["volume"]),
+            )
+        )
+        valid = got[np.isfinite(got)]
+        assert valid.size > 350
+        assert np.all(valid >= 0) and np.all(valid <= 100)
+
+    def test_zscore(self, series, ohlcv):
+        c = series["close"]
+        mu = c.rolling(20).mean()
+        sd = c.rolling(20).std(ddof=0)
+        expected = (c - mu) / sd
+        assert_close(ind.zscore(jnp.asarray(ohlcv["close"]), 20), expected, atol=5e-3)
+
+    def test_rolling_beta_corr(self, rng):
+        bench = rng.normal(0, 0.01, 300)
+        asset = 1.5 * bench + rng.normal(0, 0.005, 300)
+        sb, sa = pd.Series(bench), pd.Series(asset)
+        expected_corr = sa.rolling(50).corr(sb)
+        expected_beta = sa.rolling(50).cov(sb, ddof=0) / sb.rolling(50).var(ddof=0)
+        got = ind.rolling_beta_corr(jnp.asarray(asset), jnp.asarray(bench), 50)
+        assert_close(got.corr, expected_corr, atol=5e-3)
+        assert_close(got.beta, expected_beta, atol=5e-3)
+
+    def test_adx_in_bounds(self, ohlcv):
+        got = np.asarray(
+            ind.adx(jnp.asarray(ohlcv["high"]), jnp.asarray(ohlcv["low"]), jnp.asarray(ohlcv["close"]))
+        )
+        valid = got[np.isfinite(got)]
+        assert valid.size > 300
+        assert np.all(valid >= 0) and np.all(valid <= 100)
+
+    def test_supertrend_flips_with_trend(self, rng):
+        up = 100 * np.exp(np.cumsum(np.full(150, 0.01)))
+        down = up[-1] * np.exp(np.cumsum(np.full(150, -0.01)))
+        c = np.concatenate([up, down])
+        h, low = c * 1.002, c * 0.998
+        got = ind.supertrend(jnp.asarray(h), jnp.asarray(low), jnp.asarray(c))
+        d = np.asarray(got.direction)
+        assert d[140] == 1.0
+        assert d[-1] == -1.0
+
+    def test_connors_rsi_extremes(self):
+        # monotonic rally then crash → CRSI should sit near the extremes
+        up = 100 * np.exp(np.cumsum(np.full(200, 0.004)))
+        c = np.concatenate([up, up[-1] * np.exp(np.cumsum(np.full(10, -0.02)))])
+        got = np.asarray(ind.connors_rsi(jnp.asarray(c)))
+        assert got[195] > 60
+        assert got[-1] < 25

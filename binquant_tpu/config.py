@@ -11,9 +11,7 @@ from __future__ import annotations
 import os
 from functools import cached_property
 
-
-class ConfigurationError(Exception):
-    pass
+from binquant_tpu.exceptions import ConfigurationError
 
 
 _REQUIRED_VARS = (
@@ -61,7 +59,9 @@ class Config:
 
     @property
     def env(self) -> str:
-        return os.environ.get("ENV", "CI")
+        # No silent default: a production deploy that forgets ENV must fail
+        # validation loudly, not slide into the CI bypass.
+        return os.environ.get("ENV", "")
 
     @property
     def is_ci(self) -> bool:

@@ -1,0 +1,25 @@
+"""Engine: resident device ring buffer + the jit'd per-tick step.
+
+Replaces the reference's per-message pandas state juggling
+(``/root/reference/market_regime/market_state_store.py``,
+``/root/reference/consumers/klines_provider.py``) with a fixed-shape
+``(S, W, F)`` device array updated in place each tick and consumed by one
+compiled step over all symbols.
+"""
+
+from binquant_tpu.engine.buffer import (  # noqa: F401
+    FIELDS,
+    NUM_FIELDS,
+    Field,
+    IngestBatcher,
+    MarketBuffer,
+    SymbolRegistry,
+    apply_updates,
+    empty_buffer,
+    field,
+    fresh_mask,
+    ms_to_s,
+    reset_rows,
+    s_to_ms,
+    valid_mask,
+)

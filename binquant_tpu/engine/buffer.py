@@ -1,0 +1,344 @@
+"""Resident market ring buffer: the TPU-native MarketStateStore.
+
+The reference keeps one pandas DataFrame per symbol and, per candle, does
+concat → drop_duplicates(keep="last") → sort → tail(max_bars)
+(``/root/reference/market_regime/market_state_store.py:19-32``). Here the
+whole market lives in one fixed-shape device array ``(S symbols, W bars,
+F fields)`` that is updated by a single jit'd batched operation per tick:
+
+* **Right-aligned windows**: index ``W-1`` is always the latest bar, so every
+  downstream kernel reads ``[..., -1]`` for "now" without indexing through a
+  write pointer; warm-up slots hold NaN (values) / -1 (times), which the ops
+  kernels already treat as missing.
+* **Batched scatter-update**: all candles that arrived in a tick are applied
+  at once. Per symbol the update resolves exactly like the reference's
+  dedupe+sort: newer timestamp → shift-append, equal timestamp → overwrite
+  last bar, older timestamp → ignored (out-of-order frame).
+* **Freshness is exact-timestamp equality** with the evaluated tick, as in
+  ``get_fresh_symbols`` (``market_state_store.py:49-54``).
+
+**Time representation**: device-side times are int32 *seconds* since epoch
+(kline open times are second-aligned; int32 avoids JAX x64 mode, whose
+implicit float64 promotion is hostile to TPU). The host edge converts ms↔s
+via :func:`ms_to_s` / :func:`s_to_ms`.
+
+The symbol registry is host-side bookkeeping (symbols enter/leave the
+universe) mapping names to stable row indices with a free list; the device
+never sees strings.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from binquant_tpu.exceptions import BufferCapacityError
+
+
+class Field(IntEnum):
+    """Column layout of the values array. Superset of the reference's
+    required candle fields (``market_state_store.py:70``) plus the extra
+    Binance kline payload fields several strategies consume."""
+
+    OPEN = 0
+    HIGH = 1
+    LOW = 2
+    CLOSE = 3
+    VOLUME = 4
+    QUOTE_VOLUME = 5
+    NUM_TRADES = 6
+    TAKER_BUY_BASE = 7
+    TAKER_BUY_QUOTE = 8
+    DURATION_S = 9  # bar interval in whole seconds (rounded, f32-exact)
+
+
+FIELDS: tuple[str, ...] = tuple(f.name.lower() for f in Field)
+NUM_FIELDS = len(Field)
+
+
+def ms_to_s(ts_ms: int | np.ndarray) -> np.ndarray | int:
+    """Millisecond epoch → second epoch (device representation)."""
+    if isinstance(ts_ms, np.ndarray):
+        return (ts_ms // 1000).astype(np.int32)
+    return int(ts_ms) // 1000
+
+
+def s_to_ms(ts_s: int | np.ndarray) -> np.ndarray | int:
+    if isinstance(ts_s, np.ndarray):
+        return ts_s.astype(np.int64) * 1000
+    return int(ts_s) * 1000
+
+
+class MarketBuffer(NamedTuple):
+    """Pytree carried across ticks (device-resident)."""
+
+    times: jnp.ndarray  # (S, W) int32 open-time seconds, -1 where empty
+    values: jnp.ndarray  # (S, W, F) float32, NaN where empty
+    filled: jnp.ndarray  # (S,) int32 count of valid bars (<= W)
+
+    @property
+    def capacity(self) -> int:
+        return self.times.shape[0]
+
+    @property
+    def window(self) -> int:
+        return self.times.shape[1]
+
+    @property
+    def latest_times(self) -> jnp.ndarray:
+        return self.times[:, -1]
+
+
+def empty_buffer(num_symbols: int, window: int = 400) -> MarketBuffer:
+    return MarketBuffer(
+        times=jnp.full((num_symbols, window), -1, dtype=jnp.int32),
+        values=jnp.full((num_symbols, window, NUM_FIELDS), jnp.nan, dtype=jnp.float32),
+        filled=jnp.zeros((num_symbols,), dtype=jnp.int32),
+    )
+
+
+@jax.jit
+def apply_updates(
+    buf: MarketBuffer,
+    row_idx: jnp.ndarray,  # (U,) int32 registry rows; out-of-range rows ignored
+    ts: jnp.ndarray,  # (U,) int32 open-time seconds
+    vals: jnp.ndarray,  # (U, F) float32
+) -> MarketBuffer:
+    """Apply one tick's worth of closed candles in a single fused update.
+
+    Duplicate rows within a batch must be pre-deduped host-side (keep last) —
+    the IngestBatcher does this; scatter order on duplicates is undefined.
+    """
+    S, W = buf.times.shape
+
+    # Invalid rows map to index S (strictly out of bounds) so mode="drop"
+    # actually drops them; clipping would collide with a real row's update
+    # and duplicate-scatter order is undefined on TPU.
+    in_range = (row_idx >= 0) & (row_idx < S)
+    safe_idx = jnp.where(in_range, row_idx, S)
+    ts = ts.astype(jnp.int32)
+
+    # Scatter the batch into per-symbol slots: -1 means "no update this tick".
+    upd_ts = jnp.full((S,), -1, dtype=jnp.int32).at[safe_idx].set(ts, mode="drop")
+    upd_vals = (
+        jnp.zeros((S, NUM_FIELDS), dtype=jnp.float32)
+        .at[safe_idx]
+        .set(vals.astype(jnp.float32), mode="drop")
+    )
+
+    last_ts = buf.times[:, -1]
+    has_update = upd_ts >= 0
+    is_append = has_update & ((buf.filled == 0) | (upd_ts > last_ts))
+    is_replace = has_update & (buf.filled > 0) & (upd_ts == last_ts)
+
+    # Candidate A: shift-left append (oldest bar falls off the front).
+    app_times = jnp.concatenate([buf.times[:, 1:], upd_ts[:, None]], axis=1)
+    app_vals = jnp.concatenate([buf.values[:, 1:, :], upd_vals[:, None, :]], axis=1)
+
+    # Candidate B: overwrite the latest bar in place.
+    rep_times = buf.times.at[:, -1].set(jnp.where(is_replace, upd_ts, last_ts))
+    rep_vals = jnp.where(
+        is_replace[:, None, None],
+        buf.values.at[:, -1, :].set(upd_vals),
+        buf.values,
+    )
+
+    sel_a = is_append[:, None]
+    times = jnp.where(sel_a, app_times, rep_times)
+    values = jnp.where(sel_a[..., None], app_vals, rep_vals)
+    filled = jnp.where(
+        is_append, jnp.minimum(buf.filled + 1, W), buf.filled
+    ).astype(jnp.int32)
+    return MarketBuffer(times=times, values=values, filled=filled)
+
+
+@jax.jit
+def fresh_mask(buf: MarketBuffer, timestamp_s: jnp.ndarray) -> jnp.ndarray:
+    """(S,) bool — symbols whose latest closed bar is exactly `timestamp_s`
+    (reference ``get_fresh_symbols``, ``market_state_store.py:49-54``)."""
+    return (buf.filled > 0) & (buf.times[:, -1] == timestamp_s)
+
+
+@jax.jit
+def valid_mask(buf: MarketBuffer) -> jnp.ndarray:
+    """(S, W) bool — True where a real bar is stored."""
+    return buf.times >= 0
+
+
+def field(buf: MarketBuffer, f: Field) -> jnp.ndarray:
+    """(S, W) view of one OHLCV field."""
+    return buf.values[:, :, int(f)]
+
+
+class SymbolRegistry:
+    """Host-side symbol↔row mapping with a free list.
+
+    Symbols joining the tracked universe claim the lowest free row; symbols
+    leaving release their row (cleared eagerly via :func:`reset_rows` by the
+    engine). Capacity is static so jit'd shapes never change.
+    """
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._name_to_row: dict[str, int] = {}
+        self._row_to_name: dict[int, str] = {}
+        self._free: list[int] = list(range(capacity - 1, -1, -1))  # pop() → lowest
+
+    def __len__(self) -> int:
+        return len(self._name_to_row)
+
+    def __contains__(self, symbol: str) -> bool:
+        return self._norm(symbol) in self._name_to_row
+
+    @staticmethod
+    def _norm(symbol: str) -> str:
+        return symbol.strip().upper()
+
+    def row_of(self, symbol: str) -> int | None:
+        return self._name_to_row.get(self._norm(symbol))
+
+    def name_of(self, row: int) -> str | None:
+        return self._row_to_name.get(row)
+
+    def add(self, symbol: str) -> int:
+        """Return the symbol's row, claiming one if new. Raises when full."""
+        key = self._norm(symbol)
+        row = self._name_to_row.get(key)
+        if row is not None:
+            return row
+        if not self._free:
+            raise BufferCapacityError(
+                f"SymbolRegistry full ({self.capacity}); grow the buffer capacity"
+            )
+        row = self._free.pop()
+        self._name_to_row[key] = row
+        self._row_to_name[row] = key
+        return row
+
+    def remove(self, symbol: str) -> int | None:
+        key = self._norm(symbol)
+        row = self._name_to_row.pop(key, None)
+        if row is not None:
+            del self._row_to_name[row]
+            self._free.append(row)
+        return row
+
+    def rows_for(self, symbols: list[str], add_missing: bool = True) -> np.ndarray:
+        out = np.empty(len(symbols), dtype=np.int32)
+        for i, s in enumerate(symbols):
+            if add_missing:
+                out[i] = self.add(s)
+            else:
+                row = self.row_of(s)
+                out[i] = -1 if row is None else row
+        return out
+
+    @property
+    def names(self) -> list[str]:
+        return sorted(self._name_to_row)
+
+    @property
+    def active_rows(self) -> np.ndarray:
+        """(S,) bool mask of occupied rows."""
+        mask = np.zeros(self.capacity, dtype=bool)
+        for row in self._row_to_name:
+            mask[row] = True
+        return mask
+
+
+def reset_rows(buf: MarketBuffer, rows: jnp.ndarray) -> MarketBuffer:
+    """Clear specific rows (symbols that left the universe)."""
+    S, W = buf.times.shape
+    # Remap negatives to S: JAX normalizes negative indices *before* the
+    # drop-mode bounds check, so -1 would wrap and wipe row S-1.
+    rows = jnp.where((rows >= 0) & (rows < S), rows, S)
+    mask = jnp.zeros((S,), dtype=bool).at[rows].set(True, mode="drop")
+    return MarketBuffer(
+        times=jnp.where(mask[:, None], -1, buf.times).astype(jnp.int32),
+        values=jnp.where(mask[:, None, None], jnp.nan, buf.values),
+        filled=jnp.where(mask, 0, buf.filled).astype(jnp.int32),
+    )
+
+
+class IngestBatcher:
+    """Host-side accumulator turning per-candle dicts into one device update.
+
+    Collects ``ExtendedKline``-shaped payloads between ticks, dedupes by
+    (symbol, open_time) keep-last — matching the reference's
+    ``drop_duplicates(subset=["timestamp"], keep="last")`` per symbol — and
+    emits dense (row_idx, ts_s, vals) arrays for :func:`apply_updates`.
+    When a symbol has candles for several timestamps pending (a late frame
+    plus the current one), :meth:`drain` yields one sub-batch per timestamp
+    rank, oldest first, so sequential ``apply_updates`` calls replay them in
+    order. Known divergence from the reference: a frame older than a
+    symbol's latest stored bar cannot rewrite mid-history (fixed-shape
+    device buffer drops it); the reference's sort+dedupe would.
+    """
+
+    def __init__(self, registry: SymbolRegistry) -> None:
+        self.registry = registry
+        self._pending: dict[tuple[str, int], np.ndarray] = {}
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def add(self, kline: dict | object) -> None:
+        get = (
+            kline.get
+            if isinstance(kline, dict)
+            else lambda k, d=0.0: getattr(kline, k, d)
+        )
+        symbol = str(get("symbol", "")).strip().upper()
+        if not symbol:
+            return  # malformed kline; never claim a registry row for ""
+        open_time_ms = int(get("open_time", 0))
+        close_time_ms = int(get("close_time", 0)) or open_time_ms
+        row = np.array(
+            [
+                float(get("open", 0.0)),
+                float(get("high", 0.0)),
+                float(get("low", 0.0)),
+                float(get("close", 0.0)),
+                float(get("volume", 0.0)),
+                float(get("quote_asset_volume", 0.0)),
+                float(get("number_of_trades", 0.0)),
+                float(get("taker_buy_base_volume", 0.0)),
+                float(get("taker_buy_quote_volume", 0.0)),
+                # round, don't floor: Binance close_time is open+interval-1ms
+                float(round((close_time_ms - open_time_ms) / 1000.0)),
+            ],
+            dtype=np.float32,
+        )
+        self._pending[(symbol, ms_to_s(open_time_ms))] = row
+
+    def drain(self) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """List of (row_idx (U,), ts_s (U,), vals (U, F)) sub-batches, each
+        with at most one candle per symbol, ordered oldest-timestamp-first
+        per symbol. Usually length 1; clears pending state."""
+        per_symbol: dict[str, list[tuple[int, np.ndarray]]] = {}
+        for (symbol, t), v in self._pending.items():
+            per_symbol.setdefault(symbol, []).append((t, v))
+        max_depth = 0
+        for entries in per_symbol.values():
+            entries.sort(key=lambda e: e[0])
+            max_depth = max(max_depth, len(entries))
+
+        batches: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        for depth in range(max_depth):
+            rows_d = [
+                (self.registry.add(sym), *entries[depth])
+                for sym, entries in per_symbol.items()
+                if len(entries) > depth
+            ]
+            row_idx = np.array([r for r, _, _ in rows_d], dtype=np.int32)
+            ts = np.array([t for _, t, _ in rows_d], dtype=np.int32)
+            vals = np.stack([v for _, _, v in rows_d]).astype(np.float32)
+            batches.append((row_idx, ts, vals))
+        # Clear only after every registry.add() has succeeded, so a full
+        # registry raises without losing the whole tick's candles.
+        self._pending.clear()
+        return batches

@@ -41,6 +41,9 @@ __all__ = [
     "rolling_quantile",
     "rolling_median",
     "ewm_mean",
+    "ewm_mean_last",
+    "rolling_mean_last",
+    "rolling_std_last",
     "cummax",
     "cummin",
 ]
@@ -145,7 +148,7 @@ def _rolling_extremum(
         window_strides=(1, 1),
         padding=((0, 0), (window - 1, 0)),
     ).reshape(orig_shape)
-    _, cnt = _window_sums(jnp.where(m, 1.0, jnp.nan), window)
+    _, cnt = _window_sums(x, window)
     return jnp.where(cnt >= mp, out, jnp.nan)
 
 
@@ -246,7 +249,15 @@ def ewm_mean(
 
     m = _finite(x)
     xf = jnp.where(m, x, 0.0).astype(jnp.float32)
-    base = jnp.einsum("ts,...s->...t", A, xf, preferred_element_type=jnp.float32)
+    # precision=HIGHEST: default matmul precision lowers f32 operands to
+    # bf16 on TPU — fatal for EMA-of-price differences (MACD etc.).
+    base = jnp.einsum(
+        "ts,...s->...t",
+        A,
+        xf,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
 
     # warm-start correction: locate first valid sample per row
     s0 = jnp.argmax(m, axis=-1)  # first True (0 if none — masked below)
@@ -261,6 +272,75 @@ def ewm_mean(
     seen = rel + 1
     ok = (rel >= 0) & (seen >= max(min_periods, 1)) & any_valid[..., None]
     return jnp.where(ok, y, jnp.nan)
+
+
+def ewm_mean_last(
+    x: jnp.ndarray,
+    alpha: float | None = None,
+    span: float | None = None,
+    min_periods: int = 0,
+) -> jnp.ndarray:
+    """Last value of :func:`ewm_mean` in O(W) per row instead of O(W²).
+
+    The hot per-tick path only consumes the latest EMA; this contracts
+    against the decay matrix's final row (a plain weighted sum) plus the same
+    closed-form warm-start correction.
+    """
+    if alpha is None:
+        if span is None:
+            raise ValueError("ewm_mean_last requires alpha or span")
+        alpha = 2.0 / (span + 1.0)
+    W = x.shape[-1]
+    d = 1.0 - alpha
+    # weights[s] = alpha * d^(W-1-s)
+    w = jnp.asarray(
+        alpha * np.power(1.0 - alpha, np.arange(W - 1, -1, -1), dtype=np.float64),
+        dtype=jnp.float32,
+    )
+    m = _finite(x)
+    xf = jnp.where(m, x, 0.0).astype(jnp.float32)
+    base = jnp.einsum(
+        "s,...s->...",
+        w,
+        xf,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    s0 = jnp.argmax(m, axis=-1)
+    any_valid = jnp.any(m, axis=-1)
+    x0 = jnp.take_along_axis(x, s0[..., None], axis=-1)[..., 0]
+    rel = (W - 1) - s0  # position of the last column relative to first valid
+    corr = jnp.power(jnp.float32(d), (rel + 1).astype(jnp.float32)) * x0
+    y = base + corr
+    ok = any_valid & (rel + 1 >= max(min_periods, 1))
+    return jnp.where(ok, y, jnp.nan)
+
+
+def rolling_mean_last(
+    x: jnp.ndarray, window: int, min_periods: int | None = None
+) -> jnp.ndarray:
+    """Last value of :func:`rolling_mean` from just the trailing slice."""
+    tail = x[..., -window:]
+    m = _finite(tail)
+    cnt = jnp.sum(m, axis=-1)
+    mp = max(_resolve_min_periods(window, min_periods), 1)
+    mean = jnp.sum(jnp.where(m, tail, 0.0), axis=-1) / jnp.maximum(cnt, 1)
+    return jnp.where(cnt >= mp, mean, jnp.nan)
+
+
+def rolling_std_last(
+    x: jnp.ndarray, window: int, min_periods: int | None = None, ddof: int = 1
+) -> jnp.ndarray:
+    """Last value of :func:`rolling_std` from just the trailing slice."""
+    tail = x[..., -window:]
+    m = _finite(tail)
+    cnt = jnp.sum(m, axis=-1)
+    mp = max(_resolve_min_periods(window, min_periods), 1)
+    mean = jnp.sum(jnp.where(m, tail, 0.0), axis=-1) / jnp.maximum(cnt, 1)
+    sq = jnp.sum(jnp.where(m, (tail - mean[..., None]) ** 2, 0.0), axis=-1)
+    var = sq / jnp.maximum(cnt - ddof, 1)
+    ok = (cnt >= mp) & (cnt > ddof)
+    return jnp.where(ok, jnp.sqrt(jnp.maximum(var, 0.0)), jnp.nan)
 
 
 def cummax(x: jnp.ndarray) -> jnp.ndarray:

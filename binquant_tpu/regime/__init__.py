@@ -1,0 +1,42 @@
+"""Market-regime subsystem, batched and jit-compiled.
+
+TPU-native re-design of ``/root/reference/market_regime/``: instead of a
+Python loop over fresh symbols building pydantic objects per candle, the
+whole market context — per-symbol features, masked aggregates, stress and
+tailwind scores, macro+micro regime ladders, and transition events vs the
+carried previous state — is computed for all S symbols in one compiled
+function. Categorical regimes live as int32 codes on device
+(``binquant_tpu.enums``); the host edge materializes pydantic
+``LiveMarketContext`` objects only for symbols that actually emit.
+"""
+
+from binquant_tpu.regime.context import (  # noqa: F401
+    ContextConfig,
+    MarketContext,
+    RegimeCarry,
+    SymbolFeatureArrays,
+    compute_market_context,
+    compute_symbol_features,
+    initial_regime_carry,
+)
+from binquant_tpu.regime.grid_policy import GridOnlyPolicy  # noqa: F401
+from binquant_tpu.regime.routing import (  # noqa: F401
+    DEFAULT_REGIME_STABILITY_S,
+    allows_long_autotrade_mask,
+    is_regime_stable,
+    long_autotrade_decision,
+    regime_age_s,
+)
+from binquant_tpu.regime.scoring import (  # noqa: F401
+    ContextScoreArrays,
+    ScorerWeights,
+    SignalEvaluation,
+    adjust_score,
+    evaluate_context_score,
+    score_signal_candidate,
+)
+from binquant_tpu.regime.time_filter import (  # noqa: F401
+    build_quiet_hours_signal_msg,
+    is_autotrade_suppressed,
+    is_quiet_hours,
+)

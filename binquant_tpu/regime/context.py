@@ -1,0 +1,629 @@
+"""Batched market-context construction + regime annotation.
+
+Re-implements, as one jit'd pass over the ``(S, W)`` market buffer, what the
+reference does per candle in Python:
+
+* per-symbol features — EMA20/50, ATR-14, BB-20/2σ width, trend score,
+  last-bar return (``live_market_context_accumulator.py:244-297``),
+* coverage gates — ≥40 fresh symbols AND ≥70% of the tracked universe
+  (``live_market_context_accumulator.py:13-14,95-103,196-204``),
+* RS-vs-BTC rewrite — ``return_pct - btc_return`` for every non-BTC symbol
+  (``l.117-123``),
+* masked aggregates — advancers/decliners, breadth, %>EMA, average
+  trend/ATR/BB-width (``l.135-163``),
+* derived scores — btc_regime_score, market_stress_score, long/short
+  tailwinds with the reference's exact weights (``l.165-194``),
+* macro regime ladder + transition event/strength/stable-since and
+  per-symbol micro regime ladder + transitions
+  (``regime_transitions.py:45-232``) against a carried previous state.
+
+Scalar formulas are kept bit-identical to the reference (same clamps, same
+weights) so the pandas-oracle parity tests can assert to float tolerance.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.enums import (
+    MarketRegimeCode,
+    MarketTransitionCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.ops.indicators import true_range
+from binquant_tpu.ops.rolling import (
+    ewm_mean_last,
+    rolling_mean_last,
+    rolling_std_last,
+)
+from binquant_tpu.utils import jclamp, jnon_negative, jsafe_div, jsafe_pct
+
+# Reference constants (live_market_context_accumulator.py:13-14,
+# regime_transitions.py:23)
+REQUIRED_FRESH_SYMBOLS = 40
+MIN_COVERAGE_RATIO = 0.70
+TRANSITION_STRENGTH_FLOOR = 0.08
+
+
+class ContextConfig(NamedTuple):
+    """Static gate thresholds (overridable for small-universe tests)."""
+
+    required_fresh_symbols: int = REQUIRED_FRESH_SYMBOLS
+    min_coverage_ratio: float = MIN_COVERAGE_RATIO
+
+
+class SymbolFeatureArrays(NamedTuple):
+    """Per-symbol feature batch, (S,) each. ``valid`` gates everything."""
+
+    valid: jnp.ndarray  # bool — fresh & >=2 bars
+    timestamp: jnp.ndarray  # int32 seconds of latest bar
+    close: jnp.ndarray
+    return_pct: jnp.ndarray
+    ema20: jnp.ndarray
+    ema50: jnp.ndarray
+    above_ema20: jnp.ndarray  # bool
+    above_ema50: jnp.ndarray  # bool
+    trend_score: jnp.ndarray
+    relative_strength_vs_btc: jnp.ndarray
+    atr_pct: jnp.ndarray
+    bb_width: jnp.ndarray
+    micro_regime: jnp.ndarray  # int32 MicroRegimeCode, -1 where invalid
+    micro_regime_strength: jnp.ndarray
+    micro_transition: jnp.ndarray  # int32 MicroTransitionCode, -1 none
+    micro_transition_strength: jnp.ndarray
+
+
+class RegimeCarry(NamedTuple):
+    """Cross-tick regime state (the reference's previous-context lookup)."""
+
+    has_prev: jnp.ndarray  # bool scalar
+    market_regime: jnp.ndarray  # int32 scalar MarketRegimeCode
+    market_scores: jnp.ndarray  # (4,) long/short/range/stress
+    stable_since: jnp.ndarray  # int32 seconds
+    micro_has_prev: jnp.ndarray  # (S,) bool
+    micro_regime: jnp.ndarray  # (S,) int32
+    micro_strength: jnp.ndarray  # (S,)
+
+
+class MarketContext(NamedTuple):
+    """Device-side LiveMarketContext: scalars + per-symbol feature batch."""
+
+    valid: jnp.ndarray  # bool — coverage gates passed
+    timestamp: jnp.ndarray  # int32 seconds
+    fresh_count: jnp.ndarray  # int32 (effective_count)
+    total_tracked_symbols: jnp.ndarray  # int32
+    coverage_ratio: jnp.ndarray
+    btc_present: jnp.ndarray  # bool
+    advancers: jnp.ndarray  # int32
+    decliners: jnp.ndarray  # int32
+    advancers_ratio: jnp.ndarray
+    decliners_ratio: jnp.ndarray
+    advancers_decliners_ratio: jnp.ndarray
+    average_return: jnp.ndarray
+    average_relative_strength_vs_btc: jnp.ndarray
+    pct_above_ema20: jnp.ndarray
+    pct_above_ema50: jnp.ndarray
+    average_trend_score: jnp.ndarray
+    average_atr_pct: jnp.ndarray
+    average_bb_width: jnp.ndarray
+    btc_return: jnp.ndarray
+    btc_trend_score: jnp.ndarray
+    btc_regime_score: jnp.ndarray
+    market_stress_score: jnp.ndarray
+    long_tailwind: jnp.ndarray
+    short_tailwind: jnp.ndarray
+    market_regime: jnp.ndarray  # int32 MarketRegimeCode
+    previous_market_regime: jnp.ndarray  # int32, -1 none
+    market_regime_transition: jnp.ndarray  # int32 MarketTransitionCode, -1 none
+    market_regime_transition_strength: jnp.ndarray
+    long_regime_score: jnp.ndarray
+    short_regime_score: jnp.ndarray
+    range_regime_score: jnp.ndarray
+    stress_regime_score: jnp.ndarray
+    regime_is_transitioning: jnp.ndarray  # bool
+    regime_stable_since: jnp.ndarray  # int32 seconds
+    features: SymbolFeatureArrays
+
+
+def initial_regime_carry(num_symbols: int) -> RegimeCarry:
+    return RegimeCarry(
+        has_prev=jnp.asarray(False),
+        market_regime=jnp.asarray(-1, dtype=jnp.int32),
+        market_scores=jnp.zeros((4,), dtype=jnp.float32),
+        stable_since=jnp.asarray(-1, dtype=jnp.int32),
+        micro_has_prev=jnp.zeros((num_symbols,), dtype=bool),
+        micro_regime=jnp.full((num_symbols,), -1, dtype=jnp.int32),
+        micro_strength=jnp.zeros((num_symbols,), dtype=jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-symbol features (live_market_context_accumulator.py:244-297)
+# ---------------------------------------------------------------------------
+
+
+def compute_symbol_features(
+    buf: MarketBuffer, eligible: jnp.ndarray
+) -> SymbolFeatureArrays:
+    """Batched `_compute_symbol_features` over every buffer row.
+
+    ``eligible`` is the fresh mask; a row is valid when additionally it has
+    ≥2 bars (the reference's ``len(history) < 2`` early-out). RS-vs-BTC is
+    filled by :func:`compute_market_context` (it needs BTC's return).
+    """
+    close = buf.values[:, :, Field.CLOSE]
+    high = buf.values[:, :, Field.HIGH]
+    low = buf.values[:, :, Field.LOW]
+
+    latest_close = close[:, -1]
+    prev_close = close[:, -2]
+
+    # last-value kernels: the per-tick path reads only the latest bar's
+    # indicator values, so avoid materializing full-window series (O(W) per
+    # row instead of O(W²) for the EWM matmuls).
+    ema20 = ewm_mean_last(close, span=20, min_periods=1)
+    ema50 = ewm_mean_last(close, span=50, min_periods=1)
+    tr_tail = true_range(high[:, -15:], low[:, -15:], close[:, -15:])
+    atr = rolling_mean_last(tr_tail, 14, min_periods=1)
+    mid = rolling_mean_last(close, 20, min_periods=1)
+    std = rolling_std_last(close, 20, min_periods=1, ddof=0)
+    std = jnp.where(jnp.isfinite(std), std, 0.0)  # pandas .fillna(0.0)
+
+    bb_upper = mid + 2.0 * std
+    bb_lower = mid - 2.0 * std
+    atr_pct = jnp.where(latest_close != 0, jsafe_div(atr, latest_close), 0.0)
+    bb_width = jnp.where(mid != 0, jsafe_div(bb_upper - bb_lower, jnp.abs(mid)), 0.0)
+    trend_score = jnp.where(ema50 != 0, jsafe_div(ema20 - ema50, jnp.abs(ema50)), 0.0)
+
+    valid = eligible & (buf.filled >= 2)
+    return SymbolFeatureArrays(
+        valid=valid,
+        timestamp=buf.times[:, -1],
+        close=latest_close,
+        return_pct=jsafe_pct(latest_close, prev_close),
+        ema20=ema20,
+        ema50=ema50,
+        above_ema20=latest_close > ema20,
+        above_ema50=latest_close > ema50,
+        trend_score=trend_score,
+        relative_strength_vs_btc=jnp.zeros_like(latest_close),
+        atr_pct=atr_pct,
+        bb_width=bb_width,
+        micro_regime=jnp.full(latest_close.shape, -1, dtype=jnp.int32),
+        micro_regime_strength=jnp.zeros_like(latest_close),
+        micro_transition=jnp.full(latest_close.shape, -1, dtype=jnp.int32),
+        micro_transition_strength=jnp.zeros_like(latest_close),
+    )
+
+
+def _masked_mean(x: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+    return jsafe_div(jnp.sum(jnp.where(mask, x, 0.0)), jnp.maximum(count, 1))
+
+
+# ---------------------------------------------------------------------------
+# Macro regime ladder + transitions (regime_transitions.py:45-160)
+# ---------------------------------------------------------------------------
+
+
+def _market_transition_event(
+    prev_regime: jnp.ndarray, regime: jnp.ndarray
+) -> jnp.ndarray:
+    """Vector decision table of `_market_transition_event` (l.234-249)."""
+    T = MarketTransitionCode
+    R = MarketRegimeCode
+    return jnp.where(
+        regime == R.HIGH_STRESS,
+        T.STRESS_SPIKE,
+        jnp.where(
+            (prev_regime == R.HIGH_STRESS) & (regime != R.HIGH_STRESS),
+            T.STRESS_RELIEF,
+            jnp.where(
+                regime == R.TREND_UP,
+                T.ENTERED_TREND_UP,
+                jnp.where(
+                    regime == R.TREND_DOWN,
+                    T.ENTERED_TREND_DOWN,
+                    jnp.where(regime == R.RANGE, T.ENTERED_RANGE, T.LOST_REGIME_EDGE),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+
+def _micro_transition_event(
+    prev_regime: jnp.ndarray, regime: jnp.ndarray
+) -> jnp.ndarray:
+    """Vector decision table of `_symbol_transition_event` (l.251-278)."""
+    T = MicroTransitionCode
+    R = MicroRegimeCode
+    from_range_like = (prev_regime == R.RANGE) | (prev_regime == R.TRANSITIONAL)
+    return jnp.where(
+        regime == R.VOLATILE,
+        T.VOLATILITY_EXPANSION,
+        jnp.where(
+            from_range_like & (regime == R.TREND_UP),
+            T.BREAKOUT_UP,
+            jnp.where(
+                from_range_like & (regime == R.TREND_DOWN),
+                T.BREAKDOWN,
+                jnp.where(
+                    (prev_regime == R.TREND_DOWN) & (regime == R.TREND_UP),
+                    T.RECOVERY,
+                    jnp.where(
+                        (prev_regime == R.TREND_UP) & (regime == R.RANGE),
+                        T.MEAN_REVERSION,
+                        jnp.where(
+                            regime == R.TREND_UP,
+                            T.ENTERED_TREND_UP,
+                            jnp.where(
+                                regime == R.TREND_DOWN,
+                                T.ENTERED_TREND_DOWN,
+                                jnp.where(
+                                    regime == R.RANGE,
+                                    T.ENTERED_RANGE,
+                                    T.ENTERED_TRANSITIONAL,
+                                ),
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+
+def _annotate_market_regime(
+    ctx: dict[str, jnp.ndarray], carry: RegimeCarry, timestamp: jnp.ndarray
+) -> dict[str, jnp.ndarray]:
+    """Macro scores → regime ladder → transition annotation (l.45-160)."""
+    R = MarketRegimeCode
+    breadth_score = jclamp((ctx["advancers_ratio"] - 0.5) / 0.25)
+    trend_participation = jclamp(
+        ((ctx["pct_above_ema20"] + ctx["pct_above_ema50"]) - 1.0) * 1.4
+    )
+    avg_trend_bias = jclamp(ctx["average_trend_score"] * 20.0)
+    calm_score = jclamp(1.0 - ctx["market_stress_score"], 0.0, 1.0)
+
+    long_score = jclamp(
+        0.3 * jnon_negative(ctx["long_tailwind"])
+        + 0.24 * jnon_negative(ctx["btc_regime_score"])
+        + 0.2 * jnon_negative(breadth_score)
+        + 0.14 * jnon_negative(trend_participation)
+        + 0.12 * calm_score,
+        0.0,
+        1.0,
+    )
+    short_score = jclamp(
+        0.28 * jnon_negative(ctx["short_tailwind"])
+        + 0.24 * jnon_negative(-ctx["btc_regime_score"])
+        + 0.16 * jnon_negative(-breadth_score)
+        + 0.1 * jnon_negative(-avg_trend_bias)
+        + 0.22 * ctx["market_stress_score"],
+        0.0,
+        1.0,
+    )
+    range_score = jclamp(
+        0.32 * (1.0 - jnp.abs(breadth_score))
+        + 0.22 * (1.0 - jnp.abs(ctx["btc_regime_score"]))
+        + 0.24 * calm_score
+        + 0.12 * (1.0 - jnp.abs(avg_trend_bias))
+        + 0.1 * (1.0 - jnp.abs(ctx["long_tailwind"] - ctx["short_tailwind"])),
+        0.0,
+        1.0,
+    )
+    stress_score = jclamp(
+        0.7 * ctx["market_stress_score"]
+        + 0.18 * jnon_negative(-ctx["average_return"] * 20.0)
+        + 0.12 * jnon_negative(short_score - long_score),
+        0.0,
+        1.0,
+    )
+
+    dominant = jnp.maximum(
+        jnp.maximum(long_score, short_score), jnp.maximum(range_score, stress_score)
+    )
+    regime = jnp.where(
+        (stress_score >= 0.5) & (ctx["market_stress_score"] >= 0.35),
+        R.HIGH_STRESS,
+        jnp.where(
+            (long_score >= 0.44) & (long_score >= short_score + 0.08),
+            R.TREND_UP,
+            jnp.where(
+                (short_score >= 0.42) & (short_score >= long_score + 0.08),
+                R.TREND_DOWN,
+                jnp.where(range_score >= 0.5, R.RANGE, R.TRANSITIONAL),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+    prev_regime = jnp.where(carry.has_prev, carry.market_regime, -1).astype(jnp.int32)
+    changed = carry.has_prev & (prev_regime != regime)
+
+    scores = jnp.stack([long_score, short_score, range_score, stress_score])
+    max_delta = jnp.max(jnp.abs(scores - carry.market_scores))
+    transition_strength = jnp.where(
+        changed, jclamp(dominant + max_delta - 0.25, 0.0, 1.0), 0.0
+    )
+    transition = jnp.where(
+        changed, _market_transition_event(prev_regime, regime), -1
+    ).astype(jnp.int32)
+    regime_is_transitioning = (regime == R.TRANSITIONAL) | (
+        changed & (transition_strength >= TRANSITION_STRENGTH_FLOOR)
+    )
+
+    # stable_since anchoring (l.151-160): reset unless the carried regime is
+    # unchanged and had a valid anchor.
+    keep_anchor = carry.has_prev & (prev_regime == regime) & (carry.stable_since >= 0)
+    stable_since = jnp.where(keep_anchor, carry.stable_since, timestamp).astype(
+        jnp.int32
+    )
+
+    ctx.update(
+        market_regime=regime,
+        previous_market_regime=prev_regime,
+        market_regime_transition=transition,
+        market_regime_transition_strength=transition_strength,
+        long_regime_score=long_score,
+        short_regime_score=short_score,
+        range_regime_score=range_score,
+        stress_regime_score=stress_score,
+        regime_is_transitioning=regime_is_transitioning,
+        regime_stable_since=stable_since,
+    )
+    return ctx
+
+
+def _annotate_micro_regimes(
+    feats: SymbolFeatureArrays, carry: RegimeCarry
+) -> SymbolFeatureArrays:
+    """Per-symbol regime ladder + transitions, batched (l.162-232)."""
+    R = MicroRegimeCode
+    up_score = jclamp(
+        0.45 * jnon_negative(feats.trend_score * 30.0)
+        + 0.2 * feats.above_ema20.astype(jnp.float32)
+        + 0.15 * feats.above_ema50.astype(jnp.float32)
+        + 0.2 * jnon_negative(feats.relative_strength_vs_btc * 20.0),
+        0.0,
+        1.0,
+    )
+    down_score = jclamp(
+        0.45 * jnon_negative(-feats.trend_score * 30.0)
+        + 0.2 * (~feats.above_ema20).astype(jnp.float32)
+        + 0.15 * (~feats.above_ema50).astype(jnp.float32)
+        + 0.2 * jnon_negative(-feats.relative_strength_vs_btc * 20.0),
+        0.0,
+        1.0,
+    )
+    range_score = jclamp(
+        0.38 * (1.0 - jnp.minimum(jnp.abs(feats.trend_score) * 30.0, 1.0))
+        + 0.34 * (1.0 - jnp.minimum(feats.bb_width / 0.08, 1.0))
+        + 0.28 * (1.0 - jnp.minimum(feats.atr_pct / 0.04, 1.0)),
+        0.0,
+        1.0,
+    )
+    volatile_score = jclamp(
+        0.55 * jnp.minimum(feats.atr_pct / 0.05, 1.0)
+        + 0.45 * jnp.minimum(feats.bb_width / 0.12, 1.0),
+        0.0,
+        1.0,
+    )
+
+    strength = jnp.maximum(
+        jnp.maximum(up_score, down_score), jnp.maximum(range_score, volatile_score)
+    )
+    regime = jnp.where(
+        (volatile_score >= 0.72) & (jnp.abs(feats.return_pct) >= 0.015),
+        R.VOLATILE,
+        jnp.where(
+            (up_score >= 0.52) & (up_score >= down_score + 0.1),
+            R.TREND_UP,
+            jnp.where(
+                (down_score >= 0.52) & (down_score >= up_score + 0.1),
+                R.TREND_DOWN,
+                jnp.where(range_score >= 0.5, R.RANGE, R.TRANSITIONAL),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+    had_prev = carry.micro_has_prev & (carry.micro_regime >= 0)
+    changed = had_prev & (carry.micro_regime != regime)
+    transition = jnp.where(
+        changed, _micro_transition_event(carry.micro_regime, regime), -1
+    ).astype(jnp.int32)
+    transition_strength = jnp.where(
+        changed,
+        jclamp(strength + jnp.abs(strength - carry.micro_strength) - 0.25, 0.0, 1.0),
+        0.0,
+    )
+
+    return feats._replace(
+        micro_regime=jnp.where(feats.valid, regime, -1).astype(jnp.int32),
+        micro_regime_strength=jnp.where(feats.valid, strength, 0.0),
+        micro_transition=jnp.where(feats.valid, transition, -1).astype(jnp.int32),
+        micro_transition_strength=jnp.where(feats.valid, transition_strength, 0.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Full context build (_build_context, l.95-242)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def compute_market_context(
+    buf: MarketBuffer,
+    fresh: jnp.ndarray,  # (S,) bool — latest bar == evaluated tick
+    tracked: jnp.ndarray,  # (S,) bool — registry-occupied rows
+    btc_row: jnp.ndarray,  # int32 scalar; -1 when BTC untracked
+    timestamp: jnp.ndarray,  # int32 seconds tick being evaluated
+    carry: RegimeCarry,
+    cfg: ContextConfig = ContextConfig(),
+) -> tuple[MarketContext, RegimeCarry]:
+    """One tick's LiveMarketContext for the whole market + updated carry.
+
+    When the coverage gates fail, ``context.valid`` is False and the carry is
+    returned unchanged (the reference returns None and keeps the previous
+    context as the transition anchor).
+    """
+    S = buf.capacity
+    feats = compute_symbol_features(buf, fresh & tracked)
+
+    # --- BTC features: taken from its row even when BTC itself is not fresh
+    # (the reference computes them from the store regardless, l.105-106).
+    btc_ok = (btc_row >= 0) & (btc_row < S)
+    safe_btc = jnp.clip(btc_row, 0, S - 1)
+    btc_has_bars = buf.filled[safe_btc] >= 2
+    btc_present = btc_ok & btc_has_bars
+    btc_return = jnp.where(btc_present, feats.return_pct[safe_btc], 0.0)
+    btc_trend = jnp.where(btc_present, feats.trend_score[safe_btc], 0.0)
+
+    # --- RS-vs-BTC rewrite (l.117-123): every symbol except BTC itself.
+    is_btc_row = jnp.arange(S) == safe_btc
+    rs = jnp.where(
+        btc_present & ~is_btc_row, feats.return_pct - btc_return, 0.0
+    )
+    feats = feats._replace(relative_strength_vs_btc=rs)
+
+    # --- masked aggregates (l.135-163)
+    m = feats.valid
+    effective = jnp.sum(m.astype(jnp.int32))
+    total_tracked = jnp.sum(tracked.astype(jnp.int32))
+    total_tracked = jnp.maximum(total_tracked, effective)  # l.196
+
+    advancers = jnp.sum((m & (feats.return_pct > 0)).astype(jnp.int32))
+    decliners = jnp.sum((m & (feats.return_pct < 0)).astype(jnp.int32))
+    advancers_ratio = jsafe_div(advancers, jnp.maximum(effective, 1))
+    decliners_ratio = jsafe_div(decliners, jnp.maximum(effective, 1))
+    adv_dec_ratio = jsafe_div(advancers, jnp.maximum(decliners, 1))
+
+    average_return = _masked_mean(feats.return_pct, m, effective)
+    average_rs = _masked_mean(feats.relative_strength_vs_btc, m, effective)
+    pct_above_ema20 = _masked_mean(feats.above_ema20.astype(jnp.float32), m, effective)
+    pct_above_ema50 = _masked_mean(feats.above_ema50.astype(jnp.float32), m, effective)
+    average_trend = _masked_mean(feats.trend_score, m, effective)
+    average_atr_pct = _masked_mean(feats.atr_pct, m, effective)
+    average_bb_width = _masked_mean(feats.bb_width, m, effective)
+
+    # --- derived scores (l.165-194)
+    breadth_balance = jclamp((advancers_ratio - decliners_ratio) * 1.5)
+    ema_balance = jclamp(((pct_above_ema20 + pct_above_ema50) - 1.0) * 1.5)
+    average_return_score = jclamp(average_return * 12.0)
+    btc_regime_score = jnp.where(
+        btc_present, jclamp(btc_return * 12.0 + btc_trend * 6.0), 0.0
+    )
+    stress_from_volatility = jclamp((average_atr_pct - 0.02) * 12.0, 0.0, 1.0)
+    stress_from_bandwidth = jclamp((average_bb_width - 0.08) * 4.0, 0.0, 1.0)
+    stress_from_selloff = jclamp((-average_return) * 16.0, 0.0, 1.0)
+    market_stress_score = (
+        0.4 * stress_from_volatility
+        + 0.25 * stress_from_bandwidth
+        + 0.35 * stress_from_selloff
+    )
+    long_tailwind = jclamp(
+        0.4 * breadth_balance
+        + 0.2 * ema_balance
+        + 0.25 * btc_regime_score
+        + 0.15 * average_return_score
+        - 0.35 * market_stress_score
+    )
+    short_tailwind = jclamp(
+        -0.35 * breadth_balance
+        - 0.15 * ema_balance
+        - 0.2 * btc_regime_score
+        - 0.15 * average_return_score
+        + 0.45 * market_stress_score
+    )
+
+    # --- coverage gates (l.95-103, 196-204)
+    required = jnp.maximum(
+        cfg.required_fresh_symbols,
+        jnp.ceil(total_tracked * cfg.min_coverage_ratio).astype(jnp.int32),
+    )
+    coverage_ratio = jsafe_div(effective, jnp.maximum(total_tracked, 1))
+    valid = (
+        (effective >= required)
+        & (total_tracked > 0)
+        & (effective >= cfg.required_fresh_symbols)
+        & (coverage_ratio >= cfg.min_coverage_ratio)
+    )
+
+    ctx: dict[str, jnp.ndarray] = dict(
+        advancers_ratio=advancers_ratio,
+        pct_above_ema20=pct_above_ema20,
+        pct_above_ema50=pct_above_ema50,
+        average_trend_score=average_trend,
+        average_return=average_return,
+        market_stress_score=market_stress_score,
+        btc_regime_score=btc_regime_score,
+        long_tailwind=long_tailwind,
+        short_tailwind=short_tailwind,
+    )
+    ctx = _annotate_market_regime(ctx, carry, timestamp)
+    feats = _annotate_micro_regimes(feats, carry)
+
+    context = MarketContext(
+        valid=valid,
+        timestamp=timestamp.astype(jnp.int32),
+        fresh_count=effective,
+        total_tracked_symbols=total_tracked,
+        coverage_ratio=coverage_ratio,
+        btc_present=btc_present,
+        advancers=advancers,
+        decliners=decliners,
+        advancers_ratio=advancers_ratio,
+        decliners_ratio=decliners_ratio,
+        advancers_decliners_ratio=adv_dec_ratio,
+        average_return=average_return,
+        average_relative_strength_vs_btc=average_rs,
+        pct_above_ema20=pct_above_ema20,
+        pct_above_ema50=pct_above_ema50,
+        average_trend_score=average_trend,
+        average_atr_pct=average_atr_pct,
+        average_bb_width=average_bb_width,
+        btc_return=btc_return,
+        btc_trend_score=btc_trend,
+        btc_regime_score=btc_regime_score,
+        market_stress_score=market_stress_score,
+        long_tailwind=long_tailwind,
+        short_tailwind=short_tailwind,
+        market_regime=ctx["market_regime"],
+        previous_market_regime=ctx["previous_market_regime"],
+        market_regime_transition=ctx["market_regime_transition"],
+        market_regime_transition_strength=ctx["market_regime_transition_strength"],
+        long_regime_score=ctx["long_regime_score"],
+        short_regime_score=ctx["short_regime_score"],
+        range_regime_score=ctx["range_regime_score"],
+        stress_regime_score=ctx["stress_regime_score"],
+        regime_is_transitioning=ctx["regime_is_transitioning"],
+        regime_stable_since=ctx["regime_stable_since"],
+        features=feats,
+    )
+
+    # --- carry update: only a valid context becomes the next previous-state
+    # (reference: None contexts are never stored, l.101-103).
+    new_scores = jnp.stack(
+        [
+            ctx["long_regime_score"],
+            ctx["short_regime_score"],
+            ctx["range_regime_score"],
+            ctx["stress_regime_score"],
+        ]
+    )
+    micro_update = valid & feats.valid
+    new_carry = RegimeCarry(
+        has_prev=carry.has_prev | valid,
+        market_regime=jnp.where(valid, ctx["market_regime"], carry.market_regime),
+        market_scores=jnp.where(valid, new_scores, carry.market_scores),
+        stable_since=jnp.where(valid, ctx["regime_stable_since"], carry.stable_since),
+        micro_has_prev=carry.micro_has_prev | micro_update,
+        micro_regime=jnp.where(micro_update, feats.micro_regime, carry.micro_regime),
+        micro_strength=jnp.where(
+            micro_update, feats.micro_regime_strength, carry.micro_strength
+        ),
+    )
+    return context, new_carry

@@ -1,0 +1,140 @@
+"""Grid-only trading policy (host edge).
+
+Equivalent of ``/root/reference/market_regime/grid_only_policy.py``: in
+RANGE/TRANSITIONAL regimes, non-flat market-breadth momentum flips the
+engine into "grid ladders only" mode (standard bots blocked). The breadth
+series arrives via REST from the analytics backend, so this is host-side
+code by nature — the resulting two booleans are fed into the autotrade gate
+chain (and mirrored into the device gate mask by the engine).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import isfinite
+from typing import Any, ClassVar
+
+from binquant_tpu.enums import MarketRegimeCode
+from binquant_tpu.schemas import MarketBreadthSeries
+
+
+def timestamp_sort_key(value: Any) -> float | None:
+    """Best-effort numeric sort key for mixed timestamp payloads."""
+    try:
+        parsed = float(value)
+    except (TypeError, ValueError):
+        return None
+    if not isfinite(parsed):
+        return None
+    return parsed
+
+
+@dataclass(frozen=True)
+class GridOnlyPolicy:
+    """Resolved policy decision (reference grid_only_policy.py:12-55)."""
+
+    GRID_ONLY_REGIMES: ClassVar[frozenset[int]] = frozenset(
+        {int(MarketRegimeCode.RANGE), int(MarketRegimeCode.TRANSITIONAL)}
+    )
+    BREADTH_SOURCES: ClassVar[tuple[tuple[str, bool], ...]] = (
+        ("market_breadth_ma", True),
+        ("market_breadth", True),
+    )
+
+    allow_grid_ladder: bool
+    block_standard_bots: bool
+    reason: str
+    direction: str | None = None
+    source: str | None = None
+    latest: float | None = None
+    previous: float | None = None
+    momentum_points: float | None = None
+
+    @classmethod
+    def disabled(cls, reason: str) -> "GridOnlyPolicy":
+        return cls(allow_grid_ladder=False, block_standard_bots=False, reason=reason)
+
+    @classmethod
+    def active(
+        cls, *, direction: str, source: str, latest: float, previous: float
+    ) -> "GridOnlyPolicy":
+        return cls(
+            allow_grid_ladder=True,
+            block_standard_bots=True,
+            reason=f"breadth_momentum_{direction}_{source}",
+            direction=direction,
+            source=source,
+            latest=latest,
+            previous=previous,
+            momentum_points=(latest - previous) * 100,
+        )
+
+    @staticmethod
+    def _coerce(value: Any) -> float | None:
+        try:
+            parsed = float(value)
+        except (TypeError, ValueError):
+            return None
+        return parsed if isfinite(parsed) else None
+
+    @classmethod
+    def _ordered_values(
+        cls, values: list[Any], timestamps: list[Any], *, newest_first: bool
+    ) -> list[float]:
+        """Order breadth values oldest→newest, preferring timestamp sort;
+        fall back to list order (reversed when the source is newest-first)."""
+        if len(values) >= 2 and len(timestamps) >= len(values):
+            stamped = [
+                (key, val)
+                for ts, v in zip(timestamps, values)
+                if (key := timestamp_sort_key(ts)) is not None
+                and (val := cls._coerce(v)) is not None
+            ]
+            if len(stamped) >= 2:
+                return [val for _, val in sorted(stamped, key=lambda item: item[0])]
+        parsed = [val for v in values if (val := cls._coerce(v)) is not None]
+        return list(reversed(parsed)) if newest_first else parsed
+
+    @classmethod
+    def _breadth_pair(
+        cls, breadth: MarketBreadthSeries | None
+    ) -> tuple[float, float, str] | None:
+        if breadth is None or len(breadth.timestamp) < 2:
+            return None
+        for source, newest_first in cls.BREADTH_SOURCES:
+            ordered = cls._ordered_values(
+                getattr(breadth, source), breadth.timestamp, newest_first=newest_first
+            )
+            if len(ordered) >= 2:
+                return ordered[-2], ordered[-1], source
+        return None
+
+    @classmethod
+    def resolve(
+        cls,
+        market_regime: int | None,
+        breadth: MarketBreadthSeries | None,
+    ) -> "GridOnlyPolicy":
+        """Decision ladder (grid_only_policy.py:121-158). ``market_regime``
+        is the int code from the device context; None/-1 = unavailable."""
+        if market_regime is None:
+            return cls.disabled("market_context_unavailable")
+        if market_regime < 0:
+            return cls.disabled("market_regime_unavailable")
+        if market_regime not in cls.GRID_ONLY_REGIMES:
+            name = MarketRegimeCode(market_regime).name.lower()
+            return cls.disabled(f"market_regime_{name}")
+
+        pair = cls._breadth_pair(breadth)
+        if pair is None:
+            return cls.disabled("breadth_momentum_unavailable")
+        previous, latest, source = pair
+        if abs(latest) > abs(previous):
+            return cls.active(
+                direction="toward_trend", source=source, latest=latest, previous=previous
+            )
+        if abs(latest) < abs(previous):
+            return cls.active(
+                direction="toward_range", source=source, latest=latest, previous=previous
+            )
+        return cls.disabled("breadth_momentum_flat")

@@ -1,0 +1,136 @@
+"""Autotrade regime routing, batched.
+
+Re-implements ``/root/reference/market_regime/regime_routing.py`` as masks
+over the whole symbol batch: the policy that blocks long autotrade on
+transitioning/unstable/hostile regimes (l.47-76) becomes one ``(S,)`` bool
+array computed inside the jit'd tick step, and a host-side explainer
+reproduces the same decision with a reason string for Telegram/analytics
+payloads (reasons are load-bearing in the reference's messages).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from binquant_tpu.enums import MarketRegimeCode, MicroRegimeCode, MicroTransitionCode
+from binquant_tpu.regime.context import MarketContext
+
+# Reference: 30 min minimum regime age (regime_routing.py:10), in seconds
+# (device times are int32 seconds).
+DEFAULT_REGIME_STABILITY_S = 30 * 60
+
+
+def regime_age_s(context: MarketContext) -> jnp.ndarray:
+    """Seconds the current market regime has held (clamped at 0); -1 when no
+    stability anchor exists yet (reference returns None)."""
+    has_anchor = context.regime_stable_since >= 0
+    age = jnp.maximum(context.timestamp - context.regime_stable_since, 0)
+    return jnp.where(has_anchor, age, -1)
+
+
+def is_regime_stable(
+    context: MarketContext, min_age_s: int = DEFAULT_REGIME_STABILITY_S
+) -> jnp.ndarray:
+    """Scalar bool: regime held ≥ min_age and no in-flight transition
+    (regime_routing.py:30-44)."""
+    age = regime_age_s(context)
+    return (
+        context.valid
+        & ~context.regime_is_transitioning
+        & (age >= 0)
+        & (age >= min_age_s)
+    )
+
+
+def allows_long_autotrade_mask(
+    context: MarketContext, min_age_s: int = DEFAULT_REGIME_STABILITY_S
+) -> jnp.ndarray:
+    """(S,) bool — the reference's `allows_long_autotrade(context, symbol)`
+    for every symbol at once (regime_routing.py:47-76).
+
+    Rows with no valid features fall back to the symbol-less policy
+    (market regime in {TREND_UP, RANGE}), as the reference does when
+    `resolve_symbol_features` returns None.
+    """
+    R = MarketRegimeCode
+    M = MicroRegimeCode
+
+    # is_regime_stable already enforces context.valid and
+    # ~regime_is_transitioning; with HIGH_STRESS/TREND_DOWN/TRANSITIONAL
+    # excluded, only TREND_UP and RANGE remain in the 5-regime ladder.
+    market_regime_ok = (context.market_regime == R.TREND_UP) | (
+        context.market_regime == R.RANGE
+    )
+    market_ok = (
+        is_regime_stable(context, min_age_s)
+        & market_regime_ok
+        & (context.market_stress_score < 0.35)
+    )
+
+    f = context.features
+    micro = f.micro_regime
+    micro_allows = jnp.where(
+        micro == M.TREND_DOWN,
+        f.micro_transition == MicroTransitionCode.RECOVERY,
+        jnp.where(
+            micro == M.VOLATILE,
+            False,
+            (micro == M.TREND_UP) | (micro == M.RANGE) | (micro == M.TRANSITIONAL),
+        ),
+    )
+    per_symbol = jnp.where(f.valid & (micro >= 0), micro_allows, market_regime_ok)
+    return market_ok & per_symbol
+
+
+# ---------------------------------------------------------------------------
+# Host-side explainer (reason strings for emitted payloads)
+# ---------------------------------------------------------------------------
+
+_MARKET_REGIME_NAMES = {c.value: c.name for c in MarketRegimeCode}
+_MICRO_REGIME_NAMES = {c.value: c.name for c in MicroRegimeCode}
+
+
+def long_autotrade_decision(
+    context_np: MarketContext, row: int, min_age_s: int = DEFAULT_REGIME_STABILITY_S
+) -> tuple[bool, str]:
+    """(allowed, reason) for one symbol row, from a host snapshot of the
+    context (numpy'd MarketContext). Mirrors the mask exactly; used by the
+    emission path to annotate blocked signals."""
+    c = context_np
+    if not bool(np.asarray(c.valid)):
+        return False, "market_context_unavailable"
+    if bool(np.asarray(c.regime_is_transitioning)):
+        return False, "regime_transitioning"
+    anchor = int(np.asarray(c.regime_stable_since))
+    if anchor < 0:
+        return False, "regime_stability_unknown"
+    age = max(int(np.asarray(c.timestamp)) - anchor, 0)
+    if age < min_age_s:
+        return False, f"regime_unstable_{age}s"
+    regime = int(np.asarray(c.market_regime))
+    name = _MARKET_REGIME_NAMES.get(regime, "UNKNOWN")
+    if name in {"HIGH_STRESS", "TREND_DOWN", "TRANSITIONAL"}:
+        return False, f"market_regime_{name.lower()}"
+    if float(np.asarray(c.market_stress_score)) >= 0.35:
+        return False, "market_stress_elevated"
+    if name not in {"TREND_UP", "RANGE"}:
+        return False, f"market_regime_{name.lower()}"
+    f = c.features
+    if not bool(np.asarray(f.valid)[row]) or int(np.asarray(f.micro_regime)[row]) < 0:
+        return True, f"market_regime_{name.lower()}_no_symbol_features"
+    micro = int(np.asarray(f.micro_regime)[row])
+    micro_name = _MICRO_REGIME_NAMES.get(micro, "UNKNOWN")
+    if micro == MicroRegimeCode.TREND_DOWN:
+        if int(np.asarray(f.micro_transition)[row]) == MicroTransitionCode.RECOVERY:
+            return True, "micro_trend_down_recovery"
+        return False, "micro_regime_trend_down"
+    if micro == MicroRegimeCode.VOLATILE:
+        return False, "micro_regime_volatile"
+    if micro in {
+        MicroRegimeCode.TREND_UP,
+        MicroRegimeCode.RANGE,
+        MicroRegimeCode.TRANSITIONAL,
+    }:
+        return True, f"micro_regime_{micro_name.lower()}"
+    return False, f"micro_regime_{micro_name.lower()}"

@@ -1,0 +1,180 @@
+"""Context-conditioned signal scoring, batched.
+
+Re-implements the reference's scoring seam as array math usable both inside
+the jit'd tick step (``(S,)`` batches) and on host scalars:
+
+* ``RuleBasedMarketContextModel.evaluate`` — direction-conditioned breadth/
+  BTC-alignment/cross-asset/override/supportiveness/followthrough/risk
+  formulas (``/root/reference/market_regime/context_scoring.py:39-114``),
+* ``SignalContextScorer.adjust_score`` — ``local + confidence·w_ctx·
+  (followthrough + w_sup·support − w_risk·risk)``
+  (``signal_context_scorer.py:15-29``),
+* ``score_signal_candidate_with_context`` — adjusted score + emit flag vs
+  threshold (``score_signal_candidate_with_context.py:8-41``).
+
+Every function broadcasts: pass scalars for one symbol (host edge) or
+``(S,)`` arrays + a direction mask for the whole batch (device path).
+The confidence of a valid context is 1.0 and of an invalid one 0.0, which
+reproduces the reference's empty-score fallback (scores collapse to zero
+and ``adjust_score`` returns the local score unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.utils import jclamp, jnon_negative
+
+
+class ContextScoreArrays(NamedTuple):
+    """Batched MarketContextScore (each field scalar or (S,))."""
+
+    confidence: jnp.ndarray
+    long_tailwind: jnp.ndarray
+    short_tailwind: jnp.ndarray
+    breadth_score: jnp.ndarray
+    btc_alignment_score: jnp.ndarray
+    cross_asset_confirmation: jnp.ndarray
+    market_stress_score: jnp.ndarray
+    followthrough_score: jnp.ndarray
+    adverse_excursion_risk: jnp.ndarray
+    override_strength: jnp.ndarray
+    supportiveness_score: jnp.ndarray
+
+
+class ScorerWeights(NamedTuple):
+    """SignalContextScorer weights (signal_context_scorer.py:11-13)."""
+
+    context_weight: float = 1.0
+    risk_weight: float = 0.5
+    support_weight: float = 0.35
+
+
+def evaluate_context_score(
+    context: MarketContext,
+    is_short: jnp.ndarray,
+    symbol_rs: jnp.ndarray,
+    symbol_trend: jnp.ndarray,
+) -> ContextScoreArrays:
+    """The RuleBasedMarketContextModel formulas, direction-vectorized.
+
+    ``is_short`` — bool scalar or (S,); ``symbol_rs``/``symbol_trend`` — the
+    per-symbol features (strategies may override them with local features,
+    matching ``_resolve_feature``).
+    """
+    confidence = jnp.where(context.valid, 1.0, 0.0)
+
+    breadth = jnp.where(is_short, context.short_tailwind, context.long_tailwind)
+    btc_align = jnp.where(
+        is_short,
+        jclamp(-context.btc_regime_score),
+        jclamp(context.btc_regime_score),
+    )
+    rs_signed = jnp.where(is_short, -symbol_rs, symbol_rs)
+    trend_signed = jnp.where(is_short, -symbol_trend, symbol_trend)
+    cross_asset = jclamp(0.6 * rs_signed + 0.4 * trend_signed)
+    override = jclamp(
+        0.6 * jnon_negative(rs_signed) + 0.4 * jnon_negative(trend_signed), 0.0, 1.0
+    )
+    directional_stress = jnp.where(
+        is_short,
+        context.market_stress_score * 0.35,
+        -context.market_stress_score,
+    )
+
+    supportiveness = jclamp(
+        0.35 * breadth
+        + 0.25 * btc_align
+        + 0.25 * cross_asset
+        + 0.15 * directional_stress
+    )
+    followthrough = jclamp(0.45 * breadth + 0.3 * btc_align + 0.25 * cross_asset)
+    risk = jclamp(
+        0.55 * context.market_stress_score
+        + 0.25 * jnon_negative(-supportiveness)
+        + 0.2 * (1.0 - override),
+        0.0,
+        1.0,
+    )
+
+    # Relative-strength override bumps (context_scoring.py:79-92)
+    weak_breadth_override = (breadth < 0) & (override > 0)
+    long_bump = weak_breadth_override & ~is_short
+    short_bump = weak_breadth_override & is_short
+    supportiveness = jnp.where(
+        long_bump, jclamp(supportiveness + 0.2 * override), supportiveness
+    )
+    followthrough = jnp.where(
+        long_bump, jclamp(followthrough + 0.15 * override), followthrough
+    )
+    supportiveness = jnp.where(
+        short_bump, jclamp(supportiveness + 0.1 * override), supportiveness
+    )
+
+    # Empty-score fallback: zero everything when the context is invalid.
+    z = confidence  # 1.0 valid / 0.0 invalid — multiplying zeroes the scores
+    return ContextScoreArrays(
+        confidence=confidence,
+        long_tailwind=context.long_tailwind * z,
+        short_tailwind=context.short_tailwind * z,
+        breadth_score=breadth * z,
+        btc_alignment_score=btc_align * z,
+        cross_asset_confirmation=cross_asset * z,
+        market_stress_score=context.market_stress_score * z,
+        followthrough_score=followthrough * z,
+        adverse_excursion_risk=risk * z,
+        override_strength=override * z,
+        supportiveness_score=supportiveness * z,
+    )
+
+
+def adjust_score(
+    local_score: jnp.ndarray,
+    score: ContextScoreArrays,
+    weights: ScorerWeights = ScorerWeights(),
+) -> jnp.ndarray:
+    """signal_context_scorer.py:15-29."""
+    delta = (
+        score.confidence
+        * weights.context_weight
+        * (
+            score.followthrough_score
+            + weights.support_weight * score.supportiveness_score
+            - weights.risk_weight * score.adverse_excursion_risk
+        )
+    )
+    return local_score + delta
+
+
+class SignalEvaluation(NamedTuple):
+    """Batched SignalContextEvaluation."""
+
+    local_score: jnp.ndarray
+    adjusted_score: jnp.ndarray
+    emit: jnp.ndarray
+    context_score: ContextScoreArrays
+
+
+def score_signal_candidate(
+    context: MarketContext,
+    is_short: jnp.ndarray,
+    local_score: jnp.ndarray,
+    symbol_rs: jnp.ndarray,
+    symbol_trend: jnp.ndarray,
+    weights: ScorerWeights = ScorerWeights(),
+    emit_threshold: float | None = None,
+) -> SignalEvaluation:
+    """The strategy integration seam
+    (score_signal_candidate_with_context.py:8-41), batched."""
+    cs = evaluate_context_score(context, is_short, symbol_rs, symbol_trend)
+    adjusted = adjust_score(local_score, cs, weights)
+    if emit_threshold is None:
+        emit = jnp.ones_like(adjusted, dtype=bool)
+    else:
+        emit = adjusted >= emit_threshold
+    return SignalEvaluation(
+        local_score=local_score, adjusted_score=adjusted, emit=emit, context_score=cs
+    )

@@ -1,0 +1,96 @@
+"""Time-of-day autotrade filter (host edge).
+
+Equivalent of ``/root/reference/shared/time_of_day_filter.py``: suppress
+autotrade activation during the 20:00–23:00 London quiet window unless the
+market is in a strong, stable trend. Wall-clock-dependent by design, so it
+stays host-side; the engine applies it when turning trigger masks into
+Signal emissions. The structured block message keeps the reference's
+key/value line shape so downstream Telegram parsers stay uniform.
+"""
+
+from __future__ import annotations
+
+import os
+from datetime import datetime
+from zoneinfo import ZoneInfo
+
+from binquant_tpu.enums import MarketRegimeCode, MarketTransitionCode
+
+LONDON = ZoneInfo("Europe/London")
+
+QUIET_START_HOUR = 20
+QUIET_END_HOUR = 23
+
+_OVERRIDE_REGIMES = {int(MarketRegimeCode.TREND_UP), int(MarketRegimeCode.TREND_DOWN)}
+_MIN_TRANSITION_STRENGTH = 0.7
+
+
+def _now_london(now: datetime | None = None) -> datetime:
+    if now is None:
+        now = datetime.now(tz=LONDON)
+    return now.astimezone(LONDON)
+
+
+def is_quiet_hours(now: datetime | None = None) -> bool:
+    """True when London-local hour is within [QUIET_START_HOUR, QUIET_END_HOUR)."""
+    return QUIET_START_HOUR <= _now_london(now).hour < QUIET_END_HOUR
+
+
+def is_autotrade_suppressed(
+    market_regime: int | None,
+    transition_strength: float,
+    now: datetime | None = None,
+) -> bool:
+    """Quiet-hours suppression with the strong-stable-trend override
+    (time_of_day_filter.py:60-76). ``market_regime`` is the device int code;
+    None means no valid context (always suppressed in quiet hours)."""
+    if not is_quiet_hours(now):
+        return False
+    if market_regime is None or market_regime < 0:
+        return True
+    if market_regime in _OVERRIDE_REGIMES and (
+        transition_strength >= _MIN_TRANSITION_STRENGTH
+    ):
+        return False
+    return True
+
+
+def build_quiet_hours_signal_msg(
+    symbol: str,
+    algo: str,
+    side: str,
+    market_regime: int | None,
+    transition: int | None,
+    transition_strength: float | None,
+    stress: float | None,
+    now: datetime | None = None,
+) -> str:
+    """Structured Telegram alert for a suppressed activation
+    (time_of_day_filter.py:79-114)."""
+    london_now = _now_london(now)
+    regime_name = (
+        MarketRegimeCode(market_regime).name
+        if market_regime is not None and market_regime >= 0
+        else "UNAVAILABLE"
+    )
+    transition_name = (
+        MarketTransitionCode(transition).name
+        if transition is not None and transition >= 0
+        else "None"
+    )
+    strength_txt = (
+        f"{transition_strength:.3f}" if transition_strength is not None else "n/a"
+    )
+    stress_txt = f"{stress:.3f}" if stress is not None else "n/a"
+    return f"""
+        - [{os.getenv("ENV", "")}] <strong>#time_of_day_block</strong>
+        - Symbol: {symbol}
+        - Algorithm: {algo}
+        - Side: {side}
+        - Reason: London time {london_now.strftime("%H:%M")} falls in the {QUIET_START_HOUR:02d}:00-{QUIET_END_HOUR:02d}:00 quiet window
+        - Market regime: {regime_name}
+        - Market transition: {transition_name}
+        - Transition strength: {strength_txt}
+        - Market stress: {stress_txt}
+        - Action: autotrade suppressed (signal kept as alert only)
+    """

@@ -1,0 +1,176 @@
+"""Ring-buffer semantics vs the reference MarketStateStore contract.
+
+Reference behavior pinned here: concat → drop_duplicates(keep='last') →
+sort → tail(max_bars) per candle (market_state_store.py:19-32), exact-ts
+freshness (l.49-54).
+"""
+
+import numpy as np
+import pytest
+
+from binquant_tpu.exceptions import BufferCapacityError
+from binquant_tpu.engine import (
+    Field,
+    IngestBatcher,
+    SymbolRegistry,
+    apply_updates,
+    empty_buffer,
+    fresh_mask,
+    ms_to_s,
+    reset_rows,
+)
+
+
+def mk_vals(close: float, n_fields: int = 10) -> np.ndarray:
+    v = np.zeros((1, n_fields), dtype=np.float32)
+    v[0, Field.OPEN] = close - 1
+    v[0, Field.HIGH] = close + 1
+    v[0, Field.LOW] = close - 2
+    v[0, Field.CLOSE] = close
+    v[0, Field.VOLUME] = 100.0
+    return v
+
+
+def test_append_and_right_alignment():
+    buf = empty_buffer(4, window=8)
+    for i, ts in enumerate([100, 200, 300]):
+        buf = apply_updates(
+            buf, np.array([2], dtype=np.int32), np.array([ts], dtype=np.int32), mk_vals(10.0 + i)
+        )
+    assert int(buf.filled[2]) == 3
+    assert int(buf.times[2, -1]) == 300
+    assert int(buf.times[2, -2]) == 200
+    assert float(buf.values[2, -1, Field.CLOSE]) == 12.0
+    # untouched rows stay empty
+    assert int(buf.filled[0]) == 0
+    assert np.all(np.asarray(buf.times[0]) == -1)
+
+
+def test_duplicate_timestamp_overwrites_last():
+    buf = empty_buffer(2, window=4)
+    buf = apply_updates(buf, np.array([0], np.int32), np.array([100], np.int32), mk_vals(1.0))
+    buf = apply_updates(buf, np.array([0], np.int32), np.array([100], np.int32), mk_vals(2.0))
+    assert int(buf.filled[0]) == 1
+    assert float(buf.values[0, -1, Field.CLOSE]) == 2.0
+
+
+def test_stale_update_ignored():
+    buf = empty_buffer(2, window=4)
+    buf = apply_updates(buf, np.array([0], np.int32), np.array([200], np.int32), mk_vals(5.0))
+    buf = apply_updates(buf, np.array([0], np.int32), np.array([100], np.int32), mk_vals(9.0))
+    assert int(buf.filled[0]) == 1
+    assert float(buf.values[0, -1, Field.CLOSE]) == 5.0
+    assert int(buf.times[0, -1]) == 200
+
+
+def test_window_rolls_oldest_off():
+    buf = empty_buffer(1, window=3)
+    for i in range(5):
+        buf = apply_updates(
+            buf, np.array([0], np.int32), np.array([100 + i], np.int32), mk_vals(float(i))
+        )
+    assert int(buf.filled[0]) == 3
+    assert list(np.asarray(buf.times[0])) == [102, 103, 104]
+    assert list(np.asarray(buf.values[0, :, Field.CLOSE])) == [2.0, 3.0, 4.0]
+
+
+def test_batched_update_multiple_symbols():
+    buf = empty_buffer(8, window=4)
+    rows = np.array([0, 3, 5], dtype=np.int32)
+    ts = np.array([100, 100, 100], dtype=np.int32)
+    vals = np.concatenate([mk_vals(1.0), mk_vals(2.0), mk_vals(3.0)], axis=0)
+    buf = apply_updates(buf, rows, ts, vals)
+    assert list(np.asarray(buf.filled)) == [1, 0, 0, 1, 0, 1, 0, 0]
+    fm = np.asarray(fresh_mask(buf, 100))
+    assert list(np.nonzero(fm)[0]) == [0, 3, 5]
+    assert not np.any(np.asarray(fresh_mask(buf, 200)))
+
+
+def test_out_of_range_rows_dropped():
+    buf = empty_buffer(2, window=4)
+    rows = np.array([-1, 5, 1], dtype=np.int32)
+    ts = np.array([100, 100, 100], dtype=np.int32)
+    vals = np.concatenate([mk_vals(1.0), mk_vals(2.0), mk_vals(3.0)], axis=0)
+    buf = apply_updates(buf, rows, ts, vals)
+    assert int(buf.filled[0]) == 0
+    assert int(buf.filled[1]) == 1
+    assert float(buf.values[1, -1, Field.CLOSE]) == 3.0
+
+
+def test_registry_free_list_reuse():
+    reg = SymbolRegistry(3)
+    a, b = reg.add("btcusdt"), reg.add("ETHUSDT")
+    assert a == 0 and b == 1
+    assert reg.add("BTCUSDT") == 0  # case-normalized idempotent
+    reg.add("XRPUSDT")
+    with pytest.raises(BufferCapacityError):
+        reg.add("SOLUSDT")
+    assert reg.remove("ethusdt") == 1
+    assert reg.add("SOLUSDT") == 1  # reclaimed row
+    assert reg.name_of(1) == "SOLUSDT"
+
+
+def test_reset_rows_clears_state():
+    buf = empty_buffer(3, window=4)
+    buf = apply_updates(buf, np.array([1], np.int32), np.array([100], np.int32), mk_vals(5.0))
+    buf = reset_rows(buf, np.array([1], dtype=np.int32))
+    assert int(buf.filled[1]) == 0
+    assert np.all(np.asarray(buf.times[1]) == -1)
+    assert np.all(np.isnan(np.asarray(buf.values[1])))
+
+
+def test_ingest_batcher_dedupes_keep_last():
+    reg = SymbolRegistry(4)
+    batcher = IngestBatcher(reg)
+    t0 = 1_700_000_000_000
+    batcher.add(
+        {"symbol": "BTCUSDT", "open_time": t0, "close_time": t0 + 899_999,
+         "open": 1, "high": 2, "low": 0.5, "close": 1.5, "volume": 10}
+    )
+    batcher.add(
+        {"symbol": "btcusdt", "open_time": t0, "close_time": t0 + 899_999,
+         "open": 1, "high": 2, "low": 0.5, "close": 1.7, "volume": 11}
+    )
+    batcher.add(
+        {"symbol": "ETHUSDT", "open_time": t0, "close_time": t0 + 899_999,
+         "open": 1, "high": 2, "low": 0.5, "close": 9.9, "volume": 12}
+    )
+    batches = batcher.drain()
+    assert len(batches) == 1
+    rows, ts, vals = batches[0]
+    assert len(rows) == 2
+    assert len(batcher) == 0
+    i_btc = list(rows).index(reg.row_of("BTCUSDT"))
+    assert vals[i_btc, Field.CLOSE] == np.float32(1.7)
+    assert ts[i_btc] == ms_to_s(t0)
+
+    buf = empty_buffer(4, window=4)
+    buf = apply_updates(buf, rows, ts, vals)
+    assert int(buf.filled[reg.row_of("BTCUSDT")]) == 1
+    assert int(buf.filled[reg.row_of("ETHUSDT")]) == 1
+
+
+def test_ingest_batcher_multi_timestamp_subbatches():
+    """A late frame plus the current frame for one symbol must produce two
+    ordered sub-batches (reference keeps both rows after dedupe-by-ts)."""
+    reg = SymbolRegistry(4)
+    batcher = IngestBatcher(reg)
+    t0 = 1_700_000_000_000
+    k = {"open": 1, "high": 2, "low": 0.5, "volume": 10}
+    batcher.add({"symbol": "A", "open_time": t0 + 900_000,
+                 "close_time": t0 + 1_799_999, "close": 2.0, **k})
+    batcher.add({"symbol": "A", "open_time": t0,
+                 "close_time": t0 + 899_999, "close": 1.0, **k})  # late frame
+    batcher.add({"symbol": "B", "open_time": t0 + 900_000,
+                 "close_time": t0 + 1_799_999, "close": 3.0, **k})
+    batches = batcher.drain()
+    assert len(batches) == 2
+
+    buf = empty_buffer(4, window=4)
+    for rows, ts, vals in batches:
+        buf = apply_updates(buf, rows, ts, vals)
+    ra = reg.row_of("A")
+    assert int(buf.filled[ra]) == 2
+    closes = np.asarray(buf.values[ra, :, Field.CLOSE])
+    assert list(closes[-2:]) == [1.0, 2.0]
+    assert int(buf.filled[reg.row_of("B")]) == 1

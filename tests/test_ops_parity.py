@@ -216,3 +216,60 @@ class TestIndicators:
         got = np.asarray(ind.connors_rsi(jnp.asarray(c)))
         assert got[195] > 60
         assert got[-1] < 25
+
+
+class TestLastValueKernels:
+    """ewm_mean_last / rolling_*_last must equal the full kernel's last column."""
+
+    @pytest.mark.parametrize("span", [20, 50])
+    def test_ewm_mean_last(self, ohlcv, span):
+        x = jnp.asarray(ohlcv["close"])
+        full = roll.ewm_mean(x, span=span, min_periods=1)
+        last = roll.ewm_mean_last(x, span=span, min_periods=1)
+        np.testing.assert_allclose(
+            float(last), float(full[-1]), rtol=1e-5, atol=1e-4
+        )
+        expected = pd.Series(ohlcv["close"]).ewm(span=span, adjust=False, min_periods=1).mean().iloc[-1]
+        np.testing.assert_allclose(float(last), expected, rtol=1e-4)
+
+    def test_ewm_mean_last_leading_nan(self, ohlcv):
+        c = ohlcv["close"].copy()
+        c[:123] = np.nan
+        last = roll.ewm_mean_last(jnp.asarray(c), span=20, min_periods=1)
+        expected = pd.Series(c).ewm(span=20, adjust=False, min_periods=1).mean().iloc[-1]
+        np.testing.assert_allclose(float(last), expected, rtol=1e-4)
+
+    def test_ewm_mean_last_batched(self, rng):
+        x = rng.normal(100, 5, size=(7, 64))
+        x[2, :30] = np.nan
+        x[5, :] = np.nan
+        last = np.asarray(roll.ewm_mean_last(jnp.asarray(x), span=20, min_periods=1))
+        for i in range(7):
+            exp = pd.Series(x[i]).ewm(span=20, adjust=False, min_periods=1).mean().iloc[-1]
+            if np.isnan(exp):
+                assert np.isnan(last[i])
+            else:
+                np.testing.assert_allclose(last[i], exp, rtol=1e-4)
+
+    @pytest.mark.parametrize("window,mp", [(20, None), (14, 1)])
+    def test_rolling_mean_last(self, ohlcv, window, mp):
+        x = jnp.asarray(ohlcv["close"])
+        expected = pd.Series(ohlcv["close"]).rolling(window, min_periods=mp).mean().iloc[-1]
+        np.testing.assert_allclose(
+            float(roll.rolling_mean_last(x, window, mp)), expected, rtol=1e-5
+        )
+
+    @pytest.mark.parametrize("ddof", [0, 1])
+    def test_rolling_std_last(self, ohlcv, ddof):
+        x = jnp.asarray(ohlcv["close"])
+        expected = pd.Series(ohlcv["close"]).rolling(20).std(ddof=ddof).iloc[-1]
+        np.testing.assert_allclose(
+            float(roll.rolling_std_last(x, 20, ddof=ddof)), expected, rtol=1e-4
+        )
+
+    def test_rolling_last_short_history(self):
+        x = jnp.asarray(np.concatenate([np.full(15, np.nan), [1.0, 2.0, 3.0]]))
+        np.testing.assert_allclose(
+            float(roll.rolling_mean_last(x, 14, 1)), 2.0, rtol=1e-6
+        )
+        assert np.isnan(float(roll.rolling_mean_last(x, 14, None)))

@@ -1,0 +1,347 @@
+"""Routing mask, context scoring, grid-only policy, time-of-day filter.
+
+Oracles re-derive the reference decision logic (regime_routing.py:22-76,
+context_scoring.py:39-114, signal_context_scorer.py:15-29,
+grid_only_policy.py:121-158, time_of_day_filter.py:55-76) on scalars.
+"""
+
+from datetime import datetime, timezone
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from binquant_tpu.enums import (
+    MarketRegimeCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.regime import (
+    DEFAULT_REGIME_STABILITY_S,
+    GridOnlyPolicy,
+    ScorerWeights,
+    adjust_score,
+    allows_long_autotrade_mask,
+    evaluate_context_score,
+    is_autotrade_suppressed,
+    is_quiet_hours,
+    is_regime_stable,
+    long_autotrade_decision,
+    score_signal_candidate,
+)
+from binquant_tpu.regime.context import MarketContext, SymbolFeatureArrays
+from binquant_tpu.schemas import MarketBreadthSeries
+
+S = 6
+
+
+def mk_features(**over):
+    base = dict(
+        valid=np.ones(S, dtype=bool),
+        timestamp=np.full(S, 1000, np.int32),
+        close=np.full(S, 10.0, np.float32),
+        return_pct=np.zeros(S, np.float32),
+        ema20=np.full(S, 10.0, np.float32),
+        ema50=np.full(S, 10.0, np.float32),
+        above_ema20=np.ones(S, dtype=bool),
+        above_ema50=np.ones(S, dtype=bool),
+        trend_score=np.zeros(S, np.float32),
+        relative_strength_vs_btc=np.zeros(S, np.float32),
+        atr_pct=np.full(S, 0.01, np.float32),
+        bb_width=np.full(S, 0.03, np.float32),
+        micro_regime=np.full(S, int(MicroRegimeCode.RANGE), np.int32),
+        micro_regime_strength=np.full(S, 0.6, np.float32),
+        micro_transition=np.full(S, -1, np.int32),
+        micro_transition_strength=np.zeros(S, np.float32),
+    )
+    base.update(over)
+    return SymbolFeatureArrays(**{k: jnp.asarray(v) for k, v in base.items()})
+
+
+def mk_context(**over):
+    ts = 100_000
+    base = dict(
+        valid=True,
+        timestamp=np.int32(ts),
+        fresh_count=np.int32(50),
+        total_tracked_symbols=np.int32(50),
+        coverage_ratio=1.0,
+        btc_present=True,
+        advancers=np.int32(25),
+        decliners=np.int32(20),
+        advancers_ratio=0.5,
+        decliners_ratio=0.4,
+        advancers_decliners_ratio=1.25,
+        average_return=0.001,
+        average_relative_strength_vs_btc=0.0,
+        pct_above_ema20=0.55,
+        pct_above_ema50=0.5,
+        average_trend_score=0.001,
+        average_atr_pct=0.015,
+        average_bb_width=0.04,
+        btc_return=0.002,
+        btc_trend_score=0.001,
+        btc_regime_score=0.05,
+        market_stress_score=0.1,
+        long_tailwind=0.2,
+        short_tailwind=-0.1,
+        market_regime=np.int32(MarketRegimeCode.RANGE),
+        previous_market_regime=np.int32(MarketRegimeCode.RANGE),
+        market_regime_transition=np.int32(-1),
+        market_regime_transition_strength=0.0,
+        long_regime_score=0.3,
+        short_regime_score=0.2,
+        range_regime_score=0.6,
+        stress_regime_score=0.1,
+        regime_is_transitioning=False,
+        regime_stable_since=np.int32(ts - DEFAULT_REGIME_STABILITY_S - 10),
+        features=mk_features(),
+    )
+    base.update(over)
+    conv = {
+        k: (v if isinstance(v, SymbolFeatureArrays) else jnp.asarray(v))
+        for k, v in base.items()
+    }
+    return MarketContext(**conv)
+
+
+# ---------------------------------------------------------------------------
+# Routing
+# ---------------------------------------------------------------------------
+
+
+def test_stable_range_regime_allows_long():
+    ctx = mk_context()
+    mask = np.asarray(allows_long_autotrade_mask(ctx))
+    assert mask.all()
+    allowed, reason = long_autotrade_decision(ctx, 0)
+    assert allowed and reason.startswith("micro_regime_range")
+
+
+@pytest.mark.parametrize(
+    "over,expect_reason",
+    [
+        (dict(regime_is_transitioning=True), "regime_transitioning"),
+        (dict(regime_stable_since=np.int32(-1)), "regime_stability_unknown"),
+        (
+            dict(regime_stable_since=np.int32(100_000 - 60)),
+            "regime_unstable",
+        ),
+        (
+            dict(market_regime=np.int32(MarketRegimeCode.HIGH_STRESS)),
+            "market_regime_high_stress",
+        ),
+        (
+            dict(market_regime=np.int32(MarketRegimeCode.TREND_DOWN)),
+            "market_regime_trend_down",
+        ),
+        (dict(market_stress_score=0.4), "market_stress_elevated"),
+        (dict(valid=False), "market_context_unavailable"),
+    ],
+)
+def test_market_level_blocks(over, expect_reason):
+    ctx = mk_context(**over)
+    assert not np.asarray(allows_long_autotrade_mask(ctx)).any()
+    allowed, reason = long_autotrade_decision(ctx, 0)
+    assert not allowed
+    assert reason.startswith(expect_reason)
+
+
+def test_micro_level_blocks_and_recovery():
+    micro = np.full(S, int(MicroRegimeCode.RANGE), np.int32)
+    micro[1] = int(MicroRegimeCode.VOLATILE)
+    micro[2] = int(MicroRegimeCode.TREND_DOWN)
+    micro[3] = int(MicroRegimeCode.TREND_DOWN)
+    trans = np.full(S, -1, np.int32)
+    trans[3] = int(MicroTransitionCode.RECOVERY)
+    valid = np.ones(S, dtype=bool)
+    valid[4] = False  # falls back to market-level policy (RANGE -> allowed)
+    ctx = mk_context(features=mk_features(micro_regime=micro, micro_transition=trans, valid=valid))
+    mask = np.asarray(allows_long_autotrade_mask(ctx))
+    assert mask[0]  # RANGE micro
+    assert not mask[1]  # VOLATILE
+    assert not mask[2]  # TREND_DOWN, no recovery
+    assert mask[3]  # TREND_DOWN + RECOVERY
+    assert mask[4]  # no features -> market regime RANGE
+    assert not long_autotrade_decision(ctx, 1)[0]
+    assert long_autotrade_decision(ctx, 3)[0]
+    assert long_autotrade_decision(ctx, 4)[0]
+
+
+def test_is_regime_stable_threshold():
+    assert bool(is_regime_stable(mk_context()))
+    young = mk_context(regime_stable_since=np.int32(100_000 - 100))
+    assert not bool(is_regime_stable(young))
+
+
+# ---------------------------------------------------------------------------
+# Context scoring (oracle on scalars)
+# ---------------------------------------------------------------------------
+
+
+def clamp(v, lo=-1.0, hi=1.0):
+    return max(lo, min(hi, float(v)))
+
+
+def nneg(v):
+    return max(0.0, float(v))
+
+
+def oracle_score(ctx, direction, rs, trend):
+    """context_scoring.py:39-114 on scalars."""
+    short = direction == "SHORT"
+    breadth = float(ctx.short_tailwind if short else ctx.long_tailwind)
+    btc = float(ctx.btc_regime_score)
+    btc_align = clamp(-btc) if short else clamp(btc)
+    rs_s, tr_s = (-rs, -trend) if short else (rs, trend)
+    cross = clamp(0.6 * rs_s + 0.4 * tr_s)
+    override = clamp(0.6 * nneg(rs_s) + 0.4 * nneg(tr_s), 0.0, 1.0)
+    stress = float(ctx.market_stress_score)
+    dstress = stress * 0.35 if short else -stress
+    sup = clamp(0.35 * breadth + 0.25 * btc_align + 0.25 * cross + 0.15 * dstress)
+    fol = clamp(0.45 * breadth + 0.3 * btc_align + 0.25 * cross)
+    risk = clamp(0.55 * stress + 0.25 * nneg(-sup) + 0.2 * (1 - override), 0.0, 1.0)
+    if not short and breadth < 0 and override > 0:
+        sup = clamp(sup + 0.2 * override)
+        fol = clamp(fol + 0.15 * override)
+    if short and breadth < 0 and override > 0:
+        sup = clamp(sup + 0.1 * override)
+    return sup, fol, risk, override, cross, btc_align, breadth
+
+
+@pytest.mark.parametrize("direction", ["LONG", "SHORT"])
+@pytest.mark.parametrize("rs,trend", [(0.02, 0.01), (-0.03, -0.005), (0.0, 0.0)])
+def test_context_score_matches_oracle(direction, rs, trend):
+    ctx = mk_context(long_tailwind=-0.15, short_tailwind=0.1, market_stress_score=0.2)
+    rs_a = jnp.full((S,), rs, dtype=jnp.float32)
+    tr_a = jnp.full((S,), trend, dtype=jnp.float32)
+    cs = evaluate_context_score(ctx, jnp.asarray(direction == "SHORT"), rs_a, tr_a)
+    sup, fol, risk, override, cross, btc_align, breadth = oracle_score(
+        ctx, direction, rs, trend
+    )
+    np.testing.assert_allclose(float(np.asarray(cs.supportiveness_score)[0]), sup, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(cs.followthrough_score)[0]), fol, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(cs.adverse_excursion_risk)[0]), risk, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(cs.override_strength)[0]), override, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(cs.cross_asset_confirmation)[0]), cross, rtol=1e-5, atol=1e-6)
+
+    # adjust_score formula (signal_context_scorer.py:15-29)
+    w = ScorerWeights()
+    adj = adjust_score(jnp.asarray(1.0), cs, w)
+    expected = 1.0 + 1.0 * (fol + 0.35 * sup - 0.5 * risk)
+    np.testing.assert_allclose(float(np.asarray(adj)[0]), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_invalid_context_gives_empty_score():
+    ctx = mk_context(valid=False)
+    cs = evaluate_context_score(
+        ctx, jnp.asarray(False), jnp.zeros(S), jnp.zeros(S)
+    )
+    for name in cs._fields:
+        np.testing.assert_allclose(np.asarray(getattr(cs, name)), 0.0, atol=1e-7)
+    adj = adjust_score(jnp.asarray(0.7), cs)
+    np.testing.assert_allclose(np.asarray(adj), 0.7, atol=1e-7)
+
+
+def test_score_signal_candidate_emit_threshold():
+    ctx = mk_context()
+    ev = score_signal_candidate(
+        ctx,
+        jnp.asarray(False),
+        jnp.asarray(0.5),
+        jnp.zeros(S),
+        jnp.zeros(S),
+        emit_threshold=0.55,
+    )
+    emit = np.asarray(ev.emit)
+    adjusted = np.asarray(ev.adjusted_score)
+    assert emit.shape == adjusted.shape
+    np.testing.assert_array_equal(emit, adjusted >= 0.55)
+
+
+# ---------------------------------------------------------------------------
+# Grid-only policy
+# ---------------------------------------------------------------------------
+
+
+def breadth_series(ma=None, raw=None, ts=None):
+    n = len(ts or [])
+    return MarketBreadthSeries(
+        timestamp=ts or [],
+        market_breadth=raw or [0.0] * n,
+        market_breadth_ma=ma or [0.0] * n,
+        adp=[0.0] * n,
+        adp_ma=[0.0] * n,
+        advancers=[0.0] * n,
+        decliners=[0.0] * n,
+    )
+
+
+def test_grid_policy_activates_on_momentum():
+    b = breadth_series(ma=[0.5, 0.6], ts=[1, 2])
+    p = GridOnlyPolicy.resolve(int(MarketRegimeCode.RANGE), b)
+    assert p.allow_grid_ladder and p.block_standard_bots
+    assert p.direction == "toward_trend"
+    assert p.source == "market_breadth_ma"
+    np.testing.assert_allclose(p.momentum_points, 10.0)
+
+    p2 = GridOnlyPolicy.resolve(
+        int(MarketRegimeCode.TRANSITIONAL), breadth_series(ma=[0.6, 0.5], ts=[1, 2])
+    )
+    assert p2.allow_grid_ladder and p2.direction == "toward_range"
+
+
+def test_grid_policy_disabled_paths():
+    b = breadth_series(ma=[0.5, 0.6], ts=[1, 2])
+    assert GridOnlyPolicy.resolve(None, b).reason == "market_context_unavailable"
+    assert GridOnlyPolicy.resolve(-1, b).reason == "market_regime_unavailable"
+    p = GridOnlyPolicy.resolve(int(MarketRegimeCode.TREND_UP), b)
+    assert not p.allow_grid_ladder and p.reason == "market_regime_trend_up"
+    flat = breadth_series(ma=[0.5, 0.5], ts=[1, 2])
+    assert (
+        GridOnlyPolicy.resolve(int(MarketRegimeCode.RANGE), flat).reason
+        == "breadth_momentum_flat"
+    )
+    assert (
+        GridOnlyPolicy.resolve(int(MarketRegimeCode.RANGE), None).reason
+        == "breadth_momentum_unavailable"
+    )
+
+
+def test_grid_policy_timestamp_ordering_beats_list_order():
+    # series delivered newest-first with timestamps: sorting must win
+    b = breadth_series(ma=[0.7, 0.5], ts=[200, 100])
+    p = GridOnlyPolicy.resolve(int(MarketRegimeCode.RANGE), b)
+    # ordered -> [0.5 (ts100), 0.7 (ts200)] -> momentum toward trend
+    assert p.direction == "toward_trend"
+    assert p.latest == 0.7
+
+
+# ---------------------------------------------------------------------------
+# Time-of-day filter
+# ---------------------------------------------------------------------------
+
+
+def ldn(hour):
+    # July: London = UTC+1, so UTC hour-1 == London hour
+    return datetime(2026, 7, 20, hour - 1, 30, tzinfo=timezone.utc)
+
+
+def test_quiet_hours_window():
+    assert is_quiet_hours(ldn(20))
+    assert is_quiet_hours(ldn(22))
+    assert not is_quiet_hours(ldn(23))
+    assert not is_quiet_hours(ldn(12))
+
+
+def test_suppression_and_trend_override():
+    # mid-day: never suppressed
+    assert not is_autotrade_suppressed(int(MarketRegimeCode.RANGE), 0.0, ldn(12))
+    # quiet hours, RANGE: suppressed
+    assert is_autotrade_suppressed(int(MarketRegimeCode.RANGE), 0.9, ldn(21))
+    # quiet hours, strong stable trend: allowed
+    assert not is_autotrade_suppressed(int(MarketRegimeCode.TREND_UP), 0.75, ldn(21))
+    # weak trend: suppressed
+    assert is_autotrade_suppressed(int(MarketRegimeCode.TREND_UP), 0.5, ldn(21))
+    # no context: suppressed
+    assert is_autotrade_suppressed(None, 1.0, ldn(21))

@@ -1,0 +1,19 @@
+# binquant_tpu — single-container deployment (reference Dockerfile parity:
+# one process, heartbeat healthcheck, SIGTERM stop).
+FROM python:3.12-slim
+
+WORKDIR /app
+
+COPY pyproject.toml ./
+RUN pip install --no-cache-dir \
+    "jax[tpu]" flax optax orbax-checkpoint chex einops \
+    numpy pandas pydantic httpx websockets pytest pytest-asyncio
+
+COPY binquant_tpu ./binquant_tpu
+COPY main.py healthcheck.py bench.py __graft_entry__.py ./
+
+HEALTHCHECK --interval=60s --timeout=10s --retries=3 \
+    CMD ["python", "healthcheck.py"]
+
+STOPSIGNAL SIGTERM
+CMD ["python", "main.py"]

@@ -1,0 +1,22 @@
+.PHONY: test bench smoke replay dryrun lint
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+smoke:
+	python bench.py --smoke
+
+replay:
+	python - -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"
+	python main.py --replay /tmp/replay.jsonl
+
+dryrun:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+lint:
+	python -m ruff check binquant_tpu tests 2>/dev/null || true

@@ -1,0 +1,140 @@
+"""Benchmark: full-suite tick latency over the symbol batch.
+
+Measures the end-to-end per-tick latency of the jit'd engine step (buffer
+update → indicators → market context/regimes → all 14 strategy kernels →
+trigger-mask D2H) at the north-star scale: 2000 symbols × 400-bar windows on
+one chip (BASELINE.json: p99 < 50 ms @ 1 s ticks). Prints ONE JSON line:
+
+    {"metric": "tick_p99_ms", "value": N, "unit": "ms", "vs_baseline": R}
+
+``vs_baseline`` is the target budget ratio 50ms/value (>1 beats the
+north-star; the reference itself is O(100ms–1s) *per symbol* serial —
+SURVEY.md §6 — so any sub-50ms full-batch tick is ≥4 orders of magnitude
+over the reference pipeline).
+
+``--smoke`` runs tiny shapes for CI/CPU sanity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run(num_symbols: int, window: int, ticks: int, warmup: int) -> dict:
+    import jax
+
+    from binquant_tpu.engine.buffer import NUM_FIELDS, Field
+    from binquant_tpu.engine.step import (
+        default_host_inputs,
+        initial_engine_state,
+        pad_updates,
+        tick_step,
+    )
+    from binquant_tpu.regime.context import ContextConfig
+
+    rng = np.random.default_rng(7)
+    cfg = ContextConfig()
+    state = initial_engine_state(num_symbols, window=window)
+
+    # preload full windows so the bench measures steady state
+    t0 = 1_753_000_000
+    px = 20.0 + rng.random(num_symbols).astype(np.float32) * 100
+
+    def make_updates(ts_s: int, px: np.ndarray):
+        rows = np.arange(num_symbols, dtype=np.int32)
+        ts = np.full(num_symbols, ts_s, dtype=np.int32)
+        closes = px * (1 + rng.normal(0, 0.004, num_symbols))
+        vals = np.zeros((num_symbols, NUM_FIELDS), dtype=np.float32)
+        vals[:, Field.OPEN] = px
+        vals[:, Field.CLOSE] = closes
+        vals[:, Field.HIGH] = np.maximum(px, closes) * 1.002
+        vals[:, Field.LOW] = np.minimum(px, closes) * 0.998
+        vals[:, Field.VOLUME] = np.abs(rng.normal(1000, 150, num_symbols))
+        vals[:, Field.QUOTE_VOLUME] = vals[:, Field.VOLUME] * closes
+        vals[:, Field.NUM_TRADES] = 150
+        vals[:, Field.DURATION_S] = 900
+        return rows, ts, vals, closes
+
+    from binquant_tpu.engine.buffer import apply_updates
+
+    for b in range(window):
+        rows, ts, vals, px = make_updates(t0 + b * 900, px)
+        state = state._replace(
+            buf5=apply_updates(state.buf5, rows, ts, vals),
+            buf15=apply_updates(state.buf15, rows, ts, vals),
+        )
+    import jax.numpy as jnp
+
+    tracked = np.ones(num_symbols, dtype=bool)
+    latencies = []
+    now = t0 + window * 900
+    for i in range(warmup + ticks):
+        rows, ts, vals, px = make_updates(now + i * 900, px)
+        upd = pad_updates(rows, ts, vals, size=num_symbols)
+        inputs = default_host_inputs(num_symbols)._replace(
+            tracked=jnp.asarray(tracked),
+            btc_row=np.int32(0),
+            timestamp_s=np.int32(now + i * 900),
+            timestamp5_s=np.int32(now + i * 900),
+        )
+        start = time.perf_counter()
+        state, out = tick_step(state, upd, upd, inputs, cfg)
+        # the tiny D2H the host actually needs: ONE packed trigger summary
+        triggers = np.asarray(out.summary.trigger)
+        _ = int(np.asarray(out.context.market_regime))
+        elapsed = (time.perf_counter() - start) * 1000.0
+        if i >= warmup:
+            latencies.append(elapsed)
+        del triggers
+
+    lat = np.array(latencies)
+    return {
+        "p50_ms": float(np.percentile(lat, 50)),
+        "p99_ms": float(np.percentile(lat, 99)),
+        "mean_ms": float(lat.mean()),
+        "symbol_evals_per_sec": float(num_symbols * 14 / (lat.mean() / 1000.0)),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true", help="tiny shapes")
+    parser.add_argument("--symbols", type=int, default=2048)
+    parser.add_argument("--window", type=int, default=400)
+    parser.add_argument("--ticks", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    args = parser.parse_args()
+
+    if args.smoke:
+        args.symbols, args.window, args.ticks, args.warmup = 32, 120, 5, 2
+
+    stats = run(args.symbols, args.window, args.ticks, args.warmup)
+    value = round(stats["p99_ms"], 3)
+    print(
+        json.dumps(
+            {
+                "metric": "tick_p99_ms",
+                "value": value,
+                "unit": "ms",
+                "vs_baseline": round(50.0 / value, 3) if value > 0 else 0.0,
+                "detail": {
+                    "symbols": args.symbols,
+                    "window": args.window,
+                    "p50_ms": round(stats["p50_ms"], 3),
+                    "mean_ms": round(stats["mean_ms"], 3),
+                    "symbol_strategy_evals_per_sec": round(
+                        stats["symbol_evals_per_sec"]
+                    ),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,9 @@ Replaces the reference's per-message pandas state juggling
 compiled step over all symbols.
 """
 
+# NOTE: engine.step is NOT re-exported here — step imports the strategy
+# modules, which import engine.buffer; importing step from the package init
+# would close that cycle. Use `from binquant_tpu.engine.step import ...`.
 from binquant_tpu.engine.buffer import (  # noqa: F401
     FIELDS,
     NUM_FIELDS,

@@ -1,0 +1,382 @@
+"""The jit'd per-tick step: the whole evaluation pipeline in one compile.
+
+Inverts the reference's control flow (``producers/context_evaluator.py``):
+instead of "per kline → refetch → per-symbol pandas → per-strategy Python",
+one compiled function consumes the updated 5m/15m ring buffers and computes
+for ALL symbols at once: feature packs, the market context + regimes, the
+spike detector, and every strategy's trigger/direction/score/autotrade —
+returning small (S,) arrays from which the host extracts only the fired
+rows (tiny D2H) for emission.
+
+Dispatch parity: the live set runs in the reference's order
+(ActivityBurstPump, PriceTracker on 5m — ``l.369-389``; LiquidationSweepPump,
+MeanReversionFade, LadderDeployer on 15m — ``l.434-479``; SpikeHunterV3
+disabled but its detector live for RangeFailedBreakoutFade). Dormant
+strategies are computed too (they're pure array math riding the same pass —
+the host simply doesn't route them to autotrade unless enabled). Data
+sufficiency mirrors the reference's ``ma_100``-length gates (l.361-365,
+424-429): strategy outputs are masked where ``filled < 100``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer, apply_updates, fresh_mask
+from binquant_tpu.ops.indicators import log_returns, rolling_beta_corr
+from binquant_tpu.regime.context import (
+    ContextConfig,
+    MarketContext,
+    RegimeCarry,
+    compute_market_context,
+    initial_regime_carry,
+)
+from binquant_tpu.regime.routing import allows_long_autotrade_mask
+from binquant_tpu.strategies.activity_burst_pump import activity_burst_pump
+from binquant_tpu.strategies.base import StrategyOutputs
+from binquant_tpu.strategies.dormant import (
+    bb_extreme_reversion,
+    buy_low_sell_high,
+    buy_the_dip,
+    inverse_price_tracker,
+    range_bb_rsi_mean_reversion,
+    range_failed_breakout_fade,
+    relative_strength_reversal_range,
+    supertrend_swing_reversal,
+    twap_momentum_sniper,
+)
+from binquant_tpu.strategies.features import FeaturePack, compute_feature_pack
+from binquant_tpu.strategies.ladder_deployer import ladder_deployer
+from binquant_tpu.strategies.liquidation_sweep_pump import liquidation_sweep_pump
+from binquant_tpu.strategies.mean_reversion_fade import mean_reversion_fade
+from binquant_tpu.strategies.price_tracker import price_tracker
+from binquant_tpu.strategies.spike_hunter import SpikeSignal, detect_spikes
+
+# Sufficiency: the reference refuses to dispatch until the enriched frame
+# carries a full MA-100 (context_evaluator.py:361-365).
+MIN_BARS = 100
+
+
+class EngineState(NamedTuple):
+    """Device-resident pytree carried across ticks."""
+
+    buf5: MarketBuffer
+    buf15: MarketBuffer
+    regime_carry: RegimeCarry
+    mrf_last_emitted: jnp.ndarray  # (S,) int32 — MeanReversionFade dedupe
+    pt_last_signal_close: jnp.ndarray  # (S,) int32 — PriceTracker cooldown
+
+
+class HostInputs(NamedTuple):
+    """Per-tick host-resolved scalars/arrays (REST-derived state the device
+    can't know: OI cache, breadth series, wall clock, settings)."""
+
+    tracked: jnp.ndarray  # (S,) bool — occupied registry rows
+    btc_row: jnp.ndarray  # int32 scalar
+    timestamp_s: jnp.ndarray  # int32 scalar — evaluated 15m bucket open
+    timestamp5_s: jnp.ndarray  # int32 scalar — current 5m bucket open
+    oi_growth: jnp.ndarray  # (S,) f32, NaN unavailable
+    adp_latest: jnp.ndarray  # f32 — resolved ADP (breadth series or context)
+    adp_prev: jnp.ndarray  # f32, NaN = no history
+    adp_diff: jnp.ndarray  # f32 — breadth[-1]-breadth[-2]
+    adp_diff_prev: jnp.ndarray  # f32 — breadth[-2]-breadth[-3]
+    breadth_momentum_points: jnp.ndarray  # f32, NaN unavailable
+    quiet_hours: jnp.ndarray  # bool — wall-clock quiet window active
+    grid_policy_allows: jnp.ndarray  # bool — GridOnlyPolicy.allow_grid_ladder
+    is_futures: jnp.ndarray  # bool — autotrade settings market type
+    dominance_is_losers: jnp.ndarray  # bool
+    market_domination_reversal: jnp.ndarray  # bool
+
+
+# Fixed strategy ordering for the packed summary (dispatch order first).
+STRATEGY_ORDER: tuple[str, ...] = (
+    "activity_burst_pump",
+    "coinrule_price_tracker",
+    "liquidation_sweep_pump",
+    "mean_reversion_fade",
+    "grid_ladder",
+    "coinrule_supertrend_swing_reversal",
+    "coinrule_twap_momentum_sniper",
+    "coinrule_buy_low_sell_high",
+    "coinrule_buy_the_dip",
+    "bb_extreme_reversion",
+    "inverse_price_tracker",
+    "range_bb_rsi_mean_reversion",
+    "range_failed_breakout_fade",
+    "relative_strength_reversal_range",
+)
+
+
+class TriggerSummary(NamedTuple):
+    """All strategies' verdicts packed as (N_strategies, S) arrays so the
+    host's hot-path D2H is ONE small transfer (separate per-strategy
+    fetches cost a round trip each — fatal through a tunneled device)."""
+
+    trigger: jnp.ndarray  # (N, S) bool
+    autotrade: jnp.ndarray  # (N, S) bool
+    direction: jnp.ndarray  # (N, S) int32
+    score: jnp.ndarray  # (N, S) f32
+    stop_loss_pct: jnp.ndarray  # (N, S) f32
+
+
+class TickOutputs(NamedTuple):
+    """Everything the host needs to emit signals, (S,) arrays."""
+
+    context: MarketContext
+    fresh5: jnp.ndarray
+    fresh15: jnp.ndarray
+    long_gate: jnp.ndarray  # allows_long_autotrade mask
+    pack5: FeaturePack
+    pack15: FeaturePack
+    spikes: SpikeSignal
+    btc_beta: jnp.ndarray  # (S,) rolling 50-bar beta vs BTC
+    btc_corr: jnp.ndarray  # (S,)
+    btc_price_change_96: jnp.ndarray  # scalar — BTC 24h pct change
+    strategies: dict[str, StrategyOutputs]
+    summary: TriggerSummary
+
+
+def default_host_inputs(num_symbols: int) -> HostInputs:
+    return HostInputs(
+        tracked=jnp.zeros((num_symbols,), dtype=bool),
+        btc_row=jnp.asarray(-1, dtype=jnp.int32),
+        timestamp_s=jnp.asarray(0, dtype=jnp.int32),
+        timestamp5_s=jnp.asarray(0, dtype=jnp.int32),
+        oi_growth=jnp.full((num_symbols,), jnp.nan, dtype=jnp.float32),
+        adp_latest=jnp.asarray(jnp.nan, dtype=jnp.float32),
+        adp_prev=jnp.asarray(jnp.nan, dtype=jnp.float32),
+        adp_diff=jnp.asarray(jnp.nan, dtype=jnp.float32),
+        adp_diff_prev=jnp.asarray(jnp.nan, dtype=jnp.float32),
+        breadth_momentum_points=jnp.asarray(jnp.nan, dtype=jnp.float32),
+        quiet_hours=jnp.asarray(False),
+        grid_policy_allows=jnp.asarray(False),
+        is_futures=jnp.asarray(True),
+        dominance_is_losers=jnp.asarray(False),
+        market_domination_reversal=jnp.asarray(False),
+    )
+
+
+def initial_engine_state(
+    num_symbols: int, window: int = 400
+) -> EngineState:
+    from binquant_tpu.engine.buffer import empty_buffer
+
+    return EngineState(
+        buf5=empty_buffer(num_symbols, window),
+        buf15=empty_buffer(num_symbols, window),
+        regime_carry=initial_regime_carry(num_symbols),
+        mrf_last_emitted=jnp.full((num_symbols,), -1, dtype=jnp.int32),
+        pt_last_signal_close=jnp.full((num_symbols,), -1, dtype=jnp.int32),
+    )
+
+
+def _mask_outputs(out: StrategyOutputs, ok: jnp.ndarray) -> StrategyOutputs:
+    return out._replace(
+        trigger=out.trigger & ok,
+        autotrade=out.autotrade & ok,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def tick_step(
+    state: EngineState,
+    upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    upd15: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    inputs: HostInputs,
+    cfg: ContextConfig = ContextConfig(),
+) -> tuple[EngineState, TickOutputs]:
+    """One tick: apply candle updates, rebuild context, evaluate everything.
+
+    ``upd5``/``upd15`` are (row_idx, ts_s, vals) batches from the
+    IngestBatcher (pass empty arrays when an interval had no candles).
+    """
+    buf5 = apply_updates(state.buf5, *upd5)
+    buf15 = apply_updates(state.buf15, *upd15)
+
+    # Per-interval freshness: 5m and 15m bucket opens only coincide on
+    # quarter-hour boundaries, so each buffer gates on its own timestamp.
+    fresh5 = fresh_mask(buf5, inputs.timestamp5_s)
+    fresh15 = fresh_mask(buf15, inputs.timestamp_s)
+
+    context, regime_carry = compute_market_context(
+        buf15,
+        fresh15,
+        inputs.tracked,
+        inputs.btc_row,
+        inputs.timestamp_s,
+        state.regime_carry,
+        cfg,
+    )
+    long_gate = allows_long_autotrade_mask(context)
+
+    pack5 = compute_feature_pack(buf5)
+    pack15 = compute_feature_pack(buf15)
+    spikes = detect_spikes(buf15)
+
+    # --- BTC-relative metrics (context_evaluator.py:144-184, 415-418)
+    S = buf15.capacity
+    close15 = buf15.values[:, :, Field.CLOSE]
+    rets = log_returns(close15)
+    safe_btc = jnp.clip(inputs.btc_row, 0, S - 1)
+    btc_ok = (inputs.btc_row >= 0) & (inputs.btc_row < S)
+    btc_rets = jnp.where(btc_ok, rets[safe_btc], jnp.nan)
+    bc = rolling_beta_corr(rets, btc_rets[None, :], window=50)
+    btc_beta = jnp.where(jnp.isfinite(bc.beta[:, -1]), bc.beta[:, -1], 0.0)
+    btc_corr = jnp.where(jnp.isfinite(bc.corr[:, -1]), bc.corr[:, -1], 0.0)
+    btc_close = jnp.where(btc_ok, close15[safe_btc], jnp.nan)
+    W = close15.shape[-1]
+    if W > 96:
+        base = btc_close[-97]
+        ok96 = btc_ok & jnp.isfinite(base) & (base != 0) & jnp.isfinite(btc_close[-1])
+        btc_change_96 = jnp.where(
+            ok96, (btc_close[-1] / jnp.where(ok96, base, 1.0) - 1.0) * 100.0, 0.0
+        )
+    else:
+        btc_change_96 = jnp.asarray(0.0, dtype=jnp.float32)
+
+    ok5 = pack5.filled >= MIN_BARS
+    ok15 = pack15.filled >= MIN_BARS
+
+    # --- live 5m set (dispatch order l.369-389)
+    abp = _mask_outputs(activity_burst_pump(buf5, context), ok5 & fresh5)
+    pt, pt_carry = price_tracker(
+        pack5, context, inputs.quiet_hours, state.pt_last_signal_close
+    )
+    pt = _mask_outputs(pt, ok5 & fresh5)
+    pt_carry = jnp.where(ok5 & fresh5, pt_carry, state.pt_last_signal_close)
+
+    # --- live 15m set (dispatch order l.434-479)
+    lsp = _mask_outputs(
+        liquidation_sweep_pump(
+            buf15,
+            context,
+            inputs.oi_growth,
+            inputs.adp_latest,
+            inputs.adp_prev,
+            _btc_momentum(btc_close),
+        ),
+        ok15 & fresh15,
+    )
+    mrf, mrf_carry = mean_reversion_fade(
+        pack15, inputs.is_futures, state.mrf_last_emitted
+    )
+    mrf = _mask_outputs(mrf, ok15 & fresh15)
+    mrf_carry = jnp.where(ok15 & fresh15, mrf_carry, state.mrf_last_emitted)
+    ladder = _mask_outputs(
+        ladder_deployer(pack15, context, inputs.grid_policy_allows, inputs.is_futures),
+        ok15 & fresh15,
+    )
+
+    # --- dormant capability set
+    sts = _mask_outputs(
+        supertrend_swing_reversal(
+            buf5,
+            pack5,
+            context,
+            long_gate,
+            inputs.adp_diff,
+            inputs.adp_diff_prev,
+            inputs.dominance_is_losers,
+        ),
+        ok5 & fresh5,
+    )
+    twap = _mask_outputs(twap_momentum_sniper(buf15, pack5), ok5 & fresh5)
+    blsh = _mask_outputs(
+        buy_low_sell_high(buf15, pack15, inputs.market_domination_reversal),
+        ok15 & fresh15,
+    )
+    btd = _mask_outputs(
+        buy_the_dip(buf15, pack15, context, inputs.quiet_hours), ok15 & fresh15
+    )
+    bbx = _mask_outputs(bb_extreme_reversion(buf15, pack15, context), ok15 & fresh15)
+    ipt = _mask_outputs(inverse_price_tracker(pack5, context), ok5 & fresh5)
+    rbr = _mask_outputs(
+        range_bb_rsi_mean_reversion(buf15, pack15, context), ok15 & fresh15
+    )
+    rfbf = _mask_outputs(range_failed_breakout_fade(spikes, context), ok15 & fresh15)
+    rsr = _mask_outputs(
+        relative_strength_reversal_range(buf15, pack15, context), ok15 & fresh15
+    )
+
+    new_state = EngineState(
+        buf5=buf5,
+        buf15=buf15,
+        regime_carry=regime_carry,
+        mrf_last_emitted=mrf_carry,
+        pt_last_signal_close=pt_carry,
+    )
+    strategies = {
+        "activity_burst_pump": abp,
+        "coinrule_price_tracker": pt,
+        "liquidation_sweep_pump": lsp,
+        "mean_reversion_fade": mrf,
+        "grid_ladder": ladder,
+        "coinrule_supertrend_swing_reversal": sts,
+        "coinrule_twap_momentum_sniper": twap,
+        "coinrule_buy_low_sell_high": blsh,
+        "coinrule_buy_the_dip": btd,
+        "bb_extreme_reversion": bbx,
+        "inverse_price_tracker": ipt,
+        "range_bb_rsi_mean_reversion": rbr,
+        "range_failed_breakout_fade": rfbf,
+        "relative_strength_reversal_range": rsr,
+    }
+    ordered = [strategies[name] for name in STRATEGY_ORDER]
+    summary = TriggerSummary(
+        trigger=jnp.stack([so.trigger for so in ordered]),
+        autotrade=jnp.stack([so.autotrade for so in ordered]),
+        direction=jnp.stack([so.direction for so in ordered]),
+        score=jnp.stack([so.score for so in ordered]),
+        stop_loss_pct=jnp.stack([so.stop_loss_pct for so in ordered]),
+    )
+    outputs = TickOutputs(
+        context=context,
+        fresh5=fresh5,
+        fresh15=fresh15,
+        long_gate=long_gate,
+        pack5=pack5,
+        pack15=pack15,
+        spikes=spikes,
+        btc_beta=btc_beta,
+        btc_corr=btc_corr,
+        btc_price_change_96=btc_change_96,
+        strategies=strategies,
+        summary=summary,
+    )
+    return new_state, outputs
+
+
+def pad_updates(
+    rows, ts, vals, size: int | None = None
+):
+    """Pad an update batch to a bucketed size so tick_step doesn't recompile
+    per unique batch length. Padding rows use index -1 (dropped by
+    apply_updates). Buckets are powers of two."""
+    import numpy as np
+
+    from binquant_tpu.engine.buffer import NUM_FIELDS
+
+    n = len(rows)
+    if size is None:
+        size = 1
+        while size < max(n, 1):
+            size *= 2
+    out_rows = np.full(size, -1, dtype=np.int32)
+    out_ts = np.full(size, -1, dtype=np.int32)
+    out_vals = np.zeros((size, NUM_FIELDS), dtype=np.float32)
+    if n:
+        out_rows[:n] = rows
+        out_ts[:n] = ts
+        out_vals[:n] = vals
+    return out_rows, out_ts, out_vals
+
+
+def _btc_momentum(btc_close: jnp.ndarray) -> jnp.ndarray:
+    """BTC close pct_change at the last bar (liquidation_sweep_pump.py:166)."""
+    prev = btc_close[-2]
+    ok = jnp.isfinite(prev) & (prev != 0) & jnp.isfinite(btc_close[-1])
+    return jnp.where(ok, btc_close[-1] / jnp.where(ok, prev, 1.0) - 1.0, 0.0)

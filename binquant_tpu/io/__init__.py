@@ -1,0 +1,12 @@
+"""Host-side I/O edges.
+
+Near-ports of the reference's inherently-I/O layers (SURVEY.md §7 step 7):
+websocket ingest, the binbot REST client, Telegram/analytics/autotrade
+emission sinks, the autotrade gate chain + bot lifecycle, the leverage
+calibrator, and the replay harness. Everything network-facing takes an
+injectable transport so tests and offline replay never touch the network
+(the reference cuts the same seam at its pybinbot client classes).
+"""
+
+from binquant_tpu.io.binbot import BinbotApi, BinbotError  # noqa: F401
+from binquant_tpu.io.telegram import TelegramConsumer  # noqa: F401

@@ -1,0 +1,169 @@
+"""Binbot backend REST client.
+
+Equivalent surface to the pybinbot ``BinbotApi`` the reference consumes
+(SURVEY.md §2.8): symbols/settings, bot lifecycle (real + paper), grid
+ladders, analytics dispatch, and market breadth. Thin JSON-over-HTTP with
+an injectable session so the whole surface is mockable — the reference's
+tests patch ``BinbotApi`` wholesale (tests/conftest.py:34-49) and ours do
+the same at this class.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from binquant_tpu.exceptions import BinbotError
+from binquant_tpu.schemas import (
+    AutotradeSettingsSchema,
+    MarketBreadthSeries,
+    SymbolModel,
+    TestAutotradeSettingsSchema,
+)
+
+
+class BinbotApi:
+    """Endpoints mirror the reference's consumption sites
+    (consumers/klines_provider.py, consumers/autotrade_consumer.py,
+    shared/autotrade.py)."""
+
+    def __init__(self, base_url: str, session: Any | None = None) -> None:
+        self.base_url = base_url.rstrip("/")
+        if session is None:
+            import httpx
+
+            session = httpx.Client(timeout=10)
+        self.session = session
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _request(self, method: str, path: str, **kwargs) -> Any:
+        url = f"{self.base_url}{path}"
+        resp = self.session.request(method, url, **kwargs)
+        if resp.status_code >= 400:
+            raise BinbotError(f"{method} {path} -> {resp.status_code}: {resp.text}")
+        payload = resp.json()
+        if isinstance(payload, dict) and payload.get("error") == 1:
+            raise BinbotError(str(payload.get("message", "unknown binbot error")))
+        return payload
+
+    def _get(self, path: str, **kwargs) -> Any:
+        return self._request("GET", path, **kwargs)
+
+    def _post(self, path: str, json: Any = None, **kwargs) -> Any:
+        return self._request("POST", path, json=json, **kwargs)
+
+    def _put(self, path: str, json: Any = None, **kwargs) -> Any:
+        return self._request("PUT", path, json=json, **kwargs)
+
+    def _delete(self, path: str, **kwargs) -> Any:
+        return self._request("DELETE", path, **kwargs)
+
+    @staticmethod
+    def _data(payload: Any) -> Any:
+        if isinstance(payload, dict) and "data" in payload:
+            return payload["data"]
+        return payload
+
+    # -- symbols & settings -------------------------------------------------
+
+    def get_symbols(self) -> list[SymbolModel]:
+        rows = self._data(self._get("/symbols"))
+        return [SymbolModel.model_validate(r) for r in rows]
+
+    def get_single_symbol(self, symbol: str) -> SymbolModel:
+        row = self._data(self._get(f"/symbol/{symbol}"))
+        return SymbolModel.model_validate(row)
+
+    def edit_symbol(self, symbol: str, **fields: Any) -> Any:
+        return self._put(f"/symbol/{symbol}", json=fields)
+
+    def get_autotrade_settings(self) -> AutotradeSettingsSchema:
+        row = self._data(self._get("/autotrade-settings/bots"))
+        return AutotradeSettingsSchema.model_validate(row)
+
+    def get_test_autotrade_settings(self) -> TestAutotradeSettingsSchema:
+        row = self._data(self._get("/autotrade-settings/paper-trading"))
+        return TestAutotradeSettingsSchema.model_validate(row)
+
+    def filter_excluded_symbols(self) -> list[str]:
+        return list(self._data(self._get("/symbols/excluded")) or [])
+
+    # -- bots (real + paper) ------------------------------------------------
+
+    def create_bot(self, payload: dict) -> Any:
+        return self._post("/bot", json=payload)
+
+    def activate_bot(self, bot_id: str) -> Any:
+        return self._get(f"/bot/activate/{bot_id}")
+
+    def deactivate_bot(self, bot_id: str, algorithmic_close: bool = False) -> Any:
+        return self._delete(
+            f"/bot/deactivate/{bot_id}",
+            params={"algorithmic_close": algorithmic_close},
+        )
+
+    def create_paper_bot(self, payload: dict) -> Any:
+        return self._post("/paper-trading", json=payload)
+
+    def activate_paper_bot(self, bot_id: str) -> Any:
+        return self._get(f"/paper-trading/activate/{bot_id}")
+
+    def delete_paper_bot(self, bot_id: str) -> Any:
+        return self._delete(f"/paper-trading/{bot_id}")
+
+    def get_active_pairs(self, collection_name: str = "bots") -> list[str]:
+        return list(self._data(self._get(f"/bots/active-pairs/{collection_name}")) or [])
+
+    def submit_bot_event_logs(self, bot_id: str, message: str) -> Any:
+        try:
+            return self._post(f"/bot/errors/{bot_id}", json={"errors": message})
+        except BinbotError:
+            logging.exception("submit_bot_event_logs failed for %s", bot_id)
+            return None
+
+    def submit_paper_trading_event_logs(self, bot_id: str, message: str) -> Any:
+        try:
+            return self._post(
+                f"/paper-trading/errors/{bot_id}", json={"errors": message}
+            )
+        except BinbotError:
+            logging.exception("submit_paper_trading_event_logs failed for %s", bot_id)
+            return None
+
+    def clean_margin_short(self, pair: str) -> Any:
+        return self._get(f"/bot/clean-margin-short/{pair}")
+
+    def get_available_fiat(self, exchange: str, fiat: str = "USDT") -> float:
+        data = self._data(
+            self._get("/balance/available-fiat", params={"exchange": exchange, "fiat": fiat})
+        )
+        if isinstance(data, dict):
+            return float(data.get("amount", 0.0))
+        return float(data or 0.0)
+
+    # -- grid ladders -------------------------------------------------------
+
+    def get_active_grid_ladders(self) -> list[dict]:
+        return list(self._data(self._get("/grid-ladders/active")) or [])
+
+    def calculate_grid_levels(self, payload: dict) -> Any:
+        return self._post("/grid-ladders/calculate", json=payload)
+
+    def create_grid_ladder(self, payload: dict) -> Any:
+        return self._post("/grid-ladders", json=payload)
+
+    # -- analytics ----------------------------------------------------------
+
+    def dispatch_create_signal(self, payload: dict) -> Any:
+        """Analytics record for EVERY strategy emission
+        (producers/context_evaluator.py:268-333)."""
+        return self._post("/signals", json=payload)
+
+    # -- market data --------------------------------------------------------
+
+    async def get_market_breadth(self, size: int = 7) -> MarketBreadthSeries:
+        """Async in the reference; sync transport wrapped for interface
+        parity."""
+        data = self._data(self._get("/market-breadth", params={"size": size}))
+        return MarketBreadthSeries.model_validate(data or {})

@@ -1,0 +1,341 @@
+"""Signal emission: fired trigger rows → SignalsConsumer + sinks.
+
+The reference's every firing strategy does the same three emissions —
+analytics record, Telegram message, autotrade gate (SURVEY.md §2.5). Here
+the device returns trigger masks; this module materializes, for only the
+fired (strategy, symbol) pairs, the ``SignalsConsumer`` payload (with the
+strategy's bot params), the structured Telegram message (uniform key/value
+line shape the reference's downstream parsers rely on), and the analytics
+record body (``producers/context_evaluator.py:268-333``).
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import UTC, datetime
+from typing import Any
+
+import numpy as np
+
+from binquant_tpu.engine.step import STRATEGY_ORDER, TickOutputs
+from binquant_tpu.enums import (
+    Direction,
+    MarketRegimeCode,
+    MarketTransitionCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+    SignalKind,
+)
+from binquant_tpu.schemas import (
+    BotBase,
+    GridDeploymentRequest,
+    HABollinguerSpread,
+    MarketType,
+    Position,
+    SignalsConsumer,
+)
+from binquant_tpu.utils import (
+    build_links_msg,
+    format_context_timestamp_line,
+    round_numbers,
+)
+
+# Strategies that trade FUTURES market type in their bot params
+_FUTURES_BOT_STRATEGIES = {"activity_burst_pump", "mean_reversion_fade"}
+# Strategies flagged margin_short_reversal=False explicitly
+_NO_REVERSAL = {"coinrule_price_tracker", "mean_reversion_fade"}
+# Reversal enabled (buy_the_dip l. margin_short_reversal=True)
+_WITH_REVERSAL = {"coinrule_buy_the_dip"}
+
+
+def _name(enum_cls, code: int, fallback: str = "UNAVAILABLE") -> str:
+    try:
+        if code < 0:
+            return fallback
+        return enum_cls(code).name
+    except ValueError:
+        return fallback
+
+
+class FiredSignal:
+    """One fired (strategy, symbol) pair with host-materialized payloads."""
+
+    def __init__(
+        self,
+        strategy: str,
+        symbol: str,
+        row: int,
+        value: SignalsConsumer,
+        message: str,
+        analytics: dict[str, Any],
+    ) -> None:
+        self.strategy = strategy
+        self.symbol = symbol
+        self.row = row
+        self.value = value
+        self.message = message
+        self.analytics = analytics
+
+
+def extract_fired(
+    outputs: TickOutputs,
+    registry,
+    env: str = "",
+    exchange: str = "kucoin",
+    market_type: str = "futures",
+    settings=None,
+) -> list[FiredSignal]:
+    """Materialize FiredSignal objects for rows whose trigger bit is set.
+
+    The packed summary is ONE device fetch; per-row diagnostics are fetched
+    lazily per fired strategy (rare — a handful of rows per tick at most).
+    """
+    summary_trigger = np.asarray(outputs.summary.trigger)
+    if not summary_trigger.any():
+        return []
+
+    summary_autotrade = np.asarray(outputs.summary.autotrade)
+    summary_direction = np.asarray(outputs.summary.direction)
+    summary_score = np.asarray(outputs.summary.score)
+    summary_stop = np.asarray(outputs.summary.stop_loss_pct)
+
+    ctx = outputs.context
+    ctx_np = {
+        "market_regime": int(np.asarray(ctx.market_regime)),
+        "transition": int(np.asarray(ctx.market_regime_transition)),
+        "transition_strength": float(np.asarray(ctx.market_regime_transition_strength)),
+        "stress": float(np.asarray(ctx.market_stress_score)),
+        "timestamp_ms": int(np.asarray(ctx.timestamp)) * 1000,
+        "valid": bool(np.asarray(ctx.valid)),
+        "advancers_ratio": float(np.asarray(ctx.advancers_ratio)),
+        "long_tailwind": float(np.asarray(ctx.long_tailwind)),
+        "short_tailwind": float(np.asarray(ctx.short_tailwind)),
+    }
+    feats = ctx.features
+    micro_np = np.asarray(feats.micro_regime)
+    micro_trans_np = np.asarray(feats.micro_transition)
+
+    fired: list[FiredSignal] = []
+    for si, strategy in enumerate(STRATEGY_ORDER):
+        rows = np.nonzero(summary_trigger[si])[0]
+        if rows.size == 0:
+            continue
+        so = outputs.strategies[strategy]
+        diagnostics = {k: np.asarray(v) for k, v in so.diagnostics.items()}
+        pack = outputs.pack5 if strategy in _5M_SET else outputs.pack15
+        closes = np.asarray(pack.close)
+        bb_high = np.asarray(pack.bb_upper)
+        bb_mid = np.asarray(pack.bb_mid)
+        bb_low = np.asarray(pack.bb_lower)
+        volumes = np.asarray(pack.volume)
+
+        for row in rows:
+            row = int(row)
+            symbol = registry.name_of(row)
+            if symbol is None:
+                continue
+            direction_code = int(summary_direction[si, row])
+            direction = Direction(direction_code).name
+            position = Position.short if direction == "SHORT" else Position.long
+            autotrade = bool(summary_autotrade[si, row])
+            score = float(summary_score[si, row])
+            stop_loss = float(summary_stop[si, row])
+            current_price = float(closes[row])
+            spreads = HABollinguerSpread(
+                bb_high=round_numbers(float(bb_high[row]), 6),
+                bb_mid=round_numbers(float(bb_mid[row]), 6),
+                bb_low=round_numbers(float(bb_low[row]), 6),
+            )
+
+            if strategy == "grid_ladder":
+                value = _grid_signal(
+                    symbol, row, diagnostics, current_price, exchange,
+                    market_type, autotrade, ctx_np, settings,
+                )
+            else:
+                bot_kwargs: dict[str, Any] = dict(
+                    pair=symbol,
+                    name=strategy,
+                    position=position,
+                )
+                if strategy in _FUTURES_BOT_STRATEGIES:
+                    bot_kwargs["market_type"] = MarketType.FUTURES
+                else:
+                    bot_kwargs["market_type"] = MarketType(market_type)
+                if strategy in _NO_REVERSAL:
+                    bot_kwargs["margin_short_reversal"] = False
+                if strategy in _WITH_REVERSAL:
+                    bot_kwargs["margin_short_reversal"] = True
+                if strategy == "mean_reversion_fade":
+                    bot_kwargs["dynamic_trailing"] = True
+                    bot_kwargs["stop_loss"] = stop_loss
+                value = SignalsConsumer(
+                    autotrade=autotrade,
+                    current_price=current_price,
+                    direction=direction,
+                    score=score,
+                    volume=float(volumes[row]),
+                    signal_kind=SignalKind.standard,
+                    algorithm_name=strategy,
+                    symbol=symbol,
+                    bot_params=BotBase(**bot_kwargs),
+                    bb_spreads=spreads,
+                )
+
+            message = _build_message(
+                strategy, symbol, row, value, diagnostics, ctx_np,
+                micro_np, micro_trans_np, env, exchange, market_type,
+            )
+            analytics = _analytics_record(strategy, symbol, value, diagnostics, ctx_np, row)
+            fired.append(
+                FiredSignal(strategy, symbol, row, value, message, analytics)
+            )
+    return fired
+
+
+_5M_SET = {
+    "activity_burst_pump",
+    "coinrule_price_tracker",
+    "coinrule_supertrend_swing_reversal",
+    "coinrule_twap_momentum_sniper",
+    "inverse_price_tracker",
+}
+
+
+def _grid_signal(
+    symbol, row, diagnostics, current_price, exchange, market_type,
+    autotrade, ctx_np, settings,
+) -> SignalsConsumer:
+    """GridDeploymentRequest payload (ladder_deployer.py:116-150)."""
+    total_margin = getattr(settings, "grid_total_margin", 10.0) if settings else 10.0
+    level_count = getattr(settings, "grid_level_count", 7) if settings else 7
+    fiat = getattr(settings, "fiat", "USDT") if settings else "USDT"
+    allocation = getattr(settings, "grid_allocation_pct", None) if settings else None
+    reserve = getattr(settings, "grid_cash_reserve_pct", None) if settings else None
+    grid_params = GridDeploymentRequest(
+        symbol=symbol,
+        fiat=fiat,
+        exchange=exchange,
+        market_type=MarketType(market_type),
+        algorithm_name="grid_ladder",
+        generated_at=datetime.now(UTC),
+        range_low=float(diagnostics["range_low"][row]),
+        range_high=float(diagnostics["range_high"][row]),
+        breakout_low=float(diagnostics["breakout_low"][row]),
+        breakout_high=float(diagnostics["breakout_high"][row]),
+        total_margin=total_margin,
+        level_count=level_count,
+        current_price=current_price,
+        current_regime=_name(MarketRegimeCode, ctx_np["market_regime"], None),
+        allocation_pct=allocation,
+        cash_reserve_pct=reserve,
+        indicators={
+            "range_width_pct": float(diagnostics["range_width_pct"][row]),
+            "atr_buffer_pct": float(diagnostics["atr_buffer_pct"][row]),
+        },
+    )
+    return SignalsConsumer(
+        signal_kind=SignalKind.grid_deploy,
+        direction="grid",
+        current_price=current_price,
+        autotrade=autotrade,
+        algorithm_name="grid_ladder",
+        symbol=symbol,
+        grid_params=grid_params,
+    )
+
+
+def _build_message(
+    strategy, symbol, row, value, diagnostics, ctx_np, micro_np,
+    micro_trans_np, env, exchange, market_type,
+) -> str:
+    """Structured Telegram message with the reference's uniform key/value
+    line shape (parsed downstream — shared/time_of_day_filter.py:20-23)."""
+    exchange_link, terminal_link = build_links_msg(env, exchange, market_type, symbol)
+    direction = value.direction if value.direction != "grid" else "GRID"
+    action = f"{direction} ENTRY" if direction != "GRID" else "GRID DEPLOY"
+    regime_name = _name(MarketRegimeCode, ctx_np["market_regime"]) if ctx_np["valid"] else "UNAVAILABLE"
+    transition_name = _name(MarketTransitionCode, ctx_np["transition"], "None")
+    micro_name = _name(MicroRegimeCode, int(micro_np[row]))
+    micro_transition_name = _name(MicroTransitionCode, int(micro_trans_np[row]), "None")
+
+    lines = [
+        f"- [{env}] <strong>#{strategy} algorithm</strong> #{symbol}",
+        f"- Action: {action}",
+        f"- Current price: {round_numbers(value.current_price, 6)}",
+        f"- Strategy: {'short' if value.direction == 'SHORT' else 'long' if value.direction == 'LONG' else 'grid'}",
+        f"- Market regime: {regime_name}",
+        f"- Market transition: {transition_name}",
+        format_context_timestamp_line(ctx_np["timestamp_ms"] if ctx_np["valid"] else None),
+        f"- Coin regime: {micro_name}",
+        f"- Coin transition: {micro_transition_name}",
+        f"- Market stress: {round_numbers(ctx_np['stress'], 3)}",
+    ]
+    if value.score:
+        lines.append(f"- Score: {round_numbers(value.score, 4)}")
+    # strategy-specific telemetry lines from diagnostics (scalars only)
+    for key, arr in diagnostics.items():
+        if key in ("route",) or arr.dtype == np.bool_:
+            continue
+        try:
+            lines.append(f"- {key}: {round_numbers(float(arr[row]), 6)}")
+        except (TypeError, ValueError, IndexError):
+            continue
+    lines.extend(
+        [
+            f"- {'Autotrade is enabled' if value.autotrade else 'Autotrade is disabled'}",
+            f"- <a href='{exchange_link}'>Exchange</a>",
+            f"- <a href='{terminal_link}'>Dashboard trade</a>",
+        ]
+    )
+    return "\n".join(lines)
+
+
+def _analytics_record(
+    strategy, symbol, value, diagnostics, ctx_np, row
+) -> dict[str, Any]:
+    """POST /signals body (context_evaluator.py:302-328)."""
+    merged_indicators: dict[str, Any] = {}
+    for key, arr in diagnostics.items():
+        try:
+            merged_indicators[key] = float(arr[row])
+        except (TypeError, ValueError, IndexError):
+            continue
+    if value.bb_spreads is not None:
+        merged_indicators.setdefault(
+            "bb_spreads", value.bb_spreads.model_dump(mode="json")
+        )
+    if value.current_price:
+        merged_indicators.setdefault("current_price", value.current_price)
+    if value.score:
+        merged_indicators.setdefault("score", value.score)
+    return {
+        "algorithm_name": strategy,
+        "symbol": symbol,
+        "generated_at": datetime.now(UTC).isoformat(),
+        "direction": value.direction,
+        "autotrade": value.autotrade,
+        "current_regime": _name(MarketRegimeCode, ctx_np["market_regime"], None)
+        if ctx_np["valid"]
+        else None,
+        "signal_kind": str(value.signal_kind),
+        "bot_params": value.bot_params.model_dump(mode="json")
+        if value.bot_params
+        else {},
+        "grid_params": value.grid_params.model_dump(mode="json")
+        if value.grid_params
+        else {},
+        "indicators": merged_indicators,
+    }
+
+
+def dispatch_signal_record(binbot_api, record: dict[str, Any]) -> None:
+    """Fire-and-forget analytics POST — failures never break the trade path
+    (context_evaluator.py:329-333)."""
+    try:
+        binbot_api.dispatch_create_signal(record)
+    except Exception:
+        logging.exception(
+            "dispatch_signal_record failed for %s; trade path continues.",
+            record.get("symbol"),
+        )

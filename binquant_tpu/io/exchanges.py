@@ -1,0 +1,120 @@
+"""Exchange REST clients (Binance spot, KuCoin spot/futures).
+
+Equivalent surface to the pybinbot exchange clients the reference consumes
+(SURVEY.md §2.8): ``get_ui_klines``, ``get_ticker_price``,
+``get_open_interest``, ``get_mark_price``, ``get_symbol_info``. Sessions are
+injectable; only the endpoints binquant actually calls are implemented.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+
+class FuturesSymbolInfo(NamedTuple):
+    """Fields the futures margin resolver reads
+    (consumers/autotrade_consumer.py:117-123)."""
+
+    symbol: str
+    multiplier: float
+    lot_size: float
+    taker_fee_rate: float
+
+
+class _RestClient:
+    def __init__(self, base_url: str, session: Any | None = None) -> None:
+        self.base_url = base_url.rstrip("/")
+        if session is None:
+            import httpx
+
+            session = httpx.Client(timeout=10)
+        self.session = session
+
+    def _get(self, path: str, params: dict | None = None) -> Any:
+        resp = self.session.get(f"{self.base_url}{path}", params=params or {})
+        resp.raise_for_status()
+        return resp.json()
+
+
+class BinanceApi(_RestClient):
+    BASE = "https://api.binance.com"
+
+    def __init__(self, key: str = "", secret: str = "", session: Any | None = None):
+        super().__init__(self.BASE, session)
+        self.key, self.secret = key, secret
+
+    def get_ui_klines(
+        self, symbol: str, interval: str = "15m", limit: int = 400
+    ) -> list[list]:
+        return self._get(
+            "/api/v3/uiKlines",
+            {"symbol": symbol, "interval": interval, "limit": limit},
+        )
+
+    def get_ticker_price(self, symbol: str) -> float:
+        data = self._get("/api/v3/ticker/price", {"symbol": symbol})
+        return float(data["price"])
+
+    def get_request_weight(self, resp_headers: dict) -> int:
+        """Binance used-weight header (shared/utils.py:70-104 reads
+        x-mbx-used-weight-1m for the rate-limit guard)."""
+        return int(resp_headers.get("x-mbx-used-weight-1m", 0))
+
+
+class KucoinApi(_RestClient):
+    BASE = "https://api.kucoin.com"
+
+    def __init__(
+        self,
+        key: str = "",
+        secret: str = "",
+        passphrase: str = "",
+        session: Any | None = None,
+    ):
+        super().__init__(self.BASE, session)
+        self.key, self.secret, self.passphrase = key, secret, passphrase
+
+    def get_ticker_price(self, symbol: str) -> float:
+        data = self._get(
+            "/api/v1/market/orderbook/level1", {"symbol": symbol}
+        )
+        return float(data["data"]["price"])
+
+    def get_ui_klines(
+        self, symbol: str, interval: str = "15min", limit: int = 400
+    ) -> list[list]:
+        data = self._get(
+            "/api/v1/market/candles", {"symbol": symbol, "type": interval}
+        )
+        return list(data.get("data", []))[:limit]
+
+
+class KucoinFutures(_RestClient):
+    BASE = "https://api-futures.kucoin.com"
+
+    def __init__(
+        self,
+        key: str = "",
+        secret: str = "",
+        passphrase: str = "",
+        session: Any | None = None,
+    ):
+        super().__init__(self.BASE, session)
+        self.key, self.secret, self.passphrase = key, secret, passphrase
+
+    def get_symbol_info(self, symbol: str) -> FuturesSymbolInfo:
+        data = self._get(f"/api/v1/contracts/{symbol}")["data"]
+        return FuturesSymbolInfo(
+            symbol=symbol,
+            multiplier=float(data.get("multiplier", 1.0)),
+            lot_size=float(data.get("lotSize", 1.0)),
+            taker_fee_rate=float(data.get("takerFeeRate", 0.0006)),
+        )
+
+    def get_mark_price(self, symbol: str) -> float:
+        data = self._get(f"/api/v1/mark-price/{symbol}/current")["data"]
+        return float(data["value"])
+
+    def get_open_interest(self, symbol: str) -> float:
+        data = self._get(f"/api/v1/contracts/{symbol}")["data"]
+        return float(data.get("openInterest", 0.0) or 0.0)

@@ -1,0 +1,130 @@
+"""Leverage calibrator.
+
+Equivalent of ``/root/reference/calibrators/leverage_calibrator.py``: per
+15-minute bucket, map the regime to a per-symbol futures leverage ladder
+(expensive/defensive/stressed/low-confidence/spiky → 1x; RANGE → 2x; trends
+→ 3x) and PUT via ``edit_symbol`` only on change. Consumes a host snapshot
+of the device context (numpy'd ``MarketContext``) plus the symbol registry.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from binquant_tpu.engine.buffer import SymbolRegistry
+from binquant_tpu.enums import MarketRegimeCode
+from binquant_tpu.io.binbot import BinbotApi
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.schemas import SymbolModel
+
+
+class LeverageCalibrator:
+    MAX_LEVERAGE = 3
+    DEFAULT_PRICE_HIGH_THRESHOLD = 500.0
+    DEFAULT_STRESS_THRESHOLD = 0.7
+    DEFAULT_CONFIDENCE_FLOOR = 0.5
+    DEFAULT_ATR_HIGH_THRESHOLD = 0.04
+
+    def __init__(
+        self,
+        binbot_api: BinbotApi,
+        exchange: str,
+        *,
+        price_high_threshold: float = DEFAULT_PRICE_HIGH_THRESHOLD,
+        stress_threshold: float = DEFAULT_STRESS_THRESHOLD,
+        confidence_floor: float = DEFAULT_CONFIDENCE_FLOOR,
+        atr_high_threshold: float = DEFAULT_ATR_HIGH_THRESHOLD,
+    ) -> None:
+        self.binbot_api = binbot_api
+        self.exchange = exchange
+        self.price_high_threshold = price_high_threshold
+        self.stress_threshold = stress_threshold
+        self.confidence_floor = confidence_floor
+        self.atr_high_threshold = atr_high_threshold
+
+    def _regime_defensive(self, regime: int) -> bool:
+        return regime in (
+            int(MarketRegimeCode.HIGH_STRESS),
+            int(MarketRegimeCode.TRANSITIONAL),
+        )
+
+    def target_leverage(
+        self, close: float, atr_pct: float | None, regime: int, stress: float,
+        confidence: float,
+    ) -> int:
+        """Decision ladder (reference l.50-79)."""
+        if close >= self.price_high_threshold:
+            return 1
+        if self._regime_defensive(regime):
+            return 1
+        if stress > self.stress_threshold:
+            return 1
+        if confidence < self.confidence_floor:
+            return 1
+        if atr_pct is not None and atr_pct > self.atr_high_threshold:
+            return 1
+        if regime == int(MarketRegimeCode.RANGE):
+            return 2
+        if regime in (int(MarketRegimeCode.TREND_UP), int(MarketRegimeCode.TREND_DOWN)):
+            return self.MAX_LEVERAGE
+        return 1
+
+    def calibrate_all(
+        self,
+        context: MarketContext,
+        registry: SymbolRegistry,
+        all_symbols: list[SymbolModel],
+    ) -> dict[str, int]:
+        """Diff-and-PUT for every feature-valid row (reference l.81-127)."""
+        rows_by_id = {row.id: row for row in all_symbols}
+        applied = no_change = skipped = 0
+
+        valid = np.asarray(context.features.valid)
+        closes = np.asarray(context.features.close)
+        atr_pcts = np.asarray(context.features.atr_pct)
+        regime = int(np.asarray(context.market_regime))
+        stress = float(np.asarray(context.market_stress_score))
+        confidence = 1.0 if bool(np.asarray(context.valid)) else 0.0
+
+        for row_idx in np.nonzero(valid)[0]:
+            symbol = registry.name_of(int(row_idx))
+            if symbol is None:
+                skipped += 1
+                continue
+            row = rows_by_id.get(symbol)
+            if row is None:
+                skipped += 1
+                continue
+            target = self.target_leverage(
+                float(closes[row_idx]),
+                float(atr_pcts[row_idx]),
+                regime,
+                stress,
+                confidence,
+            )
+            if target == row.futures_leverage:
+                no_change += 1
+                continue
+            try:
+                self.binbot_api.edit_symbol(
+                    symbol,
+                    exchange_id=self.exchange,
+                    futures_leverage=target,
+                )
+                row.futures_leverage = target
+                applied += 1
+            except Exception:
+                logging.exception(
+                    "[LeverageCalibrator] failed to update %s -> %s", symbol, target
+                )
+                skipped += 1
+
+        logging.info(
+            "[LeverageCalibrator] applied=%d no_change=%d skipped=%d",
+            applied,
+            no_change,
+            skipped,
+        )
+        return {"applied": applied, "no_change": no_change, "skipped": skipped}

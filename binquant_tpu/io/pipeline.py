@@ -1,0 +1,328 @@
+"""The signal engine pipeline: ingest → device tick → emission.
+
+Equivalent of ``/root/reference/consumers/klines_provider.py`` +
+``/root/reference/main.py``, inverted TPU-first (SURVEY.md §7): instead of
+per-message REST refetch + per-symbol pandas, candles accumulate in the
+IngestBatcher between ticks and ONE jit'd ``tick_step`` evaluates the whole
+market; the host then emits only fired rows. Periodic jobs keep the
+reference's cadence: market breadth + leverage calibration once per 15m
+bucket (klines_provider.py:244-250,305-319), KuCoin OI with a 5 s TTL cache
+(l.252-276), heartbeat after each processed tick (main.py:30-32,53).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from binquant_tpu.config import Config
+from binquant_tpu.engine.buffer import IngestBatcher, SymbolRegistry
+from binquant_tpu.engine.step import (
+    default_host_inputs,
+    initial_engine_state,
+    pad_updates,
+    tick_step,
+)
+from binquant_tpu.io.autotrade import AutotradeConsumer
+from binquant_tpu.io.binbot import BinbotApi
+from binquant_tpu.io.emission import dispatch_signal_record, extract_fired
+from binquant_tpu.io.leverage import LeverageCalibrator
+from binquant_tpu.io.telegram import TelegramConsumer
+from binquant_tpu.regime.context import ContextConfig
+from binquant_tpu.regime.grid_policy import GridOnlyPolicy
+from binquant_tpu.regime.time_filter import is_autotrade_suppressed, is_quiet_hours
+from binquant_tpu.schemas import MarketBreadthSeries
+from binquant_tpu.strategies.market_regime_notifier import MarketRegimeNotifier
+
+FIFTEEN_MIN_S = 900
+FIVE_MIN_S = 300
+OI_CACHE_TTL_S = 5.0  # klines_provider.py:67-68
+
+
+class OpenInterestCache:
+    """KuCoin OI growth per symbol with a 5 s TTL (klines_provider.py:252-276)."""
+
+    def __init__(self, futures_api: Any | None) -> None:
+        self.futures_api = futures_api
+        self._cache: dict[str, tuple[float, float]] = {}  # symbol -> (ts, oi)
+        self._prev: dict[str, float] = {}
+
+    def growth(self, symbol: str) -> float:
+        """OI now / OI previous sample; NaN when unavailable."""
+        if self.futures_api is None:
+            return float("nan")
+        now = time.monotonic()
+        cached = self._cache.get(symbol)
+        if cached and now - cached[0] < OI_CACHE_TTL_S:
+            oi = cached[1]
+        else:
+            try:
+                oi = float(self.futures_api.get_open_interest(symbol))
+            except Exception:
+                return float("nan")
+            self._cache[symbol] = (now, oi)
+        prev = self._prev.get(symbol)
+        self._prev[symbol] = oi
+        if not prev or prev <= 0:
+            return float("nan")
+        return oi / prev
+
+
+class SignalEngine:
+    """Owns the device state and drives ticks from queued klines."""
+
+    def __init__(
+        self,
+        config: Config,
+        binbot_api: BinbotApi,
+        telegram_consumer: TelegramConsumer,
+        at_consumer: AutotradeConsumer,
+        registry: SymbolRegistry | None = None,
+        window: int = 400,
+        futures_api: Any | None = None,
+        context_config: ContextConfig = ContextConfig(),
+        btc_symbol: str = "BTCUSDT",
+    ) -> None:
+        self.config = config
+        self.binbot_api = binbot_api
+        self.telegram_consumer = telegram_consumer
+        self.at_consumer = at_consumer
+        self.capacity = config.max_symbols
+        self.registry = registry or SymbolRegistry(self.capacity)
+        self.batcher5 = IngestBatcher(self.registry)
+        self.batcher15 = IngestBatcher(self.registry)
+        self.state = initial_engine_state(self.capacity, window=window)
+        self.context_config = context_config
+        self.btc_symbol = btc_symbol
+        self.notifier = MarketRegimeNotifier(env=config.env)
+        self.leverage_calibrator = LeverageCalibrator(
+            binbot_api, at_consumer.exchange
+        )
+        self.oi_cache = OpenInterestCache(futures_api)
+        self.market_breadth: MarketBreadthSeries | None = None
+        self.grid_only_policy = GridOnlyPolicy.disabled("not_evaluated")
+        self._last_breadth_bucket = -1
+        self._last_calibration_bucket = -1
+        self._pending_oi: dict[int, float] = {}
+        self.heartbeat_path = Path(config.heartbeat_path)
+        self.ticks_processed = 0
+        self.signals_emitted = 0
+
+    # -- ingest -------------------------------------------------------------
+
+    def ingest(self, kline: dict) -> None:
+        """Route one closed candle to its interval batcher by bar duration."""
+        duration_s = (int(kline["close_time"]) - int(kline["open_time"])) // 1000
+        if abs(duration_s - FIVE_MIN_S) <= 1:
+            self.batcher5.add(kline)
+        else:
+            self.batcher15.add(kline)
+
+    # -- periodic jobs (15m bucket cadence) ----------------------------------
+
+    async def _refresh_market_breadth(self, bucket: int) -> None:
+        if bucket == self._last_breadth_bucket:
+            return
+        self._last_breadth_bucket = bucket
+        try:
+            self.market_breadth = await self.binbot_api.get_market_breadth()
+        except Exception:
+            logging.exception("market breadth refresh failed; keeping previous")
+
+    def _run_leverage_calibration(self, bucket: int, context) -> None:
+        if bucket == self._last_calibration_bucket:
+            return
+        self._last_calibration_bucket = bucket
+        try:
+            self.leverage_calibrator.calibrate_all(
+                context, self.registry, self.at_consumer.all_symbols
+            )
+        except Exception:
+            logging.exception("leverage calibration crashed; continuing")
+
+    # -- breadth-derived inputs ----------------------------------------------
+
+    def _breadth_scalars(self) -> tuple[float, float, float, float, float]:
+        """(adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum_points)."""
+        nan = float("nan")
+        mb = self.market_breadth
+        if mb is None or len(mb.timestamp) < 2:
+            return nan, nan, nan, nan, nan
+        values = [float(v) for v in mb.market_breadth]
+        adp_latest = values[-1] if values else nan
+        adp_prev = values[-2] if len(values) >= 2 else nan
+        adp_diff = (
+            values[-1] - values[-2] if len(values) >= 2 else nan
+        )
+        adp_diff_prev = (
+            values[-2] - values[-3] if len(values) >= 3 else nan
+        )
+        ma = [float(v) for v in mb.market_breadth_ma]
+        momentum = (ma[-1] - ma[-2]) * 100 if len(ma) >= 2 else (
+            (values[-1] - values[-2]) * 100 if len(values) >= 2 else nan
+        )
+        return adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum
+
+    # -- the tick -------------------------------------------------------------
+
+    async def process_tick(self, now_ms: int | None = None) -> list:
+        """Drain batchers, run the jit'd step, emit fired signals."""
+        import jax.numpy as jnp
+
+        ts_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        ts_s = ts_ms // 1000
+        # Evaluate against the bar that just CLOSED: its open time is one
+        # full interval behind the current wall-clock bucket.
+        bucket15 = ts_s // FIFTEEN_MIN_S
+        ts15 = bucket15 * FIFTEEN_MIN_S - FIFTEEN_MIN_S
+        ts5 = (ts_s // FIVE_MIN_S) * FIVE_MIN_S - FIVE_MIN_S
+
+        await self._refresh_market_breadth(bucket15)
+
+        batches5 = self.batcher5.drain()
+        batches15 = self.batcher15.drain()
+        # OI growth for symbols with fresh 15m candles (reference cadence)
+        oi = np.full(self.capacity, np.nan, dtype=np.float32)
+        for rows, _, _ in batches15:
+            for row in rows:
+                symbol = self.registry.name_of(int(row))
+                if symbol:
+                    oi[int(row)] = self.oi_cache.growth(symbol)
+
+        adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum = (
+            self._breadth_scalars()
+        )
+        settings = self.at_consumer.autotrade_settings
+        quiet = is_quiet_hours()
+
+        empty = pad_updates(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros((0, 10), np.float32), size=4,
+        )
+        upd5_list = [pad_updates(*b) for b in batches5] or [empty]
+        upd15_list = [pad_updates(*b) for b in batches15] or [empty]
+
+        outputs = None
+        # replay ordered sub-batches; evaluate on the last application
+        n = max(len(upd5_list), len(upd15_list))
+        for i in range(n):
+            u5 = upd5_list[i] if i < len(upd5_list) else empty
+            u15 = upd15_list[i] if i < len(upd15_list) else empty
+            inputs = default_host_inputs(self.capacity)._replace(
+                tracked=jnp.asarray(self.registry.active_rows),
+                btc_row=np.int32(self.registry.row_of(self.btc_symbol) or -1),
+                timestamp_s=np.int32(ts15),
+                timestamp5_s=np.int32(ts5),
+                oi_growth=jnp.asarray(oi),
+                adp_latest=jnp.asarray(np.float32(adp_latest)),
+                adp_prev=jnp.asarray(np.float32(adp_prev)),
+                adp_diff=jnp.asarray(np.float32(adp_diff)),
+                adp_diff_prev=jnp.asarray(np.float32(adp_diff_prev)),
+                breadth_momentum_points=jnp.asarray(np.float32(momentum)),
+                quiet_hours=jnp.asarray(
+                    is_autotrade_suppressed(None, 0.0) if quiet else False
+                ),
+                grid_policy_allows=jnp.asarray(
+                    self.grid_only_policy.allow_grid_ladder
+                ),
+                is_futures=jnp.asarray(
+                    str(settings.market_type).lower().endswith("futures")
+                ),
+                dominance_is_losers=jnp.asarray(False),
+                market_domination_reversal=jnp.asarray(
+                    self.at_consumer.market_domination_reversal
+                ),
+            )
+            self.state, outputs = tick_step(
+                self.state, u5, u15, inputs, self.context_config
+            )
+
+        assert outputs is not None
+        # refresh grid-only policy from the new context + breadth
+        regime = int(np.asarray(outputs.context.market_regime))
+        has_ctx = bool(np.asarray(outputs.context.valid))
+        self.grid_only_policy = GridOnlyPolicy.resolve(
+            regime if has_ctx else None, self.market_breadth
+        )
+        self.at_consumer.grid_only_policy = self.grid_only_policy
+
+        # regime-transition digest (host-side notifier)
+        digest = self.notifier.build_message(outputs.context)
+        if digest:
+            self.telegram_consumer.dispatch_signal(digest)
+
+        # leverage calibration once per 15m bucket, needs a valid context
+        if has_ctx:
+            self._run_leverage_calibration(bucket15, outputs.context)
+
+        # emit fired signals through the three sinks
+        fired = extract_fired(
+            outputs,
+            self.registry,
+            env=self.config.env,
+            exchange=self.at_consumer.exchange,
+            market_type=str(settings.market_type),
+            settings=settings,
+        )
+        for signal in fired:
+            dispatch_signal_record(self.binbot_api, signal.analytics)
+            self.telegram_consumer.dispatch_signal(signal.message)
+            try:
+                await self.at_consumer.process_autotrade_restrictions(signal.value)
+            except Exception:
+                logging.exception(
+                    "autotrade processing crashed for %s/%s; continuing",
+                    signal.strategy,
+                    signal.symbol,
+                )
+        self.signals_emitted += len(fired)
+        self.ticks_processed += 1
+        self.touch_heartbeat()
+        return fired
+
+    def touch_heartbeat(self) -> None:
+        """Liveness file checked by healthcheck.py (main.py:30-32)."""
+        try:
+            self.heartbeat_path.write_text(str(time.time()))
+        except OSError:
+            logging.warning("failed to write heartbeat file")
+
+    # -- loops (main.py:37-57) ------------------------------------------------
+
+    async def consume_loop(
+        self, queue: asyncio.Queue, tick_interval_s: float = 1.0
+    ) -> None:
+        """Drain the ingest queue continuously; evaluate once per interval.
+
+        Per-message crash isolation mirrors main.py:48-57: one bad payload
+        is logged and skipped, the loop never dies.
+        """
+        last_tick = 0.0
+        while True:
+            try:
+                timeout = max(tick_interval_s - (time.monotonic() - last_tick), 0.01)
+                try:
+                    kline = await asyncio.wait_for(queue.get(), timeout=timeout)
+                    self.ingest(kline)
+                    # drain whatever else is queued without blocking
+                    while True:
+                        try:
+                            self.ingest(queue.get_nowait())
+                        except asyncio.QueueEmpty:
+                            break
+                except TimeoutError:
+                    pass
+                if time.monotonic() - last_tick >= tick_interval_s and (
+                    len(self.batcher5) or len(self.batcher15)
+                ):
+                    last_tick = time.monotonic()
+                    await self.process_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logging.exception("tick processing failed; continuing")

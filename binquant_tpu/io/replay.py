@@ -1,0 +1,226 @@
+"""Offline replay harness.
+
+Feeds a JSONL file of closed klines through the full pipeline with every
+network sink stubbed — the correctness oracle and benchmark A/B the
+reference lacks (SURVEY.md §4 implication; BASELINE.json config #2). Each
+line is an ``ExtendedKline``-shaped dict; lines are replayed in file order,
+with one engine tick per distinct (15m bucket) timestamp group.
+
+Also provides ``generate_replay_file`` to synthesize a market for smoke
+runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+
+class StubSession:
+    """In-memory binbot backend for replay (the reference's tests cut the
+    same seam by patching BinbotApi)."""
+
+    class _Resp:
+        def __init__(self, payload: Any, status_code: int = 200) -> None:
+            self._payload = payload
+            self.status_code = status_code
+            self.text = json.dumps(payload)
+
+        def json(self) -> Any:
+            return self._payload
+
+    def __init__(self) -> None:
+        self.requests: list[tuple[str, str, Any]] = []
+
+    def request(self, method: str, url: str, **kwargs):
+        self.requests.append((method, url, kwargs.get("json")))
+        if "available-fiat" in url:
+            return self._Resp({"data": {"amount": 1000.0}})
+        if "active-pairs" in url or "excluded" in url or "grid-ladders/active" in url:
+            return self._Resp({"data": []})
+        if "/bot" in url and method == "POST":
+            return self._Resp(
+                {"message": "ok", "error": 0, "data": {"pair": "X", "id": "00000000-0000-0000-0000-000000000000"}}
+            )
+        if "activate" in url:
+            return self._Resp(
+                {"message": "ok", "error": 0, "data": {"pair": "X"}}
+            )
+        if "market-breadth" in url:
+            return self._Resp({"data": {}})
+        return self._Resp({"data": {}})
+
+    def get(self, url, params=None):
+        return self.request("GET", url, params=params)
+
+
+def make_stub_engine(capacity: int = 256, window: int = 200):
+    """A SignalEngine wired entirely to stubs (no network)."""
+    import os
+
+    os.environ.setdefault("ENV", "CI")
+    from binquant_tpu.config import Config
+    from binquant_tpu.io.autotrade import AutotradeConsumer
+    from binquant_tpu.io.binbot import BinbotApi
+    from binquant_tpu.io.pipeline import SignalEngine
+    from binquant_tpu.io.telegram import TelegramConsumer
+    from binquant_tpu.regime.context import ContextConfig
+    from binquant_tpu.schemas import (
+        AutotradeSettingsSchema,
+        TestAutotradeSettingsSchema,
+    )
+
+    Config.reset()
+    config = Config()
+    config.__dict__["max_symbols"] = capacity
+    config.__dict__["window_bars"] = window
+    binbot_api = BinbotApi("http://stub", session=StubSession())
+
+    sent: list[str] = []
+
+    async def capture_transport(chat_id: str, text: str) -> None:
+        sent.append(text)
+
+    telegram = TelegramConsumer(
+        token="", chat_id="stub", transport=capture_transport
+    )
+    at_consumer = AutotradeConsumer(
+        autotrade_settings=AutotradeSettingsSchema(autotrade=False),
+        active_test_bots=[],
+        all_symbols=[],
+        test_autotrade_settings=TestAutotradeSettingsSchema(autotrade=False),
+        active_grid_ladders=[],
+        binbot_api=binbot_api,
+    )
+    engine = SignalEngine(
+        config=config,
+        binbot_api=binbot_api,
+        telegram_consumer=telegram,
+        at_consumer=at_consumer,
+        window=window,
+        context_config=ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5),
+    )
+    engine._telegram_sent = sent  # type: ignore[attr-defined]
+    return engine
+
+
+def run_replay(path: str | Path, capacity: int = 256, window: int = 200) -> dict:
+    """Replay a JSONL kline file; returns run statistics."""
+    engine = make_stub_engine(capacity=capacity, window=window)
+
+    klines_by_tick: dict[int, list[dict]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            k = json.loads(line)
+            bucket = int(k["open_time"]) // 1000 // 900
+            klines_by_tick.setdefault(bucket, []).append(k)
+
+    fired_total = 0
+    t_start = time.perf_counter()
+    latencies = []
+
+    async def drive() -> None:
+        nonlocal fired_total
+        for bucket in sorted(klines_by_tick):
+            for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
+                engine.ingest(k)
+            # the tick fires just after the bucket's bars CLOSE
+            tick_ms = (bucket + 1) * 900 * 1000
+            t0 = time.perf_counter()
+            fired = await engine.process_tick(now_ms=tick_ms)
+            latencies.append((time.perf_counter() - t0) * 1000)
+            fired_total += len(fired)
+
+    asyncio.run(drive())
+    wall = time.perf_counter() - t_start
+    return {
+        "ticks": engine.ticks_processed,
+        "signals": fired_total,
+        "telegram_messages": len(engine._telegram_sent),  # type: ignore[attr-defined]
+        "wall_s": round(wall, 3),
+        "tick_p50_ms": round(float(np.percentile(latencies, 50)), 3) if latencies else None,
+        "tick_p99_ms": round(float(np.percentile(latencies, 99)), 3) if latencies else None,
+    }
+
+
+def generate_replay_file(
+    path: str | Path,
+    n_symbols: int = 100,
+    n_ticks: int = 150,
+    seed: int = 7,
+) -> None:
+    """Synthesize a dual-interval (5m + 15m) market replay with crafted
+    setups: an activity burst on S001's 5m stream and a MeanReversionFade
+    hammer on S005's 15m stream, so the emission path is exercised."""
+    rng = np.random.default_rng(seed)
+    t0 = 1_753_000_000
+    px = 20 + rng.random(n_symbols) * 100
+
+    def bar(symbol, ts_s, interval_s, o, h, low, c, volume):
+        return json.dumps(
+            {
+                "symbol": symbol,
+                "open_time": ts_s * 1000,
+                "close_time": (ts_s + interval_s) * 1000 - 1,
+                "open": round(float(o), 6),
+                "high": round(float(h), 6),
+                "low": round(float(low), 6),
+                "close": round(float(c), 6),
+                "volume": round(float(volume), 3),
+                "quote_asset_volume": round(float(volume * c), 3),
+                "number_of_trades": 300,
+                "taker_buy_base_volume": round(float(volume / 2), 3),
+                "taker_buy_quote_volume": round(float(volume * c / 2), 3),
+            }
+        ) + "\n"
+
+    with open(path, "w") as f:
+        for tick in range(n_ticks):
+            ts15 = t0 + tick * 900
+            # S005 drifts hard down so its RSI pins low before the hammer
+            rets = rng.normal(0, 0.004, n_symbols)
+            rets[5] -= 0.008
+            last_tick = tick == n_ticks - 1
+            new_px = px * (1 + rets)
+            for i in range(n_symbols):
+                symbol = "BTCUSDT" if i == 0 else f"S{i:03d}USDT"
+                o, c = px[i], new_px[i]
+                vol15 = abs(rng.normal(1000, 200))
+                h, low = max(o, c) * 1.002, min(o, c) * 0.998
+                if last_tick and i == 5:
+                    # green hammer: big gap down, green close, 2x volume
+                    o = px[i] * 0.965
+                    c = o * 1.004
+                    h, low = c * 1.001, o * 0.997
+                    new_px[i] = c
+                    vol15 *= 3.0
+                f.write(bar(symbol, ts15, 900, o, h, low, c, vol15))
+                # three 5m sub-bars splitting the 15m move
+                sub_o = o
+                for j in range(3):
+                    frac = (j + 1) / 3
+                    sub_c = o + (c - o) * frac
+                    vol5 = vol15 / 3
+                    sh, sl = max(sub_o, sub_c) * 1.001, min(sub_o, sub_c) * 0.999
+                    if last_tick and i == 1:
+                        # activity burst on the LAST 5m bar: +3% jump, green
+                        # body at highs, 6x volume, after two up sub-bars
+                        if j < 2:
+                            sub_c = sub_o * 1.003
+                            sh, sl = sub_c * 1.001, sub_o * 0.999
+                        else:
+                            sub_c = sub_o * 1.03
+                            sh, sl = sub_c * 1.002, sub_o * 0.998
+                            vol5 *= 8.0
+                        new_px[i] = sub_c
+                    f.write(bar(symbol, ts15 + j * 300, 300, sub_o, sh, sl, sub_c, vol5))
+                    sub_o = sub_c
+            px = new_px

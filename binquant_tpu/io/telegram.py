@@ -1,0 +1,219 @@
+"""Telegram emission sink.
+
+Equivalent of ``/root/reference/consumers/telegram_consumer.py``: HTML
+sanitizer preserving whitelisted tags (l.44-76), content-based dedupe key
+from algo/symbol/action fields with a 900 s cooldown and pending-set
+(l.82-137), a global send lock with 1 s min interval and flood-control
+backoff (l.139-172), and fire-and-forget dispatch with a task-set GC guard
+(l.193-212). Transport is injectable (an async callable posting to the Bot
+API) so tests never hit the network; the default uses httpx against
+api.telegram.org — no python-telegram-bot dependency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import html
+import logging
+import re
+import time
+from collections.abc import Awaitable, Callable
+
+
+class RetryAfterError(Exception):
+    """Telegram flood control: retry after N seconds."""
+
+    def __init__(self, retry_after: float) -> None:
+        super().__init__(f"retry after {retry_after}s")
+        self.retry_after = retry_after
+
+
+def make_httpx_transport(token: str) -> Callable[[str, str], Awaitable[None]]:
+    """Default transport: POST sendMessage via httpx (async)."""
+    import httpx
+
+    url = f"https://api.telegram.org/bot{token}/sendMessage"
+
+    async def send(chat_id: str, text: str) -> None:
+        async with httpx.AsyncClient(timeout=10) as client:
+            resp = await client.post(
+                url,
+                json={"chat_id": chat_id, "text": text, "parse_mode": "HTML"},
+            )
+            if resp.status_code == 429:
+                retry = float(resp.json().get("parameters", {}).get("retry_after", 5))
+                raise RetryAfterError(retry)
+            resp.raise_for_status()
+
+    return send
+
+
+class TelegramConsumer:
+    _ALLOWED_HTML_TAGS = ("b", "strong", "i", "em", "u", "s", "code", "pre", "a")
+    _MIN_SEND_INTERVAL_SECONDS = 1.0
+    _RETRY_AFTER_PAD_SECONDS = 2.0
+    _SIGNAL_DEDUPE_SECONDS = 900.0
+
+    def __init__(
+        self,
+        token: str,
+        chat_id: str,
+        is_enabled: bool = True,
+        transport: Callable[[str, str], Awaitable[None]] | None = None,
+    ) -> None:
+        self.chat_id = chat_id
+        self.is_enabled = is_enabled
+        self._transport = transport or (
+            make_httpx_transport(token) if token else None
+        )
+        self._send_lock = asyncio.Lock()
+        self._min_send_interval_seconds = self._MIN_SEND_INTERVAL_SECONDS
+        self._retry_after_pad_seconds = self._RETRY_AFTER_PAD_SECONDS
+        self._signal_dedupe_seconds = self._SIGNAL_DEDUPE_SECONDS
+        self._last_send_at = 0.0
+        self._recent_signal_keys: dict[str, float] = {}
+        self._pending_signal_keys: set[str] = set()
+        # Keep created tasks alive until the Telegram round-trip completes.
+        self._background_tasks: set[asyncio.Task] = set()
+
+    # -- sanitization (reference l.44-76) -----------------------------------
+
+    def _sanitize_html(self, message: str) -> str:
+        sanitized = html.escape(message, quote=True)
+        for tag in self._ALLOWED_HTML_TAGS:
+            sanitized = sanitized.replace(f"&lt;{tag}&gt;", f"<{tag}>")
+            sanitized = sanitized.replace(f"&lt;/{tag}&gt;", f"</{tag}>")
+        sanitized = re.sub(
+            r"&lt;(pre|code)\s+([^&]*)&gt;",
+            lambda m: f"<{m.group(1)} {m.group(2)}>",
+            sanitized,
+        )
+        sanitized = re.sub(
+            r"&lt;a\s+href=(?:&#x27;|&quot;)(.+?)(?:&#x27;|&quot;)&gt;",
+            lambda m: f'<a href="{m.group(1)}">',
+            sanitized,
+        )
+        sanitized = re.sub(
+            r"&amp;(lt|gt|amp|quot|#x27);",
+            lambda m: f"&{m.group(1)};",
+            sanitized,
+        )
+        return sanitized
+
+    # -- dedupe (reference l.78-137) ----------------------------------------
+
+    @staticmethod
+    def _clean_signal_message(message: str) -> str:
+        lines = [line.strip() for line in message.splitlines() if line.strip()]
+        return "\n".join(lines)
+
+    def _message_field(self, cleaned: str, label: str) -> str:
+        match = re.search(rf"^- {re.escape(label)}:\s*(.+)$", cleaned, re.M)
+        return match.group(1).strip() if match else ""
+
+    def _signal_dedupe_key(self, cleaned: str) -> str:
+        hashtags = re.findall(r"#([A-Za-z0-9_]+)", cleaned)
+        symbol = hashtags[-1] if hashtags else ""
+        algo_match = re.search(r"<strong>#([^<\s]+)\s+algorithm</strong>", cleaned)
+        algo = algo_match.group(1) if algo_match else ""
+        fields = {
+            "action": self._message_field(cleaned, "Action"),
+            "strategy": self._message_field(cleaned, "Strategy"),
+            "route": self._message_field(cleaned, "Autotrade route"),
+            "autotrade": "enabled"
+            if "Autotrade is enabled" in cleaned
+            else "disabled"
+            if "Autotrade is disabled" in cleaned
+            else "",
+        }
+        key_parts = [algo, symbol, *fields.values()]
+        if any(key_parts):
+            return "|".join(key_parts)
+        return hashlib.sha1(cleaned.encode("utf-8")).hexdigest()
+
+    def _drop_duplicate_signal(self, signal_key: str) -> bool:
+        if self._signal_dedupe_seconds <= 0:
+            if signal_key in self._pending_signal_keys:
+                return True
+            self._pending_signal_keys.add(signal_key)
+            return False
+
+        now = time.monotonic()
+        expired = [
+            k
+            for k, sent_at in self._recent_signal_keys.items()
+            if now - sent_at >= self._signal_dedupe_seconds
+        ]
+        for k in expired:
+            self._recent_signal_keys.pop(k, None)
+
+        if signal_key in self._pending_signal_keys:
+            logging.info("Telegram duplicate signal already pending; skipping")
+            return True
+        if signal_key in self._recent_signal_keys:
+            logging.info("Telegram duplicate signal inside cooldown; skipping")
+            return True
+
+        self._recent_signal_keys[signal_key] = now
+        self._pending_signal_keys.add(signal_key)
+        return False
+
+    # -- send path (reference l.139-184) ------------------------------------
+
+    async def _sleep_for_send_interval(self) -> None:
+        if self._min_send_interval_seconds <= 0 or self._last_send_at <= 0:
+            return
+        elapsed = time.monotonic() - self._last_send_at
+        remaining = self._min_send_interval_seconds - elapsed
+        if remaining > 0:
+            await asyncio.sleep(remaining)
+
+    async def send_msg(self, message: str) -> None:
+        if self._transport is None:
+            return
+        async with self._send_lock:
+            while True:
+                await self._sleep_for_send_interval()
+                try:
+                    await self._transport(self.chat_id, self._sanitize_html(message))
+                    self._last_send_at = time.monotonic()
+                    return
+                except RetryAfterError as e:
+                    sleep_s = e.retry_after + self._retry_after_pad_seconds
+                    logging.warning(
+                        "Telegram flood control active; retrying in %.1fs", sleep_s
+                    )
+                    await asyncio.sleep(sleep_s)
+
+    async def send_signal(self, message: str) -> None:
+        try:
+            cleaned = self._clean_signal_message(message)
+            if not cleaned:
+                return
+            await self.send_msg(cleaned)
+        except Exception as e:
+            logging.error("Error sending telegram signal: %s", e)
+            logging.error("Original message: %s", message)
+
+    def _finish_signal_task(
+        self, task: asyncio.Task, signal_key: str | None = None
+    ) -> None:
+        self._background_tasks.discard(task)
+        if signal_key is not None:
+            self._pending_signal_keys.discard(signal_key)
+
+    def dispatch_signal(self, message: str) -> asyncio.Task | None:
+        """Fire-and-forget send; never propagates exceptions (l.193-212)."""
+        if not self.is_enabled:
+            return None
+        cleaned = self._clean_signal_message(message)
+        if not cleaned:
+            return None
+        signal_key = self._signal_dedupe_key(cleaned)
+        if self._drop_duplicate_signal(signal_key):
+            return None
+        task = asyncio.create_task(self.send_signal(cleaned))
+        self._background_tasks.add(task)
+        task.add_done_callback(lambda t: self._finish_signal_task(t, signal_key))
+        return task

@@ -1,0 +1,183 @@
+"""Websocket ingest: chunked multi-client kline streams.
+
+Equivalent of ``/root/reference/producers/klines_connector.py`` and
+``/root/reference/shared/streaming/websocket_factory.py``: symbols are
+chunked across N websocket connections (400/client Binance, 300/connection
+KuCoin), frames are JSON-parsed, **closed candles only** are pushed onto the
+asyncio queue as ``KlineProduceModel`` dicts, and a closed socket triggers
+reconnect-and-resubscribe. Uses the ``websockets`` library; the connection
+factory is injectable so tests drive the parser with fake frames.
+
+The richer ``ExtendedKline`` fields (quote volume, trade count, taker-buy
+splits) are captured here too — the reference drops them at the connector
+(KlineProduceModel has only OHLCV) and several strategies then lack them on
+the 5m path; the TPU buffer keeps the full payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from collections.abc import Callable
+from typing import Any
+
+from binquant_tpu.exceptions import WebSocketError
+from binquant_tpu.schemas import SymbolModel
+
+BINANCE_WS_BASE = "wss://stream.binance.com:9443/ws"
+MAX_MARKETS_PER_CLIENT = 400  # Binance (klines_connector.py:24)
+MAX_TOPICS_PER_CONNECTION = 300  # KuCoin (websocket_factory.py:30)
+
+FIAT_PREFIXES = ("USDT", "USDC", "BUSD", "EUR", "TRY", "DAI")
+
+
+def filter_fiat_symbols(symbols: list[SymbolModel]) -> list[SymbolModel]:
+    """Drop fiat-to-fiat pairs (websocket_factory.py:49)."""
+    return [
+        s
+        for s in symbols
+        if s.active and not any(s.id.startswith(p) for p in FIAT_PREFIXES)
+    ]
+
+
+def parse_binance_kline_frame(raw: str | bytes) -> dict | None:
+    """One frame → ExtendedKline-shaped dict for closed candles, else None
+    (klines_connector.py:148-164 + the extra payload fields)."""
+    try:
+        res = json.loads(raw)
+    except Exception as e:
+        logging.error("Failed to decode ws message: %s; len=%s", e, len(str(raw)))
+        return None
+    if res.get("e") != "kline":
+        logging.debug("Non-kline event received: %s", res.get("e"))
+        return None
+    k = res.get("k", {})
+    if not k.get("s") or not k.get("x"):  # closed candles only
+        return None
+    return {
+        "symbol": k["s"],
+        "open_time": int(k["t"]),
+        "close_time": int(k["T"]),
+        "open": float(k["o"]),
+        "high": float(k["h"]),
+        "low": float(k["l"]),
+        "close": float(k["c"]),
+        "volume": float(k["v"]),
+        "quote_asset_volume": float(k.get("q", 0.0)),
+        "number_of_trades": float(k.get("n", 0.0)),
+        "taker_buy_base_volume": float(k.get("V", 0.0)),
+        "taker_buy_quote_volume": float(k.get("Q", 0.0)),
+    }
+
+
+class KlinesConnector:
+    """Binance kline streams over N chunked connections with reconnect."""
+
+    def __init__(
+        self,
+        queue: asyncio.Queue,
+        symbols: list[SymbolModel],
+        interval: str = "15m",
+        connect: Callable[..., Any] | None = None,
+        max_markets_per_client: int = MAX_MARKETS_PER_CLIENT,
+    ) -> None:
+        self.queue = queue
+        self.symbols = filter_fiat_symbols(symbols)
+        self.interval = interval
+        self.max_markets_per_client = max_markets_per_client
+        if connect is None:
+            import websockets
+
+            connect = websockets.connect
+        self._connect = connect
+        self._tasks: list[asyncio.Task] = []
+
+    def _chunks(self) -> list[list[str]]:
+        streams = [
+            f"{s.id.lower()}@kline_{self.interval}" for s in self.symbols
+        ]
+        n = self.max_markets_per_client
+        return [streams[i : i + n] for i in range(0, len(streams), n)]
+
+    async def _run_client(self, idx: int, markets: list[str]) -> None:
+        """One connection: subscribe, pump frames, reconnect on close
+        (klines_connector.py:53-69)."""
+        backoff = 1.0
+        while True:
+            try:
+                async with self._connect(BINANCE_WS_BASE) as ws:
+                    await ws.send(
+                        json.dumps(
+                            {"method": "SUBSCRIBE", "params": markets, "id": 1}
+                        )
+                    )
+                    logging.info(
+                        "Subscribed client %d to %d markets", idx, len(markets)
+                    )
+                    backoff = 1.0
+                    async for raw in ws:
+                        kline = parse_binance_kline_frame(raw)
+                        if kline is not None:
+                            await self.queue.put(kline)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logging.warning(
+                    "ws client %d dropped (%s); reconnecting in %.0fs",
+                    idx,
+                    e,
+                    backoff,
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    async def start_stream(self) -> None:
+        chunks = self._chunks()
+        if not chunks:
+            raise WebSocketError("no symbols to subscribe")
+        for idx, markets in enumerate(chunks):
+            self._tasks.append(
+                asyncio.create_task(self._run_client(idx, markets))
+            )
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+
+class WebsocketClientFactory:
+    """Chooses the exchange connector from autotrade settings
+    (websocket_factory.py:21-158)."""
+
+    def __init__(
+        self,
+        queue: asyncio.Queue,
+        symbols: list[SymbolModel],
+        exchange_id: str = "binance",
+        interval: str = "15m",
+        connect: Callable[..., Any] | None = None,
+    ) -> None:
+        self.queue = queue
+        self.symbols = symbols
+        self.exchange_id = exchange_id
+        self.interval = interval
+        self._connect = connect
+
+    def create_connector(self) -> KlinesConnector:
+        # KuCoin spot/futures use the same chunked-subscription shape with a
+        # lower per-connection topic cap (websocket_factory.py:30,86-143).
+        max_markets = (
+            MAX_TOPICS_PER_CONNECTION
+            if self.exchange_id == "kucoin"
+            else MAX_MARKETS_PER_CLIENT
+        )
+        return KlinesConnector(
+            self.queue,
+            self.symbols,
+            interval=self.interval,
+            connect=self._connect,
+            max_markets_per_client=max_markets,
+        )

@@ -1,0 +1,16 @@
+"""Multi-chip scaling: shard the symbol axis over a device mesh.
+
+The reference's only parallelism is asyncio concurrency + websocket
+connection sharding (SURVEY.md §2.9); the TPU-native analogue is data
+parallelism over symbols: every (S, ...) array in the engine state shards
+along S over a 1-D ``symbols`` mesh, XLA inserts the few collectives the
+market-context aggregates need (masked sums → psum over ICI), and everything
+else stays embarrassingly parallel.
+"""
+
+from binquant_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_engine_state,
+    shard_host_inputs,
+    symbol_sharding,
+)

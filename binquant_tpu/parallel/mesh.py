@@ -1,0 +1,99 @@
+"""Device mesh + shardings for the symbol axis.
+
+Strategy (scaling-book recipe): pick a 1-D mesh over all devices, annotate
+every ``(S, ...)`` array with ``P("symbols", ...)`` and every scalar/carry
+with replication, then let XLA insert collectives. The only cross-symbol
+communication in the whole tick is the market-context reduction
+(advancers/averages — a handful of psums over ICI per tick); strategies,
+indicators, and the ring-buffer update are element-wise over S and run
+fully parallel.
+
+Capacity S must be a multiple of the mesh size (the registry pads — S is a
+static config knob, BQT_MAX_SYMBOLS).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from binquant_tpu.engine.buffer import MarketBuffer
+from binquant_tpu.engine.step import EngineState, HostInputs
+from binquant_tpu.regime.context import RegimeCarry
+
+
+def make_mesh(devices: list | None = None, axis: str = "symbols") -> Mesh:
+    devs = np.array(devices if devices is not None else jax.devices())
+    return Mesh(devs, axis_names=(axis,))
+
+
+def symbol_sharding(mesh: Mesh, ndim: int = 1, axis: str = "symbols") -> NamedSharding:
+    """NamedSharding splitting the leading (symbol) axis."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def _replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _shard_buffer(buf: MarketBuffer, mesh: Mesh) -> MarketBuffer:
+    s2 = symbol_sharding(mesh, 2)
+    s3 = symbol_sharding(mesh, 3)
+    s1 = symbol_sharding(mesh, 1)
+    return MarketBuffer(
+        times=jax.device_put(buf.times, s2),
+        values=jax.device_put(buf.values, s3),
+        filled=jax.device_put(buf.filled, s1),
+    )
+
+
+def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
+    """Place the engine state: (S, ...) arrays split over symbols, the
+    regime carry's scalars replicated, its per-symbol arrays split."""
+    s1 = symbol_sharding(mesh, 1)
+    r = _replicated(mesh)
+    carry = state.regime_carry
+    return EngineState(
+        buf5=_shard_buffer(state.buf5, mesh),
+        buf15=_shard_buffer(state.buf15, mesh),
+        regime_carry=RegimeCarry(
+            has_prev=jax.device_put(carry.has_prev, r),
+            market_regime=jax.device_put(carry.market_regime, r),
+            market_scores=jax.device_put(carry.market_scores, r),
+            stable_since=jax.device_put(carry.stable_since, r),
+            micro_has_prev=jax.device_put(carry.micro_has_prev, s1),
+            micro_regime=jax.device_put(carry.micro_regime, s1),
+            micro_strength=jax.device_put(carry.micro_strength, s1),
+        ),
+        mrf_last_emitted=jax.device_put(state.mrf_last_emitted, s1),
+        pt_last_signal_close=jax.device_put(state.pt_last_signal_close, s1),
+    )
+
+
+def shard_host_inputs(inputs: HostInputs, mesh: Mesh) -> HostInputs:
+    """(S,) inputs split over symbols; scalars replicated."""
+    s1 = symbol_sharding(mesh, 1)
+    r = _replicated(mesh)
+    return HostInputs(
+        tracked=jax.device_put(jnp.asarray(inputs.tracked), s1),
+        btc_row=jax.device_put(jnp.asarray(inputs.btc_row), r),
+        timestamp_s=jax.device_put(jnp.asarray(inputs.timestamp_s), r),
+        timestamp5_s=jax.device_put(jnp.asarray(inputs.timestamp5_s), r),
+        oi_growth=jax.device_put(jnp.asarray(inputs.oi_growth), s1),
+        adp_latest=jax.device_put(jnp.asarray(inputs.adp_latest), r),
+        adp_prev=jax.device_put(jnp.asarray(inputs.adp_prev), r),
+        adp_diff=jax.device_put(jnp.asarray(inputs.adp_diff), r),
+        adp_diff_prev=jax.device_put(jnp.asarray(inputs.adp_diff_prev), r),
+        breadth_momentum_points=jax.device_put(
+            jnp.asarray(inputs.breadth_momentum_points), r
+        ),
+        quiet_hours=jax.device_put(jnp.asarray(inputs.quiet_hours), r),
+        grid_policy_allows=jax.device_put(jnp.asarray(inputs.grid_policy_allows), r),
+        is_futures=jax.device_put(jnp.asarray(inputs.is_futures), r),
+        dominance_is_losers=jax.device_put(jnp.asarray(inputs.dominance_is_losers), r),
+        market_domination_reversal=jax.device_put(
+            jnp.asarray(inputs.market_domination_reversal), r
+        ),
+    )

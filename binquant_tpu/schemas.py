@@ -387,6 +387,12 @@ class GridDeploymentRequest(BaseModel):
     total_margin: float
     level_count: int
     leverage: float = 1.0
+    current_price: float = 0.0
+    current_regime: str | None = None
+    context: dict[str, Any] = Field(default_factory=dict)
+    indicators: dict[str, Any] = Field(default_factory=dict)
+    allocation_pct: float | None = None
+    cash_reserve_pct: float | None = None
     metadata: dict[str, Any] = Field(default_factory=dict)
 
     model_config = ConfigDict(use_enum_values=True)
@@ -439,6 +445,7 @@ class SymbolModel(BaseModel):
     cooldown: int = 0
     cooldown_start_ts: int = 0
     leverage: float = 1.0
+    futures_leverage: float = 1.0
     blacklist_reason: str = ""
 
 
@@ -459,12 +466,16 @@ class AutotradeSettingsSchema(BaseModel):
     grid_total_margin: float = 10.0
     grid_level_count: int = 7
     max_active_grid_ladders: int = 3
+    grid_allocation_pct: float | None = 60.0
+    grid_cash_reserve_pct: float | None = 40.0
     test_autotrade: bool = False
 
     model_config = ConfigDict(use_enum_values=True)
 
 
 class TestAutotradeSettingsSchema(AutotradeSettingsSchema):
+    __test__ = False  # pydantic model, not a pytest class
+
     test_autotrade: bool = True
 
 

@@ -1,0 +1,74 @@
+"""Strategy kernels: every reference strategy as a pure batched function.
+
+Re-design of ``/root/reference/strategies/``: a strategy is not a class with
+I/O side effects but a pure function
+``(FeaturePack, MarketContext, params, carry) → (StrategyOutputs, carry)``
+evaluated for all S symbols in one pass inside the jit'd tick step. The
+reference's per-strategy rolling cooldown recomputation becomes explicit
+carried state; emission (Telegram/analytics/autotrade REST) happens host-side
+only for rows whose trigger mask fired.
+
+Live set (dispatch order preserved from
+``producers/context_evaluator.py:369-479``): activity_burst_pump,
+coinrule_price_tracker (5m); market_regime_notifier,
+liquidation_sweep_pump, mean_reversion_fade, spike_hunter_v3 (disabled),
+grid_ladder (15m). Dormant capability set: coinrule rules, buy_the_dip,
+bb_extreme_reversion, inverse_price_tracker, range_bb_rsi_mean_reversion,
+range_failed_breakout_fade, relative_strength_reversal_range,
+binance_report_ai (host-side scraper).
+"""
+
+from binquant_tpu.strategies.activity_burst_pump import (  # noqa: F401
+    ABPParams,
+    activity_burst_pump,
+)
+from binquant_tpu.strategies.base import (  # noqa: F401
+    StrategyOutputs,
+    no_signal,
+)
+from binquant_tpu.strategies.features import (  # noqa: F401
+    FeaturePack,
+    compute_feature_pack,
+)
+from binquant_tpu.strategies.ladder_deployer import (  # noqa: F401
+    LadderParams,
+    ladder_deployer,
+)
+from binquant_tpu.strategies.liquidation_sweep_pump import (  # noqa: F401
+    LSPParams,
+    liquidation_sweep_pump,
+)
+from binquant_tpu.strategies.mean_reversion_fade import (  # noqa: F401
+    MRFParams,
+    mean_reversion_fade,
+)
+from binquant_tpu.strategies.binance_report_ai import BinanceAIReport  # noqa: F401
+from binquant_tpu.strategies.dormant import (  # noqa: F401
+    BBXParams,
+    BTDParams,
+    IPTParams,
+    RBRParams,
+    RSRParams,
+    bb_extreme_reversion,
+    buy_low_sell_high,
+    buy_the_dip,
+    inverse_price_tracker,
+    range_bb_rsi_mean_reversion,
+    range_failed_breakout_fade,
+    relative_strength_reversal_range,
+    supertrend_swing_reversal,
+    twap_momentum_sniper,
+)
+from binquant_tpu.strategies.market_regime_notifier import (  # noqa: F401
+    MarketRegimeNotifier,
+)
+from binquant_tpu.strategies.price_tracker import (  # noqa: F401
+    PTParams,
+    price_tracker,
+)
+from binquant_tpu.strategies.spike_hunter import (  # noqa: F401
+    SpikeParams,
+    SpikeSignal,
+    detect_spikes,
+    spike_hunter,
+)

@@ -1,0 +1,161 @@
+"""ActivityBurstPump — 5m volume/price-burst long entry, batched.
+
+Re-implements ``/root/reference/strategies/activity_burst_pump.py`` as one
+last-bar kernel over a trailing tail of the 5m buffer: shifted rolling-median
+volume baselines (l.58-88), price jump/range/body/close-to-high quality flags
+(l.89-122), the multiplicative burst score against its shifted rolling 92nd
+percentile (l.123-148), and the 3-bar cooldown via the shifted rolling max of
+the raw signal (l.149-156). Long-only; the market-context gate mirrors l.175-179:
+a valid context that denies long autotrade suppresses the signal entirely,
+while a missing context emits with autotrade disabled.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.ops.rolling import rolling_median, rolling_quantile, shift
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.regime.routing import allows_long_autotrade_mask
+from binquant_tpu.strategies.base import StrategyOutputs
+
+
+class ABPParams(NamedTuple):
+    """Class constants of the reference (l.38-49)."""
+
+    volume_multiplier: float = 2.75
+    quote_volume_multiplier: float = 2.5
+    price_threshold: float = 0.01
+    lookback_window: int = 20
+    min_baseline_volume: float = 1e-8
+    min_range_frac: float = 0.012
+    min_body_frac: float = 0.45
+    max_close_to_high: float = 0.35
+    min_recent_up_closes: int = 2
+    score_quantile: float = 0.92
+    score_lookback: int = 80
+    cooldown_bars: int = 3
+
+
+# Tail length: threshold at the cooldown lookback positions needs scores up
+# to score_lookback+cooldown back, each score needing baseline history
+# (lookback+2). 128 covers 80+3+21 with margin.
+TAIL = 128
+
+ROUTE_UNAVAILABLE = 0  # "market_context_unavailable"
+ROUTE_ALLOWED = 1  # "long_autotrade_allowed"
+
+
+def activity_burst_pump(
+    buf5: MarketBuffer,
+    context: MarketContext,
+    params: ABPParams = ABPParams(),
+) -> StrategyOutputs:
+    p = params
+    volume = buf5.values[:, -TAIL:, Field.VOLUME]
+    quote_volume = buf5.values[:, -TAIL:, Field.QUOTE_VOLUME]
+    close = buf5.values[:, -TAIL:, Field.CLOSE]
+    open_ = buf5.values[:, -TAIL:, Field.OPEN]
+    high = buf5.values[:, -TAIL:, Field.HIGH]
+    low = buf5.values[:, -TAIL:, Field.LOW]
+
+    bw = max(p.lookback_window, 2) - 1  # rolling window after shift(2)
+    baseline = rolling_median(shift(volume, 2), bw, min_periods=bw)
+    baseline_safe = jnp.maximum(baseline, p.min_baseline_volume)
+    volume_ratio = volume / baseline_safe
+
+    # Feeds without quote volume (reference's older-spot-fixture branch,
+    # l.82-87): treat quote confirmation as neutral instead of muting.
+    has_qav = jnp.any(quote_volume > 0, axis=-1, keepdims=True)
+    q_baseline = rolling_median(shift(quote_volume, 2), bw, min_periods=bw)
+    q_baseline_safe = jnp.maximum(q_baseline, p.min_baseline_volume)
+    quote_ratio = jnp.where(has_qav, quote_volume / q_baseline_safe, 1.0)
+
+    prev_close = jnp.maximum(shift(close, 1), p.min_baseline_volume)
+    candle_range = jnp.maximum(high - low, p.min_baseline_volume)
+    body = jnp.abs(close - open_)
+
+    price_jump = (close - shift(close, 1)) / prev_close
+    range_frac = candle_range / jnp.maximum(close, p.min_baseline_volume)
+    body_frac = body / candle_range
+    close_to_high = (high - close) / candle_range
+    is_bullish = close > open_
+    up_close = (close > shift(close, 1)).astype(jnp.float32)
+    recent_up = (
+        up_close + shift(up_close, 1, 0.0) + shift(up_close, 2, 0.0)
+    )  # rolling(3).sum()
+
+    vol_spike = volume > p.volume_multiplier * baseline_safe
+    quote_spike = jnp.where(
+        has_qav, quote_volume > p.quote_volume_multiplier * q_baseline_safe, True
+    )
+    jump_flag = price_jump > p.price_threshold
+    range_flag = range_frac > p.min_range_frac
+    body_flag = (
+        is_bullish & (body_frac > p.min_body_frac) & (close_to_high < p.max_close_to_high)
+    )
+    trend_flag = recent_up >= jnp.where(has_qav, p.min_recent_up_closes, 1)
+
+    # no-QAV branch drops the quote and body factors (l.130-133)
+    score = jnp.where(
+        has_qav,
+        volume_ratio * quote_ratio * jnp.maximum(price_jump, 0.0) * (1.0 + body_frac),
+        volume_ratio * jnp.maximum(price_jump, 0.0),
+    )
+    threshold = rolling_quantile(
+        shift(score, 1), p.score_lookback, p.score_quantile,
+        min_periods=p.lookback_window,
+    )
+    threshold_filled = jnp.where(jnp.isfinite(threshold), threshold, 0.0)
+
+    raw = (
+        vol_spike
+        & quote_spike
+        & jump_flag
+        & range_flag
+        & body_flag
+        & trend_flag
+        & jnp.isfinite(score)
+        & (score >= threshold_filled)
+    )
+    # 3-bar cooldown: any raw signal in the previous cooldown_bars bars
+    raw_f = raw.astype(jnp.float32)
+    recent = shift(raw_f, 1, 0.0)
+    for i in range(1, p.cooldown_bars):
+        recent = jnp.maximum(recent, shift(raw_f, 1 + i, 0.0))
+    qualified = raw & (recent < 0.5)
+
+    fired = qualified[:, -1]
+    # data sufficiency: len(df) >= lookback+1 (l.164)
+    fired = fired & (buf5.filled >= p.lookback_window + 1)
+
+    # context gate (l.175-179): valid context + denied long -> suppress;
+    # valid + allowed -> autotrade; no context -> emit, autotrade off.
+    gate = allows_long_autotrade_mask(context)
+    has_context = context.valid
+    fired = fired & (~has_context | gate)
+    autotrade = fired & has_context & gate
+    route = jnp.where(has_context, ROUTE_ALLOWED, ROUTE_UNAVAILABLE)
+
+    S = buf5.capacity
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),  # long-only
+        score=jnp.where(jnp.isfinite(score[:, -1]), score[:, -1], 0.0),
+        autotrade=autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "baseline_volume": baseline_safe[:, -1],
+            "volume_ratio": volume_ratio[:, -1],
+            "quote_volume_ratio": quote_ratio[:, -1],
+            "price_jump": price_jump[:, -1],
+            "range_frac": range_frac[:, -1],
+            "body_frac": body_frac[:, -1],
+            "score_threshold": threshold_filled[:, -1],
+            "volume": volume[:, -1],
+            "route": jnp.broadcast_to(route, (S,)).astype(jnp.int32),
+        },
+    )

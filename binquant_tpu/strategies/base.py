@@ -1,0 +1,37 @@
+"""Strategy kernel contract.
+
+Uniform output batch mirroring what every reference strategy ultimately
+feeds into its three sinks (``SignalsConsumer`` fields + routing reason):
+trigger mask, direction, scores, autotrade flag, stop-loss, and a
+diagnostics dict of per-symbol telemetry arrays that the host edge formats
+into the Telegram/analytics payloads (reference messages carry these values
+line by line, e.g. ``strategies/activity_burst_pump.py:197-221``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class StrategyOutputs(NamedTuple):
+    """One strategy's verdict for every symbol row this tick."""
+
+    trigger: jnp.ndarray  # (S,) bool — emit a signal for this row
+    direction: jnp.ndarray  # (S,) int32 — 0 LONG / 1 SHORT (Direction enum)
+    score: jnp.ndarray  # (S,) f32 — local score (0 when unused)
+    autotrade: jnp.ndarray  # (S,) bool — device-side autotrade verdict
+    stop_loss_pct: jnp.ndarray  # (S,) f32 — 0 when strategy doesn't set one
+    diagnostics: dict[str, jnp.ndarray]  # (S,) telemetry for host formatting
+
+
+def no_signal(num_symbols: int) -> StrategyOutputs:
+    return StrategyOutputs(
+        trigger=jnp.zeros((num_symbols,), dtype=bool),
+        direction=jnp.zeros((num_symbols,), dtype=jnp.int32),
+        score=jnp.zeros((num_symbols,), dtype=jnp.float32),
+        autotrade=jnp.zeros((num_symbols,), dtype=bool),
+        stop_loss_pct=jnp.zeros((num_symbols,), dtype=jnp.float32),
+        diagnostics={},
+    )

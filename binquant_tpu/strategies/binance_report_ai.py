@@ -1,0 +1,254 @@
+"""BinanceAIReport — external AI-report feature extraction (host-side).
+
+Equivalent of ``/root/reference/strategies/binance_report_ai.py``: scrapes
+Binance's AI report endpoint per base token and turns the JSON into a
+keyword-flag feature vector, a directional signal dict, social flags, and a
+final ternary report. Pure I/O + text heuristics, so it stays host-side; the
+network call is injected (``fetch``) so tests and offline replay never touch
+the network.
+"""
+
+from __future__ import annotations
+
+import time
+from collections.abc import Callable
+from math import tanh
+from typing import Any
+
+BINANCE_AI_ENDPOINT = (
+    "https://www.binance.com/bapi/bigdata/v3/friendly/bigdata/search/ai-report/report"
+)
+QUOTE_ASSETS = ["USDT", "USDC", "BUSD", "TRY", "EUR", "BTC", "ETH"]
+
+
+def count_points(mod_list: list[dict]) -> int:
+    return sum(len(m.get("points", []) or []) for m in mod_list)
+
+
+def default_fetch(symbol: str, token: str) -> dict | None:  # pragma: no cover
+    """POST to the Binance AI-report endpoint (reference fetch_report,
+    l.33-57). Kept separate so the extractor is testable offline."""
+    import json
+    import urllib.request
+
+    payload = {
+        "lang": "en",
+        "token": token,
+        "symbol": symbol.upper(),
+        "product": "web-spot",
+        "timestamp": str(int(time.time() * 1000)),
+        "translateToken": None,
+    }
+    try:
+        req = urllib.request.Request(
+            BINANCE_AI_ENDPOINT,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+class BinanceAIReport:
+    """Feature extraction + signal derivation (reference l.11-279)."""
+
+    def __init__(
+        self,
+        symbol: str,
+        base_asset: str,
+        fetch: Callable[[str, str], dict | None] = default_fetch,
+        now_ms: Callable[[], float] | None = None,
+    ) -> None:
+        self.symbol = symbol.replace("-", "")
+        self.base_asset = base_asset
+        self._fetch = fetch
+        self._now_ms = now_ms or (lambda: time.time() * 1000)
+
+    def fetch_report(self) -> dict | None:
+        if not self.base_asset:
+            return None
+        return self._fetch(self.symbol, self.base_asset)
+
+    def extract_features(
+        self, max_fresh_minutes: int = 8 * 60, normalize: bool = True
+    ) -> dict | None:
+        """Heuristic external feature vector from the raw report JSON
+        (reference l.59-152)."""
+        report_json = self.fetch_report()
+        if not report_json:
+            return None
+
+        data = report_json.get("data", {})
+        original = (
+            data.get("report", {}).get("original", {})
+            if "report" in data
+            else data.get("original", {})
+        )
+        if not original:
+            return None
+        report_meta = original.get("reportMeta", {})
+        modules = original.get("modules", []) or []
+        update_ms = int(report_meta.get("updateAt", 0))
+        age_minutes = (self._now_ms() - update_ms) / 60000.0 if update_ms else 1e9
+        fresh = age_minutes <= max_fresh_minutes
+        base: dict[str, Any] = {
+            "external_available": 1,
+            "external_stale_flag": int(not fresh),
+            "external_age_minutes": round(age_minutes, 2),
+        }
+        if not fresh:
+            return base
+
+        by_type: dict[str, list[dict]] = {}
+        for m in modules:
+            by_type.setdefault(m.get("type", ""), []).append(m)
+        opp_count = count_points(by_type.get("opportunities", []))
+        risk_count = count_points(by_type.get("risks", []))
+        community_posts = 0
+        for m in by_type.get("community_sentiment", []):
+            for p in m.get("points", []) or []:
+                for ref in p.get("citationRefs", []) or []:
+                    if ref.get("type") == "post":
+                        community_posts += int(ref.get("count", 0))
+
+        texts = []
+        for m in modules:
+            for p in m.get("points", []) or []:
+                if p.get("content"):
+                    texts.append(p["content"])
+            if m.get("overview"):
+                texts.append(m["overview"])
+        joined = " \n ".join(texts).lower()
+
+        def kw_flag(*phrases: str) -> int:
+            return int(any(ph.lower() in joined for ph in phrases))
+
+        macd_bullish_flag = kw_flag("macd", "bullish crossover")
+        ema_bearish_flag = kw_flag("ema7", "ema25", "ema99", "bearish")
+        volatility_decreasing_flag = kw_flag("decreasing volatility")
+        price_resilience_flag = kw_flag("resilience", "altcoins", "80-99%")
+        outflow_flag = kw_flag("net outflow", "outflow")
+        coinbase_premium_weak_flag = kw_flag("premium gaps", "weak demand", "coinbase")
+        institutional_adoption_flag = kw_flag("institutional", "adoption", "survey")
+        macro_headwind_flag = kw_flag("geopolitical", "trade tensions", "tariff")
+        sentiment_mixed_flag = kw_flag("mixed sentiment", "mixed outlook")
+
+        bull_support = (
+            macd_bullish_flag + institutional_adoption_flag + price_resilience_flag
+        )
+        bear_pressure = ema_bearish_flag + outflow_flag + macro_headwind_flag
+        net_bias = bull_support - bear_pressure
+        bias_norm = tanh(net_bias) if normalize else net_bias
+
+        base.update(
+            {
+                "opp_count": opp_count,
+                "risk_count": risk_count,
+                "opp_risk_ratio": round((opp_count + 1) / (risk_count + 1), 4),
+                "net_signal_score": opp_count - risk_count,
+                "community_post_count": community_posts,
+                "large_discussion_flag": int(community_posts >= 10),
+                "external_net_bias": net_bias,
+                "external_bias_normalized": round(bias_norm, 4),
+                "macd_bullish_flag": macd_bullish_flag,
+                "ema_bearish_flag": ema_bearish_flag,
+                "sentiment_mixed_flag": sentiment_mixed_flag,
+                "volatility_decreasing_flag": volatility_decreasing_flag,
+                "coinbase_premium_weak_flag": coinbase_premium_weak_flag,
+            }
+        )
+        return base
+
+    def ai_report_signal(
+        self, bias_thr: float = 0.5, opp_risk_thr: float = 1.2, net_score_thr: int = 1
+    ) -> dict | None:
+        """Directional signal dict (reference l.154-213)."""
+        features = self.extract_features()
+        if not features:
+            return None
+
+        signal_type: dict[str, Any] = {}
+        bias = features.get("external_bias_normalized", 0)
+        ratio = features.get("opp_risk_ratio", 1)
+        net = features.get("net_signal_score", 0)
+
+        if bias > bias_thr:
+            signal_type["external_bias_normalized"] = bias
+        if ratio:
+            signal_type["opp_risk_ratio"] = ratio
+        if net > net_score_thr:
+            signal_type["net_signal_score"] = net
+        if features.get("macd_bullish_flag", 0) == 1:
+            signal_type["macd_bullish_flag"] = 1
+        if bias < -bias_thr:
+            signal_type["external_bias_normalized"] = bias
+        if ratio < 1:
+            signal_type["opp_risk_ratio"] = ratio
+        if net < -net_score_thr:
+            signal_type["net_signal_score"] = net
+        if features.get("ema_bearish_flag", 0) == 1:
+            signal_type["ema_bearish_flag"] = 1
+
+        fired = (
+            bias > bias_thr
+            or ratio > opp_risk_thr
+            or net > net_score_thr
+            or features.get("macd_bullish_flag", 0) == 1
+            or bias < -bias_thr
+            or ratio < 1
+            or net < -net_score_thr
+            or features.get("ema_bearish_flag", 0) == 1
+        )
+        return signal_type if fired else None
+
+    def social_features_flag(self) -> dict | None:
+        """Social/community flags (reference l.215-252)."""
+        features = self.extract_features()
+        if not features:
+            return None
+        signal_type: dict[str, Any] = {}
+        if features.get("large_discussion_flag", 0) > 0:
+            signal_type["large_discussion_flag"] = features["large_discussion_flag"]
+        if features.get("community_post_count", 0) >= 2:
+            signal_type["community_post_count"] = features["community_post_count"]
+        if features.get("sentiment_mixed_flag", 0) > 0:
+            signal_type["sentiment_mixed_flag"] = features["sentiment_mixed_flag"]
+        if features.get("coinbase_premium_weak_flag", 0) > 1:
+            signal_type["coinbase_premium_weak_flag"] = features[
+                "coinbase_premium_weak_flag"
+            ]
+        fired = (
+            features.get("large_discussion_flag", 0) > 1
+            or features.get("community_post_count", 0) > 1
+            or features.get("sentiment_mixed_flag", 0) > 1
+            or features.get("coinbase_premium_weak_flag", 0) > 1
+        )
+        return signal_type if fired else None
+
+    def final_report(
+        self, bias_thr: float = 0.5, opp_risk_thr: float = 1.2, net_score_thr: int = 1
+    ) -> int:
+        """Ternary verdict: 1 bullish / −1 bearish / 0 neutral (l.258-279)."""
+        features = self.extract_features()
+        if not features or not features.get("external_available", 0):
+            return 0
+        bias = features.get("external_bias_normalized", 0)
+        ratio = features.get("opp_risk_ratio", 1)
+        net = features.get("net_signal_score", 0)
+        if (
+            bias > bias_thr
+            and ratio > opp_risk_thr
+            and net > net_score_thr
+            and features.get("macd_bullish_flag", 0) == 1
+        ):
+            return 1
+        if (
+            bias < -bias_thr
+            and ratio < 1
+            and net < -net_score_thr
+            and features.get("ema_bearish_flag", 0) == 1
+        ):
+            return -1
+        return 0

@@ -1,0 +1,772 @@
+"""Dormant / test-covered strategies, batched.
+
+The reference keeps eight strategies off the live dispatch list but fully
+test-covered as capability surface (SURVEY.md §2.5). Each is a pure last-bar
+kernel here:
+
+* three coinrule rules (``strategies/coinrule/coinrule.py``),
+* BuyTheDip (``strategies/coinrule/buy_the_dip.py``),
+* BBExtremeReversion (``strategies/coinrule/bb_extreme_reversion.py``),
+* InversePriceTracker (``strategies/inverse_price_tracker.py``),
+* RangeBbRsiMeanReversion (``strategies/range_bb_rsi_mean_reversion.py``),
+* RangeFailedBreakoutFade (``strategies/range_failed_breakout_fade.py``),
+* RelativeStrengthReversalRange
+  (``strategies/relative_strength_reversal_range.py``).
+
+(The ninth, BinanceAIReport, is pure host-side I/O —
+``binquant_tpu/strategies/binance_report_ai.py``.)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.enums import (
+    Direction,
+    MarketRegimeCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.ops.indicators import supertrend
+from binquant_tpu.ops.rolling import (
+    rolling_mean,
+    rolling_mean_last,
+    rolling_std_last,
+    rolling_sum,
+    shift,
+)
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.regime.scoring import ScorerWeights, score_signal_candidate
+from binquant_tpu.strategies.base import StrategyOutputs
+from binquant_tpu.strategies.features import FeaturePack
+from binquant_tpu.strategies.spike_hunter import SpikeSignal
+from binquant_tpu.utils import jsafe_div
+
+
+# ---------------------------------------------------------------------------
+# Coinrule: twap_momentum_sniper (coinrule.py:53-126)
+# ---------------------------------------------------------------------------
+
+
+def twap_momentum_sniper(
+    buf15: MarketBuffer,
+    pack5: FeaturePack,
+    twap_window: int = 20,
+) -> StrategyOutputs:
+    """TWAP(1h bars) > price with no sharp recent selloff; telemetry-only
+    (autotrade=False, "manual_only" route).
+
+    The reference resamples 15m→1h calendar-aligned; here 1h bars are
+    trailing 4-bar blocks of the 15m buffer (documented divergence: block
+    edges may be offset from wall-clock hours by up to 45 min).
+    """
+    S, W = buf15.times.shape
+    k = W // 4
+    o = buf15.values[:, W - k * 4:, Field.OPEN].reshape(S, k, 4)
+    h = buf15.values[:, W - k * 4:, Field.HIGH].reshape(S, k, 4)
+    lo = buf15.values[:, W - k * 4:, Field.LOW].reshape(S, k, 4)
+    c = buf15.values[:, W - k * 4:, Field.CLOSE].reshape(S, k, 4)
+    open_1h = o[:, :, 0]
+    high_1h = jnp.max(h, axis=-1)
+    low_1h = jnp.min(lo, axis=-1)
+    close_1h = c[:, :, -1]
+
+    bar_avg = (open_1h + high_1h + low_1h + close_1h) / 4.0
+    twap_last = rolling_mean_last(bar_avg, twap_window, min_periods=1)
+
+    # "price_decrease" exactly as written in the reference (l.68-70):
+    # close[-1] - close[-2]/close[-1]
+    price_decrease = close_1h[:, -1] - jsafe_div(close_1h[:, -2], close_1h[:, -1])
+
+    enough = (pack5.filled >= 10) & (buf15.filled >= 8)
+    fired = enough & (twap_last > pack5.close) & (price_decrease > -0.05)
+
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=jnp.zeros((S,), dtype=bool),  # manual_only
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={"twap": twap_last, "price_decrease": price_decrease},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Coinrule: supertrend_swing_reversal (coinrule.py:128-228)
+# ---------------------------------------------------------------------------
+
+
+def supertrend_swing_reversal(
+    buf5: MarketBuffer,
+    pack5: FeaturePack,
+    context: MarketContext,
+    long_gate: jnp.ndarray,  # (S,) allows_long_autotrade mask
+    adp_diff: jnp.ndarray,  # scalar — breadth[-1]-breadth[-2], NaN if missing
+    adp_diff_prev: jnp.ndarray,  # scalar — breadth[-2]-breadth[-3]
+    dominance_is_losers: jnp.ndarray,  # scalar bool
+) -> StrategyOutputs:
+    """Supertrend(10,3) uptrend ∧ RSI<30 ∧ trades>5 ∧ rising ADP twice ∧
+    LOSERS dominance. Long; autotrade via the standard long gate."""
+    S = buf5.capacity
+    st = supertrend(
+        buf5.values[:, :, Field.HIGH],
+        buf5.values[:, :, Field.LOW],
+        buf5.values[:, :, Field.CLOSE],
+        window=10,
+        multiplier=3.0,
+    )
+    st_up = jnp.where(jnp.isfinite(st.direction[:, -1]), st.direction[:, -1] > 0, False)
+
+    breadth_ok = (
+        jnp.isfinite(adp_diff)
+        & jnp.isfinite(adp_diff_prev)
+        & (adp_diff > 0)
+        & (adp_diff_prev > 0)
+    )
+    fired = (
+        st_up
+        & (pack5.rsi < 30.0)
+        & (pack5.num_trades > 5)
+        & breadth_ok
+        & dominance_is_losers
+        & pack5.valid
+    )
+    autotrade = fired & jnp.where(context.valid, long_gate, True)
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "rsi": pack5.rsi,
+            "number_of_trades": pack5.num_trades,
+            "supertrend_up": st_up,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# Coinrule: buy_low_sell_high (coinrule.py:230-296)
+# ---------------------------------------------------------------------------
+
+
+def buy_low_sell_high(
+    buf15: MarketBuffer,
+    pack15: FeaturePack,
+    market_domination_reversal: jnp.ndarray,  # scalar bool (host)
+) -> StrategyOutputs:
+    """RSI<35 ∧ price>MA25 ∧ domination reversal; telemetry-only."""
+    S = buf15.capacity
+    ma25 = rolling_mean_last(buf15.values[:, :, Field.CLOSE], 25, min_periods=1)
+    fired = (
+        (pack15.rsi < 35.0)
+        & (pack15.close > ma25)
+        & market_domination_reversal
+        & pack15.valid
+    )
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=jnp.zeros((S,), dtype=bool),  # manual_only
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={"rsi": pack15.rsi, "ma_25": ma25},
+    )
+
+
+# ---------------------------------------------------------------------------
+# BuyTheDip (buy_the_dip.py)
+# ---------------------------------------------------------------------------
+
+BTD_ROUTE_ALLOWED_RANGE = 0  # "symbol_regime_range"/"symbol_regime_transitional"
+BTD_ROUTE_NO_CONTEXT = 1
+BTD_ROUTE_TRANSITIONING = 2
+BTD_ROUTE_STRESS = 3
+BTD_ROUTE_MARKET_REGIME = 4
+BTD_ROUTE_SYMBOL_REGIME = 5
+BTD_ROUTE_QUIET_HOURS = 6
+
+
+class BTDParams(NamedTuple):
+    lookback_candles: int = 24
+    lookback_bars_6h: int = 24  # 6h of 15m bars
+    dip_min_pct: float = -5.0  # exclusive lower bound
+    dip_max_pct: float = -2.0  # exclusive upper bound
+
+
+def buy_the_dip(
+    buf15: MarketBuffer,
+    pack15: FeaturePack,
+    context: MarketContext,
+    quiet_hours_suppressed: jnp.ndarray,  # scalar bool
+    params: BTDParams = BTDParams(),
+) -> StrategyOutputs:
+    """−2%..−5% dip over the 6h lookback (l.152-159) + reclaim of prior
+    close AND EMA20 (l.59-71); trend regimes blocked for entry (l.73-85);
+    autotrade only in RANGE/TRANSITIONAL market+micro (l.87-104)."""
+    p = params
+    S, W = buf15.times.shape
+    close = buf15.values[:, :, Field.CLOSE]
+    current = pack15.close
+
+    # reference price: last close at or before now-6h. With contiguous 15m
+    # bars this is the bar lookback_bars_6h back (close_time <= target).
+    idx = W - 1 - p.lookback_bars_6h
+    reference = close[:, idx] if idx >= 0 else jnp.full((S,), jnp.nan)
+    has_ref = jnp.isfinite(reference) & (buf15.filled > p.lookback_bars_6h)
+
+    change_6h = jsafe_div(current - reference, jnp.abs(reference)) * 100.0
+    dip = (change_6h <= p.dip_max_pct) & (change_6h > p.dip_min_pct)
+
+    from binquant_tpu.ops.rolling import ewm_mean_last
+
+    ema20 = ewm_mean_last(close, span=20, min_periods=1)
+    reclaimed = (current > pack15.prev_close) & (current > ema20)
+
+    feats = context.features
+    market_regime = context.market_regime
+    micro = feats.micro_regime
+    market_trend_blocked = context.valid & (
+        (market_regime == MarketRegimeCode.TREND_DOWN)
+        | (market_regime == MarketRegimeCode.TREND_UP)
+    )
+    symbol_trend_blocked = feats.valid & (
+        (micro == MicroRegimeCode.TREND_DOWN) | (micro == MicroRegimeCode.TREND_UP)
+    )
+    entry_allowed = ~market_trend_blocked & ~symbol_trend_blocked
+
+    fired = (
+        (pack15.filled >= p.lookback_candles)
+        & has_ref
+        & dip
+        & entry_allowed
+        & reclaimed
+        & pack15.valid
+    )
+
+    # autotrade routing (l.87-125)
+    market_rt = (market_regime == MarketRegimeCode.RANGE) | (
+        market_regime == MarketRegimeCode.TRANSITIONAL
+    )
+    micro_rt_ok = (micro == MicroRegimeCode.RANGE) | (
+        micro == MicroRegimeCode.TRANSITIONAL
+    )
+    micro_blocked = (
+        (micro == MicroRegimeCode.TREND_DOWN)
+        | (micro == MicroRegimeCode.TREND_UP)
+        | (micro == MicroRegimeCode.VOLATILE)
+    )
+    base_autotrade = (
+        context.valid
+        & ~context.regime_is_transitioning
+        & (context.market_stress_score < 0.35)
+        & market_rt
+        & jnp.where(feats.valid, ~micro_blocked & micro_rt_ok, True)
+    )
+    autotrade = base_autotrade & ~quiet_hours_suppressed
+
+    route = jnp.where(
+        ~context.valid,
+        BTD_ROUTE_NO_CONTEXT,
+        jnp.where(
+            context.regime_is_transitioning,
+            BTD_ROUTE_TRANSITIONING,
+            jnp.where(
+                context.market_stress_score >= 0.35,
+                BTD_ROUTE_STRESS,
+                jnp.where(
+                    ~market_rt,
+                    BTD_ROUTE_MARKET_REGIME,
+                    jnp.where(
+                        feats.valid & micro_blocked,
+                        BTD_ROUTE_SYMBOL_REGIME,
+                        BTD_ROUTE_ALLOWED_RANGE,
+                    ),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+    route = jnp.where(
+        fired & base_autotrade & quiet_hours_suppressed, BTD_ROUTE_QUIET_HOURS, route
+    )
+
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=fired & autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "reference_price": jnp.where(has_ref, reference, 0.0),
+            "change_6h": jnp.where(has_ref, change_6h, 0.0),
+            "route": route,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# BBExtremeReversion (bb_extreme_reversion.py)
+# ---------------------------------------------------------------------------
+
+
+class BBXParams(NamedTuple):
+    enabled: bool = False  # reference ENABLED=False (l.45-46)
+    rsi_window: int = 2
+    oversold_rsi: float = 5.0
+    overbought_rsi: float = 95.0
+    max_lower_band_position: float = 0.0
+    min_upper_band_position: float = 1.0
+    stress_threshold: float = 0.35
+    micro_min_strength: float = 0.5
+
+
+def bb_extreme_reversion(
+    buf15: MarketBuffer,
+    pack15: FeaturePack,
+    context: MarketContext,
+    params: BBXParams = BBXParams(),
+) -> StrategyOutputs:
+    """Connors-style RSI(2) ≤5/≥95 at/beyond the Bollinger bands
+    (l.152-232); direction-specific autotrade routing (l.105-135)."""
+    p = params
+    S = buf15.capacity
+    if not p.enabled:
+        from binquant_tpu.strategies.base import no_signal
+
+        out = no_signal(S)
+        return out
+
+    close = buf15.values[:, :, Field.CLOSE]
+    delta = close - shift(close, 1)
+    gain = rolling_mean_last(jnp.maximum(delta, 0.0), p.rsi_window)
+    loss = rolling_mean_last(jnp.maximum(-delta, 0.0), p.rsi_window)
+    rsi2 = jnp.where(
+        loss == 0,
+        jnp.where(gain == 0, jnp.nan, 100.0),
+        100.0 - 100.0 / (1.0 + jsafe_div(gain, jnp.where(loss == 0, 1.0, loss))),
+    )
+    rsi2 = jnp.clip(jnp.where(jnp.isfinite(gain) & jnp.isfinite(loss), rsi2, jnp.nan), 0, 100)
+
+    band_span = pack15.bb_upper - pack15.bb_lower
+    band_position = jnp.where(
+        band_span > 0, (pack15.close - pack15.bb_lower) / band_span, 0.5
+    )
+    buy = (rsi2 <= p.oversold_rsi) & (band_position <= p.max_lower_band_position)
+    sell = (rsi2 >= p.overbought_rsi) & (band_position >= p.min_upper_band_position)
+    fired = (buy | sell) & jnp.isfinite(rsi2) & (band_span > 0) & pack15.valid
+
+    # base autotrade (supports_autotrade l.88-104) + directional (l.105-135)
+    feats = context.features
+    base_ok = (
+        context.valid
+        & ~context.regime_is_transitioning
+        & (context.market_stress_score < p.stress_threshold)
+        & (context.market_regime == MarketRegimeCode.RANGE)
+    )
+    trans = feats.micro_transition
+    trans_blocked = (
+        (trans == MicroTransitionCode.VOLATILITY_EXPANSION)
+        | (trans == MicroTransitionCode.BREAKDOWN)
+        | (trans == MicroTransitionCode.ENTERED_TRANSITIONAL)
+    )
+    micro = feats.micro_regime
+    shortable = (
+        (micro == MicroRegimeCode.RANGE)
+        | (micro == MicroRegimeCode.TRANSITIONAL)
+        | (micro == MicroRegimeCode.TREND_DOWN)
+    )
+    directional_ok = (
+        feats.valid
+        & ~trans_blocked
+        & (feats.micro_regime_strength >= p.micro_min_strength)
+        & jnp.where(sell, shortable, micro != MicroRegimeCode.TREND_DOWN)
+    )
+    autotrade = fired & base_ok & directional_ok
+
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.where(sell, Direction.SHORT, Direction.LONG).astype(jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "rsi2": jnp.where(jnp.isfinite(rsi2), rsi2, 50.0),
+            "band_position": band_position,
+            "bb_width": jsafe_div(band_span, pack15.bb_mid),
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# InversePriceTracker (inverse_price_tracker.py)
+# ---------------------------------------------------------------------------
+
+IPT_ROUTE_TREND_UP = 0
+IPT_ROUTE_TRANSITIONAL_BULLISH = 1
+IPT_ROUTE_TRANSITIONAL_TELEMETRY = 2
+IPT_ROUTE_RANGE_LEADER = 3
+IPT_ROUTE_BLOCKED = 4
+
+
+class IPTParams(NamedTuple):
+    range_rs_min: float = 0.05
+    confidence_min: float = 0.4
+    followthrough_min: float = -0.1
+    adverse_risk_max: float = 0.65
+    weights: ScorerWeights = ScorerWeights(
+        context_weight=0.35, risk_weight=0.35, support_weight=0.2
+    )
+
+
+def inverse_price_tracker(
+    pack5: FeaturePack,
+    context: MarketContext,
+    params: IPTParams = IPTParams(),
+) -> StrategyOutputs:
+    """Same oversold trio as PriceTracker, routed to TREND_UP / bullish
+    TRANSITIONAL / RANGE-leader markets; telemetry-only (autotrade False)."""
+    p = params
+    f = pack5
+    S = f.close.shape[0]
+    enough = (f.filled >= 30) & jnp.isfinite(f.rsi) & jnp.isfinite(f.macd) & jnp.isfinite(f.mfi)
+    entry = (f.rsi < 30.0) & (f.macd < 0.0) & (f.mfi < 20.0)
+
+    feats = context.features
+    micro = feats.micro_regime
+
+    bullish_transitional_market = (
+        (context.market_regime == MarketRegimeCode.TRANSITIONAL)
+        & (context.long_tailwind > 0)
+        & (context.long_regime_score > context.short_regime_score)
+        & (context.long_regime_score > context.range_regime_score)
+        & (context.long_regime_score > context.stress_regime_score)
+    )
+    bullish_transitional_symbol = (
+        (micro == MicroRegimeCode.TRANSITIONAL)
+        & (feats.trend_score > 0)
+        & feats.above_ema20
+        & (feats.relative_strength_vs_btc >= 0)
+    )
+    range_leader = (
+        ((micro == MicroRegimeCode.TREND_UP) | (micro == MicroRegimeCode.TRANSITIONAL))
+        & (feats.trend_score > 0)
+        & (feats.relative_strength_vs_btc >= p.range_rs_min)
+    )
+
+    stress_ok = context.market_stress_score < 0.35
+    market_trend_up = context.market_regime == MarketRegimeCode.TREND_UP
+    market_transitional = context.market_regime == MarketRegimeCode.TRANSITIONAL
+    market_range = context.market_regime == MarketRegimeCode.RANGE
+
+    symbol_ok = (micro == MicroRegimeCode.TREND_UP) | bullish_transitional_symbol
+    routed = (
+        context.valid
+        & stress_ok
+        & feats.valid
+        & (
+            ((market_trend_up | market_transitional) & symbol_ok)
+            | (market_range & range_leader)
+        )
+    )
+    route = jnp.where(
+        routed & market_trend_up,
+        IPT_ROUTE_TREND_UP,
+        jnp.where(
+            routed & bullish_transitional_market,
+            IPT_ROUTE_TRANSITIONAL_BULLISH,
+            jnp.where(
+                routed & market_transitional,
+                IPT_ROUTE_TRANSITIONAL_TELEMETRY,
+                jnp.where(routed, IPT_ROUTE_RANGE_LEADER, IPT_ROUTE_BLOCKED),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+    local_score = (
+        1.0
+        + jnp.maximum(0.0, (30.0 - f.rsi) / 30.0) * 0.35
+        + jnp.maximum(0.0, (20.0 - f.mfi) / 20.0) * 0.35
+        + jnp.minimum(jnp.abs(f.macd) * 100.0, 1.0) * 0.3
+    )
+    trend_score = jnp.where(
+        f.ema21 != 0, jsafe_div(f.ema9 - f.ema21, jnp.abs(f.ema21)), 0.0
+    )
+    ev = score_signal_candidate(
+        context,
+        is_short=jnp.asarray(False),
+        local_score=local_score,
+        symbol_rs=feats.relative_strength_vs_btc,
+        symbol_trend=trend_score,
+        weights=p.weights,
+        emit_threshold=1.0,
+    )
+    cs = ev.context_score
+    telemetry_ok = (
+        (cs.confidence >= p.confidence_min)
+        & (cs.followthrough_score >= p.followthrough_min)
+        & (cs.adverse_excursion_risk <= p.adverse_risk_max)
+    )
+
+    fired = entry & enough & routed & telemetry_ok & f.valid
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.where(fired, local_score, 0.0),
+        autotrade=jnp.zeros((S,), dtype=bool),  # telemetry-only (l.190)
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "rsi": f.rsi,
+            "mfi": f.mfi,
+            "macd": f.macd,
+            "adjusted_score": ev.adjusted_score,
+            "route": route,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# RangeBbRsiMeanReversion (range_bb_rsi_mean_reversion.py)
+# ---------------------------------------------------------------------------
+
+
+class RBRParams(NamedTuple):
+    min_candles: int = 40
+    adx_window: int = 14
+    zscore_window: int = 20
+    adx_max: float = 32.0
+    long_rsi_max: float = 35.0
+    short_rsi_min: float = 65.0
+    long_zscore_max: float = -2.0
+    short_zscore_min: float = 2.0
+    band_touch_tolerance: float = 0.002
+    max_market_stress: float = 0.35
+    max_symbol_atr_pct: float = 0.04
+    max_symbol_bb_width: float = 0.08
+    min_rejection_wick_frac: float = 0.30
+    long_close_position_min: float = 0.55
+    short_close_position_max: float = 0.45
+
+
+def _adx_rolling_sum(
+    high: jnp.ndarray, low: jnp.ndarray, close: jnp.ndarray, window: int
+) -> jnp.ndarray:
+    """The strategy's inline rolling-sum ADX (l.101-128) — NOT Wilder EWM.
+    Returns the last ADX value; 100.0 when NaN (reference l.128)."""
+    hd = high - shift(high, 1)
+    ld = shift(low, 1) - low
+    plus_dm = jnp.where((hd > ld) & (hd > 0), hd, 0.0)
+    minus_dm = jnp.where((ld > hd) & (ld > 0), ld, 0.0)
+    pc = shift(close, 1)
+    tr = jnp.maximum(high - low, jnp.maximum(jnp.abs(high - pc), jnp.abs(low - pc)))
+    tr = jnp.where(jnp.isfinite(pc), tr, high - low)
+    atr_sum = rolling_sum(tr, window)
+    plus_di = 100.0 * jsafe_div(rolling_sum(plus_dm, window), atr_sum)
+    minus_di = 100.0 * jsafe_div(rolling_sum(minus_dm, window), atr_sum)
+    di_total = plus_di + minus_di
+    dx = jnp.where(
+        di_total != 0,
+        100.0 * jnp.abs(plus_di - minus_di) / jnp.where(di_total != 0, di_total, 1.0),
+        0.0,
+    )
+    dx = jnp.where(jnp.isfinite(atr_sum), dx, jnp.nan)
+    adx = rolling_mean(dx, window)[:, -1]
+    return jnp.where(jnp.isfinite(adx), adx, 100.0)
+
+
+def range_bb_rsi_mean_reversion(
+    buf15: MarketBuffer,
+    pack15: FeaturePack,
+    context: MarketContext,
+    params: RBRParams = RBRParams(),
+) -> StrategyOutputs:
+    """RANGE×RANGE fade with ADX<32 veto, ±2σ z-score, wick-rejection
+    candle filters. Autotrade on when fired (reference emits with
+    autotrade=True via bot_params)."""
+    p = params
+    f = pack15
+    S = buf15.capacity
+    high = buf15.values[:, :, Field.HIGH]
+    low = buf15.values[:, :, Field.LOW]
+    close = buf15.values[:, :, Field.CLOSE]
+
+    feats = context.features
+    trans = feats.micro_transition
+    routing_ok = (
+        context.valid
+        & (context.market_stress_score < p.max_market_stress)
+        & (context.market_regime == MarketRegimeCode.RANGE)
+        & feats.valid
+        & (feats.micro_regime == MicroRegimeCode.RANGE)
+        & (trans != MicroTransitionCode.BREAKOUT_UP)
+        & (trans != MicroTransitionCode.BREAKDOWN)
+        & (trans != MicroTransitionCode.VOLATILITY_EXPANSION)
+        & (feats.atr_pct <= p.max_symbol_atr_pct)
+        & (feats.bb_width <= p.max_symbol_bb_width)
+    )
+
+    adx = _adx_rolling_sum(high, low, close, p.adx_window)
+    adx_ok = adx <= p.adx_max
+
+    mean = rolling_mean_last(close, p.zscore_window)
+    std = rolling_std_last(close, p.zscore_window, ddof=0)
+    z = jnp.where((std > 0) & jnp.isfinite(std), (f.close - mean) / jnp.where(std > 0, std, 1.0), 0.0)
+
+    candle_range = f.high - f.low
+    range_ok = candle_range > 0
+    lower_wick = jnp.minimum(f.open, f.close) - f.low
+    upper_wick = f.high - jnp.maximum(f.open, f.close)
+    close_position = jsafe_div(f.close - f.low, candle_range)
+
+    bullish_rej = (
+        range_ok
+        & (f.low <= f.bb_lower * (1.0 + p.band_touch_tolerance))
+        & (f.close > f.open)
+        & (jsafe_div(lower_wick, candle_range) >= p.min_rejection_wick_frac)
+        & (close_position >= p.long_close_position_min)
+    )
+    bearish_rej = (
+        range_ok
+        & (f.high >= f.bb_upper * (1.0 - p.band_touch_tolerance))
+        & (f.close < f.open)
+        & (jsafe_div(upper_wick, candle_range) >= p.min_rejection_wick_frac)
+        & (close_position <= p.short_close_position_max)
+    )
+
+    long_setup = (
+        (f.close <= f.bb_mid) & (f.rsi <= p.long_rsi_max) & (z <= p.long_zscore_max) & bullish_rej
+    )
+    short_setup = (
+        (f.close >= f.bb_mid) & (f.rsi >= p.short_rsi_min) & (z >= p.short_zscore_min) & bearish_rej
+    )
+
+    fired = (
+        (f.filled >= p.min_candles)
+        & jnp.isfinite(f.rsi)
+        & routing_ok
+        & adx_ok
+        & (long_setup | short_setup)
+    )
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.where(short_setup, Direction.SHORT, Direction.LONG).astype(jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=fired,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={"adx": adx, "zscore": z, "rsi": f.rsi},
+    )
+
+
+# ---------------------------------------------------------------------------
+# RangeFailedBreakoutFade (range_failed_breakout_fade.py)
+# ---------------------------------------------------------------------------
+
+
+def range_failed_breakout_fade(
+    spikes: SpikeSignal,
+    context: MarketContext,
+    avg_return_max: float = -0.005,
+) -> StrategyOutputs:
+    """Short a fresh bullish spike (any spike flag + upward streak) when the
+    market is RANGE, average return < −0.5%, and the symbol is an
+    outperformer (RS ≥ 0)."""
+    feats = context.features
+    long_flags = (
+        spikes.cumulative_price_break_flag
+        | spikes.volume_cluster_flag
+        | spikes.price_break_flag
+        | spikes.accel_spike_flag
+    )
+    spike_ok = long_flags & spikes.upward
+    routing_ok = (
+        context.valid
+        & (context.market_regime == MarketRegimeCode.RANGE)
+        & (context.average_return < avg_return_max)
+        & feats.valid
+        & (feats.relative_strength_vs_btc >= 0)
+    )
+    fired = spike_ok & routing_ok
+    S = spikes.close.shape[0]
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.full((S,), Direction.SHORT, dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=fired,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "volume_cluster_flag": spikes.volume_cluster_flag,
+            "price_break_flag": spikes.price_break_flag,
+            "cumulative_price_break_flag": spikes.cumulative_price_break_flag,
+            "accel_spike_flag": spikes.accel_spike_flag,
+            "volume": spikes.volume,
+            "quote_asset_volume": spikes.quote_asset_volume,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# RelativeStrengthReversalRange (relative_strength_reversal_range.py)
+# ---------------------------------------------------------------------------
+
+
+class RSRParams(NamedTuple):
+    avg_return_max: float = -0.02
+    rs_vs_btc_min: float = 0.05
+    volume_percentile: float = 0.20
+    volume_window: int = 96
+
+
+def relative_strength_reversal_range(
+    buf15: MarketBuffer,
+    pack15: FeaturePack,
+    context: MarketContext,
+    params: RSRParams = RSRParams(),
+) -> StrategyOutputs:
+    """Contrarian long on an RS leader (> +5% vs BTC) during a broad selloff
+    (avg return < −2%) with a volume floor at the 20th percentile of the
+    last 24h. Telemetry-only while live P&L is collected (l.103-105)."""
+    p = params
+    S = buf15.capacity
+    feats = context.features
+    routing_ok = (
+        context.valid
+        & (context.market_regime == MarketRegimeCode.RANGE)
+        & (context.average_return < p.avg_return_max)
+        & feats.valid
+        & (feats.relative_strength_vs_btc > p.rs_vs_btc_min)
+    )
+
+    volume = buf15.values[:, -p.volume_window:, Field.VOLUME]
+    finite = jnp.isfinite(volume)
+    cnt = jnp.sum(finite, axis=-1)
+    s = jnp.sort(jnp.where(finite, volume, jnp.inf), axis=-1)
+    rank = p.volume_percentile * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, p.volume_window - 1)
+    hi = jnp.clip(lo + 1, 0, p.volume_window - 1)
+    frac = rank - lo
+    v_lo = jnp.take_along_axis(s, lo[:, None], axis=-1)[:, 0]
+    v_hi = jnp.take_along_axis(
+        s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[:, None], axis=-1
+    )[:, 0]
+    floor = v_lo + (v_hi - v_lo) * frac
+
+    fired = (
+        (pack15.filled >= p.volume_window)
+        & routing_ok
+        & (pack15.volume > floor)
+        & pack15.valid
+    )
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=jnp.zeros((S,), dtype=bool),  # telemetry-only
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "volume_floor": jnp.where(jnp.isfinite(floor), floor, 0.0),
+            "relative_strength_vs_btc": feats.relative_strength_vs_btc,
+        },
+    )

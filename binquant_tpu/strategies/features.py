@@ -1,0 +1,181 @@
+"""Shared per-timeframe feature pack.
+
+The reference enriches each symbol's DataFrame with the same indicator
+columns once per kline (``producers/context_evaluator.py:228-251``) and the
+strategies read the latest row plus small tails. Here the equivalent is one
+batched pass producing last-bar values (and the few short histories
+strategies inspect) for all S symbols — each indicator computed exactly once
+per tick regardless of how many strategies consume it.
+
+Variant pins (the reference is explicit that variant drift silently shifts
+strategy thresholds, ``strategies/mean_reversion_fade.py:44-49``):
+
+* ``rsi`` — simple-rolling-mean RSI (the pybinbot ``Indicators.rsi`` column
+  strategies read);
+* ``rsi_wilder`` — Wilder/EWM RSI (MeanReversionFade computes this inline);
+* ``atr`` — SMA-of-true-range (the ``ATR`` column / accumulator variant);
+* ``bb`` — 20-bar mean ± 2σ with population std (ddof=0), matching the
+  accumulator's explicit ``std(ddof=0)``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.ops.indicators import true_range
+from binquant_tpu.ops.rolling import (
+    ewm_mean,
+    ewm_mean_last,
+    rolling_mean,
+    rolling_mean_last,
+    rolling_std_last,
+    shift,
+)
+from binquant_tpu.utils import jsafe_div
+
+# Bars of BB-width history retained for LadderDeployer's stability check
+# (reference MIN_BB_WIDTH_STABILITY_CANDLES=8, ladder_deployer.py:23).
+BB_WIDTH_HISTORY = 8
+
+
+class FeaturePack(NamedTuple):
+    """Last-bar indicator batch for one timeframe. All arrays (S,) f32
+    unless noted; NaN marks not-ready (insufficient history)."""
+
+    open_time: jnp.ndarray  # (S,) int32 seconds
+    close_time: jnp.ndarray  # (S,) int32 seconds (open_time + duration)
+    open: jnp.ndarray
+    high: jnp.ndarray
+    low: jnp.ndarray
+    close: jnp.ndarray
+    prev_close: jnp.ndarray
+    volume: jnp.ndarray
+    quote_volume: jnp.ndarray
+    num_trades: jnp.ndarray
+    rsi: jnp.ndarray  # simple-rolling-mean RSI(14)
+    rsi_wilder: jnp.ndarray  # Wilder/EWM RSI(14)
+    macd: jnp.ndarray  # MACD line (12/26)
+    macd_signal: jnp.ndarray  # 9-span EMA of the line
+    mfi: jnp.ndarray  # MFI(14)
+    bb_upper: jnp.ndarray
+    bb_mid: jnp.ndarray
+    bb_lower: jnp.ndarray
+    bb_widths: jnp.ndarray  # (S, BB_WIDTH_HISTORY) trailing (u-l)/mid
+    atr: jnp.ndarray  # SMA-of-TR ATR(14)
+    atr_ma: jnp.ndarray  # 20-bar SMA of the ATR series
+    volume_ma: jnp.ndarray  # 20-bar SMA of volume
+    ema9: jnp.ndarray
+    ema21: jnp.ndarray
+    filled: jnp.ndarray  # (S,) int32 valid bar count
+    valid: jnp.ndarray  # (S,) bool — row has any bars
+
+
+def compute_feature_pack(buf: MarketBuffer) -> FeaturePack:
+    close = buf.values[:, :, Field.CLOSE]
+    high = buf.values[:, :, Field.HIGH]
+    low = buf.values[:, :, Field.LOW]
+    open_ = buf.values[:, :, Field.OPEN]
+    volume = buf.values[:, :, Field.VOLUME]
+
+    # --- RSI (both variants), full-window EWM for exact warm-up parity
+    delta = close - shift(close, 1)
+    gain = jnp.maximum(delta, 0.0)
+    loss = jnp.maximum(-delta, 0.0)
+    avg_gain_w = ewm_mean_last(gain, alpha=1.0 / 14, min_periods=14)
+    avg_loss_w = ewm_mean_last(loss, alpha=1.0 / 14, min_periods=14)
+    denom_w = avg_gain_w + avg_loss_w
+    rsi_wilder = jnp.where(
+        denom_w != 0, 100.0 * avg_gain_w / jnp.where(denom_w != 0, denom_w, 1.0), 50.0
+    )
+    rsi_wilder = jnp.where(
+        jnp.isfinite(avg_gain_w) & jnp.isfinite(avg_loss_w), rsi_wilder, jnp.nan
+    )
+    avg_gain_s = rolling_mean_last(gain, 14)
+    avg_loss_s = rolling_mean_last(loss, 14)
+    denom_s = avg_gain_s + avg_loss_s
+    rsi_sma = jnp.where(
+        denom_s != 0, 100.0 * avg_gain_s / jnp.where(denom_s != 0, denom_s, 1.0), 50.0
+    )
+    rsi_sma = jnp.where(
+        jnp.isfinite(avg_gain_s) & jnp.isfinite(avg_loss_s), rsi_sma, jnp.nan
+    )
+
+    # --- MACD: line needs its full series for the signal EMA
+    macd_line = ewm_mean(close, span=12, min_periods=1) - ewm_mean(
+        close, span=26, min_periods=1
+    )
+    macd_last = macd_line[:, -1]
+    macd_signal = ewm_mean_last(macd_line, span=9, min_periods=1)
+
+    # --- MFI(14) from the trailing 15 bars
+    tp = (high + low + close) / 3.0
+    flow = tp * volume
+    tp_delta = tp - shift(tp, 1)
+    pos_flow = jnp.where(tp_delta > 0, flow, 0.0)[:, -14:]
+    neg_flow = jnp.where(tp_delta < 0, flow, 0.0)[:, -14:]
+    flow_ok = jnp.isfinite(tp_delta[:, -14:])
+    pos_sum = jnp.sum(jnp.where(flow_ok, pos_flow, 0.0), axis=-1)
+    neg_sum = jnp.sum(jnp.where(flow_ok, neg_flow, 0.0), axis=-1)
+    total = pos_sum + neg_sum
+    mfi = jnp.where(total != 0, 100.0 * pos_sum / jnp.where(total != 0, total, 1.0), 50.0)
+    mfi = jnp.where(jnp.sum(flow_ok, axis=-1) >= 14, mfi, jnp.nan)
+
+    # --- Bollinger 20/2σ(ddof=0), last bar + trailing width history
+    k = BB_WIDTH_HISTORY
+    tail = close[:, -(20 + k - 1):]
+    mids = rolling_mean(tail, 20)[:, -k:]
+    # population std over each trailing-20 slice of the tail
+    from binquant_tpu.ops.rolling import rolling_std
+
+    stds = rolling_std(tail, 20, ddof=0)[:, -k:]
+    uppers = mids + 2.0 * stds
+    lowers = mids - 2.0 * stds
+    bb_widths = jsafe_div(uppers - lowers, mids)
+    bb_upper = uppers[:, -1]
+    bb_mid = mids[:, -1]
+    bb_lower = lowers[:, -1]
+
+    # --- ATR(14) SMA variant + its own 20-bar MA. 35-bar slice, drop the
+    # first TR (its prev_close falls outside the slice) -> 34 true TRs.
+    tr = true_range(high[:, -35:], low[:, -35:], close[:, -35:])[:, 1:]
+    atr_series = rolling_mean(tr, 14)  # (S, 34) with warm-up NaN
+    atr = atr_series[:, -1]
+    atr_ma = rolling_mean_last(atr_series, 20)
+
+    volume_ma = rolling_mean_last(volume, 20)
+    ema9 = ewm_mean_last(close, span=9, min_periods=1)
+    ema21 = ewm_mean_last(close, span=21, min_periods=1)
+
+    duration = buf.values[:, -1, Field.DURATION_S]
+    duration = jnp.where(jnp.isfinite(duration), duration, 0.0).astype(jnp.int32)
+    return FeaturePack(
+        open_time=buf.times[:, -1],
+        close_time=buf.times[:, -1] + duration,
+        open=open_[:, -1],
+        high=high[:, -1],
+        low=low[:, -1],
+        close=close[:, -1],
+        prev_close=close[:, -2],
+        volume=volume[:, -1],
+        quote_volume=buf.values[:, -1, Field.QUOTE_VOLUME],
+        num_trades=buf.values[:, -1, Field.NUM_TRADES],
+        rsi=rsi_sma,
+        rsi_wilder=rsi_wilder,
+        macd=macd_last,
+        macd_signal=macd_signal,
+        mfi=mfi,
+        bb_upper=bb_upper,
+        bb_mid=bb_mid,
+        bb_lower=bb_lower,
+        bb_widths=bb_widths,
+        atr=atr,
+        atr_ma=atr_ma,
+        volume_ma=volume_ma,
+        ema9=ema9,
+        ema21=ema21,
+        filled=buf.filled,
+        valid=buf.filled > 0,
+    )

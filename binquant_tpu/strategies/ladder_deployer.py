@@ -1,0 +1,125 @@
+"""LadderDeployer — grid-ladder deployment in stable ranges, batched.
+
+Re-implements ``/root/reference/strategies/grid/ladder_deployer.py``:
+futures-only grid deployment that requires the grid-only policy active
+(l.66), symbol micro-regime RANGE/TRANSITIONAL with no blocking transition
+(l.76-84), long_regime_score ≥ 0.2 (l.85-87), BB width stable over 8
+candles (≤20% change, l.38-52, fed by the feature pack's width history),
+price inside a BB range 1.5–8% wide (l.94-106), and an ATR-derived breakout
+buffer clamped to 0.5–4% (l.107-111). The trigger row's diagnostics carry
+everything the host needs to build the ``GridDeploymentRequest`` payload
+(l.116-141); gate-first-record-after ordering stays a host concern.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.enums import MicroRegimeCode, MicroTransitionCode
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.strategies.base import StrategyOutputs
+from binquant_tpu.strategies.features import BB_WIDTH_HISTORY, FeaturePack
+
+
+class LadderParams(NamedTuple):
+    """Class constants (l.14-30)."""
+
+    enabled: bool = True
+    autotrade: bool = True
+    min_range_width_pct: float = 1.5
+    max_range_width_pct: float = 8.0
+    min_breakout_buffer_pct: float = 0.5
+    max_breakout_buffer_pct: float = 4.0
+    breakout_atr_multiplier: float = 1.5
+    min_long_regime_score: float = 0.2
+    max_bb_width_change_pct: float = 20.0
+
+
+def ladder_deployer(
+    pack15: FeaturePack,
+    context: MarketContext,
+    grid_policy_allows: jnp.ndarray,  # scalar bool — GridOnlyPolicy (host)
+    is_futures: jnp.ndarray,  # scalar bool
+    params: LadderParams = LadderParams(),
+) -> StrategyOutputs:
+    p = params
+    f = pack15
+    S = f.close.shape[0]
+    if not p.enabled:
+        from binquant_tpu.strategies.base import no_signal
+
+        return no_signal(S)
+
+    feats = context.features
+    micro = feats.micro_regime
+    micro_ok = feats.valid & (
+        (micro == MicroRegimeCode.RANGE) | (micro == MicroRegimeCode.TRANSITIONAL)
+    )
+    trans = feats.micro_transition
+    transition_ok = (
+        (trans != MicroTransitionCode.BREAKDOWN)
+        & (trans != MicroTransitionCode.VOLATILITY_EXPANSION)
+        & (trans != MicroTransitionCode.ENTERED_TREND_DOWN)
+    )
+    breadth_ok = context.long_regime_score >= p.min_long_regime_score
+
+    # BB width stability over the trailing 8 candles (l.38-52)
+    widths = f.bb_widths  # (S, 8)
+    widths_ok = jnp.all(jnp.isfinite(widths) & (widths > 0), axis=-1) & (
+        f.filled >= 20 + BB_WIDTH_HISTORY - 1
+    )
+    w_first = widths[:, 0]
+    w_last = widths[:, -1]
+    change_pct = jnp.abs(
+        (w_last - w_first) / jnp.where(w_first != 0, w_first, 1.0)
+    ) * 100.0
+    bb_stable = widths_ok & (change_pct <= p.max_bb_width_change_pct)
+
+    range_low = f.bb_lower
+    range_high = f.bb_upper
+    price = f.close
+    in_range = (range_low < price) & (price < range_high)
+    range_width_pct = jnp.where(
+        f.bb_mid > 0, (range_high - range_low) / f.bb_mid * 100.0, 0.0
+    )
+    width_ok = (range_width_pct >= p.min_range_width_pct) & (
+        range_width_pct <= p.max_range_width_pct
+    )
+
+    atr_pct = jnp.where(price > 0, f.atr / price, 0.0)
+    raw_buffer = atr_pct * 100.0 * p.breakout_atr_multiplier
+    buffer_pct = jnp.clip(
+        raw_buffer, p.min_breakout_buffer_pct, p.max_breakout_buffer_pct
+    )
+
+    fired = (
+        is_futures
+        & grid_policy_allows
+        & context.valid
+        & micro_ok
+        & transition_ok
+        & breadth_ok
+        & bb_stable
+        & in_range
+        & width_ok
+        & f.valid
+    )
+
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=fired & p.autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "range_low": range_low,
+            "range_high": range_high,
+            "breakout_low": range_low * (1.0 - buffer_pct / 100.0),
+            "breakout_high": range_high * (1.0 + buffer_pct / 100.0),
+            "range_width_pct": range_width_pct,
+            "atr_buffer_pct": buffer_pct,
+            "bb_width_change_pct": change_pct,
+        },
+    )

@@ -1,0 +1,192 @@
+"""LiquidationSweepPump — 15m pump detector with breadth-fade routing.
+
+Re-implements ``/root/reference/strategies/liquidation_sweep_pump.py``:
+pump score = rel_volume · (1+momentum) · OI-growth / range-fraction, 2-bar
+smoothed (l.110-145); trigger when max(smooth, raw) clears the 80th
+percentile of the last 48 smoothed scores (l.163-181); optional open-interest
+confirmation ≥1.02 (l.183-185); direction from breadth-fade routing — hot
+ADP fading + BTC stalled + weak symbol → short, washed-out ADP recovering +
+BTC up → long (l.76-108). ADP (advancers-decliners pressure) comes from the
+REST breadth series when available, else from the context's
+advancers−decliners ratio (l.56-63) — the host passes the resolved pair.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.enums import Direction, MicroRegimeCode
+from binquant_tpu.ops.rolling import rolling_mean, rolling_max, rolling_min, shift
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.strategies.base import StrategyOutputs
+
+# Route codes (breadth_fade_routing, l.76-108)
+ROUTE_SHORT = 0  # "breadth_hot_fading_btc_stalled_symbol_weak"
+ROUTE_LONG = 1  # "breadth_washed_out_recovering_btc_up"
+ROUTE_NO_CONTEXT = 2
+ROUTE_STRESS = 3
+ROUTE_HOT_NOT_FALLING = 4
+ROUTE_BTC_NOT_STALLED = 5
+ROUTE_NO_SYMBOL_FEATURES = 6
+ROUTE_FOLLOWTHROUGH_NOT_WEAK = 7
+ROUTE_WASHED_NOT_INCREASING = 8
+ROUTE_BTC_NOT_INCREASING = 9
+ROUTE_ADP_NOT_EXTREME = 10
+
+
+class LSPParams(NamedTuple):
+    """Class constants (l.22-25) + windows (l.110-145, 163-180)."""
+
+    short_adp_threshold: float = 0.3
+    long_adp_threshold: float = -0.4
+    btc_stalled_momentum_abs: float = 0.002
+    window_hours: int = 3  # 15m bars per unit (reference window_hours)
+    score_window: int = 48
+    score_quantile: float = 0.80
+    min_oi_growth: float = 1.02
+
+
+# score series needs rel_volume back score_window+1 bars, each needing
+# volume 9 bars back -> 64 covers 49+9 with margin.
+TAIL = 64
+
+
+def liquidation_sweep_pump(
+    buf15: MarketBuffer,
+    context: MarketContext,
+    oi_growth: jnp.ndarray,  # (S,) f32, NaN = unavailable (KuCoin OI cache)
+    adp_latest: jnp.ndarray,  # scalar f32 — resolved ADP (breadth or context)
+    adp_prev: jnp.ndarray,  # scalar f32, NaN = no history
+    btc_momentum: jnp.ndarray,  # scalar f32 — BTC close pct_change last bar
+    params: LSPParams = LSPParams(),
+) -> StrategyOutputs:
+    p = params
+    wh = p.window_hours
+    volume = buf15.values[:, -TAIL:, Field.VOLUME]
+    close = buf15.values[:, -TAIL:, Field.CLOSE]
+    high = buf15.values[:, -TAIL:, Field.HIGH]
+    low = buf15.values[:, -TAIL:, Field.LOW]
+
+    # --- pump score pipeline (l.120-145)
+    rel_volume = volume / shift(rolling_mean(volume, wh * 2), wh)
+    momentum = close / shift(close, wh) - 1.0
+    range_frac = (rolling_max(high, wh * 2) - rolling_min(low, wh * 2)) / close
+
+    oi_factor = jnp.where(
+        jnp.isfinite(oi_growth), 1.0 + jnp.maximum(0.0, oi_growth - 1.0), 1.0
+    )[:, None]
+    pump_score = rel_volume * (1.0 + momentum) * oi_factor / range_frac
+    smooth = rolling_mean(pump_score, 2)
+
+    # --- trigger: top-quintile of last 48 smoothed scores (l.165-181)
+    recent = smooth[:, -p.score_window:]
+    finite = jnp.isfinite(recent)
+    cnt = jnp.sum(finite, axis=-1)
+    s = jnp.sort(jnp.where(finite, recent, jnp.inf), axis=-1)
+    rank = p.score_quantile * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, p.score_window - 1)
+    hi = jnp.clip(lo + 1, 0, p.score_window - 1)
+    frac = rank - lo
+    v_lo = jnp.take_along_axis(s, lo[:, None], axis=-1)[:, 0]
+    v_hi = jnp.take_along_axis(
+        s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[:, None], axis=-1
+    )[:, 0]
+    threshold = v_lo + (v_hi - v_lo) * frac
+
+    latest_smooth = smooth[:, -1]
+    latest_raw = pump_score[:, -1]
+    trigger_score = jnp.maximum(latest_smooth, latest_raw)
+    score_ok = (
+        jnp.isfinite(latest_smooth)
+        & (cnt > 0)
+        & (trigger_score >= threshold)
+    )
+
+    # OI confirmation (l.184-185)
+    oi_ok = ~jnp.isfinite(oi_growth) | (oi_growth >= p.min_oi_growth)
+
+    # --- breadth-fade routing (l.76-108)
+    feats = context.features
+    has_context = context.valid
+    stress_ok = context.market_stress_score < 0.35
+    has_breadth_pair = jnp.isfinite(adp_prev)
+    falling = has_breadth_pair & (adp_latest < adp_prev)
+    increasing = has_breadth_pair & (adp_latest > adp_prev)
+    btc_stalled = jnp.abs(btc_momentum) <= p.btc_stalled_momentum_abs
+
+    weak_followthrough = (feats.relative_strength_vs_btc <= 0) & (
+        (feats.trend_score <= 0)
+        | ~feats.above_ema20
+        | (feats.micro_regime != MicroRegimeCode.TREND_UP)
+    )
+
+    hot = adp_latest > p.short_adp_threshold
+    washed = adp_latest <= p.long_adp_threshold
+
+    short_ok = hot & falling & btc_stalled & feats.valid & weak_followthrough
+    long_ok = washed & increasing & (btc_momentum > 0)
+
+    route = jnp.where(
+        ~has_context,
+        ROUTE_NO_CONTEXT,
+        jnp.where(
+            ~stress_ok,
+            ROUTE_STRESS,
+            jnp.where(
+                hot,
+                jnp.where(
+                    ~falling,
+                    ROUTE_HOT_NOT_FALLING,
+                    jnp.where(
+                        ~btc_stalled,
+                        ROUTE_BTC_NOT_STALLED,
+                        jnp.where(
+                            ~feats.valid,
+                            ROUTE_NO_SYMBOL_FEATURES,
+                            jnp.where(
+                                weak_followthrough,
+                                ROUTE_SHORT,
+                                ROUTE_FOLLOWTHROUGH_NOT_WEAK,
+                            ),
+                        ),
+                    ),
+                ),
+                jnp.where(
+                    washed,
+                    jnp.where(
+                        ~increasing,
+                        ROUTE_WASHED_NOT_INCREASING,
+                        jnp.where(
+                            btc_momentum > 0, ROUTE_LONG, ROUTE_BTC_NOT_INCREASING
+                        ),
+                    ),
+                    ROUTE_ADP_NOT_EXTREME,
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+    routed = has_context & stress_ok & (short_ok | long_ok)
+    fired = score_ok & oi_ok & routed & (buf15.filled > 0)
+    direction = jnp.where(short_ok, Direction.SHORT, Direction.LONG).astype(jnp.int32)
+
+    S = buf15.capacity
+    return StrategyOutputs(
+        trigger=fired,
+        direction=direction,
+        score=jnp.where(jnp.isfinite(trigger_score), trigger_score, 0.0),
+        autotrade=fired,  # autotrade always on for routed signals (l.210)
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "trigger_score": jnp.where(jnp.isfinite(trigger_score), trigger_score, 0.0),
+            "threshold": jnp.where(jnp.isfinite(threshold), threshold, 0.0),
+            "oi_growth": jnp.where(jnp.isfinite(oi_growth), oi_growth, 1.0),
+            "adp": jnp.broadcast_to(adp_latest, (S,)),
+            "btc_momentum": jnp.broadcast_to(btc_momentum, (S,)),
+            "route": route,
+            "volume": volume[:, -1],
+        },
+    )

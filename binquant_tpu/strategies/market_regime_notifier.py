@@ -1,0 +1,74 @@
+"""MarketRegimeNotifier — Telegram digest on regime transitions (host).
+
+Equivalent of ``/root/reference/strategies/market_regime_notifier.py``: a
+scalar-per-tick concern (one market, one message), so it stays host-side.
+Emits a structured digest on each *new* market regime transition, deduped by
+remembering the last transition sent (reference ``last_market_regime``,
+l.42-53).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from binquant_tpu.enums import MarketRegimeCode, MarketTransitionCode
+from binquant_tpu.regime.context import MarketContext
+
+
+def _regime_summary(regime: int) -> str:
+    if regime == MarketRegimeCode.TREND_UP:
+        return "market conditions now favor long continuation"
+    if regime == MarketRegimeCode.TREND_DOWN:
+        return "market conditions now favor downside continuation"
+    if regime == MarketRegimeCode.HIGH_STRESS:
+        return "market conditions have shifted into a stressed risk-off state"
+    if regime == MarketRegimeCode.RANGE:
+        return "market conditions now favor mean-reversion and range trading"
+    return "market conditions are mixed, transitional, or range-bound"
+
+
+class MarketRegimeNotifier:
+    def __init__(self, env: str = "") -> None:
+        self.env = env
+        self.last_transition_sent: int | None = None
+
+    def build_message(self, context: MarketContext) -> str | None:
+        """Digest text for a new transition, or None when nothing to send."""
+        if not bool(np.asarray(context.valid)):
+            return None
+        transition = int(np.asarray(context.market_regime_transition))
+        previous = int(np.asarray(context.previous_market_regime))
+        current = int(np.asarray(context.market_regime))
+        if transition < 0 or previous < 0 or current < 0:
+            return None
+        if transition == self.last_transition_sent:
+            return None
+        self.last_transition_sent = transition
+
+        r3 = lambda v: round(float(np.asarray(v)), 3)
+        prev_name = MarketRegimeCode(previous).name
+        cur_name = MarketRegimeCode(current).name
+        transition_name = MarketTransitionCode(transition).name
+        ts = int(np.asarray(context.timestamp)) * 1000
+        return f"""
+            - [{self.env}] <strong>#market_regime_transition</strong>
+            - Event: {transition_name}
+            - Regime transition: {prev_name} -> {cur_name}
+            - Market regime: {cur_name}
+            - Market transition: {transition_name}
+            - Interpretation: {_regime_summary(current)}
+            - Context timestamp: {ts}
+            - Confidence: 1.0
+            - Transition strength: {r3(context.market_regime_transition_strength)}
+            - Fresh symbols: {int(np.asarray(context.fresh_count))}
+            - Advancers ratio: {r3(context.advancers_ratio)}
+            - Long regime score: {r3(context.long_regime_score)}
+            - Short regime score: {r3(context.short_regime_score)}
+            - Range regime score: {r3(context.range_regime_score)}
+            - Stress regime score: {r3(context.stress_regime_score)}
+            - Avg return: {round(float(np.asarray(context.average_return)), 4)}
+            - BTC regime score: {r3(context.btc_regime_score)}
+            - Long tailwind: {r3(context.long_tailwind)}
+            - Short tailwind: {r3(context.short_tailwind)}
+            - Market stress: {r3(context.market_stress_score)}
+        """

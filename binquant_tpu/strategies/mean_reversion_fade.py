@@ -1,0 +1,120 @@
+"""MeanReversionFade — 15m RSI+Bollinger extreme fade, batched.
+
+Re-implements ``/root/reference/strategies/mean_reversion_fade.py``: futures-
+only (l.160), Wilder-EWM RSI(14) (l.79-100, via the feature pack's
+``rsi_wilder``), long = RSI≤25 ∧ close≤bb_low ∧ green / short = RSI≥75 ∧
+close≥bb_high ∧ red (l.117-126), 20-bar volume floor and ATR-spike veto
+(l.115-118), ATR-sized stop-loss pct (l.137-141), and per-candle emit dedupe
+(l.143-151) as a carried last-emitted-open-time array. No trend/regime
+filter by design; autotrade always on.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.enums import Direction
+from binquant_tpu.strategies.base import StrategyOutputs
+from binquant_tpu.strategies.features import FeaturePack
+
+# Entry-reason codes (host maps to the reference's strings)
+REASON_NONE = 0
+REASON_LONG = 1  # "lower_band_rsi_oversold_green"
+REASON_SHORT = 2  # "upper_band_rsi_overbought_red"
+REASON_ATR_SPIKE = 3  # "atr_volatility_spike"
+REASON_LOW_VOLUME = 4  # "volume_below_average"
+
+
+class MRFParams(NamedTuple):
+    """Class constants (l.54-64)."""
+
+    rsi_long_max: float = 25.0
+    rsi_short_min: float = 75.0
+    volume_ratio_min: float = 1.0
+    atr_spike_max: float = 2.0
+    atr_stop_mult: float = 2.0
+
+
+def mean_reversion_fade(
+    pack15: FeaturePack,
+    is_futures: jnp.ndarray,  # scalar bool — market_type gate (l.160)
+    last_emitted: jnp.ndarray,  # (S,) int32 carry: open_time of last emit
+    params: MRFParams = MRFParams(),
+) -> tuple[StrategyOutputs, jnp.ndarray]:
+    p = params
+    f = pack15
+    rsi = f.rsi_wilder
+
+    ready = (
+        jnp.isfinite(rsi)
+        & jnp.isfinite(f.volume_ma)
+        & jnp.isfinite(f.atr)
+        & jnp.isfinite(f.atr_ma)
+    )
+
+    atr_ok = f.atr < p.atr_spike_max * f.atr_ma
+    volume_ok = f.volume >= p.volume_ratio_min * f.volume_ma
+
+    long_setup = (rsi <= p.rsi_long_max) & (f.close <= f.bb_lower) & (f.close > f.open)
+    short_setup = (rsi >= p.rsi_short_min) & (f.close >= f.bb_upper) & (f.close < f.open)
+
+    setup = ready & atr_ok & volume_ok & (long_setup | short_setup)
+    not_duplicate = last_emitted != f.open_time  # per-candle dedupe (l.143-151)
+    fired = setup & not_duplicate & is_futures & f.valid
+
+    direction = jnp.where(short_setup, Direction.SHORT, Direction.LONG).astype(
+        jnp.int32
+    )
+
+    # score = 1 + oversold/overbought depth (l.128-135)
+    long_depth = jnp.maximum(0.0, (p.rsi_long_max - rsi) / p.rsi_long_max)
+    short_depth = jnp.maximum(
+        0.0, (rsi - p.rsi_short_min) / (100.0 - p.rsi_short_min)
+    )
+    score = jnp.round(
+        1.0 + jnp.where(short_setup, short_depth, long_depth), 4
+    )
+
+    # ATR stop (l.137-141), entry price = close
+    stop_pct = jnp.where(
+        f.close > 0, p.atr_stop_mult * f.atr / f.close * 100.0, 0.0
+    )
+    stop_pct = jnp.round(jnp.clip(stop_pct, 0.0, 101.0), 4)
+
+    reason = jnp.where(
+        ~ready,
+        REASON_NONE,
+        jnp.where(
+            ~atr_ok,
+            REASON_ATR_SPIKE,
+            jnp.where(
+                ~volume_ok,
+                REASON_LOW_VOLUME,
+                jnp.where(
+                    long_setup,
+                    REASON_LONG,
+                    jnp.where(short_setup, REASON_SHORT, REASON_NONE),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+    new_carry = jnp.where(fired, f.open_time, last_emitted).astype(jnp.int32)
+    outputs = StrategyOutputs(
+        trigger=fired,
+        direction=direction,
+        score=jnp.where(fired, score, 0.0),
+        autotrade=fired,  # always autotrade (l.216)
+        stop_loss_pct=jnp.where(fired, stop_pct, 0.0),
+        diagnostics={
+            "rsi": rsi,
+            "volume_ma": f.volume_ma,
+            "atr": f.atr,
+            "atr_ma": f.atr_ma,
+            "entry_reason": reason,
+            "candidate_open_time": f.open_time,
+        },
+    )
+    return outputs, new_carry

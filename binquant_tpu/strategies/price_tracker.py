@@ -1,0 +1,180 @@
+"""Coinrule PriceTracker — 5m oversold mean-reversion long, batched.
+
+Re-implements ``/root/reference/strategies/coinrule/price_tracker.py``:
+entry RSI(14)<30 ∧ MACD<0 ∧ MFI<20 on 5m candles (l.186), local score from
+oversold depth (l.190-195), context-adjusted score with the strategy's own
+scorer weights (0.35/0.35/0.2, l.54-58) and telemetry gates — bad
+followthrough / high risk / low confidence kill the signal (l.229-234) —
+its own RANGE-only regime routing with the stable-breadth band and
+RS-vs-BTC floor (l.96-155), a 12-bar entry cooldown keyed on close_time
+(l.34,78-94) carried as a device array, and quiet-hours autotrade
+suppression (l.245-255; wall-clock flag supplied by the host).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.enums import (
+    MarketRegimeCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.regime.scoring import ScorerWeights, score_signal_candidate
+from binquant_tpu.strategies.base import StrategyOutputs
+from binquant_tpu.strategies.features import FeaturePack
+from binquant_tpu.utils import jsafe_div
+
+# Route codes for the host's reason strings (regime_routing l.108-155)
+ROUTE_SYMBOL_RANGE = 0  # allowed: "symbol_range"
+ROUTE_NO_CONTEXT = 1
+ROUTE_TRANSITIONING = 2
+ROUTE_STRESS = 3
+ROUTE_BREADTH_UNSTABLE = 4
+ROUTE_NOT_RANGE = 5
+ROUTE_NO_SYMBOL_FEATURES = 6
+ROUTE_SYMBOL_TRANSITION = 7
+ROUTE_RS_INSUFFICIENT = 8
+ROUTE_SYMBOL_REGIME = 9
+ROUTE_QUIET_HOURS = 10
+
+
+class PTParams(NamedTuple):
+    """Class constants (l.33-36, 119) + scorer weights (l.54-58)."""
+
+    entry_cooldown_bars: int = 12
+    min_rs_vs_btc: float = 0.005
+    stress_threshold: float = 0.3  # min(autotrade_stress_threshold, 0.3)
+    weights: ScorerWeights = ScorerWeights(
+        context_weight=0.35, risk_weight=0.35, support_weight=0.2
+    )
+
+
+def _has_stable_breadth(context: MarketContext) -> jnp.ndarray:
+    """Breadth balanced 0.48–0.62 ∧ tailwind gap ≤ 0.35 (l.96-106)."""
+    balanced = (context.advancers_ratio >= 0.48) & (context.advancers_ratio <= 0.62)
+    tailwinds = jnp.abs(context.long_tailwind - context.short_tailwind) <= 0.35
+    return balanced & tailwinds
+
+
+def price_tracker(
+    pack5: FeaturePack,
+    context: MarketContext,
+    quiet_hours_suppressed: jnp.ndarray,  # scalar bool (host wall-clock)
+    last_signal_close_time: jnp.ndarray,  # (S,) int32 carry, -1 = never
+    interval_s: int = 300,
+    params: PTParams = PTParams(),
+) -> tuple[StrategyOutputs, jnp.ndarray]:
+    p = params
+    f = pack5
+    S = f.close.shape[0]
+
+    # data sufficiency: >=30 bars and recent values present (l.166-173)
+    enough = (f.filled >= 30) & jnp.isfinite(f.rsi) & jnp.isfinite(f.macd) & jnp.isfinite(f.mfi)
+
+    entry = (f.rsi < 30.0) & (f.macd < 0.0) & (f.mfi < 20.0)
+
+    local_score = (
+        1.0
+        + jnp.maximum(0.0, (30.0 - f.rsi) / 30.0) * 0.35
+        + jnp.maximum(0.0, (20.0 - f.mfi) / 20.0) * 0.35
+        + jnp.minimum(jnp.abs(f.macd) * 100.0, 1.0) * 0.3
+    )
+    trend_score = jnp.where(
+        f.ema21 != 0, jsafe_div(f.ema9 - f.ema21, jnp.abs(f.ema21)), 0.0
+    )
+
+    feats = context.features
+    evaluation = score_signal_candidate(
+        context,
+        is_short=jnp.asarray(False),
+        local_score=local_score,
+        symbol_rs=feats.relative_strength_vs_btc,
+        symbol_trend=trend_score,  # local_features override (l.210-212)
+        weights=p.weights,
+        emit_threshold=1.0,
+    )
+    cs = evaluation.context_score
+
+    # context required (l.220-221)
+    has_context = context.valid
+
+    # telemetry gates (l.229-234)
+    telemetry_ok = (
+        (cs.followthrough_score >= -0.2)
+        & (cs.adverse_excursion_risk <= 0.6)
+        & (cs.confidence >= 0.5)
+    )
+
+    # --- regime routing (l.108-155): autotrade verdict + reason, signal
+    # still emitted when False.
+    stable_breadth = _has_stable_breadth(context)
+    micro = feats.micro_regime
+    trans = feats.micro_transition
+    bad_transition = (trans == MicroTransitionCode.BREAKDOWN) | (
+        trans == MicroTransitionCode.VOLATILITY_EXPANSION
+    )
+    rs_ok = feats.relative_strength_vs_btc > p.min_rs_vs_btc
+
+    route = jnp.full((S,), ROUTE_SYMBOL_RANGE, dtype=jnp.int32)
+
+    def set_route(route, cond, code):
+        return jnp.where((route == ROUTE_SYMBOL_RANGE) & cond, code, route)
+
+    route = jnp.where(~has_context, ROUTE_NO_CONTEXT, route)
+    route = set_route(route, context.regime_is_transitioning, ROUTE_TRANSITIONING)
+    route = set_route(
+        route, context.market_stress_score >= p.stress_threshold, ROUTE_STRESS
+    )
+    route = set_route(route, ~stable_breadth, ROUTE_BREADTH_UNSTABLE)
+    route = set_route(
+        route, context.market_regime != MarketRegimeCode.RANGE, ROUTE_NOT_RANGE
+    )
+    route = set_route(route, ~feats.valid | (micro < 0), ROUTE_NO_SYMBOL_FEATURES)
+    route = set_route(route, bad_transition, ROUTE_SYMBOL_TRANSITION)
+    route = set_route(route, ~rs_ok, ROUTE_RS_INSUFFICIENT)
+    route = set_route(route, micro != MicroRegimeCode.RANGE, ROUTE_SYMBOL_REGIME)
+    autotrade = route == ROUTE_SYMBOL_RANGE
+
+    # --- entry cooldown on close_time (l.78-94)
+    elapsed = f.close_time - last_signal_close_time
+    cooldown_active = (
+        (last_signal_close_time >= 0)
+        & (elapsed >= 0)
+        & (elapsed < p.entry_cooldown_bars * interval_s)
+    )
+
+    fired = entry & enough & has_context & telemetry_ok & ~cooldown_active & f.valid
+
+    # quiet-hours suppression flips autotrade only (l.245-255)
+    suppressed = autotrade & quiet_hours_suppressed
+    autotrade = autotrade & ~quiet_hours_suppressed
+    route = jnp.where(fired & suppressed, ROUTE_QUIET_HOURS, route)
+
+    new_carry = jnp.where(fired, f.close_time, last_signal_close_time).astype(
+        jnp.int32
+    )
+    outputs = StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),  # long-only
+        score=jnp.where(fired, local_score, 0.0),
+        autotrade=fired & autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "rsi": f.rsi,
+            "macd": f.macd,
+            "mfi": f.mfi,
+            "adjusted_score": evaluation.adjusted_score,
+            "confidence": cs.confidence,
+            "followthrough": cs.followthrough_score,
+            "risk": cs.adverse_excursion_risk,
+            "breadth_stable": stable_breadth,
+            "relative_strength_vs_btc": feats.relative_strength_vs_btc,
+            "route": route,
+            "quiet_hours_suppressed": suppressed,
+        },
+    )
+    return outputs, new_carry

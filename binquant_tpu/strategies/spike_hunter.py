@@ -1,0 +1,283 @@
+"""SpikeHunterV3 — spike detector + breadth-momentum routing, batched.
+
+Re-implements ``/root/reference/strategies/spike_hunter_v3_kucoin.py``'s
+detector as one pass over the 15m buffer: per-symbol auto-calibration from
+full-window quantiles (l.187-215), volume-cluster / dynamic-quantile
+price-break / cumulative-break / acceleration flags (l.308-402), long+short
+preliminary labels (l.404-446), and 3-candle streaks (l.480-489) — the
+``latest_signal()`` dict (l.504-551) becomes a NamedTuple of (S,) arrays.
+
+Live dispatch of this strategy is disabled in the reference after a
+production-validated losing week (``producers/context_evaluator.py:47-52``);
+the detector itself stays live because RangeFailedBreakoutFade consumes its
+flags, so the kernel is exported standalone.
+
+Notes on live-edge semantics: the reference's ``volume_cluster_label_mode
+== "last"`` inspects the *next* bar (``shift(-1)``), which at the live edge
+is always absent — the last-bar flag equals the base flag, which is what
+this kernel computes. ``post_spike_cooldown_bars`` defaults to 0 (no
+suppression), matching l.453-457.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.enums import Direction
+from binquant_tpu.ops.rolling import (
+    rolling_max,
+    rolling_mean,
+    rolling_quantile,
+    rolling_sum,
+    shift,
+)
+from binquant_tpu.regime.context import MarketContext
+from binquant_tpu.strategies.base import StrategyOutputs
+
+# Routing codes (breadth_momentum_direction, l.142-161)
+ROUTE_LONG = 0  # "breadth_momentum_up_*"
+ROUTE_SHORT = 1  # "breadth_momentum_down_*"
+ROUTE_NO_CONTEXT = 2
+ROUTE_STRESS = 3
+ROUTE_BREADTH_UNAVAILABLE = 4
+ROUTE_BREADTH_FLAT = 5
+ROUTE_SYMBOL_NOT_CONFIRMED = 6
+
+
+class SpikeParams(NamedTuple):
+    """Thresholds (l.53-77) + auto-calibration knobs (l.187-193)."""
+
+    volume_cluster_min_ratio: float = 1.6
+    volume_cluster_window: int = 8
+    volume_cluster_min_count: int = 2
+    price_break_base_threshold: float = 0.03
+    price_break_dynamic_q: float = 0.85
+    cumulative_price_window: int = 3
+    cumulative_price_threshold: float = 0.025
+    accel_volume_deriv_window: int = 3
+    accel_volume_deriv_min: float = 0.45
+    accel_price_change_min: float = 0.015
+    require_bullish_spike: bool = True
+    base_window: int = 12  # compute_base_features window
+    # auto_calibrate
+    calib_volume_quantile: float = 0.97
+    calib_price_floor_quantile: float = 0.75
+    calib_min_volume_ratio: float = 1.15
+    calib_min_price_abs_floor: float = 0.015
+    max_market_stress: float = 0.35
+
+
+class SpikeSignal(NamedTuple):
+    """latest_signal() as (S,) arrays (l.528-551)."""
+
+    close: jnp.ndarray
+    label: jnp.ndarray  # bool — bullish final spike
+    label_short: jnp.ndarray  # bool
+    volume_cluster_flag: jnp.ndarray  # bool
+    price_break_flag: jnp.ndarray  # bool
+    cumulative_price_break_flag: jnp.ndarray  # bool
+    accel_spike_flag: jnp.ndarray  # bool
+    cumulative_price_break_short_flag: jnp.ndarray  # bool
+    accel_spike_short_flag: jnp.ndarray  # bool
+    upward: jnp.ndarray  # bool — 3 green candles
+    downward: jnp.ndarray  # bool
+    volume: jnp.ndarray
+    quote_asset_volume: jnp.ndarray
+    volume_ratio_threshold: jnp.ndarray  # calibrated per symbol
+    price_break_threshold: jnp.ndarray
+
+
+def _nanquantile_last(x: jnp.ndarray, q: float) -> jnp.ndarray:
+    """np.quantile over finite values along the last axis (linear interp)."""
+    finite = jnp.isfinite(x)
+    cnt = jnp.sum(finite, axis=-1)
+    s = jnp.sort(jnp.where(finite, x, jnp.inf), axis=-1)
+    W = x.shape[-1]
+    rank = q * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, W - 1)
+    hi = jnp.clip(lo + 1, 0, W - 1)
+    frac = rank - lo
+    v_lo = jnp.take_along_axis(s, lo[..., None], axis=-1)[..., 0]
+    v_hi = jnp.take_along_axis(
+        s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[..., None], axis=-1
+    )[..., 0]
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(cnt > 0, out, jnp.nan)
+
+
+def detect_spikes(buf15: MarketBuffer, params: SpikeParams = SpikeParams()) -> SpikeSignal:
+    """The full detector (detect() l.492-502), last-bar outputs."""
+    p = params
+    close = buf15.values[:, :, Field.CLOSE]
+    open_ = buf15.values[:, :, Field.OPEN]
+    volume = buf15.values[:, :, Field.VOLUME]
+
+    price_change = close / shift(close, 1) - 1.0
+    price_change_abs = jnp.abs(price_change)
+    volume_ma = rolling_mean(volume, p.base_window)
+    volume_ratio = volume / (volume_ma + 1e-6)
+
+    # --- auto-calibration from full-window distributions (l.187-215)
+    vol_thr = jnp.maximum(
+        p.calib_min_volume_ratio,
+        _nanquantile_last(volume_ratio, p.calib_volume_quantile),
+    )
+    vol_thr = jnp.where(jnp.isfinite(vol_thr), vol_thr, p.volume_cluster_min_ratio)
+    price_floor = jnp.maximum(
+        p.calib_min_price_abs_floor,
+        _nanquantile_last(price_change_abs, p.calib_price_floor_quantile),
+    )
+    price_floor = jnp.maximum(
+        p.price_break_base_threshold,
+        jnp.where(jnp.isfinite(price_floor), price_floor, 0.0),
+    )
+
+    # --- volume cluster (l.308-318); live edge => base flag
+    cond = volume_ratio >= vol_thr[:, None]
+    cluster_count = rolling_sum(
+        jnp.where(jnp.isfinite(volume_ratio), cond.astype(jnp.float32), jnp.nan),
+        p.volume_cluster_window,
+        min_periods=1,
+    )
+    vc_flag = (cluster_count >= p.volume_cluster_min_count) & cond
+
+    # --- dynamic price break (l.320-358)
+    dyn = rolling_quantile(price_change_abs, 60, p.price_break_dynamic_q, min_periods=20)
+    thr = jnp.maximum(price_floor[:, None], dyn)  # NaN dyn -> NaN (pre-warmup)
+    pb_flag = price_change_abs >= thr
+
+    # --- cumulative break (l.360-379)
+    w = p.cumulative_price_window
+    cum_pos = rolling_sum(jnp.maximum(price_change, 0.0), w)
+    cum_neg = rolling_sum(jnp.abs(jnp.minimum(price_change, 0.0)), w)
+    vol_cond = rolling_max(
+        jnp.where(
+            jnp.isfinite(volume_ratio),
+            (volume_ratio >= vol_thr[:, None] * 0.8).astype(jnp.float32),
+            jnp.nan,
+        ),
+        w,
+    ) > 0.5
+    cum_flag = (cum_pos >= p.cumulative_price_threshold) & vol_cond
+    cum_short_flag = (cum_neg >= p.cumulative_price_threshold) & vol_cond
+
+    # --- acceleration (l.381-402)
+    vol_deriv = volume_ratio - shift(volume_ratio, p.accel_volume_deriv_window)
+    accel_base = (vol_deriv >= p.accel_volume_deriv_min) & (
+        price_change_abs >= p.accel_price_change_min
+    )
+    accel_flag = accel_base & (price_change > 0)
+    accel_short_flag = accel_base & (price_change < 0)
+
+    # --- labels (l.404-446); require_both_patterns=False default
+    base_combo = vc_flag | pb_flag
+    bullish = close > open_
+    bearish = close < open_
+    label = base_combo | cum_flag | accel_flag
+    if p.require_bullish_spike:
+        label = label & bullish
+    label_short = (base_combo | cum_short_flag | accel_short_flag) & bearish
+
+    # --- streaks (l.480-489)
+    green = bullish.astype(jnp.float32)
+    red = bearish.astype(jnp.float32)
+    upward = rolling_sum(green, 3) >= 3
+    downward = rolling_sum(red, 3) >= 3
+
+    last = lambda a: a[:, -1]
+    return SpikeSignal(
+        close=last(close),
+        label=last(label) & (buf15.filled > 0),
+        label_short=last(label_short) & (buf15.filled > 0),
+        volume_cluster_flag=last(vc_flag),
+        price_break_flag=last(pb_flag),
+        cumulative_price_break_flag=last(cum_flag),
+        accel_spike_flag=last(accel_flag),
+        cumulative_price_break_short_flag=last(cum_short_flag),
+        accel_spike_short_flag=last(accel_short_flag),
+        upward=last(upward),
+        downward=last(downward),
+        volume=buf15.values[:, -1, Field.VOLUME],
+        quote_asset_volume=buf15.values[:, -1, Field.QUOTE_VOLUME],
+        volume_ratio_threshold=vol_thr,
+        price_break_threshold=last(jnp.where(jnp.isfinite(thr), thr, price_floor[:, None])),
+    )
+
+
+def spike_hunter(
+    spikes: SpikeSignal,
+    context: MarketContext,
+    breadth_momentum_points: jnp.ndarray,  # scalar f32, NaN = unavailable
+    params: SpikeParams = SpikeParams(),
+) -> StrategyOutputs:
+    """Full strategy: breadth-momentum direction (l.142-161) + symbol spike
+    confirmation (l.163-185). Kept for capability parity — live dispatch is
+    disabled in the reference (context_evaluator.py:460-469)."""
+    has_context = context.valid
+    stress_ok = context.market_stress_score < params.max_market_stress
+    has_momentum = jnp.isfinite(breadth_momentum_points)
+    go_long = has_momentum & (breadth_momentum_points > 0.0)
+    go_short = has_momentum & (breadth_momentum_points < 0.0)
+
+    long_confirm = (
+        spikes.cumulative_price_break_flag
+        | spikes.volume_cluster_flag
+        | spikes.accel_spike_flag
+    ) & spikes.upward
+    short_confirm = (
+        spikes.cumulative_price_break_short_flag
+        | spikes.volume_cluster_flag
+        | spikes.accel_spike_short_flag
+    ) & spikes.downward
+
+    fired = (
+        has_context
+        & stress_ok
+        & ((go_long & long_confirm) | (go_short & short_confirm))
+    )
+    S = spikes.close.shape[0]
+    direction = jnp.broadcast_to(
+        jnp.where(go_short, Direction.SHORT, Direction.LONG).astype(jnp.int32), (S,)
+    )
+    route = jnp.where(
+        ~has_context,
+        ROUTE_NO_CONTEXT,
+        jnp.where(
+            ~stress_ok,
+            ROUTE_STRESS,
+            jnp.where(
+                ~has_momentum,
+                ROUTE_BREADTH_UNAVAILABLE,
+                jnp.where(
+                    go_long,
+                    jnp.where(long_confirm, ROUTE_LONG, ROUTE_SYMBOL_NOT_CONFIRMED),
+                    jnp.where(
+                        go_short,
+                        jnp.where(
+                            short_confirm, ROUTE_SHORT, ROUTE_SYMBOL_NOT_CONFIRMED
+                        ),
+                        ROUTE_BREADTH_FLAT,
+                    ),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+    route = jnp.broadcast_to(route, (S,))
+
+    return StrategyOutputs(
+        trigger=fired,
+        direction=direction,
+        score=jnp.zeros((S,), dtype=jnp.float32),
+        autotrade=fired,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            "route": route,
+            "volume": spikes.volume,
+            "quote_asset_volume": spikes.quote_asset_volume,
+            "upward": spikes.upward,
+            "downward": spikes.downward,
+        },
+    )

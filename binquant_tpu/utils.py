@@ -82,3 +82,54 @@ def safe_format(value: Any, spec: str = ".2f") -> str:
 
 def round_numbers(value: float, decimals: int = 6) -> float:
     return float(round(float(value), decimals))
+
+
+# ---------------------------------------------------------------------------
+# Telegram link/line builders (reference shared/utils.py:107-135)
+# ---------------------------------------------------------------------------
+
+def build_links_msg(
+    env: str, exchange: str, market_type: str, symbol: str
+) -> tuple[str, str]:
+    """(exchange_link, terminal_link) for Telegram messages."""
+    exchange = str(exchange).lower()
+    market_type = str(market_type).lower()
+    if exchange == "binance":
+        exchange_link = f"https://www.binance.com/en/trade/{symbol}"
+    elif market_type == "futures":
+        exchange_link = f"https://www.kucoin.com/trade/futures/{symbol}"
+    else:
+        exchange_link = f"https://www.kucoin.com/trade/{symbol}"
+
+    terminal_host = (
+        "https://terminal.binbot.in" if env == "production" else "http://localhost:3000"
+    )
+    terminal_link = (
+        f"{terminal_host}/bots/futures/new/{symbol}"
+        if market_type == "futures"
+        else f"{terminal_host}/bots/new/{symbol}"
+    )
+    return exchange_link, terminal_link
+
+
+def format_context_timestamp_line(timestamp_ms: int | None) -> str:
+    """The '- Context timestamp: ...' line every strategy message carries."""
+    if timestamp_ms is None:
+        return "- Context timestamp: UNAVAILABLE"
+    return f"- Context timestamp: {timestamp_to_datetime(timestamp_ms)}"
+
+
+# ---------------------------------------------------------------------------
+# Binance request-weight guard (reference shared/utils.py:70-104)
+# ---------------------------------------------------------------------------
+
+BINANCE_WEIGHT_LIMIT_PER_MIN = 1200
+BINANCE_WEIGHT_SOFT_CAP = 1000
+
+
+def binance_weight_backoff_seconds(used_weight: int) -> float:
+    """Seconds to sleep given the x-mbx-used-weight-1m header value: the
+    reference preemptively pauses near the 1200/min cap."""
+    if used_weight <= BINANCE_WEIGHT_SOFT_CAP:
+        return 0.0
+    return 60.0

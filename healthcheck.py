@@ -1,0 +1,33 @@
+"""Container liveness probe.
+
+Equivalent of ``/root/reference/healthcheck.py``: exit 0 iff the heartbeat
+file exists and is younger than the staleness bound (1500 s).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+HEARTBEAT_PATH = os.environ.get(
+    "BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat"
+)
+MAX_AGE_SECONDS = 1500
+
+
+def main() -> int:
+    try:
+        written_at = float(open(HEARTBEAT_PATH).read().strip())
+    except (OSError, ValueError):
+        print("heartbeat file missing or unreadable", file=sys.stderr)
+        return 1
+    age = time.time() - written_at
+    if age > MAX_AGE_SECONDS:
+        print(f"heartbeat stale: {age:.0f}s > {MAX_AGE_SECONDS}s", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Entrypoint: one asyncio event loop driving ingest + the TPU tick engine.
+
+Equivalent of ``/root/reference/main.py``: websocket ingest and the consumer
+loop joined by an asyncio.Queue, heartbeat per processed tick, per-message
+crash isolation. The evaluation itself runs on device via
+``binquant_tpu.engine.step.tick_step`` instead of per-symbol pandas.
+
+Replay mode (``--replay file.jsonl``) feeds recorded klines through the
+same pipeline with network sinks stubbed — the offline correctness/bench
+harness (BASELINE.json config #2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+
+def configure_logging(level: str = "INFO") -> None:
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+async def run_live() -> None:
+    from binquant_tpu.config import Config
+    from binquant_tpu.io.autotrade import AutotradeConsumer
+    from binquant_tpu.io.binbot import BinbotApi
+    from binquant_tpu.io.exchanges import KucoinFutures
+    from binquant_tpu.io.pipeline import SignalEngine
+    from binquant_tpu.io.telegram import TelegramConsumer
+    from binquant_tpu.io.websocket import WebsocketClientFactory
+
+    config = Config()
+    configure_logging(config.log_level)
+    binbot_api = BinbotApi(config.binbot_api_url)
+
+    autotrade_settings = binbot_api.get_autotrade_settings()
+    test_settings = binbot_api.get_test_autotrade_settings()
+    all_symbols = binbot_api.get_symbols()
+    telegram_consumer = TelegramConsumer(
+        token=config.telegram_bot_token, chat_id=config.telegram_user_id
+    )
+    at_consumer = AutotradeConsumer(
+        autotrade_settings=autotrade_settings,
+        active_test_bots=binbot_api.get_active_pairs("paper_trading"),
+        all_symbols=all_symbols,
+        test_autotrade_settings=test_settings,
+        active_grid_ladders=binbot_api.get_active_grid_ladders(),
+        binbot_api=binbot_api,
+    )
+    engine = SignalEngine(
+        config=config,
+        binbot_api=binbot_api,
+        telegram_consumer=telegram_consumer,
+        at_consumer=at_consumer,
+        futures_api=KucoinFutures(),
+        window=config.window_bars,
+    )
+
+    queue: asyncio.Queue = asyncio.Queue()
+    factory = WebsocketClientFactory(
+        queue,
+        all_symbols,
+        exchange_id=autotrade_settings.exchange_id,
+        interval=autotrade_settings.candlestick_interval,
+    )
+    connector = factory.create_connector()
+    await connector.start_stream()
+    logging.info("binquant_tpu started: %d symbols tracked", len(all_symbols))
+    await engine.consume_loop(queue)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--replay", help="JSONL kline file for offline replay")
+    parser.add_argument("--replay-report", action="store_true")
+    args = parser.parse_args()
+
+    if args.replay:
+        from binquant_tpu.io.replay import run_replay
+
+        stats = run_replay(args.replay)
+        print(stats)
+        return 0
+
+    asyncio.run(run_live())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,11 @@
 """Test harness configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh *before* jax is imported so that
-multi-chip sharding paths (binquant_tpu.parallel) are exercised on any host,
-mirroring how the driver dry-runs the multichip path.
+Requests a virtual 8-device CPU mesh before jax is imported. NOTE: in the
+tunneled-TPU environment the axon sitecustomize force-registers the TPU
+backend regardless of JAX_PLATFORMS, so there the suite actually runs on
+the real chip (clearing PALLAS_AXON_POOL_IPS in the *shell* is the only
+escape hatch — too late from conftest). Elsewhere (CI, plain hosts) the
+settings below take effect and provide the 8-device CPU mesh.
 """
 
 import os

@@ -1,0 +1,140 @@
+"""Full jit'd tick step: end-to-end integration over synthetic ticks."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from binquant_tpu.engine.step import (
+    default_host_inputs,
+    initial_engine_state,
+    pad_updates,
+    tick_step,
+)
+from binquant_tpu.engine.buffer import NUM_FIELDS, Field
+from binquant_tpu.regime.context import ContextConfig
+from tests.conftest import make_ohlcv
+
+S_CAP = 16
+WINDOW = 130
+CFG = ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5)
+
+
+def frames_to_updates(frames: dict[int, pd.DataFrame], bar: int):
+    rows, tss, vals = [], [], []
+    for row, df in frames.items():
+        if bar >= len(df):
+            continue
+        r = df.iloc[bar]
+        v = np.zeros(NUM_FIELDS, dtype=np.float32)
+        v[Field.OPEN], v[Field.HIGH] = r["open"], r["high"]
+        v[Field.LOW], v[Field.CLOSE] = r["low"], r["close"]
+        v[Field.VOLUME] = r["volume"]
+        v[Field.QUOTE_VOLUME] = r["volume"] * r["close"]
+        v[Field.NUM_TRADES] = 100
+        v[Field.DURATION_S] = 900
+        rows.append(row)
+        tss.append(int(r["open_time"]) // 1000)
+        vals.append(v)
+    return (
+        np.array(rows, np.int32),
+        np.array(tss, np.int32),
+        np.stack(vals) if vals else np.zeros((0, NUM_FIELDS), np.float32),
+    )
+
+
+def test_tick_step_end_to_end():
+    rng = np.random.default_rng(211)
+    n_rows = 8
+    frames = {
+        i: pd.DataFrame(make_ohlcv(rng, n=WINDOW, start_price=30 + i, vol=0.006))
+        for i in range(n_rows)
+    }
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    tracked = np.zeros(S_CAP, dtype=bool)
+    tracked[:n_rows] = True
+
+    # bulk-load all but the last two bars in one padded batch per bar
+    for b in range(WINDOW - 2):
+        upd = pad_updates(*frames_to_updates(frames, b), size=S_CAP)
+        ts = int(frames[0]["open_time"].iloc[b]) // 1000
+        inputs = default_host_inputs(S_CAP)._replace(
+            tracked=jnp.asarray(tracked),
+            btc_row=np.int32(0),
+            timestamp_s=np.int32(ts),
+            timestamp5_s=np.int32(ts),
+        )
+        state, out = tick_step(state, upd, upd, inputs, CFG)
+
+    assert bool(out.context.valid)
+    assert int(out.context.fresh_count) == n_rows
+    assert set(out.strategies) == {
+        "activity_burst_pump", "coinrule_price_tracker", "liquidation_sweep_pump",
+        "mean_reversion_fade", "grid_ladder", "coinrule_supertrend_swing_reversal",
+        "coinrule_twap_momentum_sniper", "coinrule_buy_low_sell_high",
+        "coinrule_buy_the_dip", "bb_extreme_reversion", "inverse_price_tracker",
+        "range_bb_rsi_mean_reversion", "range_failed_breakout_fade",
+        "relative_strength_reversal_range",
+    }
+    for name, so in out.strategies.items():
+        assert so.trigger.shape == (S_CAP,), name
+        # untracked rows never trigger
+        assert not np.asarray(so.trigger)[n_rows:].any(), name
+
+    # --- craft a MeanReversionFade long on row 3 for the next tick
+    df = frames[3]
+    last = df.iloc[-3]
+    t_next = int(last["open_time"]) + 900_000
+    prev_close = float(last["close"])
+    o = prev_close * 0.96
+    c = o * 1.004
+    candle = np.zeros(NUM_FIELDS, dtype=np.float32)
+    candle[Field.OPEN], candle[Field.CLOSE] = o, c
+    candle[Field.HIGH], candle[Field.LOW] = c * 1.001, o * 0.997
+    candle[Field.VOLUME] = float(df["volume"].iloc[-30:].mean()) * 3
+    candle[Field.QUOTE_VOLUME] = candle[Field.VOLUME] * c
+    candle[Field.DURATION_S] = 900
+
+    # advance remaining symbols normally at the same timestamp
+    rows, tss, vals = frames_to_updates(frames, WINDOW - 2)
+    tss[:] = t_next // 1000
+    vals[list(rows).index(3)] = candle
+    upd = pad_updates(rows, tss, vals, size=S_CAP)
+    inputs = default_host_inputs(S_CAP)._replace(
+        tracked=jnp.asarray(tracked),
+        btc_row=np.int32(0),
+        timestamp_s=np.int32(t_next // 1000),
+        timestamp5_s=np.int32(t_next // 1000),
+        is_futures=jnp.asarray(True),
+    )
+    state2, out2 = tick_step(state, upd, upd, inputs, CFG)
+    mrf = out2.strategies["mean_reversion_fade"]
+    # the crafted hammer may or may not breach the band after the randomized
+    # walk; if it fired, validate the full contract (direction/stop/dedupe)
+    if bool(mrf.trigger[3]):
+        assert float(mrf.stop_loss_pct[3]) > 0
+        assert bool(mrf.autotrade[3])
+        assert int(state2.mrf_last_emitted[3]) == t_next // 1000
+        # same candle resubmitted -> deduped
+        state3, out3 = tick_step(state2, upd, upd, inputs, CFG)
+        assert not bool(out3.strategies["mean_reversion_fade"].trigger[3])
+
+    # fresh masks and gates are shaped and sane
+    assert out2.fresh15.shape == (S_CAP,)
+    assert np.asarray(out2.fresh15)[:n_rows].all()
+    assert out2.long_gate.shape == (S_CAP,)
+    assert out2.btc_beta.shape == (S_CAP,)
+    # BTC row correlates perfectly with itself
+    np.testing.assert_allclose(float(out2.btc_corr[0]), 1.0, atol=1e-3)
+
+
+def test_tick_step_empty_updates_no_crash():
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    upd = pad_updates(
+        np.zeros(0, np.int32), np.zeros(0, np.int32),
+        np.zeros((0, NUM_FIELDS), np.float32), size=4,
+    )
+    inputs = default_host_inputs(S_CAP)
+    state2, out = tick_step(state, upd, upd, inputs, CFG)
+    assert not bool(out.context.valid)
+    for so in out.strategies.values():
+        assert not np.asarray(so.trigger).any()

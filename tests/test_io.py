@@ -1,0 +1,372 @@
+"""Host I/O edges: telegram sink, autotrade gates, ws parser, calibrator.
+
+Mirrors the reference's seam discipline (tests/conftest.py:34-49 patches
+BinbotApi; fakes over fakes-of-the-network) — here the seams are injectable
+transports/sessions instead of monkeypatching.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from binquant_tpu.io.autotrade import Autotrade, AutotradeConsumer
+from binquant_tpu.io.binbot import BinbotApi
+from binquant_tpu.io.leverage import LeverageCalibrator
+from binquant_tpu.io.telegram import TelegramConsumer
+from binquant_tpu.io.websocket import (
+    KlinesConnector,
+    filter_fiat_symbols,
+    parse_binance_kline_frame,
+)
+from binquant_tpu.engine.buffer import SymbolRegistry
+from binquant_tpu.enums import MarketRegimeCode
+from binquant_tpu.schemas import (
+    AutotradeSettingsSchema,
+    BotBase,
+    HABollinguerSpread,
+    SignalsConsumer,
+    SymbolModel,
+    TestAutotradeSettingsSchema,
+)
+from tests.test_regime_routing_scoring import mk_context
+
+
+# ---------------------------------------------------------------------------
+# Telegram
+# ---------------------------------------------------------------------------
+
+
+def make_consumer(sent):
+    async def transport(chat_id, text):
+        sent.append(text)
+
+    return TelegramConsumer(token="", chat_id="c", transport=transport)
+
+
+SIGNAL_MSG = """
+    - [test] <strong>#mean_reversion_fade algorithm</strong> #BTCUSDT
+    - Action: LONG ENTRY
+    - Current price: 100.5
+    - Strategy: long
+    - Autotrade route: long_autotrade_allowed
+    - Autotrade is enabled
+"""
+
+
+class TestTelegram:
+    def test_dedupe_within_cooldown(self):
+        async def run():
+            sent = []
+            consumer = make_consumer(sent)
+            consumer._min_send_interval_seconds = 0
+            t1 = consumer.dispatch_signal(SIGNAL_MSG)
+            assert t1 is not None
+            await t1
+            # identical payload within 900s -> dropped
+            assert consumer.dispatch_signal(SIGNAL_MSG) is None
+            # different action -> new key, sent
+            other = SIGNAL_MSG.replace("LONG ENTRY", "SHORT ENTRY")
+            t2 = consumer.dispatch_signal(other)
+            assert t2 is not None
+            await t2
+            assert len(sent) == 2
+
+        asyncio.run(run())
+
+    def test_sanitize_preserves_whitelist(self):
+        consumer = make_consumer([])
+        out = consumer._sanitize_html(
+            "<strong>#x</strong> <script>evil()</script> RSI &lt; 30 "
+            "<a href='https://x.y/z'>link</a>"
+        )
+        assert "<strong>#x</strong>" in out
+        assert "&lt;script&gt;" in out
+        assert "RSI &lt; 30" in out
+        assert '<a href="https://x.y/z">link</a>' in out
+
+    def test_disabled_consumer_never_sends(self):
+        consumer = TelegramConsumer(token="", chat_id="c", is_enabled=False)
+        assert consumer.dispatch_signal(SIGNAL_MSG) is None
+
+
+# ---------------------------------------------------------------------------
+# Websocket parsing
+# ---------------------------------------------------------------------------
+
+
+class TestWsParsing:
+    def test_closed_kline_parsed_with_extended_fields(self):
+        frame = json.dumps(
+            {
+                "e": "kline",
+                "k": {
+                    "s": "BTCUSDT", "x": True, "t": 1700000000000,
+                    "T": 1700000899999, "o": "1.0", "h": "2.0", "l": "0.5",
+                    "c": "1.5", "v": "10", "q": "15", "n": 42, "V": "6", "Q": "9",
+                },
+            }
+        )
+        out = parse_binance_kline_frame(frame)
+        assert out["symbol"] == "BTCUSDT"
+        assert out["quote_asset_volume"] == 15.0
+        assert out["number_of_trades"] == 42.0
+        assert out["taker_buy_base_volume"] == 6.0
+
+    def test_open_candle_and_noise_dropped(self):
+        open_frame = json.dumps(
+            {"e": "kline", "k": {"s": "BTCUSDT", "x": False, "t": 1, "T": 2,
+                                 "o": "1", "h": "1", "l": "1", "c": "1", "v": "1"}}
+        )
+        assert parse_binance_kline_frame(open_frame) is None
+        assert parse_binance_kline_frame('{"e":"depthUpdate"}') is None
+        assert parse_binance_kline_frame("not json{") is None
+
+    def test_symbol_chunking(self):
+        symbols = [SymbolModel(id=f"S{i}USDT") for i in range(950)]
+        conn = KlinesConnector(
+            asyncio.Queue(), symbols, connect=lambda *_: None,
+            max_markets_per_client=400,
+        )
+        chunks = conn._chunks()
+        assert [len(c) for c in chunks] == [400, 400, 150]
+        assert chunks[0][0] == "s0usdt@kline_15m"
+
+    def test_fiat_filter(self):
+        symbols = [
+            SymbolModel(id="BTCUSDT"),
+            SymbolModel(id="USDTTRY"),
+            SymbolModel(id="USDCUSDT"),
+            SymbolModel(id="ETHUSDT", active=False),
+        ]
+        kept = [s.id for s in filter_fiat_symbols(symbols)]
+        assert kept == ["BTCUSDT"]
+
+
+# ---------------------------------------------------------------------------
+# Autotrade gate chain (fake binbot session)
+# ---------------------------------------------------------------------------
+
+
+class FakeResp:
+    def __init__(self, payload, status_code=200):
+        self._payload = payload
+        self.status_code = status_code
+        self.text = json.dumps(payload)
+
+    def json(self):
+        return self._payload
+
+
+class FakeSession:
+    """Scriptable binbot backend."""
+
+    def __init__(self):
+        self.calls = []
+        self.active_pairs = []
+        self.paper_pairs = []
+        self.grid_ladders = []
+        self.balance = 1000.0
+        self.excluded = []
+        self.created = []
+        self.activated = []
+        self.activation_error = False
+
+    def request(self, method, url, **kwargs):
+        self.calls.append((method, url, kwargs.get("json")))
+        if "available-fiat" in url:
+            return FakeResp({"data": {"amount": self.balance}})
+        if "active-pairs/paper_trading" in url:
+            return FakeResp({"data": self.paper_pairs})
+        if "active-pairs" in url:
+            return FakeResp({"data": self.active_pairs})
+        if "excluded" in url:
+            return FakeResp({"data": self.excluded})
+        if "grid-ladders/active" in url:
+            return FakeResp({"data": self.grid_ladders})
+        if "grid-ladders/calculate" in url:
+            return FakeResp({"data": {"levels": [1, 2, 3]}})
+        if url.endswith("/grid-ladders") and method == "POST":
+            self.created.append(("grid", kwargs.get("json")))
+            return FakeResp({"data": {"ok": True}})
+        if "/symbol/" in url and method == "GET":
+            sym = url.rsplit("/", 1)[-1]
+            return FakeResp({"data": {"id": sym, "quote_asset": "USDT"}})
+        if ("/bot" in url or "paper-trading" in url) and method == "POST" and "errors" not in url:
+            self.created.append(("bot", kwargs.get("json")))
+            return FakeResp(
+                {"message": "ok", "error": 0,
+                 "data": {"pair": kwargs["json"]["pair"],
+                          "id": "11111111-1111-1111-1111-111111111111"}}
+            )
+        if "activate" in url:
+            if self.activation_error:
+                return FakeResp({"message": "boom", "error": 1, "data": None})
+            self.activated.append(url)
+            return FakeResp(
+                {"message": "ok", "error": 0,
+                 "data": {"pair": "BTCUSDT", "status": "active"}}
+            )
+        if "deactivate" in url or "errors" in url or "clean-margin-short" in url:
+            return FakeResp({"data": {}})
+        return FakeResp({"data": {}})
+
+    def get(self, url, params=None):
+        return self.request("GET", url, params=params)
+
+
+def make_at_consumer(session=None, autotrade=True, exchange="binance"):
+    session = session or FakeSession()
+    api = BinbotApi("http://fake", session=session)
+    settings = AutotradeSettingsSchema(
+        autotrade=autotrade, exchange_id=exchange, market_type="spot"
+    )
+    test_settings = TestAutotradeSettingsSchema(autotrade=False)
+    consumer = AutotradeConsumer(
+        autotrade_settings=settings,
+        active_test_bots=[],
+        all_symbols=[SymbolModel(id="BTCUSDT")],
+        test_autotrade_settings=test_settings,
+        active_grid_ladders=[],
+        binbot_api=api,
+    )
+    return consumer, session
+
+
+def make_signal(autotrade=True, pair="BTCUSDT", name="mean_reversion_fade"):
+    return SignalsConsumer(
+        autotrade=autotrade,
+        current_price=100.0,
+        direction="LONG",
+        bot_params=BotBase(pair=pair, name=name, market_type="spot"),
+        bb_spreads=HABollinguerSpread(bb_high=105, bb_mid=100, bb_low=95),
+    )
+
+
+class TestAutotradeGates:
+    def test_full_path_creates_and_activates(self):
+        consumer, session = make_at_consumer()
+        asyncio.run(consumer.process_autotrade_restrictions(make_signal()))
+        kinds = [k for k, _ in session.created]
+        assert kinds == ["bot"]
+        assert session.activated
+        payload = session.created[0][1]
+        # BB-spread-derived stop loss: whole spread ~9.52% in (2,20)
+        assert 2 < payload["stop_loss"] < 20
+
+    def test_insufficient_balance_blocks(self):
+        consumer, session = make_at_consumer()
+        session.balance = 1.0
+        asyncio.run(consumer.process_autotrade_restrictions(make_signal()))
+        assert session.created == []
+
+    def test_grid_only_policy_blocks(self):
+        from binquant_tpu.regime.grid_policy import GridOnlyPolicy
+
+        consumer, session = make_at_consumer()
+        consumer.grid_only_policy = GridOnlyPolicy.active(
+            direction="toward_range", source="x", latest=0.4, previous=0.5
+        )
+        asyncio.run(consumer.process_autotrade_restrictions(make_signal()))
+        assert session.created == []
+
+    def test_duplicate_bot_blocks(self):
+        consumer, session = make_at_consumer()
+        session.active_pairs = ["BTCUSDT"]
+        asyncio.run(consumer.process_autotrade_restrictions(make_signal()))
+        assert session.created == []
+
+    def test_activation_failure_cleans_up(self):
+        consumer, session = make_at_consumer()
+        session.activation_error = True
+        from binquant_tpu.exceptions import AutotradeError
+
+        with pytest.raises(AutotradeError):
+            asyncio.run(consumer.process_autotrade_restrictions(make_signal()))
+        # compensating deactivate happened
+        assert any("deactivate" in url for _, url, _ in session.calls)
+
+    def test_excluded_symbol_skipped(self):
+        consumer, session = make_at_consumer()
+        session.excluded = ["BTCUSDT"]
+        asyncio.run(consumer.process_autotrade_restrictions(make_signal()))
+        assert session.created == []
+
+    def test_grid_deployment_cooldown(self):
+        from datetime import UTC, datetime
+
+        from binquant_tpu.schemas import GridDeploymentRequest, SignalKind
+
+        consumer, session = make_at_consumer()
+        grid = GridDeploymentRequest(
+            symbol="BTCUSDT", fiat="USDT", exchange="binance",
+            market_type="spot", algorithm_name="grid_ladder",
+            generated_at=datetime.now(UTC),
+            range_low=95, range_high=105, breakout_low=94, breakout_high=106,
+            total_margin=10, level_count=7,
+            allocation_pct=60.0, cash_reserve_pct=40.0,
+        )
+        sig = SignalsConsumer(
+            signal_kind=SignalKind.grid_deploy, direction="grid",
+            autotrade=True, current_price=100.0, grid_params=grid,
+        )
+        asyncio.run(consumer.process_autotrade_restrictions(sig))
+        assert [k for k, _ in session.created] == ["grid"]
+        # immediate retry within 1h cooldown -> skipped
+        asyncio.run(consumer.process_autotrade_restrictions(sig))
+        assert [k for k, _ in session.created] == ["grid"]
+
+
+class TestAutotradeOverrides:
+    def test_signal_overrides_beat_bb_derived_values(self):
+        session = FakeSession()
+        api = BinbotApi("http://fake", session=session)
+        settings = AutotradeSettingsSchema(exchange_id="binance", autotrade=True)
+        autotrade = Autotrade(
+            pair="BTCUSDT", settings=settings,
+            algorithm_name="mean_reversion_fade", binbot_api=api,
+            db_collection_name="bots",
+        )
+        sig = make_signal()
+        sig.bot_params.stop_loss = 7.77  # explicit override
+        asyncio.run(autotrade.activate_autotrade(sig))
+        payload = session.created[0][1]
+        assert payload["stop_loss"] == 7.77  # override preserved
+        assert payload["cooldown"] == 360
+
+
+# ---------------------------------------------------------------------------
+# Leverage calibrator
+# ---------------------------------------------------------------------------
+
+
+class TestLeverageCalibrator:
+    def test_ladder_and_diffing(self):
+        session = FakeSession()
+        api = BinbotApi("http://fake", session=session)
+        cal = LeverageCalibrator(api, "kucoin")
+        reg = SymbolRegistry(6)
+        for s in ["AUSDT", "BUSDT", "CUSDT"]:
+            reg.add(s)
+        ctx = mk_context(n=6, market_regime=np.int32(MarketRegimeCode.RANGE))
+        rows = [
+            SymbolModel(id="AUSDT", futures_leverage=1),
+            SymbolModel(id="BUSDT", futures_leverage=2),
+            SymbolModel(id="CUSDT", futures_leverage=1),
+        ]
+        out = cal.calibrate_all(ctx, reg, rows)
+        # RANGE -> target 2x; A and C change, B already 2x
+        assert out["applied"] == 2
+        assert out["no_change"] == 1
+        assert rows[0].futures_leverage == 2
+
+    def test_defensive_regime_forces_1x(self):
+        cal = LeverageCalibrator(
+            BinbotApi("http://f", session=FakeSession()), "kucoin"
+        )
+        assert cal.target_leverage(10.0, 0.01, int(MarketRegimeCode.HIGH_STRESS), 0.1, 1.0) == 1
+        assert cal.target_leverage(10.0, 0.01, int(MarketRegimeCode.TREND_UP), 0.1, 1.0) == 3
+        assert cal.target_leverage(10.0, 0.05, int(MarketRegimeCode.TREND_UP), 0.1, 1.0) == 1  # spiky
+        assert cal.target_leverage(600.0, 0.01, int(MarketRegimeCode.TREND_UP), 0.1, 1.0) == 1  # expensive
+        assert cal.target_leverage(10.0, 0.01, int(MarketRegimeCode.RANGE), 0.8, 1.0) == 1  # stressed

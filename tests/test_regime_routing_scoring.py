@@ -35,30 +35,31 @@ from binquant_tpu.schemas import MarketBreadthSeries
 S = 6
 
 
-def mk_features(**over):
+def mk_features(n=S, **over):
+    S_ = n
     base = dict(
-        valid=np.ones(S, dtype=bool),
-        timestamp=np.full(S, 1000, np.int32),
-        close=np.full(S, 10.0, np.float32),
-        return_pct=np.zeros(S, np.float32),
-        ema20=np.full(S, 10.0, np.float32),
-        ema50=np.full(S, 10.0, np.float32),
-        above_ema20=np.ones(S, dtype=bool),
-        above_ema50=np.ones(S, dtype=bool),
-        trend_score=np.zeros(S, np.float32),
-        relative_strength_vs_btc=np.zeros(S, np.float32),
-        atr_pct=np.full(S, 0.01, np.float32),
-        bb_width=np.full(S, 0.03, np.float32),
-        micro_regime=np.full(S, int(MicroRegimeCode.RANGE), np.int32),
-        micro_regime_strength=np.full(S, 0.6, np.float32),
-        micro_transition=np.full(S, -1, np.int32),
-        micro_transition_strength=np.zeros(S, np.float32),
+        valid=np.ones(S_, dtype=bool),
+        timestamp=np.full(S_, 1000, np.int32),
+        close=np.full(S_, 10.0, np.float32),
+        return_pct=np.zeros(S_, np.float32),
+        ema20=np.full(S_, 10.0, np.float32),
+        ema50=np.full(S_, 10.0, np.float32),
+        above_ema20=np.ones(S_, dtype=bool),
+        above_ema50=np.ones(S_, dtype=bool),
+        trend_score=np.zeros(S_, np.float32),
+        relative_strength_vs_btc=np.zeros(S_, np.float32),
+        atr_pct=np.full(S_, 0.01, np.float32),
+        bb_width=np.full(S_, 0.03, np.float32),
+        micro_regime=np.full(S_, int(MicroRegimeCode.RANGE), np.int32),
+        micro_regime_strength=np.full(S_, 0.6, np.float32),
+        micro_transition=np.full(S_, -1, np.int32),
+        micro_transition_strength=np.zeros(S_, np.float32),
     )
     base.update(over)
     return SymbolFeatureArrays(**{k: jnp.asarray(v) for k, v in base.items()})
 
 
-def mk_context(**over):
+def mk_context(n=S, **over):
     ts = 100_000
     base = dict(
         valid=True,
@@ -95,7 +96,7 @@ def mk_context(**over):
         stress_regime_score=0.1,
         regime_is_transitioning=False,
         regime_stable_since=np.int32(ts - DEFAULT_REGIME_STABILITY_S - 10),
-        features=mk_features(),
+        features=mk_features(n),
     )
     base.update(over)
     conv = {

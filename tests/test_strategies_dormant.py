@@ -1,0 +1,474 @@
+"""Spike detector oracle parity + dormant strategy kernels.
+
+Oracle re-derives the reference detector pipeline
+(spike_hunter_v3_kucoin.py:187-502) in pandas; routing/kernels exercised via
+crafted contexts (mirrors the reference's per-strategy test files).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from binquant_tpu.enums import Direction, MarketRegimeCode, MicroRegimeCode
+from binquant_tpu.strategies import (
+    BinanceAIReport,
+    MarketRegimeNotifier,
+    SpikeParams,
+    bb_extreme_reversion,
+    buy_low_sell_high,
+    buy_the_dip,
+    compute_feature_pack,
+    detect_spikes,
+    inverse_price_tracker,
+    range_bb_rsi_mean_reversion,
+    range_failed_breakout_fade,
+    relative_strength_reversal_range,
+    spike_hunter,
+    supertrend_swing_reversal,
+    twap_momentum_sniper,
+)
+from binquant_tpu.strategies.dormant import BBXParams
+from tests.conftest import make_ohlcv
+from tests.test_regime_routing_scoring import mk_context, mk_features
+from tests.test_strategies_live import S_CAP, WINDOW, fill_buffer, random_frames
+
+
+def spike_oracle_last(df: pd.DataFrame, p: SpikeParams) -> dict:
+    """Reference detect() pipeline at the last bar (l.218-502)."""
+    c, o, v = (df[k].astype(float) for k in ("close", "open", "volume"))
+    pc = c.pct_change()
+    pca = pc.abs()
+    vma = v.rolling(p.base_window).mean()
+    vr = v / (vma + 1e-6)
+
+    # auto_calibrate (l.187-215)
+    vols, pcs = vr.dropna(), pca.dropna()
+    vol_thr = max(p.calib_min_volume_ratio, float(np.quantile(vols, p.calib_volume_quantile)))
+    price_floor = max(
+        p.price_break_base_threshold,
+        max(p.calib_min_price_abs_floor, float(np.quantile(pcs, p.calib_price_floor_quantile))),
+    )
+
+    cond = vr >= vol_thr
+    count = cond.rolling(p.volume_cluster_window, min_periods=1).sum()
+    base_flag = (count >= p.volume_cluster_min_count) & cond
+    vc = bool(base_flag.iloc[-1])  # live edge: "last" mode == base flag
+
+    dyn = pca.rolling(60, min_periods=20).quantile(p.price_break_dynamic_q)
+    thr = pd.Series(np.maximum(price_floor, dyn), index=df.index).ffill()
+    pb = bool((pca >= thr).iloc[-1])
+
+    w = p.cumulative_price_window
+    cum_pos = pc.clip(lower=0).rolling(w).sum()
+    cum_neg = pc.clip(upper=0).abs().rolling(w).sum()
+    vol_cond = (vr >= vol_thr * 0.8).rolling(w).max().astype(bool)
+    cum = bool(((cum_pos >= p.cumulative_price_threshold) & vol_cond).iloc[-1])
+    cum_s = bool(((cum_neg >= p.cumulative_price_threshold) & vol_cond).iloc[-1])
+
+    vd = vr - vr.shift(p.accel_volume_deriv_window)
+    accel_base = (vd >= p.accel_volume_deriv_min) & (pca >= p.accel_price_change_min)
+    accel = bool((accel_base & (pc > 0)).fillna(False).iloc[-1])
+    accel_s = bool((accel_base & (pc < 0)).fillna(False).iloc[-1])
+
+    bullish = bool((c > o).iloc[-1])
+    bearish = bool((c < o).iloc[-1])
+    base_combo = vc or pb
+    label = (base_combo or cum or accel) and bullish
+    label_short = (base_combo or cum_s or accel_s) and bearish
+    upward = bool(((c > o).astype(int).rolling(3).sum() >= 3).iloc[-1])
+    downward = bool(((c < o).astype(int).rolling(3).sum() >= 3).iloc[-1])
+    return dict(
+        label=label, label_short=label_short, volume_cluster_flag=vc,
+        price_break_flag=pb, cumulative_price_break_flag=cum,
+        accel_spike_flag=accel, upward=upward, downward=downward,
+        vol_thr=vol_thr, price_floor=price_floor,
+    )
+
+
+class TestSpikeDetector:
+    def test_oracle_parity_random_batch(self):
+        rng = np.random.default_rng(103)
+        p = SpikeParams()
+        frames = random_frames(rng, n_rows=10, vol=0.025)
+        # craft spikes on some rows: 3 green candles + volume blast
+        for i in (2, 6):
+            df = frames[i]
+            for j in range(3):
+                k = len(df) - 3 + j
+                prev = df["close"].iloc[k - 1]
+                df.loc[df.index[k], "open"] = prev
+                df.loc[df.index[k], "close"] = prev * (1.02 + 0.01 * j)
+                df.loc[df.index[k], "high"] = prev * 1.04
+                df.loc[df.index[k], "low"] = prev * 0.999
+                df.loc[df.index[k], "volume"] = df["volume"].iloc[:k].mean() * (3 + 2 * j)
+        buf = fill_buffer(frames)
+        sig = detect_spikes(buf, p)
+        for i, df in frames.items():
+            want = spike_oracle_last(df, p)
+            for key in ("label", "label_short", "volume_cluster_flag",
+                        "cumulative_price_break_flag", "accel_spike_flag",
+                        "upward", "downward"):
+                got = bool(getattr(sig, key)[i])
+                assert got == want[key], f"row {i} {key}: kernel {got} oracle {want[key]}"
+            np.testing.assert_allclose(
+                float(sig.volume_ratio_threshold[i]), want["vol_thr"], rtol=1e-3
+            )
+
+    def test_spike_hunter_routing(self):
+        rng = np.random.default_rng(107)
+        frames = random_frames(rng, n_rows=1, vol=0.025)
+        df = frames[0]
+        for j in range(3):
+            k = len(df) - 3 + j
+            prev = df["close"].iloc[k - 1]
+            df.loc[df.index[k], "open"] = prev
+            df.loc[df.index[k], "close"] = prev * 1.03
+            df.loc[df.index[k], "high"] = prev * 1.035
+            df.loc[df.index[k], "low"] = prev * 0.999
+            df.loc[df.index[k], "volume"] = df["volume"].iloc[:k].mean() * 6
+        buf = fill_buffer(frames)
+        sig = detect_spikes(buf)
+        assert bool(sig.label[0]) and bool(sig.upward[0])
+        ctx = mk_context(n=S_CAP, market_stress_score=0.1)
+        out = spike_hunter(sig, ctx, jnp.asarray(2.0))  # breadth momentum up
+        assert bool(out.trigger[0])
+        assert int(out.direction[0]) == int(Direction.LONG)
+        # flat momentum -> no trade
+        out2 = spike_hunter(sig, ctx, jnp.asarray(0.0))
+        assert not bool(out2.trigger[0])
+        # stress kills it
+        out3 = spike_hunter(sig, mk_context(n=S_CAP, market_stress_score=0.5), jnp.asarray(2.0))
+        assert not bool(out3.trigger[0])
+
+
+class TestRangeFailedBreakoutFade:
+    def test_fades_spike_in_weak_range(self):
+        rng = np.random.default_rng(109)
+        frames = random_frames(rng, n_rows=1, vol=0.025)
+        df = frames[0]
+        for j in range(3):
+            k = len(df) - 3 + j
+            prev = df["close"].iloc[k - 1]
+            df.loc[df.index[k], "open"] = prev
+            df.loc[df.index[k], "close"] = prev * 1.03
+            df.loc[df.index[k], "high"] = prev * 1.035
+            df.loc[df.index[k], "low"] = prev * 0.999
+            df.loc[df.index[k], "volume"] = df["volume"].iloc[:k].mean() * 6
+        buf = fill_buffer(frames)
+        sig = detect_spikes(buf)
+        rs = np.full(S_CAP, 0.01, np.float32)
+        ctx = mk_context(
+            n=S_CAP,
+            average_return=-0.01,
+            features=mk_features(n=S_CAP, relative_strength_vs_btc=rs),
+        )
+        out = range_failed_breakout_fade(sig, ctx)
+        assert bool(out.trigger[0])
+        assert int(out.direction[0]) == int(Direction.SHORT)
+        # market rallying -> no fade
+        ctx2 = mk_context(n=S_CAP, average_return=0.01)
+        assert not bool(range_failed_breakout_fade(sig, ctx2).trigger[0])
+        # underperformer -> no fade
+        ctx3 = mk_context(
+            n=S_CAP,
+            average_return=-0.01,
+            features=mk_features(n=S_CAP, relative_strength_vs_btc=np.full(S_CAP, -0.01, np.float32)),
+        )
+        assert not bool(range_failed_breakout_fade(sig, ctx3).trigger[0])
+
+
+class TestCoinruleRules:
+    def test_twap_momentum_sniper(self):
+        rng = np.random.default_rng(113)
+        # declining price -> TWAP above current close
+        frames = {0: pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.003, drift=-0.003))}
+        buf15 = fill_buffer(frames)
+        pack5 = compute_feature_pack(buf15)  # reuse as the 5m pack
+        out = twap_momentum_sniper(buf15, pack5)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])  # manual_only
+        assert float(out.diagnostics["twap"][0]) > float(pack5.close[0])
+
+    def test_supertrend_swing_reversal_gates(self):
+        rng = np.random.default_rng(127)
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.003, drift=-0.004))
+        # sharp reversal up at the end to flip supertrend while RSI still low
+        for j in range(6):
+            k = len(df) - 6 + j
+            prev = df["close"].iloc[k - 1]
+            df.loc[df.index[k], "open"] = prev
+            df.loc[df.index[k], "close"] = prev * 1.012
+            df.loc[df.index[k], "high"] = prev * 1.014
+            df.loc[df.index[k], "low"] = prev * 0.999
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        gate = jnp.ones((S_CAP,), dtype=bool)
+        ctx = mk_context(n=S_CAP)
+        out = supertrend_swing_reversal(
+            buf, pack, ctx, gate,
+            jnp.asarray(0.1), jnp.asarray(0.05), jnp.asarray(True),
+        )
+        # RSI may have recovered above 30 after the bounce; condition-check
+        if float(pack.rsi[0]) < 30 and bool(out.diagnostics["supertrend_up"][0]):
+            assert bool(out.trigger[0])
+        # falling ADP blocks regardless
+        out2 = supertrend_swing_reversal(
+            buf, pack, ctx, gate,
+            jnp.asarray(-0.1), jnp.asarray(0.05), jnp.asarray(True),
+        )
+        assert not bool(out2.trigger[0])
+
+    def test_buy_low_sell_high(self):
+        rng = np.random.default_rng(131)
+        # dip below-ish but above MA25: downtrend then stabilize
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.003, drift=-0.004))
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        fired_expected = (
+            float(pack.rsi[0]) < 35
+            and float(pack.close[0]) > float(np.asarray(
+                pd.Series(df["close"]).rolling(25, min_periods=1).mean().iloc[-1]
+            ))
+        )
+        out = buy_low_sell_high(buf, pack, jnp.asarray(True))
+        assert bool(out.trigger[0]) == fired_expected
+        out2 = buy_low_sell_high(buf, pack, jnp.asarray(False))
+        assert not bool(out2.trigger[0])
+
+
+class TestBuyTheDip:
+    def craft_dip(self, rng):
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.002, drift=0.0))
+        # 6h (24 bars) ago reference, dip ~3%, then reclaim
+        ref = float(df["close"].iloc[-25])
+        target = ref * 0.97
+        for j in range(8):
+            k = len(df) - 8 + j
+            df.loc[df.index[k], "close"] = target * (1 - 0.002 * (8 - j))
+            df.loc[df.index[k], "open"] = df["close"].iloc[k] * 1.001
+            df.loc[df.index[k], "high"] = df["close"].iloc[k] * 1.002
+            df.loc[df.index[k], "low"] = df["close"].iloc[k] * 0.998
+        # last bar: green reclaim above prev close (and hopefully ema20)
+        prev = float(df["close"].iloc[-2])
+        df.loc[df.index[-1], "open"] = prev
+        df.loc[df.index[-1], "close"] = prev * 1.006
+        df.loc[df.index[-1], "high"] = prev * 1.007
+        df.loc[df.index[-1], "low"] = prev * 0.999
+        return df
+
+    def test_dip_reclaim_fires(self):
+        rng = np.random.default_rng(137)
+        df = self.craft_dip(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        ctx = mk_context(n=S_CAP)  # RANGE market, RANGE micro
+        out = buy_the_dip(buf, pack, ctx, jnp.asarray(False))
+        change = float(out.diagnostics["change_6h"][0])
+        if -5.0 < change <= -2.0:
+            ema20 = float(
+                pd.Series(df["close"]).ewm(span=20, adjust=False, min_periods=1).mean().iloc[-1]
+            )
+            reclaims = float(df["close"].iloc[-1]) > max(float(df["close"].iloc[-2]), ema20)
+            assert bool(out.trigger[0]) == reclaims
+            if reclaims:
+                assert bool(out.autotrade[0])
+        # trend market blocks entry entirely
+        ctx2 = mk_context(n=S_CAP, market_regime=np.int32(MarketRegimeCode.TREND_UP))
+        assert not bool(buy_the_dip(buf, pack, ctx2, jnp.asarray(False)).trigger[0])
+
+    def test_quiet_hours_flips_autotrade(self):
+        rng = np.random.default_rng(139)
+        df = self.craft_dip(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        ctx = mk_context(n=S_CAP)
+        out = buy_the_dip(buf, pack, ctx, jnp.asarray(True))
+        if bool(out.trigger[0]):
+            assert not bool(out.autotrade[0])
+
+
+class TestBBExtremeReversion:
+    def test_disabled_by_default(self):
+        rng = np.random.default_rng(149)
+        buf = fill_buffer(random_frames(rng, n_rows=1))
+        pack = compute_feature_pack(buf)
+        out = bb_extreme_reversion(buf, pack, mk_context(n=S_CAP))
+        assert not np.asarray(out.trigger).any()
+
+    def test_enabled_oversold_extreme(self):
+        rng = np.random.default_rng(151)
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.002, drift=0.0))
+        # two hard down bars -> RSI(2)=0 and close below lower band
+        for j, pct in ((2, 0.97), (1, 0.94)):
+            k = len(df) - j
+            prev = float(df["close"].iloc[k - 1])
+            df.loc[df.index[k], "open"] = prev
+            df.loc[df.index[k], "close"] = prev * pct
+            df.loc[df.index[k], "high"] = prev * 1.001
+            df.loc[df.index[k], "low"] = prev * pct * 0.999
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        params = BBXParams(enabled=True)
+        out = bb_extreme_reversion(buf, pack, mk_context(n=S_CAP), params)
+        below_band = float(pack.close[0]) <= float(pack.bb_lower[0])
+        assert bool(out.trigger[0]) == below_band
+        if below_band:
+            assert int(out.direction[0]) == int(Direction.LONG)
+            assert float(out.diagnostics["rsi2"][0]) <= 5.0
+
+
+class TestInversePriceTracker:
+    def test_routes_to_trend_up_market(self):
+        from tests.test_strategies_live import craft_oversold
+
+        rng = np.random.default_rng(157)
+        df = craft_oversold(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        micro = np.full(S_CAP, int(MicroRegimeCode.TREND_UP), np.int32)
+        ctx = mk_context(
+            n=S_CAP,
+            market_regime=np.int32(MarketRegimeCode.TREND_UP),
+            btc_regime_score=0.3,
+            long_tailwind=0.3,
+            features=mk_features(n=S_CAP, micro_regime=micro),
+        )
+        out = inverse_price_tracker(pack, ctx)
+        if bool(out.trigger[0]):
+            assert not bool(out.autotrade[0])  # telemetry-only
+        # RANGE market without a leader blocks
+        ctx2 = mk_context(n=S_CAP)
+        out2 = inverse_price_tracker(pack, ctx2)
+        assert not bool(out2.trigger[0])
+
+
+class TestRangeBbRsi:
+    def test_long_rejection_setup(self):
+        rng = np.random.default_rng(163)
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.002, drift=0.0))
+        # hammer at the lower band: deep low, close back up in upper part
+        k = len(df) - 1
+        mid = pd.Series(df["close"]).rolling(20).mean().iloc[-2]
+        std = pd.Series(df["close"]).rolling(20).std(ddof=0).iloc[-2]
+        bb_low_approx = mid - 2 * std
+        o = bb_low_approx * 1.001
+        df.loc[df.index[k], "open"] = o
+        df.loc[df.index[k], "close"] = o * 1.003
+        df.loc[df.index[k], "high"] = o * 1.004
+        df.loc[df.index[k], "low"] = o * 0.985  # long lower wick
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        ctx = mk_context(n=S_CAP)  # RANGE x RANGE
+        out = range_bb_rsi_mean_reversion(buf, pack, ctx, )
+        # conditional: this is a statistical craft; assert internal consistency
+        if bool(out.trigger[0]):
+            assert int(out.direction[0]) == int(Direction.LONG)
+            assert float(out.diagnostics["adx"][0]) <= 32.0
+            assert float(out.diagnostics["zscore"][0]) <= -2.0
+
+    def test_non_range_blocks(self):
+        rng = np.random.default_rng(167)
+        buf = fill_buffer(random_frames(rng, n_rows=1))
+        pack = compute_feature_pack(buf)
+        ctx = mk_context(n=S_CAP, market_regime=np.int32(MarketRegimeCode.TREND_UP))
+        out = range_bb_rsi_mean_reversion(buf, pack, ctx)
+        assert not bool(out.trigger[0])
+
+
+class TestRelativeStrengthReversal:
+    def test_leader_in_selloff(self):
+        rng = np.random.default_rng(173)
+        frames = random_frames(rng, n_rows=1, n=WINDOW)
+        buf = fill_buffer(frames)
+        pack = compute_feature_pack(buf)
+        rs = np.full(S_CAP, 0.08, np.float32)
+        ctx = mk_context(
+            n=S_CAP,
+            average_return=-0.03,
+            features=mk_features(n=S_CAP, relative_strength_vs_btc=rs),
+        )
+        out = relative_strength_reversal_range(buf, pack, ctx)
+        # window=150 >= 96 bars, volume above its 20th pct almost surely
+        vol_floor = float(out.diagnostics["volume_floor"][0])
+        want = float(pack.volume[0]) > vol_floor
+        assert bool(out.trigger[0]) == want
+        if want:
+            assert not bool(out.autotrade[0])  # telemetry-only
+        # weak RS blocks
+        ctx2 = mk_context(n=S_CAP, average_return=-0.03)
+        assert not bool(relative_strength_reversal_range(buf, pack, ctx2).trigger[0])
+
+
+class TestBinanceAIReport:
+    def _report(self, texts, opp=3, risk=1, update_age_min=10):
+        import time as _t
+
+        now = _t.time() * 1000
+        modules = [
+            {"type": "opportunities", "points": [{"content": t} for t in texts[:opp]]},
+            {"type": "risks", "points": [{"content": "risk"} for _ in range(risk)]},
+            {
+                "type": "community_sentiment",
+                "points": [
+                    {"content": "chatter", "citationRefs": [{"type": "post", "count": 12}]}
+                ],
+            },
+        ]
+        return {
+            "data": {
+                "report": {
+                    "original": {
+                        "reportMeta": {"updateAt": int(now - update_age_min * 60000)},
+                        "modules": modules,
+                    }
+                }
+            }
+        }
+
+    def test_bullish_final_report(self):
+        texts = ["macd bullish crossover", "institutional adoption rising", "strong resilience"]
+        rep = self._report(texts, opp=3, risk=0)
+        ai = BinanceAIReport("BTCUSDT", "BTC", fetch=lambda s, t: rep)
+        feats = ai.extract_features()
+        assert feats["macd_bullish_flag"] == 1
+        assert feats["net_signal_score"] == 3
+        assert feats["large_discussion_flag"] == 1
+        assert ai.final_report() == 1
+        assert ai.ai_report_signal() is not None
+
+    def test_stale_report_only_base_fields(self):
+        rep = self._report(["macd"], update_age_min=10_000)
+        ai = BinanceAIReport("BTCUSDT", "BTC", fetch=lambda s, t: rep)
+        feats = ai.extract_features()
+        assert feats["external_stale_flag"] == 1
+        assert "opp_count" not in feats
+        assert ai.final_report() == 0
+
+    def test_unavailable(self):
+        ai = BinanceAIReport("BTCUSDT", "BTC", fetch=lambda s, t: None)
+        assert ai.extract_features() is None
+        assert ai.final_report() == 0
+
+
+class TestMarketRegimeNotifier:
+    def test_emits_once_per_transition(self):
+        from binquant_tpu.enums import MarketTransitionCode
+
+        notifier = MarketRegimeNotifier(env="test")
+        ctx = mk_context(
+            n=S_CAP,
+            market_regime=np.int32(MarketRegimeCode.HIGH_STRESS),
+            previous_market_regime=np.int32(MarketRegimeCode.RANGE),
+            market_regime_transition=np.int32(MarketTransitionCode.STRESS_SPIKE),
+            market_regime_transition_strength=0.8,
+        )
+        msg = notifier.build_message(ctx)
+        assert msg is not None
+        assert "#market_regime_transition" in msg
+        assert "STRESS_SPIKE" in msg
+        assert "RANGE -> HIGH_STRESS" in msg
+        # same transition again -> deduped
+        assert notifier.build_message(ctx) is None
+        # no transition -> nothing
+        assert notifier.build_message(mk_context(n=S_CAP)) is None

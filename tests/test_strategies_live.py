@@ -1,0 +1,586 @@
+"""Live strategy kernels vs pandas oracles + crafted scenarios.
+
+Oracles re-derive the reference pipelines (activity_burst_pump.py:51-158,
+mean_reversion_fade.py:102-135, liquidation_sweep_pump.py:110-180) in pandas
+on the same data the kernels see, then last-bar verdicts are compared across
+a randomized symbol batch.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from binquant_tpu.engine import Field, apply_updates, empty_buffer
+from binquant_tpu.enums import Direction, MicroRegimeCode, MicroTransitionCode
+from binquant_tpu.strategies import (
+    ABPParams,
+    activity_burst_pump,
+    compute_feature_pack,
+    ladder_deployer,
+    liquidation_sweep_pump,
+    mean_reversion_fade,
+    price_tracker,
+)
+from binquant_tpu.strategies.liquidation_sweep_pump import (
+    ROUTE_ADP_NOT_EXTREME,
+    ROUTE_LONG,
+    ROUTE_SHORT,
+)
+from binquant_tpu.strategies.price_tracker import (
+    ROUTE_NOT_RANGE,
+    ROUTE_QUIET_HOURS,
+    ROUTE_RS_INSUFFICIENT,
+    ROUTE_SYMBOL_RANGE,
+)
+from tests.conftest import make_ohlcv
+from tests.test_regime_routing_scoring import mk_context, mk_features
+
+S_CAP = 16
+WINDOW = 150
+
+
+def fill_buffer(frames: dict[int, pd.DataFrame], window=WINDOW, cap=S_CAP):
+    """Load row->DataFrame into a buffer (timestamps aligned per row)."""
+    buf = empty_buffer(cap, window=window)
+    n = max(len(df) for df in frames.values())
+    for b in range(n):
+        idx, tss, vals = [], [], []
+        for row, df in frames.items():
+            if b >= len(df):
+                continue
+            r = df.iloc[b]
+            v = np.zeros(len(Field), dtype=np.float32)
+            v[Field.OPEN], v[Field.HIGH] = r["open"], r["high"]
+            v[Field.LOW], v[Field.CLOSE] = r["low"], r["close"]
+            v[Field.VOLUME] = r["volume"]
+            v[Field.QUOTE_VOLUME] = r.get("quote_asset_volume", r["volume"] * r["close"])
+            v[Field.NUM_TRADES] = r.get("number_of_trades", 100)
+            v[Field.DURATION_S] = 900
+            idx.append(row)
+            tss.append(int(r["open_time"]) // 1000)
+            vals.append(v)
+        buf = apply_updates(
+            buf, np.array(idx, np.int32), np.array(tss, np.int32), np.stack(vals)
+        )
+    return buf
+
+
+def random_frames(rng, n_rows=10, n=WINDOW, vol=0.02):
+    return {
+        i: pd.DataFrame(make_ohlcv(rng, n=n, start_price=20 + i, vol=vol))
+        for i in range(n_rows)
+    }
+
+
+# ---------------------------------------------------------------------------
+# FeaturePack parity
+# ---------------------------------------------------------------------------
+
+
+class TestFeaturePack:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        rng = np.random.default_rng(31)
+        frames = random_frames(rng)
+        buf = fill_buffer(frames)
+        pack = compute_feature_pack(buf)
+        return frames, pack
+
+    def test_rsi_variants(self, setup):
+        frames, pack = setup
+        for i, df in frames.items():
+            closes = df["close"].astype(float)
+            delta = closes.diff()
+            gain, loss = delta.clip(lower=0), -delta.clip(upper=0)
+            ag = gain.ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+            al = loss.ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+            wilder = float((100 * ag / (ag + al)).where((ag + al) != 0, 50.0).iloc[-1])
+            np.testing.assert_allclose(float(pack.rsi_wilder[i]), wilder, rtol=1e-3)
+            ags = gain.rolling(14).mean()
+            als = loss.rolling(14).mean()
+            sma = float((100 * ags / (ags + als)).where((ags + als) != 0, 50.0).iloc[-1])
+            np.testing.assert_allclose(float(pack.rsi[i]), sma, rtol=1e-3)
+
+    def test_macd_and_signal(self, setup):
+        frames, pack = setup
+        for i, df in frames.items():
+            closes = df["close"].astype(float)
+            line = (
+                closes.ewm(span=12, adjust=False).mean()
+                - closes.ewm(span=26, adjust=False).mean()
+            )
+            sig = line.ewm(span=9, adjust=False).mean()
+            np.testing.assert_allclose(float(pack.macd[i]), float(line.iloc[-1]), rtol=1e-3, atol=1e-5)
+            np.testing.assert_allclose(float(pack.macd_signal[i]), float(sig.iloc[-1]), rtol=1e-3, atol=1e-5)
+
+    def test_mfi_bb_atr_vol(self, setup):
+        frames, pack = setup
+        for i, df in frames.items():
+            h, l, c, v = (df[k].astype(float) for k in ("high", "low", "close", "volume"))
+            tp = (h + l + c) / 3
+            flow = tp * v
+            d = tp.diff()
+            pos = flow.where(d > 0, 0.0).rolling(14).sum()
+            neg = flow.where(d < 0, 0.0).rolling(14).sum()
+            mfi = float((100 * pos / (pos + neg)).where((pos + neg) != 0, 50.0).iloc[-1])
+            np.testing.assert_allclose(float(pack.mfi[i]), mfi, rtol=1e-3)
+
+            mid = c.rolling(20).mean()
+            std = c.rolling(20).std(ddof=0)
+            np.testing.assert_allclose(float(pack.bb_upper[i]), float((mid + 2 * std).iloc[-1]), rtol=1e-4)
+            np.testing.assert_allclose(float(pack.bb_lower[i]), float((mid - 2 * std).iloc[-1]), rtol=1e-4)
+
+            pc = c.shift(1)
+            tr = pd.concat([h - l, (h - pc).abs(), (l - pc).abs()], axis=1).max(axis=1)
+            atr = tr.rolling(14).mean()
+            np.testing.assert_allclose(float(pack.atr[i]), float(atr.iloc[-1]), rtol=1e-3)
+            np.testing.assert_allclose(float(pack.atr_ma[i]), float(atr.rolling(20).mean().iloc[-1]), rtol=1e-3)
+            np.testing.assert_allclose(float(pack.volume_ma[i]), float(v.rolling(20).mean().iloc[-1]), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# ActivityBurstPump: full pandas-oracle parity over a random batch
+# ---------------------------------------------------------------------------
+
+
+def abp_oracle_last(df: pd.DataFrame, p: ABPParams) -> tuple[bool, float]:
+    """Reference compute_indicators (activity_burst_pump.py:51-158), last row."""
+    bw = max(p.lookback_window, 2)
+    v, qv = df["volume"].astype(float), df["quote_asset_volume"].astype(float)
+    baseline = v.shift(2).rolling(bw - 1, min_periods=bw - 1).median()
+    baseline_safe = baseline.clip(lower=p.min_baseline_volume)
+    vr = v / baseline_safe
+    qbaseline = qv.shift(2).rolling(bw - 1, min_periods=bw - 1).median()
+    qbaseline_safe = qbaseline.clip(lower=p.min_baseline_volume)
+    qr = qv / qbaseline_safe
+    c, o, h, lo = (df[k].astype(float) for k in ("close", "open", "high", "low"))
+    prev_close = c.shift(1).clip(lower=p.min_baseline_volume)
+    rng_ = (h - lo).clip(lower=p.min_baseline_volume)
+    body = (c - o).abs()
+    jump = (c - c.shift(1)) / prev_close
+    range_frac = rng_ / c.clip(lower=p.min_baseline_volume)
+    body_frac = body / rng_
+    cth = (h - c) / rng_
+    bullish = c > o
+    up3 = (c > c.shift(1)).rolling(3).sum()
+    score = vr * qr * jump.clip(lower=0) * (1 + body_frac)
+    thr = score.shift(1).rolling(p.score_lookback, min_periods=p.lookback_window).quantile(p.score_quantile)
+    raw = (
+        (v > p.volume_multiplier * baseline_safe)
+        & (qv > p.quote_volume_multiplier * qbaseline_safe)
+        & (jump > p.price_threshold)
+        & (range_frac > p.min_range_frac)
+        & (bullish & (body_frac > p.min_body_frac) & (cth < p.max_close_to_high))
+        & (up3 >= p.min_recent_up_closes)
+        & (score >= thr.fillna(0))
+    )
+    recent = raw.shift(1).rolling(p.cooldown_bars, min_periods=1).max().fillna(False).astype(bool)
+    qualified = raw & ~recent
+    return bool(qualified.iloc[-1]), float(score.iloc[-1])
+
+
+def inject_burst(df: pd.DataFrame, at: int = -1, fix_trend: bool = True) -> pd.DataFrame:
+    """Append/replace a bar that satisfies every burst condition."""
+    df = df.copy()
+    i = len(df) + at if at < 0 else at
+    prev_close = df["close"].iloc[i - 1]
+    o = prev_close
+    c = prev_close * 1.03  # 3% jump
+    h = c * 1.003  # close near high
+    lo = o * 0.995
+    v = df["volume"].iloc[max(0, i - 21):i - 1].median() * 4
+    for col, val in (("open", o), ("close", c), ("high", h), ("low", lo), ("volume", v)):
+        df.loc[df.index[i], col] = val
+    df.loc[df.index[i], "quote_asset_volume"] = v * c
+    if fix_trend:
+        # two prior up-closes for the trend flag
+        df.loc[df.index[i - 1], "close"] = df["close"].iloc[i - 2] * 1.001
+    return df
+
+
+class TestActivityBurstPump:
+    def test_oracle_parity_random_batch(self):
+        rng = np.random.default_rng(41)
+        p = ABPParams()
+        frames = random_frames(rng, n_rows=12, vol=0.03)
+        # make some rows bursty so both verdicts appear
+        for i in (2, 5, 9):
+            frames[i] = inject_burst(frames[i])
+        buf = fill_buffer(frames)
+        ctx = mk_context(n=S_CAP, valid=False)  # no context -> emit allowed, autotrade off
+        out = activity_burst_pump(buf, ctx, p)
+        for i, df in frames.items():
+            want, want_score = abp_oracle_last(df, p)
+            got = bool(out.trigger[i])
+            assert got == want, f"row {i}: kernel {got} oracle {want}"
+            if want:
+                np.testing.assert_allclose(float(out.score[i]), want_score, rtol=1e-3)
+                assert not bool(out.autotrade[i])  # no context
+
+    def test_context_gate(self):
+        rng = np.random.default_rng(43)
+        frames = {0: inject_burst(pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.03)))}
+        buf = fill_buffer(frames)
+        # valid context, gate open (RANGE regime, stable) -> autotrade on
+        micro = np.full(S_CAP, int(MicroRegimeCode.RANGE), np.int32)
+        ctx_open = mk_context(n=S_CAP, features=mk_features(n=S_CAP, micro_regime=micro))
+        out = activity_burst_pump(buf, ctx_open)
+        assert bool(out.trigger[0]) and bool(out.autotrade[0])
+        # valid context, gate closed (transitioning) -> suppressed entirely
+        ctx_closed = mk_context(n=S_CAP, regime_is_transitioning=True)
+        out2 = activity_burst_pump(buf, ctx_closed)
+        assert not bool(out2.trigger[0])
+
+    def test_cooldown_after_recent_raw_signal(self):
+        rng = np.random.default_rng(47)
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.03))
+        df = inject_burst(df, at=-2)  # burst on the PREVIOUS bar
+        # burst again on the last bar WITHOUT rewriting bar -2 (the previous
+        # burst already closed up, satisfying the trend flag)
+        df = inject_burst(df, at=-1, fix_trend=False)
+        buf = fill_buffer({0: df})
+        out = activity_burst_pump(buf, mk_context(n=S_CAP, valid=False))
+        want, _ = abp_oracle_last(df, ABPParams())
+        assert bool(out.trigger[0]) == want
+        assert not want  # oracle agrees: cooldown suppresses the second
+
+
+# ---------------------------------------------------------------------------
+# MeanReversionFade
+# ---------------------------------------------------------------------------
+
+
+def craft_mrf_long(rng, n=WINDOW):
+    """Monotonic decline then a green hammer at the lower band."""
+    d = make_ohlcv(rng, n=n, start_price=100, vol=0.004, drift=-0.004)
+    df = pd.DataFrame(d)
+    i = len(df) - 1
+    prev_close = df["close"].iloc[i - 1]
+    o = prev_close * 0.97
+    c = o * 1.004  # green
+    df.loc[df.index[i], "open"] = o
+    df.loc[df.index[i], "close"] = c
+    df.loc[df.index[i], "high"] = c * 1.001
+    df.loc[df.index[i], "low"] = o * 0.998
+    df.loc[df.index[i], "volume"] = df["volume"].iloc[-21:-1].mean() * 2
+    return df
+
+
+class TestMeanReversionFade:
+    def test_long_fire_and_dedupe(self):
+        rng = np.random.default_rng(53)
+        df = craft_mrf_long(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+        out, carry2 = mean_reversion_fade(pack, jnp.asarray(True), carry)
+
+        # oracle setup check
+        closes = df["close"].astype(float)
+        delta = closes.diff()
+        ag = delta.clip(lower=0).ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+        al = (-delta.clip(upper=0)).ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+        rsi = float((100 * ag / (ag + al)).where((ag + al) != 0, 50.0).iloc[-1])
+        mid = closes.rolling(20).mean()
+        std = closes.rolling(20).std(ddof=0)
+        bb_low = float((mid - 2 * std).iloc[-1])
+        want = rsi <= 25 and float(closes.iloc[-1]) <= bb_low
+        assert bool(out.trigger[0]) == want
+        if want:
+            assert int(out.direction[0]) == int(Direction.LONG)
+            assert bool(out.autotrade[0])
+            assert float(out.stop_loss_pct[0]) > 0
+            np.testing.assert_allclose(
+                float(out.score[0]), round(1.0 + max(0.0, (25 - rsi) / 25), 4), rtol=1e-3
+            )
+            # same candle again -> deduped
+            out2, _ = mean_reversion_fade(pack, jnp.asarray(True), carry2)
+            assert not bool(out2.trigger[0])
+
+    def test_futures_gate_and_vetoes(self):
+        rng = np.random.default_rng(59)
+        df = craft_mrf_long(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+        out, _ = mean_reversion_fade(pack, jnp.asarray(False), carry)
+        assert not bool(out.trigger[0])  # spot -> never
+
+        # volume veto: volume below its 20-bar MA
+        df2 = df.copy()
+        df2.loc[df2.index[-1], "volume"] = df2["volume"].iloc[-21:-1].mean() * 0.1
+        pack2 = compute_feature_pack(fill_buffer({0: df2}))
+        out2, _ = mean_reversion_fade(pack2, jnp.asarray(True), carry)
+        assert not bool(out2.trigger[0])
+
+
+# ---------------------------------------------------------------------------
+# LiquidationSweepPump
+# ---------------------------------------------------------------------------
+
+
+def lsp_oracle(df: pd.DataFrame, oi_growth, wh=3):
+    """pump score pipeline (liquidation_sweep_pump.py:110-145,163-180)."""
+    v, c, h, lo = (df[k].astype(float) for k in ("volume", "close", "high", "low"))
+    rel = v / v.rolling(wh * 2).mean().shift(wh)
+    mom = c.pct_change(periods=wh)
+    rf = (h.rolling(wh * 2).max() - lo.rolling(wh * 2).min()) / c
+    oi = 1 + max(0, (oi_growth - 1)) if oi_growth else 1.0
+    ps = rel * (1 + mom) * oi / rf
+    smooth = ps.rolling(2).mean()
+    thr = smooth.iloc[-48:].quantile(0.80)
+    trigger_score = max(float(smooth.iloc[-1]), float(ps.iloc[-1]))
+    return trigger_score >= thr, trigger_score
+
+
+class TestLiquidationSweepPump:
+    def _favorable_context(self):
+        # washed-out breadth recovering + BTC up -> LONG route
+        return mk_context(n=S_CAP, market_stress_score=0.1)
+
+    def test_oracle_score_parity_and_routing(self):
+        rng = np.random.default_rng(61)
+        frames = random_frames(rng, n_rows=10, vol=0.02)
+        # pump the last bars of a few rows
+        for i in (1, 4, 7):
+            df = frames[i]
+            df.loc[df.index[-1], "volume"] = df["volume"].iloc[-10:-4].mean() * 6
+            df.loc[df.index[-1], "close"] = df["close"].iloc[-4] * 1.05
+        buf = fill_buffer(frames)
+        ctx = self._favorable_context()
+        oi = np.full(S_CAP, 1.05, np.float32)
+        out = liquidation_sweep_pump(
+            buf, ctx, jnp.asarray(oi),
+            jnp.asarray(-0.5), jnp.asarray(-0.6),  # washed & increasing
+            jnp.asarray(0.003),  # btc up
+        )
+        for i, df in frames.items():
+            want_fire, want_score = lsp_oracle(df, 1.05)
+            assert bool(out.trigger[i]) == want_fire, f"row {i}"
+            if want_fire:
+                np.testing.assert_allclose(float(out.score[i]), want_score, rtol=1e-2)
+                assert int(out.direction[i]) == int(Direction.LONG)
+                assert int(out.diagnostics["route"][i]) == ROUTE_LONG
+
+    def test_oi_confirmation_blocks(self):
+        rng = np.random.default_rng(67)
+        frames = random_frames(rng, n_rows=2, vol=0.02)
+        df = frames[0]
+        df.loc[df.index[-1], "volume"] = df["volume"].iloc[-10:-4].mean() * 6
+        df.loc[df.index[-1], "close"] = df["close"].iloc[-4] * 1.05
+        buf = fill_buffer(frames)
+        oi = np.full(S_CAP, 1.01, np.float32)  # below 1.02
+        out = liquidation_sweep_pump(
+            buf, self._favorable_context(), jnp.asarray(oi),
+            jnp.asarray(-0.5), jnp.asarray(-0.6), jnp.asarray(0.003),
+        )
+        assert not bool(out.trigger[0])
+
+    def test_short_route_needs_weak_symbol(self):
+        rng = np.random.default_rng(71)
+        frames = random_frames(rng, n_rows=1, vol=0.02)
+        df = frames[0]
+        df.loc[df.index[-1], "volume"] = df["volume"].iloc[-10:-4].mean() * 8
+        df.loc[df.index[-1], "close"] = df["close"].iloc[-4] * 1.06
+        buf = fill_buffer(frames)
+        weak = mk_features(n=S_CAP, 
+            relative_strength_vs_btc=np.full(S_CAP, -0.01, np.float32),
+            trend_score=np.full(S_CAP, -0.01, np.float32),
+            above_ema20=np.zeros(S_CAP, dtype=bool),
+        )
+        ctx = mk_context(n=S_CAP, market_stress_score=0.1, features=weak)
+        out = liquidation_sweep_pump(
+            buf, ctx, jnp.asarray(np.full(S_CAP, 1.05, np.float32)),
+            jnp.asarray(0.5), jnp.asarray(0.6),  # hot & falling
+            jnp.asarray(0.001),  # btc stalled
+        )
+        if bool(out.trigger[0]):
+            assert int(out.direction[0]) == int(Direction.SHORT)
+            assert int(out.diagnostics["route"][0]) == ROUTE_SHORT
+
+    def test_adp_not_extreme_blocks(self):
+        rng = np.random.default_rng(73)
+        frames = random_frames(rng, n_rows=1, vol=0.02)
+        df = frames[0]
+        df.loc[df.index[-1], "volume"] = df["volume"].iloc[-10:-4].mean() * 8
+        df.loc[df.index[-1], "close"] = df["close"].iloc[-4] * 1.06
+        buf = fill_buffer(frames)
+        out = liquidation_sweep_pump(
+            buf, self._favorable_context(),
+            jnp.asarray(np.full(S_CAP, 1.05, np.float32)),
+            jnp.asarray(0.0), jnp.asarray(-0.1), jnp.asarray(0.003),
+        )
+        assert not bool(out.trigger[0])
+        assert int(out.diagnostics["route"][0]) == ROUTE_ADP_NOT_EXTREME
+
+
+# ---------------------------------------------------------------------------
+# PriceTracker
+# ---------------------------------------------------------------------------
+
+
+def craft_oversold(rng, n=WINDOW):
+    """Persistent selloff: RSI pinned low, MACD negative, MFI starved."""
+    d = make_ohlcv(rng, n=n, start_price=100, vol=0.002, drift=-0.006)
+    df = pd.DataFrame(d)
+    # force strictly falling typical price over the last 20 bars so every
+    # money flow is negative -> MFI = 0 deterministically
+    tail = 20
+    base = float(df["close"].iloc[-tail - 1])
+    for j in range(tail):
+        i = len(df) - tail + j
+        c = base * (1 - 0.004 * (j + 1))
+        df.loc[df.index[i], "open"] = c * 1.002
+        df.loc[df.index[i], "close"] = c
+        df.loc[df.index[i], "high"] = c * 1.003
+        df.loc[df.index[i], "low"] = c * 0.998
+    return df
+
+
+class TestPriceTracker:
+    def _range_context(self, rs=0.01):
+        micro = np.full(S_CAP, int(MicroRegimeCode.RANGE), np.int32)
+        return mk_context(n=S_CAP, 
+            features=mk_features(n=S_CAP, 
+                micro_regime=micro,
+                relative_strength_vs_btc=np.full(S_CAP, rs, np.float32),
+            ),
+            advancers_ratio=0.55,
+            long_tailwind=0.1,
+            short_tailwind=-0.05,
+            market_stress_score=0.1,
+        )
+
+    def test_fires_with_autotrade_in_stable_range(self):
+        rng = np.random.default_rng(79)
+        df = craft_oversold(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        assert float(pack.rsi[0]) < 30 and float(pack.macd[0]) < 0
+        if not float(pack.mfi[0]) < 20:
+            pytest.skip("crafted data did not starve MFI")
+        carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+        out, carry2 = price_tracker(
+            pack, self._range_context(), jnp.asarray(False), carry
+        )
+        assert bool(out.trigger[0])
+        assert bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == ROUTE_SYMBOL_RANGE
+        assert float(out.score[0]) > 1.0
+        # cooldown: same close_time again -> suppressed
+        out2, _ = price_tracker(pack, self._range_context(), jnp.asarray(False), carry2)
+        assert not bool(out2.trigger[0])
+
+    def test_routing_blocks_autotrade_but_emits(self):
+        rng = np.random.default_rng(83)
+        df = craft_oversold(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        if not (float(pack.rsi[0]) < 30 and float(pack.mfi[0]) < 20):
+            pytest.skip("crafted data did not reach entry thresholds")
+        carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+        # weak RS -> autotrade denied, signal still emitted
+        out, _ = price_tracker(
+            pack, self._range_context(rs=0.0), jnp.asarray(False), carry
+        )
+        if bool(out.trigger[0]):
+            assert not bool(out.autotrade[0])
+            assert int(out.diagnostics["route"][0]) == ROUTE_RS_INSUFFICIENT
+        # TREND_UP market -> not RANGE
+        micro = np.full(S_CAP, int(MicroRegimeCode.RANGE), np.int32)
+        from binquant_tpu.enums import MarketRegimeCode
+
+        ctx = mk_context(n=S_CAP, 
+            market_regime=np.int32(MarketRegimeCode.TREND_UP),
+            features=mk_features(n=S_CAP, micro_regime=micro),
+            advancers_ratio=0.55,
+            market_stress_score=0.1,
+        )
+        out2, _ = price_tracker(pack, ctx, jnp.asarray(False), carry)
+        if bool(out2.trigger[0]):
+            assert int(out2.diagnostics["route"][0]) == ROUTE_NOT_RANGE
+
+    def test_quiet_hours_flips_autotrade(self):
+        rng = np.random.default_rng(89)
+        df = craft_oversold(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        if not (float(pack.rsi[0]) < 30 and float(pack.mfi[0]) < 20):
+            pytest.skip("crafted data did not reach entry thresholds")
+        carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+        out, _ = price_tracker(pack, self._range_context(), jnp.asarray(True), carry)
+        if bool(out.trigger[0]):
+            assert not bool(out.autotrade[0])
+            assert int(out.diagnostics["route"][0]) == ROUTE_QUIET_HOURS
+
+
+# ---------------------------------------------------------------------------
+# LadderDeployer
+# ---------------------------------------------------------------------------
+
+
+def craft_stable_range(rng, n=WINDOW):
+    """Flat low-vol series: stable BB width, price mid-range."""
+    d = make_ohlcv(rng, n=n, start_price=50, vol=0.004, drift=0.0)
+    return pd.DataFrame(d)
+
+
+class TestLadderDeployer:
+    def _grid_context(self, long_score=0.4):
+        micro = np.full(S_CAP, int(MicroRegimeCode.RANGE), np.int32)
+        return mk_context(n=S_CAP, 
+            features=mk_features(n=S_CAP, micro_regime=micro),
+            long_regime_score=long_score,
+        )
+
+    def test_deploys_in_stable_range(self):
+        rng = np.random.default_rng(97)
+        df = craft_stable_range(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        out = ladder_deployer(
+            pack, self._grid_context(), jnp.asarray(True), jnp.asarray(True)
+        )
+        closes = df["close"].astype(float)
+        mid = closes.rolling(20).mean()
+        std = closes.rolling(20).std(ddof=0)
+        width_pct = float(((mid + 2 * std) - (mid - 2 * std)).iloc[-1] / mid.iloc[-1]) * 100
+        in_range = float((mid - 2 * std).iloc[-1]) < float(closes.iloc[-1]) < float((mid + 2 * std).iloc[-1])
+        expected = 1.5 <= width_pct <= 8.0 and in_range
+        assert bool(out.trigger[0]) == expected
+        if expected:
+            d = out.diagnostics
+            assert float(d["breakout_low"][0]) < float(d["range_low"][0])
+            assert float(d["breakout_high"][0]) > float(d["range_high"][0])
+            assert 0.5 <= float(d["atr_buffer_pct"][0]) <= 4.0
+
+    def test_gates(self):
+        rng = np.random.default_rng(101)
+        df = craft_stable_range(rng)
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        ctx = self._grid_context()
+        base = ladder_deployer(pack, ctx, jnp.asarray(True), jnp.asarray(True))
+        if not bool(base.trigger[0]):
+            pytest.skip("base scenario did not deploy")
+        # grid policy off
+        out = ladder_deployer(pack, ctx, jnp.asarray(False), jnp.asarray(True))
+        assert not bool(out.trigger[0])
+        # spot market
+        out = ladder_deployer(pack, ctx, jnp.asarray(True), jnp.asarray(False))
+        assert not bool(out.trigger[0])
+        # bearish breadth
+        out = ladder_deployer(
+            pack, self._grid_context(long_score=0.1), jnp.asarray(True), jnp.asarray(True)
+        )
+        assert not bool(out.trigger[0])
+        # blocking micro transition
+        trans = np.full(S_CAP, int(MicroTransitionCode.BREAKDOWN), np.int32)
+        micro = np.full(S_CAP, int(MicroRegimeCode.RANGE), np.int32)
+        ctx2 = mk_context(n=S_CAP, 
+            features=mk_features(n=S_CAP, micro_regime=micro, micro_transition=trans),
+            long_regime_score=0.4,
+        )
+        out = ladder_deployer(pack, ctx2, jnp.asarray(True), jnp.asarray(True))
+        assert not bool(out.trigger[0])

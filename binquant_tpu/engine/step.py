@@ -92,6 +92,20 @@ class HostInputs(NamedTuple):
     market_domination_reversal: jnp.ndarray  # bool
 
 
+# The reference's live dispatch set (context_evaluator.py:211-226,369-479;
+# SpikeHunterV3 disabled l.460-469, MarketRegimeNotifier is host-side).
+# Defined here so the device-side wire compaction and the host emission
+# layer share one source of truth.
+LIVE_STRATEGIES: frozenset[str] = frozenset(
+    {
+        "activity_burst_pump",
+        "coinrule_price_tracker",
+        "liquidation_sweep_pump",
+        "mean_reversion_fade",
+        "grid_ladder",
+    }
+)
+
 # Fixed strategy ordering for the packed summary (dispatch order first).
 STRATEGY_ORDER: tuple[str, ...] = (
     "activity_burst_pump",
@@ -138,6 +152,97 @@ class TickOutputs(NamedTuple):
     btc_price_change_96: jnp.ndarray  # scalar — BTC 24h pct change
     strategies: dict[str, StrategyOutputs]
     summary: TriggerSummary
+    wire: jnp.ndarray  # (23+6K,) f32 — the ONE per-tick D2H payload
+
+
+# The wire is a single small 1-D array: context scalars + a device-side
+# compaction of the fired (strategy, row) pairs. Fetching the full (5N, S)
+# summary cost ~0.6 MB/tick, which through a tunneled device serializes at
+# transfer bandwidth; the compact wire is ~18 KB. Timestamps ride as
+# (quotient, remainder) base-65536 pairs: ~1.7e9 seconds exceeds f32's
+# 2^24 integer range, the split parts don't.
+WIRE_MAX_FIRED = 64  # overflow flagged via n_fired; host falls back to summary
+WIRE_SCALARS_A: tuple[str, ...] = (
+    "valid",
+    "market_regime",
+    "previous_market_regime",
+    "market_regime_transition",
+    "market_regime_transition_strength",
+    "regime_is_transitioning",
+    "market_stress_score",
+    "advancers_ratio",
+    "long_tailwind",
+    "short_tailwind",
+    "fresh_count",
+    "average_return",
+)
+WIRE_SCALARS_B: tuple[str, ...] = (
+    "long_regime_score",
+    "short_regime_score",
+    "range_regime_score",
+    "stress_regime_score",
+    "btc_regime_score",
+    "btc_price_change_96",
+)
+_WIRE_TS_BASE = 65536
+
+
+class WireFired(NamedTuple):
+    """Host-side (numpy) compacted fired entries; first ``n`` rows valid."""
+
+    n: int  # total device-side fired count (may exceed len(strategy_idx))
+    overflow: bool  # n > WIRE_MAX_FIRED: fall back to the full summary
+    strategy_idx: object  # (K,) int — index into STRATEGY_ORDER
+    row: object  # (K,) int
+    autotrade: object  # (K,) bool
+    direction: object  # (K,) int32
+    score: object  # (K,) f32
+    stop_loss_pct: object  # (K,) f32
+
+
+def unpack_wire(wire) -> tuple[WireFired, dict]:
+    """Split one fetched wire array into fired entries + context scalars.
+
+    The scalar dict mirrors the reference's per-tick context consumption
+    (market_regime_notifier.py fields + routing inputs) so the host never
+    touches individual device scalars (each fetch is a round trip through
+    a tunneled device)."""
+    import numpy as np
+
+    w = np.asarray(wire)
+    na, nb = len(WIRE_SCALARS_A), len(WIRE_SCALARS_B)
+    a = w[:na]
+    b = w[na : na + nb + 4]
+    ctx = {k: float(a[i]) for i, k in enumerate(WIRE_SCALARS_A)}
+    ctx.update({k: float(b[i]) for i, k in enumerate(WIRE_SCALARS_B)})
+    ctx["timestamp"] = int(b[nb]) * _WIRE_TS_BASE + int(b[nb + 1])
+    ctx["regime_stable_since"] = int(b[nb + 2]) * _WIRE_TS_BASE + int(b[nb + 3])
+    for k in (
+        "market_regime",
+        "previous_market_regime",
+        "market_regime_transition",
+        "fresh_count",
+    ):
+        ctx[k] = int(ctx[k])
+    ctx["valid"] = ctx["valid"] > 0.5
+    ctx["regime_is_transitioning"] = ctx["regime_is_transitioning"] > 0.5
+
+    off = na + nb + 4
+    K = WIRE_MAX_FIRED
+    n = int(w[off])
+    blocks = w[off + 1 :].reshape(6, K)
+    kept = min(n, K)
+    fired = WireFired(
+        n=n,
+        overflow=n > K,
+        strategy_idx=blocks[0, :kept].astype(np.int32),
+        row=blocks[1, :kept].astype(np.int32),
+        autotrade=blocks[2, :kept] > 0.5,
+        direction=blocks[3, :kept].astype(np.int32),
+        score=blocks[4, :kept],
+        stop_loss_pct=blocks[5, :kept],
+    )
+    return fired, ctx
 
 
 def default_host_inputs(num_symbols: int) -> HostInputs:
@@ -181,13 +286,13 @@ def _mask_outputs(out: StrategyOutputs, ok: jnp.ndarray) -> StrategyOutputs:
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def tick_step(
+def _tick_step_impl(
     state: EngineState,
     upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     upd15: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     inputs: HostInputs,
     cfg: ContextConfig = ContextConfig(),
+    wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
 ) -> tuple[EngineState, TickOutputs]:
     """One tick: apply candle updates, rebuild context, evaluate everything.
 
@@ -333,6 +438,71 @@ def tick_step(
         score=jnp.stack([so.score for so in ordered]),
         stop_loss_pct=jnp.stack([so.stop_loss_pct for so in ordered]),
     )
+
+    # --- wire: pack the summary + every host-consumed context scalar into
+    # ONE array so the per-tick D2H is a single transfer (SURVEY §7 "keep
+    # the trigger-extraction D2H tiny").
+    scalar_values = {
+        "valid": context.valid,
+        "market_regime": context.market_regime,
+        "previous_market_regime": context.previous_market_regime,
+        "market_regime_transition": context.market_regime_transition,
+        "market_regime_transition_strength": context.market_regime_transition_strength,
+        "regime_is_transitioning": context.regime_is_transitioning,
+        "market_stress_score": context.market_stress_score,
+        "advancers_ratio": context.advancers_ratio,
+        "long_tailwind": context.long_tailwind,
+        "short_tailwind": context.short_tailwind,
+        "fresh_count": context.fresh_count,
+        "average_return": context.average_return,
+        "long_regime_score": context.long_regime_score,
+        "short_regime_score": context.short_regime_score,
+        "range_regime_score": context.range_regime_score,
+        "stress_regime_score": context.stress_regime_score,
+        "btc_regime_score": context.btc_regime_score,
+        "btc_price_change_96": btc_change_96,
+    }
+    ts32 = context.timestamp.astype(jnp.int32)
+    ss32 = context.regime_stable_since.astype(jnp.int32)
+    scalars = jnp.stack(
+        [scalar_values[k].astype(jnp.float32) for k in WIRE_SCALARS_A]
+        + [scalar_values[k].astype(jnp.float32) for k in WIRE_SCALARS_B]
+        + [
+            (ts32 // _WIRE_TS_BASE).astype(jnp.float32),
+            (ts32 % _WIRE_TS_BASE).astype(jnp.float32),
+            (ss32 // _WIRE_TS_BASE).astype(jnp.float32),
+            (ss32 % _WIRE_TS_BASE).astype(jnp.float32),
+        ]
+    )
+
+    # device-side compaction of fired (strategy, row) pairs — restricted to
+    # the enabled (emitting) strategies so dormant triggers neither consume
+    # compaction slots nor trip the overflow fallback (the host only
+    # materializes enabled strategies anyway)
+    K = WIRE_MAX_FIRED
+    enabled_mask = jnp.asarray(
+        [s in wire_enabled for s in STRATEGY_ORDER], dtype=bool
+    )
+    flat_trig = (summary.trigger & enabled_mask[:, None]).reshape(-1)  # (N*S,)
+    n_fired = jnp.sum(flat_trig).astype(jnp.float32)
+    (idx,) = jnp.nonzero(flat_trig, size=K, fill_value=-1)
+    valid_idx = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    si = safe // S
+    row = safe % S
+    gather = lambda arr: arr.reshape(-1)[safe].astype(jnp.float32)
+    fired_block = jnp.stack(
+        [
+            jnp.where(valid_idx, si.astype(jnp.float32), -1.0),
+            jnp.where(valid_idx, row.astype(jnp.float32), -1.0),
+            jnp.where(valid_idx, gather(summary.autotrade), 0.0),
+            jnp.where(valid_idx, gather(summary.direction), 0.0),
+            jnp.where(valid_idx, gather(summary.score), 0.0),
+            jnp.where(valid_idx, gather(summary.stop_loss_pct), 0.0),
+        ]
+    )  # (6, K)
+    wire = jnp.concatenate([scalars, n_fired[None], fired_block.reshape(-1)])
+
     outputs = TickOutputs(
         context=context,
         fresh5=fresh5,
@@ -346,8 +516,46 @@ def tick_step(
         btc_price_change_96=btc_change_96,
         strategies=strategies,
         summary=summary,
+        wire=wire,
     )
     return new_state, outputs
+
+
+tick_step = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
+    _tick_step_impl
+)
+
+# Bench/throughput variant: donates the carried EngineState so the ring
+# buffers update in place instead of allocating+copying ~66 MB per tick.
+# Callers must NOT reuse the passed state afterwards. The live SignalEngine
+# deliberately uses the PLAIN tick_step: its crash-isolation ring
+# (consume_loop catches a failed tick and carries on with the pre-tick
+# state) requires the old state to survive a tick that throws mid-flight.
+tick_step_donated = jax.jit(
+    _tick_step_impl,
+    static_argnames=("cfg", "wire_enabled"),
+    donate_argnums=(0,),
+)
+
+
+@jax.jit
+def apply_updates_step(
+    state: EngineState,
+    upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    upd15: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+) -> EngineState:
+    """Buffer-only update (no evaluation) for ordered sub-batch replay.
+
+    When a drain yields several bars for the same symbol (catch-up,
+    backfill), all but the final sub-batch are folded in with this cheap
+    step and the full ``tick_step`` evaluates ONCE on the final state —
+    evaluating per sub-batch would advance device-side dedupe carries and
+    discard the earlier sub-batches' signals.
+    """
+    return state._replace(
+        buf5=apply_updates(state.buf5, *upd5),
+        buf15=apply_updates(state.buf15, *upd15),
+    )
 
 
 def pad_updates(

@@ -40,6 +40,12 @@ from binquant_tpu.utils import (
     round_numbers,
 )
 
+# The reference dispatches only the live set; strategies outside it are
+# computed device-side as capability surface but are NOT materialized into
+# emissions unless explicitly enabled. Defined next to STRATEGY_ORDER so
+# the device wire compaction shares it; re-exported here for the io layer.
+from binquant_tpu.engine.step import LIVE_STRATEGIES  # noqa: F401
+
 # Strategies that trade FUTURES market type in their bot params
 _FUTURES_BOT_STRATEGIES = {"activity_burst_pump", "mean_reversion_fade"}
 # Strategies flagged margin_short_reversal=False explicitly
@@ -84,62 +90,110 @@ def extract_fired(
     exchange: str = "kucoin",
     market_type: str = "futures",
     settings=None,
+    enabled: frozenset[str] | set[str] | None = None,
+    skip=None,
+    unpacked=None,
 ) -> list[FiredSignal]:
     """Materialize FiredSignal objects for rows whose trigger bit is set.
 
-    The packed summary is ONE device fetch; per-row diagnostics are fetched
-    lazily per fired strategy (rare — a handful of rows per tick at most).
+    Only strategies in ``enabled`` (default: the reference's live dispatch
+    set) are materialized — dormant strategies ride the same device pass but
+    emit nothing unless opted in. ``skip(strategy, row) -> bool`` lets the
+    caller drop rows (e.g. already emitted this bar) BEFORE any diagnostics
+    fetch or payload construction.
+
+    The common path costs exactly ONE tiny device fetch: the packed wire
+    (context scalars + device-compacted fired entries). ``unpacked`` lets
+    the caller pass an already-fetched ``unpack_wire`` result. Per-row
+    diagnostics are fetched lazily per fired strategy (rare — a handful of
+    rows per tick at most); the full (N, S) summary is fetched only in the
+    >WIRE_MAX_FIRED overflow case.
     """
-    summary_trigger = np.asarray(outputs.summary.trigger)
-    if not summary_trigger.any():
+    from binquant_tpu.engine.step import unpack_wire
+
+    if enabled is None:
+        enabled = LIVE_STRATEGIES
+    fired_w, ctx_s = unpacked if unpacked is not None else unpack_wire(outputs.wire)
+
+    # (strategy_index, row, autotrade, direction, score, stop) tuples
+    entries: list[tuple[int, int, bool, int, float, float]] = []
+    if fired_w.overflow:
+        # pathological tick: compaction overflowed — full summary fallback
+        trig = np.asarray(outputs.summary.trigger)
+        auto = np.asarray(outputs.summary.autotrade)
+        dirn = np.asarray(outputs.summary.direction)
+        scor = np.asarray(outputs.summary.score)
+        stop = np.asarray(outputs.summary.stop_loss_pct)
+        for si, row in zip(*np.nonzero(trig)):
+            entries.append(
+                (
+                    int(si),
+                    int(row),
+                    bool(auto[si, row]),
+                    int(dirn[si, row]),
+                    float(scor[si, row]),
+                    float(stop[si, row]),
+                )
+            )
+    else:
+        for j in range(len(fired_w.strategy_idx)):
+            entries.append(
+                (
+                    int(fired_w.strategy_idx[j]),
+                    int(fired_w.row[j]),
+                    bool(fired_w.autotrade[j]),
+                    int(fired_w.direction[j]),
+                    float(fired_w.score[j]),
+                    float(fired_w.stop_loss_pct[j]),
+                )
+            )
+
+    by_strategy: dict[int, list[tuple[int, bool, int, float, float]]] = {}
+    for si, row, autotrade, direction_code, score, stop_loss in entries:
+        strategy = STRATEGY_ORDER[si]
+        if strategy not in enabled:
+            continue
+        if skip is not None and skip(strategy, row):
+            continue
+        by_strategy.setdefault(si, []).append(
+            (row, autotrade, direction_code, score, stop_loss)
+        )
+    if not by_strategy:
         return []
 
-    summary_autotrade = np.asarray(outputs.summary.autotrade)
-    summary_direction = np.asarray(outputs.summary.direction)
-    summary_score = np.asarray(outputs.summary.score)
-    summary_stop = np.asarray(outputs.summary.stop_loss_pct)
-
-    ctx = outputs.context
     ctx_np = {
-        "market_regime": int(np.asarray(ctx.market_regime)),
-        "transition": int(np.asarray(ctx.market_regime_transition)),
-        "transition_strength": float(np.asarray(ctx.market_regime_transition_strength)),
-        "stress": float(np.asarray(ctx.market_stress_score)),
-        "timestamp_ms": int(np.asarray(ctx.timestamp)) * 1000,
-        "valid": bool(np.asarray(ctx.valid)),
-        "advancers_ratio": float(np.asarray(ctx.advancers_ratio)),
-        "long_tailwind": float(np.asarray(ctx.long_tailwind)),
-        "short_tailwind": float(np.asarray(ctx.short_tailwind)),
+        "market_regime": ctx_s["market_regime"],
+        "transition": ctx_s["market_regime_transition"],
+        "transition_strength": ctx_s["market_regime_transition_strength"],
+        "stress": ctx_s["market_stress_score"],
+        "timestamp_ms": ctx_s["timestamp"] * 1000,
+        "valid": ctx_s["valid"],
+        "advancers_ratio": ctx_s["advancers_ratio"],
+        "long_tailwind": ctx_s["long_tailwind"],
+        "short_tailwind": ctx_s["short_tailwind"],
     }
-    feats = ctx.features
+    feats = outputs.context.features
     micro_np = np.asarray(feats.micro_regime)
     micro_trans_np = np.asarray(feats.micro_transition)
 
     fired: list[FiredSignal] = []
-    for si, strategy in enumerate(STRATEGY_ORDER):
-        rows = np.nonzero(summary_trigger[si])[0]
-        if rows.size == 0:
-            continue
+    for si in sorted(by_strategy):
+        strategy = STRATEGY_ORDER[si]
         so = outputs.strategies[strategy]
         diagnostics = {k: np.asarray(v) for k, v in so.diagnostics.items()}
-        pack = outputs.pack5 if strategy in _5M_SET else outputs.pack15
+        pack = outputs.pack5 if strategy in FIVE_MIN_STRATEGIES else outputs.pack15
         closes = np.asarray(pack.close)
         bb_high = np.asarray(pack.bb_upper)
         bb_mid = np.asarray(pack.bb_mid)
         bb_low = np.asarray(pack.bb_lower)
         volumes = np.asarray(pack.volume)
 
-        for row in rows:
-            row = int(row)
+        for row, autotrade, direction_code, score, stop_loss in by_strategy[si]:
             symbol = registry.name_of(row)
             if symbol is None:
                 continue
-            direction_code = int(summary_direction[si, row])
             direction = Direction(direction_code).name
             position = Position.short if direction == "SHORT" else Position.long
-            autotrade = bool(summary_autotrade[si, row])
-            score = float(summary_score[si, row])
-            stop_loss = float(summary_stop[si, row])
             current_price = float(closes[row])
             spreads = HABollinguerSpread(
                 bb_high=round_numbers(float(bb_high[row]), 6),
@@ -193,7 +247,7 @@ def extract_fired(
     return fired
 
 
-_5M_SET = {
+FIVE_MIN_STRATEGIES = {
     "activity_burst_pump",
     "coinrule_price_tracker",
     "coinrule_supertrend_swing_reversal",

@@ -23,14 +23,21 @@ import numpy as np
 from binquant_tpu.config import Config
 from binquant_tpu.engine.buffer import IngestBatcher, SymbolRegistry
 from binquant_tpu.engine.step import (
+    apply_updates_step,
     default_host_inputs,
     initial_engine_state,
     pad_updates,
     tick_step,
+    unpack_wire,
 )
 from binquant_tpu.io.autotrade import AutotradeConsumer
 from binquant_tpu.io.binbot import BinbotApi
-from binquant_tpu.io.emission import dispatch_signal_record, extract_fired
+from binquant_tpu.io.emission import (
+    FIVE_MIN_STRATEGIES,
+    LIVE_STRATEGIES,
+    dispatch_signal_record,
+    extract_fired,
+)
 from binquant_tpu.io.leverage import LeverageCalibrator
 from binquant_tpu.io.telegram import TelegramConsumer
 from binquant_tpu.regime.context import ContextConfig
@@ -87,6 +94,7 @@ class SignalEngine:
         futures_api: Any | None = None,
         context_config: ContextConfig = ContextConfig(),
         btc_symbol: str = "BTCUSDT",
+        enabled_strategies: set[str] | None = None,
     ) -> None:
         self.config = config
         self.binbot_api = binbot_api
@@ -109,6 +117,17 @@ class SignalEngine:
         self._last_breadth_bucket = -1
         self._last_calibration_bucket = -1
         self._pending_oi: dict[int, float] = {}
+        # quiet-hours override inputs: previous tick's regime state
+        self._last_regime: int | None = None
+        self._last_transition_strength: float = 0.0
+        # per-bar emission dedupe: (strategy, symbol) -> last emitted bar
+        # open ts. consume_loop re-ticks every second within a bucket; a
+        # standing trigger must fire at most once per bar (the reference
+        # dispatches once per candle arrival).
+        self._last_emitted: dict[tuple[str, str], int] = {}
+        self.enabled_strategies: frozenset[str] | None = (
+            None if enabled_strategies is None else frozenset(enabled_strategies)
+        )
         self.heartbeat_path = Path(config.heartbeat_path)
         self.ticks_processed = 0
         self.signals_emitted = 0
@@ -116,12 +135,22 @@ class SignalEngine:
     # -- ingest -------------------------------------------------------------
 
     def ingest(self, kline: dict) -> None:
-        """Route one closed candle to its interval batcher by bar duration."""
+        """Route one closed candle to its interval batcher by bar duration.
+
+        Only 5m and 15m frames are accepted; anything else (a stray 1m/1h
+        subscription) is rejected rather than corrupting buf15.
+        """
         duration_s = (int(kline["close_time"]) - int(kline["open_time"])) // 1000
         if abs(duration_s - FIVE_MIN_S) <= 1:
             self.batcher5.add(kline)
-        else:
+        elif abs(duration_s - FIFTEEN_MIN_S) <= 1:
             self.batcher15.add(kline)
+        else:
+            logging.warning(
+                "dropping kline with unsupported duration %ss for %s",
+                duration_s,
+                kline.get("symbol"),
+            )
 
     # -- periodic jobs (15m bucket cadence) ----------------------------------
 
@@ -198,7 +227,16 @@ class SignalEngine:
             self._breadth_scalars()
         )
         settings = self.at_consumer.autotrade_settings
-        quiet = is_quiet_hours()
+        # Quiet-hours with the strong-stable-trend override: judged against
+        # the PREVIOUS tick's regime/transition-strength (the reference
+        # evaluates the filter with the live context —
+        # time_of_day_filter.py:60-76; a missing context always suppresses).
+        quiet = is_autotrade_suppressed(
+            self._last_regime, self._last_transition_strength
+        )
+        # row 0 is a valid registry row — `or -1` would misread it as missing
+        _btc = self.registry.row_of(self.btc_symbol)
+        btc_row = -1 if _btc is None else int(_btc)
 
         empty = pad_updates(
             np.zeros(0, np.int32), np.zeros(0, np.int32),
@@ -207,52 +245,64 @@ class SignalEngine:
         upd5_list = [pad_updates(*b) for b in batches5] or [empty]
         upd15_list = [pad_updates(*b) for b in batches15] or [empty]
 
-        outputs = None
-        # replay ordered sub-batches; evaluate on the last application
+        # Ordered sub-batch replay: fold all but the FINAL sub-batch into the
+        # buffers with the cheap update-only step (evaluating each would
+        # advance dedupe carries and discard earlier signals), then run ONE
+        # full evaluation on the final state.
         n = max(len(upd5_list), len(upd15_list))
-        for i in range(n):
+        for i in range(n - 1):
             u5 = upd5_list[i] if i < len(upd5_list) else empty
             u15 = upd15_list[i] if i < len(upd15_list) else empty
-            inputs = default_host_inputs(self.capacity)._replace(
-                tracked=jnp.asarray(self.registry.active_rows),
-                btc_row=np.int32(self.registry.row_of(self.btc_symbol) or -1),
-                timestamp_s=np.int32(ts15),
-                timestamp5_s=np.int32(ts5),
-                oi_growth=jnp.asarray(oi),
-                adp_latest=jnp.asarray(np.float32(adp_latest)),
-                adp_prev=jnp.asarray(np.float32(adp_prev)),
-                adp_diff=jnp.asarray(np.float32(adp_diff)),
-                adp_diff_prev=jnp.asarray(np.float32(adp_diff_prev)),
-                breadth_momentum_points=jnp.asarray(np.float32(momentum)),
-                quiet_hours=jnp.asarray(
-                    is_autotrade_suppressed(None, 0.0) if quiet else False
-                ),
-                grid_policy_allows=jnp.asarray(
-                    self.grid_only_policy.allow_grid_ladder
-                ),
-                is_futures=jnp.asarray(
-                    str(settings.market_type).lower().endswith("futures")
-                ),
-                dominance_is_losers=jnp.asarray(False),
-                market_domination_reversal=jnp.asarray(
-                    self.at_consumer.market_domination_reversal
-                ),
-            )
-            self.state, outputs = tick_step(
-                self.state, u5, u15, inputs, self.context_config
-            )
-
-        assert outputs is not None
-        # refresh grid-only policy from the new context + breadth
-        regime = int(np.asarray(outputs.context.market_regime))
-        has_ctx = bool(np.asarray(outputs.context.valid))
+            self.state = apply_updates_step(self.state, u5, u15)
+        u5 = upd5_list[n - 1] if n - 1 < len(upd5_list) else empty
+        u15 = upd15_list[n - 1] if n - 1 < len(upd15_list) else empty
+        inputs = default_host_inputs(self.capacity)._replace(
+            tracked=jnp.asarray(self.registry.active_rows),
+            btc_row=np.int32(btc_row),
+            timestamp_s=np.int32(ts15),
+            timestamp5_s=np.int32(ts5),
+            oi_growth=jnp.asarray(oi),
+            adp_latest=jnp.asarray(np.float32(adp_latest)),
+            adp_prev=jnp.asarray(np.float32(adp_prev)),
+            adp_diff=jnp.asarray(np.float32(adp_diff)),
+            adp_diff_prev=jnp.asarray(np.float32(adp_diff_prev)),
+            breadth_momentum_points=jnp.asarray(np.float32(momentum)),
+            quiet_hours=jnp.asarray(quiet),
+            grid_policy_allows=jnp.asarray(
+                self.grid_only_policy.allow_grid_ladder
+            ),
+            is_futures=jnp.asarray(
+                str(settings.market_type).lower().endswith("futures")
+            ),
+            dominance_is_losers=jnp.asarray(False),
+            market_domination_reversal=jnp.asarray(
+                self.at_consumer.market_domination_reversal
+            ),
+        )
+        self.state, outputs = tick_step(
+            self.state,
+            u5,
+            u15,
+            inputs,
+            self.context_config,
+            # device-side wire compaction must match the host's enabled set
+            wire_enabled=tuple(sorted(self.enabled_strategies))
+            if self.enabled_strategies is not None
+            else tuple(sorted(LIVE_STRATEGIES)),
+        )
+        # ONE device fetch per tick: the packed wire (context scalars +
+        # compacted fired entries). Everything host-side below reads it.
+        unpacked = unpack_wire(outputs.wire)
+        fired_w, ctx_scalars = unpacked
+        regime = ctx_scalars["market_regime"]
+        has_ctx = ctx_scalars["valid"]
         self.grid_only_policy = GridOnlyPolicy.resolve(
             regime if has_ctx else None, self.market_breadth
         )
         self.at_consumer.grid_only_policy = self.grid_only_policy
 
         # regime-transition digest (host-side notifier)
-        digest = self.notifier.build_message(outputs.context)
+        digest = self.notifier.build_message(ctx_scalars)
         if digest:
             self.telegram_consumer.dispatch_signal(digest)
 
@@ -260,15 +310,39 @@ class SignalEngine:
         if has_ctx:
             self._run_leverage_calibration(bucket15, outputs.context)
 
+        # carry regime state for next tick's quiet-hours override; an
+        # invalid context clears it (reference: context None -> suppressed),
+        # so a stale strong-trend reading can't override hours later
+        if has_ctx:
+            self._last_regime = regime
+            self._last_transition_strength = ctx_scalars[
+                "market_regime_transition_strength"
+            ]
+        else:
+            self._last_regime = None
+            self._last_transition_strength = 0.0
+
         # emit fired signals through the three sinks
         fired = extract_fired(
             outputs,
             self.registry,
             env=self.config.env,
             exchange=self.at_consumer.exchange,
-            market_type=str(settings.market_type),
+            # use_enum_values schemas store the plain value string; raw
+            # enums (tests, direct construction) need .value
+            market_type=getattr(
+                settings.market_type, "value", settings.market_type
+            ),
             settings=settings,
+            enabled=self.enabled_strategies,
+            # pre-materialization skip: standing triggers already emitted
+            # for this bar cost nothing (no diagnostics fetch, no payloads)
+            skip=lambda strategy, row: self._already_emitted(
+                strategy, row, ts5, ts15
+            ),
+            unpacked=unpacked,
         )
+        fired = self._dedupe_fired(fired, ts5, ts15)
         for signal in fired:
             dispatch_signal_record(self.binbot_api, signal.analytics)
             self.telegram_consumer.dispatch_signal(signal.message)
@@ -284,6 +358,32 @@ class SignalEngine:
         self.ticks_processed += 1
         self.touch_heartbeat()
         return fired
+
+    def _already_emitted(self, strategy: str, row: int, ts5: int, ts15: int) -> bool:
+        """Check (without marking) whether this (strategy, symbol) already
+        emitted for the bar being evaluated. Keyed by symbol name — registry
+        rows are recycled, so a row-keyed entry could suppress a NEW
+        symbol's first signal."""
+        symbol = self.registry.name_of(row)
+        if symbol is None:
+            return True  # untracked row: nothing to emit
+        bar_ts = ts5 if strategy in FIVE_MIN_STRATEGIES else ts15
+        return self._last_emitted.get((strategy, symbol)) == bar_ts
+
+    def _dedupe_fired(self, fired: list, ts5: int, ts15: int) -> list:
+        """Once-per-bar emission dedupe (mark + filter). consume_loop
+        re-ticks every second within a bucket; a standing trigger must emit
+        at most once per bar (the reference dispatches once per candle
+        arrival)."""
+        kept = []
+        for signal in fired:
+            bar_ts = ts5 if signal.strategy in FIVE_MIN_STRATEGIES else ts15
+            key = (signal.strategy, signal.symbol)
+            if self._last_emitted.get(key) == bar_ts:
+                continue
+            self._last_emitted[key] = bar_ts
+            kept.append(signal)
+        return kept
 
     def touch_heartbeat(self) -> None:
         """Liveness file checked by healthcheck.py (main.py:30-32)."""

@@ -89,8 +89,14 @@ def make_stub_engine(capacity: int = 256, window: int = 200):
     telegram = TelegramConsumer(
         token="", chat_id="stub", transport=capture_transport
     )
+    # futures market type so futures-only strategies (MeanReversionFade)
+    # are exercised; autotrade stays off (no trade side effects in replay)
+    from binquant_tpu.schemas import MarketType
+
     at_consumer = AutotradeConsumer(
-        autotrade_settings=AutotradeSettingsSchema(autotrade=False),
+        autotrade_settings=AutotradeSettingsSchema(
+            autotrade=False, market_type=MarketType.FUTURES
+        ),
         active_test_bots=[],
         all_symbols=[],
         test_autotrade_settings=TestAutotradeSettingsSchema(autotrade=False),
@@ -161,7 +167,11 @@ def generate_replay_file(
     setups: an activity burst on S001's 5m stream and a MeanReversionFade
     hammer on S005's 15m stream, so the emission path is exercised."""
     rng = np.random.default_rng(seed)
-    t0 = 1_753_000_000
+    # MUST be 15m-bucket-aligned: process_tick derives the evaluated bar's
+    # open time from wall clock as bucket*900-900; misaligned open times
+    # never match the freshness mask and silently disable every strategy.
+    t0 = 1_753_000_200
+    assert t0 % 900 == 0
     px = 20 + rng.random(n_symbols) * 100
 
     def bar(symbol, ts_s, interval_s, o, h, low, c, volume):
@@ -196,9 +206,10 @@ def generate_replay_file(
                 vol15 = abs(rng.normal(1000, 200))
                 h, low = max(o, c) * 1.002, min(o, c) * 0.998
                 if last_tick and i == 5:
-                    # green hammer: big gap down, green close, 2x volume
-                    o = px[i] * 0.965
-                    c = o * 1.004
+                    # green hammer: deep gap down (clears the 20-bar lower
+                    # band even after it shifts), green close, 3x volume
+                    o = px[i] * 0.955
+                    c = o * 1.003
                     h, low = c * 1.001, o * 0.997
                     new_px[i] = c
                     vol15 *= 3.0

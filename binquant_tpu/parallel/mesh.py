@@ -49,23 +49,31 @@ def _shard_buffer(buf: MarketBuffer, mesh: Mesh) -> MarketBuffer:
     )
 
 
+def _shard_carry(carry: RegimeCarry, mesh: Mesh, num_symbols: int) -> RegimeCarry:
+    """Classify carry leaves by shape: (S, ...) arrays shard over symbols,
+    scalars and the (4,) score vectors replicate. Shape-based so future
+    carry fields are placed correctly without a manual registry."""
+    # the (4,) market-score vectors must not be mistaken for a symbol axis
+    assert num_symbols != 4, "capacity of 4 is ambiguous with score vectors"
+    s1 = symbol_sharding(mesh, 1)
+    r = _replicated(mesh)
+
+    def place(x):
+        is_symbol_axis = x.ndim >= 1 and x.shape[0] == num_symbols
+        return jax.device_put(x, s1 if is_symbol_axis else r)
+
+    return jax.tree_util.tree_map(place, carry)
+
+
 def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
     """Place the engine state: (S, ...) arrays split over symbols, the
     regime carry's scalars replicated, its per-symbol arrays split."""
     s1 = symbol_sharding(mesh, 1)
-    r = _replicated(mesh)
-    carry = state.regime_carry
     return EngineState(
         buf5=_shard_buffer(state.buf5, mesh),
         buf15=_shard_buffer(state.buf15, mesh),
-        regime_carry=RegimeCarry(
-            has_prev=jax.device_put(carry.has_prev, r),
-            market_regime=jax.device_put(carry.market_regime, r),
-            market_scores=jax.device_put(carry.market_scores, r),
-            stable_since=jax.device_put(carry.stable_since, r),
-            micro_has_prev=jax.device_put(carry.micro_has_prev, s1),
-            micro_regime=jax.device_put(carry.micro_regime, s1),
-            micro_strength=jax.device_put(carry.micro_strength, s1),
+        regime_carry=_shard_carry(
+            state.regime_carry, mesh, state.buf15.capacity
         ),
         mrf_last_emitted=jax.device_put(state.mrf_last_emitted, s1),
         pt_last_signal_close=jax.device_put(state.pt_last_signal_close, s1),

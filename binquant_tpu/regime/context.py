@@ -79,7 +79,16 @@ class SymbolFeatureArrays(NamedTuple):
 
 
 class RegimeCarry(NamedTuple):
-    """Cross-tick regime state (the reference's previous-context lookup)."""
+    """Cross-tick regime state (the reference's previous-context lookup).
+
+    Two slots: the *previous* fields hold the last context from a STRICTLY
+    older timestamp (the reference's ``_get_previous_context`` skips
+    ``known_timestamp >= timestamp`` — transitions always anchor on the
+    prior bucket), while the *stage* fields hold the latest evaluation of
+    the current timestamp. Mid-bucket re-evaluations overwrite only the
+    stage, so same-bucket refinements can't fire spurious transitions; the
+    stage is promoted to previous when a strictly newer timestamp arrives.
+    """
 
     has_prev: jnp.ndarray  # bool scalar
     market_regime: jnp.ndarray  # int32 scalar MarketRegimeCode
@@ -88,6 +97,14 @@ class RegimeCarry(NamedTuple):
     micro_has_prev: jnp.ndarray  # (S,) bool
     micro_regime: jnp.ndarray  # (S,) int32
     micro_strength: jnp.ndarray  # (S,)
+    stage_ts: jnp.ndarray  # int32 scalar, -1 = empty
+    stage_valid: jnp.ndarray  # bool scalar
+    stage_regime: jnp.ndarray  # int32 scalar
+    stage_scores: jnp.ndarray  # (4,)
+    stage_stable_since: jnp.ndarray  # int32
+    stage_micro_valid: jnp.ndarray  # (S,) bool
+    stage_micro_regime: jnp.ndarray  # (S,) int32
+    stage_micro_strength: jnp.ndarray  # (S,)
 
 
 class MarketContext(NamedTuple):
@@ -139,6 +156,14 @@ def initial_regime_carry(num_symbols: int) -> RegimeCarry:
         micro_has_prev=jnp.zeros((num_symbols,), dtype=bool),
         micro_regime=jnp.full((num_symbols,), -1, dtype=jnp.int32),
         micro_strength=jnp.zeros((num_symbols,), dtype=jnp.float32),
+        stage_ts=jnp.asarray(-1, dtype=jnp.int32),
+        stage_valid=jnp.asarray(False),
+        stage_regime=jnp.asarray(-1, dtype=jnp.int32),
+        stage_scores=jnp.zeros((4,), dtype=jnp.float32),
+        stage_stable_since=jnp.asarray(-1, dtype=jnp.int32),
+        stage_micro_valid=jnp.zeros((num_symbols,), dtype=bool),
+        stage_micro_regime=jnp.full((num_symbols,), -1, dtype=jnp.int32),
+        stage_micro_strength=jnp.zeros((num_symbols,), dtype=jnp.float32),
     )
 
 
@@ -563,8 +588,30 @@ def compute_market_context(
         long_tailwind=long_tailwind,
         short_tailwind=short_tailwind,
     )
-    ctx = _annotate_market_regime(ctx, carry, timestamp)
-    feats = _annotate_micro_regimes(feats, carry)
+    # Promote the staged context to "previous" only when this evaluation is
+    # strictly newer than the staged timestamp; same-timestamp refinements
+    # keep comparing against the prior bucket.
+    newer = timestamp.astype(jnp.int32) > carry.stage_ts
+    promote = newer & carry.stage_valid
+    promote_micro = newer & carry.stage_micro_valid
+    eff_carry = carry._replace(
+        has_prev=carry.has_prev | promote,
+        market_regime=jnp.where(promote, carry.stage_regime, carry.market_regime),
+        market_scores=jnp.where(promote, carry.stage_scores, carry.market_scores),
+        stable_since=jnp.where(
+            promote, carry.stage_stable_since, carry.stable_since
+        ),
+        micro_has_prev=carry.micro_has_prev | promote_micro,
+        micro_regime=jnp.where(
+            promote_micro, carry.stage_micro_regime, carry.micro_regime
+        ),
+        micro_strength=jnp.where(
+            promote_micro, carry.stage_micro_strength, carry.micro_strength
+        ),
+    )
+
+    ctx = _annotate_market_regime(ctx, eff_carry, timestamp)
+    feats = _annotate_micro_regimes(feats, eff_carry)
 
     context = MarketContext(
         valid=valid,
@@ -604,8 +651,9 @@ def compute_market_context(
         features=feats,
     )
 
-    # --- carry update: only a valid context becomes the next previous-state
-    # (reference: None contexts are never stored, l.101-103).
+    # --- carry update: the promoted previous slots persist untouched; only
+    # the STAGE is overwritten by this evaluation (and only when valid —
+    # reference: None contexts are never stored, l.101-103).
     new_scores = jnp.stack(
         [
             ctx["long_regime_score"],
@@ -615,15 +663,35 @@ def compute_market_context(
         ]
     )
     micro_update = valid & feats.valid
-    new_carry = RegimeCarry(
-        has_prev=carry.has_prev | valid,
-        market_regime=jnp.where(valid, ctx["market_regime"], carry.market_regime),
-        market_scores=jnp.where(valid, new_scores, carry.market_scores),
-        stable_since=jnp.where(valid, ctx["regime_stable_since"], carry.stable_since),
-        micro_has_prev=carry.micro_has_prev | micro_update,
-        micro_regime=jnp.where(micro_update, feats.micro_regime, carry.micro_regime),
-        micro_strength=jnp.where(
-            micro_update, feats.micro_regime_strength, carry.micro_strength
+    ts32 = timestamp.astype(jnp.int32)
+    new_carry = eff_carry._replace(
+        stage_ts=jnp.where(newer, ts32, carry.stage_ts).astype(jnp.int32),
+        stage_valid=jnp.where(newer, valid, carry.stage_valid | valid),
+        stage_regime=jnp.where(
+            valid,
+            ctx["market_regime"],
+            jnp.where(newer, jnp.int32(-1), carry.stage_regime),
+        ).astype(jnp.int32),
+        stage_scores=jnp.where(
+            valid, new_scores, jnp.where(newer, 0.0, carry.stage_scores)
+        ),
+        stage_stable_since=jnp.where(
+            valid,
+            ctx["regime_stable_since"],
+            jnp.where(newer, jnp.int32(-1), carry.stage_stable_since),
+        ).astype(jnp.int32),
+        stage_micro_valid=jnp.where(
+            newer, micro_update, carry.stage_micro_valid | micro_update
+        ),
+        stage_micro_regime=jnp.where(
+            micro_update,
+            feats.micro_regime,
+            jnp.where(newer, jnp.int32(-1), carry.stage_micro_regime),
+        ).astype(jnp.int32),
+        stage_micro_strength=jnp.where(
+            micro_update,
+            feats.micro_regime_strength,
+            jnp.where(newer, 0.0, carry.stage_micro_strength),
         ),
     )
     return context, new_carry

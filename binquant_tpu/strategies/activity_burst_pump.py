@@ -17,7 +17,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer
-from binquant_tpu.ops.rolling import rolling_median, rolling_quantile, shift
+from binquant_tpu.ops.rolling import rolling_median, rolling_quantile_tail, shift
 from binquant_tpu.regime.context import MarketContext
 from binquant_tpu.regime.routing import allows_long_autotrade_mask
 from binquant_tpu.strategies.base import StrategyOutputs
@@ -105,30 +105,31 @@ def activity_burst_pump(
         volume_ratio * quote_ratio * jnp.maximum(price_jump, 0.0) * (1.0 + body_frac),
         volume_ratio * jnp.maximum(price_jump, 0.0),
     )
-    threshold = rolling_quantile(
+    # The cooldown needs `raw` at only the trailing cooldown_bars+1
+    # positions, so the 92nd-pct threshold (the expensive windowed sort) is
+    # computed for just those trailing windows instead of all of TAIL.
+    n_out = p.cooldown_bars + 1
+    threshold_tail = rolling_quantile_tail(
         shift(score, 1), p.score_lookback, p.score_quantile,
-        min_periods=p.lookback_window,
-    )
-    threshold_filled = jnp.where(jnp.isfinite(threshold), threshold, 0.0)
+        num_out=n_out, min_periods=p.lookback_window,
+    )  # (S, n_out) aligned with the last n_out positions
+    threshold_filled = jnp.where(jnp.isfinite(threshold_tail), threshold_tail, 0.0)
 
+    tail_n = lambda a: a[:, -n_out:]
     raw = (
-        vol_spike
-        & quote_spike
-        & jump_flag
-        & range_flag
-        & body_flag
-        & trend_flag
-        & jnp.isfinite(score)
-        & (score >= threshold_filled)
+        tail_n(vol_spike)
+        & tail_n(quote_spike)
+        & tail_n(jump_flag)
+        & tail_n(range_flag)
+        & tail_n(body_flag)
+        & tail_n(trend_flag)
+        & jnp.isfinite(tail_n(score))
+        & (tail_n(score) >= threshold_filled)
     )
     # 3-bar cooldown: any raw signal in the previous cooldown_bars bars
-    raw_f = raw.astype(jnp.float32)
-    recent = shift(raw_f, 1, 0.0)
-    for i in range(1, p.cooldown_bars):
-        recent = jnp.maximum(recent, shift(raw_f, 1 + i, 0.0))
-    qualified = raw & (recent < 0.5)
+    qualified = raw[:, -1] & ~jnp.any(raw[:, :-1], axis=-1)
 
-    fired = qualified[:, -1]
+    fired = qualified
     # data sufficiency: len(df) >= lookback+1 (l.164)
     fired = fired & (buf5.filled >= p.lookback_window + 1)
 

@@ -27,29 +27,68 @@ def _regime_summary(regime: int) -> str:
     return "market conditions are mixed, transitional, or range-bound"
 
 
+def context_scalars(context: MarketContext) -> dict:
+    """Fetch the digest-relevant scalars from a device MarketContext into
+    the same dict shape ``engine.step.unpack_wire`` produces. Test/debug
+    convenience — the live pipeline gets the dict from the packed wire in
+    one transfer instead of ~17 per-scalar round trips."""
+    return {
+        "valid": bool(np.asarray(context.valid)),
+        "market_regime": int(np.asarray(context.market_regime)),
+        "previous_market_regime": int(np.asarray(context.previous_market_regime)),
+        "market_regime_transition": int(
+            np.asarray(context.market_regime_transition)
+        ),
+        "market_regime_transition_strength": float(
+            np.asarray(context.market_regime_transition_strength)
+        ),
+        "market_stress_score": float(np.asarray(context.market_stress_score)),
+        "advancers_ratio": float(np.asarray(context.advancers_ratio)),
+        "long_tailwind": float(np.asarray(context.long_tailwind)),
+        "short_tailwind": float(np.asarray(context.short_tailwind)),
+        "fresh_count": int(np.asarray(context.fresh_count)),
+        "average_return": float(np.asarray(context.average_return)),
+        "long_regime_score": float(np.asarray(context.long_regime_score)),
+        "short_regime_score": float(np.asarray(context.short_regime_score)),
+        "range_regime_score": float(np.asarray(context.range_regime_score)),
+        "stress_regime_score": float(np.asarray(context.stress_regime_score)),
+        "btc_regime_score": float(np.asarray(context.btc_regime_score)),
+        "timestamp": int(np.asarray(context.timestamp)),
+        "regime_is_transitioning": bool(
+            np.asarray(context.regime_is_transitioning)
+        ),
+        "regime_stable_since": int(np.asarray(context.regime_stable_since)),
+    }
+
+
 class MarketRegimeNotifier:
     def __init__(self, env: str = "") -> None:
         self.env = env
         self.last_transition_sent: int | None = None
 
-    def build_message(self, context: MarketContext) -> str | None:
-        """Digest text for a new transition, or None when nothing to send."""
-        if not bool(np.asarray(context.valid)):
+    def build_message(self, ctx) -> str | None:
+        """Digest text for a new transition, or None when nothing to send.
+
+        ``ctx`` is the scalar dict from ``unpack_wire`` (or
+        :func:`context_scalars`); a raw MarketContext is converted."""
+        if not isinstance(ctx, dict):
+            ctx = context_scalars(ctx)
+        if not ctx["valid"]:
             return None
-        transition = int(np.asarray(context.market_regime_transition))
-        previous = int(np.asarray(context.previous_market_regime))
-        current = int(np.asarray(context.market_regime))
+        transition = ctx["market_regime_transition"]
+        previous = ctx["previous_market_regime"]
+        current = ctx["market_regime"]
         if transition < 0 or previous < 0 or current < 0:
             return None
         if transition == self.last_transition_sent:
             return None
         self.last_transition_sent = transition
 
-        r3 = lambda v: round(float(np.asarray(v)), 3)
+        r3 = lambda v: round(float(v), 3)
         prev_name = MarketRegimeCode(previous).name
         cur_name = MarketRegimeCode(current).name
         transition_name = MarketTransitionCode(transition).name
-        ts = int(np.asarray(context.timestamp)) * 1000
+        ts = ctx["timestamp"] * 1000
         return f"""
             - [{self.env}] <strong>#market_regime_transition</strong>
             - Event: {transition_name}
@@ -59,16 +98,16 @@ class MarketRegimeNotifier:
             - Interpretation: {_regime_summary(current)}
             - Context timestamp: {ts}
             - Confidence: 1.0
-            - Transition strength: {r3(context.market_regime_transition_strength)}
-            - Fresh symbols: {int(np.asarray(context.fresh_count))}
-            - Advancers ratio: {r3(context.advancers_ratio)}
-            - Long regime score: {r3(context.long_regime_score)}
-            - Short regime score: {r3(context.short_regime_score)}
-            - Range regime score: {r3(context.range_regime_score)}
-            - Stress regime score: {r3(context.stress_regime_score)}
-            - Avg return: {round(float(np.asarray(context.average_return)), 4)}
-            - BTC regime score: {r3(context.btc_regime_score)}
-            - Long tailwind: {r3(context.long_tailwind)}
-            - Short tailwind: {r3(context.short_tailwind)}
-            - Market stress: {r3(context.market_stress_score)}
+            - Transition strength: {r3(ctx["market_regime_transition_strength"])}
+            - Fresh symbols: {ctx["fresh_count"]}
+            - Advancers ratio: {r3(ctx["advancers_ratio"])}
+            - Long regime score: {r3(ctx["long_regime_score"])}
+            - Short regime score: {r3(ctx["short_regime_score"])}
+            - Range regime score: {r3(ctx["range_regime_score"])}
+            - Stress regime score: {r3(ctx["stress_regime_score"])}
+            - Avg return: {round(float(ctx["average_return"]), 4)}
+            - BTC regime score: {r3(ctx["btc_regime_score"])}
+            - Long tailwind: {r3(ctx["long_tailwind"])}
+            - Short tailwind: {r3(ctx["short_tailwind"])}
+            - Market stress: {r3(ctx["market_stress_score"])}
         """

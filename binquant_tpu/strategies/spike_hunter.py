@@ -28,10 +28,8 @@ import jax.numpy as jnp
 from binquant_tpu.engine.buffer import Field, MarketBuffer
 from binquant_tpu.enums import Direction
 from binquant_tpu.ops.rolling import (
-    rolling_max,
     rolling_mean,
-    rolling_quantile,
-    rolling_sum,
+    rolling_quantile_tail,
     shift,
 )
 from binquant_tpu.regime.context import MarketContext
@@ -109,7 +107,14 @@ def _nanquantile_last(x: jnp.ndarray, q: float) -> jnp.ndarray:
 
 
 def detect_spikes(buf15: MarketBuffer, params: SpikeParams = SpikeParams()) -> SpikeSignal:
-    """The full detector (detect() l.492-502), last-bar outputs."""
+    """The full detector (detect() l.492-502), last-bar outputs.
+
+    Only the last bar is consumed downstream, so every flag is computed on
+    its trailing slice; the sole full-window work is the auto-calibration
+    quantiles over the whole (S, W) distribution — the round-1 version
+    materialized and sorted an (S, W, 60) windowed view per tick for a
+    single consumed row.
+    """
     p = params
     close = buf15.values[:, :, Field.CLOSE]
     open_ = buf15.values[:, :, Field.OPEN]
@@ -119,6 +124,9 @@ def detect_spikes(buf15: MarketBuffer, params: SpikeParams = SpikeParams()) -> S
     price_change_abs = jnp.abs(price_change)
     volume_ma = rolling_mean(volume, p.base_window)
     volume_ratio = volume / (volume_ma + 1e-6)
+    pc_last = price_change[:, -1]
+    pc_abs_last = price_change_abs[:, -1]
+    vr_last = volume_ratio[:, -1]
 
     # --- auto-calibration from full-window distributions (l.187-215)
     vol_thr = jnp.maximum(
@@ -135,75 +143,82 @@ def detect_spikes(buf15: MarketBuffer, params: SpikeParams = SpikeParams()) -> S
         jnp.where(jnp.isfinite(price_floor), price_floor, 0.0),
     )
 
-    # --- volume cluster (l.308-318); live edge => base flag
-    cond = volume_ratio >= vol_thr[:, None]
-    cluster_count = rolling_sum(
-        jnp.where(jnp.isfinite(volume_ratio), cond.astype(jnp.float32), jnp.nan),
-        p.volume_cluster_window,
-        min_periods=1,
+    # --- volume cluster at the live edge (l.308-318): count of threshold
+    # crossings in the trailing cluster window (>=1 finite sample)
+    vrw = volume_ratio[:, -p.volume_cluster_window:]
+    finite_vrw = jnp.isfinite(vrw)
+    cond_w = vrw >= vol_thr[:, None]
+    cluster_count = jnp.sum(jnp.where(finite_vrw, cond_w, False), axis=-1)
+    has_any = jnp.any(finite_vrw, axis=-1)
+    vc_flag = (
+        has_any
+        & (cluster_count >= p.volume_cluster_min_count)
+        & (vr_last >= vol_thr)
     )
-    vc_flag = (cluster_count >= p.volume_cluster_min_count) & cond
 
-    # --- dynamic price break (l.320-358)
-    dyn = rolling_quantile(price_change_abs, 60, p.price_break_dynamic_q, min_periods=20)
-    thr = jnp.maximum(price_floor[:, None], dyn)  # NaN dyn -> NaN (pre-warmup)
-    pb_flag = price_change_abs >= thr
+    # --- dynamic price break (l.320-358): trailing 60-bar quantile only
+    dyn = rolling_quantile_tail(
+        price_change_abs, 60, p.price_break_dynamic_q, num_out=1, min_periods=20
+    )[:, -1]
+    thr = jnp.maximum(price_floor, dyn)  # NaN dyn -> NaN (pre-warmup)
+    pb_flag = pc_abs_last >= thr
 
-    # --- cumulative break (l.360-379)
+    # --- cumulative break (l.360-379) over the trailing w bars
     w = p.cumulative_price_window
-    cum_pos = rolling_sum(jnp.maximum(price_change, 0.0), w)
-    cum_neg = rolling_sum(jnp.abs(jnp.minimum(price_change, 0.0)), w)
-    vol_cond = rolling_max(
-        jnp.where(
-            jnp.isfinite(volume_ratio),
-            (volume_ratio >= vol_thr[:, None] * 0.8).astype(jnp.float32),
-            jnp.nan,
-        ),
-        w,
-    ) > 0.5
-    cum_flag = (cum_pos >= p.cumulative_price_threshold) & vol_cond
-    cum_short_flag = (cum_neg >= p.cumulative_price_threshold) & vol_cond
+    pcw = price_change[:, -w:]
+    finite_pcw = jnp.isfinite(pcw)
+    full_w = jnp.sum(finite_pcw, axis=-1) >= w  # min_periods == window
+    cum_pos = jnp.sum(jnp.where(finite_pcw, jnp.maximum(pcw, 0.0), 0.0), axis=-1)
+    cum_neg = jnp.sum(
+        jnp.where(finite_pcw, jnp.abs(jnp.minimum(pcw, 0.0)), 0.0), axis=-1
+    )
+    vrw3 = volume_ratio[:, -w:]
+    finite_vrw3 = jnp.isfinite(vrw3)
+    vol_cond = (jnp.sum(finite_vrw3, axis=-1) >= w) & jnp.any(
+        finite_vrw3 & (vrw3 >= vol_thr[:, None] * 0.8), axis=-1
+    )
+    cum_flag = full_w & (cum_pos >= p.cumulative_price_threshold) & vol_cond
+    cum_short_flag = full_w & (cum_neg >= p.cumulative_price_threshold) & vol_cond
 
     # --- acceleration (l.381-402)
-    vol_deriv = volume_ratio - shift(volume_ratio, p.accel_volume_deriv_window)
+    k = p.accel_volume_deriv_window
+    vr_lag = volume_ratio[:, -1 - k] if volume_ratio.shape[-1] > k else jnp.full_like(vr_last, jnp.nan)
+    vol_deriv = vr_last - vr_lag
     accel_base = (vol_deriv >= p.accel_volume_deriv_min) & (
-        price_change_abs >= p.accel_price_change_min
+        pc_abs_last >= p.accel_price_change_min
     )
-    accel_flag = accel_base & (price_change > 0)
-    accel_short_flag = accel_base & (price_change < 0)
+    accel_flag = accel_base & (pc_last > 0)
+    accel_short_flag = accel_base & (pc_last < 0)
 
     # --- labels (l.404-446); require_both_patterns=False default
     base_combo = vc_flag | pb_flag
-    bullish = close > open_
-    bearish = close < open_
+    bullish = close[:, -1] > open_[:, -1]
+    bearish = close[:, -1] < open_[:, -1]
     label = base_combo | cum_flag | accel_flag
     if p.require_bullish_spike:
         label = label & bullish
     label_short = (base_combo | cum_short_flag | accel_short_flag) & bearish
 
-    # --- streaks (l.480-489)
-    green = bullish.astype(jnp.float32)
-    red = bearish.astype(jnp.float32)
-    upward = rolling_sum(green, 3) >= 3
-    downward = rolling_sum(red, 3) >= 3
+    # --- streaks (l.480-489): all of the last 3 candles green/red
+    upward = jnp.all(close[:, -3:] > open_[:, -3:], axis=-1)
+    downward = jnp.all(close[:, -3:] < open_[:, -3:], axis=-1)
 
-    last = lambda a: a[:, -1]
     return SpikeSignal(
-        close=last(close),
-        label=last(label) & (buf15.filled > 0),
-        label_short=last(label_short) & (buf15.filled > 0),
-        volume_cluster_flag=last(vc_flag),
-        price_break_flag=last(pb_flag),
-        cumulative_price_break_flag=last(cum_flag),
-        accel_spike_flag=last(accel_flag),
-        cumulative_price_break_short_flag=last(cum_short_flag),
-        accel_spike_short_flag=last(accel_short_flag),
-        upward=last(upward),
-        downward=last(downward),
+        close=close[:, -1],
+        label=label & (buf15.filled > 0),
+        label_short=label_short & (buf15.filled > 0),
+        volume_cluster_flag=vc_flag,
+        price_break_flag=pb_flag,
+        cumulative_price_break_flag=cum_flag,
+        accel_spike_flag=accel_flag,
+        cumulative_price_break_short_flag=cum_short_flag,
+        accel_spike_short_flag=accel_short_flag,
+        upward=upward,
+        downward=downward,
         volume=buf15.values[:, -1, Field.VOLUME],
         quote_asset_volume=buf15.values[:, -1, Field.QUOTE_VOLUME],
         volume_ratio_threshold=vol_thr,
-        price_break_threshold=last(jnp.where(jnp.isfinite(thr), thr, price_floor[:, None])),
+        price_break_threshold=jnp.where(jnp.isfinite(thr), thr, price_floor),
     )
 
 

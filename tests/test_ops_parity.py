@@ -75,6 +75,24 @@ class TestRolling:
         expected = pd.Series(ohlcv["volume"]).rolling(48).quantile(q)
         assert_close(roll.rolling_quantile(x, 48, q), expected)
 
+    @pytest.mark.parametrize("num_out", [1, 4, 9])
+    def test_rolling_quantile_tail_matches_full(self, ohlcv, num_out):
+        x = jnp.asarray(ohlcv["volume"])
+        full = np.asarray(roll.rolling_quantile(x, 48, 0.92, min_periods=20))
+        tail = np.asarray(
+            roll.rolling_quantile_tail(x, 48, 0.92, num_out=num_out, min_periods=20)
+        )
+        np.testing.assert_allclose(tail, full[-num_out:], rtol=1e-6, equal_nan=True)
+
+    def test_rolling_quantile_tail_short_series_warmup(self):
+        # series shorter than window+num_out-1: leading windows truncated
+        x = jnp.asarray(np.arange(10.0))
+        full = np.asarray(roll.rolling_quantile(x, 8, 0.5, min_periods=3))
+        tail = np.asarray(
+            roll.rolling_quantile_tail(x, 8, 0.5, num_out=6, min_periods=3)
+        )
+        np.testing.assert_allclose(tail, full[-6:], rtol=1e-6, equal_nan=True)
+
     def test_rolling_median_shifted(self, ohlcv):
         # shifted rolling median — the activity_burst_pump baseline pattern
         x = roll.shift(jnp.asarray(ohlcv["volume"]), 1)

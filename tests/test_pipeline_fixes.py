@@ -1,0 +1,259 @@
+"""Host-pipeline behavior pins: live-set gating, per-bar dedupe, ingest
+validation, BTC row-0 resolution, and same-timestamp regime staging.
+
+These target the round-1 advisor/judge findings: dormant strategies must
+not emit unless enabled, a standing trigger must fire once per bar despite
+1 s re-ticks, non-5m/15m frames must be rejected, registry row 0 is a valid
+BTC row, and mid-bucket context refinements must not fire spurious
+transitions.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from binquant_tpu.io.emission import LIVE_STRATEGIES, extract_fired
+from binquant_tpu.io.replay import make_stub_engine
+from binquant_tpu.engine.step import STRATEGY_ORDER
+from tests.test_engine_step import (
+    CFG,
+    S_CAP,
+    WINDOW,
+    frames_to_updates,
+)
+from tests.conftest import make_ohlcv
+
+
+@pytest.fixture(scope="module")
+def tick_outputs():
+    """One real tick at the shared (16, 130) shape (compile cache hit)."""
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.step import (
+        default_host_inputs,
+        initial_engine_state,
+        pad_updates,
+        tick_step,
+    )
+
+    rng = np.random.default_rng(99)
+    frames = {
+        i: pd.DataFrame(make_ohlcv(rng, n=WINDOW, start_price=30 + i, vol=0.006))
+        for i in range(8)
+    }
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    tracked = np.zeros(S_CAP, dtype=bool)
+    tracked[:8] = True
+    out = None
+    for b in range(WINDOW):
+        upd = pad_updates(*frames_to_updates(frames, b), size=S_CAP)
+        ts = int(frames[0]["open_time"].iloc[b]) // 1000
+        inputs = default_host_inputs(S_CAP)._replace(
+            tracked=jnp.asarray(tracked),
+            btc_row=np.int32(0),
+            timestamp_s=np.int32(ts),
+            timestamp5_s=np.int32(ts),
+        )
+        state, out = tick_step(state, upd, upd, inputs, CFG)
+    return out
+
+
+def _forced_unpacked(outputs, strategy: str, row: int):
+    """Synthetic unpack_wire result with one fired (strategy, row) entry."""
+    from binquant_tpu.engine.step import WireFired
+    from binquant_tpu.strategies.market_regime_notifier import context_scalars
+
+    si = STRATEGY_ORDER.index(strategy)
+    fired = WireFired(
+        n=1,
+        overflow=False,
+        strategy_idx=np.array([si], np.int32),
+        row=np.array([row], np.int32),
+        autotrade=np.array([True]),
+        direction=np.array([0], np.int32),
+        score=np.array([1.0], np.float32),
+        stop_loss_pct=np.array([0.0], np.float32),
+    )
+    return fired, context_scalars(outputs.context)
+
+
+class FakeRegistry:
+    def name_of(self, row):
+        return f"S{row:03d}USDT"
+
+
+class TestLiveSetGating:
+    def test_dormant_strategy_not_emitted_by_default(self, tick_outputs):
+        unp = _forced_unpacked(tick_outputs, "coinrule_buy_the_dip", 2)
+        fired = extract_fired(tick_outputs, FakeRegistry(), unpacked=unp)
+        assert all(f.strategy != "coinrule_buy_the_dip" for f in fired)
+
+    def test_dormant_strategy_emitted_when_enabled(self, tick_outputs):
+        unp = _forced_unpacked(tick_outputs, "coinrule_buy_the_dip", 2)
+        fired = extract_fired(
+            tick_outputs,
+            FakeRegistry(),
+            enabled=LIVE_STRATEGIES | {"coinrule_buy_the_dip"},
+            unpacked=unp,
+        )
+        assert any(
+            f.strategy == "coinrule_buy_the_dip" and f.row == 2 for f in fired
+        )
+
+    def test_live_strategy_emitted_by_default(self, tick_outputs):
+        unp = _forced_unpacked(tick_outputs, "mean_reversion_fade", 3)
+        fired = extract_fired(tick_outputs, FakeRegistry(), unpacked=unp)
+        assert any(f.strategy == "mean_reversion_fade" and f.row == 3 for f in fired)
+
+    def test_wire_roundtrip_matches_context(self, tick_outputs):
+        """unpack_wire(outputs.wire) == the directly-fetched context scalars."""
+        from binquant_tpu.engine.step import unpack_wire
+        from binquant_tpu.strategies.market_regime_notifier import context_scalars
+
+        fired_w, ctx_w = unpack_wire(tick_outputs.wire)
+        ctx_direct = context_scalars(tick_outputs.context)
+        for k, v in ctx_direct.items():
+            if isinstance(v, float):
+                assert abs(ctx_w[k] - v) < 1e-5, k
+            else:
+                assert ctx_w[k] == v, k
+        # no dormant strategy occupies a wire slot
+        for si in fired_w.strategy_idx:
+            assert STRATEGY_ORDER[int(si)] in LIVE_STRATEGIES
+
+    def test_live_set_matches_reference_dispatch(self):
+        # context_evaluator.py:369-479 dispatches ABP + PriceTracker (5m),
+        # LSP + MRF + LadderDeployer (15m); SpikeHunter disabled.
+        assert LIVE_STRATEGIES == {
+            "activity_burst_pump",
+            "coinrule_price_tracker",
+            "liquidation_sweep_pump",
+            "mean_reversion_fade",
+            "grid_ladder",
+        }
+
+
+class TestPerBarDedupe:
+    def _fake_signal(self, strategy, row):
+        from binquant_tpu.io.emission import FiredSignal
+
+        return FiredSignal(strategy, f"S{row}", row, None, "", {})
+
+    def test_second_tick_same_bar_suppressed(self):
+        eng = make_stub_engine(capacity=16, window=64)
+        sigs = [self._fake_signal("liquidation_sweep_pump", 1)]
+        kept1 = eng._dedupe_fired(list(sigs), ts5=1000, ts15=9000)
+        kept2 = eng._dedupe_fired(list(sigs), ts5=1000, ts15=9000)
+        assert len(kept1) == 1
+        assert len(kept2) == 0
+
+    def test_new_bar_re_emits(self):
+        eng = make_stub_engine(capacity=16, window=64)
+        sigs = [self._fake_signal("liquidation_sweep_pump", 1)]
+        assert len(eng._dedupe_fired(list(sigs), ts5=1000, ts15=9000)) == 1
+        assert len(eng._dedupe_fired(list(sigs), ts5=1000, ts15=9900)) == 1
+
+    def test_5m_strategy_keys_on_5m_bucket(self):
+        eng = make_stub_engine(capacity=16, window=64)
+        sigs = [self._fake_signal("activity_burst_pump", 4)]
+        assert len(eng._dedupe_fired(list(sigs), ts5=1000, ts15=9000)) == 1
+        # same 15m bucket but a NEW 5m bar -> re-emits
+        assert len(eng._dedupe_fired(list(sigs), ts5=1300, ts15=9000)) == 1
+        # same 5m bar again -> suppressed
+        assert len(eng._dedupe_fired(list(sigs), ts5=1300, ts15=9000)) == 0
+
+
+class TestIngestValidation:
+    def _kline(self, duration_s, symbol="AAAUSDT"):
+        t0 = 1_753_000_000_000
+        return {
+            "symbol": symbol,
+            "open_time": t0,
+            "close_time": t0 + duration_s * 1000 - 1,
+            "open": 1.0,
+            "high": 1.1,
+            "low": 0.9,
+            "close": 1.05,
+            "volume": 10.0,
+            "quote_asset_volume": 10.5,
+            "number_of_trades": 5,
+            "taker_buy_base_volume": 5.0,
+            "taker_buy_quote_volume": 5.2,
+        }
+
+    def test_5m_and_15m_routed(self):
+        eng = make_stub_engine(capacity=16, window=64)
+        eng.ingest(self._kline(300))
+        eng.ingest(self._kline(900))
+        assert len(eng.batcher5) == 1
+        assert len(eng.batcher15) == 1
+
+    def test_other_durations_rejected(self):
+        eng = make_stub_engine(capacity=16, window=64)
+        eng.ingest(self._kline(60))
+        eng.ingest(self._kline(3600))
+        assert len(eng.batcher5) == 0
+        assert len(eng.batcher15) == 0
+
+
+def test_btc_row_zero_not_treated_as_missing():
+    eng = make_stub_engine(capacity=16, window=64)
+    row = eng.registry.add("BTCUSDT")
+    assert row == 0
+    # reproduce the resolution logic used by process_tick
+    _btc = eng.registry.row_of(eng.btc_symbol)
+    btc_row = -1 if _btc is None else int(_btc)
+    assert btc_row == 0
+
+
+class TestRegimeStaging:
+    """Same-timestamp refinements must not promote the carry
+    (reference _get_previous_context skips known_timestamp >= timestamp)."""
+
+    def test_same_ts_refinement_has_no_previous(self):
+        from tests.test_regime_context import (
+            build_market,
+            load_buffer,
+            run_kernel,
+        )
+        from binquant_tpu.regime import ContextConfig
+
+        rng = np.random.default_rng(31)
+        cfg = ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5)
+        market = build_market(rng, n_symbols=8, n_bars=60, drift=0.004)
+        buf, rows, ts0 = load_buffer(market)
+        ctx1, carry1 = run_kernel(buf, rows, ts0, cfg=cfg)
+        assert bool(ctx1.valid)
+        assert int(ctx1.previous_market_regime) == -1
+
+        # refinement at the SAME timestamp with crashed closes: still no
+        # strictly-older context -> no previous, no transition event
+        crash = {}
+        for s, df in market.items():
+            df = df.copy()
+            df.loc[df.index[-1], "close"] = float(df["close"].iloc[-2]) * 0.91
+            df.loc[df.index[-1], "low"] = float(df["close"].iloc[-1]) * 0.99
+            crash[s] = df
+        buf2, _, _ = load_buffer(crash)
+        ctx2, carry2 = run_kernel(buf2, rows, ts0, carry=carry1, cfg=cfg)
+        assert bool(ctx2.valid)
+        assert int(ctx2.previous_market_regime) == -1
+        assert int(ctx2.market_regime_transition) == -1
+
+        # a strictly newer tick promotes the LATEST refinement (ctx2), not
+        # the first evaluation
+        nxt = {}
+        for s, df in crash.items():
+            last = df.iloc[-1]
+            t1 = int(last["open_time"]) + 900_000
+            row = dict(last)
+            px = float(last["close"]) * 1.002
+            row.update(
+                open_time=t1, close_time=t1 + 899_999, open=last["close"],
+                high=px * 1.001, low=float(last["close"]) * 0.999, close=px,
+            )
+            nxt[s] = pd.concat([df, pd.DataFrame([row])], ignore_index=True)
+        buf3, rows3, ts1 = load_buffer(nxt)
+        ctx3, _ = run_kernel(buf3, rows3, ts1, carry=carry2, cfg=cfg)
+        assert bool(ctx3.valid)
+        assert int(ctx3.previous_market_regime) == int(ctx2.market_regime)

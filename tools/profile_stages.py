@@ -1,0 +1,230 @@
+"""Per-stage latency profiler for the tick step (VERDICT r1 item 2/6).
+
+Times each stage of the evaluation pipeline separately (jitted, warmed,
+block_until_ready) at bench scale, plus transfer/RTT costs that a tunneled
+device makes dominant. Run:
+
+    python tools/profile_stages.py [--symbols 2048] [--window 400]
+
+Prints a stage table; use it to direct kernel work instead of guessing.
+Optionally dumps a jax.profiler trace with --trace <dir>.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _bench(fn, *args, iters=8, warmup=2):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(times)), float(np.max(times))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--symbols", type=int, default=2048)
+    parser.add_argument("--window", type=int, default=400)
+    parser.add_argument("--trace", type=str, default=None)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.buffer import NUM_FIELDS, Field, apply_updates
+    from binquant_tpu.engine.step import (
+        default_host_inputs,
+        initial_engine_state,
+        pad_updates,
+        tick_step,
+    )
+    from binquant_tpu.ops.indicators import log_returns, rolling_beta_corr
+    from binquant_tpu.regime.context import ContextConfig, compute_market_context
+    from binquant_tpu.strategies.features import compute_feature_pack
+    from binquant_tpu.strategies.spike_hunter import detect_spikes
+
+    S, W = args.symbols, args.window
+    print(f"device={jax.devices()[0].platform} S={S} W={W}", file=sys.stderr)
+    rng = np.random.default_rng(7)
+    cfg = ContextConfig()
+    state = initial_engine_state(S, window=W)
+    t0 = 1_753_000_000
+    px = 20.0 + rng.random(S).astype(np.float32) * 100
+
+    def make_updates(ts_s, px):
+        rows = np.arange(S, dtype=np.int32)
+        ts = np.full(S, ts_s, dtype=np.int32)
+        closes = px * (1 + rng.normal(0, 0.004, S))
+        vals = np.zeros((S, NUM_FIELDS), dtype=np.float32)
+        vals[:, Field.OPEN] = px
+        vals[:, Field.CLOSE] = closes
+        vals[:, Field.HIGH] = np.maximum(px, closes) * 1.002
+        vals[:, Field.LOW] = np.minimum(px, closes) * 0.998
+        vals[:, Field.VOLUME] = np.abs(rng.normal(1000, 150, S))
+        vals[:, Field.QUOTE_VOLUME] = vals[:, Field.VOLUME] * closes
+        vals[:, Field.NUM_TRADES] = 150
+        vals[:, Field.DURATION_S] = 900
+        return rows, ts, vals, closes
+
+    # fill buffers (chunked to keep startup fast)
+    for b in range(W):
+        rows, ts, vals, px = make_updates(t0 + b * 900, px)
+        state = state._replace(
+            buf5=apply_updates(state.buf5, rows, ts, vals),
+            buf15=apply_updates(state.buf15, rows, ts, vals),
+        )
+    jax.block_until_ready(state.buf15.values)
+
+    now = t0 + W * 900
+    rows, ts, vals, px = make_updates(now, px)
+    upd = pad_updates(rows, ts, vals, size=S)
+    inputs = default_host_inputs(S)._replace(
+        tracked=jnp.asarray(np.ones(S, dtype=bool)),
+        btc_row=np.int32(0),
+        timestamp_s=np.int32(now),
+        timestamp5_s=np.int32(now),
+    )
+    # device-resident copies for compute-only timings
+    upd_dev = jax.device_put(upd)
+    inputs_dev = jax.device_put(inputs)
+    jax.block_until_ready((upd_dev, inputs_dev))
+
+    results: list[tuple[str, float, float]] = []
+
+    def stage(name, fn, *a, **kw):
+        med, mx = _bench(fn, *a, **kw)
+        results.append((name, med, mx))
+        print(f"{name:38s} p50={med:9.3f} ms  max={mx:9.3f} ms", file=sys.stderr)
+
+    # --- transfer / RTT costs
+    tiny = jax.jit(lambda x: x + 1)
+    tiny_in = jax.device_put(np.zeros(1, np.float32))
+    stage("rtt: tiny jit + D2H fetch", lambda: np.asarray(tiny(tiny_in)))
+    stage("h2d: update batch (3 arrays)", lambda: jax.block_until_ready(jax.device_put(upd)))
+    stage("h2d: HostInputs (16 leaves)", lambda: jax.block_until_ready(jax.device_put(inputs)))
+
+    # --- compute stages (inputs already on device)
+    jitted_apply = jax.jit(apply_updates)
+    stage("apply_updates (one buffer)", jitted_apply, state.buf5, *upd_dev)
+
+    jitted_pack = jax.jit(compute_feature_pack)
+    stage("compute_feature_pack", jitted_pack, state.buf15)
+
+    jitted_spikes = jax.jit(detect_spikes)
+    stage("detect_spikes", jitted_spikes, state.buf15)
+
+    fresh = jnp.ones(S, dtype=bool)
+
+    def ctx_fn(buf, fresh, tracked, btc_row, ts, carry):
+        return compute_market_context(buf, fresh, tracked, btc_row, ts, carry, cfg)
+
+    jitted_ctx = jax.jit(ctx_fn)
+    stage(
+        "compute_market_context",
+        jitted_ctx,
+        state.buf15,
+        fresh,
+        inputs_dev.tracked,
+        inputs_dev.btc_row,
+        inputs_dev.timestamp_s,
+        state.regime_carry,
+    )
+
+    def beta_fn(buf):
+        close15 = buf.values[:, :, Field.CLOSE]
+        rets = log_returns(close15)
+        return rolling_beta_corr(rets, rets[0][None, :], window=50)
+
+    stage("btc beta/corr", jax.jit(beta_fn), state.buf15)
+
+    # --- strategy kernels, each as its own jit over prebuilt packs/context
+    pack5 = jitted_pack(state.buf5)
+    pack15 = jitted_pack(state.buf15)
+    ctx, _ = jitted_ctx(
+        state.buf15, fresh, inputs_dev.tracked, inputs_dev.btc_row,
+        inputs_dev.timestamp_s, state.regime_carry,
+    )
+    spikes = jitted_spikes(state.buf15)
+    jax.block_until_ready((pack5, pack15, ctx, spikes))
+
+    from binquant_tpu.strategies.activity_burst_pump import activity_burst_pump
+    from binquant_tpu.strategies.dormant import (
+        bb_extreme_reversion,
+        buy_low_sell_high,
+        buy_the_dip,
+        inverse_price_tracker,
+        range_bb_rsi_mean_reversion,
+        range_failed_breakout_fade,
+        relative_strength_reversal_range,
+        supertrend_swing_reversal,
+        twap_momentum_sniper,
+    )
+    from binquant_tpu.strategies.ladder_deployer import ladder_deployer
+    from binquant_tpu.strategies.liquidation_sweep_pump import liquidation_sweep_pump
+    from binquant_tpu.strategies.mean_reversion_fade import mean_reversion_fade
+    from binquant_tpu.strategies.price_tracker import price_tracker
+    from binquant_tpu.regime.routing import allows_long_autotrade_mask
+
+    f = jnp.full((S,), jnp.nan, dtype=jnp.float32)
+    nan = jnp.asarray(jnp.nan, dtype=jnp.float32)
+    long_gate = jax.jit(allows_long_autotrade_mask)(ctx)
+    mrf_last = state.mrf_last_emitted
+    pt_last = state.pt_last_signal_close
+
+    stage("abp", jax.jit(activity_burst_pump), state.buf5, ctx)
+    stage("price_tracker", jax.jit(price_tracker), pack5, ctx, jnp.asarray(False), pt_last)
+    stage("liquidation_sweep_pump", jax.jit(liquidation_sweep_pump), state.buf15, ctx, f, nan, nan, nan)
+    stage("mean_reversion_fade", jax.jit(mean_reversion_fade), pack15, jnp.asarray(True), mrf_last)
+    stage("ladder_deployer", jax.jit(ladder_deployer), pack15, ctx, jnp.asarray(False), jnp.asarray(True))
+    stage("supertrend_swing_reversal", jax.jit(supertrend_swing_reversal), state.buf5, pack5, ctx, long_gate, nan, nan, jnp.asarray(False))
+    stage("twap_momentum_sniper", jax.jit(twap_momentum_sniper), state.buf15, pack5)
+    stage("buy_low_sell_high", jax.jit(buy_low_sell_high), state.buf15, pack15, jnp.asarray(False))
+    stage("buy_the_dip", jax.jit(buy_the_dip), state.buf15, pack15, ctx, jnp.asarray(False))
+    stage("bb_extreme_reversion", jax.jit(bb_extreme_reversion), state.buf15, pack15, ctx)
+    stage("inverse_price_tracker", jax.jit(inverse_price_tracker), pack5, ctx)
+    stage("range_bb_rsi_mean_reversion", jax.jit(range_bb_rsi_mean_reversion), state.buf15, pack15, ctx)
+    stage("range_failed_breakout_fade", jax.jit(range_failed_breakout_fade), spikes, ctx)
+    stage("relative_strength_reversal_range", jax.jit(relative_strength_reversal_range), state.buf15, pack15, ctx)
+
+    # --- end-to-end
+    def full_dev():
+        s2, out = tick_step(state, upd_dev, upd_dev, inputs_dev, cfg)
+        return out.summary.trigger
+
+    stage("tick_step (device-resident inputs)", full_dev)
+
+    def full_host():
+        s2, out = tick_step(state, upd, upd, inputs, cfg)
+        return np.asarray(out.summary.trigger)
+
+    stage("tick_step (host inputs + D2H)", full_host)
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            for _ in range(3):
+                s2, out = tick_step(state, upd_dev, upd_dev, inputs_dev, cfg)
+                jax.block_until_ready(out.summary.trigger)
+        print(f"trace written to {args.trace}", file=sys.stderr)
+
+    total_compute = sum(m for n, m, _ in results if not n.startswith(("rtt", "h2d", "tick_step")))
+    print(f"{'sum of compute stages':38s} p50={total_compute:9.3f} ms", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -241,6 +241,28 @@ class SymbolRegistry:
     def names(self) -> list[str]:
         return sorted(self._name_to_row)
 
+    def to_mapping(self) -> dict[str, int]:
+        """symbol -> row snapshot for checkpointing."""
+        return dict(self._name_to_row)
+
+    def restore(self, mapping: dict[str, int]) -> None:
+        """Rebuild the exact symbol↔row assignment from a checkpoint
+        (row-accurate so restored device buffers line up)."""
+        self._name_to_row = {}
+        self._row_to_name = {}
+        used = set()
+        for symbol, row in mapping.items():
+            row = int(row)
+            if not 0 <= row < self.capacity:
+                raise BufferCapacityError(
+                    f"checkpoint row {row} outside capacity {self.capacity}"
+                )
+            key = self._norm(symbol)
+            self._name_to_row[key] = row
+            self._row_to_name[row] = key
+            used.add(row)
+        self._free = [r for r in range(self.capacity - 1, -1, -1) if r not in used]
+
     @property
     def active_rows(self) -> np.ndarray:
         """(S,) bool mask of occupied rows."""

@@ -1,0 +1,140 @@
+"""Checkpoint/resume of the device-resident engine state.
+
+The reference rebuilds everything on restart (REST refetch per symbol) and
+explicitly pays a 30-minute regime-stability cold-start because the first
+context after boot can't be "stable" (``market_regime/regime_routing.py:41-44``,
+SURVEY.md §5). Here the EngineState pytree (both ring buffers, RegimeCarry
+incl. ``regime_stable_since``, strategy dedupe carries), the symbol↔row
+registry, and the host-side carries snapshot to one ``np.savez`` archive;
+load-on-boot restores identical next-tick behavior — no stability
+cold-start, no backfill burst.
+
+Format: the EngineState's flattened leaves in tree order (the treedef is
+code-defined, so only shapes/count are validated), plus JSON blobs for the
+registry mapping and host carries.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+CKPT_VERSION = 1
+
+
+def save_state(
+    path: str | Path,
+    state,
+    registry,
+    host_carries: dict | None = None,
+) -> None:
+    """Atomically write the engine snapshot (tmp file + rename)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(state)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    meta = {
+        "version": CKPT_VERSION,
+        "n_leaves": len(leaves),
+        "registry": registry.to_mapping(),
+        "host_carries": host_carries or {},
+    }
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextl_suppress(FileNotFoundError):
+            os.unlink(tmp)
+        raise
+
+
+def contextl_suppress(*exc):
+    import contextlib
+
+    return contextlib.suppress(*exc)
+
+
+def load_state(path: str | Path, template_state, registry):
+    """Restore (state, host_carries) from ``path`` into the template's
+    pytree structure; the registry is rebuilt row-accurately in place.
+
+    Raises ValueError on shape/count mismatch (capacity or window changed
+    — start cold instead).
+    """
+    import jax
+
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta"].tobytes()).decode())
+        if meta["version"] != CKPT_VERSION:
+            raise ValueError(f"checkpoint version {meta['version']} unsupported")
+        t_leaves, treedef = jax.tree_util.tree_flatten(template_state)
+        if meta["n_leaves"] != len(t_leaves):
+            raise ValueError(
+                f"checkpoint has {meta['n_leaves']} leaves, "
+                f"engine expects {len(t_leaves)}"
+            )
+        leaves = []
+        for i, t in enumerate(t_leaves):
+            arr = data[f"leaf_{i}"]
+            if tuple(arr.shape) != tuple(np.shape(t)):
+                raise ValueError(
+                    f"checkpoint leaf {i} shape {arr.shape} != {np.shape(t)} "
+                    "(capacity/window changed — start cold)"
+                )
+            leaves.append(arr)
+    import jax.numpy as jnp
+
+    state = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(a) for a in leaves]
+    )
+    registry.restore(meta["registry"])
+    return state, meta.get("host_carries", {})
+
+
+class CheckpointManager:
+    """Periodic snapshots for the SignalEngine (save every N ticks)."""
+
+    def __init__(self, path: str | Path, every_ticks: int = 60) -> None:
+        self.path = Path(path)
+        self.every_ticks = max(int(every_ticks), 1)
+
+    def maybe_save(self, engine) -> bool:
+        if engine.ticks_processed % self.every_ticks != 0:
+            return False
+        try:
+            save_state(
+                self.path,
+                engine.state,
+                engine.registry,
+                host_carries=engine.host_carries(),
+            )
+            return True
+        except Exception:
+            logging.exception("checkpoint save failed; continuing")
+            return False
+
+    def try_restore(self, engine) -> bool:
+        if not self.path.exists():
+            return False
+        try:
+            state, carries = load_state(self.path, engine.state, engine.registry)
+        except Exception:
+            logging.exception("checkpoint restore failed; starting cold")
+            return False
+        engine.state = state
+        engine.restore_host_carries(carries)
+        logging.info(
+            "restored checkpoint: %d symbols, tick %s",
+            len(engine.registry),
+            carries.get("ticks_processed"),
+        )
+        return True

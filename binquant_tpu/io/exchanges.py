@@ -89,6 +89,90 @@ class KucoinApi(_RestClient):
         return list(data.get("data", []))[:limit]
 
 
+INTERVAL_SECONDS = {"5m": 300, "15m": 900}
+# engine interval key -> per-exchange REST interval string
+BINANCE_INTERVALS = {"5m": "5m", "15m": "15m"}
+KUCOIN_INTERVALS = {"5m": "5min", "15m": "15min"}
+
+
+def normalize_binance_klines(symbol: str, rows: list[list]) -> list[dict]:
+    """Binance uiKlines rows → ExtendedKline dicts (oldest first).
+
+    Row: [open_time_ms, open, high, low, close, volume, close_time_ms,
+    quote_asset_volume, num_trades, taker_buy_base, taker_buy_quote, _].
+    """
+    out = []
+    for r in rows:
+        out.append(
+            {
+                "symbol": symbol,
+                "open_time": int(r[0]),
+                "close_time": int(r[6]),
+                "open": float(r[1]),
+                "high": float(r[2]),
+                "low": float(r[3]),
+                "close": float(r[4]),
+                "volume": float(r[5]),
+                "quote_asset_volume": float(r[7]),
+                "number_of_trades": float(r[8]),
+                "taker_buy_base_volume": float(r[9]),
+                "taker_buy_quote_volume": float(r[10]),
+            }
+        )
+    return out
+
+
+def normalize_kucoin_klines(
+    symbol: str, rows: list[list], interval_s: int
+) -> list[dict]:
+    """KuCoin /market/candles rows (NEWEST first) → ExtendedKline dicts
+    (oldest first). Row: [time_s, open, close, high, low, volume, turnover].
+    """
+    out = []
+    for r in reversed(rows):
+        t = int(r[0]) * 1000
+        out.append(
+            {
+                "symbol": symbol,
+                "open_time": t,
+                "close_time": t + interval_s * 1000 - 1,
+                "open": float(r[1]),
+                "high": float(r[3]),
+                "low": float(r[4]),
+                "close": float(r[2]),
+                "volume": float(r[5]),
+                "quote_asset_volume": float(r[6]),
+                "number_of_trades": 0.0,
+                "taker_buy_base_volume": 0.0,
+                "taker_buy_quote_volume": 0.0,
+            }
+        )
+    return out
+
+
+def make_history_fetcher(api, exchange_id: str = "binance", limit: int = 400):
+    """(symbol, interval_key in {'5m','15m'}) -> normalized kline dicts.
+
+    The startup-backfill seam (klines_provider.py:196-222): exchanges differ
+    in interval naming, row layout, and ordering; the engine sees one shape.
+    """
+    kucoin = exchange_id.lower().startswith("kucoin")
+
+    def fetch(symbol: str, interval_key: str) -> list[dict]:
+        interval_s = INTERVAL_SECONDS[interval_key]
+        if kucoin:
+            rows = api.get_ui_klines(
+                symbol, KUCOIN_INTERVALS[interval_key], limit=limit
+            )
+            return normalize_kucoin_klines(symbol, rows, interval_s)
+        rows = api.get_ui_klines(
+            symbol, BINANCE_INTERVALS[interval_key], limit=limit
+        )
+        return normalize_binance_klines(symbol, rows)
+
+    return fetch
+
+
 class KucoinFutures(_RestClient):
     BASE = "https://api-futures.kucoin.com"
 

@@ -152,6 +152,71 @@ class SignalEngine:
                 kline.get("symbol"),
             )
 
+    # -- startup history backfill ---------------------------------------------
+
+    def _flush_batchers(self) -> None:
+        """Drain both batchers into the device buffers (update-only)."""
+        empty = pad_updates(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros((0, 10), np.float32), size=4,
+        )
+        b5 = [pad_updates(*b) for b in self.batcher5.drain()]
+        b15 = [pad_updates(*b) for b in self.batcher15.drain()]
+        for i in range(max(len(b5), len(b15))):
+            self.state = apply_updates_step(
+                self.state,
+                b5[i] if i < len(b5) else empty,
+                b15[i] if i < len(b15) else empty,
+            )
+
+    def backfill(
+        self,
+        symbols: list[str],
+        fetch,
+        now_ms: int | None = None,
+        chunk: int = 50,
+    ) -> int:
+        """Seed both interval buffers via REST history before going live.
+
+        The reference seeds 400 bars/symbol at boot and per message
+        (klines_provider.py:196-222,278-293); without this the engine is
+        strategy-blind for ~MIN_BARS*15m (~25 h) after a cold start.
+        ``fetch(symbol, '5m'|'15m')`` returns normalized kline dicts (see
+        ``io.exchanges.make_history_fetcher``). Only bars closed before
+        ``now_ms`` are loaded. Per-symbol failures are logged and skipped;
+        buffers are flushed every ``chunk`` symbols to bound host memory.
+        """
+        now = int(now_ms if now_ms is not None else time.time() * 1000)
+        ordered = [self.btc_symbol] + [
+            s for s in symbols if s != self.btc_symbol
+        ]
+        loaded = 0
+        for i, symbol in enumerate(ordered):
+            for interval_key, batcher in (
+                ("5m", self.batcher5),
+                ("15m", self.batcher15),
+            ):
+                try:
+                    klines = fetch(symbol, interval_key)
+                except Exception:
+                    logging.exception(
+                        "backfill fetch failed for %s %s; skipping",
+                        symbol,
+                        interval_key,
+                    )
+                    continue
+                for k in klines:
+                    if int(k["close_time"]) <= now:
+                        batcher.add(k)
+                        loaded += 1
+            if (i + 1) % chunk == 0:
+                self._flush_batchers()
+        self._flush_batchers()
+        logging.info(
+            "backfill complete: %d bars across %d symbols", loaded, len(ordered)
+        )
+        return loaded
+
     # -- periodic jobs (15m bucket cadence) ----------------------------------
 
     async def _refresh_market_breadth(self, bucket: int) -> None:

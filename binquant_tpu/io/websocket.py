@@ -72,19 +72,25 @@ def parse_binance_kline_frame(raw: str | bytes) -> dict | None:
 
 
 class KlinesConnector:
-    """Binance kline streams over N chunked connections with reconnect."""
+    """Binance kline streams over N chunked connections with reconnect.
+
+    Subscribes BOTH engine intervals (5m + 15m) per symbol: the engine's
+    dual buffers each need live frames (the reference re-fetches both
+    interval histories per message instead — klines_provider.py:201-210);
+    a 15m-only subscription starves buf5 and silences the 5m strategies.
+    """
 
     def __init__(
         self,
         queue: asyncio.Queue,
         symbols: list[SymbolModel],
-        interval: str = "15m",
+        intervals: tuple[str, ...] = ("5m", "15m"),
         connect: Callable[..., Any] | None = None,
         max_markets_per_client: int = MAX_MARKETS_PER_CLIENT,
     ) -> None:
         self.queue = queue
         self.symbols = filter_fiat_symbols(symbols)
-        self.interval = interval
+        self.intervals = intervals
         self.max_markets_per_client = max_markets_per_client
         if connect is None:
             import websockets
@@ -94,11 +100,20 @@ class KlinesConnector:
         self._tasks: list[asyncio.Task] = []
 
     def _chunks(self) -> list[list[str]]:
-        streams = [
-            f"{s.id.lower()}@kline_{self.interval}" for s in self.symbols
-        ]
-        n = self.max_markets_per_client
-        return [streams[i : i + n] for i in range(0, len(streams), n)]
+        """Chunk SYMBOLS so each client stays under the stream cap with
+        every interval subscribed."""
+        per_client = max(self.max_markets_per_client // len(self.intervals), 1)
+        chunks = []
+        for i in range(0, len(self.symbols), per_client):
+            chunk = self.symbols[i : i + per_client]
+            chunks.append(
+                [
+                    f"{s.id.lower()}@kline_{iv}"
+                    for s in chunk
+                    for iv in self.intervals
+                ]
+            )
+        return chunks
 
     async def _run_client(self, idx: int, markets: list[str]) -> None:
         """One connection: subscribe, pump frames, reconnect on close
@@ -148,36 +163,271 @@ class KlinesConnector:
         self._tasks.clear()
 
 
+# engine interval keys -> KuCoin ws interval strings
+KUCOIN_WS_INTERVALS = {"5m": "5min", "15m": "15min"}
+_KUCOIN_INTERVAL_S = {"5min": 300, "15min": 900, "1min": 60, "1hour": 3600}
+
+
+def parse_kucoin_candle_message(
+    raw: str | bytes, market_type: str
+) -> tuple[str, str, dict] | None:
+    """One KuCoin ws frame → (symbol, interval, candle dict) or None.
+
+    Spot topic ``/market/candles:{sym}_{iv}`` carries
+    ``data.candles = [time_s, open, close, high, low, volume, turnover]``;
+    futures ``/contractMarket/limitCandle:{sym}_{iv}`` carries
+    ``[time_s, open, high, low, close, volume]``. Both describe the candle
+    in progress — closedness is decided by the caller when a newer open
+    time appears (KucoinKlinesConnector._on_candle).
+    """
+    try:
+        msg = json.loads(raw)
+    except Exception as e:
+        logging.error("Failed to decode kucoin ws message: %s", e)
+        return None
+    if msg.get("type") != "message":
+        return None
+    topic = str(msg.get("topic", ""))
+    data = msg.get("data") or {}
+    candles = data.get("candles")
+    if not candles or ":" not in topic:
+        return None
+    try:
+        sym_iv = topic.split(":", 1)[1]
+        symbol, interval = sym_iv.rsplit("_", 1)
+    except ValueError:
+        return None
+    interval_s = _KUCOIN_INTERVAL_S.get(interval)
+    if interval_s is None:
+        return None
+    t = int(float(candles[0])) * 1000
+    if market_type == "futures":
+        o, h, low, c = (float(candles[i]) for i in (1, 2, 3, 4))
+        volume = float(candles[5]) if len(candles) > 5 else 0.0
+        turnover = 0.0
+    else:
+        o, c, h, low = (float(candles[i]) for i in (1, 2, 3, 4))
+        volume = float(candles[5]) if len(candles) > 5 else 0.0
+        turnover = float(candles[6]) if len(candles) > 6 else 0.0
+    return (
+        symbol,
+        interval,
+        {
+            "symbol": symbol.replace("-", ""),
+            "open_time": t,
+            "close_time": t + interval_s * 1000 - 1,
+            "open": o,
+            "high": h,
+            "low": low,
+            "close": c,
+            "volume": volume,
+            "quote_asset_volume": turnover,
+            "number_of_trades": 0.0,
+            "taker_buy_base_volume": 0.0,
+            "taker_buy_quote_volume": 0.0,
+        },
+    )
+
+
+class KucoinKlinesConnector:
+    """KuCoin spot/futures kline streams (websocket_factory.py:55-143).
+
+    Protocol: POST the bullet endpoint for a token + ws endpoint, connect
+    with ``?token=``, subscribe topics in batches of ≤300 per connection,
+    answer the ping cadence the bullet response dictates. KuCoin pushes the
+    *in-progress* candle; a candle is emitted as closed when a frame with a
+    newer open time arrives for the same (symbol, interval).
+    """
+
+    SPOT_BULLET = "https://api.kucoin.com/api/v1/bullet-public"
+    FUTURES_BULLET = "https://api-futures.kucoin.com/api/v1/bullet-public"
+
+    def __init__(
+        self,
+        queue: asyncio.Queue,
+        symbols: list[SymbolModel],
+        market_type: str = "futures",
+        intervals: tuple[str, ...] = ("5min", "15min"),
+        connect: Callable[..., Any] | None = None,
+        token_fetch: Callable[[], tuple[str, str, float]] | None = None,
+        max_topics_per_connection: int = MAX_TOPICS_PER_CONNECTION,
+    ) -> None:
+        self.queue = queue
+        self.market_type = market_type
+        symbols = filter_fiat_symbols(symbols)
+        if market_type == "futures":
+            # futures universe: *USDTM contract ids (websocket_factory.py:93)
+            self.topic_symbols = [
+                s.id for s in symbols if s.id.endswith("USDTM")
+            ]
+        else:
+            self.topic_symbols = [
+                f"{s.base_asset}-{s.quote_asset}" if s.base_asset else s.id
+                for s in symbols
+            ]
+        self.intervals = intervals
+        self.max_topics_per_connection = max_topics_per_connection
+        if connect is None:
+            import websockets
+
+            connect = websockets.connect
+        self._connect = connect
+        self._token_fetch = token_fetch or self._default_token_fetch
+        self._tasks: list[asyncio.Task] = []
+        # (symbol, interval) -> last in-progress candle dict
+        self._last_candle: dict[tuple[str, str], dict] = {}
+
+    def _default_token_fetch(self) -> tuple[str, str, float]:
+        """(ws_endpoint, token, ping_interval_s) via the public bullet."""
+        import httpx
+
+        url = (
+            self.FUTURES_BULLET
+            if self.market_type == "futures"
+            else self.SPOT_BULLET
+        )
+        data = httpx.post(url, timeout=10).json()["data"]
+        server = data["instanceServers"][0]
+        return (
+            server["endpoint"],
+            data["token"],
+            float(server.get("pingInterval", 18000)) / 1000.0,
+        )
+
+    def _topic(self, symbol: str, interval: str) -> str:
+        if self.market_type == "futures":
+            return f"/contractMarket/limitCandle:{symbol}_{interval}"
+        return f"/market/candles:{symbol}_{interval}"
+
+    def _chunks(self) -> list[list[str]]:
+        topics = [
+            self._topic(sym, iv)
+            for sym in self.topic_symbols
+            for iv in self.intervals
+        ]
+        n = self.max_topics_per_connection
+        return [topics[i : i + n] for i in range(0, len(topics), n)]
+
+    async def _on_candle(self, symbol: str, interval: str, candle: dict) -> None:
+        """Track the in-progress candle; emit the previous one as closed
+        when the open time advances."""
+        key = (symbol, interval)
+        prev = self._last_candle.get(key)
+        if prev is not None and candle["open_time"] > prev["open_time"]:
+            await self.queue.put(prev)
+        self._last_candle[key] = candle
+
+    async def _run_client(self, idx: int, topics: list[str]) -> None:
+        backoff = 1.0
+        while True:
+            try:
+                endpoint, token, ping_interval = self._token_fetch()
+                url = f"{endpoint}?token={token}&connectId=bq{idx}"
+                async with self._connect(url) as ws:
+                    for i, topic in enumerate(topics):
+                        await ws.send(
+                            json.dumps(
+                                {
+                                    "id": i + 1,
+                                    "type": "subscribe",
+                                    "topic": topic,
+                                    "privateChannel": False,
+                                    "response": False,
+                                }
+                            )
+                        )
+                    logging.info(
+                        "kucoin %s client %d subscribed %d topics",
+                        self.market_type,
+                        idx,
+                        len(topics),
+                    )
+                    backoff = 1.0
+
+                    async def ping_loop() -> None:
+                        n = 0
+                        while True:
+                            await asyncio.sleep(ping_interval)
+                            n += 1
+                            await ws.send(
+                                json.dumps({"id": f"ping{n}", "type": "ping"})
+                            )
+
+                    ping_task = asyncio.create_task(ping_loop())
+                    try:
+                        async for raw in ws:
+                            parsed = parse_kucoin_candle_message(
+                                raw, self.market_type
+                            )
+                            if parsed is not None:
+                                await self._on_candle(*parsed)
+                    finally:
+                        ping_task.cancel()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logging.warning(
+                    "kucoin ws client %d dropped (%s); reconnecting in %.0fs",
+                    idx,
+                    e,
+                    backoff,
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    async def start_stream(self) -> None:
+        chunks = self._chunks()
+        if not chunks:
+            raise WebSocketError("no kucoin topics to subscribe")
+        for idx, topics in enumerate(chunks):
+            self._tasks.append(
+                asyncio.create_task(self._run_client(idx, topics))
+            )
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+
 class WebsocketClientFactory:
     """Chooses the exchange connector from autotrade settings
-    (websocket_factory.py:21-158)."""
+    (websocket_factory.py:21-158). Both engine intervals are subscribed
+    regardless of exchange — the dual 5m/15m buffers each need live frames.
+    """
 
     def __init__(
         self,
         queue: asyncio.Queue,
         symbols: list[SymbolModel],
         exchange_id: str = "binance",
-        interval: str = "15m",
+        market_type: str = "futures",
         connect: Callable[..., Any] | None = None,
+        token_fetch: Callable[[], tuple[str, str, float]] | None = None,
     ) -> None:
         self.queue = queue
         self.symbols = symbols
         self.exchange_id = exchange_id
-        self.interval = interval
+        self.market_type = market_type
         self._connect = connect
+        self._token_fetch = token_fetch
 
-    def create_connector(self) -> KlinesConnector:
-        # KuCoin spot/futures use the same chunked-subscription shape with a
-        # lower per-connection topic cap (websocket_factory.py:30,86-143).
-        max_markets = (
-            MAX_TOPICS_PER_CONNECTION
-            if self.exchange_id == "kucoin"
-            else MAX_MARKETS_PER_CLIENT
-        )
+    def create_connector(self) -> KlinesConnector | KucoinKlinesConnector:
+        if self.exchange_id.lower().startswith("kucoin"):
+            return KucoinKlinesConnector(
+                self.queue,
+                self.symbols,
+                market_type=self.market_type,
+                intervals=tuple(
+                    KUCOIN_WS_INTERVALS[k] for k in ("5m", "15m")
+                ),
+                connect=self._connect,
+                token_fetch=self._token_fetch,
+            )
         return KlinesConnector(
             self.queue,
             self.symbols,
-            interval=self.interval,
+            intervals=("5m", "15m"),
             connect=self._connect,
-            max_markets_per_client=max_markets,
         )

@@ -61,12 +61,31 @@ async def run_live() -> None:
         window=config.window_bars,
     )
 
+    # Seed both interval buffers with REST history so strategies can fire
+    # on the first live tick (klines_provider.py:278-293) instead of being
+    # blind for MIN_BARS * 15m after a cold start.
+    from binquant_tpu.io.exchanges import (
+        BinanceApi,
+        KucoinApi,
+        make_history_fetcher,
+    )
+    from binquant_tpu.io.websocket import filter_fiat_symbols
+
+    exchange_id = str(autotrade_settings.exchange_id)
+    history_api = (
+        KucoinApi() if exchange_id.lower().startswith("kucoin") else BinanceApi()
+    )
+    tracked = [s.id for s in filter_fiat_symbols(all_symbols)]
+    engine.backfill(tracked, make_history_fetcher(history_api, exchange_id))
+
     queue: asyncio.Queue = asyncio.Queue()
     factory = WebsocketClientFactory(
         queue,
         all_symbols,
-        exchange_id=autotrade_settings.exchange_id,
-        interval=autotrade_settings.candlestick_interval,
+        exchange_id=exchange_id,
+        market_type=getattr(
+            autotrade_settings.market_type, "value", autotrade_settings.market_type
+        ),
     )
     connector = factory.create_connector()
     await connector.start_stream()

@@ -123,15 +123,21 @@ class TestWsParsing:
         assert parse_binance_kline_frame('{"e":"depthUpdate"}') is None
         assert parse_binance_kline_frame("not json{") is None
 
-    def test_symbol_chunking(self):
-        symbols = [SymbolModel(id=f"S{i}USDT") for i in range(950)]
+    def test_symbol_chunking_dual_interval(self):
+        symbols = [SymbolModel(id=f"S{i}USDT") for i in range(450)]
         conn = KlinesConnector(
             asyncio.Queue(), symbols, connect=lambda *_: None,
             max_markets_per_client=400,
         )
         chunks = conn._chunks()
-        assert [len(c) for c in chunks] == [400, 400, 150]
-        assert chunks[0][0] == "s0usdt@kline_15m"
+        # 200 symbols/client x 2 intervals = 400 streams per connection
+        assert [len(c) for c in chunks] == [400, 400, 100]
+        assert chunks[0][0] == "s0usdt@kline_5m"
+        assert chunks[0][1] == "s0usdt@kline_15m"
+        # every symbol carries BOTH intervals
+        all_streams = [st for c in chunks for st in c]
+        assert "s37usdt@kline_5m" in all_streams
+        assert "s37usdt@kline_15m" in all_streams
 
     def test_fiat_filter(self):
         symbols = [

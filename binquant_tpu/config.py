@@ -145,3 +145,12 @@ class Config:
     @cached_property
     def heartbeat_path(self) -> str:
         return self._get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")
+
+    @cached_property
+    def checkpoint_path(self) -> str:
+        """Engine-state snapshot location; empty disables checkpointing."""
+        return self._get("BQT_CHECKPOINT_PATH", "/tmp/binquant_tpu.ckpt.npz")
+
+    @cached_property
+    def checkpoint_every_ticks(self) -> int:
+        return int(self._get("BQT_CHECKPOINT_EVERY_TICKS", "60"))

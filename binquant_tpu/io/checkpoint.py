@@ -16,6 +16,7 @@ registry mapping and host carries.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -52,15 +53,9 @@ def save_state(
             np.savez(f, __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays)
         os.replace(tmp, path)
     except BaseException:
-        with contextl_suppress(FileNotFoundError):
+        with contextlib.suppress(FileNotFoundError):
             os.unlink(tmp)
         raise
-
-
-def contextl_suppress(*exc):
-    import contextlib
-
-    return contextlib.suppress(*exc)
 
 
 def load_state(path: str | Path, template_state, registry):
@@ -107,8 +102,16 @@ class CheckpointManager:
         self.path = Path(path)
         self.every_ticks = max(int(every_ticks), 1)
 
+    def should_save(self, engine) -> bool:
+        """Cheap cadence check — callable inline from the event loop so a
+        thread dispatch is only paid for the ticks that actually save."""
+        return (
+            engine.ticks_processed > 0
+            and engine.ticks_processed % self.every_ticks == 0
+        )
+
     def maybe_save(self, engine) -> bool:
-        if engine.ticks_processed % self.every_ticks != 0:
+        if not self.should_save(engine):
             return False
         try:
             save_state(

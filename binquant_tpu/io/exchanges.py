@@ -83,16 +83,27 @@ class KucoinApi(_RestClient):
     def get_ui_klines(
         self, symbol: str, interval: str = "15min", limit: int = 400
     ) -> list[list]:
+        """Spot candles. ``symbol`` must be the DASHED KuCoin form
+        (``BTC-USDT``) — see ``make_history_fetcher`` for the translation
+        from engine ids. Raises on KuCoin error envelopes instead of
+        silently returning [] (a silent empty turns startup backfill into
+        a no-op)."""
         data = self._get(
             "/api/v1/market/candles", {"symbol": symbol, "type": interval}
         )
-        return list(data.get("data", []))[:limit]
+        code = str(data.get("code", "200000"))
+        if code != "200000":
+            raise RuntimeError(
+                f"kucoin candles error for {symbol}: {code} {data.get('msg')}"
+            )
+        return list(data.get("data") or [])[:limit]
 
 
 INTERVAL_SECONDS = {"5m": 300, "15m": 900}
 # engine interval key -> per-exchange REST interval string
 BINANCE_INTERVALS = {"5m": "5m", "15m": "15m"}
 KUCOIN_INTERVALS = {"5m": "5min", "15m": "15min"}
+KUCOIN_FUTURES_GRANULARITY = {"5m": 5, "15m": 15}  # minutes
 
 
 def normalize_binance_klines(symbol: str, rows: list[list]) -> list[dict]:
@@ -150,23 +161,71 @@ def normalize_kucoin_klines(
     return out
 
 
-def make_history_fetcher(api, exchange_id: str = "binance", limit: int = 400):
+def normalize_kucoin_futures_klines(
+    symbol: str, rows: list[list], interval_s: int
+) -> list[dict]:
+    """KuCoin futures /kline/query rows (oldest first) → ExtendedKline
+    dicts. Row: [time_ms, open, high, low, close, volume]."""
+    out = []
+    for r in rows:
+        t = int(r[0])
+        out.append(
+            {
+                "symbol": symbol,
+                "open_time": t,
+                "close_time": t + interval_s * 1000 - 1,
+                "open": float(r[1]),
+                "high": float(r[2]),
+                "low": float(r[3]),
+                "close": float(r[4]),
+                "volume": float(r[5]),
+                "quote_asset_volume": float(r[5]) * float(r[4]),
+                "number_of_trades": 0.0,
+                "taker_buy_base_volume": 0.0,
+                "taker_buy_quote_volume": 0.0,
+            }
+        )
+    return out
+
+
+def make_history_fetcher(
+    api,
+    exchange_id: str = "binance",
+    limit: int = 400,
+    market_type: str = "spot",
+    api_symbol_of=None,
+):
     """(symbol, interval_key in {'5m','15m'}) -> normalized kline dicts.
 
     The startup-backfill seam (klines_provider.py:196-222): exchanges differ
-    in interval naming, row layout, and ordering; the engine sees one shape.
+    in interval naming, row layout, ordering, AND symbol form — KuCoin spot
+    wants dashed ``BTC-USDT`` while the engine tracks ``BTCUSDT``, and
+    KuCoin futures contracts (``XBTUSDTM``) live on a different API.
+    ``api_symbol_of`` translates engine id → API symbol (identity when
+    omitted); the returned klines always carry the ENGINE id so the
+    registry sees one row per market.
     """
     kucoin = exchange_id.lower().startswith("kucoin")
+    futures = str(market_type).lower().endswith("futures")
+    to_api = api_symbol_of or (lambda s: s)
 
     def fetch(symbol: str, interval_key: str) -> list[dict]:
         interval_s = INTERVAL_SECONDS[interval_key]
+        api_symbol = to_api(symbol)
+        if kucoin and futures:
+            rows = api.get_ui_klines(
+                api_symbol,
+                KUCOIN_FUTURES_GRANULARITY[interval_key],
+                limit=limit,
+            )
+            return normalize_kucoin_futures_klines(symbol, rows, interval_s)
         if kucoin:
             rows = api.get_ui_klines(
-                symbol, KUCOIN_INTERVALS[interval_key], limit=limit
+                api_symbol, KUCOIN_INTERVALS[interval_key], limit=limit
             )
             return normalize_kucoin_klines(symbol, rows, interval_s)
         rows = api.get_ui_klines(
-            symbol, BINANCE_INTERVALS[interval_key], limit=limit
+            api_symbol, BINANCE_INTERVALS[interval_key], limit=limit
         )
         return normalize_binance_klines(symbol, rows)
 
@@ -194,6 +253,36 @@ class KucoinFutures(_RestClient):
             lot_size=float(data.get("lotSize", 1.0)),
             taker_fee_rate=float(data.get("takerFeeRate", 0.0006)),
         )
+
+    def get_ui_klines(
+        self, symbol: str, granularity_min: int = 15, limit: int = 400
+    ) -> list[list]:
+        """Futures contract candles (oldest first). Raises on KuCoin error
+        envelopes so backfill failures are visible, not silent.
+
+        Without an explicit time range the endpoint returns only its
+        server-default recent rows (well under 400), silently seeding a
+        fraction of the window — so the range is derived from ``limit``.
+        """
+        import time
+
+        now_ms = int(time.time() * 1000)
+        data = self._get(
+            "/api/v1/kline/query",
+            {
+                "symbol": symbol,
+                "granularity": granularity_min,
+                "from": now_ms - limit * granularity_min * 60_000,
+                "to": now_ms,
+            },
+        )
+        code = str(data.get("code", "200000"))
+        if code != "200000":
+            raise RuntimeError(
+                f"kucoin futures klines error for {symbol}: "
+                f"{code} {data.get('msg')}"
+            )
+        return list(data.get("data") or [])[-limit:]
 
     def get_mark_price(self, symbol: str) -> float:
         data = self._get(f"/api/v1/mark-price/{symbol}/current")["data"]

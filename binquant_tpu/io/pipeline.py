@@ -131,6 +131,8 @@ class SignalEngine:
         self.heartbeat_path = Path(config.heartbeat_path)
         self.ticks_processed = 0
         self.signals_emitted = 0
+        # optional CheckpointManager; consume_loop snapshots through it
+        self.checkpoint = None
 
     # -- ingest -------------------------------------------------------------
 
@@ -154,20 +156,37 @@ class SignalEngine:
 
     # -- startup history backfill ---------------------------------------------
 
-    def _flush_batchers(self) -> None:
-        """Drain both batchers into the device buffers (update-only)."""
-        empty = pad_updates(
+    @staticmethod
+    def _empty_updates():
+        return pad_updates(
             np.zeros(0, np.int32), np.zeros(0, np.int32),
             np.zeros((0, 10), np.float32), size=4,
         )
-        b5 = [pad_updates(*b) for b in self.batcher5.drain()]
-        b15 = [pad_updates(*b) for b in self.batcher15.drain()]
-        for i in range(max(len(b5), len(b15))):
+
+    def _fold_updates(self, batches5: list, batches15: list):
+        """Apply all but the FINAL sub-batch pair with the cheap
+        update-only step (ordered sub-batch replay — evaluating each would
+        advance dedupe carries and discard earlier signals); returns the
+        final (upd5, upd15) pair for the caller to apply or evaluate."""
+        empty = self._empty_updates()
+        upd5 = [pad_updates(*b) for b in batches5] or [empty]
+        upd15 = [pad_updates(*b) for b in batches15] or [empty]
+        n = max(len(upd5), len(upd15))
+        for i in range(n - 1):
             self.state = apply_updates_step(
                 self.state,
-                b5[i] if i < len(b5) else empty,
-                b15[i] if i < len(b15) else empty,
+                upd5[i] if i < len(upd5) else empty,
+                upd15[i] if i < len(upd15) else empty,
             )
+        return (
+            upd5[n - 1] if n - 1 < len(upd5) else empty,
+            upd15[n - 1] if n - 1 < len(upd15) else empty,
+        )
+
+    def _flush_batchers(self) -> None:
+        """Drain both batchers into the device buffers (update-only)."""
+        u5, u15 = self._fold_updates(self.batcher5.drain(), self.batcher15.drain())
+        self.state = apply_updates_step(self.state, u5, u15)
 
     def backfill(
         self,
@@ -303,24 +322,9 @@ class SignalEngine:
         _btc = self.registry.row_of(self.btc_symbol)
         btc_row = -1 if _btc is None else int(_btc)
 
-        empty = pad_updates(
-            np.zeros(0, np.int32), np.zeros(0, np.int32),
-            np.zeros((0, 10), np.float32), size=4,
-        )
-        upd5_list = [pad_updates(*b) for b in batches5] or [empty]
-        upd15_list = [pad_updates(*b) for b in batches15] or [empty]
-
-        # Ordered sub-batch replay: fold all but the FINAL sub-batch into the
-        # buffers with the cheap update-only step (evaluating each would
-        # advance dedupe carries and discard earlier signals), then run ONE
-        # full evaluation on the final state.
-        n = max(len(upd5_list), len(upd15_list))
-        for i in range(n - 1):
-            u5 = upd5_list[i] if i < len(upd5_list) else empty
-            u15 = upd15_list[i] if i < len(upd15_list) else empty
-            self.state = apply_updates_step(self.state, u5, u15)
-        u5 = upd5_list[n - 1] if n - 1 < len(upd5_list) else empty
-        u15 = upd15_list[n - 1] if n - 1 < len(upd15_list) else empty
+        # Ordered sub-batch replay: fold all but the FINAL sub-batch into
+        # the buffers, then run ONE full evaluation on the final state.
+        u5, u15 = self._fold_updates(batches5, batches15)
         inputs = default_host_inputs(self.capacity)._replace(
             tracked=jnp.asarray(self.registry.active_rows),
             btc_row=np.int32(btc_row),
@@ -450,6 +454,77 @@ class SignalEngine:
             kept.append(signal)
         return kept
 
+    def prune_symbols(self, keep: list[str]) -> int:
+        """Drop registry rows for symbols outside ``keep`` and clear their
+        buffer rows. Called after a checkpoint restore: universe churn
+        would otherwise leak rows across restarts until ``registry.add``
+        exhausts capacity and the boot crash-loops on the stale snapshot."""
+        import jax.numpy as jnp
+
+        from binquant_tpu.engine.buffer import reset_rows
+
+        keep_rows = {
+            r for r in (self.registry.row_of(s) for s in keep) if r is not None
+        }
+        stale = [
+            (sym, row)
+            for sym, row in self.registry.to_mapping().items()
+            if row not in keep_rows
+        ]
+        if not stale:
+            return 0
+        for sym, _ in stale:
+            self.registry.remove(sym)
+        rows = jnp.asarray(np.array([row for _, row in stale], np.int32))
+        self.state = self.state._replace(
+            buf5=reset_rows(self.state.buf5, rows),
+            buf15=reset_rows(self.state.buf15, rows),
+        )
+        logging.info("pruned %d symbols that left the universe", len(stale))
+        return len(stale)
+
+    # -- checkpoint/resume ------------------------------------------------------
+
+    def host_carries(self) -> dict:
+        """JSON-serializable host-side state that must survive a restart so
+        the first post-restore tick behaves identically: regime carry for
+        the quiet-hours override, per-bar emission dedupe, bucket-job
+        watermarks, and the notifier's transition dedupe. (The device-side
+        RegimeCarry incl. ``regime_stable_since`` rides in EngineState.)"""
+        return {
+            "saved_at_s": time.time(),
+            "ticks_processed": self.ticks_processed,
+            "signals_emitted": self.signals_emitted,
+            "last_regime": self._last_regime,
+            "last_transition_strength": self._last_transition_strength,
+            # NOTE: the breadth/calibration bucket watermarks are NOT
+            # carried — they guard host data (market_breadth) that does not
+            # survive a restart; restoring them would suppress the refetch
+            # for up to a full bucket and leave breadth-gated logic blind.
+            "last_emitted": [
+                [strategy, symbol, ts]
+                for (strategy, symbol), ts in self._last_emitted.items()
+            ],
+            "notifier_last_transition": self.notifier.last_transition_sent,
+        }
+
+    def restore_host_carries(self, carries: dict) -> None:
+        self.ticks_processed = int(carries.get("ticks_processed", 0))
+        self.signals_emitted = int(carries.get("signals_emitted", 0))
+        regime = carries.get("last_regime")
+        self._last_regime = None if regime is None else int(regime)
+        self._last_transition_strength = float(
+            carries.get("last_transition_strength", 0.0)
+        )
+        self._last_emitted = {
+            (strategy, symbol): int(ts)
+            for strategy, symbol, ts in carries.get("last_emitted", [])
+        }
+        notifier_last = carries.get("notifier_last_transition")
+        self.notifier.last_transition_sent = (
+            None if notifier_last is None else int(notifier_last)
+        )
+
     def touch_heartbeat(self) -> None:
         """Liveness file checked by healthcheck.py (main.py:30-32)."""
         try:
@@ -487,6 +562,13 @@ class SignalEngine:
                 ):
                     last_tick = time.monotonic()
                     await self.process_tick()
+                    if self.checkpoint is not None and self.checkpoint.should_save(
+                        self
+                    ):
+                        # device fetch + np.savez of ~65 MB of buffers:
+                        # keep it off the event loop so ws clients and
+                        # ping deadlines aren't starved during the save
+                        await asyncio.to_thread(self.checkpoint.maybe_save, self)
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -41,6 +41,28 @@ def filter_fiat_symbols(symbols: list[SymbolModel]) -> list[SymbolModel]:
     ]
 
 
+def kucoin_spot_api_symbol(s: SymbolModel) -> str:
+    """Engine id → dashed KuCoin spot form (``BTC-USDT``). Shared by the
+    websocket topic builder and the REST history backfill — the two
+    universes must never drift apart (a mismatch silently loads/streams
+    zero bars for the affected symbols)."""
+    if not s.base_asset:
+        # an undashed id is NOT a valid KuCoin symbol: the ws subscribe
+        # fails silently (response=False) and REST raises per symbol —
+        # surface the bad symbol payload instead of quietly losing it
+        logging.warning(
+            "symbol %s has no base_asset; KuCoin spot form unknown", s.id
+        )
+        return s.id
+    return f"{s.base_asset}-{s.quote_asset}"
+
+
+def kucoin_futures_ids(symbols: list[SymbolModel]) -> list[str]:
+    """The KuCoin futures universe: *USDTM contract ids
+    (websocket_factory.py:93). Shared by ws subscription and backfill."""
+    return [s.id for s in symbols if s.id.endswith("USDTM")]
+
+
 def parse_binance_kline_frame(raw: str | bytes) -> dict | None:
     """One frame → ExtendedKline-shaped dict for closed candles, else None
     (klines_connector.py:148-164 + the extra payload fields)."""
@@ -163,9 +185,17 @@ class KlinesConnector:
         self._tasks.clear()
 
 
-# engine interval keys -> KuCoin ws interval strings
-KUCOIN_WS_INTERVALS = {"5m": "5min", "15m": "15min"}
-_KUCOIN_INTERVAL_S = {"5min": 300, "15min": 900, "1min": 60, "1hour": 3600}
+# ONE source of truth for interval naming (io/exchanges.py): ws topics and
+# REST backfill must agree or symbols silently stream/load zero bars.
+from binquant_tpu.io.exchanges import (  # noqa: E402
+    INTERVAL_SECONDS,
+    KUCOIN_INTERVALS as KUCOIN_WS_INTERVALS,
+)
+
+# KuCoin ws interval string -> seconds, derived from the shared tables
+_KUCOIN_INTERVAL_S = {
+    KUCOIN_WS_INTERVALS[k]: INTERVAL_SECONDS[k] for k in KUCOIN_WS_INTERVALS
+}
 
 
 def parse_kucoin_candle_message(
@@ -256,15 +286,9 @@ class KucoinKlinesConnector:
         self.market_type = market_type
         symbols = filter_fiat_symbols(symbols)
         if market_type == "futures":
-            # futures universe: *USDTM contract ids (websocket_factory.py:93)
-            self.topic_symbols = [
-                s.id for s in symbols if s.id.endswith("USDTM")
-            ]
+            self.topic_symbols = kucoin_futures_ids(symbols)
         else:
-            self.topic_symbols = [
-                f"{s.base_asset}-{s.quote_asset}" if s.base_asset else s.id
-                for s in symbols
-            ]
+            self.topic_symbols = [kucoin_spot_api_symbol(s) for s in symbols]
         self.intervals = intervals
         self.max_topics_per_connection = max_topics_per_connection
         if connect is None:
@@ -321,21 +345,36 @@ class KucoinKlinesConnector:
         backoff = 1.0
         while True:
             try:
-                endpoint, token, ping_interval = self._token_fetch()
+                # the bullet handshake is a blocking HTTP POST; keep it off
+                # the event loop so other clients' pings aren't starved
+                endpoint, token, ping_interval = await asyncio.to_thread(
+                    self._token_fetch
+                )
                 url = f"{endpoint}?token={token}&connectId=bq{idx}"
                 async with self._connect(url) as ws:
-                    for i, topic in enumerate(topics):
+                    # Batch comma-joined suffixes (≤100/message): 300
+                    # individual subscribes would blow KuCoin's ~100
+                    # uplink-messages-per-10s limit, and with
+                    # response=False the rejects are invisible.
+                    prefix = topics[0].split(":", 1)[0]
+                    suffixes = [t.split(":", 1)[1] for t in topics]
+                    per_msg = 100
+                    for i in range(0, len(suffixes), per_msg):
                         await ws.send(
                             json.dumps(
                                 {
-                                    "id": i + 1,
+                                    "id": i // per_msg + 1,
                                     "type": "subscribe",
-                                    "topic": topic,
+                                    "topic": (
+                                        f"{prefix}:"
+                                        + ",".join(suffixes[i : i + per_msg])
+                                    ),
                                     "privateChannel": False,
                                     "response": False,
                                 }
                             )
                         )
+                        await asyncio.sleep(0.1)
                     logging.info(
                         "kucoin %s client %d subscribed %d topics",
                         self.market_type,
@@ -366,6 +405,17 @@ class KucoinKlinesConnector:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                # Drop this client's in-progress candles: after an outage
+                # that spans a bar boundary, the next frame's newer open
+                # time would otherwise emit the pre-disconnect PARTIAL
+                # candle as closed (missing the trades during the outage),
+                # and nothing downstream ever corrects it.
+                for topic in topics:
+                    sym_iv = topic.split(":", 1)[-1]
+                    if "_" in sym_iv:
+                        self._last_candle.pop(
+                            tuple(sym_iv.rsplit("_", 1)), None
+                        )
                 logging.warning(
                     "kucoin ws client %d dropped (%s); reconnecting in %.0fs",
                     idx,

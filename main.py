@@ -52,43 +52,106 @@ async def run_live() -> None:
         active_grid_ladders=binbot_api.get_active_grid_ladders(),
         binbot_api=binbot_api,
     )
+    exchange_id = str(autotrade_settings.exchange_id)
+    market_type = str(
+        getattr(
+            autotrade_settings.market_type, "value", autotrade_settings.market_type
+        )
+    )
+    is_kucoin = exchange_id.lower().startswith("kucoin")
+    is_futures = market_type.lower().endswith("futures")
+    # benchmark symbol per market (klines_provider.py:86-87): the KuCoin
+    # futures universe has no BTCUSDT row — the XBTUSDTM contract is BTC
+    btc_symbol = "XBTUSDTM" if (is_kucoin and is_futures) else "BTCUSDT"
+
+    futures_api = KucoinFutures()
     engine = SignalEngine(
         config=config,
         binbot_api=binbot_api,
         telegram_consumer=telegram_consumer,
         at_consumer=at_consumer,
-        futures_api=KucoinFutures(),
+        futures_api=futures_api,
         window=config.window_bars,
+        btc_symbol=btc_symbol,
     )
+
+    # Resume from the last snapshot if one exists — restores the device
+    # buffers, RegimeCarry (incl. regime_stable_since: no 30-minute
+    # stability cold-start, unlike the reference's rebuild-on-restart at
+    # market_regime/regime_routing.py:41-44), and host dedupe carries.
+    from binquant_tpu.io.checkpoint import CheckpointManager
+
+    if config.checkpoint_path:
+        engine.checkpoint = CheckpointManager(
+            config.checkpoint_path, every_ticks=config.checkpoint_every_ticks
+        )
+        engine.checkpoint.try_restore(engine)
 
     # Seed both interval buffers with REST history so strategies can fire
     # on the first live tick (klines_provider.py:278-293) instead of being
-    # blind for MIN_BARS * 15m after a cold start.
+    # blind for MIN_BARS * 15m after a cold start. This always runs, even
+    # after a checkpoint restore: bars that closed while the process was
+    # down never arrive over the websocket, and a gapped window corrupts
+    # rolling indicators — the scatter-by-timestamp update is idempotent
+    # for bars the snapshot already holds, so topping up is safe.
     from binquant_tpu.io.exchanges import (
         BinanceApi,
         KucoinApi,
         make_history_fetcher,
     )
-    from binquant_tpu.io.websocket import filter_fiat_symbols
-
-    exchange_id = str(autotrade_settings.exchange_id)
-    history_api = (
-        KucoinApi() if exchange_id.lower().startswith("kucoin") else BinanceApi()
+    from binquant_tpu.io.websocket import (
+        filter_fiat_symbols,
+        kucoin_futures_ids,
+        kucoin_spot_api_symbol,
     )
-    tracked = [s.id for s in filter_fiat_symbols(all_symbols)]
-    engine.backfill(tracked, make_history_fetcher(history_api, exchange_id))
 
+    fiat_filtered = filter_fiat_symbols(all_symbols)
+    if is_kucoin and is_futures:
+        # same universe + client the websocket subscription uses
+        tracked = kucoin_futures_ids(fiat_filtered)
+        history_api = futures_api
+        api_symbol_of = None
+    elif is_kucoin:
+        # engine tracks undashed ids; KuCoin spot REST wants BASE-QUOTE
+        dash = {s.id: kucoin_spot_api_symbol(s) for s in fiat_filtered}
+        tracked = [s.id for s in fiat_filtered]
+        history_api = KucoinApi()
+        api_symbol_of = lambda sym: dash.get(sym, sym)  # noqa: E731
+    else:
+        tracked = [s.id for s in fiat_filtered]
+        history_api = BinanceApi()
+        api_symbol_of = None
+
+    # A restored snapshot can hold symbols that have since left the
+    # universe; reconcile before backfill so stale rows can't accumulate
+    # across restarts until registry.add exhausts capacity.
+    engine.prune_symbols(tracked + [btc_symbol])
+
+    # Start streaming BEFORE the (multi-minute, serial-REST) backfill:
+    # bars that close mid-backfill buffer in the queue — otherwise a
+    # symbol fetched before a bar boundary permanently misses that bar
+    # (the websocket only delivers bars closing after subscription).
+    # The scatter-by-timestamp update dedupes the overlap.
     queue: asyncio.Queue = asyncio.Queue()
     factory = WebsocketClientFactory(
         queue,
         all_symbols,
         exchange_id=exchange_id,
-        market_type=getattr(
-            autotrade_settings.market_type, "value", autotrade_settings.market_type
-        ),
+        market_type=market_type,
     )
     connector = factory.create_connector()
     await connector.start_stream()
+
+    await asyncio.to_thread(
+        engine.backfill,
+        tracked,
+        make_history_fetcher(
+            history_api,
+            exchange_id,
+            market_type=market_type,
+            api_symbol_of=api_symbol_of,
+        ),
+    )
     logging.info("binquant_tpu started: %d symbols tracked", len(all_symbols))
     await engine.consume_loop(queue)
 

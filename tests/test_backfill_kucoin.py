@@ -61,6 +61,103 @@ class TestNormalization:
         assert (k["open"], k["close"], k["high"], k["low"]) == (1.0, 1.5, 1.6, 0.9)
         assert k["close_time"] == T0 * 1000 + 900_000 - 1
 
+    def test_kucoin_futures_rows(self):
+        from binquant_tpu.io.exchanges import normalize_kucoin_futures_klines
+
+        rows = [  # futures order: [t_ms, open, high, low, close, vol]
+            [T0 * 1000, 1.0, 1.6, 0.9, 1.5, 10.0],
+            [(T0 + 300) * 1000, 1.5, 1.7, 1.4, 1.6, 12.0],
+        ]
+        out = normalize_kucoin_futures_klines("XBTUSDTM", rows, 300)
+        assert [k["open_time"] for k in out] == [T0 * 1000, (T0 + 300) * 1000]
+        k = out[0]
+        assert (k["open"], k["high"], k["low"], k["close"]) == (1.0, 1.6, 0.9, 1.5)
+        assert k["close_time"] == T0 * 1000 + 300_000 - 1
+        assert k["symbol"] == "XBTUSDTM"
+
+
+class TestFetcherSymbolForms:
+    """The engine tracks undashed ids; each exchange API wants its own
+    symbol form. A mismatch silently loads ZERO bars (round-2 review)."""
+
+    def test_kucoin_spot_translates_to_dashed_and_back(self):
+        seen = []
+
+        class Api:
+            def get_ui_klines(self, symbol, interval, limit=400):
+                seen.append((symbol, interval))
+                return [[str(T0), "1.0", "1.5", "1.6", "0.9", "10", "14"]]
+
+        fetch = make_history_fetcher(
+            Api(), "kucoin", market_type="spot",
+            api_symbol_of=lambda s: {"BTCUSDT": "BTC-USDT"}.get(s, s),
+        )
+        out = fetch("BTCUSDT", "15m")
+        assert seen == [("BTC-USDT", "15min")]  # API got the dashed form
+        assert out[0]["symbol"] == "BTCUSDT"  # engine id preserved
+
+    def test_kucoin_futures_uses_granularity_minutes(self):
+        seen = []
+
+        class Api:
+            def get_ui_klines(self, symbol, granularity, limit=400):
+                seen.append((symbol, granularity))
+                return [[T0 * 1000, 1.0, 1.6, 0.9, 1.5, 10.0]]
+
+        fetch = make_history_fetcher(Api(), "kucoin", market_type="futures")
+        out = fetch("XBTUSDTM", "5m")
+        assert seen == [("XBTUSDTM", 5)]
+        assert out[0]["symbol"] == "XBTUSDTM"
+        assert out[0]["close_time"] - out[0]["open_time"] == 300_000 - 1
+
+    def test_kucoin_error_envelope_raises(self):
+        # HTTP 200 + error code must raise, not silently return [] —
+        # a silent empty turns the whole startup backfill into a no-op
+        from binquant_tpu.io.exchanges import KucoinApi, KucoinFutures
+
+        class Sess:
+            def get(self, url, params=None):
+                class R:
+                    status_code = 200
+
+                    def raise_for_status(self):
+                        pass
+
+                    def json(self):
+                        return {"code": "400100", "msg": "bad symbol"}
+
+                return R()
+
+        with pytest.raises(RuntimeError, match="400100"):
+            KucoinApi(session=Sess()).get_ui_klines("NOPE", "15min")
+        with pytest.raises(RuntimeError, match="400100"):
+            KucoinFutures(session=Sess()).get_ui_klines("NOPE", 15)
+
+    def test_kucoin_futures_rest_sends_time_range(self):
+        # without from/to the endpoint returns server-default recent rows
+        # (~200), silently seeding half the requested window
+        from binquant_tpu.io.exchanges import KucoinFutures
+
+        captured = {}
+
+        class Sess:
+            def get(self, url, params=None):
+                captured.update(params or {})
+
+                class R:
+                    status_code = 200
+
+                    def raise_for_status(self):
+                        pass
+
+                    def json(self):
+                        return {"code": "200000", "data": []}
+
+                return R()
+
+        KucoinFutures(session=Sess()).get_ui_klines("XBTUSDTM", 15, limit=400)
+        assert captured["to"] - captured["from"] == 400 * 15 * 60_000
+
 
 # ---------------------------------------------------------------------------
 # Backfill: strategies can fire on the first live tick
@@ -268,6 +365,58 @@ class TestKucoinConnector:
         chunks = conn._chunks()
         assert all(len(c) <= 300 for c in chunks)
         assert sum(len(c) for c in chunks) == 800  # 400 contracts x 2 intervals
+
+    def test_subscribe_messages_batched_under_uplink_limit(self):
+        """300 individual subscribes would blow KuCoin's ~100 uplink
+        msgs/10s per-connection limit (invisible with response=False);
+        suffixes must be comma-joined ≤100 per message."""
+        sent = []
+
+        class FakeWs:
+            async def send(self, msg):
+                sent.append(json.loads(msg))
+
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                await asyncio.sleep(3600)  # hold the connection open
+
+        class FakeConnect:
+            def __init__(self, url):
+                pass
+
+            async def __aenter__(self):
+                return FakeWs()
+
+            async def __aexit__(self, *a):
+                return False
+
+        symbols = [SymbolModel(id=f"S{i}USDTM") for i in range(150)]
+        conn = KucoinKlinesConnector(
+            asyncio.Queue(), symbols, market_type="futures",
+            token_fetch=lambda: ("wss://fake", "tok", 18.0),
+            connect=FakeConnect,
+        )
+        topics = conn._chunks()[0]
+        assert len(topics) == 300
+
+        async def drive():
+            task = asyncio.create_task(conn._run_client(0, topics))
+            await asyncio.sleep(1.0)
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+        asyncio.run(drive())
+        subs = [m for m in sent if m.get("type") == "subscribe"]
+        assert len(subs) == 3  # 300 suffixes / 100 per message
+        for m in subs:
+            prefix, suffixes = m["topic"].split(":", 1)
+            assert prefix == "/contractMarket/limitCandle"
+            assert 1 <= len(suffixes.split(",")) <= 100
 
     def test_closed_candle_emitted_when_open_time_advances(self):
         conn = self._connector("futures")

@@ -1,0 +1,171 @@
+"""Checkpoint/resume: kill-and-restore must reproduce identical next-tick
+behavior (VERDICT round-1 item 6; SURVEY.md §5 checkpoint paragraph).
+
+The reference rebuilds all state on restart and pays a 30-minute regime
+stability cold-start (``market_regime/regime_routing.py:41-44``). Here the
+EngineState pytree + registry + host carries snapshot to one npz; a fresh
+engine restored from it must be bitwise-identical going forward.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from binquant_tpu.io.checkpoint import CheckpointManager, save_state
+from binquant_tpu.io.replay import generate_replay_file, make_stub_engine
+
+CAP, WIN = 16, 130  # shared suite shape — tick_step compile cache hit
+N_SYMBOLS, N_TICKS = 8, 6
+
+
+@pytest.fixture(scope="module")
+def replay_buckets(tmp_path_factory):
+    path = tmp_path_factory.mktemp("ckpt") / "replay.jsonl"
+    generate_replay_file(path, n_symbols=N_SYMBOLS, n_ticks=N_TICKS)
+    by_bucket: dict[int, list[dict]] = {}
+    with open(path) as f:
+        for line in f:
+            k = json.loads(line)
+            by_bucket.setdefault(int(k["open_time"]) // 1000 // 900, []).append(k)
+    return by_bucket
+
+
+def _drive(engine, by_bucket, buckets):
+    async def go():
+        fired_all = []
+        for b in buckets:
+            for k in sorted(by_bucket[b], key=lambda k: k["open_time"]):
+                engine.ingest(k)
+            fired_all.extend(
+                await engine.process_tick(now_ms=(b + 1) * 900 * 1000)
+            )
+        return fired_all
+
+    return asyncio.run(go())
+
+
+def _leaves(state):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(state)]
+
+
+def _assert_states_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert x.shape == y.shape, f"leaf {i}"
+        if np.issubdtype(x.dtype, np.floating):
+            np.testing.assert_array_equal(
+                np.nan_to_num(x, nan=-9e9), np.nan_to_num(y, nan=-9e9),
+                err_msg=f"leaf {i}",
+            )
+        else:
+            np.testing.assert_array_equal(x, y, err_msg=f"leaf {i}")
+
+
+def test_kill_and_restore_identical_next_tick(replay_buckets, tmp_path):
+    buckets = sorted(replay_buckets)
+    ckpt = tmp_path / "engine.ckpt.npz"
+
+    # engine A: run all but the final bucket, snapshot, then the final one
+    a = make_stub_engine(capacity=CAP, window=WIN)
+    _drive(a, replay_buckets, buckets[:-1])
+    save_state(ckpt, a.state, a.registry, host_carries=a.host_carries())
+
+    # engine B: cold boot + restore (the "restarted process")
+    b = make_stub_engine(capacity=CAP, window=WIN)
+    mgr = CheckpointManager(ckpt)
+    assert mgr.try_restore(b)
+    assert b.ticks_processed == a.ticks_processed
+    assert b.registry.to_mapping() == a.registry.to_mapping()
+    _assert_states_equal(a.state, b.state)
+    ca, cb = a.host_carries(), b.host_carries()
+    ca.pop("saved_at_s"), cb.pop("saved_at_s")
+    assert ca == cb
+
+    # identical next tick: same fired signals, same resulting device state
+    fired_a = _drive(a, replay_buckets, buckets[-1:])
+    fired_b = _drive(b, replay_buckets, buckets[-1:])
+    key = lambda s: (s.strategy, s.symbol, s.value.direction, s.value.score)
+    assert [key(s) for s in fired_a] == [key(s) for s in fired_b]
+    _assert_states_equal(a.state, b.state)
+    assert a._last_regime == b._last_regime
+    assert a._last_emitted == b._last_emitted
+
+
+def test_restore_preserves_regime_stable_since(replay_buckets, tmp_path):
+    """The whole point vs the reference: stable_since survives a restart,
+    so routing does not re-impose the 30-minute stability block."""
+    buckets = sorted(replay_buckets)
+    a = make_stub_engine(capacity=CAP, window=WIN)
+    _drive(a, replay_buckets, buckets)
+    ckpt = tmp_path / "engine.ckpt.npz"
+    save_state(ckpt, a.state, a.registry, host_carries=a.host_carries())
+
+    b = make_stub_engine(capacity=CAP, window=WIN)
+    assert CheckpointManager(ckpt).try_restore(b)
+    np.testing.assert_array_equal(
+        np.asarray(a.state.regime_carry.stable_since),
+        np.asarray(b.state.regime_carry.stable_since),
+    )
+
+
+def test_shape_mismatch_starts_cold(replay_buckets, tmp_path):
+    buckets = sorted(replay_buckets)
+    a = make_stub_engine(capacity=CAP, window=WIN)
+    _drive(a, replay_buckets, buckets[:1])
+    ckpt = tmp_path / "engine.ckpt.npz"
+    save_state(ckpt, a.state, a.registry, host_carries=a.host_carries())
+
+    # a capacity change must refuse the snapshot, not load garbage
+    c = make_stub_engine(capacity=CAP * 2, window=WIN)
+    mgr = CheckpointManager(ckpt)
+    assert not mgr.try_restore(c)
+    assert c.ticks_processed == 0
+    assert len(c.registry.to_mapping()) == 0
+
+
+def test_prune_symbols_reconciles_restored_universe(replay_buckets, tmp_path):
+    """Universe churn must not leak registry rows across restart cycles
+    (stale rows eventually exhaust capacity and crash-loop the boot)."""
+    buckets = sorted(replay_buckets)
+    a = make_stub_engine(capacity=CAP, window=WIN)
+    _drive(a, replay_buckets, buckets[:1])
+    ckpt = tmp_path / "engine.ckpt.npz"
+    save_state(ckpt, a.state, a.registry, host_carries=a.host_carries())
+
+    b = make_stub_engine(capacity=CAP, window=WIN)
+    assert CheckpointManager(ckpt).try_restore(b)
+    before = a.registry.to_mapping()
+    keep = ["BTCUSDT", "S001USDT"]
+    assert b.prune_symbols(keep) == len(before) - 2
+    assert set(b.registry.to_mapping()) == set(keep)
+    filled5 = np.asarray(b.state.buf5.filled)
+    filled15 = np.asarray(b.state.buf15.filled)
+    for sym, row in before.items():
+        if sym not in keep:
+            assert filled5[row] == 0 and filled15[row] == 0
+    # freed rows are reusable
+    row = b.registry.add("NEWUSDT")
+    assert 0 <= row < CAP
+
+
+def test_missing_file_is_cold_start(tmp_path):
+    e = make_stub_engine(capacity=CAP, window=WIN)
+    assert not CheckpointManager(tmp_path / "absent.npz").try_restore(e)
+
+
+def test_maybe_save_cadence(replay_buckets, tmp_path):
+    buckets = sorted(replay_buckets)
+    e = make_stub_engine(capacity=CAP, window=WIN)
+    mgr = CheckpointManager(tmp_path / "cadence.npz", every_ticks=2)
+    assert not mgr.maybe_save(e)  # tick 0: nothing to save yet
+    _drive(e, replay_buckets, buckets[:1])
+    assert e.ticks_processed == 1
+    assert not mgr.maybe_save(e)
+    _drive(e, replay_buckets, buckets[1:2])
+    assert mgr.maybe_save(e)
+    assert mgr.path.exists()

@@ -179,7 +179,12 @@ def normalize_kucoin_futures_klines(
                 "low": float(r[3]),
                 "close": float(r[4]),
                 "volume": float(r[5]),
-                "quote_asset_volume": float(r[5]) * float(r[4]),
+                # The futures ws frame carries no turnover, so the parser
+                # emits 0 (websocket.py limitCandle) — the REST seed MUST
+                # match: a mixed qav>0/qav=0 window would flip ABP's
+                # has_qav branch and silently mute its quote gate for the
+                # whole backfilled tail after every restart.
+                "quote_asset_volume": 0.0,
                 "number_of_trades": 0.0,
                 "taker_buy_base_volume": 0.0,
                 "taker_buy_quote_volume": 0.0,
@@ -254,35 +259,52 @@ class KucoinFutures(_RestClient):
             taker_fee_rate=float(data.get("takerFeeRate", 0.0006)),
         )
 
+    # per-request row cap of /api/v1/kline/query; larger ranges paginate
+    KLINE_PAGE = 200
+
     def get_ui_klines(
         self, symbol: str, granularity_min: int = 15, limit: int = 400
     ) -> list[list]:
         """Futures contract candles (oldest first). Raises on KuCoin error
         envelopes so backfill failures are visible, not silent.
 
-        Without an explicit time range the endpoint returns only its
-        server-default recent rows (well under 400), silently seeding a
-        fraction of the window — so the range is derived from ``limit``.
+        The endpoint caps rows per request (~200) and, without an explicit
+        range, returns only its server-default recent rows — both silently
+        under-seed the window. Pages of ≤200 bars walk backwards from now
+        until ``limit`` bars are covered.
         """
         import time
 
-        now_ms = int(time.time() * 1000)
-        data = self._get(
-            "/api/v1/kline/query",
-            {
-                "symbol": symbol,
-                "granularity": granularity_min,
-                "from": now_ms - limit * granularity_min * 60_000,
-                "to": now_ms,
-            },
-        )
-        code = str(data.get("code", "200000"))
-        if code != "200000":
-            raise RuntimeError(
-                f"kucoin futures klines error for {symbol}: "
-                f"{code} {data.get('msg')}"
+        end_ms = int(time.time() * 1000)
+        bar_ms = granularity_min * 60_000
+        rows: list[list] = []
+        remaining = limit
+        while remaining > 0:
+            span = min(remaining, self.KLINE_PAGE)
+            from_ms = end_ms - span * bar_ms
+            data = self._get(
+                "/api/v1/kline/query",
+                {
+                    "symbol": symbol,
+                    "granularity": granularity_min,
+                    "from": from_ms,
+                    "to": end_ms,
+                },
             )
-        return list(data.get("data") or [])[-limit:]
+            code = str(data.get("code", "200000"))
+            if code != "200000":
+                raise RuntimeError(
+                    f"kucoin futures klines error for {symbol}: "
+                    f"{code} {data.get('msg')}"
+                )
+            rows = list(data.get("data") or []) + rows
+            remaining -= span
+            end_ms = from_ms
+        # dedupe page-boundary overlaps, oldest first
+        seen: dict[int, list] = {}
+        for r in rows:
+            seen[int(r[0])] = r
+        return [seen[t] for t in sorted(seen)][-limit:]
 
     def get_mark_price(self, symbol: str) -> float:
         data = self._get(f"/api/v1/mark-price/{symbol}/current")["data"]

@@ -315,8 +315,15 @@ class SignalEngine:
         # the PREVIOUS tick's regime/transition-strength (the reference
         # evaluates the filter with the live context —
         # time_of_day_filter.py:60-76; a missing context always suppresses).
+        # The filter reads the EVALUATED tick time, not the wall clock —
+        # identical live (tick time ≈ now), and it makes replays
+        # deterministic instead of depending on when they happen to run.
+        from datetime import UTC, datetime
+
         quiet = is_autotrade_suppressed(
-            self._last_regime, self._last_transition_strength
+            self._last_regime,
+            self._last_transition_strength,
+            now=datetime.fromtimestamp(ts_ms / 1000, tz=UTC),
         )
         # row 0 is a valid registry row — `or -1` would misread it as missing
         _btc = self.registry.row_of(self.btc_symbol)

@@ -115,10 +115,8 @@ def make_stub_engine(capacity: int = 256, window: int = 200):
     return engine
 
 
-def run_replay(path: str | Path, capacity: int = 256, window: int = 200) -> dict:
-    """Replay a JSONL kline file; returns run statistics."""
-    engine = make_stub_engine(capacity=capacity, window=window)
-
+def load_klines_by_tick(path: str | Path) -> dict[int, list[dict]]:
+    """Group a JSONL kline file by 15m bucket (one engine tick each)."""
     klines_by_tick: dict[int, list[dict]] = {}
     with open(path) as f:
         for line in f:
@@ -128,6 +126,23 @@ def run_replay(path: str | Path, capacity: int = 256, window: int = 200) -> dict
             k = json.loads(line)
             bucket = int(k["open_time"]) // 1000 // 900
             klines_by_tick.setdefault(bucket, []).append(k)
+    return klines_by_tick
+
+
+def run_replay(
+    path: str | Path,
+    capacity: int = 256,
+    window: int = 200,
+    collect: list | None = None,
+) -> dict:
+    """Replay a JSONL kline file; returns run statistics.
+
+    When ``collect`` is a list, every fired signal is appended as a
+    ``(tick_ms, strategy, symbol, direction, autotrade)`` tuple — the
+    comparison surface for the A/B parity harness.
+    """
+    engine = make_stub_engine(capacity=capacity, window=window)
+    klines_by_tick = load_klines_by_tick(path)
 
     fired_total = 0
     t_start = time.perf_counter()
@@ -144,6 +159,17 @@ def run_replay(path: str | Path, capacity: int = 256, window: int = 200) -> dict
             fired = await engine.process_tick(now_ms=tick_ms)
             latencies.append((time.perf_counter() - t0) * 1000)
             fired_total += len(fired)
+            if collect is not None:
+                for s in fired:
+                    collect.append(
+                        (
+                            tick_ms,
+                            s.strategy,
+                            s.symbol,
+                            str(s.value.direction),
+                            bool(s.value.autotrade),
+                        )
+                    )
 
     asyncio.run(drive())
     wall = time.perf_counter() - t_start
@@ -154,6 +180,47 @@ def run_replay(path: str | Path, capacity: int = 256, window: int = 200) -> dict
         "wall_s": round(wall, 3),
         "tick_p50_ms": round(float(np.percentile(latencies, 50)), 3) if latencies else None,
         "tick_p99_ms": round(float(np.percentile(latencies, 99)), 3) if latencies else None,
+    }
+
+
+def run_replay_oracle(path: str | Path, window: int = 200) -> list[tuple]:
+    """Replay through the legacy per-symbol pandas backend
+    (``backend=reference``, BASELINE config #1); returns the fired
+    ``(tick_ms, strategy, symbol, direction, autotrade)`` tuples."""
+    from binquant_tpu.oracle import OracleEvaluator
+
+    evaluator = OracleEvaluator(
+        window=window,
+        required_fresh_symbols=4,
+        min_coverage_ratio=0.5,
+        is_futures=True,
+    )
+    klines_by_tick = load_klines_by_tick(path)
+    out: list[tuple] = []
+    for bucket in sorted(klines_by_tick):
+        for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
+            evaluator.ingest(k)
+        tick_ms = (bucket + 1) * 900 * 1000
+        for strategy, sym, direction, autotrade in evaluator.evaluate(tick_ms):
+            out.append((tick_ms, strategy, sym, direction, autotrade))
+    return out
+
+
+def run_replay_ab(path: str | Path, capacity: int = 256, window: int = 200) -> dict:
+    """A/B parity: the TPU batch path and the per-symbol pandas oracle run
+    the same replay and must emit the identical signal set (SURVEY.md §7
+    step 8 — the correctness oracle for the batched evaluation)."""
+    tpu_signals: list[tuple] = []
+    stats = run_replay(path, capacity=capacity, window=window, collect=tpu_signals)
+    oracle_signals = run_replay_oracle(path, window=window)
+    tpu_set, oracle_set = set(tpu_signals), set(oracle_signals)
+    return {
+        "match": tpu_set == oracle_set,
+        "tpu_count": len(tpu_set),
+        "oracle_count": len(oracle_set),
+        "only_tpu": sorted(tpu_set - oracle_set),
+        "only_oracle": sorted(oracle_set - tpu_set),
+        "tpu_stats": stats,
     }
 
 

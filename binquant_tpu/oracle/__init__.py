@@ -1,0 +1,12 @@
+"""Legacy per-symbol oracle backend (BASELINE config #1).
+
+``backend="reference"`` runs a reference-shaped evaluation — per-symbol
+pandas DataFrames, Python loops, dict carries — over the same kline stream
+as the TPU batch path, emitting the same signal tuples. It is the
+correctness oracle for A/B parity (SURVEY.md §7 step 8) and the benchmark
+baseline the batched path is measured against.
+"""
+
+from binquant_tpu.oracle.evaluator import OracleEvaluator
+
+__all__ = ["OracleEvaluator"]

@@ -1,0 +1,939 @@
+"""Per-symbol pandas evaluation — the reference's control flow, verbatim in
+shape: rolling DataFrames per symbol, indicator enrichment with pandas
+``rolling``/``ewm``, a Python loop over fresh symbols for the market
+context, and per-strategy Python evaluation with dict-carried cooldowns.
+
+This is deliberately NOT the TPU architecture: it exists as the independent
+A/B oracle (``/root/repo/BASELINE.json`` config #1; SURVEY.md §7 step 8).
+Formulas mirror the reference (same constants and clamps the device kernels
+pin): context/regime — ``live_market_context_accumulator.py:95-297``,
+``regime_transitions.py:45-232``; strategies — ``activity_burst_pump.py``,
+``coinrule/price_tracker.py``, ``liquidation_sweep_pump.py``,
+``mean_reversion_fade.py``, ``grid/ladder_deployer.py``; routing —
+``regime_routing.py:47-76``. The TPU path and this oracle must emit the
+identical signal set over a replay (tests/test_ab_parity.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from binquant_tpu.enums import (
+    Direction,
+    MarketRegimeCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.utils import clamp, non_negative, safe_pct
+
+MIN_BARS = 100  # context_evaluator.py:361-365 (MA-100 sufficiency)
+FIFTEEN_MIN_S = 900
+FIVE_MIN_S = 300
+REGIME_STABILITY_S = 30 * 60
+TRANSITION_STRENGTH_FLOOR = 0.08
+
+LIVE_STRATEGIES = (
+    "activity_burst_pump",
+    "coinrule_price_tracker",
+    "liquidation_sweep_pump",
+    "mean_reversion_fade",
+    "grid_ladder",
+)
+
+
+def _nz(x: float, default: float = 0.0) -> float:
+    return float(x) if math.isfinite(float(x)) else default
+
+
+# ---------------------------------------------------------------------------
+# Rolling store (reference MarketStateStore: dedupe, sort, tail)
+# ---------------------------------------------------------------------------
+
+
+class FrameStore:
+    def __init__(self, window: int) -> None:
+        self.window = window
+        self.frames: dict[str, pd.DataFrame] = {}
+
+    def update(self, kline: dict) -> None:
+        sym = kline["symbol"]
+        row = {
+            k: float(kline[k])
+            for k in (
+                "open",
+                "high",
+                "low",
+                "close",
+                "volume",
+                "quote_asset_volume",
+                "number_of_trades",
+            )
+        }
+        row["open_time"] = int(kline["open_time"])
+        df = self.frames.get(sym)
+        new = pd.DataFrame([row])
+        if df is None:
+            df = new
+        else:
+            df = pd.concat([df[df["open_time"] != row["open_time"]], new])
+        self.frames[sym] = (
+            df.sort_values("open_time").tail(self.window).reset_index(drop=True)
+        )
+
+    def fresh(self, ts_s: int) -> list[str]:
+        return [
+            s
+            for s, df in self.frames.items()
+            if int(df["open_time"].iloc[-1]) // 1000 == ts_s
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Market context (accumulator + regime transitions, per-symbol Python loop)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SymbolFeatures:
+    valid: bool = False
+    close: float = 0.0
+    return_pct: float = 0.0
+    ema20: float = 0.0
+    ema50: float = 0.0
+    above_ema20: bool = False
+    above_ema50: bool = False
+    trend_score: float = 0.0
+    relative_strength_vs_btc: float = 0.0
+    atr_pct: float = 0.0
+    bb_width: float = 0.0
+    micro_regime: int = -1
+    micro_strength: float = 0.0
+    micro_transition: int = -1
+
+
+@dataclass
+class OracleContext:
+    valid: bool = False
+    timestamp: int = -1
+    advancers_ratio: float = 0.0
+    pct_above_ema20: float = 0.0
+    pct_above_ema50: float = 0.0
+    average_trend_score: float = 0.0
+    average_return: float = 0.0
+    market_stress_score: float = 0.0
+    btc_regime_score: float = 0.0
+    long_tailwind: float = 0.0
+    short_tailwind: float = 0.0
+    market_regime: int = -1
+    market_regime_transition_strength: float = 0.0
+    regime_is_transitioning: bool = False
+    regime_stable_since: int = -1
+    long_regime_score: float = 0.0
+    short_regime_score: float = 0.0
+    range_regime_score: float = 0.0
+    stress_regime_score: float = 0.0
+    features: dict[str, SymbolFeatures] = field(default_factory=dict)
+
+
+def _symbol_features(df: pd.DataFrame) -> SymbolFeatures | None:
+    """_compute_symbol_features (accumulator l.244-297); None if <2 bars."""
+    if len(df) < 2:
+        return None
+    close = df["close"]
+    latest = float(close.iloc[-1])
+    prev = float(close.iloc[-2])
+    ema20 = float(close.ewm(span=20, adjust=False, min_periods=1).mean().iloc[-1])
+    ema50 = float(close.ewm(span=50, adjust=False, min_periods=1).mean().iloc[-1])
+    tail = df.tail(15)
+    prev_close = tail["close"].shift(1)
+    tr = pd.concat(
+        [
+            tail["high"] - tail["low"],
+            (tail["high"] - prev_close).abs(),
+            (tail["low"] - prev_close).abs(),
+        ],
+        axis=1,
+    ).max(axis=1)
+    atr = float(tr.rolling(14, min_periods=1).mean().iloc[-1])
+    mid = float(close.rolling(20, min_periods=1).mean().iloc[-1])
+    std = close.rolling(20, min_periods=1).std(ddof=0).iloc[-1]
+    std = _nz(std, 0.0)
+    bb_upper, bb_lower = mid + 2 * std, mid - 2 * std
+    f = SymbolFeatures(
+        valid=True,
+        close=latest,
+        return_pct=safe_pct(latest, prev),
+        ema20=ema20,
+        ema50=ema50,
+        above_ema20=latest > ema20,
+        above_ema50=latest > ema50,
+        trend_score=(ema20 - ema50) / abs(ema50) if ema50 != 0 else 0.0,
+        atr_pct=atr / latest if latest != 0 else 0.0,
+        bb_width=(bb_upper - bb_lower) / abs(mid) if mid != 0 else 0.0,
+    )
+    return f
+
+
+def _micro_scores(f: SymbolFeatures) -> tuple[int, float]:
+    """Per-symbol regime ladder (regime_transitions.py:167-206)."""
+    up = clamp(
+        0.45 * non_negative(f.trend_score * 30.0)
+        + 0.2 * float(f.above_ema20)
+        + 0.15 * float(f.above_ema50)
+        + 0.2 * non_negative(f.relative_strength_vs_btc * 20.0),
+        0.0,
+        1.0,
+    )
+    down = clamp(
+        0.45 * non_negative(-f.trend_score * 30.0)
+        + 0.2 * float(not f.above_ema20)
+        + 0.15 * float(not f.above_ema50)
+        + 0.2 * non_negative(-f.relative_strength_vs_btc * 20.0),
+        0.0,
+        1.0,
+    )
+    rng = clamp(
+        0.38 * (1.0 - min(abs(f.trend_score) * 30.0, 1.0))
+        + 0.34 * (1.0 - min(f.bb_width / 0.08, 1.0))
+        + 0.28 * (1.0 - min(f.atr_pct / 0.04, 1.0)),
+        0.0,
+        1.0,
+    )
+    vol = clamp(
+        0.55 * min(f.atr_pct / 0.05, 1.0) + 0.45 * min(f.bb_width / 0.12, 1.0),
+        0.0,
+        1.0,
+    )
+    strength = max(up, down, rng, vol)
+    if vol >= 0.72 and abs(f.return_pct) >= 0.015:
+        regime = int(MicroRegimeCode.VOLATILE)
+    elif up >= 0.52 and up >= down + 0.1:
+        regime = int(MicroRegimeCode.TREND_UP)
+    elif down >= 0.52 and down >= up + 0.1:
+        regime = int(MicroRegimeCode.TREND_DOWN)
+    elif rng >= 0.5:
+        regime = int(MicroRegimeCode.RANGE)
+    else:
+        regime = int(MicroRegimeCode.TRANSITIONAL)
+    return regime, strength
+
+
+def _micro_transition(prev: int, regime: int) -> int:
+    T, R = MicroTransitionCode, MicroRegimeCode
+    from_range_like = prev in (int(R.RANGE), int(R.TRANSITIONAL))
+    if regime == int(R.VOLATILE):
+        return int(T.VOLATILITY_EXPANSION)
+    if from_range_like and regime == int(R.TREND_UP):
+        return int(T.BREAKOUT_UP)
+    if from_range_like and regime == int(R.TREND_DOWN):
+        return int(T.BREAKDOWN)
+    if prev == int(R.TREND_DOWN) and regime == int(R.TREND_UP):
+        return int(T.RECOVERY)
+    if prev == int(R.TREND_UP) and regime == int(R.RANGE):
+        return int(T.MEAN_REVERSION)
+    if regime == int(R.TREND_UP):
+        return int(T.ENTERED_TREND_UP)
+    if regime == int(R.TREND_DOWN):
+        return int(T.ENTERED_TREND_DOWN)
+    if regime == int(R.RANGE):
+        return int(T.ENTERED_RANGE)
+    return int(T.ENTERED_TRANSITIONAL)
+
+
+# ---------------------------------------------------------------------------
+# Context-conditioned scoring (context_scoring.py + signal_context_scorer.py)
+# ---------------------------------------------------------------------------
+
+
+def _context_score(
+    ctx: OracleContext, is_short: bool, symbol_rs: float, symbol_trend: float
+) -> dict:
+    confidence = 1.0 if ctx.valid else 0.0
+    breadth = ctx.short_tailwind if is_short else ctx.long_tailwind
+    btc_align = clamp(-ctx.btc_regime_score if is_short else ctx.btc_regime_score)
+    rs_signed = -symbol_rs if is_short else symbol_rs
+    trend_signed = -symbol_trend if is_short else symbol_trend
+    cross_asset = clamp(0.6 * rs_signed + 0.4 * trend_signed)
+    override = clamp(
+        0.6 * non_negative(rs_signed) + 0.4 * non_negative(trend_signed), 0.0, 1.0
+    )
+    directional_stress = (
+        ctx.market_stress_score * 0.35 if is_short else -ctx.market_stress_score
+    )
+    supportiveness = clamp(
+        0.35 * breadth + 0.25 * btc_align + 0.25 * cross_asset
+        + 0.15 * directional_stress
+    )
+    followthrough = clamp(0.45 * breadth + 0.3 * btc_align + 0.25 * cross_asset)
+    risk = clamp(
+        0.55 * ctx.market_stress_score
+        + 0.25 * non_negative(-supportiveness)
+        + 0.2 * (1.0 - override),
+        0.0,
+        1.0,
+    )
+    if breadth < 0 and override > 0:
+        if not is_short:
+            supportiveness = clamp(supportiveness + 0.2 * override)
+            followthrough = clamp(followthrough + 0.15 * override)
+        else:
+            supportiveness = clamp(supportiveness + 0.1 * override)
+    z = confidence
+    return {
+        "confidence": confidence,
+        "followthrough": followthrough * z,
+        "risk": risk * z,
+        "supportiveness": supportiveness * z,
+    }
+
+
+def _allows_long_autotrade(ctx: OracleContext, sym: str) -> bool:
+    """regime_routing.py:47-76."""
+    if not ctx.valid or ctx.regime_is_transitioning:
+        return False
+    if ctx.regime_stable_since < 0:
+        return False
+    age = max(ctx.timestamp - ctx.regime_stable_since, 0)
+    if age < REGIME_STABILITY_S:
+        return False
+    market_regime_ok = ctx.market_regime in (
+        int(MarketRegimeCode.TREND_UP),
+        int(MarketRegimeCode.RANGE),
+    )
+    if not market_regime_ok or ctx.market_stress_score >= 0.35:
+        return False
+    f = ctx.features.get(sym)
+    if f is None or not f.valid or f.micro_regime < 0:
+        return market_regime_ok
+    if f.micro_regime == int(MicroRegimeCode.TREND_DOWN):
+        return f.micro_transition == int(MicroTransitionCode.RECOVERY)
+    if f.micro_regime == int(MicroRegimeCode.VOLATILE):
+        return False
+    return f.micro_regime in (
+        int(MicroRegimeCode.TREND_UP),
+        int(MicroRegimeCode.RANGE),
+        int(MicroRegimeCode.TRANSITIONAL),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The evaluator
+# ---------------------------------------------------------------------------
+
+
+class OracleEvaluator:
+    """Reference-shaped engine: ingest klines, evaluate per tick, emit
+    (strategy, symbol, direction, autotrade) tuples."""
+
+    def __init__(
+        self,
+        window: int = 200,
+        btc_symbol: str = "BTCUSDT",
+        required_fresh_symbols: int = 40,
+        min_coverage_ratio: float = 0.70,
+        is_futures: bool = True,
+    ) -> None:
+        self.store5 = FrameStore(window)
+        self.store15 = FrameStore(window)
+        self.btc_symbol = btc_symbol
+        self.required_fresh = required_fresh_symbols
+        self.min_coverage = min_coverage_ratio
+        self.is_futures = is_futures
+        # regime carry: previous (strictly older ts) + stage (current ts)
+        self._prev_market: tuple[int, tuple, int] | None = None  # regime, scores, since
+        self._prev_micro: dict[str, tuple[int, float]] = {}
+        self._stage_ts: int = -1
+        self._stage_market: tuple[int, tuple, int] | None = None
+        self._stage_micro: dict[str, tuple[int, float]] = {}
+        # strategy carries
+        self.pt_last_close: dict[str, int] = {}
+        self.mrf_last_open: dict[str, int] = {}
+        self.last_emitted: dict[tuple[str, str], int] = {}
+        # previous tick's regime, for the quiet-hours override (pipeline
+        # mirrors this: time_filter judged against the PREVIOUS context)
+        self._last_regime: int | None = None
+        self._last_strength: float = 0.0
+
+    # -- ingest ------------------------------------------------------------
+
+    def ingest(self, kline: dict) -> None:
+        duration_s = (int(kline["close_time"]) - int(kline["open_time"])) // 1000
+        if abs(duration_s - FIVE_MIN_S) <= 1:
+            self.store5.update(kline)
+        elif abs(duration_s - FIFTEEN_MIN_S) <= 1:
+            self.store15.update(kline)
+
+    # -- context -----------------------------------------------------------
+
+    def _build_context(self, ts15: int) -> OracleContext:
+        # promote the stage when a strictly newer timestamp arrives
+        if ts15 > self._stage_ts:
+            if self._stage_market is not None:
+                self._prev_market = self._stage_market
+            self._prev_micro.update(self._stage_micro)
+            self._stage_market = None
+            self._stage_micro = {}
+            self._stage_ts = ts15
+
+        tracked = set(self.store5.frames) | set(self.store15.frames)
+        fresh = [
+            s for s in self.store15.fresh(ts15) if s in tracked
+        ]
+        feats: dict[str, SymbolFeatures] = {}
+        for sym in fresh:
+            f = _symbol_features(self.store15.frames[sym])
+            if f is not None:
+                feats[sym] = f
+
+        # BTC features from its frame regardless of freshness (l.105-106)
+        btc_df = self.store15.frames.get(self.btc_symbol)
+        btc_present = btc_df is not None and len(btc_df) >= 2
+        btc_f = _symbol_features(btc_df) if btc_present else None
+        btc_return = btc_f.return_pct if btc_f else 0.0
+        btc_trend = btc_f.trend_score if btc_f else 0.0
+
+        for sym, f in feats.items():
+            if btc_present and sym != self.btc_symbol:
+                f.relative_strength_vs_btc = f.return_pct - btc_return
+
+        effective = len(feats)
+        total_tracked = max(len(tracked), effective)
+        ctx = OracleContext(timestamp=ts15, features=feats)
+        vals = list(feats.values())
+        n = max(effective, 1)
+        advancers = sum(1 for f in vals if f.return_pct > 0)
+        decliners = sum(1 for f in vals if f.return_pct < 0)
+        ctx.advancers_ratio = advancers / n
+        decliners_ratio = decliners / n
+        ctx.average_return = sum(f.return_pct for f in vals) / n
+        ctx.pct_above_ema20 = sum(f.above_ema20 for f in vals) / n
+        ctx.pct_above_ema50 = sum(f.above_ema50 for f in vals) / n
+        ctx.average_trend_score = sum(f.trend_score for f in vals) / n
+        average_atr_pct = sum(f.atr_pct for f in vals) / n
+        average_bb_width = sum(f.bb_width for f in vals) / n
+
+        breadth_balance = clamp((ctx.advancers_ratio - decliners_ratio) * 1.5)
+        ema_balance = clamp(
+            ((ctx.pct_above_ema20 + ctx.pct_above_ema50) - 1.0) * 1.5
+        )
+        average_return_score = clamp(ctx.average_return * 12.0)
+        ctx.btc_regime_score = (
+            clamp(btc_return * 12.0 + btc_trend * 6.0) if btc_present else 0.0
+        )
+        stress_vol = clamp((average_atr_pct - 0.02) * 12.0, 0.0, 1.0)
+        stress_bw = clamp((average_bb_width - 0.08) * 4.0, 0.0, 1.0)
+        stress_sell = clamp((-ctx.average_return) * 16.0, 0.0, 1.0)
+        ctx.market_stress_score = (
+            0.4 * stress_vol + 0.25 * stress_bw + 0.35 * stress_sell
+        )
+        ctx.long_tailwind = clamp(
+            0.4 * breadth_balance
+            + 0.2 * ema_balance
+            + 0.25 * ctx.btc_regime_score
+            + 0.15 * average_return_score
+            - 0.35 * ctx.market_stress_score
+        )
+        ctx.short_tailwind = clamp(
+            -0.35 * breadth_balance
+            - 0.15 * ema_balance
+            - 0.2 * ctx.btc_regime_score
+            - 0.15 * average_return_score
+            + 0.45 * ctx.market_stress_score
+        )
+
+        required = max(
+            self.required_fresh, math.ceil(total_tracked * self.min_coverage)
+        )
+        coverage = effective / max(total_tracked, 1)
+        ctx.valid = (
+            effective >= required
+            and total_tracked > 0
+            and effective >= self.required_fresh
+            and coverage >= self.min_coverage
+        )
+
+        # --- macro ladder + transition (regime_transitions.py:45-160)
+        R = MarketRegimeCode
+        breadth_score = clamp((ctx.advancers_ratio - 0.5) / 0.25)
+        trend_participation = clamp(
+            ((ctx.pct_above_ema20 + ctx.pct_above_ema50) - 1.0) * 1.4
+        )
+        avg_trend_bias = clamp(ctx.average_trend_score * 20.0)
+        calm = clamp(1.0 - ctx.market_stress_score, 0.0, 1.0)
+        long_score = clamp(
+            0.3 * non_negative(ctx.long_tailwind)
+            + 0.24 * non_negative(ctx.btc_regime_score)
+            + 0.2 * non_negative(breadth_score)
+            + 0.14 * non_negative(trend_participation)
+            + 0.12 * calm,
+            0.0,
+            1.0,
+        )
+        short_score = clamp(
+            0.28 * non_negative(ctx.short_tailwind)
+            + 0.24 * non_negative(-ctx.btc_regime_score)
+            + 0.16 * non_negative(-breadth_score)
+            + 0.1 * non_negative(-avg_trend_bias)
+            + 0.22 * ctx.market_stress_score,
+            0.0,
+            1.0,
+        )
+        range_score = clamp(
+            0.32 * (1.0 - abs(breadth_score))
+            + 0.22 * (1.0 - abs(ctx.btc_regime_score))
+            + 0.24 * calm
+            + 0.12 * (1.0 - abs(avg_trend_bias))
+            + 0.1 * (1.0 - abs(ctx.long_tailwind - ctx.short_tailwind)),
+            0.0,
+            1.0,
+        )
+        stress_score = clamp(
+            0.7 * ctx.market_stress_score
+            + 0.18 * non_negative(-ctx.average_return * 20.0)
+            + 0.12 * non_negative(short_score - long_score),
+            0.0,
+            1.0,
+        )
+        dominant = max(long_score, short_score, range_score, stress_score)
+        if stress_score >= 0.5 and ctx.market_stress_score >= 0.35:
+            regime = int(R.HIGH_STRESS)
+        elif long_score >= 0.44 and long_score >= short_score + 0.08:
+            regime = int(R.TREND_UP)
+        elif short_score >= 0.42 and short_score >= long_score + 0.08:
+            regime = int(R.TREND_DOWN)
+        elif range_score >= 0.5:
+            regime = int(R.RANGE)
+        else:
+            regime = int(R.TRANSITIONAL)
+        ctx.long_regime_score = long_score
+        ctx.short_regime_score = short_score
+        ctx.range_regime_score = range_score
+        ctx.stress_regime_score = stress_score
+        ctx.market_regime = regime
+
+        prev = self._prev_market
+        changed = prev is not None and prev[0] != regime
+        scores = (long_score, short_score, range_score, stress_score)
+        if changed:
+            max_delta = max(
+                abs(a - b) for a, b in zip(scores, prev[1])
+            )
+            strength = clamp(dominant + max_delta - 0.25, 0.0, 1.0)
+        else:
+            strength = 0.0
+        ctx.market_regime_transition_strength = strength
+        ctx.regime_is_transitioning = regime == int(R.TRANSITIONAL) or (
+            changed and strength >= TRANSITION_STRENGTH_FLOOR
+        )
+        keep_anchor = prev is not None and prev[0] == regime and prev[2] >= 0
+        ctx.regime_stable_since = prev[2] if keep_anchor else ts15
+
+        # --- micro ladders + transitions against carried previous
+        for sym, f in feats.items():
+            m_regime, m_strength = _micro_scores(f)
+            f.micro_regime = m_regime
+            f.micro_strength = m_strength
+            p = self._prev_micro.get(sym)
+            if p is not None and p[0] >= 0 and p[0] != m_regime:
+                f.micro_transition = _micro_transition(p[0], m_regime)
+            else:
+                f.micro_transition = -1
+
+        # --- stage update (only valid evaluations are staged, l.101-103)
+        if ctx.valid:
+            self._stage_market = (regime, scores, ctx.regime_stable_since)
+            for sym, f in feats.items():
+                self._stage_micro[sym] = (f.micro_regime, f.micro_strength)
+        return ctx
+
+    # -- strategies --------------------------------------------------------
+
+    def _abp(self, sym: str, ctx: OracleContext) -> tuple[bool, bool] | None:
+        """activity_burst_pump.py: (fired, autotrade)."""
+        df = self.store5.frames[sym]
+        if len(df) < 21:
+            return None
+        volume = df["volume"]
+        qav = df["quote_asset_volume"]
+        close, open_ = df["close"], df["open"]
+        high, low = df["high"], df["low"]
+        eps = 1e-8
+        bw = 19
+        baseline = volume.shift(2).rolling(bw, min_periods=bw).median()
+        baseline_safe = baseline.clip(lower=eps)
+        volume_ratio = volume / baseline_safe
+        has_qav = bool((qav > 0).any())
+        q_baseline = qav.shift(2).rolling(bw, min_periods=bw).median().clip(lower=eps)
+        quote_ratio = qav / q_baseline if has_qav else pd.Series(1.0, index=qav.index)
+        prev_close = close.shift(1).clip(lower=eps)
+        candle_range = (high - low).clip(lower=eps)
+        body = (close - open_).abs()
+        price_jump = (close - close.shift(1)) / prev_close
+        range_frac = candle_range / close.clip(lower=eps)
+        body_frac = body / candle_range
+        close_to_high = (high - close) / candle_range
+        is_bullish = close > open_
+        up_close = (close > close.shift(1)).astype(float)
+        recent_up = up_close.rolling(3, min_periods=1).sum()
+
+        vol_spike = volume > 2.75 * baseline_safe
+        quote_spike = qav > 2.5 * q_baseline if has_qav else pd.Series(True, index=qav.index)
+        jump_flag = price_jump > 0.01
+        range_flag = range_frac > 0.012
+        body_flag = is_bullish & (body_frac > 0.45) & (close_to_high < 0.35)
+        trend_flag = recent_up >= (2 if has_qav else 1)
+        if has_qav:
+            score = volume_ratio * quote_ratio * price_jump.clip(lower=0) * (1 + body_frac)
+        else:
+            score = volume_ratio * price_jump.clip(lower=0)
+        threshold = score.shift(1).rolling(80, min_periods=20).quantile(0.92)
+        raw = (
+            vol_spike & quote_spike & jump_flag & range_flag & body_flag
+            & trend_flag & score.notna() & (score >= threshold.fillna(0.0))
+        )
+        qualified = bool(raw.iloc[-1]) and not bool(raw.iloc[-4:-1].any())
+        if not qualified:
+            return None
+        # context gate (l.175-179)
+        gate = _allows_long_autotrade(ctx, sym)
+        if ctx.valid and not gate:
+            return None
+        return True, ctx.valid and gate
+
+    def _pt(self, sym: str, ctx: OracleContext, quiet: bool) -> tuple[bool, bool] | None:
+        """coinrule/price_tracker.py: (fired, autotrade)."""
+        df = self.store5.frames[sym]
+        close = df["close"]
+        if len(df) < 30 or not ctx.valid:
+            return None
+        delta = close.diff()
+        gain = delta.clip(lower=0)
+        loss = (-delta).clip(lower=0)
+        avg_gain = gain.rolling(14, min_periods=14).mean().iloc[-1]
+        avg_loss = loss.rolling(14, min_periods=14).mean().iloc[-1]
+        if not (math.isfinite(_nz(avg_gain, np.nan)) and math.isfinite(_nz(avg_loss, np.nan))):
+            return None
+        denom = avg_gain + avg_loss
+        rsi = 100.0 * avg_gain / denom if denom != 0 else 50.0
+        macd = float(
+            (
+                close.ewm(span=12, adjust=False, min_periods=1).mean()
+                - close.ewm(span=26, adjust=False, min_periods=1).mean()
+            ).iloc[-1]
+        )
+        tp = (df["high"] + df["low"] + df["close"]) / 3.0
+        flow = tp * df["volume"]
+        tp_delta = tp.diff()
+        last14 = tp_delta.tail(14)
+        if last14.isna().any() or len(last14) < 14:
+            return None
+        pos = float(flow.tail(14)[last14 > 0].sum())
+        neg = float(flow.tail(14)[last14 < 0].sum())
+        total = pos + neg
+        mfi = 100.0 * pos / total if total != 0 else 50.0
+
+        if not (rsi < 30.0 and macd < 0.0 and mfi < 20.0):
+            return None
+        # telemetry gates (l.229-234)
+        ema9 = float(close.ewm(span=9, adjust=False, min_periods=1).mean().iloc[-1])
+        ema21 = float(close.ewm(span=21, adjust=False, min_periods=1).mean().iloc[-1])
+        trend_score = (ema9 - ema21) / abs(ema21) if ema21 != 0 else 0.0
+        f = ctx.features.get(sym)
+        rs = f.relative_strength_vs_btc if f else 0.0
+        cs = _context_score(ctx, is_short=False, symbol_rs=rs, symbol_trend=trend_score)
+        if not (
+            cs["followthrough"] >= -0.2
+            and cs["risk"] <= 0.6
+            and cs["confidence"] >= 0.5
+        ):
+            return None
+        # cooldown on close_time (l.78-94)
+        close_time = int(df["open_time"].iloc[-1]) // 1000 + FIVE_MIN_S
+        last = self.pt_last_close.get(sym)
+        if last is not None and 0 <= close_time - last < 12 * FIVE_MIN_S:
+            return None
+        self.pt_last_close[sym] = close_time
+        # routing (l.96-155)
+        stable_breadth = (
+            0.48 <= ctx.advancers_ratio <= 0.62
+            and abs(ctx.long_tailwind - ctx.short_tailwind) <= 0.35
+        )
+        autotrade = (
+            not ctx.regime_is_transitioning
+            and ctx.market_stress_score < 0.3
+            and stable_breadth
+            and ctx.market_regime == int(MarketRegimeCode.RANGE)
+            and f is not None
+            and f.valid
+            and f.micro_regime >= 0
+            and f.micro_transition
+            not in (
+                int(MicroTransitionCode.BREAKDOWN),
+                int(MicroTransitionCode.VOLATILITY_EXPANSION),
+            )
+            and rs > 0.005
+            and f.micro_regime == int(MicroRegimeCode.RANGE)
+        )
+        return True, autotrade and not quiet
+
+    def _lsp(
+        self,
+        sym: str,
+        ctx: OracleContext,
+        oi_growth: float,
+        adp_latest: float,
+        adp_prev: float,
+        btc_momentum: float,
+    ) -> tuple[bool, bool, int] | None:
+        """liquidation_sweep_pump.py: (fired, autotrade, direction)."""
+        df = self.store15.frames[sym]
+        wh = 3
+        volume, close = df["volume"], df["close"]
+        high, low = df["high"], df["low"]
+        rel_volume = volume / volume.rolling(wh * 2).mean().shift(wh)
+        momentum = close / close.shift(wh) - 1.0
+        range_frac = (
+            high.rolling(wh * 2).max() - low.rolling(wh * 2).min()
+        ) / close
+        oi_factor = 1.0 + max(0.0, oi_growth - 1.0) if math.isfinite(oi_growth) else 1.0
+        pump_score = rel_volume * (1.0 + momentum) * oi_factor / range_frac
+        smooth = pump_score.rolling(2).mean()
+        recent = smooth.tail(48).to_numpy()
+        finite = recent[np.isfinite(recent)]
+        latest_smooth = float(smooth.iloc[-1]) if len(smooth) else float("nan")
+        if not (math.isfinite(latest_smooth) and len(finite)):
+            return None
+        threshold = float(np.quantile(finite, 0.80))
+        trigger_score = max(latest_smooth, _nz(pump_score.iloc[-1], -np.inf))
+        if trigger_score < threshold:
+            return None
+        if math.isfinite(oi_growth) and oi_growth < 1.02:
+            return None
+        # breadth-fade routing (l.76-108)
+        if not ctx.valid or ctx.market_stress_score >= 0.35:
+            return None
+        has_pair = math.isfinite(adp_prev)
+        falling = has_pair and adp_latest < adp_prev
+        increasing = has_pair and adp_latest > adp_prev
+        btc_stalled = abs(btc_momentum) <= 0.002
+        f = ctx.features.get(sym)
+        weak = (
+            f is not None
+            and f.valid
+            and f.relative_strength_vs_btc <= 0
+            and (
+                f.trend_score <= 0
+                or not f.above_ema20
+                or f.micro_regime != int(MicroRegimeCode.TREND_UP)
+            )
+        )
+        hot = adp_latest > 0.3
+        washed = adp_latest <= -0.4
+        short_ok = hot and falling and btc_stalled and f is not None and f.valid and weak
+        long_ok = washed and increasing and btc_momentum > 0
+        if not (short_ok or long_ok):
+            return None
+        direction = int(Direction.SHORT) if short_ok else int(Direction.LONG)
+        return True, True, direction
+
+    def _mrf(self, sym: str) -> tuple[bool, bool, int] | None:
+        """mean_reversion_fade.py: (fired, autotrade, direction)."""
+        if not self.is_futures:
+            return None
+        df = self.store15.frames[sym]
+        close, open_ = df["close"], df["open"]
+        delta = close.diff()
+        gain = delta.clip(lower=0)
+        loss = (-delta).clip(lower=0)
+        avg_gain = gain.ewm(alpha=1 / 14, adjust=False, min_periods=14).mean().iloc[-1]
+        avg_loss = loss.ewm(alpha=1 / 14, adjust=False, min_periods=14).mean().iloc[-1]
+        volume_ma = df["volume"].rolling(20).mean().iloc[-1]
+        tail = df.tail(35)
+        prev_close = tail["close"].shift(1)
+        tr = pd.concat(
+            [
+                tail["high"] - tail["low"],
+                (tail["high"] - prev_close).abs(),
+                (tail["low"] - prev_close).abs(),
+            ],
+            axis=1,
+        ).max(axis=1).iloc[1:]
+        atr_series = tr.rolling(14).mean()
+        atr = atr_series.iloc[-1]
+        atr_ma = atr_series.rolling(20).mean().iloc[-1]
+        if not all(
+            math.isfinite(_nz(v, np.nan))
+            for v in (avg_gain, avg_loss, volume_ma, atr, atr_ma)
+        ):
+            return None
+        denom = avg_gain + avg_loss
+        rsi = 100.0 * avg_gain / denom if denom != 0 else 50.0
+        if not (atr < 2.0 * atr_ma):
+            return None
+        if not (df["volume"].iloc[-1] >= volume_ma):
+            return None
+        mid = close.rolling(20).mean().iloc[-1]
+        std = close.rolling(20).std(ddof=0).iloc[-1]
+        if not (math.isfinite(_nz(mid, np.nan)) and math.isfinite(_nz(std, np.nan))):
+            return None
+        bb_upper, bb_lower = mid + 2 * std, mid - 2 * std
+        c, o = float(close.iloc[-1]), float(open_.iloc[-1])
+        long_setup = rsi <= 25.0 and c <= bb_lower and c > o
+        short_setup = rsi >= 75.0 and c >= bb_upper and c < o
+        if not (long_setup or short_setup):
+            return None
+        open_time = int(df["open_time"].iloc[-1]) // 1000
+        if self.mrf_last_open.get(sym) == open_time:
+            return None
+        self.mrf_last_open[sym] = open_time
+        direction = int(Direction.SHORT) if short_setup else int(Direction.LONG)
+        return True, True, direction
+
+    def _ladder(
+        self, sym: str, ctx: OracleContext, grid_policy_allows: bool
+    ) -> tuple[bool, bool] | None:
+        """grid/ladder_deployer.py: (fired, autotrade)."""
+        if not (self.is_futures and grid_policy_allows and ctx.valid):
+            return None
+        f = ctx.features.get(sym)
+        if f is None or not f.valid:
+            return None
+        if f.micro_regime not in (
+            int(MicroRegimeCode.RANGE),
+            int(MicroRegimeCode.TRANSITIONAL),
+        ):
+            return None
+        if f.micro_transition in (
+            int(MicroTransitionCode.BREAKDOWN),
+            int(MicroTransitionCode.VOLATILITY_EXPANSION),
+            int(MicroTransitionCode.ENTERED_TREND_DOWN),
+        ):
+            return None
+        if ctx.long_regime_score < 0.2:
+            return None
+        df = self.store15.frames[sym]
+        if len(df) < 27:
+            return None
+        close = df["close"]
+        mid = close.rolling(20).mean()
+        std = close.rolling(20).std(ddof=0)
+        widths = ((mid + 2 * std) - (mid - 2 * std)) / mid
+        w = widths.tail(8)
+        if len(w) < 8 or not bool((np.isfinite(w) & (w > 0)).all()):
+            return None
+        change_pct = abs(
+            (float(w.iloc[-1]) - float(w.iloc[0]))
+            / (float(w.iloc[0]) if w.iloc[0] != 0 else 1.0)
+        ) * 100.0
+        if change_pct > 20.0:
+            return None
+        range_low = float((mid - 2 * std).iloc[-1])
+        range_high = float((mid + 2 * std).iloc[-1])
+        price = float(close.iloc[-1])
+        if not (range_low < price < range_high):
+            return None
+        bb_mid = float(mid.iloc[-1])
+        width_pct = (range_high - range_low) / bb_mid * 100.0 if bb_mid > 0 else 0.0
+        if not (1.5 <= width_pct <= 8.0):
+            return None
+        return True, True
+
+    # -- the tick ----------------------------------------------------------
+
+    def evaluate(
+        self,
+        now_ms: int,
+        quiet: bool | None = None,
+        grid_policy_allows: bool = False,
+        oi_growth: dict[str, float] | None = None,
+        adp_latest: float = float("nan"),
+        adp_prev: float = float("nan"),
+    ) -> list[tuple[str, str, str, bool]]:
+        """One tick; returns fired (strategy, symbol, direction, autotrade).
+
+        ``quiet=None`` resolves the quiet-hours filter from wall clock and
+        the PREVIOUS tick's regime — the same inputs the live pipeline uses.
+        """
+        ts_s = now_ms // 1000
+        ts15 = ts_s // FIFTEEN_MIN_S * FIFTEEN_MIN_S - FIFTEEN_MIN_S
+        ts5 = ts_s // FIVE_MIN_S * FIVE_MIN_S - FIVE_MIN_S
+
+        if quiet is None:
+            from datetime import UTC, datetime
+
+            from binquant_tpu.regime.time_filter import is_autotrade_suppressed
+
+            # judged at the EVALUATED tick time, matching the pipeline
+            quiet = is_autotrade_suppressed(
+                self._last_regime,
+                self._last_strength,
+                now=datetime.fromtimestamp(now_ms / 1000, tz=UTC),
+            )
+
+        ctx = self._build_context(ts15)
+        if ctx.valid:
+            self._last_regime = ctx.market_regime
+            self._last_strength = ctx.market_regime_transition_strength
+        else:
+            self._last_regime = None
+            self._last_strength = 0.0
+
+        btc_df = self.store15.frames.get(self.btc_symbol)
+        btc_momentum = 0.0
+        if btc_df is not None and len(btc_df) >= 2:
+            prev = float(btc_df["close"].iloc[-2])
+            if prev != 0 and math.isfinite(prev):
+                btc_momentum = float(btc_df["close"].iloc[-1]) / prev - 1.0
+
+        fresh5 = {
+            s
+            for s in self.store5.fresh(ts5)
+            if len(self.store5.frames[s]) >= MIN_BARS
+        }
+        fresh15 = {
+            s
+            for s in self.store15.fresh(ts15)
+            if len(self.store15.frames[s]) >= MIN_BARS
+        }
+        oi = oi_growth or {}
+
+        fired: list[tuple[str, str, str, bool]] = []
+
+        def emit(strategy, sym, direction, autotrade, bar_ts):
+            key = (strategy, sym)
+            if self.last_emitted.get(key) == bar_ts:
+                return
+            self.last_emitted[key] = bar_ts
+            fired.append((strategy, sym, direction, autotrade))
+
+        for sym in sorted(fresh5):
+            r = self._abp(sym, ctx)
+            if r:
+                emit("activity_burst_pump", sym, "LONG", r[1], ts5)
+        for sym in sorted(fresh5):
+            r = self._pt(sym, ctx, quiet)
+            if r:
+                emit("coinrule_price_tracker", sym, "LONG", r[1], ts5)
+        for sym in sorted(fresh15):
+            r = self._lsp(
+                sym, ctx, oi.get(sym, float("nan")), adp_latest, adp_prev,
+                btc_momentum,
+            )
+            if r:
+                emit(
+                    "liquidation_sweep_pump", sym,
+                    Direction(r[2]).name, r[1], ts15,
+                )
+        for sym in sorted(fresh15):
+            r = self._mrf(sym)
+            if r:
+                emit("mean_reversion_fade", sym, Direction(r[2]).name, r[1], ts15)
+        for sym in sorted(fresh15):
+            r = self._ladder(sym, ctx, grid_policy_allows)
+            if r:
+                emit("grid_ladder", sym, "grid", r[1], ts15)
+        return fired

@@ -160,9 +160,28 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--replay", help="JSONL kline file for offline replay")
     parser.add_argument("--replay-report", action="store_true")
+    parser.add_argument(
+        "--backend",
+        choices=("tpu", "reference", "ab"),
+        default="tpu",
+        help="replay evaluation backend: the TPU batch path, the legacy "
+        "per-symbol pandas oracle, or an A/B diff of both (BASELINE #1)",
+    )
     args = parser.parse_args()
 
     if args.replay:
+        if args.backend == "reference":
+            from binquant_tpu.io.replay import run_replay_oracle
+
+            signals = run_replay_oracle(args.replay)
+            print({"backend": "reference", "signals": len(signals)})
+            return 0
+        if args.backend == "ab":
+            from binquant_tpu.io.replay import run_replay_ab
+
+            result = run_replay_ab(args.replay)
+            print(result)
+            return 0 if result["match"] else 1
         from binquant_tpu.io.replay import run_replay
 
         stats = run_replay(args.replay)

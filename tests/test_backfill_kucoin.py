@@ -133,16 +133,17 @@ class TestFetcherSymbolForms:
         with pytest.raises(RuntimeError, match="400100"):
             KucoinFutures(session=Sess()).get_ui_klines("NOPE", 15)
 
-    def test_kucoin_futures_rest_sends_time_range(self):
-        # without from/to the endpoint returns server-default recent rows
-        # (~200), silently seeding half the requested window
+    def test_kucoin_futures_rest_paginates_time_range(self):
+        # the endpoint caps ~200 rows/request AND returns server-default
+        # recent rows without from/to — 400 bars must arrive as two
+        # contiguous ≤200-bar pages, deduped and oldest-first
         from binquant_tpu.io.exchanges import KucoinFutures
 
-        captured = {}
+        calls = []
 
         class Sess:
             def get(self, url, params=None):
-                captured.update(params or {})
+                calls.append(dict(params or {}))
 
                 class R:
                     status_code = 200
@@ -151,12 +152,29 @@ class TestFetcherSymbolForms:
                         pass
 
                     def json(self):
-                        return {"code": "200000", "data": []}
+                        p = calls[-1]
+                        bar = 15 * 60_000
+                        data = [
+                            [t, 1.0, 2.0, 0.5, 1.5, 10.0]
+                            for t in range(p["from"], p["to"], bar)
+                        ]
+                        return {"code": "200000", "data": data}
 
                 return R()
 
-        KucoinFutures(session=Sess()).get_ui_klines("XBTUSDTM", 15, limit=400)
-        assert captured["to"] - captured["from"] == 400 * 15 * 60_000
+        rows = KucoinFutures(session=Sess()).get_ui_klines(
+            "XBTUSDTM", 15, limit=400
+        )
+        assert len(calls) == 2
+        bar = 15 * 60_000
+        for p in calls:
+            assert p["to"] - p["from"] == 200 * bar
+        # contiguous: second (older) page ends where the first began
+        assert calls[1]["to"] == calls[0]["from"]
+        assert len(rows) == 400
+        times = [int(r[0]) for r in rows]
+        assert times == sorted(times)
+        assert times[-1] - times[0] == 399 * bar
 
 
 # ---------------------------------------------------------------------------

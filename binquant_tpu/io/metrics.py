@@ -1,0 +1,71 @@
+"""Per-tick / per-stage latency tracking (SURVEY.md §5).
+
+The reference has no profiling at all; the TPU build budget (p99 < 50 ms
+end-to-end, BASELINE.json north star) demands the cost be measured in
+production, not guessed. ``LatencyTracker`` keeps rolling reservoirs per
+stage and logs p50/p99 periodically; ``tools/profile_stages.py`` is the
+offline jax.profiler companion for kernel-level traces.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from contextlib import contextmanager
+
+
+class LatencyTracker:
+    """Rolling per-stage latency histograms with periodic logging."""
+
+    def __init__(self, window: int = 1024, log_every_s: float = 300.0) -> None:
+        self.window = window
+        self.log_every_s = log_every_s
+        self._samples: dict[str, deque[float]] = {}
+        self._last_log = time.monotonic()
+
+    def record(self, stage: str, ms: float) -> None:
+        buf = self._samples.get(stage)
+        if buf is None:
+            buf = self._samples[stage] = deque(maxlen=self.window)
+        buf.append(float(ms))
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, (time.perf_counter() - t0) * 1000.0)
+
+    def stats(self) -> dict[str, dict[str, float]]:
+        import numpy as np
+
+        out: dict[str, dict[str, float]] = {}
+        for stage, buf in self._samples.items():
+            if not buf:
+                continue
+            vals = np.asarray(buf)
+            out[stage] = {
+                "n": len(vals),
+                "p50_ms": round(float(np.percentile(vals, 50)), 3),
+                "p99_ms": round(float(np.percentile(vals, 99)), 3),
+                "mean_ms": round(float(vals.mean()), 3),
+                "max_ms": round(float(vals.max()), 3),
+            }
+        return out
+
+    def maybe_log(self) -> bool:
+        """Log the stage table at the configured cadence; True if logged."""
+        now = time.monotonic()
+        if now - self._last_log < self.log_every_s:
+            return False
+        self._last_log = now
+        stats = self.stats()
+        if stats:
+            line = " ".join(
+                f"{stage}[p50={s['p50_ms']}ms p99={s['p99_ms']}ms n={s['n']}]"
+                for stage, s in sorted(stats.items())
+            )
+            logging.info("tick latency: %s", line)
+        return True

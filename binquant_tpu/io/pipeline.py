@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from datetime import UTC, datetime
 from pathlib import Path
 from typing import Any
 
@@ -39,6 +40,7 @@ from binquant_tpu.io.emission import (
     extract_fired,
 )
 from binquant_tpu.io.leverage import LeverageCalibrator
+from binquant_tpu.io.metrics import LatencyTracker
 from binquant_tpu.io.telegram import TelegramConsumer
 from binquant_tpu.regime.context import ContextConfig
 from binquant_tpu.regime.grid_policy import GridOnlyPolicy
@@ -133,6 +135,9 @@ class SignalEngine:
         self.signals_emitted = 0
         # optional CheckpointManager; consume_loop snapshots through it
         self.checkpoint = None
+        # per-stage latency histograms (SURVEY §5: the p99<50ms budget is
+        # measured in production, not guessed)
+        self.latency = LatencyTracker()
 
     # -- ingest -------------------------------------------------------------
 
@@ -287,6 +292,7 @@ class SignalEngine:
         """Drain batchers, run the jit'd step, emit fired signals."""
         import jax.numpy as jnp
 
+        t_tick0 = time.perf_counter()
         ts_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         ts_s = ts_ms // 1000
         # Evaluate against the bar that just CLOSED: its open time is one
@@ -318,8 +324,6 @@ class SignalEngine:
         # The filter reads the EVALUATED tick time, not the wall clock —
         # identical live (tick time ≈ now), and it makes replays
         # deterministic instead of depending on when they happen to run.
-        from datetime import UTC, datetime
-
         quiet = is_autotrade_suppressed(
             self._last_regime,
             self._last_transition_strength,
@@ -355,20 +359,22 @@ class SignalEngine:
                 self.at_consumer.market_domination_reversal
             ),
         )
-        self.state, outputs = tick_step(
-            self.state,
-            u5,
-            u15,
-            inputs,
-            self.context_config,
-            # device-side wire compaction must match the host's enabled set
-            wire_enabled=tuple(sorted(self.enabled_strategies))
-            if self.enabled_strategies is not None
-            else tuple(sorted(LIVE_STRATEGIES)),
-        )
+        with self.latency.stage("device_dispatch"):
+            self.state, outputs = tick_step(
+                self.state,
+                u5,
+                u15,
+                inputs,
+                self.context_config,
+                # device-side wire compaction must match the host's enabled set
+                wire_enabled=tuple(sorted(self.enabled_strategies))
+                if self.enabled_strategies is not None
+                else tuple(sorted(LIVE_STRATEGIES)),
+            )
         # ONE device fetch per tick: the packed wire (context scalars +
         # compacted fired entries). Everything host-side below reads it.
-        unpacked = unpack_wire(outputs.wire)
+        with self.latency.stage("wire_fetch"):
+            unpacked = unpack_wire(outputs.wire)
         fired_w, ctx_scalars = unpacked
         regime = ctx_scalars["market_regime"]
         has_ctx = ctx_scalars["valid"]
@@ -399,6 +405,7 @@ class SignalEngine:
             self._last_transition_strength = 0.0
 
         # emit fired signals through the three sinks
+        t_emit0 = time.perf_counter()
         fired = extract_fired(
             outputs,
             self.registry,
@@ -430,6 +437,9 @@ class SignalEngine:
                     signal.strategy,
                     signal.symbol,
                 )
+        self.latency.record("emission", (time.perf_counter() - t_emit0) * 1000.0)
+        self.latency.record("tick_total", (time.perf_counter() - t_tick0) * 1000.0)
+        self.latency.maybe_log()
         self.signals_emitted += len(fired)
         self.ticks_processed += 1
         self.touch_heartbeat()

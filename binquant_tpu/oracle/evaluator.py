@@ -380,9 +380,7 @@ class OracleEvaluator:
             self._stage_ts = ts15
 
         tracked = set(self.store5.frames) | set(self.store15.frames)
-        fresh = [
-            s for s in self.store15.fresh(ts15) if s in tracked
-        ]
+        fresh = self.store15.fresh(ts15)  # always a subset of tracked
         feats: dict[str, SymbolFeatures] = {}
         for sym in fresh:
             f = _symbol_features(self.store15.frames[sym])
@@ -445,16 +443,12 @@ class OracleEvaluator:
             + 0.45 * ctx.market_stress_score
         )
 
+        # effective >= required already implies the fresh-count and
+        # coverage-ratio gates (required = max of both thresholds)
         required = max(
             self.required_fresh, math.ceil(total_tracked * self.min_coverage)
         )
-        coverage = effective / max(total_tracked, 1)
-        ctx.valid = (
-            effective >= required
-            and total_tracked > 0
-            and effective >= self.required_fresh
-            and coverage >= self.min_coverage
-        )
+        ctx.valid = total_tracked > 0 and effective >= required
 
         # --- macro ladder + transition (regime_transitions.py:45-160)
         R = MarketRegimeCode

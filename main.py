@@ -169,6 +169,9 @@ def main() -> int:
     )
     args = parser.parse_args()
 
+    if args.backend != "tpu" and not args.replay:
+        parser.error("--backend reference/ab requires --replay")
+
     if args.replay:
         if args.backend == "reference":
             from binquant_tpu.io.replay import run_replay_oracle

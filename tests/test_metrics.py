@@ -1,0 +1,47 @@
+"""LatencyTracker: per-tick/per-stage latency histograms (SURVEY.md §5)."""
+
+import logging
+
+from binquant_tpu.io.metrics import LatencyTracker
+
+
+def test_percentiles_and_stats():
+    t = LatencyTracker()
+    for v in range(1, 101):  # 1..100 ms
+        t.record("tick_total", float(v))
+    s = t.stats()["tick_total"]
+    assert s["n"] == 100
+    assert abs(s["p50_ms"] - 50.5) < 0.01
+    assert abs(s["p99_ms"] - 99.01) < 0.01
+    assert s["max_ms"] == 100.0
+    assert abs(s["mean_ms"] - 50.5) < 0.01
+
+
+def test_stage_context_manager_records():
+    t = LatencyTracker()
+    with t.stage("device_dispatch"):
+        pass
+    s = t.stats()
+    assert "device_dispatch" in s and s["device_dispatch"]["n"] == 1
+    assert s["device_dispatch"]["p99_ms"] >= 0.0
+
+
+def test_rolling_window_bounded():
+    t = LatencyTracker(window=8)
+    for v in range(100):
+        t.record("x", float(v))
+    s = t.stats()["x"]
+    assert s["n"] == 8
+    assert s["max_ms"] == 99.0  # only the trailing window retained
+
+
+def test_maybe_log_cadence(caplog):
+    t = LatencyTracker(log_every_s=0.0)
+    t.record("tick_total", 5.0)
+    with caplog.at_level(logging.INFO):
+        assert t.maybe_log()
+    assert any("tick latency" in r.message for r in caplog.records)
+    # empty tracker logs nothing but still honors the cadence
+    t2 = LatencyTracker(log_every_s=1e9)
+    t2.record("x", 1.0)
+    assert not t2.maybe_log()

@@ -1,0 +1,125 @@
+"""Live tick timestamping vs reality (VERDICT round-1 weak item 5).
+
+``process_tick`` derives the evaluated bar from wall clock
+(``bucket*interval - interval``); these tests pin the behavior when the
+clock and the data disagree: a tick firing late (>1 interval after the bar
+closed) or early must evaluate an EMPTY freshness mask — going blind for a
+tick — rather than silently evaluating a stale bar as fresh, and a
+catch-up tick at the right bucket must recover the signal.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from binquant_tpu.io.replay import (
+    generate_replay_file,
+    load_klines_by_tick,
+    make_stub_engine,
+)
+
+CAP, WIN = 16, 130
+
+
+@pytest.fixture(scope="module")
+def market(tmp_path_factory):
+    path = tmp_path_factory.mktemp("ts") / "rp.jsonl"
+    # enough ticks that MIN_BARS is irrelevant to the assertion target:
+    # we inspect freshness via the engine's own wire, not strategy fires
+    generate_replay_file(path, n_symbols=8, n_ticks=5)
+    return load_klines_by_tick(path)
+
+
+def _drive(engine, by_tick, buckets, now_ms_of):
+    fired_all = []
+
+    async def go():
+        for b in buckets:
+            for k in sorted(by_tick[b], key=lambda k: k["open_time"]):
+                engine.ingest(k)
+            fired_all.append(await engine.process_tick(now_ms=now_ms_of(b)))
+
+    asyncio.run(go())
+    return fired_all
+
+
+def _fresh_counts(engine):
+    import numpy as np
+
+    return (
+        int(np.asarray(engine.state.buf5.filled).sum()),
+        int(np.asarray(engine.state.buf15.filled).sum()),
+    )
+
+
+def test_on_time_tick_sees_fresh_bars(market):
+    import numpy as np
+
+    engine = make_stub_engine(capacity=CAP, window=WIN)
+    buckets = sorted(market)
+    _drive(engine, market, buckets, lambda b: (b + 1) * 900 * 1000)
+    # the last evaluated 15m bucket matches the final bars: all 8 fresh
+    ts15 = buckets[-1] * 900
+    from binquant_tpu.engine.buffer import fresh_mask
+
+    fresh = np.asarray(fresh_mask(engine.state.buf15, ts15))
+    assert fresh.sum() == 8
+
+
+def test_late_tick_evaluates_empty_freshness_not_stale(market):
+    """Clock lands >1 interval after the bar closed: the engine must see
+    ZERO fresh symbols (blind tick), never a stale bar counted as fresh."""
+    import numpy as np
+
+    engine = make_stub_engine(capacity=CAP, window=WIN)
+    buckets = sorted(market)
+    # deliver bars on time for all but the last bucket
+    _drive(engine, market, buckets[:-1], lambda b: (b + 1) * 900 * 1000)
+    # last bucket's bars arrive, but the tick fires TWO buckets later
+    late_ms = (buckets[-1] + 3) * 900 * 1000
+    fired = _drive(engine, market, buckets[-1:], lambda b: late_ms)
+    from binquant_tpu.engine.buffer import fresh_mask
+
+    evaluated_ts15 = (late_ms // 1000) // 900 * 900 - 900
+    fresh = np.asarray(fresh_mask(engine.state.buf15, evaluated_ts15))
+    assert fresh.sum() == 0  # blind, not stale
+    assert fired[-1] == []  # and silent — no signals from stale bars
+
+
+def test_catchup_tick_recovers_the_bucket(market):
+    """After a late/blind tick, a re-tick at the CORRECT bucket boundary
+    still finds the bars fresh (the buffer holds them; only the clock
+    mapping was off)."""
+    import numpy as np
+
+    engine = make_stub_engine(capacity=CAP, window=WIN)
+    buckets = sorted(market)
+    _drive(engine, market, buckets[:-1], lambda b: (b + 1) * 900 * 1000)
+    # bars ingested, blind tick fires late
+    _drive(engine, market, buckets[-1:], lambda b: (b + 3) * 900 * 1000)
+    # catch-up: evaluate again at the right boundary
+    asyncio.run(engine.process_tick(now_ms=(buckets[-1] + 1) * 900 * 1000))
+    from binquant_tpu.engine.buffer import fresh_mask
+
+    fresh = np.asarray(
+        fresh_mask(engine.state.buf15, buckets[-1] * 900)
+    )
+    assert fresh.sum() == 8
+
+
+def test_clock_skew_before_bar_close_is_blind(market):
+    """A tick whose clock is in the bucket BEFORE the delivered bars
+    (skewed-behind clock) also evaluates empty freshness."""
+    import numpy as np
+
+    engine = make_stub_engine(capacity=CAP, window=WIN)
+    buckets = sorted(market)
+    early_ms = buckets[0] * 900 * 1000  # bars' own open bucket: bar not closed
+    fired = _drive(engine, market, buckets[:1], lambda b: early_ms)
+    from binquant_tpu.engine.buffer import fresh_mask
+
+    evaluated_ts15 = (early_ms // 1000) // 900 * 900 - 900
+    fresh = np.asarray(fresh_mask(engine.state.buf15, evaluated_ts15))
+    assert fresh.sum() == 0
+    assert fired[-1] == []

@@ -44,7 +44,7 @@ from binquant_tpu.io.metrics import LatencyTracker
 from binquant_tpu.io.telegram import TelegramConsumer
 from binquant_tpu.regime.context import ContextConfig
 from binquant_tpu.regime.grid_policy import GridOnlyPolicy
-from binquant_tpu.regime.time_filter import is_autotrade_suppressed, is_quiet_hours
+from binquant_tpu.regime.time_filter import is_autotrade_suppressed
 from binquant_tpu.schemas import MarketBreadthSeries
 from binquant_tpu.strategies.market_regime_notifier import MarketRegimeNotifier
 

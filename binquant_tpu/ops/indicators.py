@@ -21,9 +21,7 @@ import jax.numpy as jnp
 from binquant_tpu.ops.rolling import (
     diff,
     ewm_mean,
-    rolling_max,
     rolling_mean,
-    rolling_min,
     rolling_std,
     rolling_sum,
     rolling_var,

@@ -31,7 +31,6 @@ from binquant_tpu.ops.rolling import (
     ewm_mean_last,
     rolling_mean,
     rolling_mean_last,
-    rolling_std_last,
     shift,
 )
 from binquant_tpu.utils import jsafe_div

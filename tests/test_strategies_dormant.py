@@ -8,7 +8,6 @@ crafted contexts (mirrors the reference's per-strategy test files).
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
-import pytest
 
 from binquant_tpu.enums import Direction, MarketRegimeCode, MicroRegimeCode
 from binquant_tpu.strategies import (

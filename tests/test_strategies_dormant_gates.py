@@ -1,0 +1,448 @@
+"""Dormant-strategy gate matrices — deterministic branch coverage.
+
+Mirrors the reference's per-strategy test files (e.g.
+``tests/test_coinrule_buy_the_dip.py``'s 14 gate tests,
+``tests/test_range_bb_rsi_mean_reversion.py``): each strategy gets a
+deterministic base scenario that MUST fire, then every gate is flipped
+one at a time and must block (or flip autotrade only, where the reference
+emits with autotrade off).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from binquant_tpu.enums import (
+    MarketRegimeCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.strategies import (
+    buy_low_sell_high,
+    buy_the_dip,
+    compute_feature_pack,
+    inverse_price_tracker,
+    range_bb_rsi_mean_reversion,
+    relative_strength_reversal_range,
+    twap_momentum_sniper,
+)
+from binquant_tpu.strategies.dormant import (
+    BTD_ROUTE_QUIET_HOURS,
+    BTD_ROUTE_STRESS,
+)
+from tests.test_regime_routing_scoring import mk_context, mk_features
+from tests.test_strategies_live import S_CAP, WINDOW, fill_buffer
+
+
+def flat_df(n=WINDOW, price=100.0, vol_noise=0.0):
+    t0 = 1_700_000_000_000
+    close = np.full(n, price)
+    if vol_noise:
+        close = price * (1 + vol_noise * np.sin(np.arange(n) * 0.9))
+    open_ = np.concatenate([[price], close[:-1]])
+    return pd.DataFrame(
+        {
+            "open_time": t0 + 900_000 * np.arange(n, dtype=np.int64),
+            "close_time": t0 + 900_000 * np.arange(n, dtype=np.int64) + 899_999,
+            "open": open_,
+            "high": np.maximum(open_, close) * 1.0005,
+            "low": np.minimum(open_, close) * 0.9995,
+            "close": close,
+            "volume": np.full(n, 1000.0),
+            "quote_asset_volume": close * 1000.0,
+            "number_of_trades": np.full(n, 500.0),
+            "taker_buy_base_volume": np.full(n, 500.0),
+            "taker_buy_quote_volume": close * 500.0,
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# BuyTheDip — deterministic dip/reclaim matrix (reference: 14 gate tests)
+# ---------------------------------------------------------------------------
+
+
+def craft_dip(final_close=97.6, dip_level=97.0):
+    """Reference bar (-25) at 100, 6h dip to ``dip_level``, final bar at
+    ``final_close``. change_6h = final_close - 100 (%). EMA20 after 23
+    bars at 97 decays to ~97.27, so 97.6 reclaims it deterministically."""
+    df = flat_df()
+    n = len(df)
+    for j in range(n - 24, n - 1):
+        df.loc[df.index[j], "close"] = dip_level
+        df.loc[df.index[j], "open"] = dip_level
+        df.loc[df.index[j], "high"] = dip_level * 1.0005
+        df.loc[df.index[j], "low"] = dip_level * 0.9995
+    df.loc[df.index[-1], "open"] = dip_level
+    df.loc[df.index[-1], "close"] = final_close
+    df.loc[df.index[-1], "high"] = final_close * 1.0005
+    df.loc[df.index[-1], "low"] = dip_level * 0.9995
+    return df
+
+
+class TestBuyTheDipGates:
+    def _eval(self, df, ctx=None, quiet=False):
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        return buy_the_dip(
+            buf, pack, ctx or mk_context(n=S_CAP), jnp.asarray(quiet)
+        )
+
+    def test_base_dip_reclaim_fires_with_autotrade(self):
+        out = self._eval(craft_dip())
+        assert -5.0 < float(out.diagnostics["change_6h"][0]) <= -2.0
+        assert bool(out.trigger[0])
+        assert bool(out.autotrade[0])
+
+    def test_repeated_signals_without_local_cooldown(self):
+        # the reference deliberately has no per-strategy cooldown here
+        df = craft_dip()
+        assert bool(self._eval(df).trigger[0])
+        assert bool(self._eval(df).trigger[0])
+
+    def test_dip_too_small_skips(self):
+        # -1.5% > -2% upper bound
+        out = self._eval(craft_dip(final_close=98.5))
+        assert not bool(out.trigger[0])
+
+    def test_dip_too_deep_skips(self):
+        # -5.5% <= -5% lower bound (dip must stay above it)
+        out = self._eval(craft_dip(final_close=94.5, dip_level=94.0))
+        assert not bool(out.trigger[0])
+
+    def test_requires_reclaim_above_prior_close(self):
+        # close 96.9 < prior close 97: no reclaim (still a valid dip %)
+        out = self._eval(craft_dip(final_close=96.9))
+        assert not bool(out.trigger[0])
+
+    def test_requires_reclaim_above_ema20(self):
+        # above prior close (97.05 > 97) but below the ~97.27 EMA20
+        out = self._eval(craft_dip(final_close=97.05))
+        assert not bool(out.trigger[0])
+
+    def test_market_trend_regimes_block_entry(self):
+        for regime in (MarketRegimeCode.TREND_UP, MarketRegimeCode.TREND_DOWN):
+            ctx = mk_context(n=S_CAP, market_regime=np.int32(regime))
+            assert not bool(self._eval(craft_dip(), ctx).trigger[0])
+
+    def test_symbol_trend_regimes_block_entry(self):
+        for micro in (MicroRegimeCode.TREND_UP, MicroRegimeCode.TREND_DOWN):
+            ctx = mk_context(
+                n=S_CAP,
+                features=mk_features(
+                    n=S_CAP,
+                    micro_regime=np.full(S_CAP, int(micro), np.int32),
+                ),
+            )
+            assert not bool(self._eval(craft_dip(), ctx).trigger[0])
+
+    def test_stress_blocks_autotrade_not_signal(self):
+        ctx = mk_context(n=S_CAP, market_stress_score=0.5)
+        out = self._eval(craft_dip(), ctx)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == BTD_ROUTE_STRESS
+
+    def test_transitioning_blocks_autotrade_not_signal(self):
+        ctx = mk_context(n=S_CAP, regime_is_transitioning=True)
+        out = self._eval(craft_dip(), ctx)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+
+    def test_quiet_hours_flips_autotrade_only(self):
+        out = self._eval(craft_dip(), quiet=True)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == BTD_ROUTE_QUIET_HOURS
+
+
+# ---------------------------------------------------------------------------
+# RangeBbRsiMeanReversion — short rejection + vetoes
+# ---------------------------------------------------------------------------
+
+
+def craft_upper_rejection():
+    """Low-noise oscillation (keeps rolling-sum ADX low), a 2-bar pop to
+    +2σ, then a bearish upper-wick rejection candle."""
+    df = flat_df(vol_noise=0.002)
+    n = len(df)
+    c2 = float(df["close"].iloc[-4])
+    pops = [c2 * 1.012, c2 * 1.024]
+    for j, c in enumerate(pops):
+        i = n - 3 + j
+        df.loc[df.index[i], "open"] = pops[j - 1] if j else c2
+        df.loc[df.index[i], "close"] = c
+        df.loc[df.index[i], "high"] = c * 1.001
+        df.loc[df.index[i], "low"] = (pops[j - 1] if j else c2) * 0.999
+    top = pops[-1]
+    df.loc[df.index[-1], "open"] = top * 1.001
+    df.loc[df.index[-1], "high"] = top * 1.009
+    df.loc[df.index[-1], "close"] = top * 0.9985
+    df.loc[df.index[-1], "low"] = top * 0.998
+    return df
+
+
+class TestRangeBbRsiShort:
+    def _eval(self, df, ctx=None):
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        return range_bb_rsi_mean_reversion(
+            buf, pack, ctx or mk_context(n=S_CAP)
+        )
+
+    def test_short_rejection_fires(self):
+        out = self._eval(craft_upper_rejection())
+        assert float(out.diagnostics["adx"][0]) <= 32.0
+        assert float(out.diagnostics["zscore"][0]) >= 2.0
+        assert float(out.diagnostics["rsi"][0]) >= 65.0
+        assert bool(out.trigger[0])
+        assert int(out.direction[0]) == 1  # SHORT
+        assert bool(out.autotrade[0])
+
+    def test_trending_adx_vetoes(self):
+        # a steady ramp makes rolling-sum ADX spike above 32
+        df = flat_df()
+        n = len(df)
+        for j in range(30):
+            i = n - 30 + j
+            c = 100.0 * (1 + 0.004 * (j + 1))
+            df.loc[df.index[i], "open"] = c * 0.998
+            df.loc[df.index[i], "close"] = c
+            df.loc[df.index[i], "high"] = c * 1.001
+            df.loc[df.index[i], "low"] = c * 0.996
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        out = range_bb_rsi_mean_reversion(buf, pack, mk_context(n=S_CAP))
+        assert float(out.diagnostics["adx"][0]) > 32.0
+        assert not bool(out.trigger[0])
+
+    def test_non_range_market_blocks(self):
+        ctx = mk_context(
+            n=S_CAP, market_regime=np.int32(MarketRegimeCode.TREND_UP)
+        )
+        assert not bool(self._eval(craft_upper_rejection(), ctx).trigger[0])
+
+    def test_breakdown_transition_blocks(self):
+        ctx = mk_context(
+            n=S_CAP,
+            features=mk_features(
+                n=S_CAP,
+                micro_transition=np.full(
+                    S_CAP, int(MicroTransitionCode.BREAKDOWN), np.int32
+                ),
+            ),
+        )
+        assert not bool(self._eval(craft_upper_rejection(), ctx).trigger[0])
+
+    def test_no_rejection_candle_blocks(self):
+        # same pop but the last candle closes green at its highs
+        df = craft_upper_rejection()
+        top = float(df["close"].iloc[-2])
+        df.loc[df.index[-1], "open"] = top
+        df.loc[df.index[-1], "close"] = top * 1.008
+        df.loc[df.index[-1], "high"] = top * 1.009
+        df.loc[df.index[-1], "low"] = top * 0.999
+        assert not bool(self._eval(df).trigger[0])
+
+
+# ---------------------------------------------------------------------------
+# RelativeStrengthReversalRange — gate flips
+# ---------------------------------------------------------------------------
+
+
+class TestRelativeStrengthGates:
+    def _ctx(self, avg_return=-0.03, rs=0.08):
+        return mk_context(
+            n=S_CAP,
+            average_return=avg_return,
+            features=mk_features(
+                n=S_CAP,
+                relative_strength_vs_btc=np.full(S_CAP, rs, np.float32),
+            ),
+        )
+
+    def _eval(self, ctx):
+        df = flat_df(vol_noise=0.001)
+        # the floor is the 20th pct of 24h volume; a constant series makes
+        # floor == volume and the strict > gate false — trade above it
+        df.loc[df.index[-1], "volume"] = 1200.0
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        return relative_strength_reversal_range(buf, pack, ctx)
+
+    def test_leader_in_selloff_fires_telemetry_only(self):
+        out = self._eval(self._ctx())
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])  # telemetry while P&L collects
+
+    def test_rs_below_floor_blocks(self):
+        assert not bool(self._eval(self._ctx(rs=0.04)).trigger[0])
+
+    def test_mild_selloff_blocks(self):
+        assert not bool(self._eval(self._ctx(avg_return=-0.01)).trigger[0])
+
+    def test_volume_below_floor_blocks(self):
+        df = flat_df(vol_noise=0.001)
+        # the last bar's volume at the absolute bottom of the 24h window
+        df.loc[df.index[-1], "volume"] = 1.0
+        buf = fill_buffer({0: df})
+        pack = compute_feature_pack(buf)
+        out = relative_strength_reversal_range(buf, pack, self._ctx())
+        assert not bool(out.trigger[0])
+
+
+# ---------------------------------------------------------------------------
+# TWAP momentum sniper — selloff veto
+# ---------------------------------------------------------------------------
+
+
+class TestTwapSniper:
+    def test_sharp_selloff_vetoes(self):
+        # price_decrease = close[-1] - close[-2]/close[-1] (the reference's
+        # formula, verbatim): with 1h closes ~1.0 and a prior-bar pop to
+        # 1.06, the expression goes below -0.05 and vetoes.
+        df15 = flat_df(price=1.0)
+        n = len(df15)
+        # previous 1h block (bars -8..-5) closes at 1.06; last block at 1.0
+        for j in range(n - 8, n - 4):
+            df15.loc[df15.index[j], "close"] = 1.06
+        buf15 = fill_buffer({0: df15})
+        df5 = flat_df(price=2.0)  # price 2.0 > twap 1.0: twap gate false too
+        df5.loc[df5.index[-1], "close"] = 0.5  # price below TWAP -> gate true
+        buf5 = fill_buffer({0: df5})
+        pack5 = compute_feature_pack(buf5)
+        out = twap_momentum_sniper(buf15, pack5)
+        assert float(out.diagnostics["price_decrease"][0]) <= -0.05
+        assert not bool(out.trigger[0])
+
+    def test_twap_above_price_fires_manual_only(self):
+        df15 = flat_df(price=1.0)
+        buf15 = fill_buffer({0: df15})
+        df5 = flat_df(price=1.0)
+        df5.loc[df5.index[-1], "close"] = 0.5
+        buf5 = fill_buffer({0: df5})
+        pack5 = compute_feature_pack(buf5)
+        out = twap_momentum_sniper(buf15, pack5)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])  # manual_only
+
+
+# ---------------------------------------------------------------------------
+# buy_low_sell_high — host-flag gates
+# ---------------------------------------------------------------------------
+
+
+class TestBuyLowSellHigh:
+    def _bufpack(self):
+        # RSI<35 needs 14 straight losses; close>MA25 needs the mean
+        # dragged down — a crash (80) then recovery (102) then a gentle
+        # all-red slide gives RSI=0 with close ~100.6 above MA25 ~96
+        df = flat_df(price=100.0)
+        n = len(df)
+
+        def set_bar(i, c):
+            df.loc[df.index[i], "open"] = c * 1.001
+            df.loc[df.index[i], "close"] = c
+            df.loc[df.index[i], "high"] = c * 1.002
+            df.loc[df.index[i], "low"] = c * 0.999
+
+        for i in range(n - 40, n - 25):
+            set_bar(i, 80.0)
+        for j, i in enumerate(range(n - 25, n - 14)):
+            set_bar(i, 80.0 + 22.0 * (j + 1) / 11.0)  # ramp to 102
+        for j, i in enumerate(range(n - 14, n)):
+            set_bar(i, 102.0 - 0.1 * (j + 1))  # 14 straight losses
+        buf = fill_buffer({0: df})
+        return buf, compute_feature_pack(buf)
+
+    def test_requires_domination_reversal_flag(self):
+        buf, pack = self._bufpack()
+        rsi = float(pack.rsi[0])
+        ma25_gate = float(pack.close[0])
+        out_on = buy_low_sell_high(buf, pack, jnp.asarray(True))
+        out_off = buy_low_sell_high(buf, pack, jnp.asarray(False))
+        expected = rsi < 35.0 and ma25_gate > float(
+            out_on.diagnostics["ma_25"][0]
+        )
+        assert expected  # the crafted slide must reach the entry zone
+        assert bool(out_on.trigger[0])
+        assert not bool(out_on.autotrade[0])  # manual_only
+        assert not bool(out_off.trigger[0])
+
+
+# ---------------------------------------------------------------------------
+# InversePriceTracker — routing matrix
+# ---------------------------------------------------------------------------
+
+
+class TestInverseTrackerRouting:
+    def _oversold_pack(self):
+        # strictly falling tail: RSI=0, MFI=0, MACD<0 (same device kernels
+        # the live PriceTracker tests pin)
+        df = flat_df(price=100.0)
+        n = len(df)
+        for j in range(25):
+            i = n - 25 + j
+            c = 100.0 * (1 - 0.004 * (j + 1))
+            df.loc[df.index[i], "open"] = c * 1.002
+            df.loc[df.index[i], "close"] = c
+            df.loc[df.index[i], "high"] = c * 1.003
+            df.loc[df.index[i], "low"] = c * 0.998
+        buf = fill_buffer({0: df})
+        return compute_feature_pack(buf)
+
+    def test_trend_up_market_routes(self):
+        pack = self._oversold_pack()
+        ctx = mk_context(
+            n=S_CAP,
+            market_regime=np.int32(MarketRegimeCode.TREND_UP),
+            features=mk_features(
+                n=S_CAP,
+                micro_regime=np.full(
+                    S_CAP, int(MicroRegimeCode.TREND_UP), np.int32
+                ),
+            ),
+        )
+        out = inverse_price_tracker(pack, ctx)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])  # telemetry-only by design
+
+    def test_range_market_needs_rs_leadership(self):
+        pack = self._oversold_pack()
+        base = dict(
+            micro_regime=np.full(S_CAP, int(MicroRegimeCode.TREND_UP), np.int32),
+            trend_score=np.full(S_CAP, 0.01, np.float32),
+        )
+        leader = mk_context(
+            n=S_CAP,
+            features=mk_features(
+                n=S_CAP,
+                relative_strength_vs_btc=np.full(S_CAP, 0.06, np.float32),
+                **base,
+            ),
+        )
+        laggard = mk_context(
+            n=S_CAP,
+            features=mk_features(
+                n=S_CAP,
+                relative_strength_vs_btc=np.full(S_CAP, 0.01, np.float32),
+                **base,
+            ),
+        )
+        assert bool(inverse_price_tracker(pack, leader).trigger[0])
+        assert not bool(inverse_price_tracker(pack, laggard).trigger[0])
+
+    def test_stress_blocks(self):
+        pack = self._oversold_pack()
+        ctx = mk_context(
+            n=S_CAP,
+            market_regime=np.int32(MarketRegimeCode.TREND_UP),
+            market_stress_score=0.5,
+            features=mk_features(
+                n=S_CAP,
+                micro_regime=np.full(
+                    S_CAP, int(MicroRegimeCode.TREND_UP), np.int32
+                ),
+            ),
+        )
+        assert not bool(inverse_price_tracker(pack, ctx).trigger[0])

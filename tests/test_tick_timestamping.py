@@ -9,7 +9,6 @@ catch-up tick at the right bucket must recover the signal.
 """
 
 import asyncio
-import json
 
 import pytest
 
@@ -42,15 +41,6 @@ def _drive(engine, by_tick, buckets, now_ms_of):
 
     asyncio.run(go())
     return fired_all
-
-
-def _fresh_counts(engine):
-    import numpy as np
-
-    return (
-        int(np.asarray(engine.state.buf5.filled).sum()),
-        int(np.asarray(engine.state.buf15.filled).sum()),
-    )
 
 
 def test_on_time_tick_sees_fresh_bars(market):

@@ -17,8 +17,10 @@ TPU-first choices:
 * **Moments via cumsum** on row-centered data (stable in float32 even for
   BTC-scale prices), one pass for sum/mean/std.
 * **Extrema via lax.reduce_window**, XLA's native sliding-window lowering.
-* **Quantiles via windowed sort** (see rolling_quantile) with a pallas
-  alternative in ops/pallas_rolling.py for the hot path.
+* **Quantiles via windowed sort** (see rolling_quantile); the hot trailing
+  positions have a pallas TPU count-selection kernel in
+  ``ops/pallas_rolling.py`` (``rolling_quantile_tail_auto`` dispatches by
+  backend; parity pinned in tests/test_pallas_rolling.py).
 """
 
 from __future__ import annotations

@@ -17,7 +17,8 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer
-from binquant_tpu.ops.rolling import rolling_median, rolling_quantile_tail, shift
+from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_auto
+from binquant_tpu.ops.rolling import rolling_median, shift
 from binquant_tpu.regime.context import MarketContext
 from binquant_tpu.regime.routing import allows_long_autotrade_mask
 from binquant_tpu.strategies.base import StrategyOutputs
@@ -109,7 +110,8 @@ def activity_burst_pump(
     # positions, so the 92nd-pct threshold (the expensive windowed sort) is
     # computed for just those trailing windows instead of all of TAIL.
     n_out = p.cooldown_bars + 1
-    threshold_tail = rolling_quantile_tail(
+    # pallas count-selection kernel on TPU, XLA windowed sort elsewhere
+    threshold_tail = rolling_quantile_tail_auto(
         shift(score, 1), p.score_lookback, p.score_quantile,
         num_out=n_out, min_periods=p.lookback_window,
     )  # (S, n_out) aligned with the last n_out positions

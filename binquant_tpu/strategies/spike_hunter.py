@@ -27,9 +27,9 @@ import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer
 from binquant_tpu.enums import Direction
+from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_auto
 from binquant_tpu.ops.rolling import (
     rolling_mean,
-    rolling_quantile_tail,
     shift,
 )
 from binquant_tpu.regime.context import MarketContext
@@ -157,7 +157,9 @@ def detect_spikes(buf15: MarketBuffer, params: SpikeParams = SpikeParams()) -> S
     )
 
     # --- dynamic price break (l.320-358): trailing 60-bar quantile only
-    dyn = rolling_quantile_tail(
+    # (same backend dispatch as ABP's threshold — the two hot tail
+    # quantiles must route identically for BQT_ENABLE_PALLAS A/Bs)
+    dyn = rolling_quantile_tail_auto(
         price_change_abs, 60, p.price_break_dynamic_q, num_out=1, min_periods=20
     )[:, -1]
     thr = jnp.maximum(price_floor, dyn)  # NaN dyn -> NaN (pre-warmup)

@@ -1,0 +1,96 @@
+"""Pallas rolling-quantile kernel: exact parity with the XLA path.
+
+The TPU kernel (``ops/pallas_rolling.py``) replaces the windowed
+gather+sort with a count-based selection; it must be BIT-IDENTICAL to
+``rolling_quantile_tail`` (which itself is pandas-parity pinned in
+tests/test_ops_parity.py) across NaN patterns, short inputs, ties, and
+min_periods warm-up. Skipped off-TPU (the kernel is TPU-only by design;
+``rolling_quantile_tail_auto`` falls back to XLA there).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from binquant_tpu.ops.rolling import rolling_quantile_tail
+
+tpu_only = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="pallas kernel is TPU-only"
+)
+
+
+def _cases():
+    rng = np.random.default_rng(5)
+    x = rng.random((37, 128)).astype(np.float32)
+    x[3, :50] = np.nan  # leading NaN (the ring buffer's only NaN pattern)
+    x[7, :] = np.nan  # all-NaN row
+    x[11, -3:] = np.nan  # NaN inside the evaluated windows
+    x[13, 10:20] = x[13, 0]  # ties
+    return x
+
+
+@tpu_only
+@pytest.mark.parametrize("q", [0.5, 0.8, 0.92])
+@pytest.mark.parametrize("num_out", [1, 4])
+def test_kernel_matches_xla(q, num_out):
+    from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_pallas
+
+    x = jnp.asarray(_cases())
+    ref = np.asarray(
+        rolling_quantile_tail(x, 80, q, num_out=num_out, min_periods=20)
+    )
+    out = np.asarray(
+        rolling_quantile_tail_pallas(x, 80, q, num_out=num_out, min_periods=20)
+    )
+    assert np.array_equal(np.isnan(ref), np.isnan(out))
+    np.testing.assert_array_equal(
+        np.nan_to_num(ref, nan=-9e9), np.nan_to_num(out, nan=-9e9)
+    )
+
+
+@tpu_only
+def test_kernel_short_input_pads_like_xla():
+    from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_pallas
+
+    x = jnp.asarray(_cases()[:, :60])  # W < window + num_out - 1
+    ref = np.asarray(rolling_quantile_tail(x, 80, 0.92, num_out=4, min_periods=20))
+    out = np.asarray(
+        rolling_quantile_tail_pallas(x, 80, 0.92, num_out=4, min_periods=20)
+    )
+    assert np.array_equal(np.isnan(ref), np.isnan(out))
+    np.testing.assert_array_equal(
+        np.nan_to_num(ref, nan=-9e9), np.nan_to_num(out, nan=-9e9)
+    )
+
+
+def test_auto_dispatch_always_correct():
+    """Whatever the backend, the auto path equals the XLA reference."""
+    from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_auto
+
+    x = jnp.asarray(_cases())
+    ref = np.asarray(rolling_quantile_tail(x, 80, 0.92, num_out=4, min_periods=20))
+    out = np.asarray(
+        rolling_quantile_tail_auto(x, 80, 0.92, num_out=4, min_periods=20)
+    )
+    assert np.array_equal(np.isnan(ref), np.isnan(out))
+    np.testing.assert_allclose(
+        np.nan_to_num(ref, nan=-9e9), np.nan_to_num(out, nan=-9e9), rtol=1e-6
+    )
+
+
+def test_pallas_is_opt_in(monkeypatch):
+    # default off (the fused XLA sort measured faster IN the tick step);
+    # BQT_ENABLE_PALLAS turns it on, BQT_DISABLE_PALLAS always wins
+    from binquant_tpu.ops import pallas_rolling
+
+    monkeypatch.delenv("BQT_ENABLE_PALLAS", raising=False)
+    monkeypatch.delenv("BQT_DISABLE_PALLAS", raising=False)
+    assert not pallas_rolling.pallas_available()
+    monkeypatch.setenv("BQT_ENABLE_PALLAS", "1")
+    assert pallas_rolling.pallas_available() == (
+        jax.default_backend() == "tpu"
+    )
+    monkeypatch.setenv("BQT_DISABLE_PALLAS", "1")
+    assert not pallas_rolling.pallas_available()

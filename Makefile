@@ -1,4 +1,4 @@
-.PHONY: test bench smoke replay dryrun lint
+.PHONY: test bench smoke replay ab config4 dryrun lint
 
 test:
 	python -m pytest tests/ -q
@@ -10,8 +10,15 @@ smoke:
 	python bench.py --smoke
 
 replay:
-	python - -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"
+	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"
 	python main.py --replay /tmp/replay.jsonl
+
+ab:
+	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"
+	python main.py --replay /tmp/replay.jsonl --backend ab
+
+config4:
+	python bench.py --config4
 
 dryrun:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

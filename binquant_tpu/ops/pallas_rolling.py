@@ -19,9 +19,10 @@ percentile — reference ``strategies/activity_burst_pump.py:123-139``)
 where ``num_out`` is a handful of trailing positions. Full-width rolling
 medians keep the XLA sort (they are bandwidth-, not sort-, bound).
 
-Dispatch: :func:`rolling_quantile_tail_auto` uses this kernel on the TPU
-backend (opt out with ``BQT_DISABLE_PALLAS=1``) and the XLA path
-elsewhere; ``tests/test_pallas_rolling.py`` pins kernel == XLA == pandas.
+Dispatch: :func:`rolling_quantile_tail_auto` is OPT-IN
+(``BQT_ENABLE_PALLAS=1`` on the TPU backend; the fused XLA sort is the
+measured default — see :func:`pallas_available`);
+``tests/test_pallas_rolling.py`` pins kernel == XLA == pandas.
 """
 
 from __future__ import annotations

@@ -110,7 +110,8 @@ def activity_burst_pump(
     # positions, so the 92nd-pct threshold (the expensive windowed sort) is
     # computed for just those trailing windows instead of all of TAIL.
     n_out = p.cooldown_bars + 1
-    # pallas count-selection kernel on TPU, XLA windowed sort elsewhere
+    # fused XLA windowed sort by default; BQT_ENABLE_PALLAS=1 routes to
+    # the pallas count-selection kernel (ops/pallas_rolling.py)
     threshold_tail = rolling_quantile_tail_auto(
         shift(score, 1), p.score_lookback, p.score_quantile,
         num_out=n_out, min_periods=p.lookback_window,

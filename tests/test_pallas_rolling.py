@@ -65,10 +65,13 @@ def test_kernel_short_input_pads_like_xla():
     )
 
 
-def test_auto_dispatch_always_correct():
-    """Whatever the backend, the auto path equals the XLA reference."""
+def test_auto_dispatch_always_correct(monkeypatch):
+    """Whatever the backend, the auto path equals the XLA reference —
+    with the flag ON, so the pallas branch is actually taken on TPU."""
     from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_auto
 
+    monkeypatch.setenv("BQT_ENABLE_PALLAS", "1")
+    monkeypatch.delenv("BQT_DISABLE_PALLAS", raising=False)
     x = jnp.asarray(_cases())
     ref = np.asarray(rolling_quantile_tail(x, 80, 0.92, num_out=4, min_periods=20))
     out = np.asarray(

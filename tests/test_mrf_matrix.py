@@ -1,0 +1,154 @@
+"""MeanReversionFade gate matrix (reference test_mean_reversion_fade.py).
+
+Short entry, ATR-derived stop-loss, candle-color and band rejects, and the
+ATR-spike veto — each scenario's entry conditions are confirmed with the
+pandas oracle so the crafted data provably reaches the gate under test.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from binquant_tpu.enums import Direction
+from binquant_tpu.strategies import compute_feature_pack
+from binquant_tpu.strategies.mean_reversion_fade import mean_reversion_fade
+from tests.conftest import make_ohlcv
+from tests.test_strategies_live import S_CAP, WINDOW, craft_mrf_long, fill_buffer
+
+
+def craft_mrf_short(rng, n=WINDOW):
+    """Monotonic rise then a red shooting star at the upper band."""
+    d = make_ohlcv(rng, n=n, start_price=100, vol=0.004, drift=0.004)
+    df = pd.DataFrame(d)
+    i = len(df) - 1
+    prev_close = df["close"].iloc[i - 1]
+    o = prev_close * 1.03
+    c = o * 0.996  # red
+    df.loc[df.index[i], "open"] = o
+    df.loc[df.index[i], "close"] = c
+    df.loc[df.index[i], "high"] = o * 1.002
+    df.loc[df.index[i], "low"] = c * 0.999
+    df.loc[df.index[i], "volume"] = df["volume"].iloc[-21:-1].mean() * 2
+    return df
+
+
+def oracle(df):
+    """(rsi_wilder, bb_low, bb_high, atr, atr_ma) at the last bar."""
+    closes = df["close"].astype(float)
+    delta = closes.diff()
+    ag = delta.clip(lower=0).ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+    al = (-delta.clip(upper=0)).ewm(alpha=1 / 14, min_periods=14, adjust=False).mean()
+    rsi = float((100 * ag / (ag + al)).where((ag + al) != 0, 50.0).iloc[-1])
+    mid = closes.rolling(20).mean()
+    std = closes.rolling(20).std(ddof=0)
+    tail = df.tail(35)
+    pc = tail["close"].shift(1)
+    tr = pd.concat(
+        [
+            tail["high"] - tail["low"],
+            (tail["high"] - pc).abs(),
+            (tail["low"] - pc).abs(),
+        ],
+        axis=1,
+    ).max(axis=1).iloc[1:]
+    atr_series = tr.rolling(14).mean()
+    return (
+        rsi,
+        float((mid - 2 * std).iloc[-1]),
+        float((mid + 2 * std).iloc[-1]),
+        float(atr_series.iloc[-1]),
+        float(atr_series.rolling(20).mean().iloc[-1]),
+    )
+
+
+def run_mrf(df, futures=True, carry=None):
+    buf = fill_buffer({0: df})
+    pack = compute_feature_pack(buf)
+    if carry is None:
+        carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+    return mean_reversion_fade(pack, jnp.asarray(futures), carry)
+
+
+class TestShortEntry:
+    def test_short_fires_with_atr_stop(self):
+        rng = np.random.default_rng(61)
+        df = craft_mrf_short(rng)
+        rsi, _, bb_high, atr, _ = oracle(df)
+        c, o = float(df["close"].iloc[-1]), float(df["open"].iloc[-1])
+        # the crafted data must provably reach the short gate
+        assert rsi >= 75.0 and c >= bb_high and c < o
+        out, carry2 = run_mrf(df)
+        assert bool(out.trigger[0])
+        assert int(out.direction[0]) == int(Direction.SHORT)
+        assert bool(out.autotrade[0])
+        # score = 1 + overbought depth
+        np.testing.assert_allclose(
+            float(out.score[0]),
+            round(1.0 + max(0.0, (rsi - 75.0) / 25.0), 4),
+            rtol=1e-3,
+        )
+        # ATR-sized stop: 2*atr/close*100, clamped [0, 101], rounded
+        np.testing.assert_allclose(
+            float(out.stop_loss_pct[0]),
+            round(min(2.0 * atr / c * 100.0, 101.0), 4),
+            rtol=1e-3,
+        )
+        # same candle again -> deduped
+        out2, _ = run_mrf(df, carry=carry2)
+        assert not bool(out2.trigger[0])
+
+    def test_green_candle_rejects_short(self):
+        rng = np.random.default_rng(61)
+        df = craft_mrf_short(rng)
+        i = df.index[-1]
+        df.loc[i, "close"] = float(df["open"].iloc[-1]) * 1.001  # green
+        df.loc[i, "high"] = float(df["close"].iloc[-1]) * 1.001
+        assert not bool(run_mrf(df)[0].trigger[0])
+
+
+class TestLongRejects:
+    def test_red_candle_rejects_long(self):
+        rng = np.random.default_rng(53)
+        df = craft_mrf_long(rng)
+        i = df.index[-1]
+        df.loc[i, "close"] = float(df["open"].iloc[-1]) * 0.999  # red
+        df.loc[i, "low"] = float(df["close"].iloc[-1]) * 0.999
+        assert not bool(run_mrf(df)[0].trigger[0])
+
+    def test_price_above_lower_band_rejects_long(self):
+        rng = np.random.default_rng(53)
+        df = craft_mrf_long(rng)
+        # lift the hammer back inside the bands (same shape, higher close)
+        i = df.index[-1]
+        prev_close = float(df["close"].iloc[-2])
+        df.loc[i, "open"] = prev_close * 0.999
+        df.loc[i, "close"] = prev_close * 1.002
+        df.loc[i, "high"] = prev_close * 1.003
+        df.loc[i, "low"] = prev_close * 0.998
+        _, bb_low, _, _, _ = oracle(df)
+        assert float(df["close"].iloc[-1]) > bb_low
+        assert not bool(run_mrf(df)[0].trigger[0])
+
+    def test_atr_spike_vetoes(self):
+        rng = np.random.default_rng(53)
+        df = craft_mrf_long(rng)
+        # blow out the trailing 4 bars' ranges: ATR(14) spikes while its
+        # 20-bar MA lags -> atr >= 2*atr_ma vetoes the (still valid) setup
+        for k in range(2, 6):
+            i = df.index[-k]
+            c = float(df["close"].iloc[-k])
+            df.loc[i, "high"] = c * 1.30
+            df.loc[i, "low"] = c * 0.70
+        rsi, bb_low, _, atr, atr_ma = oracle(df)
+        c = float(df["close"].iloc[-1])
+        assert rsi <= 25.0 and c <= bb_low  # setup still present
+        assert atr >= 2.0 * atr_ma  # and the veto provably engaged
+        assert not bool(run_mrf(df)[0].trigger[0])
+
+    def test_spot_market_never_emits(self):
+        rng = np.random.default_rng(53)
+        df = craft_mrf_long(rng)
+        rsi, bb_low, _, _, _ = oracle(df)
+        assert rsi <= 25.0 and float(df["close"].iloc[-1]) <= bb_low
+        assert bool(run_mrf(df, futures=True)[0].trigger[0])
+        assert not bool(run_mrf(df, futures=False)[0].trigger[0])

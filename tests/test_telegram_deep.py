@@ -1,0 +1,159 @@
+"""Telegram sink: flood control, pending-set lifecycle, sanitizer edges.
+
+Mirrors the reference's ``tests/test_telegram_consumer.py`` matrix:
+retry-after backoff (l.46), dedupe-key parsing from message fields (l.59),
+anchor/entity preservation (l.104-110), plus the pending-set release and
+the send-lock min-interval the reference serializes under.
+"""
+
+import asyncio
+
+import pytest
+
+from binquant_tpu.io.telegram import RetryAfterError, TelegramConsumer
+
+
+def make_consumer(transport, **kw):
+    c = TelegramConsumer(token="", chat_id="chat", transport=transport, **kw)
+    c._min_send_interval_seconds = 0.0  # keep tests fast unless testing it
+    c._retry_after_pad_seconds = 0.0
+    return c
+
+
+class TestFloodControl:
+    def test_retry_after_backoff_then_success(self):
+        calls = []
+
+        async def transport(chat_id, text):
+            calls.append(text)
+            if len(calls) == 1:
+                raise RetryAfterError(0.01)
+
+        c = make_consumer(transport)
+        asyncio.run(c.send_msg("hello"))
+        assert len(calls) == 2  # flood-controlled once, then delivered
+
+    def test_min_interval_spacing(self):
+        import time
+
+        stamps = []
+
+        async def transport(chat_id, text):
+            stamps.append(time.monotonic())
+
+        c = make_consumer(transport)
+        c._min_send_interval_seconds = 0.05
+
+        async def go():
+            await c.send_msg("a")
+            await c.send_msg("b")
+
+        asyncio.run(go())
+        assert stamps[1] - stamps[0] >= 0.05
+
+    def test_transport_errors_never_propagate(self):
+        async def transport(chat_id, text):
+            raise RuntimeError("boom")
+
+        c = make_consumer(transport)
+        asyncio.run(c.send_signal("message"))  # must not raise
+
+
+class TestPendingSetLifecycle:
+    def test_pending_released_after_send_completes(self):
+        sent = []
+
+        async def transport(chat_id, text):
+            sent.append(text)
+
+        c = make_consumer(transport)
+        c._signal_dedupe_seconds = 0.0  # pending-set-only dedupe
+
+        async def go():
+            msg = "<strong>#algo1 algorithm</strong> #BTCUSDT\n- Action: buy"
+            t1 = c.dispatch_signal(msg)
+            t2 = c.dispatch_signal(msg)  # pending -> dropped
+            assert t2 is None
+            await t1
+            # pending released after completion: same key sends again
+            t3 = c.dispatch_signal(msg)
+            assert t3 is not None
+            await t3
+
+        asyncio.run(go())
+        assert len(sent) == 2
+
+    def test_cooldown_dedupe_blocks_even_after_completion(self):
+        sent = []
+
+        async def transport(chat_id, text):
+            sent.append(text)
+
+        c = make_consumer(transport)  # default 900 s cooldown
+
+        async def go():
+            msg = "<strong>#algo1 algorithm</strong> #BTCUSDT\n- Action: buy"
+            t1 = c.dispatch_signal(msg)
+            await t1
+            assert c.dispatch_signal(msg) is None  # inside cooldown
+
+        asyncio.run(go())
+        assert len(sent) == 1
+
+    def test_distinct_fields_are_distinct_keys(self):
+        sent = []
+
+        async def transport(chat_id, text):
+            sent.append(text)
+
+        c = make_consumer(transport)
+
+        async def go():
+            base = "<strong>#algo1 algorithm</strong> #BTCUSDT\n- Action: {a}"
+            t1 = c.dispatch_signal(base.format(a="buy"))
+            t2 = c.dispatch_signal(base.format(a="sell"))
+            await asyncio.gather(t1, t2)
+
+        asyncio.run(go())
+        assert len(sent) == 2
+
+    def test_background_task_set_gc(self):
+        async def transport(chat_id, text):
+            pass
+
+        c = make_consumer(transport)
+
+        async def go():
+            t = c.dispatch_signal("- Action: hold\n#X")
+            assert t in c._background_tasks
+            await t
+            await asyncio.sleep(0)  # let the done-callback run
+            assert t not in c._background_tasks
+
+        asyncio.run(go())
+
+
+class TestSanitizerEdges:
+    @pytest.fixture
+    def consumer(self):
+        async def transport(chat_id, text):
+            pass
+
+        return make_consumer(transport)
+
+    def test_anchor_links_preserved(self, consumer):
+        out = consumer._sanitize_html('<a href="https://x.y/z?a=1">link</a>')
+        assert out == '<a href="https://x.y/z?a=1">link</a>'
+
+    def test_existing_entities_preserved(self, consumer):
+        out = consumer._sanitize_html("5 &lt; 6 &amp; 7 &gt; 2")
+        assert out == "5 &lt; 6 &amp; 7 &gt; 2"
+
+    def test_unknown_tags_escaped(self, consumer):
+        out = consumer._sanitize_html("<script>alert(1)</script><b>ok</b>")
+        assert "<script>" not in out
+        assert "<b>ok</b>" in out
+
+    def test_raw_angle_operators_escaped(self, consumer):
+        out = consumer._sanitize_html("price < 5 and x > 3")
+        assert out == "price &lt; 5 and x &gt; 3"

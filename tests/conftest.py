@@ -60,6 +60,42 @@ def make_ohlcv(
     }
 
 
+def df_from_closes(
+    closes,
+    interval_ms: int = 900_000,
+    t0: int = 1_700_000_000_000,
+    volume: float = 1000.0,
+    start_price: float | None = None,
+):
+    """Deterministic schema-true kline DataFrame from a close series —
+    the shared builder for crafted gate-test scenarios (opens chain from
+    the previous close; highs/lows hug the body)."""
+    import numpy as np
+    import pandas as pd
+
+    closes = np.asarray(closes, dtype=float)
+    n = len(closes)
+    first = start_price if start_price is not None else closes[0]
+    open_ = np.concatenate([[first], closes[:-1]])
+    vol = np.full(n, float(volume))
+    open_time = t0 + interval_ms * np.arange(n, dtype=np.int64)
+    return pd.DataFrame(
+        {
+            "open_time": open_time,
+            "close_time": open_time + interval_ms - 1,
+            "open": open_,
+            "high": np.maximum(open_, closes) * 1.0005,
+            "low": np.minimum(open_, closes) * 0.9995,
+            "close": closes,
+            "volume": vol,
+            "quote_asset_volume": closes * vol,
+            "number_of_trades": np.full(n, 400.0),
+            "taker_buy_base_volume": vol / 2,
+            "taker_buy_quote_volume": closes * vol / 2,
+        }
+    )
+
+
 @pytest.fixture
 def ohlcv(rng):
     return make_ohlcv(rng)

@@ -103,6 +103,9 @@ class TestShortEntry:
         i = df.index[-1]
         df.loc[i, "close"] = float(df["open"].iloc[-1]) * 1.001  # green
         df.loc[i, "high"] = float(df["close"].iloc[-1]) * 1.001
+        # candle color must be the ONLY failing gate
+        rsi, _, bb_high, _, _ = oracle(df)
+        assert rsi >= 75.0 and float(df["close"].iloc[-1]) >= bb_high
         assert not bool(run_mrf(df)[0].trigger[0])
 
 
@@ -113,6 +116,9 @@ class TestLongRejects:
         i = df.index[-1]
         df.loc[i, "close"] = float(df["open"].iloc[-1]) * 0.999  # red
         df.loc[i, "low"] = float(df["close"].iloc[-1]) * 0.999
+        # candle color must be the ONLY failing gate
+        rsi, bb_low, _, _, _ = oracle(df)
+        assert rsi <= 25.0 and float(df["close"].iloc[-1]) <= bb_low
         assert not bool(run_mrf(df)[0].trigger[0])
 
     def test_price_above_lower_band_rejects_long(self):

@@ -1,0 +1,251 @@
+"""PriceTracker + BBExtremeReversion gate matrices.
+
+Completes the branch coverage of the reference's largest per-strategy
+suite (``tests/test_coinrule_price_tracker.py``, 1290 LoC): PriceTracker
+cooldown expiry and each autotrade-routing reason, and the
+BBExtremeReversion direction-conditioned matrix (enabled via params — the
+reference ships it ``ENABLED=False``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from binquant_tpu.enums import (
+    Direction,
+    MarketRegimeCode,
+    MicroRegimeCode,
+    MicroTransitionCode,
+)
+from binquant_tpu.strategies import bb_extreme_reversion, compute_feature_pack
+from binquant_tpu.strategies.dormant import BBXParams
+from binquant_tpu.strategies.price_tracker import (
+    ROUTE_BREADTH_UNSTABLE,
+    ROUTE_STRESS,
+    ROUTE_SYMBOL_REGIME,
+    ROUTE_TRANSITIONING,
+    price_tracker,
+)
+from tests.conftest import df_from_closes, make_ohlcv
+from tests.test_regime_routing_scoring import mk_context, mk_features
+from tests.test_strategies_live import (
+    S_CAP,
+    WINDOW,
+    craft_oversold,
+    fill_buffer,
+)
+
+ENABLED = BBXParams(enabled=True)
+
+
+def _pt_range_context(**over):
+    micro = np.full(S_CAP, int(MicroRegimeCode.RANGE), np.int32)
+    feats = over.pop(
+        "features",
+        mk_features(
+            n=S_CAP,
+            micro_regime=micro,
+            relative_strength_vs_btc=np.full(S_CAP, 0.01, np.float32),
+        ),
+    )
+    base = dict(
+        features=feats,
+        advancers_ratio=0.55,
+        long_tailwind=0.1,
+        short_tailwind=-0.05,
+        market_stress_score=0.1,
+    )
+    base.update(over)
+    return mk_context(n=S_CAP, **base)
+
+
+def _oversold_pack():
+    rng = np.random.default_rng(79)
+    return compute_feature_pack(fill_buffer({0: craft_oversold(rng)}))
+
+
+class TestPriceTrackerRouting:
+    def _fire(self, ctx, carry=None, quiet=False):
+        pack = _oversold_pack()
+        if carry is None:
+            carry = jnp.full((S_CAP,), -1, dtype=jnp.int32)
+        return price_tracker(pack, ctx, jnp.asarray(quiet), carry)
+
+    def test_uptrend_data_never_fires(self):
+        rng = np.random.default_rng(7)
+        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.003, drift=0.005))
+        pack = compute_feature_pack(fill_buffer({0: df}))
+        out, _ = price_tracker(
+            pack,
+            _pt_range_context(),
+            jnp.asarray(False),
+            jnp.full((S_CAP,), -1, dtype=jnp.int32),
+        )
+        assert not bool(out.trigger[0])
+
+    def test_cooldown_boundary_exact_expiry(self):
+        pack = _oversold_pack()
+        close_time = int(pack.close_time[0])
+        ctx = _pt_range_context()
+        # one second inside the 12-bar window: still cooling down
+        inside = jnp.full((S_CAP,), close_time - 12 * 300 + 1, dtype=jnp.int32)
+        out, _ = price_tracker(pack, ctx, jnp.asarray(False), inside)
+        assert not bool(out.trigger[0])
+        # exactly 12 bars elapsed: cooldown over, fires again
+        expired = jnp.full((S_CAP,), close_time - 12 * 300, dtype=jnp.int32)
+        out2, _ = price_tracker(pack, ctx, jnp.asarray(False), expired)
+        assert bool(out2.trigger[0])
+
+    def test_transitioning_market_blocks_autotrade(self):
+        out, _ = self._fire(_pt_range_context(regime_is_transitioning=True))
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == ROUTE_TRANSITIONING
+
+    def test_stress_blocks_autotrade(self):
+        out, _ = self._fire(_pt_range_context(market_stress_score=0.31))
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == ROUTE_STRESS
+
+    def test_unstable_breadth_blocks_autotrade(self):
+        out, _ = self._fire(_pt_range_context(advancers_ratio=0.70))
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == ROUTE_BREADTH_UNSTABLE
+
+    def test_transitional_micro_blocks_autotrade(self):
+        micro = np.full(S_CAP, int(MicroRegimeCode.TRANSITIONAL), np.int32)
+        ctx = _pt_range_context(
+            features=mk_features(
+                n=S_CAP,
+                micro_regime=micro,
+                relative_strength_vs_btc=np.full(S_CAP, 0.01, np.float32),
+            )
+        )
+        out, _ = self._fire(ctx)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+        assert int(out.diagnostics["route"][0]) == ROUTE_SYMBOL_REGIME
+
+
+# ---------------------------------------------------------------------------
+# BBExtremeReversion (enabled) — direction-conditioned matrix
+# ---------------------------------------------------------------------------
+
+
+def craft_bbx(direction="buy", extreme=True, pure=True, n=WINDOW):
+    """Low-noise base then a 2-bar move: RSI(2) pinned (two same-sign
+    deltas when ``pure``) and close at/beyond the band when ``extreme``."""
+    close = 100.0 * (1 + 0.001 * np.sin(np.arange(n) * 0.9))
+    sign = -1.0 if direction == "buy" else 1.0
+    step = 0.02 if extreme else 0.0005
+    base = close[n - 3]
+    close[n - 2] = base * (1 + sign * step)
+    if pure:
+        close[n - 1] = close[n - 2] * (1 + sign * step)
+    else:  # mixed deltas of comparable size: RSI(2) lands mid-range
+        close[n - 1] = close[n - 2] * (1 - sign * 0.01)
+    return df_from_closes(close, start_price=100.0)
+
+
+def run_bbx(df, ctx=None, params=ENABLED):
+    buf = fill_buffer({0: df})
+    pack = compute_feature_pack(buf)
+    return bb_extreme_reversion(buf, pack, ctx or mk_context(n=S_CAP), params)
+
+
+def strong_features(**over):
+    base = dict(micro_regime_strength=np.full(S_CAP, 0.7, np.float32))
+    base.update(over)
+    return mk_features(n=S_CAP, **base)
+
+
+class TestBBExtremeMatrix:
+    def _range_ctx(self, **over):
+        base = dict(features=strong_features())
+        base.update(over)
+        return mk_context(n=S_CAP, **base)
+
+    def test_buy_at_oversold_below_band(self):
+        out = run_bbx(craft_bbx("buy"), self._range_ctx())
+        assert bool(out.trigger[0])
+        assert int(out.direction[0]) == int(Direction.LONG)
+        assert bool(out.autotrade[0])
+        assert float(out.diagnostics["rsi2"][0]) <= 5.0
+        assert float(out.diagnostics["band_position"][0]) <= 0.0
+
+    def test_sell_at_overbought_above_band(self):
+        out = run_bbx(craft_bbx("sell"), self._range_ctx())
+        assert bool(out.trigger[0])
+        assert int(out.direction[0]) == int(Direction.SHORT)
+        assert bool(out.autotrade[0])
+        assert float(out.diagnostics["rsi2"][0]) >= 95.0
+
+    def test_disabled_by_default_params(self):
+        out = run_bbx(craft_bbx("buy"), self._range_ctx(), params=BBXParams())
+        assert not bool(out.trigger[0])
+
+    def test_mixed_deltas_rsi_not_extreme(self):
+        out = run_bbx(craft_bbx("buy", pure=False), self._range_ctx())
+        assert 5.0 < float(out.diagnostics["rsi2"][0]) < 95.0
+        assert not bool(out.trigger[0])
+
+    def test_price_inside_band_blocks(self):
+        out = run_bbx(craft_bbx("buy", extreme=False), self._range_ctx())
+        assert float(out.diagnostics["band_position"][0]) > 0.0
+        assert not bool(out.trigger[0])
+
+    def test_non_range_market_blocks_autotrade(self):
+        ctx = self._range_ctx(
+            market_regime=np.int32(MarketRegimeCode.TREND_UP)
+        )
+        out = run_bbx(craft_bbx("buy"), ctx)
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+
+    def test_stress_blocks_autotrade(self):
+        out = run_bbx(
+            craft_bbx("buy"), self._range_ctx(market_stress_score=0.5)
+        )
+        assert bool(out.trigger[0])
+        assert not bool(out.autotrade[0])
+
+    def test_trend_down_micro_blocks_buy_allows_short(self):
+        micro = np.full(S_CAP, int(MicroRegimeCode.TREND_DOWN), np.int32)
+        ctx = self._range_ctx(features=strong_features(micro_regime=micro))
+        buy = run_bbx(craft_bbx("buy"), ctx)
+        assert bool(buy.trigger[0]) and not bool(buy.autotrade[0])
+        short = run_bbx(craft_bbx("sell"), ctx)
+        assert bool(short.trigger[0]) and bool(short.autotrade[0])
+
+    def test_trend_up_micro_blocks_short(self):
+        micro = np.full(S_CAP, int(MicroRegimeCode.TREND_UP), np.int32)
+        ctx = self._range_ctx(features=strong_features(micro_regime=micro))
+        out = run_bbx(craft_bbx("sell"), ctx)
+        assert bool(out.trigger[0]) and not bool(out.autotrade[0])
+
+    def test_weak_micro_strength_blocks_autotrade(self):
+        ctx = self._range_ctx(
+            features=strong_features(
+                micro_regime_strength=np.full(S_CAP, 0.3, np.float32)
+            )
+        )
+        out = run_bbx(craft_bbx("buy"), ctx)
+        assert bool(out.trigger[0]) and not bool(out.autotrade[0])
+
+    def test_breakdown_transition_blocks_autotrade(self):
+        ctx = self._range_ctx(
+            features=strong_features(
+                micro_transition=np.full(
+                    S_CAP, int(MicroTransitionCode.BREAKDOWN), np.int32
+                )
+            )
+        )
+        out = run_bbx(craft_bbx("buy"), ctx)
+        assert bool(out.trigger[0]) and not bool(out.autotrade[0])
+
+    def test_flat_series_invalid_band_span_no_trigger(self):
+        flat = df_from_closes(np.full(WINDOW, 100.0))
+        out = run_bbx(flat, self._range_ctx())
+        assert not bool(out.trigger[0])  # band_span == 0 -> invalid

@@ -53,6 +53,28 @@ FIVE_MIN_S = 300
 OI_CACHE_TTL_S = 5.0  # klines_provider.py:67-68
 
 
+def breadth_scalars(
+    mb: MarketBreadthSeries | None,
+) -> tuple[float, float, float, float, float]:
+    """(adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum_points)
+    from a market-breadth series. Module-level so the replay oracle
+    mirrors the live pipeline's resolution exactly (one copy of the
+    semantics — the A/B harness validates against THIS function)."""
+    nan = float("nan")
+    if mb is None or len(mb.timestamp) < 2:
+        return nan, nan, nan, nan, nan
+    values = [float(v) for v in mb.market_breadth]
+    adp_latest = values[-1] if values else nan
+    adp_prev = values[-2] if len(values) >= 2 else nan
+    adp_diff = values[-1] - values[-2] if len(values) >= 2 else nan
+    adp_diff_prev = values[-2] - values[-3] if len(values) >= 3 else nan
+    ma = [float(v) for v in mb.market_breadth_ma]
+    momentum = (ma[-1] - ma[-2]) * 100 if len(ma) >= 2 else (
+        (values[-1] - values[-2]) * 100 if len(values) >= 2 else nan
+    )
+    return adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum
+
+
 class OpenInterestCache:
     """KuCoin OI growth per symbol with a 5 s TTL (klines_provider.py:252-276)."""
 
@@ -266,25 +288,7 @@ class SignalEngine:
     # -- breadth-derived inputs ----------------------------------------------
 
     def _breadth_scalars(self) -> tuple[float, float, float, float, float]:
-        """(adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum_points)."""
-        nan = float("nan")
-        mb = self.market_breadth
-        if mb is None or len(mb.timestamp) < 2:
-            return nan, nan, nan, nan, nan
-        values = [float(v) for v in mb.market_breadth]
-        adp_latest = values[-1] if values else nan
-        adp_prev = values[-2] if len(values) >= 2 else nan
-        adp_diff = (
-            values[-1] - values[-2] if len(values) >= 2 else nan
-        )
-        adp_diff_prev = (
-            values[-2] - values[-3] if len(values) >= 3 else nan
-        )
-        ma = [float(v) for v in mb.market_breadth_ma]
-        momentum = (ma[-1] - ma[-2]) * 100 if len(ma) >= 2 else (
-            (values[-1] - values[-2]) * 100 if len(values) >= 2 else nan
-        )
-        return adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum
+        return breadth_scalars(self.market_breadth)
 
     # -- the tick -------------------------------------------------------------
 

@@ -34,8 +34,11 @@ class StubSession:
         def json(self) -> Any:
             return self._payload
 
-    def __init__(self) -> None:
+    def __init__(self, breadth: dict | None = None) -> None:
         self.requests: list[tuple[str, str, Any]] = []
+        # scripted market-breadth payload (None = the empty default, which
+        # leaves breadth-gated strategies dormant)
+        self.breadth = breadth
 
     def request(self, method: str, url: str, **kwargs):
         self.requests.append((method, url, kwargs.get("json")))
@@ -52,14 +55,16 @@ class StubSession:
                 {"message": "ok", "error": 0, "data": {"pair": "X"}}
             )
         if "market-breadth" in url:
-            return self._Resp({"data": {}})
+            return self._Resp({"data": self.breadth or {}})
         return self._Resp({"data": {}})
 
     def get(self, url, params=None):
         return self.request("GET", url, params=params)
 
 
-def make_stub_engine(capacity: int = 256, window: int = 200):
+def make_stub_engine(
+    capacity: int = 256, window: int = 200, breadth: dict | None = None
+):
     """A SignalEngine wired entirely to stubs (no network)."""
     import os
 
@@ -79,7 +84,7 @@ def make_stub_engine(capacity: int = 256, window: int = 200):
     config = Config()
     config.__dict__["max_symbols"] = capacity
     config.__dict__["window_bars"] = window
-    binbot_api = BinbotApi("http://stub", session=StubSession())
+    binbot_api = BinbotApi("http://stub", session=StubSession(breadth=breadth))
 
     sent: list[str] = []
 
@@ -134,14 +139,17 @@ def run_replay(
     capacity: int = 256,
     window: int = 200,
     collect: list | None = None,
+    breadth: dict | None = None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
     When ``collect`` is a list, every fired signal is appended as a
     ``(tick_ms, strategy, symbol, direction, autotrade)`` tuple — the
-    comparison surface for the A/B parity harness.
+    comparison surface for the A/B parity harness. ``breadth`` scripts the
+    stub backend's market-breadth series so the breadth-gated paths
+    (LiquidationSweepPump routing, grid-only policy) engage.
     """
-    engine = make_stub_engine(capacity=capacity, window=window)
+    engine = make_stub_engine(capacity=capacity, window=window, breadth=breadth)
     klines_by_tick = load_klines_by_tick(path)
 
     fired_total = 0
@@ -183,11 +191,22 @@ def run_replay(
     }
 
 
-def run_replay_oracle(path: str | Path, window: int = 200) -> list[tuple]:
+def run_replay_oracle(
+    path: str | Path, window: int = 200, breadth: dict | None = None
+) -> list[tuple]:
     """Replay through the legacy per-symbol pandas backend
     (``backend=reference``, BASELINE config #1); returns the fired
-    ``(tick_ms, strategy, symbol, direction, autotrade)`` tuples."""
+    ``(tick_ms, strategy, symbol, direction, autotrade)`` tuples.
+
+    Mirrors the pipeline's host-side breadth handling: adp pair from the
+    (static) series, and the grid-only policy resolved from the PREVIOUS
+    tick's regime — the engine reads last tick's policy when building
+    HostInputs and refreshes it after the evaluation.
+    """
+    from binquant_tpu.io.pipeline import breadth_scalars
     from binquant_tpu.oracle import OracleEvaluator
+    from binquant_tpu.regime.grid_policy import GridOnlyPolicy
+    from binquant_tpu.schemas import MarketBreadthSeries
 
     evaluator = OracleEvaluator(
         window=window,
@@ -195,24 +214,47 @@ def run_replay_oracle(path: str | Path, window: int = 200) -> list[tuple]:
         min_coverage_ratio=0.5,
         is_futures=True,
     )
+    mb = MarketBreadthSeries(**breadth) if breadth else None
+    # the SAME resolution the live pipeline uses (one copy of semantics)
+    adp_latest, adp_prev, _, _, _ = breadth_scalars(mb)
+
+    policy = GridOnlyPolicy.disabled("not_evaluated")
     klines_by_tick = load_klines_by_tick(path)
     out: list[tuple] = []
     for bucket in sorted(klines_by_tick):
         for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
             evaluator.ingest(k)
         tick_ms = (bucket + 1) * 900 * 1000
-        for strategy, sym, direction, autotrade in evaluator.evaluate(tick_ms):
+        for strategy, sym, direction, autotrade in evaluator.evaluate(
+            tick_ms,
+            grid_policy_allows=policy.allow_grid_ladder,
+            adp_latest=adp_latest,
+            adp_prev=adp_prev,
+        ):
             out.append((tick_ms, strategy, sym, direction, autotrade))
+        # next tick's policy from THIS tick's regime (None when invalid)
+        policy = GridOnlyPolicy.resolve(evaluator.last_regime, mb)
     return out
 
 
-def run_replay_ab(path: str | Path, capacity: int = 256, window: int = 200) -> dict:
+def run_replay_ab(
+    path: str | Path,
+    capacity: int = 256,
+    window: int = 200,
+    breadth: dict | None = None,
+) -> dict:
     """A/B parity: the TPU batch path and the per-symbol pandas oracle run
     the same replay and must emit the identical signal set (SURVEY.md §7
     step 8 — the correctness oracle for the batched evaluation)."""
     tpu_signals: list[tuple] = []
-    stats = run_replay(path, capacity=capacity, window=window, collect=tpu_signals)
-    oracle_signals = run_replay_oracle(path, window=window)
+    stats = run_replay(
+        path,
+        capacity=capacity,
+        window=window,
+        collect=tpu_signals,
+        breadth=breadth,
+    )
+    oracle_signals = run_replay_oracle(path, window=window, breadth=breadth)
     tpu_set, oracle_set = set(tpu_signals), set(oracle_signals)
     return {
         "match": tpu_set == oracle_set,
@@ -220,6 +262,7 @@ def run_replay_ab(path: str | Path, capacity: int = 256, window: int = 200) -> d
         "oracle_count": len(oracle_set),
         "only_tpu": sorted(tpu_set - oracle_set),
         "only_oracle": sorted(oracle_set - tpu_set),
+        "strategies": sorted({s for _, s, _, _, _ in tpu_set}),
         "tpu_stats": stats,
     }
 
@@ -266,11 +309,18 @@ def generate_replay_file(
             rets = rng.normal(0, 0.004, n_symbols)
             rets[5] -= 0.008
             last_tick = tick == n_ticks - 1
+            if last_tick and n_symbols > 3:
+                # LSP setup: BTC up (long route needs btc_momentum > 0)
+                # and a +3% pump on S003 (8x volume below)
+                rets[0] = 0.005
+                rets[3] = 0.03
             new_px = px * (1 + rets)
             for i in range(n_symbols):
                 symbol = "BTCUSDT" if i == 0 else f"S{i:03d}USDT"
                 o, c = px[i], new_px[i]
                 vol15 = abs(rng.normal(1000, 200))
+                if last_tick and i == 3:
+                    vol15 *= 8.0
                 h, low = max(o, c) * 1.002, min(o, c) * 0.998
                 if last_tick and i == 5:
                     # green hammer: deep gap down (clears the 20-bar lower

@@ -358,6 +358,13 @@ class OracleEvaluator:
         self._last_regime: int | None = None
         self._last_strength: float = 0.0
 
+    @property
+    def last_regime(self) -> int | None:
+        """The most recent VALID evaluation's market regime (None when the
+        last context failed coverage) — the input the host-side grid-only
+        policy and quiet-hours filter consume next tick."""
+        return self._last_regime
+
     # -- ingest ------------------------------------------------------------
 
     def ingest(self, kline: dict) -> None:

@@ -8,9 +8,6 @@ evaluation: any formula drift between the device kernels and the
 reference semantics shows up as a set difference here.
 """
 
-import os
-import tempfile
-
 import pytest
 
 from binquant_tpu.io.replay import (
@@ -21,12 +18,24 @@ from binquant_tpu.io.replay import (
 
 CAPACITY, WINDOW = 64, 200
 
+# Washed-out breadth recovering (adp <= -0.4 and rising) with non-flat MA
+# momentum: engages LiquidationSweepPump's LONG route and flips the
+# grid-only policy active in RANGE/TRANSITIONAL regimes.
+WASHED_BREADTH = {
+    "timestamp": [1, 2, 3],
+    "market_breadth": [-0.50, -0.47, -0.44],
+    "market_breadth_ma": [-0.50, -0.46],
+}
 
-@pytest.mark.parametrize("seed", [7, 99])
-def test_ab_signal_sets_identical(seed):
-    path = os.path.join(tempfile.mkdtemp(), f"ab_{seed}.jsonl")
-    generate_replay_file(path, n_symbols=24, n_ticks=120, seed=seed)
-    result = run_replay_ab(path, capacity=CAPACITY, window=WINDOW)
+
+@pytest.fixture(scope="module")
+def replay_path(tmp_path_factory):
+    path = tmp_path_factory.mktemp("ab") / "ab_7.jsonl"
+    generate_replay_file(path, n_symbols=24, n_ticks=120, seed=7)
+    return path
+
+
+def _assert_match(result):
     assert result["match"], {
         "only_tpu": result["only_tpu"][:5],
         "only_oracle": result["only_oracle"][:5],
@@ -36,12 +45,31 @@ def test_ab_signal_sets_identical(seed):
     assert result["tpu_count"] > 0
 
 
-def test_oracle_emits_crafted_signals():
-    """The oracle independently finds the replay's crafted setups (the
-    MeanReversionFade hammer on S005 at the final tick)."""
-    path = os.path.join(tempfile.mkdtemp(), "oracle.jsonl")
-    generate_replay_file(path, n_symbols=24, n_ticks=120)
-    signals = run_replay_oracle(path, window=WINDOW)
+def test_ab_signal_sets_identical(replay_path):
+    _assert_match(run_replay_ab(replay_path, capacity=CAPACITY, window=WINDOW))
+
+
+def test_ab_alternate_seed(tmp_path):
+    path = tmp_path / "ab_99.jsonl"
+    generate_replay_file(path, n_symbols=24, n_ticks=120, seed=99)
+    _assert_match(run_replay_ab(path, capacity=CAPACITY, window=WINDOW))
+
+
+def test_ab_with_breadth_engages_lsp(replay_path):
+    """With a scripted breadth series the breadth-gated paths (LSP
+    routing, grid-only policy lag) run in BOTH backends and must agree —
+    and LSP must actually ENGAGE, or the parity is vacuous for it."""
+    result = run_replay_ab(
+        replay_path, capacity=CAPACITY, window=WINDOW, breadth=WASHED_BREADTH
+    )
+    _assert_match(result)
+    assert "liquidation_sweep_pump" in result["strategies"]
+
+
+def test_oracle_emits_crafted_signals(replay_path):
+    """The oracle independently finds the crafted setups: the MRF hammer
+    on S005 and — with breadth — the LSP pump on S003."""
+    signals = run_replay_oracle(replay_path, window=WINDOW)
     by_strategy = {}
     for _, strategy, sym, direction, _ in signals:
         by_strategy.setdefault(strategy, []).append((sym, direction))
@@ -49,3 +77,13 @@ def test_oracle_emits_crafted_signals():
         sym == "S005USDT" and direction == "LONG"
         for sym, direction in by_strategy.get("mean_reversion_fade", [])
     )
+
+    with_breadth = run_replay_oracle(
+        replay_path, window=WINDOW, breadth=WASHED_BREADTH
+    )
+    lsp = [
+        (sym, direction)
+        for _, strategy, sym, direction, _ in with_breadth
+        if strategy == "liquidation_sweep_pump"
+    ]
+    assert ("S003USDT", "LONG") in lsp

@@ -143,6 +143,14 @@ class Config:
         return int(self._get("BQT_WINDOW_BARS", "400"))
 
     @cached_property
+    def pipeline_depth(self) -> int:
+        """Tick pipelining depth: dispatch tick i, emit tick i-depth whose
+        wire D2H already landed. 1 hides the full device round trip at the
+        1 s live cadence; 0 is the serial (same-tick) fallback used by
+        replay for deterministic tick→signal attribution."""
+        return int(self._get("BQT_PIPELINE_DEPTH", "1"))
+
+    @cached_property
     def heartbeat_path(self) -> str:
         return self._get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")
 

@@ -304,9 +304,26 @@ class IngestBatcher:
     def __init__(self, registry: SymbolRegistry) -> None:
         self.registry = registry
         self._pending: dict[tuple[str, int], np.ndarray] = {}
+        self._prebuilt: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
 
     def __len__(self) -> int:
-        return len(self._pending)
+        return len(self._pending) + sum(len(r) for r, _, _ in self._prebuilt)
+
+    def add_batch(
+        self, row_idx: np.ndarray, ts_s: np.ndarray, vals: np.ndarray
+    ) -> None:
+        """Bulk ingest of an already-normalized (row_idx, ts_s, vals (U, F))
+        sub-batch — the vectorized fast path for backfill chunks and the
+        benchmark driver, skipping per-candle dict parsing. Rows must
+        already be registry rows; the batch is applied before any
+        per-candle pending entries on the next drain."""
+        self._prebuilt.append(
+            (
+                np.asarray(row_idx, dtype=np.int32),
+                np.asarray(ts_s, dtype=np.int32),
+                np.asarray(vals, dtype=np.float32),
+            )
+        )
 
     def add(self, kline: dict | object) -> None:
         get = (
@@ -350,6 +367,9 @@ class IngestBatcher:
             max_depth = max(max_depth, len(entries))
 
         batches: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        if self._prebuilt:
+            batches.extend(self._prebuilt)
+            self._prebuilt = []
         for depth in range(max_depth):
             rows_d = [
                 (self.registry.add(sym), *entries[depth])

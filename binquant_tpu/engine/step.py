@@ -156,12 +156,36 @@ class TickOutputs(NamedTuple):
 
 
 # The wire is a single small 1-D array: context scalars + a device-side
-# compaction of the fired (strategy, row) pairs. Fetching the full (5N, S)
-# summary cost ~0.6 MB/tick, which through a tunneled device serializes at
-# transfer bandwidth; the compact wire is ~18 KB. Timestamps ride as
-# (quotient, remainder) base-65536 pairs: ~1.7e9 seconds exceeds f32's
-# 2^24 integer range, the split parts don't.
+# compaction of the fired (strategy, row) pairs + a per-slot emission
+# payload + the (3, S) leverage-calibration rows. Fetching the full
+# (5N, S) summary cost ~0.6 MB/tick, which through a tunneled device
+# serializes at transfer bandwidth; the wire is ~35 KB at S=2048 (~24 KB
+# of that is the calib block, consumed once per 15m bucket — carried
+# every tick anyway because ONE fixed-shape transfer beats a separate
+# 3-round-trip fetch at bucket boundaries, and 35 KB/s is noise next to
+# the update stream). Timestamps ride as (quotient, remainder) base-65536
+# pairs: ~1.7e9 seconds exceeds f32's 2^24 integer range, the split parts
+# don't.
 WIRE_MAX_FIRED = 64  # overflow flagged via n_fired; host falls back to summary
+
+# --- per-slot emission payload -------------------------------------------
+# Everything the host-side emission layer reads for a fired row rides the
+# wire, gathered device-side: per-timeframe close/volume/BB triple, micro
+# regime codes, and the firing strategy's diagnostics. Round 2 fetched
+# these lazily per fired strategy — each np.asarray a full device round
+# trip, which through a tunneled chip turned fired ticks into multi-second
+# stalls. Now a tick is ONE transfer whether or not anything fired.
+EMISSION_DIAG_WIDTH = 16  # per-strategy diagnostics slots (padded)
+EMISSION_BASE_FIELDS: tuple[str, ...] = (
+    "close5", "volume5", "bb_upper5", "bb_mid5", "bb_lower5",
+    "close15", "volume15", "bb_upper15", "bb_mid15", "bb_lower15",
+    "micro_regime", "micro_transition",
+)
+EMISSION_SLOT_WIDTH = len(EMISSION_BASE_FIELDS) + EMISSION_DIAG_WIDTH
+# (key, kind) per strategy, kind in {"b","i","f"} — recorded at trace time
+# per wire_enabled combo (BBX's kernel is compile-time gated on it), read
+# by io.emission to rebuild typed per-row diagnostics dicts.
+EMISSION_LAYOUTS: dict[tuple, dict[str, list[tuple[str, str]]]] = {}
 WIRE_SCALARS_A: tuple[str, ...] = (
     "valid",
     "market_regime",
@@ -198,6 +222,10 @@ class WireFired(NamedTuple):
     direction: object  # (K,) int32
     score: object  # (K,) f32
     stop_loss_pct: object  # (K,) f32
+    # (kept, EMISSION_SLOT_WIDTH) per-slot emission payload, or None when
+    # absent (fabricated test wires) — emission then falls back to direct
+    # device fetches
+    payload: object = None
 
 
 def unpack_wire(wire) -> tuple[WireFired, dict]:
@@ -230,8 +258,21 @@ def unpack_wire(wire) -> tuple[WireFired, dict]:
     off = na + nb + 4
     K = WIRE_MAX_FIRED
     n = int(w[off])
-    blocks = w[off + 1 :].reshape(6, K)
+    blocks = w[off + 1 : off + 1 + 6 * K].reshape(6, K)
     kept = min(n, K)
+    payload_off = off + 1 + 6 * K
+    payload = None
+    if len(w) >= payload_off + K * EMISSION_SLOT_WIDTH:
+        payload = w[payload_off : payload_off + K * EMISSION_SLOT_WIDTH].reshape(
+            K, EMISSION_SLOT_WIDTH
+        )[:kept]
+        calib_off = payload_off + K * EMISSION_SLOT_WIDTH
+        rest = len(w) - calib_off
+        if rest > 0 and rest % 3 == 0:
+            calib = w[calib_off:].reshape(3, rest // 3)
+            ctx["calib_valid"] = calib[0] > 0.5
+            ctx["calib_close"] = calib[1]
+            ctx["calib_atr_pct"] = calib[2]
     fired = WireFired(
         n=n,
         overflow=n > K,
@@ -241,6 +282,7 @@ def unpack_wire(wire) -> tuple[WireFired, dict]:
         direction=blocks[3, :kept].astype(np.int32),
         score=blocks[4, :kept],
         stop_loss_pct=blocks[5, :kept],
+        payload=payload,
     )
     return fired, ctx
 
@@ -397,7 +439,21 @@ def _tick_step_impl(
     btd = _mask_outputs(
         buy_the_dip(buf15, pack15, context, inputs.quiet_hours), ok15 & fresh15
     )
-    bbx = _mask_outputs(bb_extreme_reversion(buf15, pack15, context), ok15 & fresh15)
+    # BBX ships ENABLED=False (reference l.45-46); opting it into the wire
+    # set (enabled_strategies override) also enables the kernel — the
+    # static wire_enabled makes this a compile-time branch, costing nothing
+    # when dormant
+    from binquant_tpu.strategies.dormant import BBXParams
+
+    bbx = _mask_outputs(
+        bb_extreme_reversion(
+            buf15,
+            pack15,
+            context,
+            BBXParams(enabled="bb_extreme_reversion" in wire_enabled),
+        ),
+        ok15 & fresh15,
+    )
     ipt = _mask_outputs(inverse_price_tracker(pack5, context), ok5 & fresh5)
     rbr = _mask_outputs(
         range_bb_rsi_mean_reversion(buf15, pack15, context), ok15 & fresh15
@@ -501,7 +557,74 @@ def _tick_step_impl(
             jnp.where(valid_idx, gather(summary.stop_loss_pct), 0.0),
         ]
     )  # (6, K)
-    wire = jnp.concatenate([scalars, n_fired[None], fired_block.reshape(-1)])
+
+    # --- per-slot emission payload: gather, for each fired slot, the
+    # pack/micro features and the firing strategy's diagnostics so the
+    # host emits signals with ZERO further device fetches
+    layout: dict[str, list[tuple[str, str]]] = {}
+    diag_mats = []
+    for name in STRATEGY_ORDER:
+        entries: list[tuple[str, str]] = []
+        diag_rows = []
+        for key, arr in strategies[name].diagnostics.items():
+            if arr.ndim == 0:
+                arr = jnp.broadcast_to(arr, (S,))
+            kind = (
+                "b"
+                if arr.dtype == jnp.bool_
+                else "i"
+                if jnp.issubdtype(arr.dtype, jnp.integer)
+                else "f"
+            )
+            entries.append((key, kind))
+            diag_rows.append(arr.astype(jnp.float32))
+        assert len(entries) <= EMISSION_DIAG_WIDTH, (name, len(entries))
+        diag_rows += [jnp.zeros((S,), jnp.float32)] * (
+            EMISSION_DIAG_WIDTH - len(diag_rows)
+        )
+        layout[name] = entries
+        diag_mats.append(jnp.stack(diag_rows))
+    EMISSION_LAYOUTS[wire_enabled] = layout
+    diag_all = jnp.stack(diag_mats)  # (N, D, S)
+    base_feats = jnp.stack(
+        [
+            pack5.close, pack5.volume, pack5.bb_upper, pack5.bb_mid,
+            pack5.bb_lower,
+            pack15.close, pack15.volume, pack15.bb_upper, pack15.bb_mid,
+            pack15.bb_lower,
+            context.features.micro_regime.astype(jnp.float32),
+            context.features.micro_transition.astype(jnp.float32),
+        ]
+    )  # (12, S)
+    slot_base = base_feats[:, row].T  # (K, 12)
+    slot_diag = diag_all[si, :, row]  # (K, D)
+    slot_payload = jnp.where(
+        valid_idx[:, None],
+        jnp.concatenate([slot_base, slot_diag], axis=1),
+        0.0,
+    )  # (K, EMISSION_SLOT_WIDTH)
+
+    # per-symbol calibration rows: the leverage calibrator consumes these
+    # once per 15m bucket — riding the wire keeps that path free of device
+    # fetches too (round 2's calibrate_all pulled five arrays per bucket,
+    # ~0.6 s of blocking round trips through a tunneled chip)
+    calib_block = jnp.stack(
+        [
+            context.features.valid.astype(jnp.float32),
+            context.features.close.astype(jnp.float32),
+            context.features.atr_pct.astype(jnp.float32),
+        ]
+    )  # (3, S)
+
+    wire = jnp.concatenate(
+        [
+            scalars,
+            n_fired[None],
+            fired_block.reshape(-1),
+            slot_payload.reshape(-1),
+            calib_block.reshape(-1),
+        ]
+    )
 
     outputs = TickOutputs(
         context=context,

@@ -81,6 +81,21 @@ class FiredSignal:
         self.value = value
         self.message = message
         self.analytics = analytics
+        # stamped by SignalEngine._finalize_tick: the evaluated tick's
+        # wall-clock ms (pipelined emission happens one process_tick call
+        # after dispatch, so callers can't infer this from call order)
+        self.tick_ms: int | None = None
+
+
+def _cast_diag(kind: str, v: float):
+    """Rebuild a typed numpy scalar from a payload float so downstream
+    checks (bool-skip in messages, float coercion in analytics) behave
+    exactly as with directly-fetched arrays."""
+    if kind == "b":
+        return np.bool_(v > 0.5)
+    if kind == "i":
+        return np.int32(round(v))
+    return np.float32(v)
 
 
 def extract_fired(
@@ -93,30 +108,32 @@ def extract_fired(
     enabled: frozenset[str] | set[str] | None = None,
     skip=None,
     unpacked=None,
+    diag_layout: dict[str, list[tuple[str, str]]] | None = None,
 ) -> list[FiredSignal]:
     """Materialize FiredSignal objects for rows whose trigger bit is set.
 
     Only strategies in ``enabled`` (default: the reference's live dispatch
     set) are materialized — dormant strategies ride the same device pass but
     emit nothing unless opted in. ``skip(strategy, row) -> bool`` lets the
-    caller drop rows (e.g. already emitted this bar) BEFORE any diagnostics
-    fetch or payload construction.
+    caller drop rows (e.g. already emitted this bar) BEFORE any payload
+    construction.
 
-    The common path costs exactly ONE tiny device fetch: the packed wire
-    (context scalars + device-compacted fired entries). ``unpacked`` lets
-    the caller pass an already-fetched ``unpack_wire`` result. Per-row
-    diagnostics are fetched lazily per fired strategy (rare — a handful of
-    rows per tick at most); the full (N, S) summary is fetched only in the
-    >WIRE_MAX_FIRED overflow case.
+    The common path costs exactly ONE tiny device fetch — the packed wire,
+    whose per-slot emission payload carries every value needed here
+    (``diag_layout`` maps the payload's diagnostics slots back to typed
+    keys; see ``engine.step.EMISSION_LAYOUTS``). Direct device fetches
+    happen only when the payload is absent (fabricated test wires) or in
+    the >WIRE_MAX_FIRED overflow case.
     """
-    from binquant_tpu.engine.step import unpack_wire
+    from binquant_tpu.engine.step import EMISSION_BASE_FIELDS, unpack_wire
 
     if enabled is None:
         enabled = LIVE_STRATEGIES
     fired_w, ctx_s = unpacked if unpacked is not None else unpack_wire(outputs.wire)
 
-    # (strategy_index, row, autotrade, direction, score, stop) tuples
-    entries: list[tuple[int, int, bool, int, float, float]] = []
+    n_base = len(EMISSION_BASE_FIELDS)
+    # (strategy_index, row, autotrade, direction, score, stop, payload_row)
+    entries: list[tuple[int, int, bool, int, float, float, Any]] = []
     if fired_w.overflow:
         # pathological tick: compaction overflowed — full summary fallback
         trig = np.asarray(outputs.summary.trigger)
@@ -133,9 +150,11 @@ def extract_fired(
                     int(dirn[si, row]),
                     float(scor[si, row]),
                     float(stop[si, row]),
+                    None,
                 )
             )
     else:
+        has_payload = fired_w.payload is not None and diag_layout is not None
         for j in range(len(fired_w.strategy_idx)):
             entries.append(
                 (
@@ -145,18 +164,19 @@ def extract_fired(
                     int(fired_w.direction[j]),
                     float(fired_w.score[j]),
                     float(fired_w.stop_loss_pct[j]),
+                    fired_w.payload[j] if has_payload else None,
                 )
             )
 
-    by_strategy: dict[int, list[tuple[int, bool, int, float, float]]] = {}
-    for si, row, autotrade, direction_code, score, stop_loss in entries:
+    by_strategy: dict[int, list[tuple[int, bool, int, float, float, Any]]] = {}
+    for si, row, autotrade, direction_code, score, stop_loss, slot in entries:
         strategy = STRATEGY_ORDER[si]
         if strategy not in enabled:
             continue
         if skip is not None and skip(strategy, row):
             continue
         by_strategy.setdefault(si, []).append(
-            (row, autotrade, direction_code, score, stop_loss)
+            (row, autotrade, direction_code, score, stop_loss, slot)
         )
     if not by_strategy:
         return []
@@ -172,38 +192,72 @@ def extract_fired(
         "long_tailwind": ctx_s["long_tailwind"],
         "short_tailwind": ctx_s["short_tailwind"],
     }
-    feats = outputs.context.features
-    micro_np = np.asarray(feats.micro_regime)
-    micro_trans_np = np.asarray(feats.micro_transition)
+    # direct-fetch caches, resolved lazily ONLY for payload-less entries
+    micro_np = micro_trans_np = None
 
     fired: list[FiredSignal] = []
     for si in sorted(by_strategy):
         strategy = STRATEGY_ORDER[si]
-        so = outputs.strategies[strategy]
-        diagnostics = {k: np.asarray(v) for k, v in so.diagnostics.items()}
-        pack = outputs.pack5 if strategy in FIVE_MIN_STRATEGIES else outputs.pack15
-        closes = np.asarray(pack.close)
-        bb_high = np.asarray(pack.bb_upper)
-        bb_mid = np.asarray(pack.bb_mid)
-        bb_low = np.asarray(pack.bb_lower)
-        volumes = np.asarray(pack.volume)
+        five_min = strategy in FIVE_MIN_STRATEGIES
+        legacy = None
+        if any(slot is None for *_, slot in by_strategy[si]):
+            # fabricated wire or overflow: fetch this strategy's arrays
+            so = outputs.strategies[strategy]
+            pack = outputs.pack5 if five_min else outputs.pack15
+            legacy = (
+                {k: np.asarray(v) for k, v in so.diagnostics.items()},
+                np.asarray(pack.close),
+                np.asarray(pack.bb_upper),
+                np.asarray(pack.bb_mid),
+                np.asarray(pack.bb_lower),
+                np.asarray(pack.volume),
+            )
+            if micro_np is None:
+                feats = outputs.context.features
+                micro_np = np.asarray(feats.micro_regime)
+                micro_trans_np = np.asarray(feats.micro_transition)
 
-        for row, autotrade, direction_code, score, stop_loss in by_strategy[si]:
+        for row, autotrade, direction_code, score, stop_loss, slot in by_strategy[si]:
             symbol = registry.name_of(row)
             if symbol is None:
                 continue
+            if slot is not None:
+                base = slot[:n_base]
+                off = 0 if five_min else 5
+                current_price = float(base[0 + off])
+                volume = float(base[1 + off])
+                bb_high_v = float(base[2 + off])
+                bb_mid_v = float(base[3 + off])
+                bb_low_v = float(base[4 + off])
+                micro = int(base[10])
+                micro_trans = int(base[11])
+                diag_vec = slot[n_base:]
+                diag_row = {
+                    key: _cast_diag(kind, float(diag_vec[t]))
+                    for t, (key, kind) in enumerate(diag_layout[strategy])
+                }
+            else:
+                diags, closes, bb_h, bb_m, bb_l, volumes = legacy
+                current_price = float(closes[row])
+                volume = float(volumes[row])
+                bb_high_v = float(bb_h[row])
+                bb_mid_v = float(bb_m[row])
+                bb_low_v = float(bb_l[row])
+                micro = int(micro_np[row])
+                micro_trans = int(micro_trans_np[row])
+                diag_row = {k: v[row] for k, v in diags.items()}
+
             direction = Direction(direction_code).name
             position = Position.short if direction == "SHORT" else Position.long
-            current_price = float(closes[row])
             spreads = HABollinguerSpread(
-                bb_high=round_numbers(float(bb_high[row]), 6),
-                bb_mid=round_numbers(float(bb_mid[row]), 6),
-                bb_low=round_numbers(float(bb_low[row]), 6),
+                bb_high=round_numbers(bb_high_v, 6),
+                bb_mid=round_numbers(bb_mid_v, 6),
+                bb_low=round_numbers(bb_low_v, 6),
             )
 
             if strategy == "grid_ladder":
                 value = _grid_signal(
-                    symbol, row, diagnostics, current_price, exchange,
+                    symbol, diag_row, current_price, exchange,
                     market_type, autotrade, ctx_np, settings,
                 )
             else:
@@ -228,7 +282,7 @@ def extract_fired(
                     current_price=current_price,
                     direction=direction,
                     score=score,
-                    volume=float(volumes[row]),
+                    volume=volume,
                     signal_kind=SignalKind.standard,
                     algorithm_name=strategy,
                     symbol=symbol,
@@ -237,10 +291,10 @@ def extract_fired(
                 )
 
             message = _build_message(
-                strategy, symbol, row, value, diagnostics, ctx_np,
-                micro_np, micro_trans_np, env, exchange, market_type,
+                strategy, symbol, value, diag_row, ctx_np,
+                micro, micro_trans, env, exchange, market_type,
             )
-            analytics = _analytics_record(strategy, symbol, value, diagnostics, ctx_np, row)
+            analytics = _analytics_record(strategy, symbol, value, diag_row, ctx_np)
             fired.append(
                 FiredSignal(strategy, symbol, row, value, message, analytics)
             )
@@ -257,10 +311,11 @@ FIVE_MIN_STRATEGIES = {
 
 
 def _grid_signal(
-    symbol, row, diagnostics, current_price, exchange, market_type,
+    symbol, diag_row, current_price, exchange, market_type,
     autotrade, ctx_np, settings,
 ) -> SignalsConsumer:
-    """GridDeploymentRequest payload (ladder_deployer.py:116-150)."""
+    """GridDeploymentRequest payload (ladder_deployer.py:116-150).
+    ``diag_row`` carries this row's diagnostics as scalars."""
     total_margin = getattr(settings, "grid_total_margin", 10.0) if settings else 10.0
     level_count = getattr(settings, "grid_level_count", 7) if settings else 7
     fiat = getattr(settings, "fiat", "USDT") if settings else "USDT"
@@ -273,10 +328,10 @@ def _grid_signal(
         market_type=MarketType(market_type),
         algorithm_name="grid_ladder",
         generated_at=datetime.now(UTC),
-        range_low=float(diagnostics["range_low"][row]),
-        range_high=float(diagnostics["range_high"][row]),
-        breakout_low=float(diagnostics["breakout_low"][row]),
-        breakout_high=float(diagnostics["breakout_high"][row]),
+        range_low=float(diag_row["range_low"]),
+        range_high=float(diag_row["range_high"]),
+        breakout_low=float(diag_row["breakout_low"]),
+        breakout_high=float(diag_row["breakout_high"]),
         total_margin=total_margin,
         level_count=level_count,
         current_price=current_price,
@@ -284,8 +339,8 @@ def _grid_signal(
         allocation_pct=allocation,
         cash_reserve_pct=reserve,
         indicators={
-            "range_width_pct": float(diagnostics["range_width_pct"][row]),
-            "atr_buffer_pct": float(diagnostics["atr_buffer_pct"][row]),
+            "range_width_pct": float(diag_row["range_width_pct"]),
+            "atr_buffer_pct": float(diag_row["atr_buffer_pct"]),
         },
     )
     return SignalsConsumer(
@@ -300,18 +355,19 @@ def _grid_signal(
 
 
 def _build_message(
-    strategy, symbol, row, value, diagnostics, ctx_np, micro_np,
-    micro_trans_np, env, exchange, market_type,
+    strategy, symbol, value, diag_row, ctx_np, micro, micro_trans,
+    env, exchange, market_type,
 ) -> str:
     """Structured Telegram message with the reference's uniform key/value
-    line shape (parsed downstream — shared/time_of_day_filter.py:20-23)."""
+    line shape (parsed downstream — shared/time_of_day_filter.py:20-23).
+    ``diag_row`` holds this row's diagnostics as typed numpy scalars."""
     exchange_link, terminal_link = build_links_msg(env, exchange, market_type, symbol)
     direction = value.direction if value.direction != "grid" else "GRID"
     action = f"{direction} ENTRY" if direction != "GRID" else "GRID DEPLOY"
     regime_name = _name(MarketRegimeCode, ctx_np["market_regime"]) if ctx_np["valid"] else "UNAVAILABLE"
     transition_name = _name(MarketTransitionCode, ctx_np["transition"], "None")
-    micro_name = _name(MicroRegimeCode, int(micro_np[row]))
-    micro_transition_name = _name(MicroTransitionCode, int(micro_trans_np[row]), "None")
+    micro_name = _name(MicroRegimeCode, micro)
+    micro_transition_name = _name(MicroTransitionCode, micro_trans, "None")
 
     lines = [
         f"- [{env}] <strong>#{strategy} algorithm</strong> #{symbol}",
@@ -328,11 +384,11 @@ def _build_message(
     if value.score:
         lines.append(f"- Score: {round_numbers(value.score, 4)}")
     # strategy-specific telemetry lines from diagnostics (scalars only)
-    for key, arr in diagnostics.items():
-        if key in ("route",) or arr.dtype == np.bool_:
+    for key, val in diag_row.items():
+        if key in ("route",) or getattr(val, "dtype", None) == np.bool_:
             continue
         try:
-            lines.append(f"- {key}: {round_numbers(float(arr[row]), 6)}")
+            lines.append(f"- {key}: {round_numbers(float(val), 6)}")
         except (TypeError, ValueError, IndexError):
             continue
     lines.extend(
@@ -346,13 +402,13 @@ def _build_message(
 
 
 def _analytics_record(
-    strategy, symbol, value, diagnostics, ctx_np, row
+    strategy, symbol, value, diag_row, ctx_np
 ) -> dict[str, Any]:
     """POST /signals body (context_evaluator.py:302-328)."""
     merged_indicators: dict[str, Any] = {}
-    for key, arr in diagnostics.items():
+    for key, val in diag_row.items():
         try:
-            merged_indicators[key] = float(arr[row])
+            merged_indicators[key] = float(val)
         except (TypeError, ValueError, IndexError):
             continue
     if value.bb_spreads is not None:

@@ -8,7 +8,23 @@ injectable; only the endpoints binquant actually calls are implemented.
 
 from __future__ import annotations
 
+import asyncio
+import logging
+import time
 from typing import Any, NamedTuple
+
+
+def _blocking_sleep_allowed() -> bool:
+    """True when NOT on a running asyncio event loop. The rate-limit
+    guard's sleeps must only block worker threads (backfill pool, OI
+    refresher's to_thread) — a sleep on the event loop would freeze
+    websocket consumption, the tick cadence, and heartbeats for up to a
+    minute."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return True
+    return False
 
 
 class FuturesSymbolInfo(NamedTuple):
@@ -32,8 +48,28 @@ class _RestClient:
 
     def _get(self, path: str, params: dict | None = None) -> Any:
         resp = self.session.get(f"{self.base_url}{path}", params=params or {})
+        if getattr(resp, "status_code", 200) == 429 and _blocking_sleep_allowed():
+            # hard rate-limit hit: honor Retry-After once, then retry.
+            # Worker threads only — on the event loop the 429 raises below
+            # (the caller's crash-isolation ring handles it) rather than
+            # freezing the engine for Retry-After seconds. The preemptive
+            # weight guard is deliberately NOT consulted for the 429
+            # response itself: its header is at/over the cap by
+            # definition, and honoring both would stack two ~60 s sleeps
+            # for one response.
+            headers = getattr(resp, "headers", None) or {}
+            retry_s = float(headers.get("retry-after", 60) or 60)
+            logging.warning(
+                "%s returned 429; sleeping %.0fs before retry", path, retry_s
+            )
+            time.sleep(retry_s)
+            resp = self.session.get(f"{self.base_url}{path}", params=params or {})
+        self._on_response(resp)
         resp.raise_for_status()
         return resp.json()
+
+    def _on_response(self, resp: Any) -> None:
+        """Per-response hook (rate-limit accounting); default no-op."""
 
 
 class BinanceApi(_RestClient):
@@ -42,6 +78,38 @@ class BinanceApi(_RestClient):
     def __init__(self, key: str = "", secret: str = "", session: Any | None = None):
         super().__init__(self.BASE, session)
         self.key, self.secret = key, secret
+        self.backoffs_engaged = 0
+
+    def _on_response(self, resp: Any) -> None:
+        """Preemptive weight guard on EVERY response (the reference reads
+        x-mbx-used-weight-1m and pauses near the 1200/min cap,
+        shared/utils.py:70-104). Wired here — in the client, not at call
+        sites — so boot backfill's thousands of uiKlines stay under the
+        budget by construction: any worker that sees the (account-global)
+        header past the soft cap sleeps out the remainder of the minute."""
+        from binquant_tpu.utils import binance_weight_backoff_seconds
+
+        used = self.get_request_weight(getattr(resp, "headers", None) or {})
+        delay = binance_weight_backoff_seconds(used)
+        if delay > 0:
+            self.backoffs_engaged += 1
+            if _blocking_sleep_allowed():
+                logging.warning(
+                    "binance used weight %d near the 1200/min cap; "
+                    "sleeping %.0fs",
+                    used,
+                    delay,
+                )
+                time.sleep(delay)
+            else:
+                # event-loop context (a one-off call from the tick path):
+                # don't freeze the engine — the bulk traffic this guard
+                # exists for runs in worker threads, which DO sleep
+                logging.warning(
+                    "binance used weight %d near the 1200/min cap "
+                    "(event-loop call; not pausing the engine)",
+                    used,
+                )
 
     def get_ui_klines(
         self, symbol: str, interval: str = "15m", limit: int = 400
@@ -55,10 +123,13 @@ class BinanceApi(_RestClient):
         data = self._get("/api/v3/ticker/price", {"symbol": symbol})
         return float(data["price"])
 
-    def get_request_weight(self, resp_headers: dict) -> int:
+    def get_request_weight(self, resp_headers: Any) -> int:
         """Binance used-weight header (shared/utils.py:70-104 reads
         x-mbx-used-weight-1m for the rate-limit guard)."""
-        return int(resp_headers.get("x-mbx-used-weight-1m", 0))
+        try:
+            return int(resp_headers.get("x-mbx-used-weight-1m", 0) or 0)
+        except (TypeError, ValueError, AttributeError):
+            return 0
 
 
 class KucoinApi(_RestClient):

@@ -10,6 +10,7 @@ of the device context (numpy'd ``MarketContext``) plus the symbol registry.
 from __future__ import annotations
 
 import logging
+from typing import NamedTuple
 
 import numpy as np
 
@@ -18,6 +19,18 @@ from binquant_tpu.enums import MarketRegimeCode
 from binquant_tpu.io.binbot import BinbotApi
 from binquant_tpu.regime.context import MarketContext
 from binquant_tpu.schemas import SymbolModel
+
+
+class CalibrationInputs(NamedTuple):
+    """Host snapshot of the calibrator's per-symbol inputs, decoded from
+    the tick wire (engine/step.py calib_block) — zero device fetches."""
+
+    valid: np.ndarray  # (S,) bool
+    close: np.ndarray  # (S,) f32
+    atr_pct: np.ndarray  # (S,) f32
+    regime: int
+    stress: float
+    confidence: float
 
 
 class LeverageCalibrator:
@@ -73,20 +86,32 @@ class LeverageCalibrator:
 
     def calibrate_all(
         self,
-        context: MarketContext,
+        context: MarketContext | CalibrationInputs,
         registry: SymbolRegistry,
         all_symbols: list[SymbolModel],
     ) -> dict[str, int]:
-        """Diff-and-PUT for every feature-valid row (reference l.81-127)."""
+        """Diff-and-PUT for every feature-valid row (reference l.81-127).
+
+        Accepts either a wire-decoded :class:`CalibrationInputs` snapshot
+        (the production path — no device fetches) or a raw
+        ``MarketContext`` (tests / direct use — fetched here)."""
         rows_by_id = {row.id: row for row in all_symbols}
         applied = no_change = skipped = 0
 
-        valid = np.asarray(context.features.valid)
-        closes = np.asarray(context.features.close)
-        atr_pcts = np.asarray(context.features.atr_pct)
-        regime = int(np.asarray(context.market_regime))
-        stress = float(np.asarray(context.market_stress_score))
-        confidence = 1.0 if bool(np.asarray(context.valid)) else 0.0
+        if isinstance(context, CalibrationInputs):
+            valid = context.valid
+            closes = context.close
+            atr_pcts = context.atr_pct
+            regime = context.regime
+            stress = context.stress
+            confidence = context.confidence
+        else:
+            valid = np.asarray(context.features.valid)
+            closes = np.asarray(context.features.close)
+            atr_pcts = np.asarray(context.features.atr_pct)
+            regime = int(np.asarray(context.market_regime))
+            stress = float(np.asarray(context.market_stress_score))
+            confidence = 1.0 if bool(np.asarray(context.valid)) else 0.0
 
         for row_idx in np.nonzero(valid)[0]:
             symbol = registry.name_of(int(row_idx))

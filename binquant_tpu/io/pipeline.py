@@ -15,9 +15,10 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from datetime import UTC, datetime
 from pathlib import Path
-from typing import Any
+from typing import Any, NamedTuple
 
 import numpy as np
 
@@ -50,7 +51,6 @@ from binquant_tpu.strategies.market_regime_notifier import MarketRegimeNotifier
 
 FIFTEEN_MIN_S = 900
 FIVE_MIN_S = 300
-OI_CACHE_TTL_S = 5.0  # klines_provider.py:67-68
 
 
 def breadth_scalars(
@@ -76,32 +76,133 @@ def breadth_scalars(
 
 
 class OpenInterestCache:
-    """KuCoin OI growth per symbol with a 5 s TTL (klines_provider.py:252-276)."""
+    """KuCoin OI growth per symbol, refreshed by a BACKGROUND task.
 
-    def __init__(self, futures_api: Any | None) -> None:
+    The reference fetches OI inline per incoming message with a 5 s TTL
+    (klines_provider.py:252-276) — tolerable at one message at a time, but
+    the batched engine sees every fresh symbol in ONE tick; a synchronous
+    GET per symbol inside ``process_tick`` would hold the event loop for up
+    to N round trips at a 15m boundary. Here the tick path is read-only:
+    :meth:`growth` returns the last growth computed by the background
+    :meth:`refresh_forever` loop, which walks the tracked universe in
+    bounded-concurrency batches amortized across the bucket.
+    """
+
+    def __init__(
+        self,
+        futures_api: Any | None,
+        max_concurrency: int = 8,
+        batch_size: int = 40,
+        batch_interval_s: float = 1.0,
+        growth_horizon_s: float = 900.0,
+        stale_after_s: float = 300.0,
+    ) -> None:
         self.futures_api = futures_api
-        self._cache: dict[str, tuple[float, float]] = {}  # symbol -> (ts, oi)
-        self._prev: dict[str, float] = {}
+        self.max_concurrency = max_concurrency
+        self.batch_size = batch_size
+        self.batch_interval_s = batch_interval_s
+        # Growth is measured against the newest sample at least this old —
+        # matching the reference's cadence, where the previous OI reading
+        # came with the previous fresh 15m candle (~900 s earlier). A
+        # sweep-to-sweep ratio (~50 s apart at 2000 symbols) would almost
+        # never clear LSP's >=1.02 confirmation gate and quietly veto the
+        # whole strategy.
+        self.growth_horizon_s = growth_horizon_s
+        # A growth value not refreshed within this window decays to NaN —
+        # the reference's TTL'd cache never served stale OI after the
+        # endpoint started failing; neither may this one (a cached 1.05
+        # would keep passing LSP's confirmation gate on dead data).
+        self.stale_after_s = stale_after_s
+        self._growth: dict[str, tuple[float, float]] = {}  # sym -> (ts, ratio)
+        self._samples: dict[str, deque[tuple[float, float]]] = {}
+        self._cursor = 0
+        self.requests_made = 0
+
+    @property
+    def has_data(self) -> bool:
+        return bool(self._growth)
 
     def growth(self, symbol: str) -> float:
-        """OI now / OI previous sample; NaN when unavailable."""
+        """Cache-only read (the tick path performs ZERO REST calls): OI
+        now / the >=horizon-old background sample; NaN when unsampled or
+        stale (fetches failing)."""
+        entry = self._growth.get(symbol)
+        if entry is None or time.monotonic() - entry[0] > self.stale_after_s:
+            return float("nan")
+        return entry[1]
+
+    async def refresh_batch(self, symbols: list[str]) -> None:
+        """Fetch OI for ``symbols`` with bounded concurrency; growth is the
+        ratio of the new sample to the newest sample at least
+        ``growth_horizon_s`` old (NaN until such a baseline exists)."""
+        if self.futures_api is None or not symbols:
+            return
+        sem = asyncio.Semaphore(self.max_concurrency)
+
+        async def one(symbol: str) -> None:
+            async with sem:
+                try:
+                    oi = float(
+                        await asyncio.to_thread(
+                            self.futures_api.get_open_interest, symbol
+                        )
+                    )
+                except Exception:
+                    return
+                self.requests_made += 1
+                now = time.monotonic()
+                dq = self._samples.setdefault(symbol, deque())
+                # baseline BEFORE appending: the newest sample older than
+                # the horizon (horizon 0 degenerates to "previous sample")
+                cutoff = now - self.growth_horizon_s
+                while len(dq) > 1 and dq[1][0] <= cutoff:
+                    dq.popleft()
+                baseline = dq[0] if dq and dq[0][0] <= cutoff else None
+                dq.append((now, oi))
+                if baseline is not None and baseline[1] > 0:
+                    self._growth[symbol] = (now, oi / baseline[1])
+
+        await asyncio.gather(*(one(s) for s in symbols))
+
+    async def refresh_forever(self, symbols_fn) -> None:
+        """Background loop: rotate through ``symbols_fn()`` one batch per
+        interval. At 2000 symbols / 40 per second a full sweep takes ~50 s —
+        well inside a 15m bucket, and never on the tick path."""
         if self.futures_api is None:
-            return float("nan")
-        now = time.monotonic()
-        cached = self._cache.get(symbol)
-        if cached and now - cached[0] < OI_CACHE_TTL_S:
-            oi = cached[1]
-        else:
+            return
+        while True:
             try:
-                oi = float(self.futures_api.get_open_interest(symbol))
+                names = symbols_fn()
+                if names:
+                    if self._cursor >= len(names):
+                        self._cursor = 0
+                        # sweep wrap: drop state for symbols that left the
+                        # tracked universe so churn can't grow the caches
+                        # without bound
+                        keep = set(names)
+                        for stale in [
+                            s for s in self._samples if s not in keep
+                        ]:
+                            self._samples.pop(stale, None)
+                            self._growth.pop(stale, None)
+                    batch = names[self._cursor : self._cursor + self.batch_size]
+                    self._cursor += self.batch_size
+                    await self.refresh_batch(batch)
+            except asyncio.CancelledError:
+                raise
             except Exception:
-                return float("nan")
-            self._cache[symbol] = (now, oi)
-        prev = self._prev.get(symbol)
-        self._prev[symbol] = oi
-        if not prev or prev <= 0:
-            return float("nan")
-        return oi / prev
+                logging.exception("OI refresh batch failed; continuing")
+            await asyncio.sleep(self.batch_interval_s)
+
+
+class _PendingTick(NamedTuple):
+    """A dispatched-but-not-yet-emitted tick riding the device pipeline."""
+
+    outputs: Any  # TickOutputs — wire D2H already started
+    ts_ms: int
+    ts5: int
+    ts15: int
+    bucket15: int
 
 
 class SignalEngine:
@@ -119,6 +220,7 @@ class SignalEngine:
         context_config: ContextConfig = ContextConfig(),
         btc_symbol: str = "BTCUSDT",
         enabled_strategies: set[str] | None = None,
+        pipeline_depth: int = 0,
     ) -> None:
         self.config = config
         self.binbot_api = binbot_api
@@ -160,6 +262,19 @@ class SignalEngine:
         # per-stage latency histograms (SURVEY §5: the p99<50ms budget is
         # measured in production, not guessed)
         self.latency = LatencyTracker()
+        # Tick pipelining: dispatch tick i to the device, start its wire's
+        # async D2H, and emit tick i-1's already-landed wire — the host
+        # never blocks on the device round trip. depth=0 is the serial
+        # fallback (dispatch + fetch + emit of the SAME tick; deterministic
+        # tick→signal attribution for replay/A-B). depth=1 is the live
+        # default (main.py): at a 1 s cadence the wire has the whole idle
+        # gap to land, so the fetch is free. Deeper pipelines only matter
+        # when ticks run back-to-back against a high-RTT (tunneled) device.
+        self.pipeline_depth = int(pipeline_depth)
+        self._pending: deque[_PendingTick] = deque()
+        # HostInputs template built once: re-creating all 16 device arrays
+        # per tick costs a dozen extra H2D dispatches
+        self._base_inputs = None
 
     # -- ingest -------------------------------------------------------------
 
@@ -221,6 +336,7 @@ class SignalEngine:
         fetch,
         now_ms: int | None = None,
         chunk: int = 50,
+        concurrency: int = 8,
     ) -> int:
         """Seed both interval buffers via REST history before going live.
 
@@ -231,35 +347,65 @@ class SignalEngine:
         ``io.exchanges.make_history_fetcher``). Only bars closed before
         ``now_ms`` are loaded. Per-symbol failures are logged and skipped;
         buffers are flushed every ``chunk`` symbols to bound host memory.
+
+        Fetches run ``concurrency``-way in a thread pool (round 2 was one
+        serial round trip at a time — minutes of boot at 2000 symbols);
+        batcher mutation stays on the calling thread. The Binance weight
+        guard lives inside ``BinanceApi._on_response``: any worker that
+        sees the account-global used-weight header past the soft cap
+        sleeps, which throttles the whole pool under the 1200/min budget.
         """
+        from concurrent.futures import ThreadPoolExecutor
+
+        t_start = time.monotonic()
         now = int(now_ms if now_ms is not None else time.time() * 1000)
         ordered = [self.btc_symbol] + [
             s for s in symbols if s != self.btc_symbol
         ]
         loaded = 0
-        for i, symbol in enumerate(ordered):
-            for interval_key, batcher in (
-                ("5m", self.batcher5),
-                ("15m", self.batcher15),
-            ):
+        requests = 0
+        failures = 0
+
+        def fetch_symbol(symbol: str):
+            out = []
+            for interval_key in ("5m", "15m"):
                 try:
-                    klines = fetch(symbol, interval_key)
+                    out.append((interval_key, fetch(symbol, interval_key)))
                 except Exception:
                     logging.exception(
                         "backfill fetch failed for %s %s; skipping",
                         symbol,
                         interval_key,
                     )
-                    continue
-                for k in klines:
-                    if int(k["close_time"]) <= now:
-                        batcher.add(k)
-                        loaded += 1
-            if (i + 1) % chunk == 0:
-                self._flush_batchers()
+                    out.append((interval_key, None))
+            return out
+
+        with ThreadPoolExecutor(max_workers=max(1, concurrency)) as pool:
+            for i, results in enumerate(pool.map(fetch_symbol, ordered)):
+                for interval_key, klines in results:
+                    if klines is None:
+                        failures += 1
+                        continue
+                    requests += 1
+                    batcher = (
+                        self.batcher5 if interval_key == "5m" else self.batcher15
+                    )
+                    for k in klines:
+                        if int(k["close_time"]) <= now:
+                            batcher.add(k)
+                            loaded += 1
+                if (i + 1) % chunk == 0:
+                    self._flush_batchers()
         self._flush_batchers()
         logging.info(
-            "backfill complete: %d bars across %d symbols", loaded, len(ordered)
+            "backfill complete: %d bars across %d symbols in %.1fs "
+            "(%d fetches ok, %d failed, %d-way)",
+            loaded,
+            len(ordered),
+            time.monotonic() - t_start,
+            requests,
+            failures,
+            concurrency,
         )
         return loaded
 
@@ -279,9 +425,10 @@ class SignalEngine:
             return
         self._last_calibration_bucket = bucket
         try:
-            self.leverage_calibrator.calibrate_all(
-                context, self.registry, self.at_consumer.all_symbols
-            )
+            with self.latency.stage("leverage_calibration"):
+                self.leverage_calibrator.calibrate_all(
+                    context, self.registry, self.at_consumer.all_symbols
+                )
         except Exception:
             logging.exception("leverage calibration crashed; continuing")
 
@@ -293,10 +440,42 @@ class SignalEngine:
     # -- the tick -------------------------------------------------------------
 
     async def process_tick(self, now_ms: int | None = None) -> list:
-        """Drain batchers, run the jit'd step, emit fired signals."""
+        """One tick of the pipelined production loop.
+
+        Dispatches tick i to the device (batcher drain → jit'd step → async
+        wire D2H) and emits the oldest tick whose pipeline slot it evicts —
+        with ``pipeline_depth=0`` that is tick i itself (serial fallback);
+        with the live ``depth=1`` it is tick i-1, whose wire landed during
+        the idle gap since the previous call, so nothing here blocks on the
+        device round trip. ``latency['tick_total']`` therefore measures the
+        true per-tick wall time of the production loop — the number the
+        p99 < 50 ms budget is judged against. Returns the emitted signals
+        (each stamped with ``tick_ms`` of the tick that produced it).
+        """
+        t_tick0 = time.perf_counter()
+        pending = await self._dispatch_tick(now_ms)
+        self._pending.append(pending)
+        fired: list = []
+        while len(self._pending) > self.pipeline_depth:
+            fired.extend(await self._finalize_tick(self._pending.popleft()))
+        self.latency.record("tick_total", (time.perf_counter() - t_tick0) * 1000.0)
+        self.latency.maybe_log()
+        self.ticks_processed += 1
+        self.touch_heartbeat()
+        return fired
+
+    async def flush_pending(self) -> list:
+        """Finalize every in-flight tick (replay end, pre-checkpoint, or
+        shutdown) so no dispatched tick's signals are lost."""
+        fired: list = []
+        while self._pending:
+            fired.extend(await self._finalize_tick(self._pending.popleft()))
+        return fired
+
+    async def _dispatch_tick(self, now_ms: int | None = None) -> _PendingTick:
+        """Drain batchers and launch the jit'd step + async wire transfer."""
         import jax.numpy as jnp
 
-        t_tick0 = time.perf_counter()
         ts_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         ts_s = ts_ms // 1000
         # Evaluate against the bar that just CLOSED: its open time is one
@@ -305,17 +484,25 @@ class SignalEngine:
         ts15 = bucket15 * FIFTEEN_MIN_S - FIFTEEN_MIN_S
         ts5 = (ts_s // FIVE_MIN_S) * FIVE_MIN_S - FIVE_MIN_S
 
-        await self._refresh_market_breadth(bucket15)
+        with self.latency.stage("breadth_refresh"):
+            await self._refresh_market_breadth(bucket15)
 
-        batches5 = self.batcher5.drain()
-        batches15 = self.batcher15.drain()
-        # OI growth for symbols with fresh 15m candles (reference cadence)
-        oi = np.full(self.capacity, np.nan, dtype=np.float32)
-        for rows, _, _ in batches15:
-            for row in rows:
-                symbol = self.registry.name_of(int(row))
-                if symbol:
-                    oi[int(row)] = self.oi_cache.growth(symbol)
+        with self.latency.stage("ingest_drain"):
+            batches5 = self.batcher5.drain()
+            batches15 = self.batcher15.drain()
+            # OI growth for symbols with fresh 15m candles (reference
+            # cadence). Cache-only reads: the background refresh_forever
+            # loop owns the REST traffic — a 15m boundary with 2000 fresh
+            # symbols performs zero network calls here. O(cached symbols),
+            # not O(capacity): an empty cache (spot deployments, bench)
+            # skips the scan entirely.
+            oi = np.full(self.capacity, np.nan, dtype=np.float32)
+            if self.oi_cache.has_data:
+                for rows, _, _ in batches15:
+                    for row in rows:
+                        symbol = self.registry.name_of(int(row))
+                        if symbol:
+                            oi[int(row)] = self.oi_cache.growth(symbol)
 
         adp_latest, adp_prev, adp_diff, adp_diff_prev, momentum = (
             self._breadth_scalars()
@@ -340,7 +527,10 @@ class SignalEngine:
         # Ordered sub-batch replay: fold all but the FINAL sub-batch into
         # the buffers, then run ONE full evaluation on the final state.
         u5, u15 = self._fold_updates(batches5, batches15)
-        inputs = default_host_inputs(self.capacity)._replace(
+        t_inputs0 = time.perf_counter()
+        if self._base_inputs is None:
+            self._base_inputs = default_host_inputs(self.capacity)
+        inputs = self._base_inputs._replace(
             tracked=jnp.asarray(self.registry.active_rows),
             btc_row=np.int32(btc_row),
             timestamp_s=np.int32(ts15),
@@ -358,10 +548,22 @@ class SignalEngine:
             is_futures=jnp.asarray(
                 str(settings.market_type).lower().endswith("futures")
             ),
-            dominance_is_losers=jnp.asarray(False),
+            # host-resolved market-domination state: attrs on the consumer
+            # (reference pattern, context_evaluator.py:95-97 /
+            # autotrade_consumer.py:37) — NEUTRAL/False in production,
+            # scriptable in replay so the dominance-gated strategies can
+            # be A/B'd
+            dominance_is_losers=jnp.asarray(
+                getattr(
+                    self.at_consumer, "current_market_dominance_is_losers", False
+                )
+            ),
             market_domination_reversal=jnp.asarray(
                 self.at_consumer.market_domination_reversal
             ),
+        )
+        self.latency.record(
+            "inputs_build", (time.perf_counter() - t_inputs0) * 1000.0
         )
         with self.latency.stage("device_dispatch"):
             self.state, outputs = tick_step(
@@ -371,10 +573,24 @@ class SignalEngine:
                 inputs,
                 self.context_config,
                 # device-side wire compaction must match the host's enabled set
-                wire_enabled=tuple(sorted(self.enabled_strategies))
-                if self.enabled_strategies is not None
-                else tuple(sorted(LIVE_STRATEGIES)),
+                wire_enabled=self._wire_enabled_key(),
             )
+            # start the wire's D2H immediately; by the time this tick is
+            # finalized (depth ticks later) the transfer has landed and the
+            # host-side np.asarray is a copy, not a round trip
+            try:
+                outputs.wire.copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax array (tests with stubbed steps)
+        return _PendingTick(
+            outputs=outputs, ts_ms=ts_ms, ts5=ts5, ts15=ts15, bucket15=bucket15
+        )
+
+    async def _finalize_tick(self, pending: _PendingTick) -> list:
+        """Consume one dispatched tick's wire: refresh host policy state and
+        emit its fired signals through the three sinks."""
+        outputs = pending.outputs
+        ts5, ts15 = pending.ts5, pending.ts15
         # ONE device fetch per tick: the packed wire (context scalars +
         # compacted fired entries). Everything host-side below reads it.
         with self.latency.stage("wire_fetch"):
@@ -392,9 +608,23 @@ class SignalEngine:
         if digest:
             self.telegram_consumer.dispatch_signal(digest)
 
-        # leverage calibration once per 15m bucket, needs a valid context
+        # leverage calibration once per 15m bucket, needs a valid context;
+        # inputs decoded from the wire (zero device fetches) when present
         if has_ctx:
-            self._run_leverage_calibration(bucket15, outputs.context)
+            from binquant_tpu.io.leverage import CalibrationInputs
+
+            if "calib_valid" in ctx_scalars:
+                calib = CalibrationInputs(
+                    valid=ctx_scalars["calib_valid"],
+                    close=ctx_scalars["calib_close"],
+                    atr_pct=ctx_scalars["calib_atr_pct"],
+                    regime=regime,
+                    stress=ctx_scalars["market_stress_score"],
+                    confidence=1.0,
+                )
+                self._run_leverage_calibration(pending.bucket15, calib)
+            else:
+                self._run_leverage_calibration(pending.bucket15, outputs.context)
 
         # carry regime state for next tick's quiet-hours override; an
         # invalid context clears it (reference: context None -> suppressed),
@@ -410,6 +640,9 @@ class SignalEngine:
 
         # emit fired signals through the three sinks
         t_emit0 = time.perf_counter()
+        settings = self.at_consumer.autotrade_settings
+        from binquant_tpu.engine.step import EMISSION_LAYOUTS
+
         fired = extract_fired(
             outputs,
             self.registry,
@@ -428,6 +661,10 @@ class SignalEngine:
                 strategy, row, ts5, ts15
             ),
             unpacked=unpacked,
+            # diagnostics slot layout recorded when this wire_enabled combo
+            # was traced — lets emission decode the wire's per-slot payload
+            # instead of fetching arrays from the device
+            diag_layout=EMISSION_LAYOUTS.get(self._wire_enabled_key()),
         )
         fired = self._dedupe_fired(fired, ts5, ts15)
         for signal in fired:
@@ -442,12 +679,24 @@ class SignalEngine:
                     signal.symbol,
                 )
         self.latency.record("emission", (time.perf_counter() - t_emit0) * 1000.0)
-        self.latency.record("tick_total", (time.perf_counter() - t_tick0) * 1000.0)
-        self.latency.maybe_log()
         self.signals_emitted += len(fired)
-        self.ticks_processed += 1
-        self.touch_heartbeat()
+        for signal in fired:
+            # which tick produced this signal — pipelined emission happens
+            # one call later, so callers (replay A/B) must not attribute it
+            # to the tick that evicted it
+            signal.tick_ms = pending.ts_ms
         return fired
+
+    def _wire_enabled_key(self) -> tuple[str, ...]:
+        """The static wire_enabled tuple this engine compiles with — also
+        the key into ``EMISSION_LAYOUTS`` for payload decoding."""
+        return tuple(
+            sorted(
+                LIVE_STRATEGIES
+                if self.enabled_strategies is None
+                else self.enabled_strategies
+            )
+        )
 
     def _already_emitted(self, strategy: str, row: int, ts5: int, ts15: int) -> bool:
         """Check (without marking) whether this (strategy, symbol) already
@@ -578,18 +827,32 @@ class SignalEngine:
                             break
                 except TimeoutError:
                     pass
-                if time.monotonic() - last_tick >= tick_interval_s and (
-                    len(self.batcher5) or len(self.batcher15)
-                ):
-                    last_tick = time.monotonic()
-                    await self.process_tick()
-                    if self.checkpoint is not None and self.checkpoint.should_save(
-                        self
-                    ):
-                        # device fetch + np.savez of ~65 MB of buffers:
-                        # keep it off the event loop so ws clients and
-                        # ping deadlines aren't starved during the save
-                        await asyncio.to_thread(self.checkpoint.maybe_save, self)
+                if time.monotonic() - last_tick >= tick_interval_s:
+                    if len(self.batcher5) or len(self.batcher15):
+                        last_tick = time.monotonic()
+                        await self.process_tick()
+                        if (
+                            self.checkpoint is not None
+                            and self.checkpoint.should_save(self)
+                        ):
+                            # finalize in-flight ticks first so the host
+                            # carries (emission dedupe, regime carry) in the
+                            # snapshot are consistent with the device state
+                            await self.flush_pending()
+                            # device fetch + np.savez of ~65 MB of buffers:
+                            # keep it off the event loop so ws clients and
+                            # ping deadlines aren't starved during the save
+                            await asyncio.to_thread(
+                                self.checkpoint.maybe_save, self
+                            )
+                    elif self._pending:
+                        # no new candles this interval but a dispatched tick
+                        # is still riding the pipeline: finalize it now.
+                        # Without this, a quiet feed would delay the last
+                        # burst's signals until the NEXT candle arrives
+                        # (up to a full 5m bar — or forever on a stall).
+                        last_tick = time.monotonic()
+                        await self.flush_pending()
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -63,7 +63,11 @@ class StubSession:
 
 
 def make_stub_engine(
-    capacity: int = 256, window: int = 200, breadth: dict | None = None
+    capacity: int = 256,
+    window: int = 200,
+    breadth: dict | None = None,
+    pipeline_depth: int = 0,
+    enabled_strategies: set[str] | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network)."""
     import os
@@ -115,6 +119,8 @@ def make_stub_engine(
         at_consumer=at_consumer,
         window=window,
         context_config=ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5),
+        pipeline_depth=pipeline_depth,
+        enabled_strategies=enabled_strategies,
     )
     engine._telegram_sent = sent  # type: ignore[attr-defined]
     return engine
@@ -140,6 +146,10 @@ def run_replay(
     window: int = 200,
     collect: list | None = None,
     breadth: dict | None = None,
+    pipeline_depth: int = 0,
+    enabled_strategies: set | None = None,
+    dominance_is_losers: bool = False,
+    market_domination_reversal: bool = False,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -148,16 +158,46 @@ def run_replay(
     comparison surface for the A/B parity harness. ``breadth`` scripts the
     stub backend's market-breadth series so the breadth-gated paths
     (LiquidationSweepPump routing, grid-only policy) engage.
+    ``pipeline_depth`` drives the engine's pipelined tick loop (default 0:
+    serial, so host policy state advances with the SAME one-tick lag the
+    oracle models); fired signals are attributed to their producing tick
+    via ``FiredSignal.tick_ms`` either way, and in-flight ticks are flushed
+    at end of file.
     """
-    engine = make_stub_engine(capacity=capacity, window=window, breadth=breadth)
+    engine = make_stub_engine(
+        capacity=capacity,
+        window=window,
+        breadth=breadth,
+        pipeline_depth=pipeline_depth,
+        enabled_strategies=enabled_strategies,
+    )
+    # scripted dominance state (reference: attrs on the evaluator/consumer,
+    # NEUTRAL/False in production — scriptable here so the dominance-gated
+    # dormant strategies can be exercised in A/B runs)
+    engine.at_consumer.market_domination_reversal = market_domination_reversal
+    engine.at_consumer.current_market_dominance_is_losers = dominance_is_losers
     klines_by_tick = load_klines_by_tick(path)
 
     fired_total = 0
     t_start = time.perf_counter()
     latencies = []
 
-    async def drive() -> None:
+    def record(fired) -> None:
         nonlocal fired_total
+        fired_total += len(fired)
+        if collect is not None:
+            for s in fired:
+                collect.append(
+                    (
+                        s.tick_ms,
+                        s.strategy,
+                        s.symbol,
+                        str(s.value.direction),
+                        bool(s.value.autotrade),
+                    )
+                )
+
+    async def drive() -> None:
         for bucket in sorted(klines_by_tick):
             for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
                 engine.ingest(k)
@@ -166,18 +206,8 @@ def run_replay(
             t0 = time.perf_counter()
             fired = await engine.process_tick(now_ms=tick_ms)
             latencies.append((time.perf_counter() - t0) * 1000)
-            fired_total += len(fired)
-            if collect is not None:
-                for s in fired:
-                    collect.append(
-                        (
-                            tick_ms,
-                            s.strategy,
-                            s.symbol,
-                            str(s.value.direction),
-                            bool(s.value.autotrade),
-                        )
-                    )
+            record(fired)
+        record(await engine.flush_pending())
 
     asyncio.run(drive())
     wall = time.perf_counter() - t_start
@@ -192,7 +222,12 @@ def run_replay(
 
 
 def run_replay_oracle(
-    path: str | Path, window: int = 200, breadth: dict | None = None
+    path: str | Path,
+    window: int = 200,
+    breadth: dict | None = None,
+    enabled_strategies: set | None = None,
+    dominance_is_losers: bool = False,
+    market_domination_reversal: bool = False,
 ) -> list[tuple]:
     """Replay through the legacy per-symbol pandas backend
     (``backend=reference``, BASELINE config #1); returns the fired
@@ -213,10 +248,11 @@ def run_replay_oracle(
         required_fresh_symbols=4,
         min_coverage_ratio=0.5,
         is_futures=True,
+        enabled_strategies=enabled_strategies,
     )
     mb = MarketBreadthSeries(**breadth) if breadth else None
     # the SAME resolution the live pipeline uses (one copy of semantics)
-    adp_latest, adp_prev, _, _, _ = breadth_scalars(mb)
+    adp_latest, adp_prev, adp_diff, adp_diff_prev, _ = breadth_scalars(mb)
 
     policy = GridOnlyPolicy.disabled("not_evaluated")
     klines_by_tick = load_klines_by_tick(path)
@@ -230,6 +266,10 @@ def run_replay_oracle(
             grid_policy_allows=policy.allow_grid_ladder,
             adp_latest=adp_latest,
             adp_prev=adp_prev,
+            adp_diff=adp_diff,
+            adp_diff_prev=adp_diff_prev,
+            dominance_is_losers=dominance_is_losers,
+            market_domination_reversal=market_domination_reversal,
         ):
             out.append((tick_ms, strategy, sym, direction, autotrade))
         # next tick's policy from THIS tick's regime (None when invalid)
@@ -242,10 +282,17 @@ def run_replay_ab(
     capacity: int = 256,
     window: int = 200,
     breadth: dict | None = None,
+    enabled_strategies: set | None = None,
+    dominance_is_losers: bool = False,
+    market_domination_reversal: bool = False,
 ) -> dict:
     """A/B parity: the TPU batch path and the per-symbol pandas oracle run
     the same replay and must emit the identical signal set (SURVEY.md §7
-    step 8 — the correctness oracle for the batched evaluation)."""
+    step 8 — the correctness oracle for the batched evaluation).
+    ``enabled_strategies`` overrides the live dispatch set in BOTH backends
+    (e.g. to A/B the dormant oracle set — VERDICT r2 item 6); the dominance
+    flags script the host-resolved market-domination state both backends
+    consume."""
     tpu_signals: list[tuple] = []
     stats = run_replay(
         path,
@@ -253,8 +300,16 @@ def run_replay_ab(
         window=window,
         collect=tpu_signals,
         breadth=breadth,
+        enabled_strategies=enabled_strategies,
+        dominance_is_losers=dominance_is_losers,
+        market_domination_reversal=market_domination_reversal,
     )
-    oracle_signals = run_replay_oracle(path, window=window, breadth=breadth)
+    oracle_signals = run_replay_oracle(
+        path, window=window, breadth=breadth,
+        enabled_strategies=enabled_strategies,
+        dominance_is_losers=dominance_is_losers,
+        market_domination_reversal=market_domination_reversal,
+    )
     tpu_set, oracle_set = set(tpu_signals), set(oracle_signals)
     return {
         "match": tpu_set == oracle_set,
@@ -265,6 +320,237 @@ def run_replay_ab(
         "strategies": sorted({s for _, s, _, _, _ in tpu_set}),
         "tpu_stats": stats,
     }
+
+
+def generate_dormant_replay(
+    path: str | Path,
+    n_symbols: int = 24,
+    n_ticks: int = 130,
+    seed: int = 23,
+) -> None:
+    """Synthesize a calm RANGE market with crafted setups for the dormant
+    oracle set (VERDICT r2 item 6):
+
+    * S002 — BuyTheDip: a −4% shelf drop starting ~22 bars before the end
+      (inside the 24-bar lookback), a flat base, then a green reclaim bar
+      over prev close + EMA20 on the final tick;
+    * S003 — BBExtremeReversion: two consecutive hard red 15m bars ending
+      below the 20-bar −2σ band (Connors RSI(2) pins to 0);
+    * S004 — RangeBbRsiMeanReversion: a choppy zig-zag bleed (keeps the
+      rolling-sum ADX under 32 while RSI(14) sits ≤35) ending in a hammer
+      that undershoots −2σ, closes green near its high, below the mid.
+
+    The rest of the universe oscillates gently so the macro regime stays
+    RANGE with low stress.
+    """
+    rng = np.random.default_rng(seed)
+    t0 = 1_753_000_200
+    assert t0 % 900 == 0
+    levels = 20 + rng.random(n_symbols) * 100
+    closes = np.zeros((n_ticks, n_symbols))
+    # base: per-symbol sine oscillation ±0.25% + tiny noise; BTC flat-ish
+    phase = rng.random(n_symbols) * 2 * np.pi
+    for i in range(n_symbols):
+        wave = 0.0025 * np.sin(2 * np.pi * np.arange(n_ticks) / 16.0 + phase[i])
+        noise = rng.normal(0, 0.0006, n_ticks).cumsum() * 0.2
+        closes[:, i] = levels[i] * (1 + wave + noise)
+    last = n_ticks - 1
+
+    # S002 BuyTheDip: drop over [last-22, last-16], flat base, green pop
+    s = 2
+    base = closes[last - 30, s]
+    for k, t in enumerate(range(last - 22, last - 16)):
+        closes[t, s] = base * (1 - 0.007 * (k + 1))
+    shelf = base * (1 - 0.042)
+    for t in range(last - 16, last):
+        closes[t, s] = shelf * (1 + rng.normal(0, 0.0003))
+    closes[last, s] = shelf * 1.011  # reclaim: > prev close and > EMA20
+
+    # S003 BBX: two hard red bars to below the lower band
+    s = 3
+    lvl = closes[last - 2, s]
+    closes[last - 1, s] = lvl * 0.975
+    closes[last, s] = lvl * 0.950
+
+    # S004 RBR: choppy bleed then hammer (bar shapes set below)
+    s = 4
+    lvl = closes[last - 20, s]
+    px_s4 = lvl
+    for k, t in enumerate(range(last - 19, last)):
+        px_s4 *= (1 - 0.0035) if k % 2 == 0 else (1 + 0.0015)
+        closes[t, s] = px_s4
+    closes[last, s] = closes[last - 1, s] * 0.988  # green close, set shapes below
+
+    def bar(symbol, ts_s, interval_s, o, h, low, c, volume):
+        return json.dumps(
+            {
+                "symbol": symbol,
+                "open_time": ts_s * 1000,
+                "close_time": (ts_s + interval_s) * 1000 - 1,
+                "open": round(float(o), 6),
+                "high": round(float(h), 6),
+                "low": round(float(low), 6),
+                "close": round(float(c), 6),
+                "volume": round(float(volume), 3),
+                "quote_asset_volume": round(float(volume * c), 3),
+                "number_of_trades": 300,
+                "taker_buy_base_volume": round(float(volume / 2), 3),
+                "taker_buy_quote_volume": round(float(volume * c / 2), 3),
+            }
+        ) + "\n"
+
+    with open(path, "w") as f:
+        for tick in range(n_ticks):
+            ts15 = t0 + tick * 900
+            for i in range(n_symbols):
+                symbol = "BTCUSDT" if i == 0 else f"S{i:03d}USDT"
+                c = closes[tick, i]
+                o = closes[tick - 1, i] if tick else c
+                h, low = max(o, c) * 1.001, min(o, c) * 0.999
+                vol15 = abs(rng.normal(1000, 150))
+                if i == 4 and last - 19 <= tick < last and (tick - (last - 19)) % 2 == 1:
+                    # RBR bleed's up-bars carry tall high wicks: +DM then
+                    # balances the down-bars' −DM so the rolling-sum ADX
+                    # stays under the 32 veto while closes still bleed
+                    # (RSI ≤ 35) — the shape the strategy hunts: choppy
+                    # range, not a trend
+                    h = max(o, c) * 1.0075
+                if tick == n_ticks - 1 and i == 4:
+                    # RBR hammer: gap down, deep low poke through −2σ,
+                    # green close near the candle high
+                    o = closes[tick - 1, i] * 0.986
+                    low = o * 0.9875
+                    h = c * 1.0008
+                if tick == n_ticks - 1 and i == 2:
+                    # BTD reclaim bar: clean green, modest wicks
+                    h, low = c * 1.0005, o * 0.9995
+                f.write(bar(symbol, ts15, 900, o, h, low, c, vol15))
+                # three 5m sub-bars splitting the 15m move (both buffers
+                # must fill for MIN_BARS gates)
+                sub_o = o
+                for j in range(3):
+                    frac = (j + 1) / 3
+                    sub_c = o + (c - o) * frac
+                    sh, sl = max(sub_o, sub_c) * 1.0005, min(sub_o, sub_c) * 0.9995
+                    f.write(bar(symbol, ts15 + j * 300, 300, sub_o, sh, sl, sub_c, vol15 / 3))
+                    sub_o = sub_c
+
+
+def generate_dormant_extended_replay(
+    path: str | Path,
+    n_symbols: int = 24,
+    n_ticks: int = 130,
+    seed: int = 31,
+) -> None:
+    """Scenario for the EXTENDED dormant oracle set (twap sniper,
+    supertrend swing reversal, buy-low-sell-high, inverse price tracker,
+    RS reversal range):
+
+    * most symbols drift mildly up (advancers-heavy TRANSITIONAL/TREND_UP
+      market for IPT's routing; BTC drifts with them);
+    * S005 — a strong 15m rally with wide bars (supertrend pinned up,
+      micro TREND_UP), then six final ticks of tiny red 5m sub-bars: the
+      5m RSI/MFI pin low with MACD negative while the supertrend band
+      holds — SupertrendSwingReversal and InversePriceTracker arm at tick
+      last-1 (with rising scripted breadth + LOSERS dominance for STS);
+    * S006 — an early +15% rally then a slow −0.15%/bar bleed: RSI(14)
+      pins ~0 while price stays above MA25 (BuyLowSellHigh with scripted
+      domination reversal) and under its 80-bar TWAP (TwapMomentumSniper);
+    * final tick — half the universe (incl. BTC) drops ~5% while S007
+      pumps +3.5%: a broad RANGE selloff with an RS leader
+      (RelativeStrengthReversalRange).
+    """
+    rng = np.random.default_rng(seed)
+    t0 = 1_753_000_200
+    assert t0 % 900 == 0
+    levels = 20 + rng.random(n_symbols) * 100
+    closes = np.zeros((n_ticks, n_symbols))
+    for i in range(n_symbols):
+        drift = 0.0012 * np.arange(n_ticks)  # mild up-drift (advancers-heavy)
+        noise = rng.normal(0, 0.0008, n_ticks).cumsum() * 0.3
+        closes[:, i] = levels[i] * (1 + drift + noise)
+    last = n_ticks - 1
+
+    # S005: strong rally, then a tiny-red-5m-sub-bar fade over the final
+    # six ticks (the fade is shaped in the sub-bar writer below)
+    s = 5
+    closes[:, s] = levels[s] * (1 + 0.003 * np.arange(n_ticks))
+    fade_start = last - 5
+    peak = closes[fade_start - 1, s]
+    for k, t in enumerate(range(fade_start, last + 1)):
+        closes[t, s] = peak * (1 - 0.0012 * (k + 1))
+
+    # S006: flat base → STEEP 14-bar rally (+2%/bar) ending 18 bars before
+    # the end → 17-bar slow bleed. The 25-bar MA window then spans the
+    # rally's low prices, so the bleed's close stays ABOVE ma25 while the
+    # all-red last 14 bars pin RSI(14) at 0 — the BLSH transient.
+    s = 6
+    rally_end = last - 17
+    rally_len = 14
+    base = levels[s]
+    # gently rising base — a perfectly flat price makes twap == price, an
+    # f32-vs-f64 knife edge the A/B comparison can land on either side of
+    closes[: rally_end - rally_len, s] = base * (
+        1 + 0.0004 * np.arange(rally_end - rally_len)
+    )
+    base = closes[rally_end - rally_len - 1, s]
+    for k, t in enumerate(range(rally_end - rally_len, rally_end)):
+        closes[t, s] = base * (1.02 ** (k + 1))
+    top = closes[rally_end - 1, s]
+    for k, t in enumerate(range(rally_end, last + 1)):
+        closes[t, s] = top * (1 - 0.0015 * (k + 1))
+
+    # final-tick broad selloff with an RS leader
+    droppers = [0] + list(range(8, 18))  # BTC + ten others
+    for i in droppers:
+        closes[last, i] = closes[last - 1, i] * 0.948
+    closes[last, 7] = closes[last - 1, 7] * 1.035  # S007: the leader
+
+    def bar(symbol, ts_s, interval_s, o, h, low, c, volume, trades=300.0):
+        return json.dumps(
+            {
+                "symbol": symbol,
+                "open_time": ts_s * 1000,
+                "close_time": (ts_s + interval_s) * 1000 - 1,
+                "open": round(float(o), 6),
+                "high": round(float(h), 6),
+                "low": round(float(low), 6),
+                "close": round(float(c), 6),
+                "volume": round(float(volume), 3),
+                "quote_asset_volume": round(float(volume * c), 3),
+                "number_of_trades": trades,
+                "taker_buy_base_volume": round(float(volume / 2), 3),
+                "taker_buy_quote_volume": round(float(volume * c / 2), 3),
+            }
+        ) + "\n"
+
+    with open(path, "w") as f:
+        for tick in range(n_ticks):
+            ts15 = t0 + tick * 900
+            for i in range(n_symbols):
+                symbol = "BTCUSDT" if i == 0 else f"S{i:03d}USDT"
+                c = closes[tick, i]
+                o = closes[tick - 1, i] if tick else c
+                vol15 = abs(rng.normal(1000, 150))
+                if i == 5 and tick < fade_start:
+                    # wide rally bars keep the supertrend band ~1.5% below
+                    h, low = max(o, c) * 1.005, min(o, c) * 0.995
+                else:
+                    h, low = max(o, c) * 1.001, min(o, c) * 0.999
+                f.write(bar(symbol, ts15, 900, o, h, low, c, vol15))
+                sub_o = o
+                for j in range(3):
+                    frac = (j + 1) / 3
+                    sub_c = o + (c - o) * frac
+                    sh, sl = max(sub_o, sub_c) * 1.0005, min(sub_o, sub_c) * 0.9995
+                    f.write(
+                        bar(symbol, ts15 + j * 300, 300, sub_o, sh, sl,
+                            sub_c, vol15 / 3)
+                    )
+                    sub_o = sub_c
+            # the fade's sub-bars are strictly monotone red by construction
+            # (each 15m fade bar splits into three falling sub-bars above)
+    return None
 
 
 def generate_replay_file(

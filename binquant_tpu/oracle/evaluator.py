@@ -44,6 +44,30 @@ LIVE_STRATEGIES = (
     "grid_ladder",
 )
 
+# Dormant strategies with an independent oracle (VERDICT r2 item 6: the
+# ones whose inline indicator variants — rolling-sum ADX, Connors RSI(2),
+# 6h-dip reference — carry the highest formula-drift risk). A/B'd via the
+# ``enabled_strategies`` override in run_replay_ab.
+DORMANT_ORACLE_STRATEGIES = (
+    "coinrule_buy_the_dip",
+    "bb_extreme_reversion",
+    "range_bb_rsi_mean_reversion",
+)
+
+# Remaining dormant set with oracle coverage (round 3 extension): the
+# coinrule rules, InversePriceTracker, and RelativeStrengthReversalRange.
+# RangeFailedBreakoutFade is the one dormant kernel WITHOUT an oracle —
+# it rides the ~30-feature SpikeHunter detector, whose pandas mirror is a
+# project of its own; its gate layer is covered by the device-side matrix
+# tests instead (tests/test_strategies_dormant_gates.py).
+DORMANT_ORACLE_EXTENDED = (
+    "coinrule_twap_momentum_sniper",
+    "coinrule_supertrend_swing_reversal",
+    "coinrule_buy_low_sell_high",
+    "inverse_price_tracker",
+    "relative_strength_reversal_range",
+)
+
 
 def _nz(x: float, default: float = 0.0) -> float:
     return float(x) if math.isfinite(float(x)) else default
@@ -336,6 +360,7 @@ class OracleEvaluator:
         required_fresh_symbols: int = 40,
         min_coverage_ratio: float = 0.70,
         is_futures: bool = True,
+        enabled_strategies: set[str] | frozenset[str] | tuple | None = None,
     ) -> None:
         self.store5 = FrameStore(window)
         self.store15 = FrameStore(window)
@@ -343,6 +368,9 @@ class OracleEvaluator:
         self.required_fresh = required_fresh_symbols
         self.min_coverage = min_coverage_ratio
         self.is_futures = is_futures
+        self.enabled = frozenset(
+            LIVE_STRATEGIES if enabled_strategies is None else enabled_strategies
+        )
         # regime carry: previous (strictly older ts) + stage (current ts)
         self._prev_market: tuple[int, tuple, int] | None = None  # regime, scores, since
         self._prev_micro: dict[str, tuple[int, float]] = {}
@@ -843,6 +871,427 @@ class OracleEvaluator:
             return None
         return True, True
 
+    # -- dormant-set oracles (VERDICT r2 item 6) ---------------------------
+
+    def _btd(
+        self, sym: str, ctx: OracleContext, quiet: bool
+    ) -> tuple[bool, bool] | None:
+        """coinrule/buy_the_dip.py: −2..−5% dip over 24×15m bars + reclaim
+        of prev close AND EMA20; trend regimes blocked; RANGE/TRANSITIONAL
+        autotrade."""
+        df = self.store15.frames[sym]
+        lookback = 24
+        if len(df) <= lookback:
+            return None
+        close = df["close"]
+        current = float(close.iloc[-1])
+        reference = float(close.iloc[-1 - lookback])
+        if not math.isfinite(reference) or reference == 0:
+            return None
+        change_6h = (current - reference) / abs(reference) * 100.0
+        if not (-5.0 < change_6h <= -2.0):
+            return None
+        ema20 = float(close.ewm(span=20, adjust=False, min_periods=1).mean().iloc[-1])
+        prev_close = float(close.iloc[-2])
+        if not (current > prev_close and current > ema20):
+            return None
+        f = ctx.features.get(sym)
+        R, M = MarketRegimeCode, MicroRegimeCode
+        market_trend_blocked = ctx.valid and ctx.market_regime in (
+            int(R.TREND_DOWN), int(R.TREND_UP),
+        )
+        symbol_trend_blocked = (
+            f is not None
+            and f.valid
+            and f.micro_regime in (int(M.TREND_DOWN), int(M.TREND_UP))
+        )
+        if market_trend_blocked or symbol_trend_blocked:
+            return None
+        market_rt = ctx.market_regime in (int(R.RANGE), int(R.TRANSITIONAL))
+        if f is not None and f.valid:
+            micro_blocked = f.micro_regime in (
+                int(M.TREND_DOWN), int(M.TREND_UP), int(M.VOLATILE),
+            )
+            micro_ok = (
+                not micro_blocked
+                and f.micro_regime in (int(M.RANGE), int(M.TRANSITIONAL))
+            )
+        else:
+            micro_ok = True
+        autotrade = (
+            ctx.valid
+            and not ctx.regime_is_transitioning
+            and ctx.market_stress_score < 0.35
+            and market_rt
+            and micro_ok
+            and not quiet
+        )
+        return True, autotrade
+
+    def _bbx(self, sym: str, ctx: OracleContext) -> tuple[bool, bool, int] | None:
+        """coinrule/bb_extreme_reversion.py: Connors RSI(2) ≤5/≥95 at or
+        beyond the Bollinger bands; direction-specific routing."""
+        df = self.store15.frames[sym]
+        close = df["close"]
+        delta = close.diff()
+        gain = delta.clip(lower=0).rolling(2, min_periods=2).mean().iloc[-1]
+        loss = (-delta).clip(lower=0).rolling(2, min_periods=2).mean().iloc[-1]
+        if not (math.isfinite(_nz(gain, np.nan)) and math.isfinite(_nz(loss, np.nan))):
+            return None
+        if loss == 0:
+            if gain == 0:
+                return None  # flat: RSI undefined (device: NaN)
+            rsi2 = 100.0
+        else:
+            rsi2 = clamp(100.0 - 100.0 / (1.0 + gain / loss), 0.0, 100.0)
+        mid = close.rolling(20).mean().iloc[-1]
+        std = close.rolling(20).std(ddof=0).iloc[-1]
+        if not (math.isfinite(_nz(mid, np.nan)) and math.isfinite(_nz(std, np.nan))):
+            return None
+        bb_upper, bb_lower = mid + 2 * std, mid - 2 * std
+        span = bb_upper - bb_lower
+        if not span > 0:
+            return None
+        price = float(close.iloc[-1])
+        band_position = (price - bb_lower) / span
+        buy = rsi2 <= 5.0 and band_position <= 0.0
+        sell = rsi2 >= 95.0 and band_position >= 1.0
+        if not (buy or sell):
+            return None
+        f = ctx.features.get(sym)
+        M, T = MicroRegimeCode, MicroTransitionCode
+        base_ok = (
+            ctx.valid
+            and not ctx.regime_is_transitioning
+            and ctx.market_stress_score < 0.35
+            and ctx.market_regime == int(MarketRegimeCode.RANGE)
+        )
+        directional_ok = False
+        if f is not None and f.valid:
+            trans_blocked = f.micro_transition in (
+                int(T.VOLATILITY_EXPANSION), int(T.BREAKDOWN),
+                int(T.ENTERED_TRANSITIONAL),
+            )
+            if sell:
+                direction_micro_ok = f.micro_regime in (
+                    int(M.RANGE), int(M.TRANSITIONAL), int(M.TREND_DOWN),
+                )
+            else:
+                direction_micro_ok = f.micro_regime != int(M.TREND_DOWN)
+            directional_ok = (
+                not trans_blocked
+                and f.micro_strength >= 0.5
+                and direction_micro_ok
+            )
+        direction = int(Direction.SHORT) if sell else int(Direction.LONG)
+        return True, base_ok and directional_ok, direction
+
+    def _rbr(self, sym: str, ctx: OracleContext) -> tuple[bool, bool, int] | None:
+        """range_bb_rsi_mean_reversion.py: RANGE×RANGE fade — rolling-sum
+        ADX<32 veto, ±2σ z-score, wick-rejection candle filters."""
+        f = ctx.features.get(sym)
+        M, T = MicroRegimeCode, MicroTransitionCode
+        if not (
+            ctx.valid
+            and ctx.market_stress_score < 0.35
+            and ctx.market_regime == int(MarketRegimeCode.RANGE)
+            and f is not None
+            and f.valid
+            and f.micro_regime == int(M.RANGE)
+            and f.micro_transition
+            not in (
+                int(T.BREAKOUT_UP), int(T.BREAKDOWN), int(T.VOLATILITY_EXPANSION),
+            )
+            and f.atr_pct <= 0.04
+            and f.bb_width <= 0.08
+        ):
+            return None
+        df = self.store15.frames[sym]
+        if len(df) < 40:
+            return None
+        close, high, low, open_ = df["close"], df["high"], df["low"], df["open"]
+        # simple-rolling-mean RSI(14) (the Indicators.rsi column variant)
+        delta = close.diff()
+        avg_gain = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        avg_loss = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        if not (
+            math.isfinite(_nz(avg_gain, np.nan)) and math.isfinite(_nz(avg_loss, np.nan))
+        ):
+            return None
+        denom = avg_gain + avg_loss
+        rsi = 100.0 * avg_gain / denom if denom != 0 else 50.0
+        # inline rolling-SUM ADX (NOT Wilder EWM; reference l.101-128).
+        # sdiv mirrors the device's jsafe_div: 0 where the denominator is
+        # exactly 0, NaN propagation elsewhere.
+        def sdiv(a, b):
+            a, b = np.asarray(a, float), np.asarray(b, float)
+            ok = b != 0
+            with np.errstate(invalid="ignore", divide="ignore"):
+                return np.where(ok, a / np.where(ok, b, 1.0), 0.0)
+
+        hd = high.diff()
+        ld = low.shift(1) - low
+        plus_dm = hd.where((hd > ld) & (hd > 0), 0.0).fillna(0.0)
+        minus_dm = ld.where((ld > hd) & (ld > 0), 0.0).fillna(0.0)
+        pc = close.shift(1)
+        tr = pd.concat(
+            [high - low, (high - pc).abs(), (low - pc).abs()], axis=1
+        ).max(axis=1)
+        tr = tr.where(pc.notna(), high - low)
+        atr_sum = tr.rolling(14).sum().to_numpy()
+        plus_di = 100.0 * sdiv(plus_dm.rolling(14).sum().to_numpy(), atr_sum)
+        minus_di = 100.0 * sdiv(minus_dm.rolling(14).sum().to_numpy(), atr_sum)
+        di_total = plus_di + minus_di
+        with np.errstate(invalid="ignore"):
+            dx = np.where(
+                di_total != 0, 100.0 * sdiv(np.abs(plus_di - minus_di), di_total), 0.0
+            )
+        dx = np.where(np.isfinite(atr_sum), dx, np.nan)
+        adx = _nz(pd.Series(dx).rolling(14).mean().iloc[-1], 100.0)
+        if adx > 32.0:
+            return None
+        mean = close.rolling(20).mean().iloc[-1]
+        std = close.rolling(20).std(ddof=0).iloc[-1]
+        if math.isfinite(_nz(std, np.nan)) and std > 0:
+            z = (float(close.iloc[-1]) - mean) / std
+        else:
+            z = 0.0
+        mid = mean
+        bb_std = std
+        if not (math.isfinite(_nz(mid, np.nan)) and math.isfinite(_nz(bb_std, np.nan))):
+            return None
+        bb_upper, bb_lower = mid + 2 * bb_std, mid - 2 * bb_std
+        c, o = float(close.iloc[-1]), float(open_.iloc[-1])
+        h, lo_ = float(high.iloc[-1]), float(low.iloc[-1])
+        candle_range = h - lo_
+        if not candle_range > 0:
+            return None
+        lower_wick = min(o, c) - lo_
+        upper_wick = h - max(o, c)
+        close_position = (c - lo_) / candle_range
+        bullish_rej = (
+            lo_ <= bb_lower * 1.002
+            and c > o
+            and lower_wick / candle_range >= 0.30
+            and close_position >= 0.55
+        )
+        bearish_rej = (
+            h >= bb_upper * (1.0 - 0.002)
+            and c < o
+            and upper_wick / candle_range >= 0.30
+            and close_position <= 0.45
+        )
+        long_setup = c <= mid and rsi <= 35.0 and z <= -2.0 and bullish_rej
+        short_setup = c >= mid and rsi >= 65.0 and z >= 2.0 and bearish_rej
+        if not (long_setup or short_setup):
+            return None
+        direction = int(Direction.SHORT) if short_setup else int(Direction.LONG)
+        return True, True, direction
+
+    def _twap(self, sym: str) -> tuple[bool, bool] | None:
+        """coinrule/twap_momentum_sniper: TWAP(20 trailing 1h blocks) >
+        price, no sharp selloff. Mirrors the device's trailing-4-bar block
+        resample (documented divergence from the reference's calendar
+        alignment — strategies/dormant.py:54-94)."""
+        df15 = self.store15.frames[sym]
+        df5 = self.store5.frames.get(sym)
+        if df5 is None or len(df5) < 10 or len(df15) < 8:
+            return None
+        n = len(df15) - len(df15) % 4
+        if n < 8:
+            return None
+        tail = df15.tail(n)
+        o = tail["open"].to_numpy().reshape(-1, 4)
+        h = tail["high"].to_numpy().reshape(-1, 4)
+        lo = tail["low"].to_numpy().reshape(-1, 4)
+        c = tail["close"].to_numpy().reshape(-1, 4)
+        bar_avg = (o[:, 0] + h.max(axis=1) + lo.min(axis=1) + c[:, -1]) / 4.0
+        twap = float(bar_avg[-20:].mean())
+        close_1h = c[:, -1]
+        price = float(df5["close"].iloc[-1])
+        # "price_decrease" exactly as written in the reference (l.68-70)
+        price_decrease = close_1h[-1] - close_1h[-2] / close_1h[-1]
+        if not (twap > price and price_decrease > -0.05):
+            return None
+        return True, False  # manual_only
+
+    def _sts(
+        self,
+        sym: str,
+        ctx: OracleContext,
+        adp_diff: float,
+        adp_diff_prev: float,
+        dominance_is_losers: bool,
+    ) -> tuple[bool, bool] | None:
+        """coinrule/supertrend_swing_reversal: supertrend(10,3) uptrend ∧
+        RSI(14)<30 ∧ trades>5 ∧ rising ADP twice ∧ LOSERS dominance."""
+        if not (
+            math.isfinite(adp_diff)
+            and math.isfinite(adp_diff_prev)
+            and adp_diff > 0
+            and adp_diff_prev > 0
+            and dominance_is_losers
+        ):
+            return None
+        df = self.store5.frames[sym]
+        close, high, low = df["close"], df["high"], df["low"]
+        # simple-rolling-mean RSI(14) (pack5.rsi variant)
+        delta = close.diff()
+        ag = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        al = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        if not (math.isfinite(_nz(ag, np.nan)) and math.isfinite(_nz(al, np.nan))):
+            return None
+        denom = ag + al
+        rsi = 100.0 * ag / denom if denom != 0 else 50.0
+        trades = float(df["number_of_trades"].iloc[-1])
+        if not (rsi < 30.0 and trades > 5):
+            return None
+        # supertrend(10,3): Wilder ATR + band ratchet + flip state,
+        # sequential — mirrors ops/indicators.supertrend exactly
+        pc = close.shift(1)
+        tr = pd.concat(
+            [high - low, (high - pc).abs(), (low - pc).abs()], axis=1
+        ).max(axis=1)
+        tr = tr.where(pc.notna(), high - low)
+        atr = tr.ewm(alpha=1.0 / 10, adjust=False, min_periods=10).mean()
+        hl2 = (high + low) / 2.0
+        upper = (hl2 + 3.0 * atr).to_numpy()
+        lower = (hl2 - 3.0 * atr).to_numpy()
+        closes = close.to_numpy()
+        fu, fl, d, prev_close = np.inf, -np.inf, 1.0, 0.0
+        for ub, lb, cl in zip(upper, lower, closes):
+            ub = ub if math.isfinite(ub) else np.inf
+            lb = lb if math.isfinite(lb) else -np.inf
+            fu = ub if (ub < fu or prev_close > fu) else fu
+            fl = lb if (lb > fl or prev_close < fl) else fl
+            d = 1.0 if cl > fu else (-1.0 if cl < fl else d)
+            prev_close = cl
+        st_up = math.isfinite(float(atr.iloc[-1])) and d > 0
+        if not st_up:
+            return None
+        # autotrade via the standard long gate; an invalid context passes
+        # (device: jnp.where(context.valid, long_gate, True))
+        autotrade = _allows_long_autotrade(ctx, sym) if ctx.valid else True
+        return True, autotrade
+
+    def _blsh(
+        self, sym: str, market_domination_reversal: bool
+    ) -> tuple[bool, bool] | None:
+        """coinrule/buy_low_sell_high: RSI(14)<35 ∧ price>MA25 ∧
+        domination reversal; telemetry-only."""
+        if not market_domination_reversal:
+            return None
+        df = self.store15.frames[sym]
+        close = df["close"]
+        delta = close.diff()
+        ag = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        al = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        if not (math.isfinite(_nz(ag, np.nan)) and math.isfinite(_nz(al, np.nan))):
+            return None
+        denom = ag + al
+        rsi = 100.0 * ag / denom if denom != 0 else 50.0
+        ma25 = float(close.rolling(25, min_periods=1).mean().iloc[-1])
+        if not (rsi < 35.0 and float(close.iloc[-1]) > ma25):
+            return None
+        return True, False  # manual_only
+
+    def _ipt(self, sym: str, ctx: OracleContext) -> tuple[bool, bool] | None:
+        """inverse_price_tracker: PriceTracker's oversold trio routed to
+        TREND_UP / bullish-TRANSITIONAL / RANGE-leader markets;
+        telemetry-only."""
+        df = self.store5.frames[sym]
+        close = df["close"]
+        if len(df) < 30:
+            return None
+        delta = close.diff()
+        ag = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        al = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+        if not (math.isfinite(_nz(ag, np.nan)) and math.isfinite(_nz(al, np.nan))):
+            return None
+        denom = ag + al
+        rsi = 100.0 * ag / denom if denom != 0 else 50.0
+        macd = float(
+            (
+                close.ewm(span=12, adjust=False, min_periods=1).mean()
+                - close.ewm(span=26, adjust=False, min_periods=1).mean()
+            ).iloc[-1]
+        )
+        tp = (df["high"] + df["low"] + df["close"]) / 3.0
+        flow = tp * df["volume"]
+        tp_delta = tp.diff()
+        last14 = tp_delta.tail(14)
+        if last14.isna().any() or len(last14) < 14:
+            return None
+        pos = float(flow.tail(14)[last14 > 0].sum())
+        neg = float(flow.tail(14)[last14 < 0].sum())
+        total = pos + neg
+        mfi = 100.0 * pos / total if total != 0 else 50.0
+        if not (rsi < 30.0 and macd < 0.0 and mfi < 20.0):
+            return None
+        f = ctx.features.get(sym)
+        M, R = MicroRegimeCode, MarketRegimeCode
+        if not (ctx.valid and ctx.market_stress_score < 0.35 and f and f.valid):
+            return None
+        bullish_transitional_symbol = (
+            f.micro_regime == int(M.TRANSITIONAL)
+            and f.trend_score > 0
+            and f.above_ema20
+            and f.relative_strength_vs_btc >= 0
+        )
+        range_leader = (
+            f.micro_regime in (int(M.TREND_UP), int(M.TRANSITIONAL))
+            and f.trend_score > 0
+            and f.relative_strength_vs_btc >= 0.05
+        )
+        symbol_ok = f.micro_regime == int(M.TREND_UP) or bullish_transitional_symbol
+        routed = (
+            ctx.market_regime in (int(R.TREND_UP), int(R.TRANSITIONAL))
+            and symbol_ok
+        ) or (ctx.market_regime == int(R.RANGE) and range_leader)
+        if not routed:
+            return None
+        # telemetry gates (confidence 0.4 / followthrough -0.1 / risk 0.65)
+        ema9 = float(close.ewm(span=9, adjust=False, min_periods=1).mean().iloc[-1])
+        ema21 = float(close.ewm(span=21, adjust=False, min_periods=1).mean().iloc[-1])
+        trend = (ema9 - ema21) / abs(ema21) if ema21 != 0 else 0.0
+        cs = _context_score(
+            ctx, is_short=False,
+            symbol_rs=f.relative_strength_vs_btc, symbol_trend=trend,
+        )
+        if not (
+            cs["confidence"] >= 0.4
+            and cs["followthrough"] >= -0.1
+            and cs["risk"] <= 0.65
+        ):
+            return None
+        return True, False  # telemetry-only
+
+    def _rsr(self, sym: str, ctx: OracleContext) -> tuple[bool, bool] | None:
+        """relative_strength_reversal_range: contrarian long on an RS
+        leader during a broad RANGE selloff, volume above the 20th
+        percentile of the last 96 bars; telemetry-only."""
+        f = ctx.features.get(sym)
+        if not (
+            ctx.valid
+            and ctx.market_regime == int(MarketRegimeCode.RANGE)
+            and ctx.average_return < -0.02
+            and f is not None
+            and f.valid
+            and f.relative_strength_vs_btc > 0.05
+        ):
+            return None
+        df = self.store15.frames[sym]
+        if len(df) < 96:
+            return None
+        vol = df["volume"].tail(96).to_numpy(dtype=float)
+        finite = vol[np.isfinite(vol)]
+        if not len(finite):
+            return None
+        floor = float(np.quantile(finite, 0.20))
+        if not float(df["volume"].iloc[-1]) > floor:
+            return None
+        return True, False  # telemetry-only
+
     # -- the tick ----------------------------------------------------------
 
     def evaluate(
@@ -853,6 +1302,10 @@ class OracleEvaluator:
         oi_growth: dict[str, float] | None = None,
         adp_latest: float = float("nan"),
         adp_prev: float = float("nan"),
+        adp_diff: float = float("nan"),
+        adp_diff_prev: float = float("nan"),
+        dominance_is_losers: bool = False,
+        market_domination_reversal: bool = False,
     ) -> list[tuple[str, str, str, bool]]:
         """One tick; returns fired (strategy, symbol, direction, autotrade).
 
@@ -911,30 +1364,90 @@ class OracleEvaluator:
             self.last_emitted[key] = bar_ts
             fired.append((strategy, sym, direction, autotrade))
 
-        for sym in sorted(fresh5):
-            r = self._abp(sym, ctx)
-            if r:
-                emit("activity_burst_pump", sym, "LONG", r[1], ts5)
-        for sym in sorted(fresh5):
-            r = self._pt(sym, ctx, quiet)
-            if r:
-                emit("coinrule_price_tracker", sym, "LONG", r[1], ts5)
-        for sym in sorted(fresh15):
-            r = self._lsp(
-                sym, ctx, oi.get(sym, float("nan")), adp_latest, adp_prev,
-                btc_momentum,
-            )
-            if r:
-                emit(
-                    "liquidation_sweep_pump", sym,
-                    Direction(r[2]).name, r[1], ts15,
+        if "activity_burst_pump" in self.enabled:
+            for sym in sorted(fresh5):
+                r = self._abp(sym, ctx)
+                if r:
+                    emit("activity_burst_pump", sym, "LONG", r[1], ts5)
+        if "coinrule_price_tracker" in self.enabled:
+            for sym in sorted(fresh5):
+                r = self._pt(sym, ctx, quiet)
+                if r:
+                    emit("coinrule_price_tracker", sym, "LONG", r[1], ts5)
+        if "liquidation_sweep_pump" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._lsp(
+                    sym, ctx, oi.get(sym, float("nan")), adp_latest, adp_prev,
+                    btc_momentum,
                 )
-        for sym in sorted(fresh15):
-            r = self._mrf(sym)
-            if r:
-                emit("mean_reversion_fade", sym, Direction(r[2]).name, r[1], ts15)
-        for sym in sorted(fresh15):
-            r = self._ladder(sym, ctx, grid_policy_allows)
-            if r:
-                emit("grid_ladder", sym, "grid", r[1], ts15)
+                if r:
+                    emit(
+                        "liquidation_sweep_pump", sym,
+                        Direction(r[2]).name, r[1], ts15,
+                    )
+        if "mean_reversion_fade" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._mrf(sym)
+                if r:
+                    emit("mean_reversion_fade", sym, Direction(r[2]).name, r[1], ts15)
+        if "grid_ladder" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._ladder(sym, ctx, grid_policy_allows)
+                if r:
+                    emit("grid_ladder", sym, "grid", r[1], ts15)
+        # dormant set (enabled_strategies override only)
+        if "coinrule_twap_momentum_sniper" in self.enabled:
+            for sym in sorted(fresh5):
+                r = self._twap(sym)
+                if r:
+                    emit("coinrule_twap_momentum_sniper", sym, "LONG", r[1], ts5)
+        if "coinrule_supertrend_swing_reversal" in self.enabled:
+            for sym in sorted(fresh5):
+                r = self._sts(
+                    sym, ctx, adp_diff, adp_diff_prev, dominance_is_losers
+                )
+                if r:
+                    emit(
+                        "coinrule_supertrend_swing_reversal", sym,
+                        "LONG", r[1], ts5,
+                    )
+        if "inverse_price_tracker" in self.enabled:
+            for sym in sorted(fresh5):
+                r = self._ipt(sym, ctx)
+                if r:
+                    emit("inverse_price_tracker", sym, "LONG", r[1], ts5)
+        if "coinrule_buy_low_sell_high" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._blsh(sym, market_domination_reversal)
+                if r:
+                    emit("coinrule_buy_low_sell_high", sym, "LONG", r[1], ts15)
+        if "relative_strength_reversal_range" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._rsr(sym, ctx)
+                if r:
+                    emit(
+                        "relative_strength_reversal_range", sym,
+                        "LONG", r[1], ts15,
+                    )
+        if "coinrule_buy_the_dip" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._btd(sym, ctx, quiet)
+                if r:
+                    emit("coinrule_buy_the_dip", sym, "LONG", r[1], ts15)
+        if "bb_extreme_reversion" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._bbx(sym, ctx)
+                if r:
+                    emit(
+                        "bb_extreme_reversion", sym,
+                        Direction(r[2]).name, r[1], ts15,
+                    )
+        if "range_bb_rsi_mean_reversion" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._rbr(sym, ctx)
+                if r:
+                    emit(
+                        "range_bb_rsi_mean_reversion", sym,
+                        Direction(r[2]).name, r[1], ts15,
+                    )
         return fired

@@ -73,6 +73,10 @@ async def run_live() -> None:
         futures_api=futures_api,
         window=config.window_bars,
         btc_symbol=btc_symbol,
+        # live loop runs pipelined: dispatch tick i, emit tick i-1 whose
+        # wire landed during the idle second — the production shape the
+        # p99 < 50 ms budget is measured against
+        pipeline_depth=config.pipeline_depth,
     )
 
     # Resume from the last snapshot if one exists — restores the device
@@ -153,7 +157,12 @@ async def run_live() -> None:
         ),
     )
     logging.info("binquant_tpu started: %d symbols tracked", len(all_symbols))
-    await engine.consume_loop(queue)
+    # OI refresh rides a background task (bounded-concurrency REST sweeps
+    # amortized across the bucket); the tick path only reads its cache
+    await asyncio.gather(
+        engine.consume_loop(queue),
+        engine.oi_cache.refresh_forever(lambda: engine.registry.names),
+    )
 
 
 def main() -> int:

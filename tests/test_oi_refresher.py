@@ -1,0 +1,134 @@
+"""Open-interest retrieval off the tick path (VERDICT round-2 item 4).
+
+The reference fetches OI inline per message with a 5 s TTL
+(``consumers/klines_provider.py:252-276``); the batched engine would turn
+that into up-to-N serial REST round trips inside ``process_tick`` at a 15m
+boundary. Round 3 moves the traffic to ``OpenInterestCache.refresh_forever``
+(background task, bounded concurrency); the tick path is cache-read only.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from binquant_tpu.engine.buffer import NUM_FIELDS
+from binquant_tpu.io.pipeline import OpenInterestCache
+from binquant_tpu.io.replay import make_stub_engine
+
+
+class CountingFuturesApi:
+    """Counts get_open_interest calls; returns a scripted growing OI."""
+
+    def __init__(self) -> None:
+        self.calls: list[str] = []
+        self.oi: dict[str, float] = {}
+
+    def get_open_interest(self, symbol: str) -> float:
+        self.calls.append(symbol)
+        value = self.oi.get(symbol, 100.0) * 1.05
+        self.oi[symbol] = value
+        return value
+
+
+def test_tick_at_boundary_makes_zero_rest_calls():
+    """A tick with 500 fresh 15m symbols must not touch the network —
+    the VERDICT item-4 acceptance criterion."""
+    n = 500
+    engine = make_stub_engine(capacity=512, window=40)
+    api = CountingFuturesApi()
+    engine.oi_cache = OpenInterestCache(api)
+
+    names = [f"S{i:03d}USDTM" for i in range(n)]
+    rows = engine.registry.rows_for(names)
+    ts = 1_753_000_200  # 900-aligned
+    vals = np.zeros((n, NUM_FIELDS), dtype=np.float32)
+    vals[:, 0:4] = 10.0
+    vals[:, 4] = 100.0
+    vals[:, 9] = 900.0
+    engine.batcher15.add_batch(rows, np.full(n, ts, np.int32), vals)
+
+    asyncio.run(engine.process_tick(now_ms=(ts + 900) * 1000))
+    assert api.calls == []  # zero blocking REST on the tick path
+
+
+def test_growth_requires_two_background_samples():
+    api = CountingFuturesApi()
+    # horizon 0: growth vs the previous sample (test-visible degenerate)
+    cache = OpenInterestCache(api, growth_horizon_s=0.0)
+    assert np.isnan(cache.growth("AUSDTM"))
+    asyncio.run(cache.refresh_batch(["AUSDTM"]))
+    assert np.isnan(cache.growth("AUSDTM"))  # one sample: no baseline yet
+    asyncio.run(cache.refresh_batch(["AUSDTM"]))
+    assert cache.growth("AUSDTM") == pytest.approx(1.05)
+    assert cache.requests_made == 2
+
+
+def test_growth_horizon_matches_reference_cadence(monkeypatch):
+    """Growth must be measured against a ~15-minute-old baseline (the
+    reference's previous-fresh-candle cadence), NOT sweep-to-sweep —
+    a ~50 s ratio would never clear LSP's >=1.02 confirmation gate."""
+    import binquant_tpu.io.pipeline as pipeline_mod
+
+    api = CountingFuturesApi()
+    cache = OpenInterestCache(api, growth_horizon_s=900.0)
+    fake_now = [0.0]
+    monkeypatch.setattr(pipeline_mod.time, "monotonic", lambda: fake_now[0])
+
+    # sweeps every 50 s: growth stays NaN until a >=900 s-old baseline
+    for i in range(18):  # 0..850 s
+        asyncio.run(cache.refresh_batch(["XUSDTM"]))
+        assert np.isnan(cache.growth("XUSDTM")), f"sweep {i}"
+        fake_now[0] += 50.0
+    fake_now[0] = 900.0
+    asyncio.run(cache.refresh_batch(["XUSDTM"]))  # baseline: the t=0 sample
+    # 19 samples at +5% each → ratio vs 18-samples-older baseline
+    assert cache.growth("XUSDTM") == pytest.approx(1.05**18)
+
+
+def test_refresh_batch_bounded_concurrency_and_error_isolation():
+    class FlakyApi(CountingFuturesApi):
+        def get_open_interest(self, symbol: str) -> float:
+            if symbol == "BAD":
+                raise RuntimeError("exchange 500")
+            return super().get_open_interest(symbol)
+
+    api = FlakyApi()
+    cache = OpenInterestCache(api, max_concurrency=4, growth_horizon_s=0.0)
+    symbols = [f"S{i}" for i in range(16)] + ["BAD"]
+    asyncio.run(cache.refresh_batch(symbols))
+    asyncio.run(cache.refresh_batch(symbols))
+    assert cache.growth("S0") == pytest.approx(1.05)
+    assert np.isnan(cache.growth("BAD"))  # failure isolated, others fine
+
+
+def test_refresh_forever_rotates_through_the_universe():
+    api = CountingFuturesApi()
+    cache = OpenInterestCache(api, batch_size=3, batch_interval_s=0.0)
+    names = [f"S{i}" for i in range(7)]
+
+    async def run_cycles():
+        task = asyncio.create_task(cache.refresh_forever(lambda: names))
+        # 3 batches of 3 cover the 7-symbol universe with wraparound
+        while len(api.calls) < 9:
+            await asyncio.sleep(0.01)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(run_cycles())
+    assert set(api.calls[:9]) >= set(names)  # full sweep reached everyone
+
+
+def test_no_futures_api_is_inert():
+    cache = OpenInterestCache(None)
+    assert np.isnan(cache.growth("X"))
+    asyncio.run(cache.refresh_batch(["X"]))
+
+    async def immediate():
+        # refresh_forever returns immediately instead of looping
+        await asyncio.wait_for(cache.refresh_forever(lambda: ["X"]), 1.0)
+
+    asyncio.run(immediate())

@@ -1,0 +1,87 @@
+"""Record a live KuCoin websocket session into the test fixture format.
+
+Captures, for spot and futures: the full bullet-public response and the
+first N frames of a real candle subscription, writing
+``tests/fixtures/kucoin_session.json``. Run from a host WITH network
+egress; the checked-in fixture then pins the connector's protocol tests
+(tests/test_kucoin_session_fixture.py) to genuine wire shapes.
+
+    python tools/record_kucoin_session.py --frames 20 \
+        --spot BTC-USDT --futures XBTUSDTM
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from pathlib import Path
+
+FIXTURE = Path(__file__).parent.parent / "tests" / "fixtures" / "kucoin_session.json"
+
+SPOT_BULLET = "https://api.kucoin.com/api/v1/bullet-public"
+FUTURES_BULLET = "https://api-futures.kucoin.com/api/v1/bullet-public"
+
+
+async def record(market_type: str, symbol: str, n_frames: int) -> tuple[dict, list]:
+    import httpx
+    import websockets
+
+    bullet_url = FUTURES_BULLET if market_type == "futures" else SPOT_BULLET
+    bullet = httpx.post(bullet_url, timeout=10).json()
+    server = bullet["data"]["instanceServers"][0]
+    url = f"{server['endpoint']}?token={bullet['data']['token']}&connectId=rec0"
+    topic = (
+        f"/contractMarket/limitCandle:{symbol}_15min"
+        if market_type == "futures"
+        else f"/market/candles:{symbol}_15min"
+    )
+    frames: list = []
+    async with websockets.connect(url) as ws:
+        await ws.send(
+            json.dumps(
+                {
+                    "id": 1,
+                    "type": "subscribe",
+                    "topic": topic,
+                    "privateChannel": False,
+                    "response": True,
+                }
+            )
+        )
+        while len(frames) < n_frames:
+            raw = await asyncio.wait_for(ws.recv(), timeout=120)
+            frames.append(json.loads(raw))
+    return bullet, frames
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--frames", type=int, default=20)
+    parser.add_argument("--spot", default="BTC-USDT")
+    parser.add_argument("--futures", default="XBTUSDTM")
+    args = parser.parse_args()
+
+    spot_bullet, spot_frames = asyncio.run(
+        record("spot", args.spot, args.frames)
+    )
+    fut_bullet, fut_frames = asyncio.run(
+        record("futures", args.futures, args.frames)
+    )
+    FIXTURE.write_text(
+        json.dumps(
+            {
+                "_comment": "Recorded live KuCoin session (record_kucoin_session.py).",
+                "spot_bullet_response": spot_bullet,
+                "futures_bullet_response": fut_bullet,
+                "futures_frames": fut_frames,
+                "spot_frames": spot_frames,
+            },
+            indent=2,
+        )
+    )
+    print(f"wrote {FIXTURE}: {len(spot_frames)} spot + {len(fut_frames)} futures frames")
+
+
+if __name__ == "__main__":
+    main()

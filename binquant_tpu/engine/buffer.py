@@ -187,6 +187,9 @@ class SymbolRegistry:
         self._name_to_row: dict[str, int] = {}
         self._row_to_name: dict[int, str] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))  # pop() → lowest
+        # bumped on every membership change; lets callers cache derived
+        # arrays (e.g. the engine's device-resident tracked mask)
+        self.version = 0
 
     def __len__(self) -> int:
         return len(self._name_to_row)
@@ -217,6 +220,7 @@ class SymbolRegistry:
         row = self._free.pop()
         self._name_to_row[key] = row
         self._row_to_name[row] = key
+        self.version += 1
         return row
 
     def remove(self, symbol: str) -> int | None:
@@ -225,6 +229,7 @@ class SymbolRegistry:
         if row is not None:
             del self._row_to_name[row]
             self._free.append(row)
+            self.version += 1
         return row
 
     def rows_for(self, symbols: list[str], add_missing: bool = True) -> np.ndarray:
@@ -262,6 +267,7 @@ class SymbolRegistry:
             self._row_to_name[row] = key
             used.add(row)
         self._free = [r for r in range(self.capacity - 1, -1, -1) if r not in used]
+        self.version += 1
 
     @property
     def active_rows(self) -> np.ndarray:

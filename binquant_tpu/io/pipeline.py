@@ -275,6 +275,13 @@ class SignalEngine:
         # HostInputs template built once: re-creating all 16 device arrays
         # per tick costs a dozen extra H2D dispatches
         self._base_inputs = None
+        # per-name device-scalar cache: breadth scalars change once per
+        # bucket and the flags rarely — re-uploading identical values
+        # every tick is allocation churn that shows up as inputs_build
+        # p99 spikes (GC) on the 50 ms budget
+        self._scalar_cache: dict[str, tuple[Any, Any]] = {}
+        self._tracked_cache: tuple[int, Any] | None = None
+        self._nan_oi_cache: Any = None
 
     # -- ingest -------------------------------------------------------------
 
@@ -453,10 +460,17 @@ class SignalEngine:
         (each stamped with ``tick_ms`` of the tick that produced it).
         """
         t_tick0 = time.perf_counter()
+        fired: list = []
+        # Finalize BEFORE dispatching: at depth 1 this consumes tick i-1's
+        # (already-landed) wire first, so the host carries feeding tick i
+        # (quiet-hours regime, grid-only policy) have the SAME one-tick lag
+        # as the serial path — the semantics the pandas oracle verifies.
+        # Dispatch-first would leave them two ticks stale.
+        while len(self._pending) >= max(self.pipeline_depth, 1):
+            fired.extend(await self._finalize_tick(self._pending.popleft()))
         pending = await self._dispatch_tick(now_ms)
         self._pending.append(pending)
-        fired: list = []
-        while len(self._pending) > self.pipeline_depth:
+        if self.pipeline_depth == 0:
             fired.extend(await self._finalize_tick(self._pending.popleft()))
         self.latency.record("tick_total", (time.perf_counter() - t_tick0) * 1000.0)
         self.latency.maybe_log()
@@ -495,9 +509,10 @@ class SignalEngine:
             # loop owns the REST traffic — a 15m boundary with 2000 fresh
             # symbols performs zero network calls here. O(cached symbols),
             # not O(capacity): an empty cache (spot deployments, bench)
-            # skips the scan entirely.
-            oi = np.full(self.capacity, np.nan, dtype=np.float32)
+            # reuses one device-resident all-NaN array.
+            oi = None
             if self.oi_cache.has_data:
+                oi = np.full(self.capacity, np.nan, dtype=np.float32)
                 for rows, _, _ in batches15:
                     for row in rows:
                         symbol = self.registry.name_of(int(row))
@@ -530,36 +545,55 @@ class SignalEngine:
         t_inputs0 = time.perf_counter()
         if self._base_inputs is None:
             self._base_inputs = default_host_inputs(self.capacity)
+        if oi is None:
+            if self._nan_oi_cache is None:
+                self._nan_oi_cache = jnp.full(
+                    (self.capacity,), jnp.nan, dtype=jnp.float32
+                )
+            oi_dev = self._nan_oi_cache
+        else:
+            oi_dev = jnp.asarray(oi)
         inputs = self._base_inputs._replace(
-            tracked=jnp.asarray(self.registry.active_rows),
+            tracked=self._tracked_mask(),
             btc_row=np.int32(btc_row),
             timestamp_s=np.int32(ts15),
             timestamp5_s=np.int32(ts5),
-            oi_growth=jnp.asarray(oi),
-            adp_latest=jnp.asarray(np.float32(adp_latest)),
-            adp_prev=jnp.asarray(np.float32(adp_prev)),
-            adp_diff=jnp.asarray(np.float32(adp_diff)),
-            adp_diff_prev=jnp.asarray(np.float32(adp_diff_prev)),
-            breadth_momentum_points=jnp.asarray(np.float32(momentum)),
-            quiet_hours=jnp.asarray(quiet),
-            grid_policy_allows=jnp.asarray(
-                self.grid_only_policy.allow_grid_ladder
+            oi_growth=oi_dev,
+            adp_latest=self._dev_scalar("adp_latest", np.float32(adp_latest)),
+            adp_prev=self._dev_scalar("adp_prev", np.float32(adp_prev)),
+            adp_diff=self._dev_scalar("adp_diff", np.float32(adp_diff)),
+            adp_diff_prev=self._dev_scalar(
+                "adp_diff_prev", np.float32(adp_diff_prev)
             ),
-            is_futures=jnp.asarray(
-                str(settings.market_type).lower().endswith("futures")
+            breadth_momentum_points=self._dev_scalar(
+                "breadth_momentum", np.float32(momentum)
+            ),
+            quiet_hours=self._dev_scalar("quiet_hours", bool(quiet)),
+            grid_policy_allows=self._dev_scalar(
+                "grid_policy_allows", bool(self.grid_only_policy.allow_grid_ladder)
+            ),
+            is_futures=self._dev_scalar(
+                "is_futures",
+                str(settings.market_type).lower().endswith("futures"),
             ),
             # host-resolved market-domination state: attrs on the consumer
             # (reference pattern, context_evaluator.py:95-97 /
             # autotrade_consumer.py:37) — NEUTRAL/False in production,
             # scriptable in replay so the dominance-gated strategies can
             # be A/B'd
-            dominance_is_losers=jnp.asarray(
-                getattr(
-                    self.at_consumer, "current_market_dominance_is_losers", False
-                )
+            dominance_is_losers=self._dev_scalar(
+                "dominance_is_losers",
+                bool(
+                    getattr(
+                        self.at_consumer,
+                        "current_market_dominance_is_losers",
+                        False,
+                    )
+                ),
             ),
-            market_domination_reversal=jnp.asarray(
-                self.at_consumer.market_domination_reversal
+            market_domination_reversal=self._dev_scalar(
+                "market_domination_reversal",
+                bool(self.at_consumer.market_domination_reversal),
             ),
         )
         self.latency.record(
@@ -687,6 +721,32 @@ class SignalEngine:
             signal.tick_ms = pending.ts_ms
         return fired
 
+    def _dev_scalar(self, name: str, value):
+        """Device scalar cached per input name, re-uploaded only when the
+        value changes (NaN-stable: NaN == previous NaN counts as a hit)."""
+        import jax.numpy as jnp
+
+        hit = self._scalar_cache.get(name)
+        if hit is not None and (
+            hit[0] == value or (value != value and hit[0] != hit[0])
+        ):
+            return hit[1]
+        arr = jnp.asarray(value)
+        self._scalar_cache[name] = (value, arr)
+        return arr
+
+    def _tracked_mask(self):
+        """Device-resident occupied-rows mask, rebuilt only on registry
+        membership changes."""
+        import jax.numpy as jnp
+
+        cached = self._tracked_cache
+        if cached is not None and cached[0] == self.registry.version:
+            return cached[1]
+        arr = jnp.asarray(self.registry.active_rows)
+        self._tracked_cache = (self.registry.version, arr)
+        return arr
+
     def _wire_enabled_key(self) -> tuple[str, ...]:
         """The static wire_enabled tuple this engine compiles with — also
         the key into ``EMISSION_LAYOUTS`` for payload decoding."""
@@ -810,8 +870,29 @@ class SignalEngine:
         """Drain the ingest queue continuously; evaluate once per interval.
 
         Per-message crash isolation mirrors main.py:48-57: one bad payload
-        is logged and skipped, the loop never dies.
+        is logged and skipped, the loop never dies. On shutdown
+        (cancellation) any in-flight dispatched tick is flushed
+        best-effort so its signals aren't dropped between the SIGTERM and
+        the restart.
         """
+        try:
+            await self._consume_loop_body(queue, tick_interval_s)
+        finally:
+            if self._pending:
+                try:
+                    await self.flush_pending()
+                except asyncio.CancelledError:
+                    # already-cancelled task: a suspension point inside the
+                    # flush re-raises; the sync parts (wire decode, sink
+                    # enqueues) have still run — log and let the original
+                    # cancellation proceed
+                    logging.warning("shutdown flush interrupted mid-emission")
+                except Exception:
+                    logging.exception("shutdown flush failed")
+
+    async def _consume_loop_body(
+        self, queue: asyncio.Queue, tick_interval_s: float
+    ) -> None:
         last_tick = 0.0
         while True:
             try:

@@ -322,6 +322,32 @@ def run_replay_ab(
     }
 
 
+def _kline_json(
+    symbol: str, ts_s: int, interval_s: int, o, h, low, c, volume,
+    trades: float = 300.0,
+) -> str:
+    """One ExtendedKline JSONL line — the single writer every replay
+    generator shares, so all fixtures exercise the same ingest-parser
+    field contract (close_time = open+interval-1ms, taker splits, 6-dp
+    rounding)."""
+    return json.dumps(
+        {
+            "symbol": symbol,
+            "open_time": ts_s * 1000,
+            "close_time": (ts_s + interval_s) * 1000 - 1,
+            "open": round(float(o), 6),
+            "high": round(float(h), 6),
+            "low": round(float(low), 6),
+            "close": round(float(c), 6),
+            "volume": round(float(volume), 3),
+            "quote_asset_volume": round(float(volume * c), 3),
+            "number_of_trades": trades,
+            "taker_buy_base_volume": round(float(volume / 2), 3),
+            "taker_buy_quote_volume": round(float(volume * c / 2), 3),
+        }
+    ) + "\n"
+
+
 def generate_dormant_replay(
     path: str | Path,
     n_symbols: int = 24,
@@ -381,24 +407,6 @@ def generate_dormant_replay(
         closes[t, s] = px_s4
     closes[last, s] = closes[last - 1, s] * 0.988  # green close, set shapes below
 
-    def bar(symbol, ts_s, interval_s, o, h, low, c, volume):
-        return json.dumps(
-            {
-                "symbol": symbol,
-                "open_time": ts_s * 1000,
-                "close_time": (ts_s + interval_s) * 1000 - 1,
-                "open": round(float(o), 6),
-                "high": round(float(h), 6),
-                "low": round(float(low), 6),
-                "close": round(float(c), 6),
-                "volume": round(float(volume), 3),
-                "quote_asset_volume": round(float(volume * c), 3),
-                "number_of_trades": 300,
-                "taker_buy_base_volume": round(float(volume / 2), 3),
-                "taker_buy_quote_volume": round(float(volume * c / 2), 3),
-            }
-        ) + "\n"
-
     with open(path, "w") as f:
         for tick in range(n_ticks):
             ts15 = t0 + tick * 900
@@ -424,7 +432,7 @@ def generate_dormant_replay(
                 if tick == n_ticks - 1 and i == 2:
                     # BTD reclaim bar: clean green, modest wicks
                     h, low = c * 1.0005, o * 0.9995
-                f.write(bar(symbol, ts15, 900, o, h, low, c, vol15))
+                f.write(_kline_json(symbol, ts15, 900, o, h, low, c, vol15))
                 # three 5m sub-bars splitting the 15m move (both buffers
                 # must fill for MIN_BARS gates)
                 sub_o = o
@@ -432,7 +440,7 @@ def generate_dormant_replay(
                     frac = (j + 1) / 3
                     sub_c = o + (c - o) * frac
                     sh, sl = max(sub_o, sub_c) * 1.0005, min(sub_o, sub_c) * 0.9995
-                    f.write(bar(symbol, ts15 + j * 300, 300, sub_o, sh, sl, sub_c, vol15 / 3))
+                    f.write(_kline_json(symbol, ts15 + j * 300, 300, sub_o, sh, sl, sub_c, vol15 / 3))
                     sub_o = sub_c
 
 
@@ -506,24 +514,6 @@ def generate_dormant_extended_replay(
         closes[last, i] = closes[last - 1, i] * 0.948
     closes[last, 7] = closes[last - 1, 7] * 1.035  # S007: the leader
 
-    def bar(symbol, ts_s, interval_s, o, h, low, c, volume, trades=300.0):
-        return json.dumps(
-            {
-                "symbol": symbol,
-                "open_time": ts_s * 1000,
-                "close_time": (ts_s + interval_s) * 1000 - 1,
-                "open": round(float(o), 6),
-                "high": round(float(h), 6),
-                "low": round(float(low), 6),
-                "close": round(float(c), 6),
-                "volume": round(float(volume), 3),
-                "quote_asset_volume": round(float(volume * c), 3),
-                "number_of_trades": trades,
-                "taker_buy_base_volume": round(float(volume / 2), 3),
-                "taker_buy_quote_volume": round(float(volume * c / 2), 3),
-            }
-        ) + "\n"
-
     with open(path, "w") as f:
         for tick in range(n_ticks):
             ts15 = t0 + tick * 900
@@ -537,15 +527,15 @@ def generate_dormant_extended_replay(
                     h, low = max(o, c) * 1.005, min(o, c) * 0.995
                 else:
                     h, low = max(o, c) * 1.001, min(o, c) * 0.999
-                f.write(bar(symbol, ts15, 900, o, h, low, c, vol15))
+                f.write(_kline_json(symbol, ts15, 900, o, h, low, c, vol15))
                 sub_o = o
                 for j in range(3):
                     frac = (j + 1) / 3
                     sub_c = o + (c - o) * frac
                     sh, sl = max(sub_o, sub_c) * 1.0005, min(sub_o, sub_c) * 0.9995
                     f.write(
-                        bar(symbol, ts15 + j * 300, 300, sub_o, sh, sl,
-                            sub_c, vol15 / 3)
+                        _kline_json(symbol, ts15 + j * 300, 300, sub_o, sh,
+                                    sl, sub_c, vol15 / 3)
                     )
                     sub_o = sub_c
             # the fade's sub-bars are strictly monotone red by construction
@@ -569,24 +559,6 @@ def generate_replay_file(
     t0 = 1_753_000_200
     assert t0 % 900 == 0
     px = 20 + rng.random(n_symbols) * 100
-
-    def bar(symbol, ts_s, interval_s, o, h, low, c, volume):
-        return json.dumps(
-            {
-                "symbol": symbol,
-                "open_time": ts_s * 1000,
-                "close_time": (ts_s + interval_s) * 1000 - 1,
-                "open": round(float(o), 6),
-                "high": round(float(h), 6),
-                "low": round(float(low), 6),
-                "close": round(float(c), 6),
-                "volume": round(float(volume), 3),
-                "quote_asset_volume": round(float(volume * c), 3),
-                "number_of_trades": 300,
-                "taker_buy_base_volume": round(float(volume / 2), 3),
-                "taker_buy_quote_volume": round(float(volume * c / 2), 3),
-            }
-        ) + "\n"
 
     with open(path, "w") as f:
         for tick in range(n_ticks):
@@ -616,7 +588,7 @@ def generate_replay_file(
                     h, low = c * 1.001, o * 0.997
                     new_px[i] = c
                     vol15 *= 3.0
-                f.write(bar(symbol, ts15, 900, o, h, low, c, vol15))
+                f.write(_kline_json(symbol, ts15, 900, o, h, low, c, vol15))
                 # three 5m sub-bars splitting the 15m move
                 sub_o = o
                 for j in range(3):
@@ -635,6 +607,6 @@ def generate_replay_file(
                             sh, sl = sub_c * 1.002, sub_o * 0.998
                             vol5 *= 8.0
                         new_px[i] = sub_c
-                    f.write(bar(symbol, ts15 + j * 300, 300, sub_o, sh, sl, sub_c, vol5))
+                    f.write(_kline_json(symbol, ts15 + j * 300, 300, sub_o, sh, sl, sub_c, vol5))
                     sub_o = sub_c
             px = new_px

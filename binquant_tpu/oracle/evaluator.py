@@ -73,6 +73,21 @@ def _nz(x: float, default: float = 0.0) -> float:
     return float(x) if math.isfinite(float(x)) else default
 
 
+def _rsi14_sma(close: pd.Series) -> float | None:
+    """Simple-rolling-mean RSI(14) — the ``Indicators.rsi`` column variant
+    every oracle strategy that reads plain RSI must share (MRF's Wilder
+    variant stays inline there). None when the 14-bar warm-up is unmet."""
+    delta = close.diff()
+    avg_gain = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+    avg_loss = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
+    if not (
+        math.isfinite(_nz(avg_gain, np.nan)) and math.isfinite(_nz(avg_loss, np.nan))
+    ):
+        return None
+    denom = avg_gain + avg_loss
+    return 100.0 * avg_gain / denom if denom != 0 else 50.0
+
+
 # ---------------------------------------------------------------------------
 # Rolling store (reference MarketStateStore: dedupe, sort, tail)
 # ---------------------------------------------------------------------------
@@ -639,15 +654,9 @@ class OracleEvaluator:
         close = df["close"]
         if len(df) < 30 or not ctx.valid:
             return None
-        delta = close.diff()
-        gain = delta.clip(lower=0)
-        loss = (-delta).clip(lower=0)
-        avg_gain = gain.rolling(14, min_periods=14).mean().iloc[-1]
-        avg_loss = loss.rolling(14, min_periods=14).mean().iloc[-1]
-        if not (math.isfinite(_nz(avg_gain, np.nan)) and math.isfinite(_nz(avg_loss, np.nan))):
+        rsi = _rsi14_sma(close)
+        if rsi is None:
             return None
-        denom = avg_gain + avg_loss
-        rsi = 100.0 * avg_gain / denom if denom != 0 else 50.0
         macd = float(
             (
                 close.ewm(span=12, adjust=False, min_periods=1).mean()
@@ -1010,16 +1019,9 @@ class OracleEvaluator:
         if len(df) < 40:
             return None
         close, high, low, open_ = df["close"], df["high"], df["low"], df["open"]
-        # simple-rolling-mean RSI(14) (the Indicators.rsi column variant)
-        delta = close.diff()
-        avg_gain = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        avg_loss = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        if not (
-            math.isfinite(_nz(avg_gain, np.nan)) and math.isfinite(_nz(avg_loss, np.nan))
-        ):
+        rsi = _rsi14_sma(close)
+        if rsi is None:
             return None
-        denom = avg_gain + avg_loss
-        rsi = 100.0 * avg_gain / denom if denom != 0 else 50.0
         # inline rolling-SUM ADX (NOT Wilder EWM; reference l.101-128).
         # sdiv mirrors the device's jsafe_div: 0 where the denominator is
         # exactly 0, NaN propagation elsewhere.
@@ -1135,16 +1137,9 @@ class OracleEvaluator:
             return None
         df = self.store5.frames[sym]
         close, high, low = df["close"], df["high"], df["low"]
-        # simple-rolling-mean RSI(14) (pack5.rsi variant)
-        delta = close.diff()
-        ag = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        al = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        if not (math.isfinite(_nz(ag, np.nan)) and math.isfinite(_nz(al, np.nan))):
-            return None
-        denom = ag + al
-        rsi = 100.0 * ag / denom if denom != 0 else 50.0
+        rsi = _rsi14_sma(close)
         trades = float(df["number_of_trades"].iloc[-1])
-        if not (rsi < 30.0 and trades > 5):
+        if rsi is None or not (rsi < 30.0 and trades > 5):
             return None
         # supertrend(10,3): Wilder ATR + band ratchet + flip state,
         # sequential — mirrors ops/indicators.supertrend exactly
@@ -1183,13 +1178,9 @@ class OracleEvaluator:
             return None
         df = self.store15.frames[sym]
         close = df["close"]
-        delta = close.diff()
-        ag = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        al = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        if not (math.isfinite(_nz(ag, np.nan)) and math.isfinite(_nz(al, np.nan))):
+        rsi = _rsi14_sma(close)
+        if rsi is None:
             return None
-        denom = ag + al
-        rsi = 100.0 * ag / denom if denom != 0 else 50.0
         ma25 = float(close.rolling(25, min_periods=1).mean().iloc[-1])
         if not (rsi < 35.0 and float(close.iloc[-1]) > ma25):
             return None
@@ -1203,13 +1194,9 @@ class OracleEvaluator:
         close = df["close"]
         if len(df) < 30:
             return None
-        delta = close.diff()
-        ag = delta.clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        al = (-delta).clip(lower=0).rolling(14, min_periods=14).mean().iloc[-1]
-        if not (math.isfinite(_nz(ag, np.nan)) and math.isfinite(_nz(al, np.nan))):
+        rsi = _rsi14_sma(close)
+        if rsi is None:
             return None
-        denom = ag + al
-        rsi = 100.0 * ag / denom if denom != 0 else 50.0
         macd = float(
             (
                 close.ewm(span=12, adjust=False, min_periods=1).mean()

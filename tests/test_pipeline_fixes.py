@@ -257,3 +257,47 @@ class TestRegimeStaging:
         ctx3, _ = run_kernel(buf3, rows3, ts1, carry=carry2, cfg=cfg)
         assert bool(ctx3.valid)
         assert int(ctx3.previous_market_regime) == int(ctx2.market_regime)
+
+
+class TestDeviceInputCaches:
+    """Per-tick HostInputs churn (r3): device scalars are re-uploaded only
+    when values change; the tracked mask only on registry membership
+    changes; NaN-valued scalars must count as cache hits (NaN != NaN would
+    otherwise re-upload every tick)."""
+
+    def _engine(self):
+        from binquant_tpu.io.replay import make_stub_engine
+
+        return make_stub_engine(capacity=8, window=40)
+
+    def test_dev_scalar_value_cache_nan_stable(self):
+        engine = self._engine()
+        a = engine._dev_scalar("adp_latest", np.float32("nan"))
+        b = engine._dev_scalar("adp_latest", np.float32("nan"))
+        assert a is b  # NaN == NaN counts as a hit
+        c = engine._dev_scalar("adp_latest", np.float32(0.25))
+        assert c is not b
+        assert float(c) == 0.25
+        d = engine._dev_scalar("adp_latest", np.float32(0.25))
+        assert d is c
+
+    def test_dev_scalar_bool_flags(self):
+        engine = self._engine()
+        t1 = engine._dev_scalar("quiet_hours", True)
+        f1 = engine._dev_scalar("quiet_hours", False)
+        assert bool(t1) is True and bool(f1) is False
+        assert engine._dev_scalar("quiet_hours", False) is f1
+
+    def test_tracked_mask_invalidated_by_registry_changes(self):
+        engine = self._engine()
+        engine.registry.add("AUSDT")
+        m1 = engine._tracked_mask()
+        assert engine._tracked_mask() is m1  # no membership change: cached
+        engine.registry.add("BUSDT")
+        m2 = engine._tracked_mask()
+        assert m2 is not m1
+        assert int(np.asarray(m2).sum()) == 2
+        engine.registry.remove("AUSDT")
+        m3 = engine._tracked_mask()
+        assert m3 is not m2
+        assert int(np.asarray(m3).sum()) == 1

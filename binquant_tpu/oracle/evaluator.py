@@ -55,17 +55,17 @@ DORMANT_ORACLE_STRATEGIES = (
 )
 
 # Remaining dormant set with oracle coverage (round 3 extension): the
-# coinrule rules, InversePriceTracker, and RelativeStrengthReversalRange.
-# RangeFailedBreakoutFade is the one dormant kernel WITHOUT an oracle —
-# it rides the ~30-feature SpikeHunter detector, whose pandas mirror is a
-# project of its own; its gate layer is covered by the device-side matrix
-# tests instead (tests/test_strategies_dormant_gates.py).
+# coinrule rules, InversePriceTracker, RelativeStrengthReversalRange, and
+# RangeFailedBreakoutFade (with a full pandas mirror of the SpikeHunter
+# detector's flag pipeline). Every one of the 14 strategy kernels now has
+# an independent oracle.
 DORMANT_ORACLE_EXTENDED = (
     "coinrule_twap_momentum_sniper",
     "coinrule_supertrend_swing_reversal",
     "coinrule_buy_low_sell_high",
     "inverse_price_tracker",
     "relative_strength_reversal_range",
+    "range_failed_breakout_fade",
 )
 
 
@@ -1253,6 +1253,93 @@ class OracleEvaluator:
             return None
         return True, False  # telemetry-only
 
+    def _rfbf(self, sym: str, ctx: OracleContext) -> tuple[bool, bool] | None:
+        """range_failed_breakout_fade: short a fresh bullish spike when the
+        market is RANGE with average return < −0.5% and the symbol is an
+        outperformer. Mirrors the SpikeHunter detector's flag pipeline
+        (strategies/spike_hunter.py detect_spikes — auto-calibrated volume
+        cluster, dynamic price break, cumulative break, acceleration)."""
+        f = ctx.features.get(sym)
+        if not (
+            ctx.valid
+            and ctx.market_regime == int(MarketRegimeCode.RANGE)
+            and ctx.average_return < -0.005
+            and f is not None
+            and f.valid
+            and f.relative_strength_vs_btc >= 0
+        ):
+            return None
+        df = self.store15.frames[sym]
+        close, open_, volume = df["close"], df["open"], df["volume"]
+        # upward streak: ALL of the last 3 candles green
+        if len(df) < 4:
+            return None
+        c3 = close.tail(3).to_numpy(float)
+        o3 = open_.tail(3).to_numpy(float)
+        if not bool((c3 > o3).all()):
+            return None
+
+        def nanq(arr: np.ndarray, q: float) -> float:
+            a = arr[np.isfinite(arr)]
+            return float(np.quantile(a, q)) if len(a) else float("nan")
+
+        pc = (close / close.shift(1) - 1.0).to_numpy(float)
+        pc_abs = np.abs(pc)
+        vma = volume.rolling(12, min_periods=12).mean()
+        vr = (volume / (vma + 1e-6)).to_numpy(float)
+        pc_last, pc_abs_last, vr_last = pc[-1], pc_abs[-1], vr[-1]
+
+        # auto-calibration over the full stored window. Resolve the NaN
+        # fallbacks BEFORE the max (Python's max(a, nan) keeps a, unlike
+        # jnp.maximum which propagates NaN — the device's order is
+        # quantile → isfinite fallback → max).
+        q_vol = nanq(vr, 0.97)
+        vol_thr = max(1.15, q_vol) if math.isfinite(q_vol) else 1.6
+        q_pf = nanq(pc_abs, 0.75)
+        pf = max(0.015, q_pf) if math.isfinite(q_pf) else 0.0
+        price_floor = max(0.03, pf)
+
+        # volume cluster: trailing 8 ratios, >=2 crossings and a hot last bar
+        vrw = vr[-8:]
+        fin = np.isfinite(vrw)
+        vc_flag = (
+            bool(fin.any())
+            and int(np.where(fin, vrw >= vol_thr, False).sum()) >= 2
+            and bool(vr_last >= vol_thr)
+        )
+        # dynamic price break: trailing-60 quantile(0.85), min 20 finite
+        t60 = pc_abs[-60:]
+        fin60 = t60[np.isfinite(t60)]
+        dyn = float(np.quantile(fin60, 0.85)) if len(fin60) >= 20 else float("nan")
+        pb_flag = math.isfinite(dyn) and bool(
+            pc_abs_last >= max(price_floor, dyn)
+        )
+        # cumulative break over the trailing 3 bars
+        pcw = pc[-3:]
+        finw = np.isfinite(pcw)
+        vr3 = vr[-3:]
+        fin3 = np.isfinite(vr3)
+        vol_cond = int(fin3.sum()) >= 3 and bool(
+            (vr3[fin3] >= vol_thr * 0.8).any()
+        )
+        cum_flag = (
+            int(finw.sum()) >= 3
+            and float(np.maximum(pcw, 0.0)[finw].sum()) >= 0.025
+            and vol_cond
+        )
+        # acceleration: volume-ratio derivative over 3 bars + a real move
+        vr_lag = vr[-4] if len(vr) > 3 else float("nan")
+        accel_base = (
+            math.isfinite(vr_lag)
+            and math.isfinite(vr_last)
+            and vr_last - vr_lag >= 0.45
+            and pc_abs_last >= 0.015
+        )
+        accel_flag = accel_base and pc_last > 0
+        if not (cum_flag or vc_flag or pb_flag or accel_flag):
+            return None
+        return True, True  # shorts the spike; autotrade on
+
     def _rsr(self, sym: str, ctx: OracleContext) -> tuple[bool, bool] | None:
         """relative_strength_reversal_range: contrarian long on an RS
         leader during a broad RANGE selloff, volume above the 20th
@@ -1415,6 +1502,13 @@ class OracleEvaluator:
                     emit(
                         "relative_strength_reversal_range", sym,
                         "LONG", r[1], ts15,
+                    )
+        if "range_failed_breakout_fade" in self.enabled:
+            for sym in sorted(fresh15):
+                r = self._rfbf(sym, ctx)
+                if r:
+                    emit(
+                        "range_failed_breakout_fade", sym, "SHORT", r[1], ts15
                     )
         if "coinrule_buy_the_dip" in self.enabled:
             for sym in sorted(fresh15):

@@ -104,9 +104,10 @@ def test_ab_dormant_oracle_set(tmp_path):
 def test_ab_dormant_extended_oracle_set(tmp_path):
     """Round-3 extension beyond VERDICT item 6: oracle + A/B for the
     REMAINING dormant strategies (coinrule twap sniper / supertrend swing
-    reversal / buy-low-sell-high, InversePriceTracker, RS reversal range
-    — everything except the SpikeHunter-backed RangeFailedBreakoutFade).
-    Dominance flags are scripted through both backends; all five must
+    reversal / buy-low-sell-high, InversePriceTracker, RS reversal range,
+    and RangeFailedBreakoutFade with its SpikeHunter-detector mirror) —
+    every one of the 14 strategy kernels now has an independent oracle.
+    Dominance flags are scripted through both backends; all six must
     ENGAGE and match."""
     from binquant_tpu.io.replay import generate_dormant_extended_replay
     from binquant_tpu.oracle.evaluator import DORMANT_ORACLE_EXTENDED

@@ -245,7 +245,12 @@ def extract_fired(
                 bb_low_v = float(bb_l[row])
                 micro = int(micro_np[row])
                 micro_trans = int(micro_trans_np[row])
-                diag_row = {k: v[row] for k, v in diags.items()}
+                # some diagnostics are market-wide scalars (0-d arrays,
+                # e.g. PriceTracker's breadth_stable/confidence) — the
+                # same value applies to every row
+                diag_row = {
+                    k: (v[row] if v.ndim else v[()]) for k, v in diags.items()
+                }
 
             direction = Direction(direction_code).name
             position = Position.short if direction == "SHORT" else Position.long

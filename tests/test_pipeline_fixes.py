@@ -301,3 +301,19 @@ class TestDeviceInputCaches:
         m3 = engine._tracked_mask()
         assert m3 is not m2
         assert int(np.asarray(m3).sum()) == 1
+
+
+def test_legacy_emission_handles_scalar_diagnostics(tick_outputs):
+    """The overflow/fabricated-wire fallback indexes diagnostics per row;
+    market-wide scalar diagnostics (0-d arrays — PriceTracker's
+    breadth_stable and confidence are the real cases) must resolve to the
+    shared value instead of raising (r3 regression found by the
+    4096-symbol bench's overflow ticks)."""
+    so = tick_outputs.strategies["coinrule_price_tracker"]
+    assert any(
+        np.asarray(v).ndim == 0 for v in so.diagnostics.values()
+    ), "fixture lost its 0-d diagnostic; the test would go vacuous"
+    unp = _forced_unpacked(tick_outputs, "coinrule_price_tracker", 2)
+    fired = extract_fired(tick_outputs, FakeRegistry(), unpacked=unp)
+    sig = next(f for f in fired if f.strategy == "coinrule_price_tracker")
+    assert "confidence" in sig.analytics["indicators"]

@@ -166,7 +166,12 @@ class TickOutputs(NamedTuple):
 # the update stream). Timestamps ride as (quotient, remainder) base-65536
 # pairs: ~1.7e9 seconds exceeds f32's 2^24 integer range, the split parts
 # don't.
-WIRE_MAX_FIRED = 64  # overflow flagged via n_fired; host falls back to summary
+# Compaction slots for fired (strategy, row) pairs; overflow is flagged
+# via n_fired and the host falls back to the full-summary fetch — slow
+# through a tunneled device, so sized for a broad-market burst (a crash
+# tick can legitimately fire MRF/BBX on >100 symbols at once). 128 slots
+# cost ~17 KB of wire.
+WIRE_MAX_FIRED = 128
 
 # --- per-slot emission payload -------------------------------------------
 # Everything the host-side emission layer reads for a fired row rides the

@@ -1,56 +1,251 @@
-"""Telegram emission sink.
+"""Telegram alert sink.
 
-Equivalent of ``/root/reference/consumers/telegram_consumer.py``: HTML
-sanitizer preserving whitelisted tags (l.44-76), content-based dedupe key
-from algo/symbol/action fields with a 900 s cooldown and pending-set
-(l.82-137), a global send lock with 1 s min interval and flood-control
-backoff (l.139-172), and fire-and-forget dispatch with a task-set GC guard
-(l.193-212). Transport is injectable (an async callable posting to the Bot
-API) so tests never hit the network; the default uses httpx against
-api.telegram.org — no python-telegram-bot dependency.
+Covers the capability surface of the reference Telegram consumer
+(``/root/reference/consumers/telegram_consumer.py``): HTML-safe message
+rendering limited to Telegram's supported tags, content-derived duplicate
+suppression with a 900 s cooldown, a paced single-flight send channel with
+flood-control backoff, and fire-and-forget dispatch. The implementation is
+original: sanitization is a single-pass tokenizer over the *raw* message
+(the reference escapes everything and then un-escapes a whitelist), dedupe
+is a parsed ``SignalFingerprint`` admitted through a ``CooldownLedger``,
+and transport is an injected async callable (httpx by default) so tests
+never touch the network.
+
+Behavior contract pinned by tests/test_telegram_deep.py and
+tests/test_io.py:
+- whitelisted tags (b/strong/i/em/u/s/code/pre/a) survive verbatim;
+  ``<a href='u'>`` is normalized to double quotes; ``<pre lang=x>`` keeps
+  attribute text only when it carries no quoting/entity characters;
+  pre-escaped entities (&lt; &gt; &amp; &quot; &#x27;) pass through;
+  everything else is entity-escaped.
+- two messages collide iff their (algo, symbol, Action, Strategy,
+  Autotrade-route, autotrade-enabled-flag) extraction collides; a message
+  with none of those fields dedupes on its full content hash.
+- at most one send per second, serialized, retrying on flood control with
+  a 2 s pad.
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
-import html
 import logging
 import re
 import time
 from collections.abc import Awaitable, Callable
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+TransportFn = Callable[[str, str], Awaitable[None]]
 
 
 class RetryAfterError(Exception):
-    """Telegram flood control: retry after N seconds."""
+    """Raised by a transport when Telegram flood control asks us to wait."""
 
     def __init__(self, retry_after: float) -> None:
         super().__init__(f"retry after {retry_after}s")
         self.retry_after = retry_after
 
 
-def make_httpx_transport(token: str) -> Callable[[str, str], Awaitable[None]]:
-    """Default transport: POST sendMessage via httpx (async)."""
+# ---------------------------------------------------------------------------
+# Sanitizer: one tokenizing scan over the raw message.
+#
+# Rather than escaping the whole string and then carving a whitelist back
+# out of entity-space, classify each region of the raw text directly:
+# known-safe markup and already-encoded entities are emitted as-is, every
+# other character is escaped. One regex pass, no re-entrant substitutions.
+# ---------------------------------------------------------------------------
+
+_TELEGRAM_TAGS = ("b", "strong", "i", "em", "u", "s", "code", "pre", "a")
+
+_CHAR_ENTITIES = {
+    "&": "&amp;",
+    "<": "&lt;",
+    ">": "&gt;",
+    '"': "&quot;",
+    "'": "&#x27;",
+}
+
+_KNOWN_ENTITY = r"&(?:lt|gt|amp|quot|#x27);"
+
+_TOKEN_SCANNER = re.compile(
+    # plain open/close form of any supported tag, e.g. <strong> </a>
+    rf"(?P<tag></?(?:{'|'.join(_TELEGRAM_TAGS)})>)"
+    # anchor with a quoted href (either quote style; emitted double-quoted)
+    r"|(?P<anchor><a\s+href=['\"](?P<href>.+?)['\"]>)"
+    # pre/code carrying attribute text free of quoting/entity characters
+    r"|(?P<fenced><(?P<fence>pre|code)\s+(?P<fattrs>[^&<>'\"]*)>)"
+    # an entity the author already encoded; passes through untouched
+    rf"|(?P<entity>{_KNOWN_ENTITY})"
+)
+
+_ENTITY_OR_CHAR = re.compile(rf"({_KNOWN_ENTITY})|(.)", re.S)
+
+
+def _escape_segment(text: str) -> str:
+    """Entity-escape plain text, letting already-encoded entities stand."""
+    return _ENTITY_OR_CHAR.sub(
+        lambda m: m.group(1) or _CHAR_ENTITIES.get(m.group(2), m.group(2)),
+        text,
+    )
+
+
+def sanitize_telegram_html(message: str) -> str:
+    out: list[str] = []
+    cursor = 0
+    for token in _TOKEN_SCANNER.finditer(message):
+        out.append(_escape_segment(message[cursor : token.start()]))
+        if token.group("tag") or token.group("entity"):
+            out.append(token.group(0))
+        elif token.group("anchor"):
+            out.append(f'<a href="{_escape_segment(token.group("href"))}">')
+        else:  # fenced: attribute text is verified entity-free by the regex
+            out.append(f"<{token.group('fence')} {token.group('fattrs')}>")
+        cursor = token.end()
+    out.append(_escape_segment(message[cursor:]))
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Duplicate suppression: parse once into a fingerprint, admit via a ledger.
+# ---------------------------------------------------------------------------
+
+_HASHTAG = re.compile(r"#([A-Za-z0-9_]+)")
+_ALGO_HEADER = re.compile(r"<strong>#([^<\s]+)\s+algorithm</strong>")
+_KEYED_FIELDS = ("Action", "Strategy", "Autotrade route")
+
+
+@dataclass(frozen=True)
+class SignalFingerprint:
+    """The identity of an alert for dedupe purposes.
+
+    Extraction targets the structured message layout every emission uses
+    (``- Label: value`` bullet lines, a ``#algo algorithm`` header, a
+    trailing ``#SYMBOL`` hashtag, and the autotrade enabled/disabled
+    sentence). Messages that expose none of those collapse to a content
+    digest, so free-form digests still dedupe on exact repetition.
+    """
+
+    algo: str = ""
+    symbol: str = ""
+    action: str = ""
+    strategy: str = ""
+    route: str = ""
+    autotrade: str = ""
+    digest: str = ""
+
+    def key(self) -> tuple[str, ...]:
+        structured = (
+            self.algo,
+            self.symbol,
+            self.action,
+            self.strategy,
+            self.route,
+            self.autotrade,
+        )
+        if any(structured):
+            return structured
+        return ("digest", self.digest)
+
+
+def parse_fingerprint(condensed: str) -> SignalFingerprint:
+    bullets: dict[str, str] = {}
+    for line in condensed.splitlines():
+        if not line.startswith("- "):
+            continue
+        label, sep, value = line[2:].partition(":")
+        if sep and label in _KEYED_FIELDS:
+            bullets.setdefault(label, value.strip())
+
+    tags = _HASHTAG.findall(condensed)
+    header = _ALGO_HEADER.search(condensed)
+
+    if "Autotrade is enabled" in condensed:
+        autotrade = "enabled"
+    elif "Autotrade is disabled" in condensed:
+        autotrade = "disabled"
+    else:
+        autotrade = ""
+
+    return SignalFingerprint(
+        algo=header.group(1) if header else "",
+        symbol=tags[-1] if tags else "",
+        action=bullets.get("Action", ""),
+        strategy=bullets.get("Strategy", ""),
+        route=bullets.get("Autotrade route", ""),
+        autotrade=autotrade,
+        digest=hashlib.sha1(condensed.encode("utf-8")).hexdigest(),
+    )
+
+
+class CooldownLedger:
+    """Admission control over fingerprint keys.
+
+    Two layers: an *in-flight* set (a key currently being sent is never
+    re-admitted, regardless of TTL) and a *sent-at* map enforcing a
+    cooldown window. A non-positive TTL disables the window, leaving
+    in-flight suppression only.
+    """
+
+    def __init__(self) -> None:
+        self._sent_at: dict[tuple[str, ...], float] = {}
+        self._inflight: set[tuple[str, ...]] = set()
+
+    def admit(self, key: tuple[str, ...], ttl: float) -> bool:
+        if key in self._inflight:
+            log.info("Telegram duplicate signal already pending; skipping")
+            return False
+        if ttl <= 0:
+            self._inflight.add(key)
+            return True
+
+        now = time.monotonic()
+        for stale in [k for k, at in self._sent_at.items() if now - at >= ttl]:
+            del self._sent_at[stale]
+
+        if key in self._sent_at:
+            log.info("Telegram duplicate signal inside cooldown; skipping")
+            return False
+        self._sent_at[key] = now
+        self._inflight.add(key)
+        return True
+
+    def release(self, key: tuple[str, ...]) -> None:
+        self._inflight.discard(key)
+
+
+# ---------------------------------------------------------------------------
+# Transport + consumer
+# ---------------------------------------------------------------------------
+
+
+def httpx_bot_transport(token: str) -> TransportFn:
+    """Production transport: Bot API sendMessage over httpx."""
     import httpx
 
-    url = f"https://api.telegram.org/bot{token}/sendMessage"
+    endpoint = f"https://api.telegram.org/bot{token}/sendMessage"
 
-    async def send(chat_id: str, text: str) -> None:
+    async def post(chat_id: str, text: str) -> None:
         async with httpx.AsyncClient(timeout=10) as client:
-            resp = await client.post(
-                url,
+            reply = await client.post(
+                endpoint,
                 json={"chat_id": chat_id, "text": text, "parse_mode": "HTML"},
             )
-            if resp.status_code == 429:
-                retry = float(resp.json().get("parameters", {}).get("retry_after", 5))
-                raise RetryAfterError(retry)
-            resp.raise_for_status()
+            if reply.status_code == 429:
+                pause = reply.json().get("parameters", {}).get("retry_after", 5)
+                raise RetryAfterError(float(pause))
+            reply.raise_for_status()
 
-    return send
+    return post
+
+
+def _condense(message: str) -> str:
+    """Strip indentation and blank lines (messages are triple-quoted)."""
+    return "\n".join(ln.strip() for ln in message.splitlines() if ln.strip())
 
 
 class TelegramConsumer:
-    _ALLOWED_HTML_TAGS = ("b", "strong", "i", "em", "u", "s", "code", "pre", "a")
     _MIN_SEND_INTERVAL_SECONDS = 1.0
     _RETRY_AFTER_PAD_SECONDS = 2.0
     _SIGNAL_DEDUPE_SECONDS = 900.0
@@ -60,160 +255,85 @@ class TelegramConsumer:
         token: str,
         chat_id: str,
         is_enabled: bool = True,
-        transport: Callable[[str, str], Awaitable[None]] | None = None,
+        transport: TransportFn | None = None,
     ) -> None:
         self.chat_id = chat_id
         self.is_enabled = is_enabled
-        self._transport = transport or (
-            make_httpx_transport(token) if token else None
-        )
+        if transport is None and token:
+            transport = httpx_bot_transport(token)
+        self._transport = transport
+        self._ledger = CooldownLedger()
         self._send_lock = asyncio.Lock()
         self._min_send_interval_seconds = self._MIN_SEND_INTERVAL_SECONDS
         self._retry_after_pad_seconds = self._RETRY_AFTER_PAD_SECONDS
         self._signal_dedupe_seconds = self._SIGNAL_DEDUPE_SECONDS
-        self._last_send_at = 0.0
-        self._recent_signal_keys: dict[str, float] = {}
-        self._pending_signal_keys: set[str] = set()
-        # Keep created tasks alive until the Telegram round-trip completes.
+        self._sent_monotonic: float | None = None
+        # Hold strong refs so fire-and-forget tasks survive GC mid-send.
         self._background_tasks: set[asyncio.Task] = set()
 
-    # -- sanitization (reference l.44-76) -----------------------------------
-
+    # The method name is part of the tested surface; logic lives above.
     def _sanitize_html(self, message: str) -> str:
-        sanitized = html.escape(message, quote=True)
-        for tag in self._ALLOWED_HTML_TAGS:
-            sanitized = sanitized.replace(f"&lt;{tag}&gt;", f"<{tag}>")
-            sanitized = sanitized.replace(f"&lt;/{tag}&gt;", f"</{tag}>")
-        sanitized = re.sub(
-            r"&lt;(pre|code)\s+([^&]*)&gt;",
-            lambda m: f"<{m.group(1)} {m.group(2)}>",
-            sanitized,
-        )
-        sanitized = re.sub(
-            r"&lt;a\s+href=(?:&#x27;|&quot;)(.+?)(?:&#x27;|&quot;)&gt;",
-            lambda m: f'<a href="{m.group(1)}">',
-            sanitized,
-        )
-        sanitized = re.sub(
-            r"&amp;(lt|gt|amp|quot|#x27);",
-            lambda m: f"&{m.group(1)};",
-            sanitized,
-        )
-        return sanitized
+        return sanitize_telegram_html(message)
 
-    # -- dedupe (reference l.78-137) ----------------------------------------
-
-    @staticmethod
-    def _clean_signal_message(message: str) -> str:
-        lines = [line.strip() for line in message.splitlines() if line.strip()]
-        return "\n".join(lines)
-
-    def _message_field(self, cleaned: str, label: str) -> str:
-        match = re.search(rf"^- {re.escape(label)}:\s*(.+)$", cleaned, re.M)
-        return match.group(1).strip() if match else ""
-
-    def _signal_dedupe_key(self, cleaned: str) -> str:
-        hashtags = re.findall(r"#([A-Za-z0-9_]+)", cleaned)
-        symbol = hashtags[-1] if hashtags else ""
-        algo_match = re.search(r"<strong>#([^<\s]+)\s+algorithm</strong>", cleaned)
-        algo = algo_match.group(1) if algo_match else ""
-        fields = {
-            "action": self._message_field(cleaned, "Action"),
-            "strategy": self._message_field(cleaned, "Strategy"),
-            "route": self._message_field(cleaned, "Autotrade route"),
-            "autotrade": "enabled"
-            if "Autotrade is enabled" in cleaned
-            else "disabled"
-            if "Autotrade is disabled" in cleaned
-            else "",
-        }
-        key_parts = [algo, symbol, *fields.values()]
-        if any(key_parts):
-            return "|".join(key_parts)
-        return hashlib.sha1(cleaned.encode("utf-8")).hexdigest()
-
-    def _drop_duplicate_signal(self, signal_key: str) -> bool:
-        if self._signal_dedupe_seconds <= 0:
-            if signal_key in self._pending_signal_keys:
-                return True
-            self._pending_signal_keys.add(signal_key)
-            return False
-
-        now = time.monotonic()
-        expired = [
-            k
-            for k, sent_at in self._recent_signal_keys.items()
-            if now - sent_at >= self._signal_dedupe_seconds
-        ]
-        for k in expired:
-            self._recent_signal_keys.pop(k, None)
-
-        if signal_key in self._pending_signal_keys:
-            logging.info("Telegram duplicate signal already pending; skipping")
-            return True
-        if signal_key in self._recent_signal_keys:
-            logging.info("Telegram duplicate signal inside cooldown; skipping")
-            return True
-
-        self._recent_signal_keys[signal_key] = now
-        self._pending_signal_keys.add(signal_key)
-        return False
-
-    # -- send path (reference l.139-184) ------------------------------------
-
-    async def _sleep_for_send_interval(self) -> None:
-        if self._min_send_interval_seconds <= 0 or self._last_send_at <= 0:
+    async def _pace(self) -> None:
+        if self._sent_monotonic is None or self._min_send_interval_seconds <= 0:
             return
-        elapsed = time.monotonic() - self._last_send_at
-        remaining = self._min_send_interval_seconds - elapsed
-        if remaining > 0:
-            await asyncio.sleep(remaining)
+        due = self._sent_monotonic + self._min_send_interval_seconds
+        wait = due - time.monotonic()
+        if wait > 0:
+            await asyncio.sleep(wait)
 
     async def send_msg(self, message: str) -> None:
+        """Deliver one message, serialized, paced, flood-control aware."""
         if self._transport is None:
             return
+        text = sanitize_telegram_html(message)
         async with self._send_lock:
             while True:
-                await self._sleep_for_send_interval()
+                await self._pace()
                 try:
-                    await self._transport(self.chat_id, self._sanitize_html(message))
-                    self._last_send_at = time.monotonic()
-                    return
-                except RetryAfterError as e:
-                    sleep_s = e.retry_after + self._retry_after_pad_seconds
-                    logging.warning(
-                        "Telegram flood control active; retrying in %.1fs", sleep_s
+                    await self._transport(self.chat_id, text)
+                except RetryAfterError as flood:
+                    pause = flood.retry_after + self._retry_after_pad_seconds
+                    log.warning(
+                        "Telegram flood control active; retrying in %.1fs", pause
                     )
-                    await asyncio.sleep(sleep_s)
+                    await asyncio.sleep(pause)
+                    continue
+                self._sent_monotonic = time.monotonic()
+                return
 
     async def send_signal(self, message: str) -> None:
+        """send_msg that swallows every error (alerting must never crash)."""
         try:
-            cleaned = self._clean_signal_message(message)
-            if not cleaned:
-                return
-            await self.send_msg(cleaned)
-        except Exception as e:
-            logging.error("Error sending telegram signal: %s", e)
-            logging.error("Original message: %s", message)
-
-    def _finish_signal_task(
-        self, task: asyncio.Task, signal_key: str | None = None
-    ) -> None:
-        self._background_tasks.discard(task)
-        if signal_key is not None:
-            self._pending_signal_keys.discard(signal_key)
+            condensed = _condense(message)
+            if condensed:
+                await self.send_msg(condensed)
+        except Exception as exc:
+            log.error("Error sending telegram signal: %s", exc)
+            log.error("Original message: %s", message)
 
     def dispatch_signal(self, message: str) -> asyncio.Task | None:
-        """Fire-and-forget send; never propagates exceptions (l.193-212)."""
+        """Fire-and-forget entry point used by the emission path.
+
+        Returns the created task (kept alive in ``_background_tasks``), or
+        None when disabled, empty, or suppressed as a duplicate.
+        """
         if not self.is_enabled:
             return None
-        cleaned = self._clean_signal_message(message)
-        if not cleaned:
+        condensed = _condense(message)
+        if not condensed:
             return None
-        signal_key = self._signal_dedupe_key(cleaned)
-        if self._drop_duplicate_signal(signal_key):
+        key = parse_fingerprint(condensed).key()
+        if not self._ledger.admit(key, self._signal_dedupe_seconds):
             return None
-        task = asyncio.create_task(self.send_signal(cleaned))
+
+        task = asyncio.create_task(self.send_signal(condensed))
         self._background_tasks.add(task)
-        task.add_done_callback(lambda t: self._finish_signal_task(t, signal_key))
+
+        def _done(t: asyncio.Task, key: tuple[str, ...] = key) -> None:
+            self._background_tasks.discard(t)
+            self._ledger.release(key)
+
+        task.add_done_callback(_done)
         return task

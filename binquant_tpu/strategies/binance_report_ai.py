@@ -1,37 +1,58 @@
 """BinanceAIReport — external AI-report feature extraction (host-side).
 
-Equivalent of ``/root/reference/strategies/binance_report_ai.py``: scrapes
-Binance's AI report endpoint per base token and turns the JSON into a
-keyword-flag feature vector, a directional signal dict, social flags, and a
-final ternary report. Pure I/O + text heuristics, so it stays host-side; the
-network call is injected (``fetch``) so tests and offline replay never touch
-the network.
+Covers the capability of ``/root/reference/strategies/binance_report_ai.py``:
+turn Binance's per-token AI-report JSON into a numeric feature vector, a
+directional signal dict, social/community flags, and a ternary verdict.
+
+The implementation is table-driven rather than a run of inline flag
+assignments and repeated threshold expressions:
+
+* ``_LEXICON`` declares every keyword flag once — its feature name, its
+  bias polarity (bull/bear/neutral), whether it is exported in the feature
+  dict, and the phrases that raise it. The phrase lists and thresholds are
+  behavior constants shared with the reference; the machinery is not.
+* ``ReportDigest`` is the parsed intermediate (freshness, point counts,
+  community posts, raised flags) produced by pure functions over the JSON.
+* The bull/bear cases are each a tuple of named predicates; the signal
+  dict, the fired test, and the final verdict are all derived from those
+  two tuples instead of restating the comparisons.
+
+Network access is injected (``fetch``) so tests and offline replay never
+touch the network; ``default_fetch`` POSTs to the public endpoint.
 """
 
 from __future__ import annotations
 
 import time
 from collections.abc import Callable
+from dataclasses import dataclass, field
 from math import tanh
 from typing import Any
 
 BINANCE_AI_ENDPOINT = (
     "https://www.binance.com/bapi/bigdata/v3/friendly/bigdata/search/ai-report/report"
 )
-QUOTE_ASSETS = ["USDT", "USDC", "BUSD", "TRY", "EUR", "BTC", "ETH"]
+# Simple heuristic for deriving a base token from a trading symbol.
+QUOTE_ASSETS = ("USDT", "USDC", "BUSD", "TRY", "EUR", "BTC", "ETH")
+
+DEFAULT_FRESH_MINUTES = 8 * 60
 
 
-def count_points(mod_list: list[dict]) -> int:
-    return sum(len(m.get("points", []) or []) for m in mod_list)
+def base_asset_of(symbol: str) -> str:
+    """Strip a known quote asset suffix: BTCUSDT -> BTC."""
+    plain = symbol.replace("-", "").upper()
+    for quote in QUOTE_ASSETS:
+        if plain.endswith(quote) and len(plain) > len(quote):
+            return plain[: -len(quote)]
+    return plain
 
 
 def default_fetch(symbol: str, token: str) -> dict | None:  # pragma: no cover
-    """POST to the Binance AI-report endpoint (reference fetch_report,
-    l.33-57). Kept separate so the extractor is testable offline."""
+    """POST to the Binance AI-report endpoint (reference l.33-57)."""
     import json
     import urllib.request
 
-    payload = {
+    body = {
         "lang": "en",
         "token": token,
         "symbol": symbol.upper(),
@@ -40,29 +61,253 @@ def default_fetch(symbol: str, token: str) -> dict | None:  # pragma: no cover
         "translateToken": None,
     }
     try:
-        req = urllib.request.Request(
+        request = urllib.request.Request(
             BINANCE_AI_ENDPOINT,
-            data=json.dumps(payload).encode(),
+            data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        with urllib.request.urlopen(request, timeout=10) as resp:
             return json.loads(resp.read())
     except Exception:
         return None
 
 
+# ---------------------------------------------------------------------------
+# Lexicon: every keyword flag, declared once.
+# polarity: +1 feeds bull support, -1 feeds bear pressure, 0 is contextual.
+# exported: whether the flag appears in the feature dict (reference exports
+# five of the nine; the other four only feed the bias sum).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _Cue:
+    name: str
+    polarity: int
+    exported: bool
+    phrases: tuple[str, ...]
+
+
+_LEXICON = (
+    _Cue("macd_bullish_flag", +1, True, ("macd", "bullish crossover")),
+    _Cue("price_resilience_flag", +1, False, ("resilience", "altcoins", "80-99%")),
+    _Cue(
+        "institutional_adoption_flag",
+        +1,
+        False,
+        ("institutional", "adoption", "survey"),
+    ),
+    _Cue("ema_bearish_flag", -1, True, ("ema7", "ema25", "ema99", "bearish")),
+    _Cue("outflow_flag", -1, False, ("net outflow", "outflow")),
+    _Cue(
+        "macro_headwind_flag", -1, False, ("geopolitical", "trade tensions", "tariff")
+    ),
+    _Cue("volatility_decreasing_flag", 0, True, ("decreasing volatility",)),
+    _Cue(
+        "coinbase_premium_weak_flag",
+        0,
+        True,
+        ("premium gaps", "weak demand", "coinbase"),
+    ),
+    _Cue("sentiment_mixed_flag", 0, True, ("mixed sentiment", "mixed outlook")),
+)
+
+LARGE_DISCUSSION_POST_COUNT = 10
+
+
+# ---------------------------------------------------------------------------
+# Parsing: pure functions over the report JSON
+# ---------------------------------------------------------------------------
+
+
+def _report_original(report_json: dict) -> dict:
+    data = report_json.get("data", {})
+    if "report" in data:
+        return data.get("report", {}).get("original", {})
+    return data.get("original", {})
+
+
+def _points_of(module: dict) -> list[dict]:
+    return module.get("points", []) or []
+
+
+def _corpus(modules: list[dict]) -> str:
+    """All point contents + module overviews, lowercased for matching."""
+    texts: list[str] = []
+    for module in modules:
+        texts.extend(p["content"] for p in _points_of(module) if p.get("content"))
+        if module.get("overview"):
+            texts.append(module["overview"])
+    return " \n ".join(texts).lower()
+
+
+def _post_citations(modules: list[dict]) -> int:
+    posts = 0
+    for module in modules:
+        if module.get("type") != "community_sentiment":
+            continue
+        for point in _points_of(module):
+            for ref in point.get("citationRefs", []) or []:
+                if ref.get("type") == "post":
+                    posts += int(ref.get("count", 0))
+    return posts
+
+
+def _point_total(modules: list[dict], module_type: str) -> int:
+    return sum(
+        len(_points_of(m)) for m in modules if m.get("type") == module_type
+    )
+
+
+@dataclass
+class ReportDigest:
+    """Parsed intermediate between the raw JSON and the feature dict."""
+
+    age_minutes: float
+    fresh: bool
+    opportunity_points: int = 0
+    risk_points: int = 0
+    community_posts: int = 0
+    flags: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def net_bias(self) -> int:
+        return sum(
+            cue.polarity * self.flags.get(cue.name, 0) for cue in _LEXICON
+        )
+
+
+def digest_report(
+    report_json: dict,
+    *,
+    now_ms: float,
+    max_fresh_minutes: float = DEFAULT_FRESH_MINUTES,
+) -> ReportDigest | None:
+    original = _report_original(report_json)
+    if not original:
+        return None
+
+    update_ms = int(original.get("reportMeta", {}).get("updateAt", 0))
+    age_minutes = (now_ms - update_ms) / 60000.0 if update_ms else 1e9
+    digest = ReportDigest(
+        age_minutes=age_minutes, fresh=age_minutes <= max_fresh_minutes
+    )
+    if not digest.fresh:
+        return digest
+
+    modules = original.get("modules", []) or []
+    digest.opportunity_points = _point_total(modules, "opportunities")
+    digest.risk_points = _point_total(modules, "risks")
+    digest.community_posts = _post_citations(modules)
+    corpus = _corpus(modules)
+    digest.flags = {
+        cue.name: int(any(ph.lower() in corpus for ph in cue.phrases))
+        for cue in _LEXICON
+    }
+    return digest
+
+
+def digest_features(digest: ReportDigest, *, normalize: bool = True) -> dict:
+    """The flat feature dict downstream consumers read."""
+    features: dict[str, Any] = {
+        "external_available": 1,
+        "external_stale_flag": int(not digest.fresh),
+        "external_age_minutes": round(digest.age_minutes, 2),
+    }
+    if not digest.fresh:
+        return features
+
+    opp, risk = digest.opportunity_points, digest.risk_points
+    bias = digest.net_bias
+    features.update(
+        {
+            "opp_count": opp,
+            "risk_count": risk,
+            "opp_risk_ratio": round((opp + 1) / (risk + 1), 4),
+            "net_signal_score": opp - risk,
+            "community_post_count": digest.community_posts,
+            "large_discussion_flag": int(
+                digest.community_posts >= LARGE_DISCUSSION_POST_COUNT
+            ),
+            "external_net_bias": bias,
+            "external_bias_normalized": round(tanh(bias) if normalize else bias, 4),
+        }
+    )
+    features.update(
+        {cue.name: digest.flags.get(cue.name, 0) for cue in _LEXICON if cue.exported}
+    )
+    return features
+
+
+# ---------------------------------------------------------------------------
+# Signal derivation: the bull and bear cases as predicate tables
+# ---------------------------------------------------------------------------
+
+# Each entry: (feature key, predicate(features value, thresholds)).
+# The bull case fires on strong positive bias, opportunity dominance, and a
+# MACD cue; the bear case mirrors it with EMA weakness.
+_BULL_CASE = (
+    ("external_bias_normalized", lambda v, t: v > t.bias),
+    ("opp_risk_ratio", lambda v, t: v > t.opp_risk),
+    ("net_signal_score", lambda v, t: v > t.net),
+    ("macd_bullish_flag", lambda v, t: v == 1),
+)
+_BEAR_CASE = (
+    ("external_bias_normalized", lambda v, t: v < -t.bias),
+    ("opp_risk_ratio", lambda v, t: v < 1),
+    ("net_signal_score", lambda v, t: v < -t.net),
+    ("ema_bearish_flag", lambda v, t: v == 1),
+)
+
+# Which fields land in the signal dict, and when. The ratio rides along
+# whenever it exists so consumers always see the opportunity/risk balance.
+_SIGNAL_EXPORTS = (
+    ("external_bias_normalized", lambda v, t: v > t.bias or v < -t.bias),
+    ("opp_risk_ratio", lambda v, t: bool(v)),
+    ("net_signal_score", lambda v, t: v > t.net or v < -t.net),
+    ("macd_bullish_flag", lambda v, t: v == 1),
+    ("ema_bearish_flag", lambda v, t: v == 1),
+)
+
+# Social surface: (field, include-in-dict predicate, fires predicate).
+_SOCIAL_EXPORTS = (
+    ("large_discussion_flag", lambda v: v > 0, lambda v: v > 1),
+    ("community_post_count", lambda v: v >= 2, lambda v: v > 1),
+    ("sentiment_mixed_flag", lambda v: v > 0, lambda v: v > 1),
+    ("coinbase_premium_weak_flag", lambda v: v > 1, lambda v: v > 1),
+)
+
+_FEATURE_DEFAULTS = {"opp_risk_ratio": 1}
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    bias: float = 0.5
+    opp_risk: float = 1.2
+    net: int = 1
+
+
+def _case_votes(features: dict, case, thresholds: Thresholds) -> list[bool]:
+    return [
+        bool(check(features.get(name, _FEATURE_DEFAULTS.get(name, 0)), thresholds))
+        for name, check in case
+    ]
+
+
 class BinanceAIReport:
-    """Feature extraction + signal derivation (reference l.11-279)."""
+    """Fetch + digest + derive, per symbol (reference l.11-279)."""
 
     def __init__(
         self,
         symbol: str,
-        base_asset: str,
+        base_asset: str = "",
         fetch: Callable[[str, str], dict | None] = default_fetch,
         now_ms: Callable[[], float] | None = None,
     ) -> None:
         self.symbol = symbol.replace("-", "")
-        self.base_asset = base_asset
+        # callers that know the exchange's base asset pass it; otherwise
+        # fall back to the quote-suffix heuristic
+        self.base_asset = base_asset or base_asset_of(self.symbol)
         self._fetch = fetch
         self._now_ms = now_ms or (lambda: time.time() * 1000)
 
@@ -72,183 +317,68 @@ class BinanceAIReport:
         return self._fetch(self.symbol, self.base_asset)
 
     def extract_features(
-        self, max_fresh_minutes: int = 8 * 60, normalize: bool = True
+        self, max_fresh_minutes: int = DEFAULT_FRESH_MINUTES, normalize: bool = True
     ) -> dict | None:
-        """Heuristic external feature vector from the raw report JSON
-        (reference l.59-152)."""
         report_json = self.fetch_report()
         if not report_json:
             return None
-
-        data = report_json.get("data", {})
-        original = (
-            data.get("report", {}).get("original", {})
-            if "report" in data
-            else data.get("original", {})
+        digest = digest_report(
+            report_json,
+            now_ms=self._now_ms(),
+            max_fresh_minutes=max_fresh_minutes,
         )
-        if not original:
+        if digest is None:
             return None
-        report_meta = original.get("reportMeta", {})
-        modules = original.get("modules", []) or []
-        update_ms = int(report_meta.get("updateAt", 0))
-        age_minutes = (self._now_ms() - update_ms) / 60000.0 if update_ms else 1e9
-        fresh = age_minutes <= max_fresh_minutes
-        base: dict[str, Any] = {
-            "external_available": 1,
-            "external_stale_flag": int(not fresh),
-            "external_age_minutes": round(age_minutes, 2),
-        }
-        if not fresh:
-            return base
-
-        by_type: dict[str, list[dict]] = {}
-        for m in modules:
-            by_type.setdefault(m.get("type", ""), []).append(m)
-        opp_count = count_points(by_type.get("opportunities", []))
-        risk_count = count_points(by_type.get("risks", []))
-        community_posts = 0
-        for m in by_type.get("community_sentiment", []):
-            for p in m.get("points", []) or []:
-                for ref in p.get("citationRefs", []) or []:
-                    if ref.get("type") == "post":
-                        community_posts += int(ref.get("count", 0))
-
-        texts = []
-        for m in modules:
-            for p in m.get("points", []) or []:
-                if p.get("content"):
-                    texts.append(p["content"])
-            if m.get("overview"):
-                texts.append(m["overview"])
-        joined = " \n ".join(texts).lower()
-
-        def kw_flag(*phrases: str) -> int:
-            return int(any(ph.lower() in joined for ph in phrases))
-
-        macd_bullish_flag = kw_flag("macd", "bullish crossover")
-        ema_bearish_flag = kw_flag("ema7", "ema25", "ema99", "bearish")
-        volatility_decreasing_flag = kw_flag("decreasing volatility")
-        price_resilience_flag = kw_flag("resilience", "altcoins", "80-99%")
-        outflow_flag = kw_flag("net outflow", "outflow")
-        coinbase_premium_weak_flag = kw_flag("premium gaps", "weak demand", "coinbase")
-        institutional_adoption_flag = kw_flag("institutional", "adoption", "survey")
-        macro_headwind_flag = kw_flag("geopolitical", "trade tensions", "tariff")
-        sentiment_mixed_flag = kw_flag("mixed sentiment", "mixed outlook")
-
-        bull_support = (
-            macd_bullish_flag + institutional_adoption_flag + price_resilience_flag
-        )
-        bear_pressure = ema_bearish_flag + outflow_flag + macro_headwind_flag
-        net_bias = bull_support - bear_pressure
-        bias_norm = tanh(net_bias) if normalize else net_bias
-
-        base.update(
-            {
-                "opp_count": opp_count,
-                "risk_count": risk_count,
-                "opp_risk_ratio": round((opp_count + 1) / (risk_count + 1), 4),
-                "net_signal_score": opp_count - risk_count,
-                "community_post_count": community_posts,
-                "large_discussion_flag": int(community_posts >= 10),
-                "external_net_bias": net_bias,
-                "external_bias_normalized": round(bias_norm, 4),
-                "macd_bullish_flag": macd_bullish_flag,
-                "ema_bearish_flag": ema_bearish_flag,
-                "sentiment_mixed_flag": sentiment_mixed_flag,
-                "volatility_decreasing_flag": volatility_decreasing_flag,
-                "coinbase_premium_weak_flag": coinbase_premium_weak_flag,
-            }
-        )
-        return base
+        return digest_features(digest, normalize=normalize)
 
     def ai_report_signal(
         self, bias_thr: float = 0.5, opp_risk_thr: float = 1.2, net_score_thr: int = 1
     ) -> dict | None:
-        """Directional signal dict (reference l.154-213)."""
+        """The notable directional fields, or None when nothing is notable."""
         features = self.extract_features()
         if not features:
             return None
+        thresholds = Thresholds(bias_thr, opp_risk_thr, net_score_thr)
 
-        signal_type: dict[str, Any] = {}
-        bias = features.get("external_bias_normalized", 0)
-        ratio = features.get("opp_risk_ratio", 1)
-        net = features.get("net_signal_score", 0)
-
-        if bias > bias_thr:
-            signal_type["external_bias_normalized"] = bias
-        if ratio:
-            signal_type["opp_risk_ratio"] = ratio
-        if net > net_score_thr:
-            signal_type["net_signal_score"] = net
-        if features.get("macd_bullish_flag", 0) == 1:
-            signal_type["macd_bullish_flag"] = 1
-        if bias < -bias_thr:
-            signal_type["external_bias_normalized"] = bias
-        if ratio < 1:
-            signal_type["opp_risk_ratio"] = ratio
-        if net < -net_score_thr:
-            signal_type["net_signal_score"] = net
-        if features.get("ema_bearish_flag", 0) == 1:
-            signal_type["ema_bearish_flag"] = 1
-
-        fired = (
-            bias > bias_thr
-            or ratio > opp_risk_thr
-            or net > net_score_thr
-            or features.get("macd_bullish_flag", 0) == 1
-            or bias < -bias_thr
-            or ratio < 1
-            or net < -net_score_thr
-            or features.get("ema_bearish_flag", 0) == 1
+        fired = any(_case_votes(features, _BULL_CASE, thresholds)) or any(
+            _case_votes(features, _BEAR_CASE, thresholds)
         )
-        return signal_type if fired else None
+        if not fired:
+            return None
+        return {
+            name: features.get(name, _FEATURE_DEFAULTS.get(name, 0))
+            for name, include in _SIGNAL_EXPORTS
+            if include(features.get(name, _FEATURE_DEFAULTS.get(name, 0)), thresholds)
+        }
 
     def social_features_flag(self) -> dict | None:
-        """Social/community flags (reference l.215-252)."""
+        """Notable social/community context. Polarity is the caller's call:
+        mixed sentiment and weak premium signal caution, not bullishness."""
         features = self.extract_features()
         if not features:
             return None
-        signal_type: dict[str, Any] = {}
-        if features.get("large_discussion_flag", 0) > 0:
-            signal_type["large_discussion_flag"] = features["large_discussion_flag"]
-        if features.get("community_post_count", 0) >= 2:
-            signal_type["community_post_count"] = features["community_post_count"]
-        if features.get("sentiment_mixed_flag", 0) > 0:
-            signal_type["sentiment_mixed_flag"] = features["sentiment_mixed_flag"]
-        if features.get("coinbase_premium_weak_flag", 0) > 1:
-            signal_type["coinbase_premium_weak_flag"] = features[
-                "coinbase_premium_weak_flag"
-            ]
-        fired = (
-            features.get("large_discussion_flag", 0) > 1
-            or features.get("community_post_count", 0) > 1
-            or features.get("sentiment_mixed_flag", 0) > 1
-            or features.get("coinbase_premium_weak_flag", 0) > 1
+        fired = any(
+            fires(features.get(name, 0)) for name, _, fires in _SOCIAL_EXPORTS
         )
-        return signal_type if fired else None
+        if not fired:
+            return None
+        return {
+            name: features[name]
+            for name, include, _ in _SOCIAL_EXPORTS
+            if include(features.get(name, 0))
+        }
 
     def final_report(
         self, bias_thr: float = 0.5, opp_risk_thr: float = 1.2, net_score_thr: int = 1
     ) -> int:
-        """Ternary verdict: 1 bullish / −1 bearish / 0 neutral (l.258-279)."""
+        """Ternary verdict: 1 when the whole bull case holds, -1 when the
+        whole bear case holds, else 0."""
         features = self.extract_features()
         if not features or not features.get("external_available", 0):
             return 0
-        bias = features.get("external_bias_normalized", 0)
-        ratio = features.get("opp_risk_ratio", 1)
-        net = features.get("net_signal_score", 0)
-        if (
-            bias > bias_thr
-            and ratio > opp_risk_thr
-            and net > net_score_thr
-            and features.get("macd_bullish_flag", 0) == 1
-        ):
+        thresholds = Thresholds(bias_thr, opp_risk_thr, net_score_thr)
+        if all(_case_votes(features, _BULL_CASE, thresholds)):
             return 1
-        if (
-            bias < -bias_thr
-            and ratio < 1
-            and net < -net_score_thr
-            and features.get("ema_bearish_flag", 0) == 1
-        ):
+        if all(_case_votes(features, _BEAR_CASE, thresholds)):
             return -1
         return 0

@@ -151,6 +151,23 @@ class Config:
         return int(self._get("BQT_PIPELINE_DEPTH", "1"))
 
     @cached_property
+    def early_emit(self) -> bool:
+        """Fired-tick fast path: consume_loop emits a dispatched tick's
+        signals as soon as its wire lands (~device RTT after dispatch)
+        instead of when the next tick evicts it (~one cadence). Disable
+        (BQT_EARLY_EMIT=0) for strictly tick-aligned emission."""
+        return self._get("BQT_EARLY_EMIT", "1") != "0"
+
+    @cached_property
+    def mesh_devices(self) -> int:
+        """Shard the symbol axis of the live engine over this many devices
+        (jax.sharding 1-D ``symbols`` mesh). 0/1 = single chip. The batch
+        outgrowing one chip is the only reason ICI enters (SURVEY §5);
+        host ingest/emission are unchanged — XLA inserts the context
+        reductions as collectives."""
+        return int(self._get("BQT_MESH_DEVICES", "0") or 0)
+
+    @cached_property
     def heartbeat_path(self) -> str:
         return self._get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")
 

@@ -85,7 +85,11 @@ class HostInputs(NamedTuple):
     adp_diff: jnp.ndarray  # f32 — breadth[-1]-breadth[-2]
     adp_diff_prev: jnp.ndarray  # f32 — breadth[-2]-breadth[-3]
     breadth_momentum_points: jnp.ndarray  # f32, NaN unavailable
-    quiet_hours: jnp.ndarray  # bool — wall-clock quiet window active
+    # bool — London 20:00-23:00 quiet WINDOW active (pure wall clock). The
+    # strong-stable-trend override is applied device-side from the CURRENT
+    # tick's context (the reference reads the live context,
+    # time_of_day_filter.py:60-76) — not a carried previous-tick regime.
+    quiet_hours: jnp.ndarray
     grid_policy_allows: jnp.ndarray  # bool — GridOnlyPolicy.allow_grid_ladder
     is_futures: jnp.ndarray  # bool — autotrade settings market type
     dominance_is_losers: jnp.ndarray  # bool
@@ -375,11 +379,19 @@ def _tick_step_impl(
     rets = log_returns(close15)
     safe_btc = jnp.clip(inputs.btc_row, 0, S - 1)
     btc_ok = (inputs.btc_row >= 0) & (inputs.btc_row < S)
-    btc_rets = jnp.where(btc_ok, rets[safe_btc], jnp.nan)
+    # Extract the BTC row as a masked reduction, not `rets[btc_row]`: a
+    # dynamic row index on a symbol-sharded matrix makes the SPMD
+    # partitioner all-gather the full (S, W) array (~3 MB at production
+    # shape — caught by __graft_entry__._collective_audit); the one-hot
+    # sum communicates only the (W,) result.
+    btc_onehot = (jnp.arange(S) == safe_btc)[:, None]
+    btc_rets_row = jnp.where(btc_onehot, rets, 0.0).sum(axis=0)
+    btc_close_row = jnp.where(btc_onehot, close15, 0.0).sum(axis=0)
+    btc_rets = jnp.where(btc_ok, btc_rets_row, jnp.nan)
     bc = rolling_beta_corr(rets, btc_rets[None, :], window=50)
     btc_beta = jnp.where(jnp.isfinite(bc.beta[:, -1]), bc.beta[:, -1], 0.0)
     btc_corr = jnp.where(jnp.isfinite(bc.corr[:, -1]), bc.corr[:, -1], 0.0)
-    btc_close = jnp.where(btc_ok, close15[safe_btc], jnp.nan)
+    btc_close = jnp.where(btc_ok, btc_close_row, jnp.nan)
     W = close15.shape[-1]
     if W > 96:
         base = btc_close[-97]
@@ -393,10 +405,30 @@ def _tick_step_impl(
     ok5 = pack5.filled >= MIN_BARS
     ok15 = pack15.filled >= MIN_BARS
 
+    # Quiet-hours suppression with the strong-stable-trend override judged
+    # against the context computed THIS tick (reference semantics:
+    # time_of_day_filter.py:60-76 reads the live context; an invalid
+    # context always suppresses inside the window). Constants shared with
+    # the host filter so the oracle A/B and the device can never diverge.
+    from binquant_tpu.regime.time_filter import (
+        MIN_TRANSITION_STRENGTH,
+        OVERRIDE_REGIMES,
+    )
+
+    strong_trend = jnp.zeros((), dtype=bool)
+    for code in sorted(OVERRIDE_REGIMES):
+        strong_trend = strong_trend | (context.market_regime == code)
+    trend_override = (
+        context.valid
+        & strong_trend
+        & (context.market_regime_transition_strength >= MIN_TRANSITION_STRENGTH)
+    )
+    quiet_suppressed = inputs.quiet_hours & ~trend_override
+
     # --- live 5m set (dispatch order l.369-389)
     abp = _mask_outputs(activity_burst_pump(buf5, context), ok5 & fresh5)
     pt, pt_carry = price_tracker(
-        pack5, context, inputs.quiet_hours, state.pt_last_signal_close
+        pack5, context, quiet_suppressed, state.pt_last_signal_close
     )
     pt = _mask_outputs(pt, ok5 & fresh5)
     pt_carry = jnp.where(ok5 & fresh5, pt_carry, state.pt_last_signal_close)
@@ -442,7 +474,7 @@ def _tick_step_impl(
         ok15 & fresh15,
     )
     btd = _mask_outputs(
-        buy_the_dip(buf15, pack15, context, inputs.quiet_hours), ok15 & fresh15
+        buy_the_dip(buf15, pack15, context, quiet_suppressed), ok15 & fresh15
     )
     # BBX ships ENABLED=False (reference l.45-46); opting it into the wire
     # set (enabled_strategies override) also enables the kernel — the
@@ -651,6 +683,30 @@ def _tick_step_impl(
 
 tick_step = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
     _tick_step_impl
+)
+
+
+def _tick_step_wire_impl(
+    state: EngineState,
+    upd5,
+    upd15,
+    inputs: HostInputs,
+    cfg: ContextConfig = ContextConfig(),
+    wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+) -> tuple[EngineState, jnp.ndarray]:
+    """The live engine's step: identical evaluation, but only the wire
+    leaves the computation. The full ``TickOutputs`` pytree is ~400 output
+    buffers; each one costs host-side handle creation at dispatch (and IPC
+    through a tunneled device) — measured at S=2048 the full step's paced
+    dispatch is ~6.6 ms vs ~2.9 ms wire-only. The host consumes nothing but
+    the wire on the common path anyway (io/emission.py); overflow/fallback
+    paths re-run the full ``tick_step`` (pure function, same inputs)."""
+    new_state, outputs = _tick_step_impl(state, upd5, upd15, inputs, cfg, wire_enabled)
+    return new_state, outputs.wire
+
+
+tick_step_wire = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
+    _tick_step_wire_impl
 )
 
 # Bench/throughput variant: donates the carried EngineState so the ring

@@ -133,6 +133,10 @@ class CheckpointManager:
         except Exception:
             logging.exception("checkpoint restore failed; starting cold")
             return False
+        if getattr(engine, "mesh", None) is not None:
+            from binquant_tpu.parallel.mesh import shard_engine_state
+
+            state = shard_engine_state(state, engine.mesh)
         engine.state = state
         engine.restore_host_carries(carries)
         logging.info(

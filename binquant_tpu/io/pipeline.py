@@ -30,6 +30,7 @@ from binquant_tpu.engine.step import (
     initial_engine_state,
     pad_updates,
     tick_step,
+    tick_step_wire,
     unpack_wire,
 )
 from binquant_tpu.io.autotrade import AutotradeConsumer
@@ -45,7 +46,7 @@ from binquant_tpu.io.metrics import LatencyTracker
 from binquant_tpu.io.telegram import TelegramConsumer
 from binquant_tpu.regime.context import ContextConfig
 from binquant_tpu.regime.grid_policy import GridOnlyPolicy
-from binquant_tpu.regime.time_filter import is_autotrade_suppressed
+from binquant_tpu.regime.time_filter import is_quiet_hours
 from binquant_tpu.schemas import MarketBreadthSeries
 from binquant_tpu.strategies.market_regime_notifier import MarketRegimeNotifier
 
@@ -198,11 +199,14 @@ class OpenInterestCache:
 class _PendingTick(NamedTuple):
     """A dispatched-but-not-yet-emitted tick riding the device pipeline."""
 
-    outputs: Any  # TickOutputs — wire D2H already started
+    wire: Any  # (L,) device array — async D2H already started
+    fallback: Any  # () -> TickOutputs — re-runs the FULL step (pure; used
+    # only on wire overflow or a payload-less wire)
     ts_ms: int
     ts5: int
     ts15: int
     bucket15: int
+    dispatched_at: float  # perf_counter at dispatch (signal-lag metric)
 
 
 class SignalEngine:
@@ -231,6 +235,42 @@ class SignalEngine:
         self.batcher5 = IngestBatcher(self.registry)
         self.batcher15 = IngestBatcher(self.registry)
         self.state = initial_engine_state(self.capacity, window=window)
+        # Production multi-chip mode (BQT_MESH_DEVICES>1): shard the
+        # carried state over a 1-D `symbols` mesh ONCE; jit sharding
+        # propagation keeps every tick's outputs (incl. the carried state)
+        # sharded, so the per-tick path never re-places anything. Host
+        # ingest and emission are unchanged — the wire is tiny and
+        # fully replicated by its final concatenate reduction.
+        self.mesh = None
+        mesh_n = getattr(config, "mesh_devices", 0)
+        if mesh_n and mesh_n > 1:
+            import jax
+
+            from binquant_tpu.parallel.mesh import make_mesh, shard_engine_state
+
+            devices = jax.devices()
+            if len(devices) < mesh_n:
+                logging.warning(
+                    "BQT_MESH_DEVICES=%d but only %d device(s) visible; "
+                    "running single-chip",
+                    mesh_n,
+                    len(devices),
+                )
+            elif self.capacity % mesh_n != 0:
+                logging.warning(
+                    "capacity %d not divisible by mesh size %d; "
+                    "running single-chip",
+                    self.capacity,
+                    mesh_n,
+                )
+            else:
+                self.mesh = make_mesh(devices[:mesh_n])
+                self.state = shard_engine_state(self.state, self.mesh)
+                logging.info(
+                    "symbol axis sharded over %d devices (%s)",
+                    mesh_n,
+                    self.mesh.shape,
+                )
         self.context_config = context_config
         self.btc_symbol = btc_symbol
         self.notifier = MarketRegimeNotifier(env=config.env)
@@ -243,7 +283,9 @@ class SignalEngine:
         self._last_breadth_bucket = -1
         self._last_calibration_bucket = -1
         self._pending_oi: dict[int, float] = {}
-        # quiet-hours override inputs: previous tick's regime state
+        # last valid regime/strength seen (checkpoint introspection only —
+        # the quiet-hours override reads the CURRENT tick's context
+        # device-side, engine/step.py)
         self._last_regime: int | None = None
         self._last_transition_strength: float = 0.0
         # per-bar emission dedupe: (strategy, symbol) -> last emitted bar
@@ -262,6 +304,12 @@ class SignalEngine:
         # per-stage latency histograms (SURVEY §5: the p99<50ms budget is
         # measured in production, not guessed)
         self.latency = LatencyTracker()
+        # Fired-tick fast path: consume_loop lands + emits a dispatched
+        # tick's wire as soon as it arrives instead of waiting for the next
+        # tick to evict it — cuts the depth-1 emission lag from one full
+        # cadence (~1 s) to roughly the device round trip. Off for replay
+        # determinism when BQT_EARLY_EMIT=0.
+        self.early_emit = getattr(config, "early_emit", True)
         # Tick pipelining: dispatch tick i to the device, start its wire's
         # async D2H, and emit tick i-1's already-landed wire — the host
         # never blocks on the device round trip. depth=0 is the serial
@@ -275,6 +323,9 @@ class SignalEngine:
         # HostInputs template built once: re-creating all 16 device arrays
         # per tick costs a dozen extra H2D dispatches
         self._base_inputs = None
+        # (wire key, update shapes) whose full-step fallback compile has
+        # been background-warmed (see _dispatch_tick)
+        self._fallback_warmed: set[tuple] = set()
         # per-name device-scalar cache: breadth scalars change once per
         # bucket and the flags rarely — re-uploading identical values
         # every tick is allocation churn that shows up as inputs_build
@@ -486,6 +537,30 @@ class SignalEngine:
             fired.extend(await self._finalize_tick(self._pending.popleft()))
         return fired
 
+    async def emit_ready(self) -> list:
+        """Fired-tick fast path: land and emit the oldest in-flight tick
+        NOW instead of waiting for the next tick to evict it.
+
+        At depth 1 the pipelined loop otherwise emits tick i's signals a
+        full cadence (~1 s) later; this waits out only the device round
+        trip. The wire is landed in a worker thread so the event loop (WS
+        ingest, Telegram sends) never blocks on the transfer; finalize
+        order — and therefore the host-carry lag the A/B oracle pins — is
+        unchanged, signals just leave earlier in wall time.
+        """
+        if not self._pending:
+            return []
+        head = self._pending[0]
+        try:
+            await asyncio.to_thread(np.asarray, head.wire)
+        except Exception:
+            logging.exception("early-emit wire landing failed; deferring")
+            return []
+        if self._pending and self._pending[0] is head:
+            self._pending.popleft()
+            return await self._finalize_tick(head)
+        return []
+
     async def _dispatch_tick(self, now_ms: int | None = None) -> _PendingTick:
         """Drain batchers and launch the jit'd step + async wire transfer."""
         import jax.numpy as jnp
@@ -523,17 +598,15 @@ class SignalEngine:
             self._breadth_scalars()
         )
         settings = self.at_consumer.autotrade_settings
-        # Quiet-hours with the strong-stable-trend override: judged against
-        # the PREVIOUS tick's regime/transition-strength (the reference
-        # evaluates the filter with the live context —
-        # time_of_day_filter.py:60-76; a missing context always suppresses).
-        # The filter reads the EVALUATED tick time, not the wall clock —
-        # identical live (tick time ≈ now), and it makes replays
+        # Quiet-hours: the host resolves only the wall-clock WINDOW; the
+        # strong-stable-trend override is applied device-side inside
+        # tick_step from the context computed THIS tick — the reference's
+        # exact semantics (time_of_day_filter.py:60-76 reads the live
+        # context). The window reads the EVALUATED tick time, not the wall
+        # clock — identical live (tick time ≈ now), and it makes replays
         # deterministic instead of depending on when they happen to run.
-        quiet = is_autotrade_suppressed(
-            self._last_regime,
-            self._last_transition_strength,
-            now=datetime.fromtimestamp(ts_ms / 1000, tz=UTC),
+        quiet = is_quiet_hours(
+            now=datetime.fromtimestamp(ts_ms / 1000, tz=UTC)
         )
         # row 0 is a valid registry row — `or -1` would misread it as missing
         _btc = self.registry.row_of(self.btc_symbol)
@@ -545,14 +618,20 @@ class SignalEngine:
         t_inputs0 = time.perf_counter()
         if self._base_inputs is None:
             self._base_inputs = default_host_inputs(self.capacity)
+            if self.mesh is not None:
+                from binquant_tpu.parallel.mesh import shard_host_inputs
+
+                self._base_inputs = shard_host_inputs(
+                    self._base_inputs, self.mesh
+                )
         if oi is None:
             if self._nan_oi_cache is None:
-                self._nan_oi_cache = jnp.full(
-                    (self.capacity,), jnp.nan, dtype=jnp.float32
+                self._nan_oi_cache = self._place_symbol_array(
+                    np.full((self.capacity,), np.nan, dtype=np.float32)
                 )
             oi_dev = self._nan_oi_cache
         else:
-            oi_dev = jnp.asarray(oi)
+            oi_dev = self._place_symbol_array(oi)
         inputs = self._base_inputs._replace(
             tracked=self._tracked_mask(),
             btc_row=np.int32(btc_row),
@@ -600,8 +679,15 @@ class SignalEngine:
             "inputs_build", (time.perf_counter() - t_inputs0) * 1000.0
         )
         with self.latency.stage("device_dispatch"):
-            self.state, outputs = tick_step(
-                self.state,
+            # Wire-only step: the full TickOutputs pytree is ~400 output
+            # buffers whose handle creation dominates dispatch (measured
+            # ~6.6 ms vs ~2.9 ms at S=2048 through the tunneled chip). The
+            # host consumes only the wire; the rare overflow/payload-less
+            # paths re-run the full step via the fallback closure below
+            # (pure function of the captured pre-tick state).
+            prev_state = self.state
+            self.state, wire = tick_step_wire(
+                prev_state,
                 u5,
                 u15,
                 inputs,
@@ -613,23 +699,73 @@ class SignalEngine:
             # finalized (depth ticks later) the transfer has landed and the
             # host-side np.asarray is a copy, not a round trip
             try:
-                outputs.wire.copy_to_host_async()
+                wire.copy_to_host_async()
             except AttributeError:
                 pass  # non-jax array (tests with stubbed steps)
+
+        # NOTE the retention cost: the closure pins the pre-tick state
+        # (dominated by the ~66 MB of ring buffers at production shape) in
+        # device memory until this tick finalizes — one extra state copy
+        # per in-flight tick (~0.4% of a v5e's HBM at depth 1; scale depth
+        # with that in mind).
+        cfg, key = self.context_config, self._wire_enabled_key()
+
+        def fallback(_args=(prev_state, u5, u15, inputs, cfg, key)):
+            st, upd5, upd15, inp, cfg_, key_ = _args
+            _, full = tick_step(st, upd5, upd15, inp, cfg_, wire_enabled=key_)
+            return full
+
+        # Pre-warm the fallback's jit cache in the background the first
+        # time each (wire key, update-bucket shape) appears: without this,
+        # the first overflow tick (>WIRE_MAX_FIRED fired pairs — a broad
+        # market burst, exactly when signals matter) would pay the full
+        # step's trace+compile (tens of seconds) inside finalize. One
+        # throwaway execution per shape bucket (~60 ms device time).
+        # (skipped under CI/replay stubs — a surprise compile there only
+        # costs a test second, and the suite would otherwise pay a full
+        # background compile per stub engine)
+        warm_sig = (key, u5[0].shape, u15[0].shape)
+        if self.config.env != "CI" and warm_sig not in self._fallback_warmed:
+            self._fallback_warmed.add(warm_sig)
+            import threading
+
+            def _warm(args=(prev_state, u5, u15, inputs, cfg, key)):
+                try:
+                    st, upd5, upd15, inp, cfg_, key_ = args
+                    tick_step(st, upd5, upd15, inp, cfg_, wire_enabled=key_)
+                except Exception:
+                    logging.exception("fallback pre-warm failed (non-fatal)")
+
+            threading.Thread(target=_warm, daemon=True).start()
+
         return _PendingTick(
-            outputs=outputs, ts_ms=ts_ms, ts5=ts5, ts15=ts15, bucket15=bucket15
+            wire=wire,
+            fallback=fallback,
+            ts_ms=ts_ms,
+            ts5=ts5,
+            ts15=ts15,
+            bucket15=bucket15,
+            dispatched_at=time.perf_counter(),
         )
 
     async def _finalize_tick(self, pending: _PendingTick) -> list:
         """Consume one dispatched tick's wire: refresh host policy state and
         emit its fired signals through the three sinks."""
-        outputs = pending.outputs
         ts5, ts15 = pending.ts5, pending.ts15
         # ONE device fetch per tick: the packed wire (context scalars +
         # compacted fired entries). Everything host-side below reads it.
         with self.latency.stage("wire_fetch"):
-            unpacked = unpack_wire(outputs.wire)
+            unpacked = unpack_wire(pending.wire)
         fired_w, ctx_scalars = unpacked
+        # The full TickOutputs exists only if a degenerate path needs it:
+        # compaction overflow (>WIRE_MAX_FIRED fired pairs) or a wire
+        # without the emission payload. Re-running the full step costs one
+        # serial round trip — acceptable on a pathological tick, free
+        # otherwise.
+        outputs = None
+        if fired_w.overflow or fired_w.payload is None:
+            with self.latency.stage("overflow_fallback"):
+                outputs = pending.fallback()
         regime = ctx_scalars["market_regime"]
         has_ctx = ctx_scalars["valid"]
         self.grid_only_policy = GridOnlyPolicy.resolve(
@@ -658,11 +794,14 @@ class SignalEngine:
                 )
                 self._run_leverage_calibration(pending.bucket15, calib)
             else:
-                self._run_leverage_calibration(pending.bucket15, outputs.context)
+                # calib rows absent from the wire (fabricated test wires):
+                # fall back to the full outputs' context
+                full = outputs if outputs is not None else pending.fallback()
+                self._run_leverage_calibration(pending.bucket15, full.context)
 
-        # carry regime state for next tick's quiet-hours override; an
-        # invalid context clears it (reference: context None -> suppressed),
-        # so a stale strong-trend reading can't override hours later
+        # carry regime state across restarts (checkpoint introspection; the
+        # quiet-hours override itself is applied device-side from the
+        # CURRENT tick's context). An invalid context clears it.
         if has_ctx:
             self._last_regime = regime
             self._last_transition_strength = ctx_scalars[
@@ -714,11 +853,28 @@ class SignalEngine:
                 )
         self.latency.record("emission", (time.perf_counter() - t_emit0) * 1000.0)
         self.signals_emitted += len(fired)
+        # Signal-latency accounting (the number a trading system cares
+        # about, not just per-tick wall time): dispatch→emit is the
+        # pipelining lag this tick actually paid; candle→emit adds how
+        # stale the evaluated bar already was when the tick dispatched
+        # (logical, from the tick's own clock — exact live, where tick
+        # time ≈ wall clock).
+        emit_lag_ms = (time.perf_counter() - pending.dispatched_at) * 1000.0
+        self.latency.record("dispatch_to_emit", emit_lag_ms)
         for signal in fired:
             # which tick produced this signal — pipelined emission happens
             # one call later, so callers (replay A/B) must not attribute it
             # to the tick that evicted it
             signal.tick_ms = pending.ts_ms
+            bar_close_ms = (
+                (ts5 + FIVE_MIN_S) * 1000
+                if signal.strategy in FIVE_MIN_STRATEGIES
+                else (ts15 + FIFTEEN_MIN_S) * 1000
+            )
+            self.latency.record(
+                "candle_to_emit",
+                (pending.ts_ms - bar_close_ms) + emit_lag_ms,
+            )
         return fired
 
     def _dev_scalar(self, name: str, value):
@@ -735,15 +891,26 @@ class SignalEngine:
         self._scalar_cache[name] = (value, arr)
         return arr
 
+    def _place_symbol_array(self, arr):
+        """Host (S,) array → device, split over the symbol mesh when one is
+        active (pre-placing avoids a per-tick resharding inside jit)."""
+        import jax
+
+        if self.mesh is None:
+            import jax.numpy as jnp
+
+            return jnp.asarray(arr)
+        from binquant_tpu.parallel.mesh import symbol_sharding
+
+        return jax.device_put(arr, symbol_sharding(self.mesh, 1))
+
     def _tracked_mask(self):
         """Device-resident occupied-rows mask, rebuilt only on registry
         membership changes."""
-        import jax.numpy as jnp
-
         cached = self._tracked_cache
         if cached is not None and cached[0] == self.registry.version:
             return cached[1]
-        arr = jnp.asarray(self.registry.active_rows)
+        arr = self._place_symbol_array(self.registry.active_rows)
         self._tracked_cache = (self.registry.version, arr)
         return arr
 
@@ -912,6 +1079,10 @@ class SignalEngine:
                     if len(self.batcher5) or len(self.batcher15):
                         last_tick = time.monotonic()
                         await self.process_tick()
+                        if self.early_emit and self._pending:
+                            # emit this tick's signals as soon as its wire
+                            # lands (~RTT) instead of next tick (~cadence)
+                            await self.emit_ready()
                         if (
                             self.checkpoint is not None
                             and self.checkpoint.should_save(self)

@@ -1091,25 +1091,31 @@ class OracleEvaluator:
         return True, True, direction
 
     def _twap(self, sym: str) -> tuple[bool, bool] | None:
-        """coinrule/twap_momentum_sniper: TWAP(20 trailing 1h blocks) >
-        price, no sharp selloff. Mirrors the device's trailing-4-bar block
-        resample (documented divergence from the reference's calendar
-        alignment — strategies/dormant.py:54-94)."""
+        """coinrule/twap_momentum_sniper: TWAP(last 20 calendar hours) >
+        price, no sharp selloff. Calendar-aligned 15m→1h resample exactly
+        as the reference's ``df.resample('1h')``
+        (producers/context_evaluator.py:392-395), last (partial) hour
+        included, empty hours skipped by the nan-mean — mirroring the
+        device's ``_resample_1h`` (strategies/dormant.py)."""
         df15 = self.store15.frames[sym]
         df5 = self.store5.frames.get(sym)
         if df5 is None or len(df5) < 10 or len(df15) < 8:
             return None
-        n = len(df15) - len(df15) % 4
-        if n < 8:
+        hours = df15["open_time"] // 3_600_000
+        grouped = df15.groupby(hours)
+        last_hr = int(hours.iloc[-1])
+        # the device resamples into twap_window + 2 = 22 hour buckets
+        span = pd.RangeIndex(last_hr - 21, last_hr + 1)
+        o = grouped["open"].first().reindex(span)
+        h = grouped["high"].max().reindex(span)
+        lo = grouped["low"].min().reindex(span)
+        c = grouped["close"].last().reindex(span)
+        bar_avg = ((o + h + lo + c) / 4.0).to_numpy()
+        with np.errstate(invalid="ignore"):
+            twap = float(np.nanmean(bar_avg[-20:]))
+        close_1h = c.to_numpy()
+        if not (np.isfinite(close_1h[-1]) and np.isfinite(close_1h[-2])):
             return None
-        tail = df15.tail(n)
-        o = tail["open"].to_numpy().reshape(-1, 4)
-        h = tail["high"].to_numpy().reshape(-1, 4)
-        lo = tail["low"].to_numpy().reshape(-1, 4)
-        c = tail["close"].to_numpy().reshape(-1, 4)
-        bar_avg = (o[:, 0] + h.max(axis=1) + lo.min(axis=1) + c[:, -1]) / 4.0
-        twap = float(bar_avg[-20:].mean())
-        close_1h = c[:, -1]
         price = float(df5["close"].iloc[-1])
         # "price_decrease" exactly as written in the reference (l.68-70)
         price_decrease = close_1h[-1] - close_1h[-2] / close_1h[-1]
@@ -1383,24 +1389,14 @@ class OracleEvaluator:
     ) -> list[tuple[str, str, str, bool]]:
         """One tick; returns fired (strategy, symbol, direction, autotrade).
 
-        ``quiet=None`` resolves the quiet-hours filter from wall clock and
-        the PREVIOUS tick's regime — the same inputs the live pipeline uses.
+        ``quiet=None`` resolves the quiet-hours filter from the evaluated
+        tick time and the context built THIS tick — the same inputs the
+        device step uses (the strong-trend override is applied against the
+        current context on both sides).
         """
         ts_s = now_ms // 1000
         ts15 = ts_s // FIFTEEN_MIN_S * FIFTEEN_MIN_S - FIFTEEN_MIN_S
         ts5 = ts_s // FIVE_MIN_S * FIVE_MIN_S - FIVE_MIN_S
-
-        if quiet is None:
-            from datetime import UTC, datetime
-
-            from binquant_tpu.regime.time_filter import is_autotrade_suppressed
-
-            # judged at the EVALUATED tick time, matching the pipeline
-            quiet = is_autotrade_suppressed(
-                self._last_regime,
-                self._last_strength,
-                now=datetime.fromtimestamp(now_ms / 1000, tz=UTC),
-            )
 
         ctx = self._build_context(ts15)
         if ctx.valid:
@@ -1409,6 +1405,20 @@ class OracleEvaluator:
         else:
             self._last_regime = None
             self._last_strength = 0.0
+
+        if quiet is None:
+            from datetime import UTC, datetime
+
+            from binquant_tpu.regime.time_filter import is_autotrade_suppressed
+
+            # judged at the EVALUATED tick time against the context built
+            # THIS tick — the reference reads the live context
+            # (time_of_day_filter.py:60-76), and so does the device step
+            quiet = is_autotrade_suppressed(
+                ctx.market_regime if ctx.valid else None,
+                ctx.market_regime_transition_strength if ctx.valid else 0.0,
+                now=datetime.fromtimestamp(now_ms / 1000, tz=UTC),
+            )
 
         btc_df = self.store15.frames.get(self.btc_symbol)
         btc_momentum = 0.0

@@ -21,8 +21,14 @@ LONDON = ZoneInfo("Europe/London")
 QUIET_START_HOUR = 20
 QUIET_END_HOUR = 23
 
-_OVERRIDE_REGIMES = {int(MarketRegimeCode.TREND_UP), int(MarketRegimeCode.TREND_DOWN)}
-_MIN_TRANSITION_STRENGTH = 0.7
+# Strong-stable-trend override inputs (time_of_day_filter.py:45-46).
+# Public: the device-side tick step applies the same override against the
+# CURRENT tick's context (engine/step.py), exactly as the reference reads
+# the live context (time_of_day_filter.py:60-76).
+OVERRIDE_REGIMES = {int(MarketRegimeCode.TREND_UP), int(MarketRegimeCode.TREND_DOWN)}
+MIN_TRANSITION_STRENGTH = 0.7
+_OVERRIDE_REGIMES = OVERRIDE_REGIMES
+_MIN_TRANSITION_STRENGTH = MIN_TRANSITION_STRENGTH
 
 
 def _now_london(now: datetime | None = None) -> datetime:

@@ -51,6 +51,56 @@ from binquant_tpu.utils import jsafe_div
 # ---------------------------------------------------------------------------
 
 
+def _resample_1h(buf15: MarketBuffer, n_buckets: int):
+    """Calendar-aligned 15m→1h OHLC resample, device-side.
+
+    Buckets bars by ``open_time // 3600`` exactly as the reference's
+    pandas resample (``producers/context_evaluator.py:392-395``): the last
+    bucket is the current (possibly partial) wall-clock hour, preceding
+    buckets the full hours before it. Returns (open, high, low, close) of
+    shape (S, n_buckets), NaN where an hour has no bars; bars older than
+    ``n_buckets`` hours before each symbol's latest bar are dropped.
+    """
+    import jax
+
+    S, W = buf15.times.shape
+    times = buf15.times
+    has_bar = times >= 0
+    hour = times // 3600
+    last_hour = jnp.max(jnp.where(has_bar, hour, -1), axis=1, keepdims=True)
+    idx = hour - last_hour + (n_buckets - 1)  # (S, W) bucket per bar
+    in_range = has_bar & (idx >= 0) & (idx < n_buckets)
+    # out-of-range bars land in a discarded overflow segment
+    seg = jnp.where(in_range, idx, n_buckets).astype(jnp.int32)
+    pos = jnp.arange(W)
+
+    o = buf15.values[:, :, Field.OPEN]
+    h = buf15.values[:, :, Field.HIGH]
+    lo = buf15.values[:, :, Field.LOW]
+    c = buf15.values[:, :, Field.CLOSE]
+
+    def one(seg_s, o_s, h_s, lo_s, c_s):
+        n_seg = n_buckets + 1
+        first = jax.ops.segment_min(pos, seg_s, num_segments=n_seg)[:-1]
+        last = jax.ops.segment_max(pos, seg_s, num_segments=n_seg)[:-1]
+        filled = first <= last  # segment_min returns +inf-ish for empties
+        open_1h = jnp.where(filled, o_s[jnp.clip(first, 0, W - 1)], jnp.nan)
+        close_1h = jnp.where(filled, c_s[jnp.clip(last, 0, W - 1)], jnp.nan)
+        high_1h = jnp.where(
+            filled,
+            jax.ops.segment_max(h_s, seg_s, num_segments=n_seg)[:-1],
+            jnp.nan,
+        )
+        low_1h = jnp.where(
+            filled,
+            jax.ops.segment_min(lo_s, seg_s, num_segments=n_seg)[:-1],
+            jnp.nan,
+        )
+        return open_1h, high_1h, low_1h, close_1h
+
+    return jax.vmap(one)(seg, o, h, lo, c)
+
+
 def twap_momentum_sniper(
     buf15: MarketBuffer,
     pack5: FeaturePack,
@@ -59,23 +109,22 @@ def twap_momentum_sniper(
     """TWAP(1h bars) > price with no sharp recent selloff; telemetry-only
     (autotrade=False, "manual_only" route).
 
-    The reference resamples 15m→1h calendar-aligned; here 1h bars are
-    trailing 4-bar blocks of the 15m buffer (documented divergence: block
-    edges may be offset from wall-clock hours by up to 45 min).
+    1h bars come from a calendar-aligned resample of the 15m buffer
+    (``_resample_1h``), matching the reference's
+    ``df.resample("1h")`` (producers/context_evaluator.py:392-395); the
+    TWAP is the nan-mean of the last ``twap_window`` wall-clock hours
+    (the trailing partial hour included, hours with no bars skipped).
     """
     S, W = buf15.times.shape
-    k = W // 4
-    o = buf15.values[:, W - k * 4:, Field.OPEN].reshape(S, k, 4)
-    h = buf15.values[:, W - k * 4:, Field.HIGH].reshape(S, k, 4)
-    lo = buf15.values[:, W - k * 4:, Field.LOW].reshape(S, k, 4)
-    c = buf15.values[:, W - k * 4:, Field.CLOSE].reshape(S, k, 4)
-    open_1h = o[:, :, 0]
-    high_1h = jnp.max(h, axis=-1)
-    low_1h = jnp.min(lo, axis=-1)
-    close_1h = c[:, :, -1]
+    n_buckets = twap_window + 2  # TWAP window + the pair close[-1]/close[-2]
+    open_1h, high_1h, low_1h, close_1h = _resample_1h(buf15, n_buckets)
 
     bar_avg = (open_1h + high_1h + low_1h + close_1h) / 4.0
-    twap_last = rolling_mean_last(bar_avg, twap_window, min_periods=1)
+    twap_last = jnp.nanmean(
+        jnp.where(jnp.isfinite(bar_avg[:, -twap_window:]),
+                  bar_avg[:, -twap_window:], jnp.nan),
+        axis=1,
+    )
 
     # "price_decrease" exactly as written in the reference (l.68-70):
     # close[-1] - close[-2]/close[-1]

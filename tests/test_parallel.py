@@ -54,6 +54,53 @@ def test_dryrun_multichip_driver_entry():
     graft.dryrun_multichip(8)
 
 
+@multi
+def test_collective_audit_no_buffer_gather():
+    """Compiled-HLO proof of the mesh.py:5-9 claim: the (S,W,F) buffers
+    are never all-gathered; every collective is orders of magnitude
+    smaller than a buffer leaf (VERDICT r3 item 4)."""
+    graft._collective_audit(8, num_symbols=256, window=400)
+
+
+@multi
+def test_signal_engine_mesh_mode_shards_state():
+    """BQT_MESH_DEVICES wires the mesh into the production SignalEngine:
+    carried state is placed on the symbols mesh at startup and STAYS
+    sharded after a real process_tick."""
+    import asyncio
+    import os
+
+    from binquant_tpu.io.replay import make_stub_engine
+
+    os.environ["BQT_MESH_DEVICES"] = "8"
+    try:
+        engine = make_stub_engine(capacity=32, window=120)
+    finally:
+        os.environ.pop("BQT_MESH_DEVICES", None)
+    assert engine.mesh is not None
+    spec = engine.state.buf15.values.sharding.spec
+    assert spec[0] == "symbols"
+
+    rows = [engine.registry.add(f"S{i:03d}USDT") for i in range(8)]
+    assert rows
+    t0 = 1_753_000_200
+    for sym in list(engine.registry.to_mapping()):
+        for b in range(3):
+            engine.ingest(
+                {
+                    "symbol": sym,
+                    "open_time": (t0 + b * 900) * 1000,
+                    "close_time": (t0 + b * 900 + 900) * 1000 - 1,
+                    "open": 1.0, "high": 1.01, "low": 0.99, "close": 1.0,
+                    "volume": 10.0, "quote_volume": 10.0, "num_trades": 5,
+                }
+            )
+    asyncio.run(engine.process_tick(now_ms=(t0 + 3 * 900) * 1000))
+    asyncio.run(engine.flush_pending())
+    # the carried state must still be sharded over the mesh after a tick
+    assert engine.state.buf15.values.sharding.spec[0] == "symbols"
+
+
 @pytest.mark.slow
 def test_parity_subprocess_eight_cpu_devices():
     """Full sharded-vs-unsharded parity under a forced 8-CPU mesh, env set

@@ -725,7 +725,7 @@ class SignalEngine:
         # costs a test second, and the suite would otherwise pay a full
         # background compile per stub engine)
         warm_sig = (key, u5[0].shape, u15[0].shape)
-        if self.config.env != "CI" and warm_sig not in self._fallback_warmed:
+        if not self.config.is_ci and warm_sig not in self._fallback_warmed:
             self._fallback_warmed.add(warm_sig)
             import threading
 

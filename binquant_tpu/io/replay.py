@@ -127,9 +127,13 @@ def make_stub_engine(
 
 
 def load_klines_by_tick(path: str | Path) -> dict[int, list[dict]]:
-    """Group a JSONL kline file by 15m bucket (one engine tick each)."""
+    """Group a JSONL kline file by 15m bucket (one engine tick each).
+    Transparently reads gzip fixtures (checked-in market files)."""
+    import gzip
+
+    opener = gzip.open if str(path).endswith(".gz") else open
     klines_by_tick: dict[int, list[dict]] = {}
-    with open(path) as f:
+    with opener(path, "rt") as f:
         for line in f:
             line = line.strip()
             if not line:

@@ -27,8 +27,6 @@ QUIET_END_HOUR = 23
 # the live context (time_of_day_filter.py:60-76).
 OVERRIDE_REGIMES = {int(MarketRegimeCode.TREND_UP), int(MarketRegimeCode.TREND_DOWN)}
 MIN_TRANSITION_STRENGTH = 0.7
-_OVERRIDE_REGIMES = OVERRIDE_REGIMES
-_MIN_TRANSITION_STRENGTH = MIN_TRANSITION_STRENGTH
 
 
 def _now_london(now: datetime | None = None) -> datetime:
@@ -54,8 +52,8 @@ def is_autotrade_suppressed(
         return False
     if market_regime is None or market_regime < 0:
         return True
-    if market_regime in _OVERRIDE_REGIMES and (
-        transition_strength >= _MIN_TRANSITION_STRENGTH
+    if market_regime in OVERRIDE_REGIMES and (
+        transition_strength >= MIN_TRANSITION_STRENGTH
     ):
         return False
     return True

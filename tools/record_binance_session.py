@@ -1,0 +1,122 @@
+"""Record real Binance kline history into the replay fixture format.
+
+REST-reconstructs a dual-interval (5m + 15m) market session from Binance's
+public ``/api/v3/klines`` endpoint — no API key needed — and writes the
+same JSONL(.gz) the replay harness and ``tests/test_market_fixture.py``
+consume. Run from a host WITH network egress (the build environment has
+none; see tests/fixtures/README.md):
+
+    python tools/record_binance_session.py --hours 36 --symbols 100 \
+        --out tests/fixtures/market_36h_100sym.jsonl.gz
+
+Symbols are the top-quote-volume USDT pairs from /api/v3/ticker/24hr,
+BTCUSDT always first (the engine's benchmark row). Respects the public
+1200 weight/min budget with a simple request pacer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import time
+import urllib.parse
+import urllib.request
+
+BASE = "https://api.binance.com"
+BARS_PER_CALL = 1000
+
+
+def _get(path: str, **params) -> object:
+    url = f"{BASE}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        return json.loads(resp.read())
+
+
+def top_usdt_symbols(n: int) -> list[str]:
+    rows = _get("/api/v3/ticker/24hr")
+    usdt = [
+        r for r in rows
+        if r["symbol"].endswith("USDT") and float(r.get("quoteVolume", 0)) > 0
+    ]
+    usdt.sort(key=lambda r: -float(r["quoteVolume"]))
+    names = [r["symbol"] for r in usdt[: n + 1]]
+    if "BTCUSDT" in names:
+        names.remove("BTCUSDT")
+    return ["BTCUSDT"] + names[: n - 1]
+
+
+def fetch_klines(symbol: str, interval: str, start_ms: int, end_ms: int) -> list:
+    out: list = []
+    cursor = start_ms
+    while cursor < end_ms:
+        batch = _get(
+            "/api/v3/klines",
+            symbol=symbol,
+            interval=interval,
+            startTime=cursor,
+            endTime=end_ms,
+            limit=BARS_PER_CALL,
+        )
+        if not batch:
+            break
+        out.extend(batch)
+        cursor = int(batch[-1][6]) + 1  # last close_time + 1ms
+        time.sleep(0.15)  # ~8 req/s keeps well under the weight budget
+    return out
+
+
+def row_to_line(symbol: str, k: list) -> str:
+    return json.dumps(
+        {
+            "symbol": symbol,
+            "open_time": int(k[0]),
+            "close_time": int(k[6]),
+            "open": float(k[1]),
+            "high": float(k[2]),
+            "low": float(k[3]),
+            "close": float(k[4]),
+            "volume": float(k[5]),
+            "quote_asset_volume": float(k[7]),
+            "number_of_trades": int(k[8]),
+            "taker_buy_base_volume": float(k[9]),
+            "taker_buy_quote_volume": float(k[10]),
+        }
+    ) + "\n"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--hours", type=int, default=36)
+    parser.add_argument("--symbols", type=int, default=100)
+    parser.add_argument(
+        "--out", default="tests/fixtures/market_36h_100sym.jsonl.gz"
+    )
+    args = parser.parse_args()
+
+    now_ms = int(time.time() * 1000)
+    # align the window to a closed 15m boundary
+    end_ms = now_ms - now_ms % 900_000
+    start_ms = end_ms - args.hours * 3_600_000
+
+    names = top_usdt_symbols(args.symbols)
+    print(f"recording {len(names)} symbols x {args.hours}h ending {end_ms}")
+
+    opener = gzip.open if args.out.endswith(".gz") else open
+    written = 0
+    with opener(args.out, "wt") as f:
+        for i, symbol in enumerate(names):
+            for interval in ("15m", "5m"):
+                for k in fetch_klines(symbol, interval, start_ms, end_ms):
+                    if int(k[6]) < end_ms:  # closed bars only
+                        f.write(row_to_line(symbol, k))
+                        written += 1
+            if (i + 1) % 10 == 0:
+                print(f"  {i + 1}/{len(names)} symbols, {written} bars")
+    print(f"wrote {written} bars to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -238,6 +238,8 @@ def run(
             "p99_ms"
         ],
         "rtt_probe_ms": rtt_ms,
+        # sustained soak rate: back-to-back pipelined ticks, no idle gap
+        "ticks_per_sec": float(1000.0 / throughput["mean_ms"]),
         "symbol_evals_per_sec": float(
             num_symbols * 14 / (throughput["mean_ms"] / 1000.0)
         ),
@@ -543,6 +545,7 @@ def main() -> None:
                     "classic_lag_p99_ms": _r3(stats["classic_lag_p99_ms"]),
                     "serial_lag_p99_ms": _r3(stats["serial_lag_p99_ms"]),
                     "rtt_probe_ms": round(stats["rtt_probe_ms"], 3),
+                    "ticks_per_sec": round(stats["ticks_per_sec"], 1),
                     "measurement": (
                         "production SignalEngine.process_tick via its own "
                         "LatencyTracker. Headline: depth-1 at the 1 s live "

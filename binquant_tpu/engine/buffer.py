@@ -174,6 +174,16 @@ def field(buf: MarketBuffer, f: Field) -> jnp.ndarray:
     return buf.values[:, :, int(f)]
 
 
+class FrozenRows:
+    """Point-in-time row→name mapping (see SymbolRegistry.frozen_rows)."""
+
+    def __init__(self, row_to_name: dict[int, str]) -> None:
+        self._row_to_name = row_to_name
+
+    def name_of(self, row: int) -> str | None:
+        return self._row_to_name.get(int(row))
+
+
 class SymbolRegistry:
     """Host-side symbol↔row mapping with a free list.
 
@@ -206,6 +216,13 @@ class SymbolRegistry:
 
     def name_of(self, row: int) -> str | None:
         return self._row_to_name.get(row)
+
+    def frozen_rows(self) -> "FrozenRows":
+        """An immutable row→name view as of NOW. The pipelined engine
+        snapshots this at dispatch: rows freed and re-claimed by a new
+        symbol before the tick finalizes must not mis-attribute the
+        in-flight tick's signals to the newcomer."""
+        return FrozenRows(dict(self._row_to_name))
 
     def add(self, symbol: str) -> int:
         """Return the symbol's row, claiming one if new. Raises when full."""

@@ -74,8 +74,7 @@ class MarketSimConfig:
 
 
 def _garch_path(
-    rng: np.ndarray, innov: np.ndarray, long_run_vol: float,
-    alpha: float, beta: float,
+    innov: np.ndarray, long_run_vol: float, alpha: float, beta: float
 ) -> np.ndarray:
     """Return series with GARCH(1,1) variance driven by ``innov`` (unit
     variance). Vectorized over leading axes of innov's first dim = time."""
@@ -103,8 +102,9 @@ def simulate_market(cfg: MarketSimConfig) -> dict:
     innov_i = rng.standard_t(cfg.t_df, size=(T, S)) / scale
 
     # market factor with volatility clustering
-    r_m = _garch_path(rng, innov_m[:, None], cfg.factor_vol,
-                      cfg.garch_alpha, cfg.garch_beta)[:, 0]
+    r_m = _garch_path(
+        innov_m[:, None], cfg.factor_vol, cfg.garch_alpha, cfg.garch_beta
+    )[:, 0]
 
     # liquidation cascades: multi-bar crash + volume blowout + rebound
     event_vol_mult = np.ones(T)
@@ -127,7 +127,7 @@ def simulate_market(cfg: MarketSimConfig) -> dict:
     betas[0] = 1.0  # BTC IS the factor
     idio_vol = rng.uniform(*cfg.idio_vol_range, size=S)
     idio_vol[0] = cfg.factor_vol * 0.15
-    r_i = _garch_path(rng, innov_i, 1.0, cfg.garch_alpha, cfg.garch_beta)
+    r_i = _garch_path(innov_i, 1.0, cfg.garch_alpha, cfg.garch_beta)
     r = betas[None, :] * r_m[:, None] + r_i * idio_vol[None, :]
 
     # idiosyncratic pumps: 2-bar run-up then a +5..8% bar (not on BTC)

@@ -207,6 +207,8 @@ class _PendingTick(NamedTuple):
     ts15: int
     bucket15: int
     dispatched_at: float  # perf_counter at dispatch (signal-lag metric)
+    rows: Any  # FrozenRows — row→symbol AS OF dispatch (registry churn
+    # between dispatch and finalize must not re-attribute fired rows)
 
 
 class SignalEngine:
@@ -299,6 +301,10 @@ class SignalEngine:
         self.heartbeat_path = Path(config.heartbeat_path)
         self.ticks_processed = 0
         self.signals_emitted = 0
+        # ticks whose fired set overflowed the wire's compaction slots
+        # (exact count — the latency reservoir is capped and also times
+        # payload-less fallbacks)
+        self.overflow_ticks = 0
         # optional CheckpointManager; consume_loop snapshots through it
         self.checkpoint = None
         # per-stage latency histograms (SURVEY §5: the p99<50ms budget is
@@ -746,6 +752,7 @@ class SignalEngine:
             ts15=ts15,
             bucket15=bucket15,
             dispatched_at=time.perf_counter(),
+            rows=self.registry.frozen_rows(),
         )
 
     async def _finalize_tick(self, pending: _PendingTick) -> list:
@@ -764,6 +771,8 @@ class SignalEngine:
         # otherwise.
         outputs = None
         if fired_w.overflow or fired_w.payload is None:
+            if fired_w.overflow:
+                self.overflow_ticks += 1
             with self.latency.stage("overflow_fallback"):
                 outputs = pending.fallback()
         regime = ctx_scalars["market_regime"]
@@ -818,7 +827,10 @@ class SignalEngine:
 
         fired = extract_fired(
             outputs,
-            self.registry,
+            # row→symbol AS OF dispatch: a row freed and re-claimed between
+            # dispatch and finalize must not attribute this tick's signal
+            # to the new occupant
+            pending.rows,
             env=self.config.env,
             exchange=self.at_consumer.exchange,
             # use_enum_values schemas store the plain value string; raw
@@ -831,7 +843,7 @@ class SignalEngine:
             # pre-materialization skip: standing triggers already emitted
             # for this bar cost nothing (no diagnostics fetch, no payloads)
             skip=lambda strategy, row: self._already_emitted(
-                strategy, row, ts5, ts15
+                strategy, pending.rows.name_of(row), ts5, ts15
             ),
             unpacked=unpacked,
             # diagnostics slot layout recorded when this wire_enabled combo
@@ -925,12 +937,14 @@ class SignalEngine:
             )
         )
 
-    def _already_emitted(self, strategy: str, row: int, ts5: int, ts15: int) -> bool:
+    def _already_emitted(
+        self, strategy: str, symbol: str | None, ts5: int, ts15: int
+    ) -> bool:
         """Check (without marking) whether this (strategy, symbol) already
         emitted for the bar being evaluated. Keyed by symbol name — registry
         rows are recycled, so a row-keyed entry could suppress a NEW
-        symbol's first signal."""
-        symbol = self.registry.name_of(row)
+        symbol's first signal. The caller resolves the symbol through the
+        tick's dispatch-time row snapshot."""
         if symbol is None:
             return True  # untracked row: nothing to emit
         bar_ts = ts5 if strategy in FIVE_MIN_STRATEGIES else ts15

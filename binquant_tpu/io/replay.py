@@ -215,6 +215,7 @@ def run_replay(
 
     asyncio.run(drive())
     wall = time.perf_counter() - t_start
+    overflow = engine.latency.stats().get("overflow_fallback", {})
     return {
         "ticks": engine.ticks_processed,
         "signals": fired_total,
@@ -222,6 +223,11 @@ def run_replay(
         "wall_s": round(wall, 3),
         "tick_p50_ms": round(float(np.percentile(latencies, 50)), 3) if latencies else None,
         "tick_p99_ms": round(float(np.percentile(latencies, 99)), 3) if latencies else None,
+        # wire-compaction overflow ticks (>WIRE_MAX_FIRED fired pairs):
+        # how often the slow full-summary path ran, and what it cost
+        # (p99 also times payload-less fallbacks; the count is exact)
+        "overflow_ticks": engine.overflow_ticks,
+        "overflow_p99_ms": overflow.get("p99_ms"),
     }
 
 
@@ -315,7 +321,13 @@ def run_replay_ab(
         market_domination_reversal=market_domination_reversal,
     )
     tpu_set, oracle_set = set(tpu_signals), set(oracle_signals)
+    from collections import Counter
+
+    per_tick = Counter(t for t, *_ in tpu_set)
     return {
+        # the largest single-tick fired set (the wire-overflow drill
+        # asserts one tick exceeded the compaction slots)
+        "per_tick_max": max(per_tick.values()) if per_tick else 0,
         "match": tpu_set == oracle_set,
         "tpu_count": len(tpu_set),
         "oracle_count": len(oracle_set),
@@ -350,6 +362,59 @@ def _kline_json(
             "taker_buy_quote_volume": round(float(volume * c / 2), 3),
         }
     ) + "\n"
+
+
+def generate_burst_replay(
+    path: str | Path,
+    n_symbols: int = 160,
+    n_ticks: int = 108,
+    seed: int = 23,
+) -> None:
+    """A market-wide crash tick that fires MeanReversionFade on EVERY
+    symbol simultaneously — more fired (strategy, row) pairs than the
+    wire's compaction slots (WIRE_MAX_FIRED=128 at the default 160
+    symbols), forcing the overflow fallback through dispatch→emission.
+    The drill for engine/step.py's compaction limit (commits
+    48301f4/f446a62)."""
+    rng = np.random.default_rng(seed)
+    t0 = 1_753_000_200
+    px = 20 + rng.random(n_symbols) * 100
+
+    with open(path, "w") as f:
+        for tick in range(n_ticks):
+            ts15 = t0 + tick * 900
+            # steady market-wide downtrend keeps every symbol's RSI pinned
+            rets = rng.normal(-0.004, 0.002, n_symbols)
+            new_px = px * (1 + rets)
+            last_tick = tick == n_ticks - 1
+            for i in range(n_symbols):
+                symbol = "BTCUSDT" if i == 0 else f"S{i:03d}USDT"
+                o, c = px[i], new_px[i]
+                vol15 = abs(rng.normal(1000, 200))
+                h, low = max(o, c) * 1.002, min(o, c) * 0.998
+                if last_tick:
+                    # the same green-hammer recipe the single-symbol
+                    # scenario uses, applied market-wide: deep gap down
+                    # below the lower band, green close, 3x volume
+                    o = px[i] * 0.955
+                    c = o * 1.003
+                    h, low = c * 1.001, o * 0.997
+                    new_px[i] = c
+                    vol15 *= 3.0
+                f.write(_kline_json(symbol, ts15, 900, o, h, low, c, vol15))
+                sub_o = o
+                for j in range(3):
+                    sub_c = o + (c - o) * (j + 1) / 3
+                    sh = max(sub_o, sub_c) * 1.001
+                    sl = min(sub_o, sub_c) * 0.999
+                    f.write(
+                        _kline_json(
+                            symbol, ts15 + j * 300, 300,
+                            sub_o, sh, sl, sub_c, vol15 / 3,
+                        )
+                    )
+                    sub_o = sub_c
+            px = new_px
 
 
 def generate_dormant_replay(

@@ -451,6 +451,34 @@ def _r3(value) -> float | None:
     return round(value, 3)
 
 
+def _pallas_quantile_ab() -> dict | None:
+    """Standalone pallas-vs-XLA A/B for the tail rolling quantile (the one
+    pallas kernel). Publishes the measured story: at the production shape
+    the XLA windowed sort wins BOTH standalone and embedded (the
+    pallas_call boundary additionally blocks producer fusion), so XLA is
+    the default and the kernel is the opt-in escape hatch for larger
+    window/num_out shapes (ops/pallas_rolling.py pallas_available).
+    Numbers include one tunnel round trip amortized over the iteration
+    count — compare the two arms, not the absolutes. TPU only."""
+    import jax
+
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+        from binquant_tpu.ops.pallas_rolling import micro_bench
+
+        r = micro_bench()
+        return {
+            "xla_ms_per_call": round(r["xla"], 3),
+            "pallas_ms_per_call": round(r["pallas"], 3),
+            "shape": "2048x128 L=80 K=4 q=0.92",
+            "default": "xla (pallas_call boundary blocks fusion in the "
+            "fused tick step; kernel is opt-in via BQT_ENABLE_PALLAS)",
+        }
+    except Exception:
+        return None
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny shapes")
@@ -546,6 +574,7 @@ def main() -> None:
                     "serial_lag_p99_ms": _r3(stats["serial_lag_p99_ms"]),
                     "rtt_probe_ms": round(stats["rtt_probe_ms"], 3),
                     "ticks_per_sec": round(stats["ticks_per_sec"], 1),
+                    "pallas_quantile_ab": _pallas_quantile_ab(),
                     "measurement": (
                         "production SignalEngine.process_tick via its own "
                         "LatencyTracker. Headline: depth-1 at the 1 s live "

@@ -12,8 +12,12 @@ F fields)`` that is updated by a single jit'd batched operation per tick:
   kernels already treat as missing.
 * **Batched scatter-update**: all candles that arrived in a tick are applied
   at once. Per symbol the update resolves exactly like the reference's
-  dedupe+sort: newer timestamp → shift-append, equal timestamp → overwrite
-  last bar, older timestamp → ignored (out-of-order frame).
+  dedupe+sort: newer timestamp → shift-append; a timestamp already in the
+  window (latest OR mid-history) → overwrite that bar in place (the
+  exchange re-sent a corrected candle); an older timestamp with no
+  matching bar → ignored (fixed-shape windows cannot insert mid-history —
+  requires both the original delivery and the catch-up fetch to have
+  missed that bucket).
 * **Freshness is exact-timestamp equality** with the evaluated tick, as in
   ``get_fresh_symbols`` (``market_state_store.py:49-54``).
 
@@ -133,23 +137,31 @@ def apply_updates(
     last_ts = buf.times[:, -1]
     has_update = upd_ts >= 0
     is_append = has_update & ((buf.filled == 0) | (upd_ts > last_ts))
-    is_replace = has_update & (buf.filled > 0) & (upd_ts == last_ts)
 
     # Candidate A: shift-left append (oldest bar falls off the front).
     app_times = jnp.concatenate([buf.times[:, 1:], upd_ts[:, None]], axis=1)
     app_vals = jnp.concatenate([buf.values[:, 1:, :], upd_vals[:, None, :]], axis=1)
 
-    # Candidate B: overwrite the latest bar in place.
-    rep_times = buf.times.at[:, -1].set(jnp.where(is_replace, upd_ts, last_ts))
-    rep_vals = jnp.where(
-        is_replace[:, None, None],
-        buf.values.at[:, -1, :].set(upd_vals),
+    # Candidate B: rewrite the bar that already holds this timestamp —
+    # the latest bar (same-bucket correction) or ANY mid-history bar (an
+    # exchange re-sending a corrected candle), exactly the reference's
+    # dedupe-by-timestamp keep-last (market_state_store.py:19-32). Times
+    # are strictly increasing per symbol, so at most one slot matches.
+    # An older timestamp with NO matching bar (a bar missed entirely,
+    # delivered late) is dropped: a fixed-shape window cannot insert
+    # mid-history without a full sort. Rare — it requires the original
+    # delivery AND the catch-up fetch for that bucket to both have failed.
+    slot_match = (buf.times == upd_ts[:, None]) & has_update[:, None]
+    is_rewrite = has_update & ~is_append & slot_match.any(axis=1)
+    rw_vals = jnp.where(
+        (is_rewrite[:, None] & slot_match)[..., None],
+        upd_vals[:, None, :],
         buf.values,
     )
 
     sel_a = is_append[:, None]
-    times = jnp.where(sel_a, app_times, rep_times)
-    values = jnp.where(sel_a[..., None], app_vals, rep_vals)
+    times = jnp.where(sel_a, app_times, buf.times)
+    values = jnp.where(sel_a[..., None], app_vals, rw_vals)
     filled = jnp.where(
         is_append, jnp.minimum(buf.filled + 1, W), buf.filled
     ).astype(jnp.int32)
@@ -319,9 +331,10 @@ class IngestBatcher:
     When a symbol has candles for several timestamps pending (a late frame
     plus the current one), :meth:`drain` yields one sub-batch per timestamp
     rank, oldest first, so sequential ``apply_updates`` calls replay them in
-    order. Known divergence from the reference: a frame older than a
-    symbol's latest stored bar cannot rewrite mid-history (fixed-shape
-    device buffer drops it); the reference's sort+dedupe would.
+    order. A frame older than a symbol's latest stored bar rewrites its
+    matching window slot in place (``apply_updates`` candidate B); only a
+    mid-history INSERT — an older bar absent from the window — is dropped
+    (documented divergence from the reference's sort+dedupe).
     """
 
     def __init__(self, registry: SymbolRegistry) -> None:

@@ -118,14 +118,17 @@ def rolling_quantile_tail_pallas(
 def pallas_available() -> bool:
     """True when the TPU pallas path should be used.
 
-    OPT-IN (``BQT_ENABLE_PALLAS=1``): standalone, the kernel beats the XLA
-    windowed sort (~2.45 vs ~2.97 ms/call at 2048×128 through the tunnel),
-    but EMBEDDED in the fused tick step the ``pallas_call`` boundary stops
-    XLA from fusing the ``shift(score, 1)`` producer into the op and the
-    measured tick p50 regresses ~1 ms (21.6 vs 20.5 ms at 2048×400) — so
-    the fused sort stays the default and the kernel is the escape hatch
-    for shapes where the sort dominates. ``BQT_DISABLE_PALLAS=1`` always
-    wins over the enable flag.
+    OPT-IN (``BQT_ENABLE_PALLAS=1``) and currently NOT winning: with a
+    true D2H sync (round 3's block_until_ready timing was a near-no-op
+    through the tunnel), the XLA windowed sort beats the kernel standalone
+    at ABP's shape (~2.8 vs ~3.8 ms/call at 2048×128, L=80, K=4 —
+    re-measured per bench run under ``pallas_quantile_ab``), and embedded
+    in the fused tick step the ``pallas_call`` boundary also blocks
+    producer fusion (~1 ms tick-p50 regression). The kernel is kept as a
+    parity-pinned reference implementation and the escape hatch for
+    shapes where O(L log L) sort growth overtakes the O(L·K) rank
+    selection (bigger windows / many trailing positions).
+    ``BQT_DISABLE_PALLAS=1`` always wins over the enable flag.
     """
     if os.environ.get("BQT_DISABLE_PALLAS", "").lower() in {"1", "true"}:
         return False
@@ -157,8 +160,16 @@ def rolling_quantile_tail_auto(
     )
 
 
-def micro_bench(S: int = 2048, W: int = 128, window: int = 80, num_out: int = 4):
-    """Compare pallas vs XLA for the tail quantile at ABP's shape."""
+def micro_bench(
+    S: int = 2048, W: int = 128, window: int = 80, num_out: int = 4,
+    iters: int = 200,
+):
+    """Compare pallas vs XLA for the tail quantile at ABP's shape.
+
+    Timings include ONE blocking D2H round trip amortized over ``iters``
+    (~0.75 ms at a 150 ms tunnel RTT and the default 200) — identical for
+    both arms, so compare them to each other, not as absolute kernel
+    times."""
     import time
 
     from binquant_tpu.ops.rolling import rolling_quantile_tail
@@ -172,10 +183,12 @@ def micro_bench(S: int = 2048, W: int = 128, window: int = 80, num_out: int = 4)
 
     results = {}
     for name, fn in (("xla", xla), ("pallas", pls)):
-        out = jax.block_until_ready(fn(x))
+        np.asarray(fn(x))  # compile + real sync (block_until_ready can be
+        # a near-no-op through the tunneled backend; a D2H fetch is not)
         t0 = time.perf_counter()
-        for _ in range(50):
+        out = None
+        for _ in range(iters):
             out = fn(x)
-        jax.block_until_ready(out)
-        results[name] = (time.perf_counter() - t0) / 50 * 1000
+        np.asarray(out)  # queue is serial: syncing the last syncs them all
+        results[name] = (time.perf_counter() - t0) / iters * 1000
     return results

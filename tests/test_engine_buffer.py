@@ -63,6 +63,42 @@ def test_stale_update_ignored():
     assert int(buf.times[0, -1]) == 200
 
 
+def test_mid_history_rewrite_in_place():
+    """A re-sent candle whose timestamp already sits mid-window overwrites
+    THAT bar (reference dedupe-by-timestamp keep-last,
+    market_state_store.py:19-32) without touching order or fill count."""
+    buf = empty_buffer(2, window=4)
+    for i, ts in enumerate([100, 200, 300]):
+        buf = apply_updates(
+            buf, np.array([0], np.int32), np.array([ts], np.int32),
+            mk_vals(float(i + 1)),
+        )
+    # correction for the MIDDLE bar (ts=200)
+    buf = apply_updates(
+        buf, np.array([0], np.int32), np.array([200], np.int32), mk_vals(77.0)
+    )
+    assert int(buf.filled[0]) == 3
+    assert [int(t) for t in buf.times[0, -3:]] == [100, 200, 300]
+    assert float(buf.values[0, -2, Field.CLOSE]) == 77.0
+    assert float(buf.values[0, -1, Field.CLOSE]) == 3.0  # latest untouched
+
+
+def test_older_absent_timestamp_still_dropped():
+    """An older timestamp with NO matching bar cannot be inserted into a
+    fixed-shape window; it is dropped (documented divergence)."""
+    buf = empty_buffer(2, window=4)
+    for ts, v in [(100, 1.0), (300, 3.0)]:
+        buf = apply_updates(
+            buf, np.array([0], np.int32), np.array([ts], np.int32), mk_vals(v)
+        )
+    buf = apply_updates(
+        buf, np.array([0], np.int32), np.array([200], np.int32), mk_vals(9.0)
+    )
+    assert int(buf.filled[0]) == 2
+    assert [int(t) for t in buf.times[0, -2:]] == [100, 300]
+    assert not (np.asarray(buf.values[0, :, Field.CLOSE]) == 9.0).any()
+
+
 def test_window_rolls_oldest_off():
     buf = empty_buffer(1, window=3)
     for i in range(5):

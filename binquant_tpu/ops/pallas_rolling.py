@@ -118,17 +118,17 @@ def rolling_quantile_tail_pallas(
 def pallas_available() -> bool:
     """True when the TPU pallas path should be used.
 
-    OPT-IN (``BQT_ENABLE_PALLAS=1``) and currently NOT winning: with a
-    true D2H sync (round 3's block_until_ready timing was a near-no-op
-    through the tunnel), the XLA windowed sort beats the kernel standalone
-    at ABP's shape (~2.8 vs ~3.8 ms/call at 2048×128, L=80, K=4 —
-    re-measured per bench run under ``pallas_quantile_ab``), and embedded
-    in the fused tick step the ``pallas_call`` boundary also blocks
-    producer fusion (~1 ms tick-p50 regression). The kernel is kept as a
-    parity-pinned reference implementation and the escape hatch for
-    shapes where O(L log L) sort growth overtakes the O(L·K) rank
-    selection (bigger windows / many trailing positions).
-    ``BQT_DISABLE_PALLAS=1`` always wins over the enable flag.
+    OPT-IN (``BQT_ENABLE_PALLAS=1``): with a true D2H sync (round 3's
+    block_until_ready timing was a near-no-op through the tunnel), the
+    kernel and the XLA windowed sort are statistically indistinguishable
+    STANDALONE at ABP's shape (~0.7-1.1 ms/call each at 2048×128, L=80,
+    K=4, run-to-run spread larger than their gap — re-measured per bench
+    run under ``pallas_quantile_ab``). EMBEDDED in the fused tick step the
+    ``pallas_call`` boundary blocks producer fusion (~1 ms tick-p50
+    regression), so the XLA sort stays the default and the kernel is the
+    parity-pinned escape hatch for shapes where O(L log L) sort growth
+    overtakes the O(L·K) rank selection (bigger windows / many trailing
+    positions). ``BQT_DISABLE_PALLAS=1`` always wins over the enable flag.
     """
     if os.environ.get("BQT_DISABLE_PALLAS", "").lower() in {"1", "true"}:
         return False

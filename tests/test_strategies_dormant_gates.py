@@ -299,13 +299,15 @@ class TestRelativeStrengthGates:
 class TestTwapSniper:
     def test_sharp_selloff_vetoes(self):
         # price_decrease = close[-1] - close[-2]/close[-1] (the reference's
-        # formula, verbatim): with 1h closes ~1.0 and a prior-bar pop to
+        # formula, verbatim): with 1h closes ~1.0 and a prior-hour pop to
         # 1.06, the expression goes below -0.05 and vetoes.
         df15 = flat_df(price=1.0)
-        n = len(df15)
-        # previous 1h block (bars -8..-5) closes at 1.06; last block at 1.0
-        for j in range(n - 8, n - 4):
-            df15.loc[df15.index[j], "close"] = 1.06
+        # pop the previous CALENDAR hour's bars (the resample buckets by
+        # open_time // 3600, so address the bucket, not a trailing block)
+        hours = df15["open_time"] // 3_600_000
+        prev_hour = hours == (int(hours.iloc[-1]) - 1)
+        assert prev_hour.any()
+        df15.loc[prev_hour, "close"] = 1.06
         buf15 = fill_buffer({0: df15})
         df5 = flat_df(price=2.0)  # price 2.0 > twap 1.0: twap gate false too
         df5.loc[df5.index[-1], "close"] = 0.5  # price below TWAP -> gate true

@@ -130,9 +130,10 @@ def simulate_market(cfg: MarketSimConfig) -> dict:
     r_i = _garch_path(innov_i, 1.0, cfg.garch_alpha, cfg.garch_beta)
     r = betas[None, :] * r_m[:, None] + r_i * idio_vol[None, :]
 
-    # idiosyncratic pumps: 2-bar run-up then a +5..8% bar (not on BTC)
+    # idiosyncratic pumps: 2-bar run-up then a +5..8% bar (not on BTC —
+    # requires at least one altcoin)
     pump_vol_mult = np.ones((T, S))
-    for p in range(cfg.n_pumps):
+    for p in range(cfg.n_pumps if S > 1 else 0):
         sym = int(rng.integers(1, S))
         bar = int(rng.integers(first_event_bar + 4, T - 2))
         r[bar - 2 : bar, sym] = np.abs(r[bar - 2 : bar, sym]) + 0.004

@@ -396,8 +396,9 @@ class OracleEvaluator:
         self.pt_last_close: dict[str, int] = {}
         self.mrf_last_open: dict[str, int] = {}
         self.last_emitted: dict[tuple[str, str], int] = {}
-        # previous tick's regime, for the quiet-hours override (pipeline
-        # mirrors this: time_filter judged against the PREVIOUS context)
+        # most recent VALID regime (grid-only policy input next tick; the
+        # quiet-hours override itself reads the CURRENT tick's context,
+        # matching the device step)
         self._last_regime: int | None = None
         self._last_strength: float = 0.0
 

@@ -62,43 +62,88 @@ def test_collective_audit_no_buffer_gather():
     graft._collective_audit(8, num_symbols=256, window=400)
 
 
-@multi
-def test_signal_engine_mesh_mode_shards_state():
-    """BQT_MESH_DEVICES wires the mesh into the production SignalEngine:
-    carried state is placed on the symbols mesh at startup and STAYS
-    sharded after a real process_tick."""
+_T0 = 1_753_000_200
+
+
+def _ingest_bars(engine, symbols, price: float = 1.0, bars: int = 3):
+    """Feed `bars` closed 15m candles per symbol (the batcher's expected
+    ExtendedKline key set) and run one tick + flush."""
     import asyncio
-    import os
 
-    from binquant_tpu.io.replay import make_stub_engine
-
-    os.environ["BQT_MESH_DEVICES"] = "8"
-    try:
-        engine = make_stub_engine(capacity=32, window=120)
-    finally:
-        os.environ.pop("BQT_MESH_DEVICES", None)
-    assert engine.mesh is not None
-    spec = engine.state.buf15.values.sharding.spec
-    assert spec[0] == "symbols"
-
-    rows = [engine.registry.add(f"S{i:03d}USDT") for i in range(8)]
-    assert rows
-    t0 = 1_753_000_200
-    for sym in list(engine.registry.to_mapping()):
-        for b in range(3):
+    for sym in symbols:
+        for b in range(bars):
+            ts = _T0 + b * 900
             engine.ingest(
                 {
                     "symbol": sym,
-                    "open_time": (t0 + b * 900) * 1000,
-                    "close_time": (t0 + b * 900 + 900) * 1000 - 1,
-                    "open": 1.0, "high": 1.01, "low": 0.99, "close": 1.0,
-                    "volume": 10.0, "quote_volume": 10.0, "num_trades": 5,
+                    "open_time": ts * 1000,
+                    "close_time": (ts + 900) * 1000 - 1,
+                    "open": price, "high": price * 1.01,
+                    "low": price * 0.99, "close": price,
+                    "volume": 10.0,
+                    "quote_asset_volume": 10.0 * price,
+                    "number_of_trades": 5,
                 }
             )
-    asyncio.run(engine.process_tick(now_ms=(t0 + 3 * 900) * 1000))
+    asyncio.run(engine.process_tick(now_ms=(_T0 + bars * 900) * 1000))
     asyncio.run(engine.flush_pending())
+
+
+@multi
+def test_signal_engine_mesh_mode_shards_state(monkeypatch):
+    """BQT_MESH_DEVICES wires the mesh into the production SignalEngine:
+    carried state is placed on the symbols mesh at startup and STAYS
+    sharded after a real process_tick."""
+    from binquant_tpu.io.replay import make_stub_engine
+
+    monkeypatch.setenv("BQT_MESH_DEVICES", "8")
+    engine = make_stub_engine(capacity=32, window=120)
+    assert engine.mesh is not None
+    assert engine.state.buf15.values.sharding.spec[0] == "symbols"
+
+    _ingest_bars(engine, [f"S{i:03d}USDT" for i in range(8)])
     # the carried state must still be sharded over the mesh after a tick
     assert engine.state.buf15.values.sharding.spec[0] == "symbols"
+    # and the candles actually landed (8 symbols x 3 bars)
+    import numpy as np
+
+    assert int((np.asarray(engine.state.buf15.times) >= 0).sum()) == 24
+
+
+@multi
+def test_mesh_checkpoint_restore_reshards(tmp_path, monkeypatch):
+    """A checkpoint written by a mesh-mode engine restores into a fresh
+    mesh-mode engine SHARDED (checkpoint.py re-places restored leaves on
+    the mesh) with every state leaf and the host carries intact."""
+    import jax
+    import numpy as np
+
+    from binquant_tpu.io.checkpoint import CheckpointManager
+    from binquant_tpu.io.replay import make_stub_engine
+
+    monkeypatch.setenv("BQT_MESH_DEVICES", "8")
+    a = make_stub_engine(capacity=32, window=120)
+    _ingest_bars(a, [f"M{i:03d}USDT" for i in range(8)], price=2.0)
+    ckpt = CheckpointManager(tmp_path / "mesh.npz", every_ticks=1)
+    assert ckpt.maybe_save(a)
+
+    b = make_stub_engine(capacity=32, window=120)
+    b.checkpoint = CheckpointManager(tmp_path / "mesh.npz", every_ticks=1)
+    assert b.checkpoint.try_restore(b)
+
+    assert b.mesh is not None
+    assert b.state.buf15.values.sharding.spec[0] == "symbols"
+    # EVERY state leaf round-trips (times, OHLCV values, fills, carries)
+    for (path, la), lb in zip(
+        jax.tree_util.tree_leaves_with_path(a.state),
+        jax.tree_util.tree_leaves(b.state),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=jax.tree_util.keystr(path),
+        )
+    assert b.ticks_processed == a.ticks_processed
+    assert b._last_emitted == a._last_emitted
 
 
 @pytest.mark.slow

@@ -1,13 +1,18 @@
 """Per-stage latency profiler for the tick step (VERDICT r1 item 2/6).
 
 Times each stage of the evaluation pipeline separately (jitted, warmed,
-block_until_ready) at bench scale, plus transfer/RTT costs that a tunneled
-device makes dominant. Run:
+truly D2H-synced — see _sync) at bench scale, plus transfer/RTT costs
+that a tunneled device makes dominant. Run:
 
     python tools/profile_stages.py [--symbols 2048] [--window 400]
 
 Prints a stage table; use it to direct kernel work instead of guessing.
 Optionally dumps a jax.profiler trace with --trace <dir>.
+
+Through the tunneled device every stage's timing includes ONE device
+round trip (the sync) — subtract the "rtt: tiny jit + D2H fetch" row to
+get the stage's own cost; on a local chip the rtt row is ~0.1 ms and the
+numbers read directly.
 """
 
 from __future__ import annotations
@@ -22,17 +27,26 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
-def _bench(fn, *args, iters=8, warmup=2):
+def _sync(out):
+    """Real device sync: fetch one leaf. jax.block_until_ready is a
+    near-no-op through the axon tunnel (it returns before execution
+    finishes), which silently turns timings into dispatch-only numbers;
+    a D2H fetch on the serial device queue is a true barrier."""
     import jax
 
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        np.asarray(leaves[-1]).ravel()[:1]
+    return out
+
+
+def _bench(fn, *args, iters=8, warmup=2):
     for _ in range(warmup):
-        out = fn(*args)
-        jax.block_until_ready(out)
+        _sync(fn(*args))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
+        _sync(fn(*args))
         times.append((time.perf_counter() - t0) * 1000.0)
     return float(np.median(times)), float(np.max(times))
 
@@ -89,7 +103,7 @@ def main() -> None:
             buf5=apply_updates(state.buf5, rows, ts, vals),
             buf15=apply_updates(state.buf15, rows, ts, vals),
         )
-    jax.block_until_ready(state.buf15.values)
+    _sync(state.buf15.values)
 
     now = t0 + W * 900
     rows, ts, vals, px = make_updates(now, px)
@@ -103,7 +117,7 @@ def main() -> None:
     # device-resident copies for compute-only timings
     upd_dev = jax.device_put(upd)
     inputs_dev = jax.device_put(inputs)
-    jax.block_until_ready((upd_dev, inputs_dev))
+    _sync((upd_dev, inputs_dev))
 
     results: list[tuple[str, float, float]] = []
 
@@ -116,8 +130,8 @@ def main() -> None:
     tiny = jax.jit(lambda x: x + 1)
     tiny_in = jax.device_put(np.zeros(1, np.float32))
     stage("rtt: tiny jit + D2H fetch", lambda: np.asarray(tiny(tiny_in)))
-    stage("h2d: update batch (3 arrays)", lambda: jax.block_until_ready(jax.device_put(upd)))
-    stage("h2d: HostInputs (16 leaves)", lambda: jax.block_until_ready(jax.device_put(inputs)))
+    stage("h2d: update batch (3 arrays)", lambda: _sync(jax.device_put(upd)))
+    stage("h2d: HostInputs (16 leaves)", lambda: _sync(jax.device_put(inputs)))
 
     # --- compute stages (inputs already on device)
     jitted_apply = jax.jit(apply_updates)
@@ -161,7 +175,7 @@ def main() -> None:
         inputs_dev.timestamp_s, state.regime_carry,
     )
     spikes = jitted_spikes(state.buf15)
-    jax.block_until_ready((pack5, pack15, ctx, spikes))
+    _sync((pack5, pack15, ctx, spikes))
 
     from binquant_tpu.strategies.activity_burst_pump import activity_burst_pump
     from binquant_tpu.strategies.dormant import (
@@ -219,7 +233,7 @@ def main() -> None:
         with jax.profiler.trace(args.trace):
             for _ in range(3):
                 s2, out = tick_step(state, upd_dev, upd_dev, inputs_dev, cfg)
-                jax.block_until_ready(out.summary.trigger)
+                _sync(out.summary.trigger)
         print(f"trace written to {args.trace}", file=sys.stderr)
 
     total_compute = sum(m for n, m, _ in results if not n.startswith(("rtt", "h2d", "tick_step")))

@@ -518,7 +518,12 @@ def run_config2(num_symbols: int = 100, window: int = 400, iters: int = 50) -> d
 
     import jax
 
-    from binquant_tpu.engine.buffer import Field, apply_updates, empty_buffer
+    from binquant_tpu.engine.buffer import (
+        NUM_FIELDS,
+        Field,
+        apply_updates,
+        empty_buffer,
+    )
     from binquant_tpu.io.replay import load_klines_by_tick
     from binquant_tpu.ops.indicators import ema, rsi_wilder, sma
 
@@ -542,7 +547,7 @@ def run_config2(num_symbols: int = 100, window: int = 400, iters: int = 50) -> d
             ]
             if not batch:
                 continue
-            vals = np.zeros((len(batch), 10), np.float32)
+            vals = np.zeros((len(batch), NUM_FIELDS), np.float32)
             for u, k in enumerate(batch):
                 vals[u, Field.OPEN] = k["open"]
                 vals[u, Field.HIGH] = k["high"]

@@ -804,9 +804,11 @@ class SignalEngine:
                 self._run_leverage_calibration(pending.bucket15, calib)
             else:
                 # calib rows absent from the wire (fabricated test wires):
-                # fall back to the full outputs' context
-                full = outputs if outputs is not None else pending.fallback()
-                self._run_leverage_calibration(pending.bucket15, full.context)
+                # fall back to the full outputs' context (and keep the
+                # fallback result so later consumers don't re-run the step)
+                if outputs is None:
+                    outputs = pending.fallback()
+                self._run_leverage_calibration(pending.bucket15, outputs.context)
 
         # carry regime state across restarts (checkpoint introspection; the
         # quiet-hours override itself is applied device-side from the

@@ -238,6 +238,7 @@ def run_replay_oracle(
     enabled_strategies: set | None = None,
     dominance_is_losers: bool = False,
     market_domination_reversal: bool = False,
+    collect_regimes: list | None = None,
 ) -> list[tuple]:
     """Replay through the legacy per-symbol pandas backend
     (``backend=reference``, BASELINE config #1); returns the fired
@@ -284,6 +285,17 @@ def run_replay_oracle(
             out.append((tick_ms, strategy, sym, direction, autotrade))
         # next tick's policy from THIS tick's regime (None when invalid)
         policy = GridOnlyPolicy.resolve(evaluator.last_regime, mb)
+        if collect_regimes is not None:
+            from binquant_tpu.enums import market_regime_label
+
+            code = evaluator.last_regime
+            collect_regimes.append(
+                (
+                    tick_ms,
+                    market_regime_label(code) if code is not None else None,
+                    float(evaluator.last_strength),
+                )
+            )
     return out
 
 

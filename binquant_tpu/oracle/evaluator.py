@@ -409,6 +409,12 @@ class OracleEvaluator:
         policy and quiet-hours filter consume next tick."""
         return self._last_regime
 
+    @property
+    def last_strength(self) -> float:
+        """The most recent evaluation's regime-transition strength (0.0
+        when the context was invalid) — paired with :attr:`last_regime`."""
+        return self._last_strength
+
     # -- ingest ------------------------------------------------------------
 
     def ingest(self, kline: dict) -> None:

@@ -10,6 +10,18 @@ fully parallel.
 
 Capacity S must be a multiple of the mesh size (the registry pads — S is a
 static config knob, BQT_MAX_SYMBOLS).
+
+SCOPE — single host only. ``shard_host_inputs``/``shard_engine_state``
+build full arrays on the host and ``jax.device_put`` them against a
+NamedSharding, which requires every mesh device to be addressable from
+this process. That covers the production target (one v5e chip) and
+multi-chip single-host meshes (the 8-device dryrun), NOT a multi-host pod:
+there each process must construct only its addressable shards
+(``jax.make_array_from_single_device_arrays`` from per-host slices of the
+symbol axis, with the ingest path routing each symbol's klines to the host
+that owns its rows) and the checkpoint restore must re-slice per process.
+``make_mesh`` fails fast under multi-process JAX rather than letting
+device_put raise mid-tick.
 """
 
 from __future__ import annotations
@@ -25,6 +37,13 @@ from binquant_tpu.regime.context import RegimeCarry
 
 
 def make_mesh(devices: list | None = None, axis: str = "symbols") -> Mesh:
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "binquant_tpu's mesh mode is single-host: shard_host_inputs "
+            "device_puts full host arrays, which requires all mesh devices "
+            "addressable from one process (see module docstring for the "
+            "process-local construction a pod would need)"
+        )
     devs = np.array(devices if devices is not None else jax.devices())
     return Mesh(devs, axis_names=(axis,))
 

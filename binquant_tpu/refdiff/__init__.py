@@ -1,0 +1,28 @@
+"""Differential-verification harness: execute the REFERENCE code itself.
+
+Every other correctness layer in this repo compares the TPU batch path
+against a builder-transcribed pandas oracle (``binquant_tpu/oracle``). If
+the transcription misread a reference formula, both sides inherit the bug
+and stay green. This package closes that hole (VERDICT r4 item 1): it
+imports the reference implementation from ``/root/reference`` (read-only)
+and replays the SAME fixtures through the reference's own
+``KlinesProvider.aggregate_data`` → ``ContextEvaluator.process_data``
+chain — market state store, context accumulator, regime transition
+detector, strategies, autotrade gates all executing verbatim — then diffs
+the emitted signal set against both the transcribed oracle and the TPU
+batch path.
+
+The only code NOT executed verbatim is the external ``pybinbot`` PyPI
+package (not installed in this environment, zero egress): ``shims``
+provides its SDK surface — pydantic models/enums re-exported from this
+repo's own SDK replica (``binquant_tpu.schemas``/``enums``/``utils``),
+plus ``Candles``/``Indicators`` re-implemented from the surface
+documented in SURVEY.md §2.8. Indicator-column math is therefore shared
+with the transcription and NOT independently verified by this harness;
+everything under ``/root/reference`` itself is.
+
+Usage: ``tests/test_reference_differential.py`` (slow suite).
+"""
+
+from binquant_tpu.refdiff.driver import run_replay_reference  # noqa: F401
+from binquant_tpu.refdiff.shims import install_shims, reference_available  # noqa: F401

@@ -1,0 +1,379 @@
+"""Drive the reference's own provider chain over a replay fixture.
+
+``run_replay_reference`` replays the same JSONL kline fixtures the A/B
+harness uses (``binquant_tpu/io/replay.py``) through the REFERENCE
+implementation imported from ``/root/reference``:
+
+    KlinesProvider.aggregate_data                (consumers/klines_provider.py:295-370)
+      -> MarketStateStore sync                   (market_state_store.py)
+      -> LiveMarketContextAccumulator            (live_market_context_accumulator.py)
+      -> RegimeTransitionDetector                (regime_transitions.py)
+      -> LeverageCalibrator                      (calibrators/leverage_calibrator.py)
+      -> ContextEvaluator.process_data           (producers/context_evaluator.py:335-481)
+           -> ActivityBurstPump, PriceTracker, MarketRegimeNotifier,
+              LiquidationSweepPump, MeanReversionFade, LadderDeployer
+      -> AutotradeConsumer gates                 (consumers/autotrade_consumer.py)
+
+all executing verbatim, with ONLY the external pybinbot SDK shimmed
+(see ``binquant_tpu.refdiff.shims``). Emitted signals are captured at the
+same seam the reference's analytics sink uses
+(``BinbotApi.dispatch_create_signal``) and keyed exactly like the A/B
+harness: ``(tick_ms, strategy, symbol, DIRECTION, autotrade)``.
+
+Driver-level sequencing choices (semantics, with reasons):
+
+* One evaluation per symbol per closed 15m bucket, after pre-syncing the
+  FULL universe's 15m history into the state store — the batch engine's
+  tick semantics. Reference production reaches the same state only after
+  every symbol's WS message for the bucket has arrived; evaluating
+  mid-bucket partial contexts is a production race the replay
+  deliberately removes on both sides.
+* The quiet-hours clock is injected (``is_autotrade_suppressed(now=...)``)
+  so the reference's London-wall-clock filter runs at the REPLAYED tick
+  time — the reference function itself executes unmodified.
+* The accumulator's coverage floor (module constants
+  ``REQUIRED_FRESH_SYMBOLS``/``MIN_COVERAGE_RATIO``) is overridable to
+  match the engine-under-test's ``ContextConfig`` on small fixtures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from contextlib import ExitStack
+from datetime import UTC, datetime
+from pathlib import Path
+from unittest.mock import patch
+
+from binquant_tpu.refdiff import shims
+
+FIFTEEN_MIN_MS = 900_000
+
+# benchmark ids the reference asks its exchange APIs for
+# (klines_provider.py:86-96) -> the fixture's BTC row
+_BENCHMARK_ALIASES = {"XBTUSDTM", "BTC-USDT", "BTCUSDC", "BTCUSDTM", "BTCUSDT"}
+
+
+class ReferenceHub:
+    """Market-data + recording backend the shimmed pybinbot clients hit."""
+
+    def __init__(self, symbols, autotrade_settings, test_settings, breadth) -> None:
+        self.symbols = symbols
+        self.autotrade_settings = autotrade_settings
+        self.test_autotrade_settings = test_settings
+        self.breadth = breadth
+        # per (symbol, interval_s) ascending list of UI rows
+        self.rows: dict[tuple[str, int], list[list]] = {}
+        self.now_ms = 0
+        self.current_tick_ms = 0
+        self.signals: list[dict] = []
+        self.symbol_edits: list[tuple] = []
+        self.bot_calls: list[tuple] = []
+
+    # -- ingest -----------------------------------------------------------
+    def add_kline(self, k: dict) -> None:
+        interval_s = (int(k["close_time"]) + 1 - int(k["open_time"])) // 1000
+        row = [
+            int(k["open_time"]),
+            float(k["open"]),
+            float(k["high"]),
+            float(k["low"]),
+            float(k["close"]),
+            float(k["volume"]),
+            int(k["close_time"]),
+            float(k.get("quote_asset_volume", 0.0)),
+            float(k.get("number_of_trades", 0.0)),
+            float(k.get("taker_buy_base_volume", 0.0)),
+            float(k.get("taker_buy_quote_volume", 0.0)),
+        ]
+        self.rows.setdefault((k["symbol"], interval_s), []).append(row)
+
+    # -- shim client surface ---------------------------------------------
+    def ui_klines(self, symbol: str, interval: str, limit: int) -> list[list]:
+        if symbol in _BENCHMARK_ALIASES:
+            symbol = "BTCUSDT"
+        interval_s = {"5m": 300, "5min": 300, "15m": 900, "15min": 900}[interval]
+        rows = self.rows.get((symbol, interval_s), [])
+        closed = [r for r in rows if r[6] < self.now_ms]
+        return closed[-limit:]
+
+    def last_price(self, symbol: str) -> float:
+        rows = self.ui_klines(symbol, "15min", 1)
+        return rows[-1][4] if rows else 0.0
+
+    def open_interest(self, symbol: str) -> float:
+        # neutral: replay fixtures carry no OI stream (same on the engine
+        # side, where the OI refresher is stubbed out)
+        return float("nan")
+
+    def record_signal(self, kwargs: dict) -> None:
+        self.signals.append({"tick_ms": self.current_tick_ms, **kwargs})
+
+    @property
+    def now_dt(self) -> datetime:
+        return datetime.fromtimestamp(self.now_ms / 1000, tz=UTC)
+
+
+def _normalize_direction(direction) -> str:
+    d = str(direction)
+    return d if d == "grid" else d.upper()
+
+
+def _install_and_import():
+    shims.install_shims()
+    # imported lazily so the shims are in sys.modules first
+    from consumers.klines_provider import KlinesProvider  # noqa: PLC0415
+    from market_regime import live_market_context_accumulator as accumulator_mod
+    from shared import time_of_day_filter as tod_mod
+
+    return KlinesProvider, accumulator_mod, tod_mod
+
+
+class _StrategyCrashCheck(logging.Handler):
+    """The reference swallows per-strategy exceptions (`_safe_signal`,
+    `dispatch_signal_record`); in a differential run a swallowed shim crash
+    would masquerade as "the reference didn't fire". Capture them and fail
+    the harness instead."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.ERROR)
+        self.crashes: list[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if record.exc_info:
+            self.crashes.append(self.format(record))
+
+
+def run_replay_reference(
+    path: str | Path,
+    window: int = 400,
+    breadth: dict | None = None,
+    required_fresh_symbols: int | None = 4,
+    min_coverage_ratio: float | None = 0.5,
+    collect_regimes: list | None = None,
+    collect_leverage: list | None = None,
+    symbols: set[str] | None = None,
+) -> list[tuple]:
+    """Replay ``path`` through the reference chain; return the fired
+    ``(tick_ms, strategy, symbol, direction, autotrade)`` tuples.
+
+    ``required_fresh_symbols``/``min_coverage_ratio`` override the
+    accumulator's module constants to match the engine config under test
+    (pass ``None`` to keep the reference defaults 40/0.70).
+    ``collect_regimes`` receives ``(tick_ms, market_regime|None,
+    transition_strength)`` per tick. ``collect_leverage`` receives the
+    recorded ``edit_symbol`` calls. ``symbols`` restricts the replayed
+    universe (both histories and evaluations).
+    """
+    os.environ.setdefault("ENV", "CI")
+    KlinesProvider, accumulator_mod, tod_mod = _install_and_import()
+    import pybinbot  # the shim
+
+    from binquant_tpu.io.replay import load_klines_by_tick
+    from binquant_tpu.schemas import MarketBreadthSeries
+
+    klines_by_tick = load_klines_by_tick(path)
+    all_symbols = sorted(
+        {
+            k["symbol"]
+            for ks in klines_by_tick.values()
+            for k in ks
+            if symbols is None or k["symbol"] in symbols
+        }
+    )
+
+    hub = ReferenceHub(
+        symbols=[
+            pybinbot.SymbolModel(id=s, base_asset=s.removesuffix("USDT"))
+            for s in all_symbols
+        ],
+        autotrade_settings=pybinbot.AutotradeSettingsSchema(
+            autotrade=False,
+            exchange_id="kucoin",
+            market_type="futures",
+            telegram_signals=False,
+        ),
+        test_settings=pybinbot.TestAutotradeSettingsSchema(autotrade=False),
+        breadth=MarketBreadthSeries(**breadth) if breadth else MarketBreadthSeries(),
+    )
+    shims.set_active_hub(hub)
+
+    crash_check = _StrategyCrashCheck()
+    logging.getLogger().addHandler(crash_check)
+
+    real_suppressed = tod_mod.is_autotrade_suppressed
+
+    def replay_clock_suppressed(context=None, now=None, **kw):
+        return real_suppressed(context=context, now=hub.now_dt)
+
+    tod_targets = ["strategies.coinrule.price_tracker"]
+
+    try:
+        with ExitStack() as stack:
+            if required_fresh_symbols is not None:
+                stack.enter_context(
+                    patch.object(
+                        accumulator_mod,
+                        "REQUIRED_FRESH_SYMBOLS",
+                        required_fresh_symbols,
+                    )
+                )
+            if min_coverage_ratio is not None:
+                stack.enter_context(
+                    patch.object(
+                        accumulator_mod, "MIN_COVERAGE_RATIO", min_coverage_ratio
+                    )
+                )
+            for target in tod_targets:
+                import importlib
+
+                mod = importlib.import_module(target)
+                stack.enter_context(
+                    patch.object(
+                        mod, "is_autotrade_suppressed", replay_clock_suppressed
+                    )
+                )
+
+            provider = KlinesProvider()
+            provider.LIMIT = window
+            # In production the KuCoin-futures benchmark id ("XBTUSDTM") IS
+            # a tracked universe symbol, so the store holds one BTC row. The
+            # fixture's BTC row is named BTCUSDT; keep the benchmark id
+            # equal to it or BTC would be double-counted in breadth.
+            provider.benchmark_symbol = "BTCUSDT"
+            provider.futures_benchmark_symbol = "BTCUSDT"
+            provider.market_context_accumulator.btc_symbol = "BTCUSDT"
+            # the store was sized from the class-level LIMIT in __init__;
+            # keep it in lockstep so context features see the same history
+            # depth as the engine-under-test's window
+            provider.market_state_store.max_bars_per_symbol = window
+            _memoize_context_refresh(provider)
+            asyncio.run(
+                _drive(provider, hub, klines_by_tick, all_symbols, collect_regimes)
+            )
+    finally:
+        logging.getLogger().removeHandler(crash_check)
+        shims.set_active_hub(None)
+
+    if crash_check.crashes:
+        raise RuntimeError(
+            "reference-side exception(s) swallowed by crash isolation "
+            f"({len(crash_check.crashes)}):\n" + "\n---\n".join(crash_check.crashes[:3])
+        )
+
+    if collect_leverage is not None:
+        collect_leverage.extend(hub.symbol_edits)
+
+    out = []
+    for rec in hub.signals:
+        out.append(
+            (
+                rec["tick_ms"],
+                rec["algorithm_name"],
+                rec["symbol"],
+                _normalize_direction(rec["direction"]),
+                bool(rec["autotrade"]),
+            )
+        )
+    return out
+
+
+def _memoize_context_refresh(provider) -> None:
+    """Return the already-built context on repeated same-timestamp refreshes.
+
+    The reference rebuilds the full-universe context from the state store on
+    EVERY kline (`_refresh_latest_market_context` →
+    `refresh_context_for_timestamp`) so a mid-bucket context refines as
+    candles trickle in. The driver pre-syncs the whole universe before any
+    evaluation, so within a bucket the store no longer changes and the
+    rebuild is a deterministic no-op — O(S²) pandas work per bucket that
+    cannot alter the result. Memoized per timestamp at the driver seam; the
+    first build per timestamp (and every build while the context is still
+    None) runs the real reference code."""
+    acc = provider.market_context_accumulator
+    real_refresh = acc.refresh_context_for_timestamp
+    # a timestamp is only ever refreshed within its own bucket (the store
+    # grows by whole buckets), so a None result is final for that timestamp
+    none_cache: set[int] = set()
+
+    def memoized(timestamp: int):
+        cached = acc.get_context(timestamp)
+        if cached is not None:
+            return cached
+        if timestamp in none_cache:
+            return None
+        out = real_refresh(timestamp)
+        if out is None:
+            none_cache.add(timestamp)
+        return out
+
+    acc.refresh_context_for_timestamp = memoized
+
+
+async def _drive(provider, hub, klines_by_tick, all_symbols, collect_regimes) -> None:
+    import pybinbot  # the shim (installed before _drive runs)
+
+    futures = pybinbot.MarketType.FUTURES
+    allowed = set(all_symbols)
+    for bucket in sorted(klines_by_tick):
+        tick_klines = [k for k in klines_by_tick[bucket] if k["symbol"] in allowed]
+        for k in sorted(tick_klines, key=lambda k: k["open_time"]):
+            hub.add_kline(k)
+        tick_ms = (bucket + 1) * FIFTEEN_MIN_MS
+        hub.now_ms = tick_ms
+        hub.current_tick_ms = tick_ms
+
+        # full-universe pre-sync (see module docstring): the state store and
+        # the context for this bucket reflect every symbol's closed candle
+        # BEFORE any strategy runs, matching the engine's tick semantics
+        last_ts = None
+        for sym in all_symbols:
+            rows = provider._sync_market_state_from_ui_klines(
+                symbol=sym, ui_klines=hub.ui_klines(sym, "15min", provider.LIMIT)
+            )
+            if rows:
+                last_ts = max(
+                    last_ts or 0, int(rows[-1]["timestamp"])
+                )
+        provider._store_btc_history(market_type=futures)
+        if last_ts is not None:
+            provider._refresh_latest_market_context(
+                timestamp=last_ts, market_type=futures
+            )
+        if collect_regimes is not None:
+            ctx = provider.latest_market_context
+            fresh = (
+                ctx is not None
+                and last_ts is not None
+                and int(ctx.timestamp) == last_ts
+            )
+            collect_regimes.append(
+                (
+                    tick_ms,
+                    ctx.market_regime if fresh else None,
+                    float(ctx.market_regime_transition_strength) if fresh else 0.0,
+                )
+            )
+
+        # evaluate each symbol whose 15m bar just closed (the freshness the
+        # engine's tick mask applies)
+        fresh_15m = {
+            k["symbol"]
+            for k in tick_klines
+            if (k["close_time"] + 1 - k["open_time"]) // 1000 == 900
+        }
+        for sym in sorted(fresh_15m):
+            last15 = hub.ui_klines(sym, "15min", 1)[-1]
+            payload = {
+                "symbol": sym,
+                "open_time": str(last15[0]),
+                "close_time": str(last15[6]),
+                "open_price": str(last15[1]),
+                "high_price": str(last15[2]),
+                "low_price": str(last15[3]),
+                "close_price": str(last15[4]),
+                "volume": str(last15[5]),
+                "market_type": "futures",
+            }
+            await provider.aggregate_data(payload)

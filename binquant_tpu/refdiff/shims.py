@@ -1,0 +1,610 @@
+"""In-process stand-ins for the reference's third-party imports.
+
+The reference codebase (``/root/reference``) has four dependencies that are
+not installed here: ``pybinbot`` (the platform SDK, an external PyPI
+package), ``pandera``, ``python-telegram-bot`` and ``python-dotenv``. Its
+own test suite cuts the network seam at exactly this boundary
+(``/root/reference/tests/conftest.py:34-49`` patches the four ``BinbotApi``
+constructors); this module cuts the same seam for the differential harness,
+but with functional fakes instead of Mocks so the full provider chain runs.
+
+What the shim provides and where it comes from:
+
+* pydantic models / enums / helpers (``SignalsConsumer``, ``BotBase``,
+  ``SymbolModel``, ``Position``, ``MarketType``, ``round_numbers``, ...)
+  — re-exported from this repo's own pybinbot-surface replica
+  (``binquant_tpu.schemas`` / ``enums`` / ``utils``, SURVEY.md §2.8), so
+  the differential run doubles as a compatibility test of that replica.
+* ``Candles`` / ``Indicators`` — re-implemented here from the surface
+  documented in SURVEY.md §2.8 (``producers/context_evaluator.py:228-251``
+  consumes them). pybinbot's source is not in the environment, so these
+  formulas are the transcription's (shared with ``binquant_tpu/oracle``)
+  — NOT independently verified by the differential. Everything under
+  ``/root/reference`` itself executes verbatim.
+* network clients (``BinbotApi``, ``KucoinApi``, ``KucoinFutures``,
+  ``BinanceApi``) — recording fakes wired to the active
+  :class:`binquant_tpu.refdiff.driver.ReferenceHub`.
+"""
+
+from __future__ import annotations
+
+import html as _html
+import logging
+import os
+import sys
+import types
+from enum import Enum
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+REFERENCE_PATH = os.environ.get("BQT_REFERENCE_PATH", "/root/reference")
+
+# The active market-data hub (set by driver.run_replay_reference); module
+# global so the provider-constructed clients (KlinesProvider builds its own
+# BinbotApi/KucoinFutures — klines_provider.py:42-53) can find it.
+_ACTIVE_HUB = None
+
+
+def set_active_hub(hub) -> None:
+    global _ACTIVE_HUB
+    _ACTIVE_HUB = hub
+
+
+def reference_available() -> bool:
+    return (Path(REFERENCE_PATH) / "producers" / "context_evaluator.py").is_file()
+
+
+# ---------------------------------------------------------------------------
+# pybinbot data layer: Candles + Indicators (SURVEY.md §2.8)
+# ---------------------------------------------------------------------------
+
+# UI kline row layout (klines_provider.py:130-149: "open_time, open, high,
+# low, close, volume, close_time" first seven columns)
+_UI_COLUMNS = [
+    "open_time",
+    "open",
+    "high",
+    "low",
+    "close",
+    "volume",
+    "close_time",
+    "quote_asset_volume",
+    "number_of_trades",
+    "taker_buy_base_asset_volume",
+    "taker_buy_quote_asset_volume",
+]
+
+_OHLC_REQUIRED = list(_UI_COLUMNS)
+
+
+class Candles:
+    """Raw UI-kline rows → validated OHLC DataFrame.
+
+    Behavior pinned by the reference's own ``tests/test_ohlc.py`` (missing
+    columns / coercion / all-NaN errors) and its call sites
+    (``context_evaluator.py:352-421``: pre_process → enrichment →
+    post_process, plus the 15m→1h resample)."""
+
+    def __init__(self, exchange=None, candles=None) -> None:
+        self.exchange = exchange
+        self.candles = list(candles) if candles else []
+
+    def pre_process(self) -> pd.DataFrame:
+        if not self.candles:
+            return pd.DataFrame()
+        width = max(len(row) for row in self.candles)
+        cols = _UI_COLUMNS[: min(width, len(_UI_COLUMNS))]
+        rows = [list(row[: len(cols)]) for row in self.candles]
+        df = pd.DataFrame(rows, columns=cols)
+        for missing in _UI_COLUMNS[len(cols):]:
+            df[missing] = 0.0
+        df = self.ensure_ohlc(df)
+        return df.sort_values("open_time").reset_index(drop=True)
+
+    def ensure_ohlc(self, df: pd.DataFrame) -> pd.DataFrame:
+        missing = [c for c in _OHLC_REQUIRED if c not in df.columns]
+        if missing:
+            raise ValueError(f"missing required columns: {', '.join(missing)}")
+        df = df.copy()
+        for col in _OHLC_REQUIRED:
+            coerced = pd.to_numeric(df[col], errors="coerce")
+            if len(coerced) and coerced.isna().all() and df[col].notna().any():
+                raise ValueError(f"column {col} is entirely non-numeric")
+            df[col] = coerced
+        df["open_time"] = df["open_time"].astype("int64")
+        df["close_time"] = df["close_time"].astype("int64")
+        return df
+
+    def post_process(self, df: pd.DataFrame) -> pd.DataFrame:
+        # Enrichment leaves NaN warm-up rows in place; the evaluator's
+        # MA-sufficiency gates (`context_evaluator.py:361-365,424-429`) use
+        # `.size`, i.e. row COUNT — so post-processing must not drop them.
+        return df.reset_index(drop=True)
+
+    def resample(self, df: pd.DataFrame, interval: str = "1h") -> pd.DataFrame:
+        if df.empty:
+            return pd.DataFrame()
+        step_ms = {"1h": 3_600_000, "4h": 14_400_000, "6h": 21_600_000}[interval]
+        bucket = df["open_time"] // step_ms
+        g = df.groupby(bucket)
+        out = pd.DataFrame(
+            {
+                "open_time": g["open_time"].first() // step_ms * step_ms,
+                "open": g["open"].first(),
+                "high": g["high"].max(),
+                "low": g["low"].min(),
+                "close": g["close"].last(),
+                "close_time": g["close_time"].last(),
+                "volume": g["volume"].sum(),
+                "quote_asset_volume": g["quote_asset_volume"].sum(),
+                "number_of_trades": g["number_of_trades"].sum(),
+                "taker_buy_base_asset_volume": g["taker_buy_base_asset_volume"].sum(),
+                "taker_buy_quote_asset_volume": g["taker_buy_quote_asset_volume"].sum(),
+            }
+        )
+        return out.reset_index(drop=True).sort_values("open_time").reset_index(drop=True)
+
+
+class Indicators:
+    """The enrichment columns ``indicators_enrichment`` expects
+    (``context_evaluator.py:228-251``). Formulas shared with the
+    transcription (``binquant_tpu/oracle/evaluator.py`` cites each)."""
+
+    @staticmethod
+    def moving_averages(df: pd.DataFrame, window: int = 7) -> pd.DataFrame:
+        df[f"ma_{window}"] = df["close"].rolling(window).mean()
+        return df
+
+    @staticmethod
+    def macd(df: pd.DataFrame) -> pd.DataFrame:
+        close = df["close"]
+        ema12 = close.ewm(span=12, adjust=False, min_periods=1).mean()
+        ema26 = close.ewm(span=26, adjust=False, min_periods=1).mean()
+        df["macd"] = ema12 - ema26
+        df["macd_signal"] = df["macd"].ewm(span=9, adjust=False, min_periods=1).mean()
+        return df
+
+    @staticmethod
+    def rsi(df: pd.DataFrame) -> pd.DataFrame:
+        # Simple-rolling-mean RSI(14) — the shared-column variant MRF's
+        # docstring contrasts with its inline Wilder RSI
+        # (mean_reversion_fade.py:44-48); oracle `_rsi14_sma`.
+        delta = df["close"].diff()
+        avg_gain = delta.clip(lower=0).rolling(14, min_periods=14).mean()
+        avg_loss = (-delta).clip(upper=None, lower=0).rolling(14, min_periods=14).mean()
+        denom = avg_gain + avg_loss
+        df["rsi"] = (100.0 * avg_gain / denom).where(denom != 0, 50.0)
+        return df
+
+    @staticmethod
+    def mfi(df: pd.DataFrame, window: int = 14) -> float:
+        # Money-flow index of the last `window` bars (oracle `_pt`).
+        tp = (df["high"] + df["low"] + df["close"]) / 3.0
+        flow = tp * df["volume"]
+        tp_delta = tp.diff()
+        last = tp_delta.tail(window)
+        if len(last) < window or last.isna().any():
+            return float("nan")
+        pos = float(flow.tail(window)[last > 0].sum())
+        neg = float(flow.tail(window)[last < 0].sum())
+        total = pos + neg
+        return 100.0 * pos / total if total != 0 else 50.0
+
+    @staticmethod
+    def ma_spreads(df: pd.DataFrame) -> pd.DataFrame:
+        for fast, slow in ((7, 25), (25, 100)):
+            df[f"ma_{fast}_{slow}_spread"] = (
+                df[f"ma_{fast}"] - df[f"ma_{slow}"]
+            ) / df[f"ma_{slow}"].abs().replace(0, np.nan)
+        return df
+
+    @staticmethod
+    def bollinguer_spreads(df: pd.DataFrame, window: int = 20) -> pd.DataFrame:
+        close = df["close"]
+        mid = close.rolling(window).mean()
+        std = close.rolling(window).std(ddof=0)
+        df["bb_mid"] = mid
+        df["bb_upper"] = mid + 2 * std
+        df["bb_lower"] = mid - 2 * std
+        return df
+
+    @staticmethod
+    def set_twap(df: pd.DataFrame, window: int = 80) -> pd.DataFrame:
+        bar_avg = (df["open"] + df["high"] + df["low"] + df["close"]) / 4.0
+        df["twap"] = bar_avg.rolling(window, min_periods=1).mean()
+        return df
+
+    @staticmethod
+    def atr(df: pd.DataFrame, window: int = 14) -> pd.DataFrame:
+        prev_close = df["close"].shift(1)
+        tr = pd.concat(
+            [
+                df["high"] - df["low"],
+                (df["high"] - prev_close).abs(),
+                (df["low"] - prev_close).abs(),
+            ],
+            axis=1,
+        ).max(axis=1)
+        df["ATR"] = tr.rolling(window).mean()
+        return df
+
+    @staticmethod
+    def set_supertrend(
+        df: pd.DataFrame, period: int = 10, multiplier: float = 3.0
+    ) -> pd.DataFrame:
+        # Wilder-ATR band ratchet + flip state (oracle `_sts`).
+        close, high, low = df["close"], df["high"], df["low"]
+        pc = close.shift(1)
+        tr = pd.concat([high - low, (high - pc).abs(), (low - pc).abs()], axis=1).max(
+            axis=1
+        )
+        tr = tr.where(pc.notna(), high - low)
+        atr = tr.ewm(alpha=1.0 / period, adjust=False, min_periods=period).mean()
+        hl2 = (high + low) / 2.0
+        upper = (hl2 + multiplier * atr).to_numpy()
+        lower = (hl2 - multiplier * atr).to_numpy()
+        closes = close.to_numpy()
+        n = len(closes)
+        st_dir = np.ones(n)
+        st_line = np.full(n, np.nan)
+        fu, fl, d, prev = np.inf, -np.inf, 1.0, 0.0
+        for i in range(n):
+            ub = upper[i] if np.isfinite(upper[i]) else np.inf
+            lb = lower[i] if np.isfinite(lower[i]) else -np.inf
+            fu = ub if (ub < fu or prev > fu) else fu
+            fl = lb if (lb > fl or prev < fl) else fl
+            d = 1.0 if closes[i] > fu else (-1.0 if closes[i] < fl else d)
+            st_dir[i] = d
+            st_line[i] = fl if d > 0 else fu
+            prev = closes[i]
+        df["supertrend"] = st_line
+        df["supertrend_direction"] = st_dir
+        return df
+
+
+# ---------------------------------------------------------------------------
+# pybinbot network clients — recording fakes bound to the active hub
+# ---------------------------------------------------------------------------
+
+
+class BinbotErrors(Exception):
+    pass
+
+
+class BinbotError(BinbotErrors):
+    pass
+
+
+class _HubClient:
+    def __init__(self, *args, **kwargs) -> None:
+        self.hub = _ACTIVE_HUB
+
+    def _login_service_account(self):  # conftest parity
+        return None
+
+
+class BinbotApi(_HubClient):
+    def get_autotrade_settings(self):
+        return self.hub.autotrade_settings
+
+    def get_test_autotrade_settings(self):
+        return self.hub.test_autotrade_settings
+
+    def get_symbols(self):
+        return list(self.hub.symbols)
+
+    def get_single_symbol(self, symbol):
+        return next(s for s in self.hub.symbols if s.id == symbol)
+
+    def edit_symbol(self, symbol, **payload):
+        self.hub.symbol_edits.append((self.hub.current_tick_ms, symbol, payload))
+        return {"message": "ok"}
+
+    def get_active_pairs(self, collection_name: str | None = None):
+        return []
+
+    def get_active_grid_ladders(self):
+        return []
+
+    async def get_market_breadth(self):
+        return self.hub.breadth
+
+    def get_available_fiat(self, *a, **k):
+        return 1000.0
+
+    def filter_excluded_symbols(self, symbols):
+        return symbols
+
+    def dispatch_create_signal(self, **kwargs):
+        self.hub.record_signal(kwargs)
+
+    def submit_bot_event_logs(self, *a, **k):
+        return {"message": "ok"}
+
+    def submit_paper_trading_event_logs(self, *a, **k):
+        return {"message": "ok"}
+
+    def clean_margin_short(self, *a, **k):
+        return {"message": "ok"}
+
+    def create_bot(self, payload):
+        self.hub.bot_calls.append(("create_bot", payload))
+        return {"message": "ok", "error": 0, "data": {"pair": getattr(payload, "pair", ""), "id": "0" * 32}}
+
+    def activate_bot(self, *a, **k):
+        self.hub.bot_calls.append(("activate_bot", a or k))
+        return {"message": "ok", "error": 0}
+
+    def create_paper_bot(self, payload):
+        self.hub.bot_calls.append(("create_paper_bot", payload))
+        return {"message": "ok", "error": 0, "data": {"pair": getattr(payload, "pair", ""), "id": "0" * 32}}
+
+    def activate_paper_bot(self, *a, **k):
+        return {"message": "ok", "error": 0}
+
+    def delete_paper_bot(self, *a, **k):
+        return {"message": "ok", "error": 0}
+
+    def calculate_grid_levels(self, *a, **k):
+        return {"levels": []}
+
+    def create_grid_ladder(self, payload):
+        self.hub.bot_calls.append(("create_grid_ladder", payload))
+        return {"message": "ok", "error": 0}
+
+
+class _ExchangeApi(_HubClient):
+    def get_ui_klines(self, symbol: str, interval: str, limit: int = 400, **kw):
+        return self.hub.ui_klines(symbol, interval, limit)
+
+    def get_ticker_price(self, symbol: str):
+        return self.hub.last_price(symbol)
+
+    def get_mark_price(self, symbol: str):
+        return self.hub.last_price(symbol)
+
+    def get_open_interest(self, symbol: str):
+        return self.hub.open_interest(symbol)
+
+    def get_symbol_info(self, symbol: str):
+        # futures contract spec consumed by the sizing math
+        # (autotrade_consumer.py:117-131)
+        return types.SimpleNamespace(
+            multiplier=1.0, lot_size=1.0, taker_fee_rate=0.0006
+        )
+
+
+class BinanceApi(_ExchangeApi):
+    pass
+
+
+class KucoinApi(_ExchangeApi):
+    pass
+
+
+class KucoinFutures(_ExchangeApi):
+    pass
+
+
+class AsyncSpotWebsocketStreamClient:
+    def __init__(self, *a, **k) -> None:
+        raise RuntimeError("refdiff harness does not drive websockets")
+
+
+class AsyncKucoinWebsocketClient(AsyncSpotWebsocketStreamClient):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Interval enums (pybinbot *KlineIntervals with .get_ms())
+# ---------------------------------------------------------------------------
+
+_INTERVAL_MS = {
+    "1m": 60_000, "1min": 60_000,
+    "5m": 300_000, "5min": 300_000,
+    "15m": 900_000, "15min": 900_000,
+    "1h": 3_600_000, "1hour": 3_600_000,
+}
+
+
+class BinanceKlineIntervals(str, Enum):
+    one_minute = "1m"
+    five_minutes = "5m"
+    fifteen_minutes = "15m"
+    one_hour = "1h"
+
+    def get_ms(self) -> int:
+        return _INTERVAL_MS[self.value]
+
+
+class KucoinKlineIntervals(str, Enum):
+    ONE_MINUTE = "1min"
+    FIVE_MINUTES = "5min"
+    FIFTEEN_MINUTES = "15min"
+    ONE_HOUR = "1hour"
+
+    def get_ms(self) -> int:
+        return _INTERVAL_MS[self.value]
+
+
+def timestamp_sort_key(value):
+    """Sortable numeric key for mixed timestamp payloads, None when
+    unusable — `grid_only_policy.py:78-81` filters on `is not None`, so
+    returning a sentinel instead would keep junk rows the engine-side
+    policy drops (same contract as binquant_tpu.regime.grid_policy)."""
+    try:
+        parsed = float(value)
+    except (TypeError, ValueError):
+        try:
+            parsed = float(pd.Timestamp(value).timestamp())
+        except (TypeError, ValueError):
+            return None
+    return parsed if np.isfinite(parsed) else None
+
+
+def configure_logging(*a, **k) -> None:
+    logging.basicConfig(level=logging.WARNING)
+
+
+def _build_pybinbot_module() -> types.ModuleType:
+    from binquant_tpu import enums as _enums
+    from binquant_tpu import schemas as _schemas
+    from binquant_tpu import utils as _utils
+    from pydantic import BaseModel
+
+    mod = types.ModuleType("pybinbot")
+
+    class KlineProduceModel(_schemas.KlineProduceModel):
+        # the connector payload carries the market type of the producing
+        # stream (klines_provider.py:322-329)
+        market_type: _enums.MarketType | None = None
+
+    class AutotradeSettingsSchema(_schemas.AutotradeSettingsSchema):
+        # pybinbot field names the repo replica renamed/omitted
+        telegram_signals: bool = False
+        grid_max_active_ladders: int = 3
+
+    class TestAutotradeSettingsSchema(AutotradeSettingsSchema):
+        __test__ = False
+        test_autotrade: bool = True
+
+    class KlineSchema(BaseModel):
+        """Typing-only stand-in for pybinbot's pandera KlineSchema."""
+
+    for name, obj in {
+        # data layer
+        "Candles": Candles,
+        "Indicators": Indicators,
+        "KlineSchema": KlineSchema,
+        # models (repo SDK replica — binquant_tpu/schemas.py)
+        "SignalsConsumer": _schemas.SignalsConsumer,
+        "HABollinguerSpread": _schemas.HABollinguerSpread,
+        "BotBase": _schemas.BotBase,
+        "BotModel": _schemas.BotModel,
+        "BotResponse": _schemas.BotResponse,
+        "OrderBase": _schemas.OrderBase,
+        "DealBase": _schemas.DealBase,
+        "DealType": _enums.DealType,
+        "RecoveryParams": _schemas.RecoveryParams,
+        "CloseConditions": _schemas.CloseConditions,
+        "GridDeploymentRequest": _schemas.GridDeploymentRequest,
+        "SymbolModel": _schemas.SymbolModel,
+        "MarketBreadthSeries": _schemas.MarketBreadthSeries,
+        "KlineProduceModel": KlineProduceModel,
+        "AutotradeSettingsSchema": AutotradeSettingsSchema,
+        "TestAutotradeSettingsSchema": TestAutotradeSettingsSchema,
+        # enums
+        "Position": _schemas.Position,
+        "MarketType": _enums.MarketType,
+        "ExchangeId": _enums.ExchangeId,
+        "MarketDominance": _enums.MarketDominance,
+        "Status": _enums.Status,
+        "BinanceKlineIntervals": BinanceKlineIntervals,
+        "KucoinKlineIntervals": KucoinKlineIntervals,
+        # helpers
+        "round_numbers": _utils.round_numbers,
+        "timestamp_to_datetime": _utils.timestamp_to_datetime,
+        "timestamp_sort_key": timestamp_sort_key,
+        "configure_logging": configure_logging,
+        # network clients
+        "BinbotApi": BinbotApi,
+        "BinanceApi": BinanceApi,
+        "KucoinApi": KucoinApi,
+        "KucoinFutures": KucoinFutures,
+        "AsyncSpotWebsocketStreamClient": AsyncSpotWebsocketStreamClient,
+        "AsyncKucoinWebsocketClient": AsyncKucoinWebsocketClient,
+        "BinbotErrors": BinbotErrors,
+        "BinbotError": BinbotError,
+    }.items():
+        setattr(mod, name, obj)
+    return mod
+
+
+def _build_pandera_module() -> tuple[types.ModuleType, types.ModuleType]:
+    pandera = types.ModuleType("pandera")
+    typing_mod = types.ModuleType("pandera.typing")
+
+    class DataFrame:
+        """``TypedDataFrame[KlineSchema]`` annotation support only."""
+
+        def __class_getitem__(cls, item):
+            return pd.DataFrame
+
+    typing_mod.DataFrame = DataFrame
+    typing_mod.Series = pd.Series
+    pandera.typing = typing_mod
+    return pandera, typing_mod
+
+
+def _build_telegram_modules() -> dict[str, types.ModuleType]:
+    telegram = types.ModuleType("telegram")
+    constants = types.ModuleType("telegram.constants")
+    error = types.ModuleType("telegram.error")
+    helpers = types.ModuleType("telegram.helpers")
+
+    class Bot:
+        def __init__(self, token=None, *a, **k) -> None:
+            self.token = token
+
+        async def send_message(self, *a, **k) -> None:
+            return None
+
+    class ParseMode:
+        HTML = "HTML"
+        MARKDOWN = "Markdown"
+
+    class TelegramError(Exception):
+        pass
+
+    class RetryAfter(TelegramError):
+        def __init__(self, retry_after: float = 1.0) -> None:
+            super().__init__(f"retry after {retry_after}")
+            self.retry_after = retry_after
+
+    class TimedOut(TelegramError):
+        pass
+
+    telegram.Bot = Bot
+    constants.ParseMode = ParseMode
+    error.TelegramError = TelegramError
+    error.RetryAfter = RetryAfter
+    error.TimedOut = TimedOut
+    helpers.escape = lambda text: _html.escape(str(text), quote=False)
+    telegram.constants = constants
+    telegram.error = error
+    telegram.helpers = helpers
+    return {
+        "telegram": telegram,
+        "telegram.constants": constants,
+        "telegram.error": error,
+        "telegram.helpers": helpers,
+    }
+
+
+def _build_dotenv_module() -> types.ModuleType:
+    dotenv = types.ModuleType("dotenv")
+    dotenv.load_dotenv = lambda *a, **k: False
+    return dotenv
+
+
+def install_shims() -> str:
+    """Register the shims in ``sys.modules`` and put the reference on the
+    import path. Idempotent. Returns the reference path."""
+    if "pybinbot" not in sys.modules:
+        sys.modules["pybinbot"] = _build_pybinbot_module()
+    if "pandera" not in sys.modules:
+        pandera, typing_mod = _build_pandera_module()
+        sys.modules["pandera"] = pandera
+        sys.modules["pandera.typing"] = typing_mod
+    if "telegram" not in sys.modules:
+        sys.modules.update(_build_telegram_modules())
+    if "dotenv" not in sys.modules:
+        sys.modules["dotenv"] = _build_dotenv_module()
+    if REFERENCE_PATH not in sys.path:
+        # append (not prepend): the reference's generic top-level names
+        # (shared, models, strategies, ...) must never shadow repo modules
+        sys.path.append(REFERENCE_PATH)
+    os.environ.setdefault("ENV", "CI")
+    return REFERENCE_PATH

@@ -1,49 +1,107 @@
-"""Grid-only trading policy (host edge).
+"""Grid-only trading mode (host edge).
 
-Equivalent of ``/root/reference/market_regime/grid_only_policy.py``: in
-RANGE/TRANSITIONAL regimes, non-flat market-breadth momentum flips the
-engine into "grid ladders only" mode (standard bots blocked). The breadth
-series arrives via REST from the analytics backend, so this is host-side
-code by nature — the resulting two booleans are fed into the autotrade gate
-chain (and mirrored into the device gate mask by the engine).
+Behavioral equivalent of ``/root/reference/market_regime/grid_only_policy.py``
+(:121-158): in RANGE/TRANSITIONAL regimes, non-flat market-breadth momentum
+flips the engine into "grid ladders only" mode — ladder deploys allowed,
+standard bots blocked. The breadth series arrives via REST, so this stays
+host-side; the verdict feeds the autotrade gate chain and is mirrored into
+the device gate mask by the engine.
+
+Written in this codebase's gate-chain idiom (see
+``binquant_tpu/regime/routing.py``): a plain-function decision ladder over
+an explicit :class:`BreadthMomentum` reading, returning an immutable
+verdict tuple. Reason strings are load-bearing (they ride Telegram and
+analytics payloads) and follow the reference's vocabulary exactly.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from math import isfinite
-from typing import Any, ClassVar
+from typing import Any, NamedTuple
 
 from binquant_tpu.enums import MarketRegimeCode
 from binquant_tpu.schemas import MarketBreadthSeries
 
 
-def timestamp_sort_key(value: Any) -> float | None:
-    """Best-effort numeric sort key for mixed timestamp payloads."""
+def _as_finite(value: Any) -> float | None:
+    """float(value) if it parses to a finite number, else None."""
     try:
         parsed = float(value)
     except (TypeError, ValueError):
         return None
-    if not isfinite(parsed):
+    return parsed if isfinite(parsed) else None
+
+
+def timestamp_sort_key(value: Any) -> float | None:
+    """Best-effort numeric sort key for mixed timestamp payloads."""
+    return _as_finite(value)
+
+
+def _oldest_to_newest(
+    values: list[Any], timestamps: list[Any], *, api_is_newest_first: bool
+) -> list[float]:
+    """Put a breadth series in oldest→newest order.
+
+    Timestamps win when at least two rows carry usable ones; otherwise the
+    raw list is trusted in its API order (the analytics endpoint serves the
+    MA series newest-first, hence the reversal fallback)."""
+    if len(values) >= 2 and len(timestamps) >= len(values):
+        stamped = sorted(
+            (
+                (key, parsed)
+                for ts, raw in zip(timestamps, values)
+                if (key := timestamp_sort_key(ts)) is not None
+                and (parsed := _as_finite(raw)) is not None
+            ),
+            key=lambda pair: pair[0],  # stable: ties keep arrival order
+        )
+        if len(stamped) >= 2:
+            return [parsed for _, parsed in stamped]
+    cleaned = [parsed for raw in values if (parsed := _as_finite(raw)) is not None]
+    return cleaned[::-1] if api_is_newest_first else cleaned
+
+
+class BreadthMomentum(NamedTuple):
+    """The last two usable readings of the preferred breadth series."""
+
+    source: str
+    previous: float
+    latest: float
+
+    @property
+    def leaning(self) -> str:
+        """'toward_trend' | 'toward_range' | 'flat' — breadth magnitude
+        growing means the market is picking a side; shrinking means it is
+        settling into range; equal means no signal."""
+        if abs(self.latest) > abs(self.previous):
+            return "toward_trend"
+        if abs(self.latest) < abs(self.previous):
+            return "toward_range"
+        return "flat"
+
+
+def read_breadth_momentum(
+    breadth: MarketBreadthSeries | None,
+) -> BreadthMomentum | None:
+    """Extract the momentum reading, preferring the smoothed MA series and
+    falling back to the raw one — both served newest-first by the API."""
+    if breadth is None or len(breadth.timestamp) < 2:
         return None
-    return parsed
+    for source in ("market_breadth_ma", "market_breadth"):
+        series = _oldest_to_newest(
+            getattr(breadth, source), breadth.timestamp, api_is_newest_first=True
+        )
+        if len(series) >= 2:
+            return BreadthMomentum(source=source, previous=series[-2], latest=series[-1])
+    return None
 
 
-@dataclass(frozen=True)
-class GridOnlyPolicy:
-    """Resolved policy decision (reference grid_only_policy.py:12-55)."""
+class GridOnlyPolicy(NamedTuple):
+    """Immutable verdict of the grid-only decision ladder."""
 
-    GRID_ONLY_REGIMES: ClassVar[frozenset[int]] = frozenset(
-        {int(MarketRegimeCode.RANGE), int(MarketRegimeCode.TRANSITIONAL)}
-    )
-    BREADTH_SOURCES: ClassVar[tuple[tuple[str, bool], ...]] = (
-        ("market_breadth_ma", True),
-        ("market_breadth", True),
-    )
-
-    allow_grid_ladder: bool
-    block_standard_bots: bool
-    reason: str
+    allow_grid_ladder: bool = False
+    block_standard_bots: bool = False
+    reason: str = "not_evaluated"
     direction: str | None = None
     source: str | None = None
     latest: float | None = None
@@ -52,7 +110,7 @@ class GridOnlyPolicy:
 
     @classmethod
     def disabled(cls, reason: str) -> "GridOnlyPolicy":
-        return cls(allow_grid_ladder=False, block_standard_bots=False, reason=reason)
+        return cls(reason=reason)
 
     @classmethod
     def active(
@@ -69,72 +127,27 @@ class GridOnlyPolicy:
             momentum_points=(latest - previous) * 100,
         )
 
-    @staticmethod
-    def _coerce(value: Any) -> float | None:
-        try:
-            parsed = float(value)
-        except (TypeError, ValueError):
-            return None
-        return parsed if isfinite(parsed) else None
-
-    @classmethod
-    def _ordered_values(
-        cls, values: list[Any], timestamps: list[Any], *, newest_first: bool
-    ) -> list[float]:
-        """Order breadth values oldest→newest, preferring timestamp sort;
-        fall back to list order (reversed when the source is newest-first)."""
-        if len(values) >= 2 and len(timestamps) >= len(values):
-            stamped = [
-                (key, val)
-                for ts, v in zip(timestamps, values)
-                if (key := timestamp_sort_key(ts)) is not None
-                and (val := cls._coerce(v)) is not None
-            ]
-            if len(stamped) >= 2:
-                return [val for _, val in sorted(stamped, key=lambda item: item[0])]
-        parsed = [val for v in values if (val := cls._coerce(v)) is not None]
-        return list(reversed(parsed)) if newest_first else parsed
-
-    @classmethod
-    def _breadth_pair(
-        cls, breadth: MarketBreadthSeries | None
-    ) -> tuple[float, float, str] | None:
-        if breadth is None or len(breadth.timestamp) < 2:
-            return None
-        for source, newest_first in cls.BREADTH_SOURCES:
-            ordered = cls._ordered_values(
-                getattr(breadth, source), breadth.timestamp, newest_first=newest_first
-            )
-            if len(ordered) >= 2:
-                return ordered[-2], ordered[-1], source
-        return None
-
     @classmethod
     def resolve(
-        cls,
-        market_regime: int | None,
-        breadth: MarketBreadthSeries | None,
+        cls, market_regime: int | None, breadth: MarketBreadthSeries | None
     ) -> "GridOnlyPolicy":
-        """Decision ladder (grid_only_policy.py:121-158). ``market_regime``
-        is the int code from the device context; None/-1 = unavailable."""
+        """Decision ladder. ``market_regime`` is the int regime code from
+        the device context (None = no context, -1 = context invalid)."""
         if market_regime is None:
             return cls.disabled("market_context_unavailable")
         if market_regime < 0:
             return cls.disabled("market_regime_unavailable")
-        if market_regime not in cls.GRID_ONLY_REGIMES:
-            name = MarketRegimeCode(market_regime).name.lower()
-            return cls.disabled(f"market_regime_{name}")
-
-        pair = cls._breadth_pair(breadth)
-        if pair is None:
+        regime = MarketRegimeCode(market_regime)
+        if regime not in (MarketRegimeCode.RANGE, MarketRegimeCode.TRANSITIONAL):
+            return cls.disabled(f"market_regime_{regime.name.lower()}")
+        momentum = read_breadth_momentum(breadth)
+        if momentum is None:
             return cls.disabled("breadth_momentum_unavailable")
-        previous, latest, source = pair
-        if abs(latest) > abs(previous):
-            return cls.active(
-                direction="toward_trend", source=source, latest=latest, previous=previous
-            )
-        if abs(latest) < abs(previous):
-            return cls.active(
-                direction="toward_range", source=source, latest=latest, previous=previous
-            )
-        return cls.disabled("breadth_momentum_flat")
+        if momentum.leaning == "flat":
+            return cls.disabled("breadth_momentum_flat")
+        return cls.active(
+            direction=momentum.leaning,
+            source=momentum.source,
+            latest=momentum.latest,
+            previous=momentum.previous,
+        )

@@ -171,7 +171,8 @@ def _feed_tick(engine, names, bucket, *, mrf_hammer=()):
         base = {
             "symbol": sym,
             "open": o, "high": h, "low": lo, "close": c,
-            "volume": vol, "quote_volume": vol * c, "num_trades": 50,
+            "volume": vol, "quote_asset_volume": vol * c,
+            "number_of_trades": 50,
         }
         engine.ingest(
             {**base, "open_time": ts15 * 1000,
@@ -180,7 +181,8 @@ def _feed_tick(engine, names, bucket, *, mrf_hammer=()):
         for j in range(3):
             t5 = ts15 + j * 300
             engine.ingest(
-                {**base, "volume": vol / 3, "quote_volume": vol * c / 3,
+                {**base, "volume": vol / 3,
+                 "quote_asset_volume": vol * c / 3,
                  "open_time": t5 * 1000, "close_time": (t5 + 300) * 1000 - 1}
             )
 

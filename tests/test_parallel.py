@@ -159,3 +159,16 @@ def test_parity_subprocess_eight_cpu_devices():
     )
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "parity ok" in proc.stdout
+
+
+def test_make_mesh_rejects_multiprocess(monkeypatch):
+    """The mesh path is single-host by construction (shard_host_inputs
+    device_puts full host arrays); a pod must fail fast at mesh creation,
+    not mid-tick inside device_put."""
+    import jax
+
+    from binquant_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(NotImplementedError, match="single-host"):
+        make_mesh()

@@ -1,0 +1,52 @@
+"""Fast integrity checks for the reference-differential shim layer.
+
+The real three-way diffs are slow-lane (test_reference_differential.py);
+these keep the harness from rotting silently in the fast lane: the shims
+install, the reference tree imports against them, and a micro-replay runs
+the full provider chain end-to-end under the crash-isolation check.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from binquant_tpu.io.replay import generate_replay_file
+from binquant_tpu.refdiff import (
+    install_shims,
+    reference_available,
+    run_replay_reference,
+)
+
+pytestmark = pytest.mark.skipif(
+    not reference_available(),
+    reason="reference tree not present (BQT_REFERENCE_PATH)",
+)
+
+
+def test_shims_install_and_reference_imports():
+    install_shims()
+    import pybinbot
+
+    # the SDK surface the reference consumes resolves through the shim
+    assert pybinbot.MarketType.FUTURES.value == "futures"
+    assert pybinbot.KucoinKlineIntervals.FIFTEEN_MINUTES.get_ms() == 900_000
+    from consumers.klines_provider import KlinesProvider
+    from market_regime.regime_transitions import RegimeTransitionDetector
+    from strategies.mean_reversion_fade import MeanReversionFade
+
+    assert KlinesProvider.LIMIT == 400
+    assert MeanReversionFade.RSI_LONG_MAX == 25.0
+    assert RegimeTransitionDetector._transition_strength_floor == 0.08
+
+
+def test_micro_replay_runs_reference_chain(tmp_path):
+    """8 symbols x 8 ticks: too short for any strategy to fire (MA-100
+    gates), but the entire provider chain — store sync, accumulator,
+    enrichment, dispatch — must execute without a swallowed exception
+    (the driver raises on any crash-isolated error)."""
+    path = tmp_path / "micro.jsonl"
+    generate_replay_file(path, n_symbols=8, n_ticks=8, seed=3)
+    regimes: list = []
+    fired = run_replay_reference(path, window=100, collect_regimes=regimes)
+    assert fired == []
+    assert len(regimes) == 8

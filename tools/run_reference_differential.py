@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Full-breadth reference differential: execute /root/reference's own code
+over a fixture and diff its signal set + regime trace against both the
+transcribed pandas oracle and the TPU batch path (VERDICT r4 item 1).
+
+The slow suite runs the same diff on a 32-symbol subset of the 36h market
+fixture (tests/test_reference_differential.py) to bound CI wall-clock; this
+script is the unbounded version — all 100 symbols, full duration. Writes
+``REFDIFF.json`` at the repo root with counts, per-strategy tallies and any
+mismatches (empty lists = the three backends agree exactly).
+
+Usage:
+    python tools/run_reference_differential.py [--fixture PATH] [--window N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--fixture", default=str(REPO / "tests/fixtures/market_36h_100sym.jsonl.gz")
+    )
+    ap.add_argument("--window", type=int, default=200)
+    ap.add_argument("--capacity", type=int, default=128)
+    ap.add_argument("--out", default=str(REPO / "REFDIFF.json"))
+    ap.add_argument(
+        "--skip-tpu", action="store_true",
+        help="diff reference vs oracle only (no device runs)",
+    )
+    args = ap.parse_args()
+
+    from binquant_tpu.io.replay import run_replay, run_replay_oracle
+    from binquant_tpu.refdiff import run_replay_reference
+
+    results: dict = {"fixture": args.fixture, "window": args.window}
+
+    t0 = time.time()
+    ref_regimes: list = []
+    ref = set(
+        run_replay_reference(
+            args.fixture, window=args.window, collect_regimes=ref_regimes
+        )
+    )
+    results["reference_wall_s"] = round(time.time() - t0, 1)
+    results["reference_count"] = len(ref)
+
+    t0 = time.time()
+    orc_regimes: list = []
+    orc = set(
+        run_replay_oracle(
+            args.fixture, window=args.window, collect_regimes=orc_regimes
+        )
+    )
+    results["oracle_wall_s"] = round(time.time() - t0, 1)
+    results["oracle_count"] = len(orc)
+
+    if not args.skip_tpu:
+        t0 = time.time()
+        tpu_list: list = []
+        run_replay(
+            args.fixture, capacity=args.capacity, window=args.window,
+            collect=tpu_list,
+        )
+        tpu = set(tpu_list)
+        results["tpu_wall_s"] = round(time.time() - t0, 1)
+        results["tpu_count"] = len(tpu)
+        results["only_tpu_vs_ref"] = sorted(tpu - ref)[:50]
+        results["only_ref_vs_tpu"] = sorted(ref - tpu)[:50]
+
+    results["only_ref_vs_oracle"] = sorted(ref - orc)[:50]
+    results["only_oracle_vs_ref"] = sorted(orc - ref)[:50]
+
+    regime_mismatches = [
+        {"tick_ms": r[0], "reference": r[1], "oracle": o[1]}
+        for r, o in zip(ref_regimes, orc_regimes)
+        if r[1] != o[1]
+    ]
+    results["regime_ticks"] = len(ref_regimes)
+    results["regime_mismatches"] = regime_mismatches[:50]
+    results["regime_mismatch_count"] = len(regime_mismatches)
+
+    from collections import Counter
+
+    results["per_strategy_reference"] = dict(Counter(s for _, s, *_ in ref))
+
+    ok = (
+        not results["only_ref_vs_oracle"]
+        and not results["only_oracle_vs_ref"]
+        and not regime_mismatches
+        and (args.skip_tpu or (not results["only_tpu_vs_ref"] and not results["only_ref_vs_tpu"]))
+    )
+    results["match"] = ok
+
+    Path(args.out).write_text(json.dumps(results, indent=2) + "\n")
+    print(json.dumps({k: v for k, v in results.items() if "only_" not in k}, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

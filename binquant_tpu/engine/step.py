@@ -344,11 +344,21 @@ def _tick_step_impl(
     inputs: HostInputs,
     cfg: ContextConfig = ContextConfig(),
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+    compute_all: bool = True,
 ) -> tuple[EngineState, TickOutputs]:
     """One tick: apply candle updates, rebuild context, evaluate everything.
 
     ``upd5``/``upd15`` are (row_idx, ts_s, vals) batches from the
     IngestBatcher (pass empty arrays when an interval had no candles).
+
+    ``compute_all=False`` (the wire path) compiles only the strategies the
+    host will actually emit: disabled carry-free kernels are replaced with
+    ``no_signal`` constants at TRACE time, so XLA never schedules them.
+    Without this the wire's per-slot diagnostics gather
+    (``diag_all[si, :, row]``) keeps every dormant kernel live — measured
+    ~52 → ~21 ms/tick at S=2048×W=400 (bench ``device.step_ms``). The two
+    carry-owning kernels (PriceTracker, MeanReversionFade) always run so
+    the device dedupe state advances identically in both variants.
     """
     buf5 = apply_updates(state.buf5, *upd5)
     buf15 = apply_updates(state.buf15, *upd15)
@@ -425,8 +435,23 @@ def _tick_step_impl(
     )
     quiet_suppressed = inputs.quiet_hours & ~trend_override
 
+    from binquant_tpu.strategies.base import no_signal
+
+    skipped = no_signal(S)
+
+    def want(name: str) -> bool:
+        # trace-time (static) decision: compile a carry-free kernel only if
+        # its output can reach the wire
+        return compute_all or name in wire_enabled
+
     # --- live 5m set (dispatch order l.369-389)
-    abp = _mask_outputs(activity_burst_pump(buf5, context), ok5 & fresh5)
+    abp = (
+        _mask_outputs(activity_burst_pump(buf5, context), ok5 & fresh5)
+        if want("activity_burst_pump")
+        else skipped
+    )
+    # PriceTracker/MeanReversionFade own device carries (cooldown/dedupe)
+    # and therefore always run — see docstring.
     pt, pt_carry = price_tracker(
         pack5, context, quiet_suppressed, state.pt_last_signal_close
     )
@@ -434,47 +459,73 @@ def _tick_step_impl(
     pt_carry = jnp.where(ok5 & fresh5, pt_carry, state.pt_last_signal_close)
 
     # --- live 15m set (dispatch order l.434-479)
-    lsp = _mask_outputs(
-        liquidation_sweep_pump(
-            buf15,
-            context,
-            inputs.oi_growth,
-            inputs.adp_latest,
-            inputs.adp_prev,
-            _btc_momentum(btc_close),
-        ),
-        ok15 & fresh15,
+    lsp = (
+        _mask_outputs(
+            liquidation_sweep_pump(
+                buf15,
+                context,
+                inputs.oi_growth,
+                inputs.adp_latest,
+                inputs.adp_prev,
+                _btc_momentum(btc_close),
+            ),
+            ok15 & fresh15,
+        )
+        if want("liquidation_sweep_pump")
+        else skipped
     )
     mrf, mrf_carry = mean_reversion_fade(
         pack15, inputs.is_futures, state.mrf_last_emitted
     )
     mrf = _mask_outputs(mrf, ok15 & fresh15)
     mrf_carry = jnp.where(ok15 & fresh15, mrf_carry, state.mrf_last_emitted)
-    ladder = _mask_outputs(
-        ladder_deployer(pack15, context, inputs.grid_policy_allows, inputs.is_futures),
-        ok15 & fresh15,
+    ladder = (
+        _mask_outputs(
+            ladder_deployer(
+                pack15, context, inputs.grid_policy_allows, inputs.is_futures
+            ),
+            ok15 & fresh15,
+        )
+        if want("grid_ladder")
+        else skipped
     )
 
     # --- dormant capability set
-    sts = _mask_outputs(
-        supertrend_swing_reversal(
-            buf5,
-            pack5,
-            context,
-            long_gate,
-            inputs.adp_diff,
-            inputs.adp_diff_prev,
-            inputs.dominance_is_losers,
-        ),
-        ok5 & fresh5,
+    sts = (
+        _mask_outputs(
+            supertrend_swing_reversal(
+                buf5,
+                pack5,
+                context,
+                long_gate,
+                inputs.adp_diff,
+                inputs.adp_diff_prev,
+                inputs.dominance_is_losers,
+            ),
+            ok5 & fresh5,
+        )
+        if want("coinrule_supertrend_swing_reversal")
+        else skipped
     )
-    twap = _mask_outputs(twap_momentum_sniper(buf15, pack5), ok5 & fresh5)
-    blsh = _mask_outputs(
-        buy_low_sell_high(buf15, pack15, inputs.market_domination_reversal),
-        ok15 & fresh15,
+    twap = (
+        _mask_outputs(twap_momentum_sniper(buf15, pack5), ok5 & fresh5)
+        if want("coinrule_twap_momentum_sniper")
+        else skipped
     )
-    btd = _mask_outputs(
-        buy_the_dip(buf15, pack15, context, quiet_suppressed), ok15 & fresh15
+    blsh = (
+        _mask_outputs(
+            buy_low_sell_high(buf15, pack15, inputs.market_domination_reversal),
+            ok15 & fresh15,
+        )
+        if want("coinrule_buy_low_sell_high")
+        else skipped
+    )
+    btd = (
+        _mask_outputs(
+            buy_the_dip(buf15, pack15, context, quiet_suppressed), ok15 & fresh15
+        )
+        if want("coinrule_buy_the_dip")
+        else skipped
     )
     # BBX ships ENABLED=False (reference l.45-46); opting it into the wire
     # set (enabled_strategies override) also enables the kernel — the
@@ -482,22 +533,40 @@ def _tick_step_impl(
     # when dormant
     from binquant_tpu.strategies.dormant import BBXParams
 
-    bbx = _mask_outputs(
-        bb_extreme_reversion(
-            buf15,
-            pack15,
-            context,
-            BBXParams(enabled="bb_extreme_reversion" in wire_enabled),
-        ),
-        ok15 & fresh15,
+    bbx = (
+        _mask_outputs(
+            bb_extreme_reversion(
+                buf15,
+                pack15,
+                context,
+                BBXParams(enabled="bb_extreme_reversion" in wire_enabled),
+            ),
+            ok15 & fresh15,
+        )
+        if want("bb_extreme_reversion")
+        else skipped
     )
-    ipt = _mask_outputs(inverse_price_tracker(pack5, context), ok5 & fresh5)
-    rbr = _mask_outputs(
-        range_bb_rsi_mean_reversion(buf15, pack15, context), ok15 & fresh15
+    ipt = (
+        _mask_outputs(inverse_price_tracker(pack5, context), ok5 & fresh5)
+        if want("inverse_price_tracker")
+        else skipped
     )
-    rfbf = _mask_outputs(range_failed_breakout_fade(spikes, context), ok15 & fresh15)
-    rsr = _mask_outputs(
-        relative_strength_reversal_range(buf15, pack15, context), ok15 & fresh15
+    rbr = (
+        _mask_outputs(range_bb_rsi_mean_reversion(buf15, pack15, context), ok15 & fresh15)
+        if want("range_bb_rsi_mean_reversion")
+        else skipped
+    )
+    rfbf = (
+        _mask_outputs(range_failed_breakout_fade(spikes, context), ok15 & fresh15)
+        if want("range_failed_breakout_fade")
+        else skipped
+    )
+    rsr = (
+        _mask_outputs(
+            relative_strength_reversal_range(buf15, pack15, context), ok15 & fresh15
+        )
+        if want("relative_strength_reversal_range")
+        else skipped
     )
 
     new_state = EngineState(
@@ -681,9 +750,9 @@ def _tick_step_impl(
     return new_state, outputs
 
 
-tick_step = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
-    _tick_step_impl
-)
+tick_step = partial(
+    jax.jit, static_argnames=("cfg", "wire_enabled", "compute_all")
+)(_tick_step_impl)
 
 
 def _tick_step_wire_impl(
@@ -700,8 +769,15 @@ def _tick_step_wire_impl(
     through a tunneled device) — measured at S=2048 the full step's paced
     dispatch is ~6.6 ms vs ~2.9 ms wire-only. The host consumes nothing but
     the wire on the common path anyway (io/emission.py); overflow/fallback
-    paths re-run the full ``tick_step`` (pure function, same inputs)."""
-    new_state, outputs = _tick_step_impl(state, upd5, upd15, inputs, cfg, wire_enabled)
+    paths re-run the full ``tick_step`` (pure function, same inputs).
+
+    Disabled carry-free strategy kernels are compiled OUT of this variant
+    (``compute_all=False``) — the wire can't carry their output, so the
+    device shouldn't pay for them (9 dormant kernels at the default live
+    set)."""
+    new_state, outputs = _tick_step_impl(
+        state, upd5, upd15, inputs, cfg, wire_enabled, compute_all=False
+    )
     return new_state, outputs.wire
 
 
@@ -717,7 +793,7 @@ tick_step_wire = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
 # state) requires the old state to survive a tick that throws mid-flight.
 tick_step_donated = jax.jit(
     _tick_step_impl,
-    static_argnames=("cfg", "wire_enabled"),
+    static_argnames=("cfg", "wire_enabled", "compute_all"),
     donate_argnums=(0,),
 )
 

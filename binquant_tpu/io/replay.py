@@ -68,6 +68,7 @@ def make_stub_engine(
     breadth: dict | None = None,
     pipeline_depth: int = 0,
     enabled_strategies: set[str] | None = None,
+    context_config=None,
 ):
     """A SignalEngine wired entirely to stubs (no network)."""
     import os
@@ -118,7 +119,10 @@ def make_stub_engine(
         telegram_consumer=telegram,
         at_consumer=at_consumer,
         window=window,
-        context_config=ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5),
+        # small-universe default; production-breadth tests pass the real
+        # ContextConfig() (40 fresh / 0.70 coverage)
+        context_config=context_config
+        or ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5),
         pipeline_depth=pipeline_depth,
         enabled_strategies=enabled_strategies,
     )
@@ -154,6 +158,7 @@ def run_replay(
     enabled_strategies: set | None = None,
     dominance_is_losers: bool = False,
     market_domination_reversal: bool = False,
+    context_config=None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -174,6 +179,7 @@ def run_replay(
         breadth=breadth,
         pipeline_depth=pipeline_depth,
         enabled_strategies=enabled_strategies,
+        context_config=context_config,
     )
     # scripted dominance state (reference: attrs on the evaluator/consumer,
     # NEUTRAL/False in production — scriptable here so the dominance-gated

@@ -119,12 +119,30 @@ class ExchangeId(str, Enum):
 
 
 class MarketType(str, Enum):
-    SPOT = "spot"
-    FUTURES = "futures"
+    """Wire values are UPPERCASE — the pybinbot/binbot analytics contract
+    (the reference's own tests pin bot_params.market_type == "FUTURES").
+    Parsing is case-insensitive so config/env inputs like "futures" and
+    legacy lowercase payloads keep working."""
+
+    SPOT = "SPOT"
+    FUTURES = "FUTURES"
+
+    @classmethod
+    def _missing_(cls, value):
+        if isinstance(value, str):
+            upper = value.upper()
+            for member in cls:
+                if member.value == upper:
+                    return member
+        return None
 
 
 class Status(str, Enum):
     inactive = "inactive"
+    # a submitted-but-not-yet-opened bot (limit entry resting) — the
+    # activation path reports "submitted" vs "opened" on it
+    # (reference shared/autotrade.py:326)
+    pending = "pending"
     active = "active"
     completed = "completed"
     error = "error"

@@ -22,7 +22,11 @@ class AutotradeError(BinquantError):
 
 
 class BinbotError(BinquantError):
-    pass
+    """Backend API error; carries ``.message`` like the pybinbot original."""
+
+    def __init__(self, message: str = "", *args) -> None:
+        super().__init__(message, *args)
+        self.message = message
 
 
 class InvalidSymbol(BinquantError):

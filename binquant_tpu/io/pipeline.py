@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 import time
 from collections import deque
 from datetime import UTC, datetime
@@ -64,12 +65,22 @@ def breadth_scalars(
     nan = float("nan")
     if mb is None or len(mb.timestamp) < 2:
         return nan, nan, nan, nan, nan
-    values = [float(v) for v in mb.market_breadth]
+    # the live API may null individual entries (model tolerates them);
+    # treat None as NaN rather than crashing the tick input build
+    values = [nan if v is None else float(v) for v in mb.market_breadth]
     adp_latest = values[-1] if values else nan
     adp_prev = values[-2] if len(values) >= 2 else nan
     adp_diff = values[-1] - values[-2] if len(values) >= 2 else nan
     adp_diff_prev = values[-2] - values[-3] if len(values) >= 3 else nan
-    ma = [float(v) for v in mb.market_breadth_ma]
+    # momentum prefers the smoothed MA series; nulled/non-finite entries
+    # are dropped (not propagated as NaN) so the raw-values fallback
+    # engages exactly when the MA series is unusable — the same
+    # preference order grid_policy's reading applies
+    ma = [
+        float(v)
+        for v in mb.market_breadth_ma
+        if v is not None and math.isfinite(float(v))
+    ]
     momentum = (ma[-1] - ma[-2]) * 100 if len(ma) >= 2 else (
         (values[-1] - values[-2]) * 100 if len(values) >= 2 else nan
     )

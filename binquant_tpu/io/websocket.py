@@ -231,7 +231,7 @@ def parse_kucoin_candle_message(
     if interval_s is None:
         return None
     t = int(float(candles[0])) * 1000
-    if market_type == "futures":
+    if str(market_type).lower() == "futures":
         o, h, low, c = (float(candles[i]) for i in (1, 2, 3, 4))
         volume = float(candles[5]) if len(candles) > 5 else 0.0
         turnover = 0.0
@@ -285,7 +285,7 @@ class KucoinKlinesConnector:
         self.queue = queue
         self.market_type = market_type
         symbols = filter_fiat_symbols(symbols)
-        if market_type == "futures":
+        if str(market_type).lower() == "futures":
             self.topic_symbols = kucoin_futures_ids(symbols)
         else:
             self.topic_symbols = [kucoin_spot_api_symbol(s) for s in symbols]
@@ -307,7 +307,7 @@ class KucoinKlinesConnector:
 
         url = (
             self.FUTURES_BULLET
-            if self.market_type == "futures"
+            if str(self.market_type).lower() == "futures"
             else self.SPOT_BULLET
         )
         data = httpx.post(url, timeout=10).json()["data"]
@@ -319,7 +319,7 @@ class KucoinKlinesConnector:
         )
 
     def _topic(self, symbol: str, interval: str) -> str:
-        if self.market_type == "futures":
+        if str(self.market_type).lower() == "futures":
             return f"/contractMarket/limitCandle:{symbol}_{interval}"
         return f"/market/candles:{symbol}_{interval}"
 

@@ -1,0 +1,37 @@
+"""pytest plugin that lets the REFERENCE's own test suite run here.
+
+Usage (see ``tools/run_reference_suite.py``):
+
+    pytest /root/reference/tests -p binquant_tpu.refdiff.pytest_plugin
+
+Two jobs:
+
+* install the pybinbot/pandera/telegram/dotenv shims BEFORE the reference
+  conftest imports them — so the reference's 300-odd unit tests execute
+  against THIS repo's SDK-surface replica (``binquant_tpu.schemas`` et
+  al.), turning the reference suite into a behavioral-compatibility check
+  of that replica;
+* run ``async def`` tests (the reference uses pytest-asyncio, not
+  installed in this environment) with a minimal asyncio runner.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+
+from binquant_tpu.refdiff.shims import install_shims
+
+install_shims()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -270,7 +270,12 @@ class Indicators:
 
 
 class BinbotErrors(Exception):
-    pass
+    """Carries ``.message`` — the consumer logs it directly
+    (autotrade_consumer.py ``except BinbotErrors as e: logging.info(e.message)``)."""
+
+    def __init__(self, message: str = "", *args) -> None:
+        super().__init__(message, *args)
+        self.message = message
 
 
 class BinbotError(BinbotErrors):
@@ -389,7 +394,16 @@ class KucoinFutures(_ExchangeApi):
 
 
 class AsyncSpotWebsocketStreamClient:
+    """Constructible inert stand-in (the factory tests instantiate these);
+    actually RUNNING a stream is out of scope for the harness."""
+
     def __init__(self, *a, **k) -> None:
+        self.args, self.kwargs = a, k
+
+    def subscribe_klines(self, *a, **k) -> None:
+        return None
+
+    async def run_forever(self, *a, **k) -> None:
         raise RuntimeError("refdiff harness does not drive websockets")
 
 
@@ -431,17 +445,14 @@ class KucoinKlineIntervals(str, Enum):
 
 def timestamp_sort_key(value):
     """Sortable numeric key for mixed timestamp payloads, None when
-    unusable — `grid_only_policy.py:78-81` filters on `is not None`, so
-    returning a sentinel instead would keep junk rows the engine-side
-    policy drops (same contract as binquant_tpu.regime.grid_policy)."""
-    try:
-        parsed = float(value)
-    except (TypeError, ValueError):
-        try:
-            parsed = float(pd.Timestamp(value).timestamp())
-        except (TypeError, ValueError):
-            return None
-    return parsed if np.isfinite(parsed) else None
+    unusable — `grid_only_policy.py:78-81` filters on `is not None`.
+    Delegates to the engine-side implementation so the reference and the
+    engine can never order the same breadth payload differently."""
+    from binquant_tpu.regime.grid_policy import (
+        timestamp_sort_key as _engine_sort_key,
+    )
+
+    return _engine_sort_key(value)
 
 
 def configure_logging(*a, **k) -> None:
@@ -473,6 +484,32 @@ def _build_pybinbot_module() -> types.ModuleType:
     class KlineSchema(BaseModel):
         """Typing-only stand-in for pybinbot's pandera KlineSchema."""
 
+    class GridLadderStatus(str, Enum):
+        pending = "pending"
+        active = "active"
+        completed = "completed"
+        cancelled = "cancelled"
+
+    class GridLadderRecord(BaseModel):
+        """Active-ladder record as served by GET grid-ladders/active —
+        consumed generically (attr/key reads) by the autotrade consumer."""
+
+        model_config = {"extra": "allow"}
+
+        symbol: str
+        fiat: str = "USDT"
+        exchange: str = "kucoin"
+        market_type: str = "FUTURES"
+        algorithm_name: str = "grid_ladder"
+        status: GridLadderStatus = GridLadderStatus.pending
+        range_low: float = 0.0
+        range_high: float = 0.0
+        grid_step: float = 0.0
+        level_count: int = 0
+        total_margin: float = 0.0
+        breakout_low: float = 0.0
+        breakout_high: float = 0.0
+
     for name, obj in {
         # data layer
         "Candles": Candles,
@@ -495,6 +532,8 @@ def _build_pybinbot_module() -> types.ModuleType:
         "KlineProduceModel": KlineProduceModel,
         "AutotradeSettingsSchema": AutotradeSettingsSchema,
         "TestAutotradeSettingsSchema": TestAutotradeSettingsSchema,
+        "GridLadderRecord": GridLadderRecord,
+        "GridLadderStatus": GridLadderStatus,
         # enums
         "Position": _schemas.Position,
         "MarketType": _enums.MarketType,
@@ -571,7 +610,9 @@ def _build_telegram_modules() -> dict[str, types.ModuleType]:
     error.TelegramError = TelegramError
     error.RetryAfter = RetryAfter
     error.TimedOut = TimedOut
-    helpers.escape = lambda text: _html.escape(str(text), quote=False)
+    # quote=True: the reference's sanitizer regexes match &#x27;/&quot;
+    # (telegram.helpers.escape escapes quotes)
+    helpers.escape = lambda text: _html.escape(str(text), quote=True)
     telegram.constants = constants
     telegram.error = error
     telegram.helpers = helpers
@@ -593,7 +634,42 @@ def install_shims() -> str:
     """Register the shims in ``sys.modules`` and put the reference on the
     import path. Idempotent. Returns the reference path."""
     if "pybinbot" not in sys.modules:
-        sys.modules["pybinbot"] = _build_pybinbot_module()
+        mod = _build_pybinbot_module()
+        sys.modules["pybinbot"] = mod
+        # package-shaped submodules some reference tests patch directly
+        # (e.g. `pybinbot.apis.binbot.base.BinbotApi`)
+        mod.__path__ = []  # mark as package
+        apis = types.ModuleType("pybinbot.apis")
+        apis.__path__ = []
+        binbot_pkg = types.ModuleType("pybinbot.apis.binbot")
+        binbot_pkg.__path__ = []
+        base = types.ModuleType("pybinbot.apis.binbot.base")
+        base.BinbotApi = mod.BinbotApi
+        binbot_pkg.base = base
+        apis.binbot = binbot_pkg
+        mod.apis = apis
+        sys.modules["pybinbot.apis"] = apis
+        sys.modules["pybinbot.apis.binbot"] = binbot_pkg
+        sys.modules["pybinbot.apis.binbot.base"] = base
+        # pybinbot.streaming.kucoin.kucoin_async_client (factory tests
+        # patch DefaultClient at this path)
+        streaming = types.ModuleType("pybinbot.streaming")
+        streaming.__path__ = []
+        kucoin = types.ModuleType("pybinbot.streaming.kucoin")
+        kucoin.__path__ = []
+        kac = types.ModuleType("pybinbot.streaming.kucoin.kucoin_async_client")
+
+        class DefaultClient:
+            def __init__(self, *a, **k) -> None:
+                self.args, self.kwargs = a, k
+
+        kac.DefaultClient = DefaultClient
+        kucoin.kucoin_async_client = kac
+        streaming.kucoin = kucoin
+        mod.streaming = streaming
+        sys.modules["pybinbot.streaming"] = streaming
+        sys.modules["pybinbot.streaming.kucoin"] = kucoin
+        sys.modules["pybinbot.streaming.kucoin.kucoin_async_client"] = kac
     if "pandera" not in sys.modules:
         pandera, typing_mod = _build_pandera_module()
         sys.modules["pandera"] = pandera

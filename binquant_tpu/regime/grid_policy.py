@@ -33,8 +33,26 @@ def _as_finite(value: Any) -> float | None:
 
 
 def timestamp_sort_key(value: Any) -> float | None:
-    """Best-effort numeric sort key for mixed timestamp payloads."""
-    return _as_finite(value)
+    """Best-effort epoch-SECONDS sort key for mixed timestamp payloads —
+    the live analytics API stamps breadth rows with ISO-8601 strings,
+    older payloads with epoch numbers (ms or s). Everything lands in one
+    comparable unit: numerics ≥1e11 are treated as epoch-ms, ISO strings
+    are parsed with naive stamps pinned to UTC (a local-time
+    interpretation would shift ordering by the host's UTC offset)."""
+    numeric = _as_finite(value)
+    if numeric is not None:
+        return numeric / 1000.0 if abs(numeric) >= 1e11 else numeric
+    if isinstance(value, str):
+        from datetime import UTC, datetime
+
+        try:
+            parsed = datetime.fromisoformat(value)
+        except ValueError:
+            return None
+        if parsed.tzinfo is None:
+            parsed = parsed.replace(tzinfo=UTC)
+        return parsed.timestamp()
+    return None
 
 
 def _oldest_to_newest(

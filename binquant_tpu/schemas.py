@@ -272,9 +272,17 @@ class SignalContextEvaluation(BaseModel):
 
 
 class RecoveryParams(BaseModel):
-    enabled: bool = True
-    max_recovery_attempts: int = 1
-    recovery_margin_pct: float = 0.0
+    """Bounded-recovery (reversal) parameters on a bot.
+
+    Field set pinned by the reference's own tests
+    (``/root/reference/tests/test_autotrade_consumer.py:589-594``): the
+    recovery re-enters along the SOURCE trade's path with its contracts
+    and realized loss carried over."""
+
+    reversal_path: str = "source"
+    source_contracts: float = 0
+    source_loss_fiat: float = 0
+    stop_loss_pct: float = 0
 
 
 class OrderBase(BaseModel):
@@ -315,7 +323,9 @@ class BotBase(BaseModel):
     pair: str
     name: str = "terminal"
     fiat: str = "USDT"
-    quote_asset: str = ""
+    # platform default quote asset (pinned by the reference's
+    # tests/test_producer.py json-mode payload assertions)
+    quote_asset: str = "USDC"
     fiat_order_size: float = 15.0
     candlestick_interval: str = "15m"
     close_condition: CloseConditions = CloseConditions.dynamic_trailing
@@ -480,15 +490,26 @@ class TestAutotradeSettingsSchema(AutotradeSettingsSchema):
 
 
 class MarketBreadthSeries(BaseModel):
-    """Rolling market-breadth time series from the binbot analytics API."""
+    """Rolling market-breadth time series from the binbot analytics API.
 
-    timestamp: list[int] = Field(default_factory=list)
-    market_breadth: list[float] = Field(default_factory=list)
-    market_breadth_ma: list[float] = Field(default_factory=list)
-    adp: list[float] = Field(default_factory=list)
-    adp_ma: list[float] = Field(default_factory=list)
-    advancers: list[float] = Field(default_factory=list)
-    decliners: list[float] = Field(default_factory=list)
+    The live endpoint serves `timestamp` as ISO-8601 strings (newest
+    first) and may null out individual MA entries; the reference's own
+    tests pin that payload shape (e.g.
+    ``/root/reference/tests/test_klines_provider.py:189-200``), so the
+    model must accept it — consumers order by timestamp and drop
+    non-finite entries themselves (``regime/grid_policy.py``,
+    ``io/pipeline.breadth_scalars``). Extra fields (avg_gain, avg_loss,
+    total_volume, ...) are retained untyped."""
+
+    model_config = ConfigDict(extra="allow")
+
+    timestamp: list[int | str] = Field(default_factory=list)
+    market_breadth: list[float | None] = Field(default_factory=list)
+    market_breadth_ma: list[float | None] = Field(default_factory=list)
+    adp: list[float | None] = Field(default_factory=list)
+    adp_ma: list[float | None] = Field(default_factory=list)
+    advancers: list[float | None] = Field(default_factory=list)
+    decliners: list[float | None] = Field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------

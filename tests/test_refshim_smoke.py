@@ -27,8 +27,11 @@ def test_shims_install_and_reference_imports():
     install_shims()
     import pybinbot
 
-    # the SDK surface the reference consumes resolves through the shim
-    assert pybinbot.MarketType.FUTURES.value == "futures"
+    # the SDK surface the reference consumes resolves through the shim;
+    # wire values are UPPERCASE (the real pybinbot contract), parsing is
+    # case-insensitive
+    assert pybinbot.MarketType.FUTURES.value == "FUTURES"
+    assert pybinbot.MarketType("futures") is pybinbot.MarketType.FUTURES
     assert pybinbot.KucoinKlineIntervals.FIFTEEN_MINUTES.get_ms() == 900_000
     from consumers.klines_provider import KlinesProvider
     from market_regime.regime_transitions import RegimeTransitionDetector

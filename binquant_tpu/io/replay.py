@@ -395,7 +395,7 @@ def generate_burst_replay(
     The drill for engine/step.py's compaction limit (commits
     48301f4/f446a62)."""
     rng = np.random.default_rng(seed)
-    t0 = 1_753_000_200
+    t0 = 1_780_272_000
     px = 20 + rng.random(n_symbols) * 100
 
     with open(path, "w") as f:
@@ -457,7 +457,7 @@ def generate_dormant_replay(
     RANGE with low stress.
     """
     rng = np.random.default_rng(seed)
-    t0 = 1_753_000_200
+    t0 = 1_780_272_000
     assert t0 % 900 == 0
     levels = 20 + rng.random(n_symbols) * 100
     closes = np.zeros((n_ticks, n_symbols))
@@ -556,7 +556,7 @@ def generate_dormant_extended_replay(
       (RelativeStrengthReversalRange).
     """
     rng = np.random.default_rng(seed)
-    t0 = 1_753_000_200
+    t0 = 1_780_272_000
     assert t0 % 900 == 0
     levels = 20 + rng.random(n_symbols) * 100
     closes = np.zeros((n_ticks, n_symbols))
@@ -643,7 +643,7 @@ def generate_replay_file(
     # MUST be 15m-bucket-aligned: process_tick derives the evaluated bar's
     # open time from wall clock as bucket*900-900; misaligned open times
     # never match the freshness mask and silently disable every strategy.
-    t0 = 1_753_000_200
+    t0 = 1_780_272_000
     assert t0 % 900 == 0
     px = 20 + rng.random(n_symbols) * 100
 

@@ -195,6 +195,89 @@ class SupertrendResult(NamedTuple):
     direction: jnp.ndarray  # +1 uptrend, -1 downtrend
 
 
+def supertrend_from(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    start: jnp.ndarray,
+    window: int = 10,
+    multiplier: float = 3.0,
+) -> SupertrendResult:
+    """Supertrend whose series BEGINS at per-lane index ``start``.
+
+    The reference computes supertrend on a dropna'd frame
+    (``coinrule.py:140-143`` after ``pre_process``), i.e. the series'
+    first bar is the first row surviving the enrichment warm-up — and the
+    ratchet + Wilder-ATR recursion are path-dependent, so seeding from
+    the full window would diverge. TR, the ATR recursion (ewm
+    ``adjust=False``, NaN before ``window`` samples) and the band ratchet
+    all restart at ``start``: bars before it are ignored entirely,
+    matching ``Indicators.set_supertrend`` applied to ``df.iloc[s:]``.
+    """
+    import jax
+
+    W = close.shape[-1]
+    batch_shape = close.shape[:-1]
+    flat = lambda z: jnp.reshape(z, (-1, W)).T  # (W, B)
+    h, lo, c = flat(high), flat(low), flat(close)
+    start_b = jnp.reshape(jnp.broadcast_to(start, batch_shape), (-1,))
+    B = c.shape[1]
+    alpha = 1.0 / window
+
+    def step(carry, inputs):
+        atr, n_seen, fu, fl, d, prev_close = carry
+        hb, lb_, cb, idx = inputs
+        active = idx >= start_b
+        hl2 = (hb + lb_) / 2.0
+        tr_first = hb - lb_
+        tr = jnp.where(
+            n_seen == 0,
+            tr_first,
+            jnp.maximum(
+                tr_first,
+                jnp.maximum(jnp.abs(hb - prev_close), jnp.abs(lb_ - prev_close)),
+            ),
+        )
+        atr_new = jnp.where(n_seen == 0, tr, atr + alpha * (tr - atr))
+        n_new = n_seen + 1
+        atr_ready = n_new >= window
+        ub = jnp.where(atr_ready, hl2 + multiplier * atr_new, jnp.inf)
+        lb = jnp.where(atr_ready, hl2 - multiplier * atr_new, -jnp.inf)
+        fu_new = jnp.where((ub < fu) | (prev_close > fu), ub, fu)
+        fl_new = jnp.where((lb > fl) | (prev_close < fl), lb, fl)
+        d_new = jnp.where(cb > fu_new, 1.0, jnp.where(cb < fl_new, -1.0, d))
+        # inactive lanes (before their start) keep the initial carry
+        keep = lambda new, old: jnp.where(active, new, old)
+        carry = (
+            keep(atr_new, atr),
+            keep(n_new, n_seen),
+            keep(fu_new, fu),
+            keep(fl_new, fl),
+            keep(d_new, d),
+            keep(cb, prev_close),
+        )
+        line = jnp.where(d_new > 0, fl_new, fu_new)
+        valid = active & atr_ready
+        return carry, (
+            jnp.where(valid, line, jnp.nan),
+            jnp.where(valid, d_new, jnp.nan),
+        )
+
+    init = (
+        jnp.zeros((B,)),
+        jnp.zeros((B,), dtype=jnp.int32),
+        jnp.full((B,), jnp.inf),
+        jnp.full((B,), -jnp.inf),
+        jnp.ones((B,)),
+        jnp.zeros((B,)),
+    )
+    _, (st, dirn) = jax.lax.scan(
+        step, init, (h, lo, c, jnp.arange(W, dtype=jnp.int32))
+    )
+    unflat = lambda z: jnp.reshape(z.T, batch_shape + (W,))
+    return SupertrendResult(unflat(st), unflat(dirn))
+
+
 def supertrend(
     high: jnp.ndarray,
     low: jnp.ndarray,

@@ -899,6 +899,10 @@ class OracleEvaluator:
         lookback = 24
         if len(df) <= lookback:
             return None
+        # go-live gate (buy_the_dip.py:34,147-149: START_TIME 2026-04-12
+        # 23:21 UTC, judged on the bar's close_time)
+        if int(df["open_time"].iloc[-1]) // 1000 + 900 < 1_776_036_060:
+            return None
         close = df["close"]
         current = float(close.iloc[-1])
         reference = float(close.iloc[-1 - lookback])
@@ -1154,8 +1158,14 @@ class OracleEvaluator:
         trades = float(df["number_of_trades"].iloc[-1])
         if rsi is None or not (rsi < 30.0 and trades > 5):
             return None
-        # supertrend(10,3): Wilder ATR + band ratchet + flip state,
-        # sequential — mirrors ops/indicators.supertrend exactly
+        # supertrend(10,3) on the dropna'd enriched frame (coinrule.py:
+        # 140-143 via pre_process): the series begins after the ma_100
+        # warm-up, 99 rows past the first available bar — the ratchet is
+        # path-dependent so the seed point matters (ops supertrend_from)
+        if len(df) <= 99:
+            return None
+        tail_df = df.iloc[99:]
+        close, high, low = tail_df["close"], tail_df["high"], tail_df["low"]
         pc = close.shift(1)
         tr = pd.concat(
             [high - low, (high - pc).abs(), (low - pc).abs()], axis=1

@@ -145,6 +145,161 @@ class _StrategyCrashCheck(logging.Handler):
             self.crashes.append(self.format(record))
 
 
+def _dormant_dispatch_wrapper(
+    evaluator_cls,
+    *,
+    dominance_is_losers: bool,
+    market_domination_reversal: bool,
+    enable_bbx: bool,
+):
+    """Wrap ``ContextEvaluator.process_data`` to ALSO dispatch the dormant
+    strategy set after the live one.
+
+    The reference removed these strategies from ``process_data``'s
+    dispatch but kept their classes fully wired to the evaluator (each
+    ctor still takes ``cls: ContextEvaluator`` and reads its dfs/context/
+    sinks). This wrapper reconstructs the retired dispatch — 5m set with
+    5m spreads, 15m set with 15m spreads, the same MA-sufficiency gates
+    ``process_data`` applies — WITHOUT modifying any reference code: the
+    strategies' own ``signal`` bodies execute verbatim.
+
+    Harness-level scripting mirrors the engine A/B's knobs: the
+    market-dominance flags (hardcoded NEUTRAL/False at evaluator
+    construction in the reference) and BBExtremeReversion's ``ENABLED``
+    ship-flag (False in the reference — flipping it is the reference-side
+    analogue of the engine's ``enabled_strategies`` override)."""
+    from strategies.coinrule.bb_extreme_reversion import BBExtremeReversion
+    from strategies.coinrule.buy_the_dip import BuyTheDip
+    from strategies.coinrule.coinrule import Coinrule
+    from strategies.inverse_price_tracker import InversePriceTracker
+    from strategies.range_bb_rsi_mean_reversion import RangeBbRsiMeanReversion
+    from strategies.range_failed_breakout_fade import RangeFailedBreakoutFade
+    from strategies.relative_strength_reversal_range import (
+        RelativeStrengthReversalRange,
+    )
+
+    original = evaluator_cls.process_data
+
+    async def process_data_with_dormant(self, candles, candles_15m, btc_candles_15m=None):
+        await original(self, candles, candles_15m, btc_candles_15m)
+
+        from pybinbot import Indicators, MarketDominance
+
+        if dominance_is_losers:
+            self.current_market_dominance = MarketDominance.LOSERS
+        self.market_domination_reversal = market_domination_reversal
+
+        # the TWAP sniper reads a twap column off the 1h resample, which
+        # the retired dispatch enriched (current process_data leaves df_1h
+        # bare) — prepare it the same way the 5m/15m frames are enriched
+        df1h = getattr(self, "df_1h", None)
+        if df1h is not None and not df1h.empty and "twap" not in df1h:
+            self.df_1h = Indicators.set_twap(df1h)
+
+        async def safe(name, coro):
+            await self._safe_signal(name, coro)
+
+        # --- 5m dormant set (same sufficiency gate as the live 5m block,
+        # context_evaluator.py:361-365)
+        df5 = getattr(self, "df_5m", None)
+        if (
+            df5 is not None
+            and not df5.empty
+            and "ma_100" in df5
+            and df5.ma_7.size >= 7
+            and df5.ma_25.size >= 25
+            and df5.ma_100.size >= 100
+        ):
+            close5 = float(df5["close"].iloc[-1])
+            s5 = self.bb_spreads(df5)
+            coinrule = Coinrule(cls=self)
+            await safe(
+                "InversePriceTracker",
+                InversePriceTracker(cls=self).signal(
+                    close5, s5.bb_high, s5.bb_low, s5.bb_mid
+                ),
+            )
+            # the TWAP sniper and supertrend rule open with
+            # `df.isnull().values.any()` gates that predate the current
+            # keep-NaN frame hygiene (the enriched frame always carries
+            # ma_100 warm-up NaNs, which would dead-gate both); hand them
+            # the dropna'd frame the retired dispatch saw — their signal
+            # bodies execute unmodified
+            saved_df5 = self.df_5m
+            try:
+                self.df_5m = saved_df5.dropna().reset_index(drop=True)
+                await safe(
+                    "TwapMomentumSniper",
+                    coinrule.twap_momentum_sniper(
+                        close5, s5.bb_high, s5.bb_low, s5.bb_mid
+                    ),
+                )
+                await safe(
+                    "SupertrendSwingReversal",
+                    coinrule.supertrend_swing_reversal(
+                        close5, s5.bb_high, s5.bb_low, s5.bb_mid
+                    ),
+                )
+            finally:
+                self.df_5m = saved_df5
+
+        # --- 15m dormant set (same gate as the live 15m block,
+        # context_evaluator.py:424-429)
+        df15 = getattr(self, "df_15m", None)
+        if (
+            df15 is None
+            or df15.empty
+            or "ma_100" not in df15
+            or df15["ma_7"].size < 7
+            or df15["ma_25"].size < 25
+            or df15["ma_100"].size < 100
+        ):
+            return
+        close15 = float(df15["close"].iloc[-1])
+        s15 = self.bb_spreads(df15)
+        coinrule15 = Coinrule(cls=self)
+        rsi15 = float(df15["rsi"].iloc[-1])
+        ma25_15 = float(df15["ma_25"].iloc[-1])
+        await safe(
+            "BuyLowSellHigh",
+            coinrule15.buy_low_sell_high(
+                close15, rsi15, ma25_15, s15.bb_high, s15.bb_mid, s15.bb_low
+            ),
+        )
+        await safe(
+            "BuyTheDip",
+            BuyTheDip(cls=self).signal(
+                close15, s15.bb_high, s15.bb_mid, s15.bb_low
+            ),
+        )
+        if enable_bbx:
+            bbx = BBExtremeReversion(cls=self)
+            bbx.ENABLED = True
+            await safe(
+                "BBExtremeReversion",
+                bbx.signal(close15, s15.bb_high, s15.bb_mid, s15.bb_low),
+            )
+        await safe(
+            "RangeBbRsiMeanReversion",
+            RangeBbRsiMeanReversion(cls=self).signal(
+                close15, s15.bb_high, s15.bb_mid, s15.bb_low
+            ),
+        )
+        await safe(
+            "RangeFailedBreakoutFade",
+            RangeFailedBreakoutFade(cls=self).signal(
+                close15, s15.bb_high, s15.bb_mid, s15.bb_low
+            ),
+        )
+        await safe(
+            "RelativeStrengthReversalRange",
+            RelativeStrengthReversalRange(cls=self).signal(
+                close15, s15.bb_high, s15.bb_mid, s15.bb_low
+            ),
+        )
+    return process_data_with_dormant
+
+
 def run_replay_reference(
     path: str | Path,
     window: int = 400,
@@ -154,6 +309,9 @@ def run_replay_reference(
     collect_regimes: list | None = None,
     collect_leverage: list | None = None,
     symbols: set[str] | None = None,
+    dispatch_dormant: bool = False,
+    dominance_is_losers: bool = False,
+    market_domination_reversal: bool = False,
 ) -> list[tuple]:
     """Replay ``path`` through the reference chain; return the fired
     ``(tick_ms, strategy, symbol, direction, autotrade)`` tuples.
@@ -232,6 +390,29 @@ def run_replay_reference(
                 stack.enter_context(
                     patch.object(
                         mod, "is_autotrade_suppressed", replay_clock_suppressed
+                    )
+                )
+            # BuyTheDip consults the quiet-hours filter too; pin its clock
+            # the same way when the dormant set is dispatched
+            if dispatch_dormant:
+                btd_mod = importlib.import_module("strategies.coinrule.buy_the_dip")
+                stack.enter_context(
+                    patch.object(
+                        btd_mod, "is_autotrade_suppressed", replay_clock_suppressed
+                    )
+                )
+                import producers.context_evaluator as ce_mod
+
+                stack.enter_context(
+                    patch.object(
+                        ce_mod.ContextEvaluator,
+                        "process_data",
+                        _dormant_dispatch_wrapper(
+                            ce_mod.ContextEvaluator,
+                            dominance_is_losers=dominance_is_losers,
+                            market_domination_reversal=market_domination_reversal,
+                            enable_bbx=True,
+                        ),
                     )
                 )
 

@@ -211,7 +211,9 @@ class Indicators:
         return df
 
     @staticmethod
-    def set_twap(df: pd.DataFrame, window: int = 80) -> pd.DataFrame:
+    def set_twap(df: pd.DataFrame, window: int = 20) -> pd.DataFrame:
+        # rolling mean of OHLC bar averages; the TWAP sniper consumes it
+        # on 1h bars with a 20-hour horizon (oracle `_twap`)
         bar_avg = (df["open"] + df["high"] + df["low"] + df["close"]) / 4.0
         df["twap"] = bar_avg.rolling(window, min_periods=1).mean()
         return df
@@ -259,7 +261,14 @@ class Indicators:
             st_dir[i] = d
             st_line[i] = fl if d > 0 else fu
             prev = closes[i]
-        df["supertrend"] = st_line
+        # "supertrend" is the boolean uptrend flag — its only consumer
+        # truth-tests it (`coinrule.py:160 bool(df["supertrend"].iloc[-1])`);
+        # a band-line float there would be always-truthy. Until the ATR
+        # warm-up completes there is no confirmed trend: flag False (the
+        # engine/oracle pin the same semantic — supertrend_from emits NaN
+        # direction before atr_ready).
+        df["supertrend"] = (st_dir > 0) & atr.notna().to_numpy()
+        df["supertrend_line"] = st_line
         df["supertrend_direction"] = st_dir
         return df
 

@@ -30,7 +30,7 @@ from binquant_tpu.enums import (
     MicroRegimeCode,
     MicroTransitionCode,
 )
-from binquant_tpu.ops.indicators import supertrend
+from binquant_tpu.ops.indicators import supertrend_from
 from binquant_tpu.ops.rolling import (
     rolling_mean,
     rolling_mean_last,
@@ -160,10 +160,17 @@ def supertrend_swing_reversal(
     """Supertrend(10,3) uptrend ∧ RSI<30 ∧ trades>5 ∧ rising ADP twice ∧
     LOSERS dominance. Long; autotrade via the standard long gate."""
     S = buf5.capacity
-    st = supertrend(
+    W = buf5.times.shape[1]
+    # The reference runs supertrend on the dropna'd enriched frame
+    # (coinrule.py:140-143): the series starts after the ma_100 warm-up —
+    # 99 rows past the first available bar. The ratchet is path-dependent,
+    # so the seed point must match, not just the tail.
+    start = (W - pack5.filled + 99).astype(jnp.int32)
+    st = supertrend_from(
         buf5.values[:, :, Field.HIGH],
         buf5.values[:, :, Field.LOW],
         buf5.values[:, :, Field.CLOSE],
+        start,
         window=10,
         multiplier=3.0,
     )
@@ -245,6 +252,10 @@ class BTDParams(NamedTuple):
     lookback_bars_6h: int = 24  # 6h of 15m bars
     dip_min_pct: float = -5.0  # exclusive lower bound
     dip_max_pct: float = -2.0  # exclusive upper bound
+    # go-live gate: no fires on bars closing before the strategy's launch
+    # (buy_the_dip.py:34 START_TIME = 2026-04-12 23:21 UTC), so a restart
+    # backfill can never retro-fire the dip rule
+    live_since_s: int = 1_776_036_060
 
 
 def buy_the_dip(
@@ -288,10 +299,15 @@ def buy_the_dip(
     )
     entry_allowed = ~market_trend_blocked & ~symbol_trend_blocked
 
+    # evaluated bar's close time (seconds; the reference compares the
+    # close_time stamp — buy_the_dip.py:147-149) vs the go-live date
+    live = (buf15.times[:, -1] + 900) >= p.live_since_s
+
     fired = (
         (pack15.filled >= p.lookback_candles)
         & has_ref
         & dip
+        & live
         & entry_allowed
         & reclaimed
         & pack15.valid

@@ -177,3 +177,94 @@ def test_reference_own_suite_passes_against_sdk_replica():
     tail = "\n".join(proc.stdout.splitlines()[-5:])
     assert proc.returncode == 0, tail
     assert " passed" in tail and "failed" not in tail, tail
+
+
+DORMANT_BREADTH = {
+    "timestamp": [1, 2, 3, 4],
+    "market_breadth": [0.30, 0.34, 0.38, 0.42],
+    "market_breadth_ma": [0.30, 0.36],
+}
+
+
+def test_reference_dormant_core_set_matches(tmp_path):
+    """The dormant strategies are not dispatched by the reference's
+    current evaluator, but their classes remain fully wired to it; the
+    harness reconstructs the retired dispatch (refdiff/driver.py
+    _dormant_dispatch_wrapper) and their signal bodies execute verbatim.
+    Core set (BuyTheDip / BBExtremeReversion / RangeBbRsiMeanReversion —
+    the inline-indicator transcription risks of VERDICT r2 item 6) must
+    match both backends."""
+    from binquant_tpu.io.replay import generate_dormant_replay
+    from binquant_tpu.oracle.evaluator import DORMANT_ORACLE_STRATEGIES
+
+    path = tmp_path / "dormant.jsonl"
+    generate_dormant_replay(path)
+    dorm = set(DORMANT_ORACLE_STRATEGIES)
+    ref = {
+        t
+        for t in run_replay_reference(path, window=WINDOW, dispatch_dormant=True)
+        if t[1] in dorm
+    }
+    orc = {
+        t
+        for t in run_replay_oracle(path, window=WINDOW, enabled_strategies=dorm)
+        if t[1] in dorm
+    }
+    tpu_list: list = []
+    run_replay(
+        path, capacity=CAPACITY, window=WINDOW, collect=tpu_list,
+        enabled_strategies=dorm,
+    )
+    tpu = {t for t in tpu_list if t[1] in dorm}
+    assert ref == orc == tpu, {
+        "only_ref": sorted(ref - orc)[:5],
+        "only_orc": sorted(orc - ref)[:5],
+        "only_tpu": sorted(tpu - ref)[:5],
+    }
+    assert {s for _, s, *_ in ref} == dorm  # all three engaged
+
+
+def test_reference_dormant_extended_set_matches(tmp_path):
+    """Extended dormant set (TWAP sniper, supertrend swing reversal,
+    buy-low-sell-high, inverse price tracker, RS reversal range, range
+    failed-breakout fade) — every one of the 14 strategy kernels now
+    diffs against the reference's own executed code. Exercises the
+    dropna-seeded supertrend (ops supertrend_from) and the dominance
+    scripting."""
+    from binquant_tpu.io.replay import generate_dormant_extended_replay
+    from binquant_tpu.oracle.evaluator import DORMANT_ORACLE_EXTENDED
+
+    path = tmp_path / "dormant_ext.jsonl"
+    generate_dormant_extended_replay(path)
+    dorm = set(DORMANT_ORACLE_EXTENDED)
+    kwargs = dict(
+        breadth=DORMANT_BREADTH,
+        dominance_is_losers=True,
+        market_domination_reversal=True,
+    )
+    ref = {
+        t
+        for t in run_replay_reference(
+            path, window=WINDOW, dispatch_dormant=True, **kwargs
+        )
+        if t[1] in dorm
+    }
+    orc = {
+        t
+        for t in run_replay_oracle(
+            path, window=WINDOW, enabled_strategies=dorm, **kwargs
+        )
+        if t[1] in dorm
+    }
+    tpu_list: list = []
+    run_replay(
+        path, capacity=CAPACITY, window=WINDOW, collect=tpu_list,
+        enabled_strategies=dorm, **kwargs,
+    )
+    tpu = {t for t in tpu_list if t[1] in dorm}
+    assert ref == orc == tpu, {
+        "only_ref": sorted(ref - orc)[:5],
+        "only_orc": sorted(orc - ref)[:5],
+        "only_tpu": sorted(tpu - ref)[:5],
+    }
+    assert {s for _, s, *_ in ref} == dorm

@@ -238,7 +238,11 @@ class TestCoinruleRules:
 
 class TestBuyTheDip:
     def craft_dip(self, rng):
-        df = pd.DataFrame(make_ohlcv(rng, n=WINDOW, vol=0.002, drift=0.0))
+        # timestamps past the strategy's go-live gate
+        # (buy_the_dip.py:34 START_TIME 2026-04-12)
+        df = pd.DataFrame(
+            make_ohlcv(rng, n=WINDOW, vol=0.002, drift=0.0, t0=1_776_040_000_000)
+        )
         # 6h (24 bars) ago reference, dip ~3%, then reclaim
         ref = float(df["close"].iloc[-25])
         target = ref * 0.97

@@ -35,7 +35,8 @@ from tests.test_strategies_live import S_CAP, WINDOW, fill_buffer
 
 
 def flat_df(n=WINDOW, price=100.0, vol_noise=0.0):
-    t0 = 1_700_000_000_000
+    # past BuyTheDip's go-live gate (buy_the_dip.py:34 START_TIME 2026-04-12)
+    t0 = 1_776_040_000_000
     close = np.full(n, price)
     if vol_noise:
         close = price * (1 + vol_noise * np.sin(np.arange(n) * 0.9))

@@ -285,44 +285,17 @@ def supertrend(
     window: int = 10,
     multiplier: float = 3.0,
 ) -> SupertrendResult:
-    """Supertrend bands. Genuinely sequential (band ratchet + flip state), so
-    this is the one indicator implemented with lax.scan over the window axis.
-    """
-    import jax
-
-    a = atr_wilder(high, low, close, window)
-    hl2 = (high + low) / 2.0
-    upper = hl2 + multiplier * a
-    lower = hl2 - multiplier * a
+    """Supertrend over the full series: :func:`supertrend_from` started at
+    each lane's first finite bar (ring buffers left-pad unfilled lanes
+    with NaN). One copy of the path-dependent ratchet recursion lives in
+    ``supertrend_from``; parity vs pandas is pinned in
+    tests/test_ops_parity.py."""
     W = close.shape[-1]
-    batch_shape = close.shape[:-1]
-
-    flat = lambda z: jnp.reshape(z, (-1, W)).T  # (W, B)
-    u, lo, c = flat(upper), flat(lower), flat(close)
-
-    def step(carry, inputs):
-        prev_upper, prev_lower, prev_dir, prev_close = carry
-        ub, lb, cl = inputs
-        ub = jnp.where(jnp.isfinite(ub), ub, jnp.inf)
-        lb = jnp.where(jnp.isfinite(lb), lb, -jnp.inf)
-        # band ratchet: final bands only move in the trend's favour
-        fu = jnp.where((ub < prev_upper) | (prev_close > prev_upper), ub, prev_upper)
-        fl = jnp.where((lb > prev_lower) | (prev_close < prev_lower), lb, prev_lower)
-        d = jnp.where(cl > fu, 1.0, jnp.where(cl < fl, -1.0, prev_dir))
-        return (fu, fl, d, cl), (jnp.where(d > 0, fl, fu), d)
-
-    B = u.shape[1]
-    init = (
-        jnp.full((B,), jnp.inf),
-        jnp.full((B,), -jnp.inf),
-        jnp.ones((B,)),
-        jnp.zeros((B,)),
+    finite = jnp.isfinite(high) & jnp.isfinite(low) & jnp.isfinite(close)
+    start = jnp.min(
+        jnp.where(finite, jnp.arange(W, dtype=jnp.int32), W), axis=-1
     )
-    _, (st, dirn) = jax.lax.scan(step, init, (u, lo, c))
-    unflat = lambda z: jnp.reshape(z.T, batch_shape + (W,))
-    st, dirn = unflat(st), unflat(dirn)
-    valid = jnp.isfinite(a)
-    return SupertrendResult(jnp.where(valid, st, jnp.nan), jnp.where(valid, dirn, jnp.nan))
+    return supertrend_from(high, low, close, start, window, multiplier)
 
 
 def adx(

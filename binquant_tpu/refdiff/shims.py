@@ -21,6 +21,23 @@ What the shim provides and where it comes from:
   formulas are the transcription's (shared with ``binquant_tpu/oracle``)
   — NOT independently verified by the differential. Everything under
   ``/root/reference`` itself executes verbatim.
+
+UNVERIFIED-SEMANTICS LEDGER — shim decisions with NO external oracle
+(pybinbot 1.11.5 is an absent PyPI dep and the reference's own tests
+don't cover them; if real pybinbot differs, production behavior differs
+from the replica AND this differential cannot see it):
+
+* ``Indicators.set_twap`` horizon (window=20): chosen to match the
+  transcription's 20-hour TWAP (oracle ``_twap``); the real default is
+  unknown.
+* ``Indicators.set_supertrend``'s ``df["supertrend"]`` column: pinned as
+  the BOOLEAN confirmed-uptrend flag (False during ATR warm-up) because
+  its only consumer truth-tests it (``coinrule.py:160``); if the real
+  SDK stores the band line there, the production gate is always-truthy.
+* ``Candles.post_process`` keeps enrichment warm-up NaNs (pins the
+  MA-``.size`` sufficiency gates at 100 raw bars); a dropna variant
+  would shift dispatch eligibility by 99 bars. The dormant dispatch
+  wrapper documents where each interpretation is applied.
 * network clients (``BinbotApi``, ``KucoinApi``, ``KucoinFutures``,
   ``BinanceApi``) — recording fakes wired to the active
   :class:`binquant_tpu.refdiff.driver.ReferenceHub`.

@@ -257,7 +257,11 @@ def supertrend_from(
             keep(cb, prev_close),
         )
         line = jnp.where(d_new > 0, fl_new, fu_new)
-        valid = active & atr_ready
+        # a mid-series NaN bar poisons the ATR recursion permanently (the
+        # pandas mirror dropna()s such rows away entirely); masking on ATR
+        # finiteness keeps the output NaN from the gap onward instead of
+        # serving frozen pre-gap bands as live values
+        valid = active & atr_ready & jnp.isfinite(atr_new)
         return carry, (
             jnp.where(valid, line, jnp.nan),
             jnp.where(valid, d_new, jnp.nan),
@@ -288,8 +292,9 @@ def supertrend(
     """Supertrend over the full series: :func:`supertrend_from` started at
     each lane's first finite bar (ring buffers left-pad unfilled lanes
     with NaN). One copy of the path-dependent ratchet recursion lives in
-    ``supertrend_from``; parity vs pandas is pinned in
-    tests/test_ops_parity.py."""
+    ``supertrend_from``; numeric parity vs the sequential pandas mirror is
+    pinned in tests/test_ops_parity.py (test_supertrend_matches_pandas),
+    trend-flip behavior in test_supertrend_flips_with_trend."""
     W = close.shape[-1]
     finite = jnp.isfinite(high) & jnp.isfinite(low) & jnp.isfinite(close)
     start = jnp.min(

@@ -291,3 +291,72 @@ class TestLastValueKernels:
             float(roll.rolling_mean_last(x, 14, 1)), 2.0, rtol=1e-6
         )
         assert np.isnan(float(roll.rolling_mean_last(x, 14, None)))
+
+
+def test_supertrend_matches_pandas():
+    """Full-series numeric parity of the scan-based supertrend against an
+    independent sequential pandas/python mirror (the same recursion the
+    refdiff shim ships): Wilder-ATR ewm seeding, min_periods gating, band
+    ratchet, flip state, and the start-offset variant."""
+    import numpy as np
+    import pandas as pd
+
+    from binquant_tpu.ops.indicators import supertrend, supertrend_from
+
+    rng = np.random.default_rng(421)
+    W = 160
+    close = 100 * np.exp(np.cumsum(rng.normal(0, 0.01, W)))
+    spread = np.abs(rng.normal(0, 0.004, W)) * close
+    high, low = close + spread, close - spread
+
+    def pandas_mirror(h, lo, c, period=10, mult=3.0):
+        h, lo, c = pd.Series(h), pd.Series(lo), pd.Series(c)
+        pc = c.shift(1)
+        tr = pd.concat([h - lo, (h - pc).abs(), (lo - pc).abs()], axis=1).max(axis=1)
+        tr = tr.where(pc.notna(), h - lo)
+        atr = tr.ewm(alpha=1.0 / period, adjust=False, min_periods=period).mean()
+        hl2 = (h + lo) / 2.0
+        upper = (hl2 + mult * atr).to_numpy()
+        lower = (hl2 - mult * atr).to_numpy()
+        cs = c.to_numpy()
+        n = len(cs)
+        dirn = np.full(n, np.nan)
+        line = np.full(n, np.nan)
+        fu, fl, d, prev = np.inf, -np.inf, 1.0, 0.0
+        for i in range(n):
+            ub = upper[i] if np.isfinite(upper[i]) else np.inf
+            lb = lower[i] if np.isfinite(lower[i]) else -np.inf
+            fu = ub if (ub < fu or prev > fu) else fu
+            fl = lb if (lb > fl or prev < fl) else fl
+            d = 1.0 if cs[i] > fu else (-1.0 if cs[i] < fl else d)
+            if np.isfinite(atr.iloc[i]):
+                dirn[i] = d
+                line[i] = fl if d > 0 else fu
+            prev = cs[i]
+        return line, dirn
+
+    exp_line, exp_dir = pandas_mirror(high, low, close)
+    got = supertrend(high[None, :], low[None, :], close[None, :])
+    np.testing.assert_allclose(
+        np.asarray(got.supertrend)[0], exp_line, rtol=1e-5, equal_nan=True
+    )
+    np.testing.assert_allclose(np.asarray(got.direction)[0], exp_dir, equal_nan=True)
+
+    # start-offset variant == plain variant on the sliced series
+    start = 37
+    exp_line_s, exp_dir_s = pandas_mirror(high[start:], low[start:], close[start:])
+    got_s = supertrend_from(
+        high[None, :], low[None, :], close[None, :], np.array([start])
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_s.supertrend)[0, start:], exp_line_s, rtol=1e-5, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_s.direction)[0, start:], exp_dir_s, equal_nan=True
+    )
+    # a mid-series NaN bar poisons the recursion: NaN from the gap onward,
+    # never frozen stale values
+    high2, low2, close2 = high.copy(), low.copy(), close.copy()
+    high2[80] = np.nan
+    got_gap = supertrend(high2[None, :], low2[None, :], close2[None, :])
+    assert np.isnan(np.asarray(got_gap.direction)[0, 80:]).all()

@@ -276,3 +276,118 @@ def test_reference_dormant_extended_set_matches(tmp_path):
         "only_tpu": sorted(tpu - ref)[:5],
     }
     assert {s for _, s, *_ in ref} == dorm
+
+
+def test_reference_leverage_calibrator_matches():
+    """SURVEY row 22 (leverage calibrator): the REFERENCE's own
+    LeverageCalibrator executes verbatim over contexts built by its own
+    accumulator, and its edit decisions must equal this repo's calibrator
+    on the same inputs (vectorized ladder + FrozenRows snapshot)."""
+    import numpy as np
+
+    from binquant_tpu.engine.buffer import FrozenRows
+    from binquant_tpu.io.leverage import CalibrationInputs
+    from binquant_tpu.io.leverage import LeverageCalibrator as MyCalibrator
+    from binquant_tpu.schemas import SymbolModel as MySymbolModel
+    from binquant_tpu.refdiff.shims import install_shims
+    from binquant_tpu.enums import MarketRegimeCode
+
+    install_shims()
+    import pandas as pd
+    import pybinbot
+    from calibrators.leverage_calibrator import LeverageCalibrator as RefCalibrator
+    from market_regime.live_market_context_accumulator import (
+        LiveMarketContextAccumulator,
+    )
+    from market_regime.market_state_store import MarketStateStore
+
+    rng = np.random.default_rng(77)
+    n_sym, n_bars = 60, 60
+    names = ["BTCUSDT"] + [f"S{i:03d}USDT" for i in range(1, n_sym)]
+
+    def build_context(drift: float, vol: float):
+        store = MarketStateStore(max_bars_per_symbol=200)
+        acc = LiveMarketContextAccumulator(state_store=store, btc_symbol="BTCUSDT")
+        t0 = 1_780_272_000_000
+        for s, name in enumerate(names):
+            # price levels straddle the 500 price-high threshold; per-symbol
+            # vol straddles the 4% atr_pct threshold
+            base = [40.0, 120.0, 480.0, 510.0, 800.0][s % 5]
+            v = vol * (0.3 + 2.2 * (s % 7) / 6)
+            closes = base * np.exp(np.cumsum(rng.normal(drift, v, n_bars)))
+            df = pd.DataFrame(
+                {
+                    "timestamp": t0 + 900_000 * np.arange(n_bars),
+                    "open": np.r_[base, closes[:-1]],
+                    "high": closes * (1 + v),
+                    "low": closes * (1 - v),
+                    "close": closes,
+                    "volume": 1000.0,
+                }
+            )
+            store.update(symbol=name, candle=df)
+        ctx = acc.refresh_context_for_timestamp(int(t0 + 900_000 * (n_bars - 1)))
+        assert ctx is not None
+        return ctx
+
+    class _Recorder:
+        def __init__(self):
+            self.edits = {}
+
+        def edit_symbol(self, symbol=None, **kw):
+            self.edits[symbol] = kw["futures_leverage"]
+
+    scenarios = [
+        ("calm_range", build_context(drift=0.0, vol=0.004)),
+        ("stressed", build_context(drift=-0.02, vol=0.02)),
+        ("trending", build_context(drift=0.01, vol=0.006)),
+    ]
+    # exercise the confidence floor too
+    low_conf = scenarios[0][1].model_copy(update={"confidence": 0.3})
+    scenarios.append(("low_confidence", low_conf))
+
+    regime_code = {r.name: int(r) for r in MarketRegimeCode}
+    total_edits = 0
+    for label, ctx in scenarios:
+        ref_rec = _Recorder()
+        ref_cal = RefCalibrator(binbot_api=ref_rec, exchange=pybinbot.ExchangeId.KUCOIN)
+        ref_symbols = [pybinbot.SymbolModel(id=n, futures_leverage=1) for n in names]
+        ref_cal.calibrate_all(ctx, ref_symbols)
+
+        # my calibrator on the SAME inputs: rows in name order
+        feats = ctx.symbol_features
+        valid = np.array([n in feats for n in names])
+        closes = np.array([feats[n].close if n in feats else np.nan for n in names])
+        atrs = np.array([feats[n].atr_pct if n in feats else np.nan for n in names])
+        my_rec = _Recorder()
+
+        class _Api:
+            def edit_symbol(self, symbol, **kw):
+                my_rec.edits[symbol] = kw["futures_leverage"]
+
+        my_cal = MyCalibrator(binbot_api=_Api(), exchange="kucoin")
+        my_symbols = [MySymbolModel(id=n, futures_leverage=1) for n in names]
+        my_cal.calibrate_all(
+            CalibrationInputs(
+                valid=valid,
+                close=closes,
+                atr_pct=atrs,
+                regime=regime_code[ctx.market_regime],
+                stress=float(ctx.market_stress_score),
+                confidence=float(ctx.confidence),
+            ),
+            FrozenRows({i: n for i, n in enumerate(names)}),
+            my_symbols,
+        )
+        total_edits += len(ref_rec.edits)
+        assert ref_rec.edits == my_rec.edits, (
+            label,
+            {k: (ref_rec.edits.get(k), my_rec.edits.get(k))
+             for k in set(ref_rec.edits) ^ set(my_rec.edits)
+             | {k for k in set(ref_rec.edits) & set(my_rec.edits)
+                if ref_rec.edits[k] != my_rec.edits[k]}},
+        )
+    # non-vacuous: the scenarios must actually have produced edits
+    # (ref_rec is rebuilt per scenario; the loop asserted equality each
+    # time, so checking the final one plus total coverage suffices)
+    assert total_edits > 0

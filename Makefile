@@ -1,4 +1,20 @@
-.PHONY: test bench smoke replay ab config4 dryrun lint
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke
+
+help:
+	@echo "binquant_tpu targets:"
+	@echo "  test       - full pytest suite (forced-CPU in CI)"
+	@echo "  bench      - headline production-engine tick p99 @ 2048x400"
+	@echo "  smoke      - fast bench smoke"
+	@echo "  replay     - synthesize a market + offline replay (stubbed sinks)"
+	@echo "  ab         - replay A/B parity diff (TPU batch vs pandas oracle)"
+	@echo "  config4    - context scoring x 4 timeframes bench"
+	@echo "  obs-smoke  - one replay run with the /metrics exporter up;"
+	@echo "               asserts the core metric families are present and"
+	@echo "               non-zero (tier-1 test, tests/test_obs.py)"
+	@echo "  dryrun     - 8-device virtual-mesh multichip dry run"
+	@echo "  lint       - ruff check"
+	@echo "offline kernel profiling: tools/profile_stages.py captures"
+	@echo "per-stage jax.profiler traces (see README.md section Observability)"
 
 test:
 	python -m pytest tests/ -q
@@ -8,6 +24,9 @@ bench:
 
 smoke:
 	python bench.py --smoke
+
+obs-smoke:
+	python -m pytest tests/test_obs.py -q -m "not slow" -k "obs_smoke or healthz"
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

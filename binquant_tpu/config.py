@@ -172,6 +172,22 @@ class Config:
         return self._get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")
 
     @cached_property
+    def heartbeat_max_age_s(self) -> float:
+        """Staleness bound for the heartbeat (healthcheck.py + /healthz)."""
+        return float(self._get("BQT_HEARTBEAT_MAX_AGE", "1500"))
+
+    @cached_property
+    def metrics_port(self) -> int:
+        """Port for the /metrics + /healthz exporter; 0 disables it."""
+        return int(self._get("BQT_METRICS_PORT", "0") or 0)
+
+    @cached_property
+    def event_log(self) -> str:
+        """Structured JSONL event sink: "" disables, "stderr"/"-" writes
+        to stderr, anything else is a rotating file path."""
+        return self._get("BQT_EVENT_LOG", "")
+
+    @cached_property
     def checkpoint_path(self) -> str:
         """Engine-state snapshot location; empty disables checkpointing."""
         return self._get("BQT_CHECKPOINT_PATH", "/tmp/binquant_tpu.ckpt.npz")

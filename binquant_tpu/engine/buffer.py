@@ -41,6 +41,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from binquant_tpu.exceptions import BufferCapacityError
+from binquant_tpu.obs.instruments import (
+    INGEST_DEDUP_OVERWRITES,
+    REGISTRY_CAPACITY_ERRORS,
+    REGISTRY_SYMBOLS,
+)
 
 
 class Field(IntEnum):
@@ -243,6 +248,7 @@ class SymbolRegistry:
         if row is not None:
             return row
         if not self._free:
+            REGISTRY_CAPACITY_ERRORS.inc()
             raise BufferCapacityError(
                 f"SymbolRegistry full ({self.capacity}); grow the buffer capacity"
             )
@@ -250,6 +256,7 @@ class SymbolRegistry:
         self._name_to_row[key] = row
         self._row_to_name[row] = key
         self.version += 1
+        REGISTRY_SYMBOLS.set(len(self._name_to_row))
         return row
 
     def remove(self, symbol: str) -> int | None:
@@ -259,6 +266,7 @@ class SymbolRegistry:
             del self._row_to_name[row]
             self._free.append(row)
             self.version += 1
+            REGISTRY_SYMBOLS.set(len(self._name_to_row))
         return row
 
     def rows_for(self, symbols: list[str], add_missing: bool = True) -> np.ndarray:
@@ -297,6 +305,7 @@ class SymbolRegistry:
             used.add(row)
         self._free = [r for r in range(self.capacity - 1, -1, -1) if r not in used]
         self.version += 1
+        REGISTRY_SYMBOLS.set(len(self._name_to_row))
 
     @property
     def active_rows(self) -> np.ndarray:
@@ -388,7 +397,11 @@ class IngestBatcher:
             ],
             dtype=np.float32,
         )
-        self._pending[(symbol, ms_to_s(open_time_ms))] = row
+        key = (symbol, ms_to_s(open_time_ms))
+        if key in self._pending:
+            # keep-last dedupe evicting a stale payload for the same bar
+            INGEST_DEDUP_OVERWRITES.inc()
+        self._pending[key] = row
 
     def drain(self) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """List of (row_idx (U,), ts_s (U,), vals (U, F)) sub-batches, each

@@ -843,6 +843,57 @@ def pad_updates(
     return out_rows, out_ts, out_vals
 
 
+# -- dispatch observability --------------------------------------------------
+#
+# Host-side shape-signature cache approximating jax's compile cache for the
+# tick step: a dispatch whose (buffer shapes, padded update shapes, wire
+# key, static config) tuple is new will trace+compile. The pipeline calls
+# observe_dispatch before every tick_step_wire launch, so the
+# bqt_jit_recompiles_total counter and the jit_compile event record exactly
+# the ticks that pay a compile — live, an unexpected increment means the
+# pad_updates bucketing or the wire key regressed.
+
+_DISPATCH_SIGNATURES: set[tuple] = set()
+
+
+def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
+                     fn: str = "tick_step_wire") -> bool:
+    """Record per-dispatch telemetry; True when this signature is new
+    (i.e. the launch below it will trace+compile)."""
+    import numpy as np
+
+    from binquant_tpu.obs.events import get_event_log
+    from binquant_tpu.obs.instruments import JIT_RECOMPILES, SYMBOLS_PER_TICK
+
+    SYMBOLS_PER_TICK.labels(interval="5m").set(
+        int(np.count_nonzero(np.asarray(upd5[0]) >= 0))
+    )
+    SYMBOLS_PER_TICK.labels(interval="15m").set(
+        int(np.count_nonzero(np.asarray(upd15[0]) >= 0))
+    )
+    signature = (
+        fn,
+        tuple(state.buf5.times.shape),
+        tuple(state.buf15.times.shape),
+        tuple(np.asarray(upd5[0]).shape),
+        tuple(np.asarray(upd15[0]).shape),
+        tuple(wire_enabled),
+        cfg,
+    )
+    if signature in _DISPATCH_SIGNATURES:
+        return False
+    _DISPATCH_SIGNATURES.add(signature)
+    JIT_RECOMPILES.labels(fn=fn).inc()
+    get_event_log().emit(
+        "jit_compile",
+        fn=fn,
+        update5_rows=signature[3][0],
+        update15_rows=signature[4][0],
+        wire_enabled=list(wire_enabled),
+    )
+    return True
+
+
 def _btc_momentum(btc_close: jnp.ndarray) -> jnp.ndarray:
     """BTC close pct_change at the last bar (liquidation_sweep_pump.py:166)."""
     prev = btc_close[-2]

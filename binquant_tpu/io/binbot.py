@@ -14,6 +14,7 @@ import logging
 from typing import Any
 
 from binquant_tpu.exceptions import BinbotError
+from binquant_tpu.obs.instruments import BINBOT_REQUESTS
 from binquant_tpu.schemas import (
     AutotradeSettingsSchema,
     MarketBreadthSeries,
@@ -39,12 +40,19 @@ class BinbotApi:
 
     def _request(self, method: str, path: str, **kwargs) -> Any:
         url = f"{self.base_url}{path}"
-        resp = self.session.request(method, url, **kwargs)
+        try:
+            resp = self.session.request(method, url, **kwargs)
+        except Exception:
+            BINBOT_REQUESTS.labels(method=method, outcome="transport_error").inc()
+            raise
         if resp.status_code >= 400:
+            BINBOT_REQUESTS.labels(method=method, outcome="http_error").inc()
             raise BinbotError(f"{method} {path} -> {resp.status_code}: {resp.text}")
         payload = resp.json()
         if isinstance(payload, dict) and payload.get("error") == 1:
+            BINBOT_REQUESTS.labels(method=method, outcome="backend_error").inc()
             raise BinbotError(str(payload.get("message", "unknown binbot error")))
+        BINBOT_REQUESTS.labels(method=method, outcome="ok").inc()
         return payload
 
     def _get(self, path: str, **kwargs) -> Any:

@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import tempfile
+import time
 from pathlib import Path
 
 import numpy as np
@@ -111,8 +112,12 @@ class CheckpointManager:
         )
 
     def maybe_save(self, engine) -> bool:
+        from binquant_tpu.obs.events import get_event_log
+        from binquant_tpu.obs.instruments import CHECKPOINT_SAVES
+
         if not self.should_save(engine):
             return False
+        t0 = time.perf_counter()
         try:
             save_state(
                 self.path,
@@ -120,8 +125,16 @@ class CheckpointManager:
                 engine.registry,
                 host_carries=engine.host_carries(),
             )
+            CHECKPOINT_SAVES.labels(outcome="ok").inc()
+            get_event_log().emit(
+                "checkpoint_save",
+                path=str(self.path),
+                ticks=engine.ticks_processed,
+                duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+            )
             return True
         except Exception:
+            CHECKPOINT_SAVES.labels(outcome="error").inc()
             logging.exception("checkpoint save failed; continuing")
             return False
 
@@ -139,6 +152,14 @@ class CheckpointManager:
             state = shard_engine_state(state, engine.mesh)
         engine.state = state
         engine.restore_host_carries(carries)
+        from binquant_tpu.obs.events import get_event_log
+
+        get_event_log().emit(
+            "checkpoint_restore",
+            path=str(self.path),
+            symbols=len(engine.registry),
+            ticks=carries.get("ticks_processed"),
+        )
         logging.info(
             "restored checkpoint: %d symbols, tick %s",
             len(engine.registry),

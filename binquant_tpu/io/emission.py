@@ -12,7 +12,12 @@ record body (``producers/context_evaluator.py:268-333``).
 from __future__ import annotations
 
 import logging
-from datetime import UTC, datetime
+try:  # py3.11+
+    from datetime import UTC, datetime
+except ImportError:  # py3.10: datetime.UTC not there yet
+    from datetime import datetime, timezone
+
+    UTC = timezone.utc
 from typing import Any
 
 import numpy as np
@@ -447,9 +452,13 @@ def _analytics_record(
 def dispatch_signal_record(binbot_api, record: dict[str, Any]) -> None:
     """Fire-and-forget analytics POST — failures never break the trade path
     (context_evaluator.py:329-333)."""
+    from binquant_tpu.obs.instruments import SINK_EMISSIONS
+
     try:
         binbot_api.dispatch_create_signal(record)
+        SINK_EMISSIONS.labels(sink="analytics", outcome="ok").inc()
     except Exception:
+        SINK_EMISSIONS.labels(sink="analytics", outcome="error").inc()
         logging.exception(
             "dispatch_signal_record failed for %s; trade path continues.",
             record.get("symbol"),

@@ -5,6 +5,14 @@ end-to-end, BASELINE.json north star) demands the cost be measured in
 production, not guessed. ``LatencyTracker`` keeps rolling reservoirs per
 stage and logs p50/p99 periodically; ``tools/profile_stages.py`` is the
 offline jax.profiler companion for kernel-level traces.
+
+Since the observability subsystem landed, every ``record`` is also
+absorbed into the process-global ``bqt_stage_latency_ms`` histogram family
+(``binquant_tpu.obs.instruments.STAGE_LATENCY``) so Prometheus scrapes see
+the same stages the periodic log line reports — the tracker keeps the
+exact rolling percentiles, the histogram keeps the scrapeable cumulative
+view. Pass ``mirror=False`` to opt a tracker out (micro-benchmarks that
+spin millions of synthetic samples).
 """
 
 from __future__ import annotations
@@ -14,13 +22,23 @@ import time
 from collections import deque
 from contextlib import contextmanager
 
+import numpy as np
+
+from binquant_tpu.obs.instruments import STAGE_LATENCY
+
 
 class LatencyTracker:
     """Rolling per-stage latency histograms with periodic logging."""
 
-    def __init__(self, window: int = 1024, log_every_s: float = 300.0) -> None:
+    def __init__(
+        self,
+        window: int = 1024,
+        log_every_s: float = 300.0,
+        mirror: bool = True,
+    ) -> None:
         self.window = window
         self.log_every_s = log_every_s
+        self.mirror = mirror
         self._samples: dict[str, deque[float]] = {}
         self._last_log = time.monotonic()
 
@@ -29,6 +47,8 @@ class LatencyTracker:
         if buf is None:
             buf = self._samples[stage] = deque(maxlen=self.window)
         buf.append(float(ms))
+        if self.mirror:
+            STAGE_LATENCY.labels(stage=stage).observe(ms)
 
     @contextmanager
     def stage(self, name: str):
@@ -38,18 +58,22 @@ class LatencyTracker:
         finally:
             self.record(name, (time.perf_counter() - t0) * 1000.0)
 
-    def stats(self) -> dict[str, dict[str, float]]:
-        import numpy as np
+    def reset(self) -> None:
+        """Drop all samples (benches reuse one tracker across phases; the
+        global histogram mirror is cumulative by design and unaffected)."""
+        self._samples.clear()
 
+    def stats(self) -> dict[str, dict[str, float]]:
         out: dict[str, dict[str, float]] = {}
         for stage, buf in self._samples.items():
             if not buf:
                 continue
             vals = np.asarray(buf)
+            p50, p99 = np.percentile(vals, [50, 99])
             out[stage] = {
                 "n": len(vals),
-                "p50_ms": round(float(np.percentile(vals, 50)), 3),
-                "p99_ms": round(float(np.percentile(vals, 99)), 3),
+                "p50_ms": round(float(p50), 3),
+                "p99_ms": round(float(p99), 3),
                 "mean_ms": round(float(vals.mean()), 3),
                 "max_ms": round(float(vals.max()), 3),
             }

@@ -17,7 +17,12 @@ import logging
 import math
 import time
 from collections import deque
-from datetime import UTC, datetime
+try:  # py3.11+
+    from datetime import UTC, datetime
+except ImportError:  # py3.10: datetime.UTC not there yet
+    from datetime import datetime, timezone
+
+    UTC = timezone.utc
 from pathlib import Path
 from typing import Any, NamedTuple
 
@@ -29,6 +34,7 @@ from binquant_tpu.engine.step import (
     apply_updates_step,
     default_host_inputs,
     initial_engine_state,
+    observe_dispatch,
     pad_updates,
     tick_step,
     tick_step_wire,
@@ -45,6 +51,14 @@ from binquant_tpu.io.emission import (
 from binquant_tpu.io.leverage import LeverageCalibrator
 from binquant_tpu.io.metrics import LatencyTracker
 from binquant_tpu.io.telegram import TelegramConsumer
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.instruments import (
+    HEARTBEAT_FAILURES,
+    OVERFLOW_TICKS,
+    QUEUE_DEPTH,
+    SIGNALS,
+    TICKS,
+)
 from binquant_tpu.regime.context import ContextConfig
 from binquant_tpu.regime.grid_policy import GridOnlyPolicy
 from binquant_tpu.regime.time_filter import is_quiet_hours
@@ -313,6 +327,14 @@ class SignalEngine:
         self.heartbeat_path = Path(config.heartbeat_path)
         self.ticks_processed = 0
         self.signals_emitted = 0
+        # liveness bookkeeping surfaced by /healthz (obs.exposition):
+        # last successful heartbeat write, last processed tick, and the
+        # write-failure counters touch_heartbeat maintains
+        self._last_heartbeat_s: float | None = None
+        self._last_tick_wall_s: float | None = None
+        self.heartbeat_write_failures = 0
+        self._hb_consecutive_failures = 0
+        self._hb_last_warn = float("-inf")
         # ticks whose fired set overflowed the wire's compaction slots
         # (exact count — the latency reservoir is capped and also times
         # payload-less fallbacks)
@@ -582,6 +604,10 @@ class SignalEngine:
         self.latency.record("tick_total", (time.perf_counter() - t_tick0) * 1000.0)
         self.latency.maybe_log()
         self.ticks_processed += 1
+        self._last_tick_wall_s = time.time()
+        TICKS.inc()
+        # event-log records carry the tick they were emitted under
+        get_event_log().tick = self.ticks_processed
         self.touch_heartbeat()
         return fired
 
@@ -638,6 +664,9 @@ class SignalEngine:
             await self._refresh_market_breadth(bucket15)
 
         with self.latency.stage("ingest_drain"):
+            # backlog at dispatch: how many deduped candles this tick drains
+            QUEUE_DEPTH.labels(queue="batcher5").set(len(self.batcher5))
+            QUEUE_DEPTH.labels(queue="batcher15").set(len(self.batcher15))
             batches5 = self.batcher5.drain()
             batches15 = self.batcher15.drain()
             # OI growth for symbols with fresh 15m candles (reference
@@ -747,6 +776,13 @@ class SignalEngine:
             # paths re-run the full step via the fallback closure below
             # (pure function of the captured pre-tick state).
             prev_state = self.state
+            # recompile counter + symbols-per-tick gauge (engine/step.py's
+            # shape-signature cache — a True return means the launch below
+            # pays a jax trace+compile)
+            observe_dispatch(
+                prev_state, u5, u15, self._wire_enabled_key(),
+                cfg=self.context_config,
+            )
             self.state, wire = tick_step_wire(
                 prev_state,
                 u5,
@@ -828,6 +864,7 @@ class SignalEngine:
         if fired_w.overflow or fired_w.payload is None:
             if fired_w.overflow:
                 self.overflow_ticks += 1
+                OVERFLOW_TICKS.inc()
             with self.latency.stage("overflow_fallback"):
                 outputs = pending.fallback()
         regime = ctx_scalars["market_regime"]
@@ -939,6 +976,15 @@ class SignalEngine:
             # one call later, so callers (replay A/B) must not attribute it
             # to the tick that evicted it
             signal.tick_ms = pending.ts_ms
+            SIGNALS.labels(strategy=signal.strategy).inc()
+            get_event_log().emit(
+                "signal",
+                strategy=signal.strategy,
+                symbol=signal.symbol,
+                direction=str(signal.value.direction),
+                autotrade=bool(signal.value.autotrade),
+                tick_ms=pending.ts_ms,
+            )
             bar_close_ms = (
                 (ts5 + FIVE_MIN_S) * 1000
                 if signal.strategy in FIVE_MIN_STRATEGIES
@@ -1097,12 +1143,69 @@ class SignalEngine:
             None if notifier_last is None else int(notifier_last)
         )
 
+    _HB_WARN_EVERY_S = 60.0
+
     def touch_heartbeat(self) -> None:
-        """Liveness file checked by healthcheck.py (main.py:30-32)."""
+        """Liveness file checked by healthcheck.py (main.py:30-32).
+
+        Write failures are counted (``bqt_heartbeat_write_failures_total``;
+        /healthz reports degraded liveness while they persist) and the
+        warning is rate-limited — a full disk at a 1 s tick cadence must
+        not turn the log into a firehose that buries real errors.
+        """
         try:
             self.heartbeat_path.write_text(str(time.time()))
+            self._last_heartbeat_s = time.time()
+            self._hb_consecutive_failures = 0
         except OSError:
-            logging.warning("failed to write heartbeat file")
+            self.heartbeat_write_failures += 1
+            self._hb_consecutive_failures += 1
+            HEARTBEAT_FAILURES.inc()
+            now = time.monotonic()
+            if now - self._hb_last_warn >= self._HB_WARN_EVERY_S:
+                self._hb_last_warn = now
+                logging.warning(
+                    "failed to write heartbeat file (%d consecutive, "
+                    "%d total; further warnings rate-limited to one per "
+                    "%.0fs)",
+                    self._hb_consecutive_failures,
+                    self.heartbeat_write_failures,
+                    self._HB_WARN_EVERY_S,
+                )
+
+    def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
+        """Liveness JSON for the /healthz endpoint (obs.exposition).
+
+        ``status`` semantics: ``ok`` — a heartbeat write succeeded within
+        ``max_age_s``; ``degraded`` — the engine is ticking but heartbeat
+        writes are currently failing (file liveness is lying about us);
+        ``stale`` — no successful heartbeat inside the window. Attribute
+        reads only, safe to call inline on the event loop.
+        """
+        now = time.time()
+        heartbeat_age = (
+            None if self._last_heartbeat_s is None
+            else round(now - self._last_heartbeat_s, 3)
+        )
+        last_tick_age = (
+            None if self._last_tick_wall_s is None
+            else round(now - self._last_tick_wall_s, 3)
+        )
+        if heartbeat_age is not None and heartbeat_age <= max_age_s:
+            status = "degraded" if self._hb_consecutive_failures else "ok"
+        else:
+            status = "stale"
+        return {
+            "status": status,
+            "heartbeat_age_s": heartbeat_age,
+            "heartbeat_max_age_s": max_age_s,
+            "heartbeat_write_failures": self.heartbeat_write_failures,
+            "last_tick_age_s": last_tick_age,
+            "ticks_processed": self.ticks_processed,
+            "signals_emitted": self.signals_emitted,
+            "overflow_ticks": self.overflow_ticks,
+            "pending_ticks": len(self._pending),
+        }
 
     # -- loops (main.py:37-57) ------------------------------------------------
 
@@ -1148,8 +1251,12 @@ class SignalEngine:
                             self.ingest(queue.get_nowait())
                         except asyncio.QueueEmpty:
                             break
-                except TimeoutError:
+                # py3.10: asyncio.TimeoutError is NOT the builtin; catching
+                # only the builtin would route every idle-queue timeout to
+                # the outer crash ring and starve the tick-dispatch block
+                except (TimeoutError, asyncio.TimeoutError):
                     pass
+                QUEUE_DEPTH.labels(queue="ingest").set(queue.qsize())
                 if time.monotonic() - last_tick >= tick_interval_s:
                     if len(self.batcher5) or len(self.batcher15):
                         last_tick = time.monotonic()

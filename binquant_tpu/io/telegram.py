@@ -35,6 +35,8 @@ import time
 from collections.abc import Awaitable, Callable
 from dataclasses import dataclass
 
+from binquant_tpu.obs.instruments import SINK_EMISSIONS
+
 log = logging.getLogger(__name__)
 
 TransportFn = Callable[[str, str], Awaitable[None]]
@@ -294,6 +296,7 @@ class TelegramConsumer:
                 try:
                     await self._transport(self.chat_id, text)
                 except RetryAfterError as flood:
+                    SINK_EMISSIONS.labels(sink="telegram", outcome="retry").inc()
                     pause = flood.retry_after + self._retry_after_pad_seconds
                     log.warning(
                         "Telegram flood control active; retrying in %.1fs", pause
@@ -301,6 +304,7 @@ class TelegramConsumer:
                     await asyncio.sleep(pause)
                     continue
                 self._sent_monotonic = time.monotonic()
+                SINK_EMISSIONS.labels(sink="telegram", outcome="ok").inc()
                 return
 
     async def send_signal(self, message: str) -> None:
@@ -310,6 +314,7 @@ class TelegramConsumer:
             if condensed:
                 await self.send_msg(condensed)
         except Exception as exc:
+            SINK_EMISSIONS.labels(sink="telegram", outcome="error").inc()
             log.error("Error sending telegram signal: %s", exc)
             log.error("Original message: %s", message)
 
@@ -326,6 +331,7 @@ class TelegramConsumer:
             return None
         key = parse_fingerprint(condensed).key()
         if not self._ledger.admit(key, self._signal_dedupe_seconds):
+            SINK_EMISSIONS.labels(sink="telegram", outcome="suppressed").inc()
             return None
 
         task = asyncio.create_task(self.send_signal(condensed))

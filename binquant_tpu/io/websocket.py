@@ -23,6 +23,8 @@ from collections.abc import Callable
 from typing import Any
 
 from binquant_tpu.exceptions import WebSocketError
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.instruments import WS_FRAMES, WS_RECONNECTS
 from binquant_tpu.schemas import SymbolModel
 
 BINANCE_WS_BASE = "wss://stream.binance.com:9443/ws"
@@ -154,12 +156,21 @@ class KlinesConnector:
                     )
                     backoff = 1.0
                     async for raw in ws:
+                        WS_FRAMES.labels(exchange="binance").inc()
                         kline = parse_binance_kline_frame(raw)
                         if kline is not None:
                             await self.queue.put(kline)
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                WS_RECONNECTS.labels(exchange="binance").inc()
+                get_event_log().emit(
+                    "ws_reconnect",
+                    exchange="binance",
+                    client=idx,
+                    error=str(e),
+                    backoff_s=backoff,
+                )
                 logging.warning(
                     "ws client %d dropped (%s); reconnecting in %.0fs",
                     idx,
@@ -395,6 +406,7 @@ class KucoinKlinesConnector:
                     ping_task = asyncio.create_task(ping_loop())
                     try:
                         async for raw in ws:
+                            WS_FRAMES.labels(exchange="kucoin").inc()
                             parsed = parse_kucoin_candle_message(
                                 raw, self.market_type
                             )
@@ -416,6 +428,14 @@ class KucoinKlinesConnector:
                         self._last_candle.pop(
                             tuple(sym_iv.rsplit("_", 1)), None
                         )
+                WS_RECONNECTS.labels(exchange="kucoin").inc()
+                get_event_log().emit(
+                    "ws_reconnect",
+                    exchange="kucoin",
+                    client=idx,
+                    error=str(e),
+                    backoff_s=backoff,
+                )
                 logging.warning(
                     "kucoin ws client %d dropped (%s); reconnecting in %.0fs",
                     idx,

@@ -1,0 +1,25 @@
+"""Unified observability subsystem (ROADMAP: production-scale serving).
+
+Three dependency-free pieces, importable everywhere (no jax, no httpx):
+
+* :mod:`registry` — ``Counter`` / ``Gauge`` / ``Histogram`` primitives with
+  labels, thread-safe, plus the process-global default :data:`REGISTRY`.
+* :mod:`exposition` — Prometheus text rendering and a tiny asyncio HTTP
+  server exposing ``/metrics`` + ``/healthz`` (``BQT_METRICS_PORT``).
+* :mod:`events` — a structured JSONL event log for discrete facts
+  (reconnects, signals, autotrade attempts, checkpoint saves, JIT
+  compiles), each stamped with wall + monotonic time and the tick number.
+
+The metric name catalogue lives in :mod:`instruments` (one definition per
+family — importing any instrumented module registers the whole catalogue,
+so ``/metrics`` always exposes every family name). The human-readable
+catalogue is in README.md §Observability.
+"""
+
+from binquant_tpu.obs.registry import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)

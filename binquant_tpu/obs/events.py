@@ -1,0 +1,143 @@
+"""Structured JSONL event log for discrete operational facts.
+
+Counters say *how often*; the event log says *what, exactly, and when* —
+reconnects (which client, what error), signal emissions (strategy/symbol),
+autotrade attempts, checkpoint saves, JIT compile events. One JSON object
+per line so ``jq``/log shippers consume it directly.
+
+Every record carries:
+
+* ``event``  — the kind (``ws_reconnect``, ``signal``, ``autotrade``,
+  ``checkpoint_save``, ``jit_compile``, ...);
+* ``ts``     — wall-clock epoch seconds (correlate with external systems);
+* ``mono``   — ``time.monotonic()`` (order/dedupe across clock steps);
+* ``seq``    — per-process emission sequence number;
+* ``tick``   — the engine tick counter at emission time (the pipeline
+  advances :attr:`EventLog.tick` once per processed tick), 0 before the
+  first tick;
+* any event-specific fields.
+
+Sinks: ``None`` disables (emit is a cheap no-op — safe on hot paths),
+``"stderr"``/``"-"`` writes to stderr, anything else is a path with
+size-based rotation (``path`` -> ``path.1``). The process default is
+configured by ``BQT_EVENT_LOG`` and reachable via :func:`get_event_log`;
+``emit`` never raises — a full disk must not take down the tick loop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import IO, Any
+
+log = logging.getLogger(__name__)
+
+
+class EventLog:
+    def __init__(
+        self,
+        sink: str | Path | None = None,
+        max_bytes: int = 64 * 1024 * 1024,
+        backups: int = 1,
+    ) -> None:
+        self.max_bytes = int(max_bytes)
+        self.backups = max(int(backups), 0)
+        self.tick = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._fh: IO[str] | None = None
+        self._path: Path | None = None
+        self._warned = False
+        if sink in (None, ""):
+            self.enabled = False
+        elif str(sink) in ("stderr", "-"):
+            self.enabled = True
+            self._fh = sys.stderr
+        else:
+            self.enabled = True
+            self._path = Path(sink)
+
+    def emit(self, event: str, **fields: Any) -> dict | None:
+        """Write one record; returns it (tests), or None when disabled or
+        the write failed. Never raises."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._seq += 1
+            record = {
+                "event": event,
+                "ts": time.time(),
+                "mono": time.monotonic(),
+                "seq": self._seq,
+                "tick": self.tick,
+                **fields,
+            }
+            try:
+                line = json.dumps(record, default=str, separators=(",", ":"))
+                fh = self._file()
+                fh.write(line + "\n")
+                fh.flush()
+            except Exception:
+                if not self._warned:
+                    self._warned = True
+                    log.exception("event log write failed; further failures silent")
+                return None
+            return record
+
+    def _file(self) -> IO[str]:
+        if self._path is None:
+            assert self._fh is not None  # stderr sink
+            return self._fh
+        if self._fh is not None and self._fh.tell() >= self.max_bytes:
+            self._rotate()
+        if self._fh is None:
+            self._path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = self._path.open("a", encoding="utf-8")
+        return self._fh
+
+    def _rotate(self) -> None:
+        assert self._path is not None
+        self._fh.close()  # type: ignore[union-attr]
+        self._fh = None
+        if self.backups <= 0:
+            self._path.unlink(missing_ok=True)
+            return
+        # shift path.(n-1) -> path.n, ..., path -> path.1
+        for i in range(self.backups, 0, -1):
+            src = self._path if i == 1 else Path(f"{self._path}.{i - 1}")
+            if src.exists():
+                os.replace(src, f"{self._path}.{i}")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._path is not None and self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_default_log: EventLog | None = None
+_default_lock = threading.Lock()
+
+
+def get_event_log() -> EventLog:
+    """The process-default event log, built from ``BQT_EVENT_LOG`` on first
+    use ("" = disabled, "stderr"/"-" = stderr, else a rotating file path)."""
+    global _default_log
+    if _default_log is None:
+        with _default_lock:
+            if _default_log is None:
+                _default_log = EventLog(os.environ.get("BQT_EVENT_LOG") or None)
+    return _default_log
+
+
+def set_event_log(event_log: EventLog | None) -> None:
+    """Install (or clear, with None) the process-default event log —
+    main.py wiring and test isolation."""
+    global _default_log
+    with _default_lock:
+        _default_log = event_log

@@ -1,0 +1,189 @@
+"""Prometheus text exposition + the /metrics + /healthz HTTP endpoint.
+
+``render_text`` turns a :class:`~binquant_tpu.obs.registry.MetricsRegistry`
+into Prometheus text format 0.0.4. :class:`MetricsServer` is a tiny asyncio
+HTTP server (stdlib only — the image carries no aiohttp, and httpx is a
+client) that serves:
+
+* ``GET /metrics``  — the rendered registry;
+* ``GET /healthz``  — liveness JSON from an injected callable (heartbeat
+  age + last-tick status; ``SignalEngine.health_snapshot`` in production).
+  HTTP 200 while the process is live (``status`` of ``ok`` or
+  ``degraded`` — a ticking engine whose heartbeat writes fail is alive;
+  restarting it would not fix a full disk) and 503 otherwise, so
+  orchestrators can probe it directly without killing live engines.
+
+Started from ``main.py`` when ``BQT_METRICS_PORT`` is set; ``port=0``
+binds an ephemeral port (tests), reported by :meth:`MetricsServer.start`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from collections.abc import Callable
+
+from binquant_tpu.obs.registry import (
+    REGISTRY,
+    MetricFamily,
+    MetricsRegistry,
+    format_value,
+)
+
+CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
+log = logging.getLogger(__name__)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label_value(text: str) -> str:
+    return (
+        text.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _label_str(names: tuple[str, ...], values: tuple[str, ...],
+               extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = [
+        f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)
+    ] + [f'{n}="{_escape_label_value(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _render_family(fam: MetricFamily, out: list[str]) -> None:
+    out.append(f"# HELP {fam.name} {_escape_help(fam.documentation)}")
+    out.append(f"# TYPE {fam.name} {fam.kind}")
+    for key, child in sorted(fam.children()):
+        if fam.kind == "histogram":
+            bounds = list(fam.bucket_bounds) + [float("inf")]
+            for bound, cum in zip(bounds, child.cumulative_counts()):
+                labels = _label_str(
+                    fam.label_names, key, extra=(("le", format_value(bound)),)
+                )
+                out.append(f"{fam.name}_bucket{labels} {cum}")
+            base = _label_str(fam.label_names, key)
+            out.append(f"{fam.name}_sum{base} {format_value(child.sum)}")
+            out.append(f"{fam.name}_count{base} {child.count}")
+        else:
+            labels = _label_str(fam.label_names, key)
+            out.append(f"{fam.name}{labels} {format_value(child.value)}")
+
+
+def render_text(registry: MetricsRegistry | None = None) -> str:
+    """The whole registry in Prometheus text format (trailing newline)."""
+    registry = registry if registry is not None else REGISTRY
+    out: list[str] = []
+    for fam in registry.collect():
+        _render_family(fam, out)
+    return "\n".join(out) + "\n"
+
+
+class MetricsServer:
+    """``/metrics`` + ``/healthz`` on an asyncio socket server.
+
+    ``health_fn`` returns the liveness JSON payload (a dict with at least
+    ``status``); it runs inline on the event loop, so it must be cheap and
+    non-blocking — ``SignalEngine.health_snapshot`` only reads attributes.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        health_fn: Callable[[], dict] | None = None,
+        port: int = 9464,
+        host: str = "0.0.0.0",
+    ) -> None:
+        self.registry = registry if registry is not None else REGISTRY
+        self.health_fn = health_fn
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> int:
+        """Bind and serve; returns the bound port (resolves ``port=0``)."""
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("metrics exporter listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- request handling ---------------------------------------------------
+
+    def _respond(self, status: int, reason: str, ctype: str, body: str) -> bytes:
+        payload = body.encode("utf-8")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            "Connection: close\r\n\r\n"
+        )
+        return head.encode("ascii") + payload
+
+    def _route(self, path: str) -> bytes:
+        if path == "/metrics":
+            return self._respond(
+                200, "OK", CONTENT_TYPE_LATEST, render_text(self.registry)
+            )
+        if path == "/healthz":
+            if self.health_fn is None:
+                payload: dict = {"status": "unknown"}
+            else:
+                try:
+                    payload = self.health_fn()
+                except Exception:
+                    log.exception("health_fn crashed")
+                    payload = {"status": "error"}
+            # degraded = alive-but-impaired: visible in the payload (and
+            # the heartbeat-failure counter) but NOT a probe failure — a
+            # restart doesn't fix the underlying write failure
+            ok = payload.get("status") in ("ok", "degraded")
+            return self._respond(
+                200 if ok else 503,
+                "OK" if ok else "Service Unavailable",
+                "application/json",
+                json.dumps(payload),
+            )
+        return self._respond(404, "Not Found", "text/plain", "not found\n")
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), timeout=5)
+            parts = request_line.decode("latin-1").split()
+            # drain headers (bounded — a scraper sends a handful of lines)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if len(parts) < 2 or parts[0] != "GET":
+                writer.write(
+                    self._respond(
+                        405, "Method Not Allowed", "text/plain", "GET only\n"
+                    )
+                )
+            else:
+                path = parts[1].split("?", 1)[0]
+                writer.write(self._route(path))
+            await writer.drain()
+        except (TimeoutError, asyncio.TimeoutError, ConnectionError, OSError):
+            pass  # scraper went away (or never spoke); nothing to salvage
+        except Exception:
+            log.exception("metrics request handling failed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass

@@ -1,0 +1,125 @@
+"""The metric catalogue: every family this codebase emits, defined once.
+
+Instrumented modules import their instruments from here instead of
+declaring families ad hoc — so (a) name/type/label collisions are
+impossible, (b) importing ANY instrumented module registers the whole
+catalogue and ``/metrics`` always exposes every family name, and (c) this
+file + README.md §Observability are the same list in two forms. Keep the
+two in sync when adding a family.
+
+Label cardinality is deliberately bounded: stage names, strategy names,
+exchange ids, gate names and outcome enums are all small fixed sets —
+never put symbols, paths, or error strings in a label (those belong in the
+event log).
+"""
+
+from __future__ import annotations
+
+from binquant_tpu.obs.registry import REGISTRY
+
+# -- tick pipeline (io/pipeline.py) -----------------------------------------
+
+TICKS = REGISTRY.counter(
+    "bqt_ticks_total", "Engine ticks processed (one batched device step each)."
+)
+SIGNALS = REGISTRY.counter(
+    "bqt_signals_total",
+    "Signals emitted through the sinks, after per-bar dedupe.",
+    labels=("strategy",),
+)
+OVERFLOW_TICKS = REGISTRY.counter(
+    "bqt_wire_overflow_ticks_total",
+    "Ticks whose fired set overflowed the wire's compaction slots "
+    "(full-summary fallback ran).",
+)
+QUEUE_DEPTH = REGISTRY.gauge(
+    "bqt_queue_depth",
+    "Ingest backlog: asyncio queue (consume loop) and per-interval "
+    "batcher pending-candle counts at tick dispatch.",
+    labels=("queue",),
+)
+STAGE_LATENCY = REGISTRY.histogram(
+    "bqt_stage_latency_ms",
+    "Per-stage pipeline latency in milliseconds (absorbs LatencyTracker; "
+    "tick_total is the p99<50ms budget stage).",
+    labels=("stage",),
+)
+HEARTBEAT_FAILURES = REGISTRY.counter(
+    "bqt_heartbeat_write_failures_total",
+    "Failed heartbeat-file writes (persistent failure degrades /healthz).",
+)
+
+# -- device step (engine/step.py) -------------------------------------------
+
+SYMBOLS_PER_TICK = REGISTRY.gauge(
+    "bqt_symbols_per_tick",
+    "Symbols with fresh candles applied in the last dispatched tick.",
+    labels=("interval",),
+)
+JIT_RECOMPILES = REGISTRY.counter(
+    "bqt_jit_recompiles_total",
+    "New (shape, wire-key, config) dispatch signatures — each one is a "
+    "jax trace+compile of the tick step.",
+    labels=("fn",),
+)
+
+# -- ingest buffers + registry (engine/buffer.py) ---------------------------
+
+INGEST_DEDUP_OVERWRITES = REGISTRY.counter(
+    "bqt_ingest_dedup_overwrites_total",
+    "Pending candles overwritten before drain by a re-sent (symbol, "
+    "open_time) — the keep-last dedupe evicting the stale payload.",
+)
+REGISTRY_SYMBOLS = REGISTRY.gauge(
+    "bqt_registry_symbols",
+    "Occupied symbol rows in the device ring buffer registry.",
+)
+REGISTRY_CAPACITY_ERRORS = REGISTRY.counter(
+    "bqt_registry_capacity_errors_total",
+    "Symbol-add attempts refused because the registry overflowed "
+    "BQT_MAX_SYMBOLS.",
+)
+
+# -- websocket ingest (io/websocket.py) -------------------------------------
+
+WS_FRAMES = REGISTRY.counter(
+    "bqt_ws_frames_total",
+    "Raw websocket frames received, per exchange (all message kinds).",
+    labels=("exchange",),
+)
+WS_RECONNECTS = REGISTRY.counter(
+    "bqt_ws_reconnects_total",
+    "Websocket client drops that entered the reconnect-backoff loop.",
+    labels=("exchange",),
+)
+
+# -- emission sinks (io/emission.py, io/telegram.py, io/autotrade.py) -------
+
+SINK_EMISSIONS = REGISTRY.counter(
+    "bqt_sink_emissions_total",
+    "Per-sink emission outcomes (ok / error / retry / suppressed / "
+    "attempt / refused / launched / grid_deployed).",
+    labels=("sink", "outcome"),
+)
+AUTOTRADE_REFUSALS = REGISTRY.counter(
+    "bqt_autotrade_refusals_total",
+    "Autotrade admissions refused, by gate name.",
+    labels=("gate",),
+)
+
+# -- binbot REST client (io/binbot.py) --------------------------------------
+
+BINBOT_REQUESTS = REGISTRY.counter(
+    "bqt_binbot_requests_total",
+    "Binbot backend REST calls by method and outcome "
+    "(ok / http_error / backend_error / transport_error).",
+    labels=("method", "outcome"),
+)
+
+# -- checkpointing (io/checkpoint.py) ---------------------------------------
+
+CHECKPOINT_SAVES = REGISTRY.counter(
+    "bqt_checkpoint_saves_total",
+    "Engine-state snapshot attempts by outcome (ok / error).",
+    labels=("outcome",),
+)

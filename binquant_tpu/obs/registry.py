@@ -1,0 +1,280 @@
+"""Metric primitives: Counter, Gauge, fixed-bucket Histogram.
+
+Prometheus-shaped but dependency-free (the container image carries no
+prometheus_client). Semantics:
+
+* a **family** is created through a :class:`MetricsRegistry` and owns all
+  label-children of one metric name; creation is get-or-create, so every
+  instrumented module can declare the family it uses and concurrent
+  declarations converge on the same object (kind/labels must agree).
+* an **unlabeled** family acts as its own single child (``inc``/``set``/
+  ``observe`` directly on it); a labeled family mints children via
+  ``.labels(key=value, ...)``.
+* all mutation is lock-guarded — instrumented paths run on the event loop,
+  worker threads (backfill pool, checkpoint writer) and background tasks
+  simultaneously.
+
+Histograms are fixed-bucket (upper bounds in the metric's unit, ``+Inf``
+implicit) with cumulative bucket counts at render time — the exposition
+format's contract.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Iterable
+
+_METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Latency-in-ms default buckets, shaped around the p99 < 50 ms budget.
+DEFAULT_LATENCY_BUCKETS_MS: tuple[float, ...] = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0,
+)
+
+
+class Counter:
+    """Monotonically increasing value (one label-child)."""
+
+    def __init__(self) -> None:
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Freely settable value (one label-child)."""
+
+    def __init__(self) -> None:
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (one label-child).
+
+    ``buckets`` are inclusive upper bounds, strictly increasing; a final
+    ``+Inf`` bucket is implicit. ``counts`` are per-bucket (NOT cumulative);
+    exposition accumulates them.
+    """
+
+    def __init__(self, buckets: tuple[float, ...]) -> None:
+        self.buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        # linear probe: bucket lists are short (~14) and the common case
+        # (tick latencies) lands in the first few
+        i = len(self.buckets)
+        for j, bound in enumerate(self.buckets):
+            if value <= bound:
+                i = j
+                break
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def cumulative_counts(self) -> list[int]:
+        """Per-``le`` cumulative counts, one per bucket plus ``+Inf``."""
+        with self._lock:
+            out, acc = [], 0
+            for c in self._counts:
+                acc += c
+                out.append(acc)
+            return out
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricFamily:
+    """All label-children of one metric name."""
+
+    def __init__(
+        self,
+        name: str,
+        documentation: str,
+        kind: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] | None = None,
+    ) -> None:
+        if not _METRIC_NAME.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in label_names:
+            if not _LABEL_NAME.match(ln):
+                raise ValueError(f"invalid label name {ln!r}")
+        if kind not in _KINDS:
+            raise ValueError(f"unknown metric kind {kind!r}")
+        if kind == "histogram":
+            buckets = tuple(float(b) for b in (buckets or DEFAULT_LATENCY_BUCKETS_MS))
+            if list(buckets) != sorted(set(buckets)):
+                raise ValueError("histogram buckets must be strictly increasing")
+        self.name = name
+        self.documentation = documentation
+        self.kind = kind
+        self.label_names = tuple(label_names)
+        self.bucket_bounds = buckets
+        self._children: dict[tuple[str, ...], Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+        if not self.label_names:
+            # eager unlabeled child: the family always renders a sample
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        if self.kind == "histogram":
+            return Histogram(self.bucket_bounds)
+        return _KINDS[self.kind]()
+
+    def labels(self, **label_values: object):
+        if set(label_values) != set(self.label_names):
+            raise ValueError(
+                f"{self.name} expects labels {self.label_names}, "
+                f"got {tuple(sorted(label_values))}"
+            )
+        key = tuple(str(label_values[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    def children(self) -> Iterable[tuple[tuple[str, ...], object]]:
+        with self._lock:
+            return list(self._children.items())
+
+    # -- unlabeled convenience: the family IS its single child -------------
+
+    def _solo(self):
+        if self.label_names:
+            raise ValueError(f"{self.name} is labeled; use .labels(...)")
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._solo().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._solo().value
+
+
+class MetricsRegistry:
+    """Get-or-create family store; the exposition layer renders it."""
+
+    def __init__(self) -> None:
+        self._families: dict[str, MetricFamily] = {}
+        self._lock = threading.Lock()
+
+    def _family(
+        self,
+        name: str,
+        documentation: str,
+        kind: str,
+        labels: tuple[str, ...],
+        buckets: tuple[float, ...] | None = None,
+    ) -> MetricFamily:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = MetricFamily(name, documentation, kind, labels, buckets)
+                self._families[name] = fam
+                return fam
+        if fam.kind != kind or fam.label_names != tuple(labels):
+            raise ValueError(
+                f"metric {name!r} re-declared as {kind}{tuple(labels)} but "
+                f"exists as {fam.kind}{fam.label_names}"
+            )
+        return fam
+
+    def counter(
+        self, name: str, documentation: str, labels: tuple[str, ...] = ()
+    ) -> MetricFamily:
+        return self._family(name, documentation, "counter", tuple(labels))
+
+    def gauge(
+        self, name: str, documentation: str, labels: tuple[str, ...] = ()
+    ) -> MetricFamily:
+        return self._family(name, documentation, "gauge", tuple(labels))
+
+    def histogram(
+        self,
+        name: str,
+        documentation: str,
+        labels: tuple[str, ...] = (),
+        buckets: tuple[float, ...] | None = None,
+    ) -> MetricFamily:
+        return self._family(name, documentation, "histogram", tuple(labels), buckets)
+
+    def collect(self) -> list[MetricFamily]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def get(self, name: str) -> MetricFamily | None:
+        with self._lock:
+            return self._families.get(name)
+
+
+def format_value(v: float) -> str:
+    """Prometheus sample value: integral floats render bare, +/-Inf and NaN
+    in the exposition spellings."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+#: Process-global default registry: every instrument in
+#: binquant_tpu.obs.instruments registers here, and the /metrics endpoint
+#: serves it unless handed a different registry.
+REGISTRY = MetricsRegistry()

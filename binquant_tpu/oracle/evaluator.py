@@ -1424,8 +1424,9 @@ class OracleEvaluator:
             self._last_strength = 0.0
 
         if quiet is None:
-            from datetime import UTC, datetime
+            from datetime import datetime, timezone
 
+            UTC = timezone.utc  # datetime.UTC alias (3.11+) for py3.10
             from binquant_tpu.regime.time_filter import is_autotrade_suppressed
 
             # judged at the EVALUATED tick time against the context built

@@ -42,7 +42,12 @@ import asyncio
 import logging
 import os
 from contextlib import ExitStack
-from datetime import UTC, datetime
+try:  # py3.11+
+    from datetime import UTC, datetime
+except ImportError:  # py3.10: datetime.UTC not there yet
+    from datetime import datetime, timezone
+
+    UTC = timezone.utc
 from pathlib import Path
 from unittest.mock import patch
 

@@ -43,8 +43,9 @@ def timestamp_sort_key(value: Any) -> float | None:
     if numeric is not None:
         return numeric / 1000.0 if abs(numeric) >= 1e11 else numeric
     if isinstance(value, str):
-        from datetime import UTC, datetime
+        from datetime import datetime, timezone
 
+        UTC = timezone.utc  # datetime.UTC alias (3.11+) for py3.10 runtimes
         try:
             parsed = datetime.fromisoformat(value)
         except ValueError:

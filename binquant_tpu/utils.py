@@ -7,7 +7,12 @@ parity tests; the ``j*`` variants are the jnp analogues used inside jit.
 
 from __future__ import annotations
 
-from datetime import UTC, datetime
+try:  # py3.11+
+    from datetime import UTC, datetime
+except ImportError:  # py3.10: datetime.UTC not there yet
+    from datetime import datetime, timezone
+
+    UTC = timezone.utc
 from typing import Any
 
 import jax.numpy as jnp

@@ -1,32 +1,88 @@
 """Container liveness probe.
 
-Equivalent of ``/root/reference/healthcheck.py``: exit 0 iff the heartbeat
-file exists and is younger than the staleness bound (1500 s).
+Equivalent of ``/root/reference/healthcheck.py``, extended for the
+observability subsystem: when the process exposes the in-process
+``/healthz`` endpoint (``BQT_METRICS_PORT`` set), prefer its richer
+verdict — it distinguishes a live engine whose heartbeat *writes* are
+failing (degraded) from a dead one — and fall back to the heartbeat-file
+staleness check when the endpoint is unreachable (exporter disabled, or
+the process is too wedged to serve it, which the file check then catches).
+
+The staleness bound is env-configurable via ``BQT_HEARTBEAT_MAX_AGE``
+(seconds, default 1500) to match the heartbeat path already being
+env-configurable — a deploy that relocates the file can also retune the
+probe without patching the image.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
 
-HEARTBEAT_PATH = os.environ.get(
-    "BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat"
-)
-MAX_AGE_SECONDS = 1500
+
+def heartbeat_path() -> str:
+    return os.environ.get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")
 
 
-def main() -> int:
+def max_age_seconds() -> float:
+    return float(os.environ.get("BQT_HEARTBEAT_MAX_AGE", "1500"))
+
+
+def check_healthz(port: int, timeout_s: float = 3.0) -> int | None:
+    """Probe the in-process /healthz endpoint. Returns an exit code when
+    the server answered (its verdict is authoritative), or None when it is
+    unreachable and the caller should fall back to the heartbeat file."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}/healthz"
     try:
-        written_at = float(open(HEARTBEAT_PATH).read().strip())
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+            status = payload.get("status")
+    except urllib.error.HTTPError as err:
+        # the server answered (503 = degraded/stale): authoritative
+        try:
+            status = json.loads(err.read().decode("utf-8")).get("status")
+        except Exception:
+            status = "error"
+    except (OSError, ValueError):
+        return None  # not listening / unparsable: fall back to the file
+    if status in ("ok", "degraded"):
+        # degraded = live engine with failing heartbeat WRITES — restarting
+        # it wouldn't fix the disk; surfaced via /healthz payload + the
+        # bqt_heartbeat_write_failures_total counter instead
+        if status == "degraded":
+            print("/healthz reports degraded (still live)", file=sys.stderr)
+        return 0
+    print(f"/healthz reports status={status}", file=sys.stderr)
+    return 1
+
+
+def check_heartbeat_file() -> int:
+    path = heartbeat_path()
+    max_age = max_age_seconds()
+    try:
+        written_at = float(open(path).read().strip())
     except (OSError, ValueError):
         print("heartbeat file missing or unreadable", file=sys.stderr)
         return 1
     age = time.time() - written_at
-    if age > MAX_AGE_SECONDS:
-        print(f"heartbeat stale: {age:.0f}s > {MAX_AGE_SECONDS}s", file=sys.stderr)
+    if age > max_age:
+        print(f"heartbeat stale: {age:.0f}s > {max_age:.0f}s", file=sys.stderr)
         return 1
     return 0
+
+
+def main() -> int:
+    port = int(os.environ.get("BQT_METRICS_PORT", "0") or 0)
+    if port:
+        verdict = check_healthz(port)
+        if verdict is not None:
+            return verdict
+    return check_heartbeat_file()
 
 
 if __name__ == "__main__":

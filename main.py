@@ -36,6 +36,10 @@ async def run_live() -> None:
 
     config = Config()
     configure_logging(config.log_level)
+    if config.event_log:
+        from binquant_tpu.obs.events import EventLog, set_event_log
+
+        set_event_log(EventLog(config.event_log))
     binbot_api = BinbotApi(config.binbot_api_url)
 
     autotrade_settings = binbot_api.get_autotrade_settings()
@@ -156,13 +160,29 @@ async def run_live() -> None:
             api_symbol_of=api_symbol_of,
         ),
     )
+    # Observability exporter: /metrics (Prometheus text) + /healthz
+    # (heartbeat age + last-tick status), enabled by BQT_METRICS_PORT.
+    metrics_server = None
+    if config.metrics_port:
+        from binquant_tpu.obs.exposition import MetricsServer
+
+        metrics_server = MetricsServer(
+            health_fn=lambda: engine.health_snapshot(config.heartbeat_max_age_s),
+            port=config.metrics_port,
+        )
+        await metrics_server.start()
+
     logging.info("binquant_tpu started: %d symbols tracked", len(all_symbols))
     # OI refresh rides a background task (bounded-concurrency REST sweeps
     # amortized across the bucket); the tick path only reads its cache
-    await asyncio.gather(
-        engine.consume_loop(queue),
-        engine.oi_cache.refresh_forever(lambda: engine.registry.names),
-    )
+    try:
+        await asyncio.gather(
+            engine.consume_loop(queue),
+            engine.oi_cache.refresh_forever(lambda: engine.registry.names),
+        )
+    finally:
+        if metrics_server is not None:
+            await metrics_server.stop()
 
 
 def main() -> int:

@@ -268,8 +268,9 @@ class TestCapsAndOwnership:
 
 
 def grid_signal(symbol="BTCUSDT", generated_at=None, allocation=60.0):
-    from datetime import UTC, datetime
+    from datetime import datetime, timezone
 
+    UTC = timezone.utc  # datetime.UTC alias (3.11+) for py3.10 runtimes
     params = GridDeploymentRequest(
         symbol=symbol, fiat="USDT", exchange="binance", market_type="spot",
         algorithm_name="grid_ladder",

@@ -300,8 +300,9 @@ class TestAutotradeGates:
         assert session.created == []
 
     def test_grid_deployment_cooldown(self):
-        from datetime import UTC, datetime
+        from datetime import datetime, timezone
 
+        UTC = timezone.utc  # datetime.UTC alias (3.11+) for py3.10
         from binquant_tpu.schemas import GridDeploymentRequest, SignalKind
 
         consumer, session = make_at_consumer()

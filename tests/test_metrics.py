@@ -35,6 +35,31 @@ def test_rolling_window_bounded():
     assert s["max_ms"] == 99.0  # only the trailing window retained
 
 
+def test_reset_clears_samples_for_phase_reuse():
+    t = LatencyTracker()
+    t.record("tick_total", 5.0)
+    assert "tick_total" in t.stats()
+    t.reset()
+    assert t.stats() == {}
+    # the tracker is reusable after a reset (bench phases)
+    t.record("tick_total", 1.0)
+    assert t.stats()["tick_total"]["n"] == 1
+
+
+def test_record_mirrors_into_global_histogram():
+    from binquant_tpu.obs.instruments import STAGE_LATENCY
+
+    child = STAGE_LATENCY.labels(stage="obs_mirror_probe")
+    before = child.count
+    t = LatencyTracker()
+    t.record("obs_mirror_probe", 3.0)
+    assert child.count == before + 1
+    # opt-out for synthetic micro-benchmarks
+    t2 = LatencyTracker(mirror=False)
+    t2.record("obs_mirror_probe", 3.0)
+    assert child.count == before + 1
+
+
 def test_maybe_log_cadence(caplog):
     t = LatencyTracker(log_every_s=0.0)
     t.record("tick_total", 5.0)

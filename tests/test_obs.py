@@ -1,0 +1,517 @@
+"""Observability subsystem: registry semantics, Prometheus exposition,
+/healthz, the JSONL event log, healthcheck probe preference, and the
+in-process end-to-end scrape of an instrumented replay pipeline (the
+acceptance gate for the metric catalogue)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import threading
+import time
+
+import pytest
+
+import healthcheck
+from binquant_tpu.obs.events import EventLog
+from binquant_tpu.obs.exposition import MetricsServer, render_text
+from binquant_tpu.obs.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# registry primitives
+# ---------------------------------------------------------------------------
+
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "doc")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_depth", "doc")
+    g.set(10)
+    g.inc(5)
+    g.dec(2)
+    assert g.value == 13.0
+
+
+def test_histogram_bucket_boundaries():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_ms", "doc", buckets=(1.0, 2.0, 5.0))
+    child = h._solo()
+    h.observe(1.0)  # le is INCLUSIVE: lands in the first bucket
+    h.observe(1.5)
+    h.observe(5.0)
+    h.observe(99.0)  # +Inf only
+    assert child.cumulative_counts() == [1, 2, 3, 4]
+    assert child.count == 4
+    assert child.sum == pytest.approx(106.5)
+
+
+def test_histogram_rejects_unsorted_buckets():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("t_bad", "doc", buckets=(5.0, 1.0))
+
+
+def test_label_cardinality_and_identity():
+    reg = MetricsRegistry()
+    fam = reg.counter("t_sig", "doc", labels=("strategy",))
+    a1 = fam.labels(strategy="a")
+    a2 = fam.labels(strategy="a")
+    b = fam.labels(strategy="b")
+    assert a1 is a2 and a1 is not b
+    a1.inc()
+    a1.inc()
+    b.inc()
+    assert a2.value == 2 and b.value == 1
+    with pytest.raises(ValueError):
+        fam.labels(wrong="x")  # undeclared label name
+    with pytest.raises(ValueError):
+        fam.labels(strategy="a", extra="y")  # extra label name
+    with pytest.raises(ValueError):
+        fam.inc()  # labeled family has no solo child
+
+
+def test_family_get_or_create_and_conflicts():
+    reg = MetricsRegistry()
+    fam = reg.counter("t_dup", "doc")
+    assert reg.counter("t_dup", "other doc") is fam  # get-or-create
+    with pytest.raises(ValueError):
+        reg.gauge("t_dup", "doc")  # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("t_dup", "doc", labels=("x",))  # label conflict
+    with pytest.raises(ValueError):
+        reg.counter("0bad name", "doc")  # invalid metric name
+
+
+def test_concurrent_increments_are_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("t_conc", "doc")
+    h = reg.histogram("t_conc_ms", "doc", buckets=(10.0,))
+
+    def work():
+        for _ in range(5000):
+            c.inc()
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 40000
+    assert h._solo().count == 40000
+    assert h._solo().cumulative_counts() == [40000, 40000]
+
+
+# ---------------------------------------------------------------------------
+# exposition format
+# ---------------------------------------------------------------------------
+
+
+def _golden_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("g_ticks_total", "Ticks.").inc(3)
+    reg.gauge("g_depth", "Depth.", labels=("queue",)).labels(queue="q5").set(7)
+    hist = reg.histogram("g_lat_ms", "Latency.", labels=("stage",),
+                         buckets=(1.0, 5.0))
+    hist.labels(stage="tick").observe(0.5)
+    hist.labels(stage="tick").observe(4.0)
+    hist.labels(stage="tick").observe(50.0)
+    return reg
+
+
+def test_exposition_golden():
+    text = render_text(_golden_registry())
+    assert text == (
+        "# HELP g_depth Depth.\n"
+        "# TYPE g_depth gauge\n"
+        'g_depth{queue="q5"} 7\n'
+        "# HELP g_lat_ms Latency.\n"
+        "# TYPE g_lat_ms histogram\n"
+        'g_lat_ms_bucket{stage="tick",le="1"} 1\n'
+        'g_lat_ms_bucket{stage="tick",le="5"} 2\n'
+        'g_lat_ms_bucket{stage="tick",le="+Inf"} 3\n'
+        'g_lat_ms_sum{stage="tick"} 54.5\n'
+        'g_lat_ms_count{stage="tick"} 3\n'
+        "# HELP g_ticks_total Ticks.\n"
+        "# TYPE g_ticks_total counter\n"
+        "g_ticks_total 3\n"
+    )
+
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$"
+)
+
+
+def assert_prometheus_grammar(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _SAMPLE_LINE.match(line), f"bad exposition line: {line!r}"
+
+
+def test_exposition_grammar_validates():
+    assert_prometheus_grammar(render_text(_golden_registry()))
+
+
+def test_exposition_label_escaping():
+    reg = MetricsRegistry()
+    fam = reg.counter("g_esc_total", "Line one.\nLine two \\ slash.",
+                      labels=("name",))
+    fam.labels(name='we"ird\\val\nue').inc()
+    text = render_text(reg)
+    assert r"# HELP g_esc_total Line one.\nLine two \\ slash." in text
+    assert 'g_esc_total{name="we\\"ird\\\\val\\nue"} 1' in text
+    assert_prometheus_grammar(text)
+
+
+def test_unlabeled_families_render_zero_sample():
+    reg = MetricsRegistry()
+    reg.counter("g_zero_total", "Never incremented.")
+    assert "g_zero_total 0\n" in render_text(reg)
+
+
+# ---------------------------------------------------------------------------
+# HTTP server: /metrics + /healthz
+# ---------------------------------------------------------------------------
+
+
+async def _http_get(port: int, path: str) -> tuple[int, str]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"GET {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body.decode("utf-8")
+
+
+def test_healthz_fresh_vs_stale_and_metrics_route():
+    reg = MetricsRegistry()
+    reg.counter("g_srv_total", "doc").inc()
+    health = {"status": "ok", "heartbeat_age_s": 1.0}
+
+    async def go():
+        server = MetricsServer(
+            registry=reg, health_fn=lambda: dict(health), port=0,
+            host="127.0.0.1",
+        )
+        port = await server.start()
+        try:
+            status, body = await _http_get(port, "/healthz")
+            assert status == 200
+            fresh = json.loads(body)
+            assert fresh["status"] == "ok"
+            assert fresh["heartbeat_age_s"] == 1.0
+
+            # degraded stays HTTP 200: the engine is alive, only its
+            # heartbeat WRITES are failing — a restart fixes nothing
+            health["status"] = "degraded"
+            status, body = await _http_get(port, "/healthz")
+            assert status == 200
+            assert json.loads(body)["status"] == "degraded"
+
+            health["status"] = "stale"
+            health["heartbeat_age_s"] = 9999.0
+            status, body = await _http_get(port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["status"] == "stale"
+
+            status, body = await _http_get(port, "/metrics")
+            assert status == 200
+            assert "g_srv_total 1" in body
+            assert_prometheus_grammar(body)
+
+            status, _ = await _http_get(port, "/nope")
+            assert status == 404
+        finally:
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_healthz_crashing_health_fn_is_503_not_fatal():
+    async def go():
+        server = MetricsServer(
+            registry=MetricsRegistry(),
+            health_fn=lambda: 1 / 0,
+            port=0,
+            host="127.0.0.1",
+        )
+        port = await server.start()
+        try:
+            status, body = await _http_get(port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["status"] == "error"
+        finally:
+            await server.stop()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    log.tick = 7
+    first = log.emit("ws_reconnect", exchange="binance", client=2,
+                     error="boom", backoff_s=1.0)
+    log.emit("signal", strategy="grid_ladder", symbol="BTCUSDT")
+    log.close()
+    assert first is not None and first["seq"] == 1
+
+    records = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [r["event"] for r in records] == ["ws_reconnect", "signal"]
+    for r in records:
+        # the stamped schema: kind, wall + monotonic time, seq, tick
+        assert set(r) >= {"event", "ts", "mono", "seq", "tick"}
+        assert r["tick"] == 7
+        assert abs(r["ts"] - time.time()) < 60
+    assert records[0]["seq"] == 1 and records[1]["seq"] == 2
+    assert records[1]["mono"] >= records[0]["mono"]
+    assert records[0]["exchange"] == "binance"
+    assert records[1]["symbol"] == "BTCUSDT"
+
+
+def test_event_log_rotation(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    log = EventLog(path, max_bytes=200, backups=1)
+    for i in range(50):
+        log.emit("tickmark", i=i)
+    log.close()
+    rotated = tmp_path / "ev.jsonl.1"
+    assert rotated.exists(), "rotation must shift the full file to .1"
+    # no line is ever split across the rotation boundary
+    for f in (path, rotated):
+        for ln in f.read_text().splitlines():
+            json.loads(ln)
+
+
+def test_event_log_disabled_is_noop():
+    log = EventLog(None)
+    assert log.emit("anything", x=1) is None
+
+
+def test_event_log_never_raises(tmp_path):
+    log = EventLog(tmp_path / "ev.jsonl")
+    # an unserializable payload falls back to str() via default=str
+    rec = log.emit("weird", obj=object())
+    assert rec is not None
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# healthcheck.py probe
+# ---------------------------------------------------------------------------
+
+
+def test_healthcheck_file_max_age_env(monkeypatch, tmp_path):
+    hb = tmp_path / "hb"
+    hb.write_text(str(time.time() - 100))
+    monkeypatch.setenv("BQT_HEARTBEAT_PATH", str(hb))
+    monkeypatch.delenv("BQT_METRICS_PORT", raising=False)
+    monkeypatch.setenv("BQT_HEARTBEAT_MAX_AGE", "1000")
+    assert healthcheck.main() == 0
+    monkeypatch.setenv("BQT_HEARTBEAT_MAX_AGE", "50")
+    assert healthcheck.main() == 1
+    hb.unlink()
+    assert healthcheck.main() == 1
+
+
+def _serve_in_thread(health_fn):
+    """Run a MetricsServer on a background thread's event loop; returns
+    (port, stop_fn). Lets the synchronous healthcheck probe hit it."""
+    loop = asyncio.new_event_loop()
+    server = MetricsServer(
+        registry=MetricsRegistry(), health_fn=health_fn, port=0,
+        host="127.0.0.1",
+    )
+    port = loop.run_until_complete(server.start())
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    def stop():
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(5)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(5)
+        loop.close()
+
+    return port, stop
+
+
+def test_healthcheck_prefers_healthz(monkeypatch, tmp_path):
+    health = {"status": "ok"}
+    port, stop = _serve_in_thread(lambda: dict(health))
+    try:
+        monkeypatch.setenv("BQT_METRICS_PORT", str(port))
+        # no heartbeat file at all: /healthz verdict is authoritative
+        monkeypatch.setenv("BQT_HEARTBEAT_PATH", str(tmp_path / "absent"))
+        assert healthcheck.main() == 0
+        # degraded = alive-but-impaired: the probe must NOT kill the engine
+        health["status"] = "degraded"
+        assert healthcheck.main() == 0
+        # stale /healthz (503) wins even with a FRESH heartbeat file
+        health["status"] = "stale"
+        fresh = tmp_path / "fresh"
+        fresh.write_text(str(time.time()))
+        monkeypatch.setenv("BQT_HEARTBEAT_PATH", str(fresh))
+        assert healthcheck.main() == 1
+    finally:
+        stop()
+    # exporter down: falls back to the (fresh) file
+    assert healthcheck.main() == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: instrumented replay pipeline scraped in-process
+# ---------------------------------------------------------------------------
+
+CAP, WIN = 16, 130  # shared suite shape — tick_step compile cache hit
+
+
+def _sample_value(body: str, name: str, labels: str = "") -> float | None:
+    target = f"{name}{labels} "
+    for line in body.splitlines():
+        if line.startswith(target):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_obs_smoke_scrape_replay_tick(tmp_path):
+    """The acceptance gate: run replay ticks through the production
+    SignalEngine with the exporter up, GET /metrics in-process, and assert
+    the catalogue's core families are present — with the tick counter,
+    stage histograms, queue gauge, and recompile counter non-zero."""
+    from binquant_tpu.io.replay import (
+        generate_replay_file,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+
+    path = tmp_path / "rp.jsonl"
+    generate_replay_file(path, n_symbols=8, n_ticks=6)
+    engine = make_stub_engine(capacity=CAP, window=WIN, pipeline_depth=0)
+    by_tick = load_klines_by_tick(path)
+
+    async def go() -> tuple[str, int, dict]:
+        server = MetricsServer(
+            health_fn=lambda: engine.health_snapshot(max_age_s=1500),
+            port=0,
+            host="127.0.0.1",
+        )
+        port = await server.start()
+        try:
+            for bucket in sorted(by_tick):
+                for k in sorted(by_tick[bucket], key=lambda k: k["open_time"]):
+                    engine.ingest(k)
+                await engine.process_tick(now_ms=(bucket + 1) * 900 * 1000)
+            await engine.flush_pending()
+            status, body = await _http_get(port, "/metrics")
+            hz_status, hz_body = await _http_get(port, "/healthz")
+            return body, status, {"status": hz_status, "body": hz_body}
+        finally:
+            await server.stop()
+
+    body, status, hz = asyncio.run(go())
+    assert status == 200
+    assert_prometheus_grammar(body)
+
+    # non-zero core families (global registry: >= covers prior tests)
+    assert _sample_value(body, "bqt_ticks_total") >= 6
+    count = _sample_value(
+        body, "bqt_stage_latency_ms_count", '{stage="tick_total"}'
+    )
+    assert count and count >= 6
+    for stage in ("device_dispatch", "wire_fetch", "emission", "ingest_drain"):
+        assert f'bqt_stage_latency_ms_bucket{{stage="{stage}"' in body
+    recompiles = _sample_value(
+        body, "bqt_jit_recompiles_total", '{fn="tick_step_wire"}'
+    )
+    assert recompiles and recompiles >= 1
+    assert _sample_value(body, "bqt_queue_depth", '{queue="batcher15"}') is not None
+    assert _sample_value(body, "bqt_registry_symbols") >= 8
+
+    # the full catalogue is always exposed, used or not
+    for family, kind in (
+        ("bqt_ws_reconnects_total", "counter"),
+        ("bqt_ws_frames_total", "counter"),
+        ("bqt_sink_emissions_total", "counter"),
+        ("bqt_signals_total", "counter"),
+        ("bqt_wire_overflow_ticks_total", "counter"),
+        ("bqt_heartbeat_write_failures_total", "counter"),
+        ("bqt_symbols_per_tick", "gauge"),
+        ("bqt_binbot_requests_total", "counter"),
+        ("bqt_autotrade_refusals_total", "counter"),
+        ("bqt_checkpoint_saves_total", "counter"),
+        ("bqt_ingest_dedup_overwrites_total", "counter"),
+        ("bqt_registry_capacity_errors_total", "counter"),
+    ):
+        assert f"# TYPE {family} {kind}" in body, family
+
+    # /healthz: the engine just ticked and wrote its heartbeat
+    assert hz["status"] == 200
+    payload = json.loads(hz["body"])
+    assert payload["status"] == "ok"
+    assert payload["ticks_processed"] >= 6
+    assert payload["heartbeat_age_s"] is not None
+
+
+def test_health_snapshot_degrades_on_heartbeat_failure(tmp_path):
+    """touch_heartbeat failure path: counter + degraded /healthz payload,
+    with the log warning rate-limited instead of per-tick."""
+    import logging
+
+    from binquant_tpu.io.replay import make_stub_engine
+
+    engine = make_stub_engine(capacity=CAP, window=WIN)
+    engine.heartbeat_path = tmp_path  # a DIRECTORY: write_text -> OSError
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture()
+    logging.getLogger().addHandler(handler)
+    try:
+        for _ in range(5):
+            engine.touch_heartbeat()
+    finally:
+        logging.getLogger().removeHandler(handler)
+
+    assert engine.heartbeat_write_failures == 5
+    warned = [r for r in records if "heartbeat" in r.getMessage()]
+    assert len(warned) == 1, "warning must be rate-limited, not per-tick"
+
+    snap = engine.health_snapshot(max_age_s=1500)
+    assert snap["status"] == "stale"  # never wrote successfully
+    assert snap["heartbeat_write_failures"] == 5
+
+    # a success then failures => degraded (alive but liveness file is lying)
+    engine.heartbeat_path = tmp_path / "hb"
+    engine.touch_heartbeat()
+    assert engine.health_snapshot(1500)["status"] == "ok"
+    engine.heartbeat_path = tmp_path
+    engine.touch_heartbeat()
+    assert engine.health_snapshot(1500)["status"] == "degraded"

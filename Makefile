@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -10,7 +10,11 @@ help:
 	@echo "  config4    - context scoring x 4 timeframes bench"
 	@echo "  obs-smoke  - one replay run with the /metrics exporter up;"
 	@echo "               asserts the core metric families are present and"
-	@echo "               non-zero (tier-1 test, tests/test_obs.py)"
+	@echo "               non-zero, incl. the incremental-path fallback"
+	@echo "               counter bqt_full_recompute_total (tier-1 test,"
+	@echo "               tests/test_obs.py)"
+	@echo "  incr-smoke - fast CPU smoke of the incremental indicator path"
+	@echo "               (step parity + pipeline gating, tier-1 lane)"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run"
 	@echo "  lint       - ruff check"
 	@echo "offline kernel profiling: tools/profile_stages.py captures"
@@ -27,6 +31,9 @@ smoke:
 
 obs-smoke:
 	python -m pytest tests/test_obs.py -q -m "not slow" -k "obs_smoke or healthz"
+
+incr-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_incremental.py -q -m "not slow"
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

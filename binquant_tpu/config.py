@@ -168,6 +168,22 @@ class Config:
         return int(self._get("BQT_MESH_DEVICES", "0") or 0)
 
     @cached_property
+    def incremental_enabled(self) -> bool:
+        """Incremental indicator fast path: advance carried EMA/Wilder/
+        rolling-sum state by the newest bar instead of recomputing full
+        400-bar windows every tick (BQT_INCREMENTAL=0 forces the full
+        recompute on every tick)."""
+        return self._get("BQT_INCREMENTAL", "1") != "0"
+
+    @cached_property
+    def carry_audit_every_ticks(self) -> int:
+        """Drift audit cadence for the incremental path: every N processed
+        ticks the engine dispatches a FULL recompute, which re-anchors the
+        carried indicator state from the windows and bounds f32
+        accumulation drift. 0 disables the audit."""
+        return int(self._get("BQT_CARRY_AUDIT_EVERY", "256") or "256")
+
+    @cached_property
     def heartbeat_path(self) -> str:
         return self._get("BQT_HEARTBEAT_PATH", "/tmp/binquant_tpu.heartbeat")
 

@@ -49,7 +49,13 @@ from binquant_tpu.strategies.dormant import (
     supertrend_swing_reversal,
     twap_momentum_sniper,
 )
-from binquant_tpu.strategies.features import FeaturePack, compute_feature_pack
+from binquant_tpu.strategies.features import (
+    FeatureCarry,
+    FeaturePack,
+    compute_feature_pack,
+    empty_feature_carry,
+    init_feature_carry,
+)
 from binquant_tpu.strategies.ladder_deployer import ladder_deployer
 from binquant_tpu.strategies.liquidation_sweep_pump import liquidation_sweep_pump
 from binquant_tpu.strategies.mean_reversion_fade import mean_reversion_fade
@@ -61,6 +67,21 @@ from binquant_tpu.strategies.spike_hunter import SpikeSignal, detect_spikes
 MIN_BARS = 100
 
 
+class IndicatorCarry(NamedTuple):
+    """Per-timeframe incremental indicator state (ops/incremental.py).
+
+    Rebuilt from the windows by every FULL tick (``init_indicator_carry``),
+    advanced in O(1) bytes per symbol by the incremental tick. The beta/
+    corr and supertrend carries defined in ops/incremental.py are NOT
+    resident here yet: the wire path DCEs btc-beta entirely and the
+    supertrend consumer is a dormant strategy — they join when a live
+    consumer does.
+    """
+
+    pack5: FeatureCarry
+    pack15: FeatureCarry
+
+
 class EngineState(NamedTuple):
     """Device-resident pytree carried across ticks."""
 
@@ -69,6 +90,7 @@ class EngineState(NamedTuple):
     regime_carry: RegimeCarry
     mrf_last_emitted: jnp.ndarray  # (S,) int32 — MeanReversionFade dedupe
     pt_last_signal_close: jnp.ndarray  # (S,) int32 — PriceTracker cooldown
+    indicator_carry: IndicatorCarry
 
 
 class HostInputs(NamedTuple):
@@ -327,6 +349,17 @@ def initial_engine_state(
         regime_carry=initial_regime_carry(num_symbols),
         mrf_last_emitted=jnp.full((num_symbols,), -1, dtype=jnp.int32),
         pt_last_signal_close=jnp.full((num_symbols,), -1, dtype=jnp.int32),
+        indicator_carry=IndicatorCarry(
+            pack5=empty_feature_carry(num_symbols),
+            pack15=empty_feature_carry(num_symbols),
+        ),
+    )
+
+
+def init_indicator_carry(buf5: MarketBuffer, buf15: MarketBuffer) -> IndicatorCarry:
+    """Carry rebuilt from both windows (what every full tick emits)."""
+    return IndicatorCarry(
+        pack5=init_feature_carry(buf5), pack15=init_feature_carry(buf15)
     )
 
 
@@ -345,6 +378,8 @@ def _tick_step_impl(
     cfg: ContextConfig = ContextConfig(),
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
     compute_all: bool = True,
+    incremental: bool = False,
+    maintain_carry: bool = True,
 ) -> tuple[EngineState, TickOutputs]:
     """One tick: apply candle updates, rebuild context, evaluate everything.
 
@@ -359,6 +394,21 @@ def _tick_step_impl(
     ~52 → ~21 ms/tick at S=2048×W=400 (bench ``device.step_ms``). The two
     carry-owning kernels (PriceTracker, MeanReversionFade) always run so
     the device dedupe state advances identically in both variants.
+
+    ``incremental=True`` (static) is the FAST PATH: feature packs and the
+    context's per-symbol features are read from the carried indicator
+    state advanced by the newest bar (O(1) bytes per symbol) instead of
+    recomputed from the full windows. Valid only when every update since
+    the last full tick was a clean single-bar append — the HOST decides
+    (io/pipeline.py) and falls back to incremental=False on cold start,
+    mid-history rewrites, backfill folds, and every N ticks as a drift
+    audit. The full path re-initializes the carry from the windows, so one
+    full tick resynchronizes everything — unless ``maintain_carry=False``
+    (static): deployments that pin the classic path (BQT_INCREMENTAL=0)
+    would otherwise pay a second feature-pack's worth of window reads per
+    tick for dead state XLA cannot DCE (the carry rides the returned
+    EngineState). Never pass False on a tick whose carry a later
+    incremental tick will consume.
     """
     buf5 = apply_updates(state.buf5, *upd5)
     buf15 = apply_updates(state.buf15, *upd15)
@@ -368,6 +418,38 @@ def _tick_step_impl(
     fresh5 = fresh_mask(buf5, inputs.timestamp5_s)
     fresh15 = fresh_mask(buf15, inputs.timestamp_s)
 
+    if incremental:
+        from binquant_tpu.regime.context import symbol_features_from_carry
+        from binquant_tpu.strategies.features import (
+            advance_feature_carry,
+            feature_pack_from_carry,
+        )
+
+        carry5, stale5 = advance_feature_carry(
+            buf5, state.indicator_carry.pack5
+        )
+        carry15, stale15 = advance_feature_carry(
+            buf15, state.indicator_carry.pack15
+        )
+        pack5 = feature_pack_from_carry(buf5, carry5, stale5)
+        pack15 = feature_pack_from_carry(buf15, carry15, stale15)
+        feats15 = symbol_features_from_carry(
+            buf15, carry15, fresh15 & inputs.tracked, stale15
+        )
+        indicator_carry = IndicatorCarry(pack5=carry5, pack15=carry15)
+    else:
+        pack5 = compute_feature_pack(buf5)
+        pack15 = compute_feature_pack(buf15)
+        feats15 = None
+        # full recompute re-anchors the carry from the updated windows —
+        # the resync every fallback/audit tick provides for free; skipped
+        # (passthrough) when the caller will never consume it
+        indicator_carry = (
+            init_indicator_carry(buf5, buf15)
+            if maintain_carry
+            else state.indicator_carry
+        )
+
     context, regime_carry = compute_market_context(
         buf15,
         fresh15,
@@ -376,11 +458,10 @@ def _tick_step_impl(
         inputs.timestamp_s,
         state.regime_carry,
         cfg,
+        feats=feats15,
     )
     long_gate = allows_long_autotrade_mask(context)
 
-    pack5 = compute_feature_pack(buf5)
-    pack15 = compute_feature_pack(buf15)
     spikes = detect_spikes(buf15)
 
     # --- BTC-relative metrics (context_evaluator.py:144-184, 415-418)
@@ -575,6 +656,7 @@ def _tick_step_impl(
         regime_carry=regime_carry,
         mrf_last_emitted=mrf_carry,
         pt_last_signal_close=pt_carry,
+        indicator_carry=indicator_carry,
     )
     strategies = {
         "activity_burst_pump": abp,
@@ -751,7 +833,10 @@ def _tick_step_impl(
 
 
 tick_step = partial(
-    jax.jit, static_argnames=("cfg", "wire_enabled", "compute_all")
+    jax.jit,
+    static_argnames=(
+        "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry"
+    ),
 )(_tick_step_impl)
 
 
@@ -762,6 +847,8 @@ def _tick_step_wire_impl(
     inputs: HostInputs,
     cfg: ContextConfig = ContextConfig(),
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+    incremental: bool = False,
+    maintain_carry: bool = True,
 ) -> tuple[EngineState, jnp.ndarray]:
     """The live engine's step: identical evaluation, but only the wire
     leaves the computation. The full ``TickOutputs`` pytree is ~400 output
@@ -776,14 +863,23 @@ def _tick_step_wire_impl(
     device shouldn't pay for them (9 dormant kernels at the default live
     set)."""
     new_state, outputs = _tick_step_impl(
-        state, upd5, upd15, inputs, cfg, wire_enabled, compute_all=False
+        state,
+        upd5,
+        upd15,
+        inputs,
+        cfg,
+        wire_enabled,
+        compute_all=False,
+        incremental=incremental,
+        maintain_carry=maintain_carry,
     )
     return new_state, outputs.wire
 
 
-tick_step_wire = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
-    _tick_step_wire_impl
-)
+tick_step_wire = partial(
+    jax.jit,
+    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+)(_tick_step_wire_impl)
 
 # Bench/throughput variant: donates the carried EngineState so the ring
 # buffers update in place instead of allocating+copying ~66 MB per tick.
@@ -793,7 +889,9 @@ tick_step_wire = partial(jax.jit, static_argnames=("cfg", "wire_enabled"))(
 # state) requires the old state to survive a tick that throws mid-flight.
 tick_step_donated = jax.jit(
     _tick_step_impl,
-    static_argnames=("cfg", "wire_enabled", "compute_all"),
+    static_argnames=(
+        "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry"
+    ),
     donate_argnums=(0,),
 )
 
@@ -811,10 +909,38 @@ def apply_updates_step(
     step and the full ``tick_step`` evaluates ONCE on the final state —
     evaluating per sub-batch would advance device-side dedupe carries and
     discard the earlier sub-batches' signals.
+
+    Leaves the indicator carry UNTOUCHED (desynced): callers on the
+    incremental path use :func:`apply_updates_carry_step` instead, or mark
+    the carry desynced so the next tick runs the full recompute.
     """
     return state._replace(
         buf5=apply_updates(state.buf5, *upd5),
         buf15=apply_updates(state.buf15, *upd15),
+    )
+
+
+@jax.jit
+def apply_updates_carry_step(
+    state: EngineState,
+    upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    upd15: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+) -> EngineState:
+    """Sub-batch fold that ALSO advances the indicator carry (O(1) bytes
+    per symbol on top of the buffer scatter). Used for ordered catch-up
+    replay of clean strictly-newer appends so a multi-bar drain — e.g.
+    three 5m bars landing in one 15m tick — stays on the incremental path
+    instead of desyncing the carry."""
+    from binquant_tpu.strategies.features import advance_feature_carry
+
+    buf5 = apply_updates(state.buf5, *upd5)
+    buf15 = apply_updates(state.buf15, *upd15)
+    carry5, _ = advance_feature_carry(buf5, state.indicator_carry.pack5)
+    carry15, _ = advance_feature_carry(buf15, state.indicator_carry.pack15)
+    return state._replace(
+        buf5=buf5,
+        buf15=buf15,
+        indicator_carry=IndicatorCarry(pack5=carry5, pack15=carry15),
     )
 
 
@@ -857,7 +983,9 @@ _DISPATCH_SIGNATURES: set[tuple] = set()
 
 
 def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
-                     fn: str = "tick_step_wire") -> bool:
+                     fn: str = "tick_step_wire",
+                     incremental: bool = False,
+                     maintain_carry: bool = True) -> bool:
     """Record per-dispatch telemetry; True when this signature is new
     (i.e. the launch below it will trace+compile)."""
     import numpy as np
@@ -873,6 +1001,8 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
     )
     signature = (
         fn,
+        bool(incremental),
+        bool(maintain_carry),
         tuple(state.buf5.times.shape),
         tuple(state.buf15.times.shape),
         tuple(np.asarray(upd5[0]).shape),
@@ -887,8 +1017,9 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
     get_event_log().emit(
         "jit_compile",
         fn=fn,
-        update5_rows=signature[3][0],
-        update15_rows=signature[4][0],
+        incremental=bool(incremental),
+        update5_rows=signature[5][0],
+        update15_rows=signature[6][0],
         wire_enabled=list(wire_enabled),
     )
     return True

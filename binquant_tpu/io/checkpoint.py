@@ -26,7 +26,12 @@ from pathlib import Path
 
 import numpy as np
 
-CKPT_VERSION = 1
+# v2: EngineState grew the incremental IndicatorCarry (engine/step.py) —
+# its leaves append AFTER the v1 leaves in tree order, so a v1 archive
+# restores by filling the leading leaves and keeping the template's empty
+# carry; the engine then rebuilds it from the windows on the first tick
+# (load returns ``_carry_rebuilt`` in host_carries).
+CKPT_VERSION = 2
 
 
 def save_state(
@@ -70,16 +75,32 @@ def load_state(path: str | Path, template_state, registry):
 
     with np.load(path) as data:
         meta = json.loads(bytes(data["__meta"].tobytes()).decode())
-        if meta["version"] != CKPT_VERSION:
+        if meta["version"] not in (1, CKPT_VERSION):
             raise ValueError(f"checkpoint version {meta['version']} unsupported")
         t_leaves, treedef = jax.tree_util.tree_flatten(template_state)
-        if meta["n_leaves"] != len(t_leaves):
+        migrated = meta["version"] < CKPT_VERSION
+        if migrated:
+            # v1 predates the indicator carry, whose leaves sit at the END
+            # of the EngineState flatten order (it is the last field): the
+            # archive must cover exactly the non-carry prefix; the carry
+            # keeps the template's empty state and is rebuilt from the
+            # windows by the first (full-recompute) tick.
+            n_carry = len(
+                jax.tree_util.tree_leaves(template_state.indicator_carry)
+            )
+            expected = len(t_leaves) - n_carry
+        else:
+            expected = len(t_leaves)
+        if meta["n_leaves"] != expected:
             raise ValueError(
                 f"checkpoint has {meta['n_leaves']} leaves, "
-                f"engine expects {len(t_leaves)}"
+                f"engine expects {expected}"
             )
         leaves = []
         for i, t in enumerate(t_leaves):
+            if i >= meta["n_leaves"]:
+                leaves.append(np.asarray(t))  # template carry leaf (v1)
+                continue
             arr = data[f"leaf_{i}"]
             if tuple(arr.shape) != tuple(np.shape(t)):
                 raise ValueError(
@@ -93,7 +114,10 @@ def load_state(path: str | Path, template_state, registry):
         treedef, [jnp.asarray(a) for a in leaves]
     )
     registry.restore(meta["registry"])
-    return state, meta.get("host_carries", {})
+    carries = dict(meta.get("host_carries", {}))
+    if migrated:
+        carries["_carry_rebuilt"] = True
+    return state, carries
 
 
 class CheckpointManager:
@@ -152,6 +176,13 @@ class CheckpointManager:
             state = shard_engine_state(state, engine.mesh)
         engine.state = state
         engine.restore_host_carries(carries)
+        if hasattr(engine, "note_state_restored"):
+            # refresh the host-side latest-ts mirror and carry sync state
+            # (a migrated v1 restore forces one full-recompute tick, which
+            # rebuilds the indicator carry from the restored windows)
+            engine.note_state_restored(
+                migrated=bool(carries.get("_carry_rebuilt", False))
+            )
         from binquant_tpu.obs.events import get_event_log
 
         get_event_log().emit(

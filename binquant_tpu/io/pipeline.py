@@ -31,6 +31,7 @@ import numpy as np
 from binquant_tpu.config import Config
 from binquant_tpu.engine.buffer import IngestBatcher, SymbolRegistry
 from binquant_tpu.engine.step import (
+    apply_updates_carry_step,
     apply_updates_step,
     default_host_inputs,
     initial_engine_state,
@@ -53,6 +54,7 @@ from binquant_tpu.io.metrics import LatencyTracker
 from binquant_tpu.io.telegram import TelegramConsumer
 from binquant_tpu.obs.events import get_event_log
 from binquant_tpu.obs.instruments import (
+    FULL_RECOMPUTE,
     HEARTBEAT_FAILURES,
     OVERFLOW_TICKS,
     QUEUE_DEPTH,
@@ -373,6 +375,29 @@ class SignalEngine:
         self._scalar_cache: dict[str, tuple[Any, Any]] = {}
         self._tracked_cache: tuple[int, Any] | None = None
         self._nan_oi_cache: Any = None
+        # -- incremental indicator fast path (engine/step.py incremental=True)
+        # The host decides per tick: carried state is only valid when every
+        # update since the last full recompute was a clean strictly-newer
+        # single-bar append. Cold start, mid-history rewrites, backfill
+        # folds, registry churn, and the periodic drift audit all route the
+        # tick to the full step (counted in bqt_full_recompute_total),
+        # which re-anchors the carry from the windows.
+        self.incremental = bool(getattr(config, "incremental_enabled", True))
+        self.carry_audit_every = int(
+            getattr(config, "carry_audit_every_ticks", 256) or 0
+        )
+        # why the carry is desynced (None = synced); seeded as cold start
+        self._carry_desync_reason: str | None = "cold_start"
+        # last applied open-time per registry row, per interval — the
+        # host-side mirror that detects rewrites/out-of-order deliveries
+        # without a device fetch
+        self._host_latest: dict[str, np.ndarray] = {
+            "5m": np.full(self.capacity, -1, dtype=np.int64),
+            "15m": np.full(self.capacity, -1, dtype=np.int64),
+        }
+        # exact counters surfaced by health_snapshot / tests
+        self.incremental_ticks = 0
+        self.full_recompute_ticks = 0
 
     # -- ingest -------------------------------------------------------------
 
@@ -403,17 +428,23 @@ class SignalEngine:
             np.zeros((0, 10), np.float32), size=4,
         )
 
-    def _fold_updates(self, batches5: list, batches15: list):
+    def _fold_updates(self, batches5: list, batches15: list, advance_carry: bool = False):
         """Apply all but the FINAL sub-batch pair with the cheap
         update-only step (ordered sub-batch replay — evaluating each would
         advance dedupe carries and discard earlier signals); returns the
-        final (upd5, upd15) pair for the caller to apply or evaluate."""
+        final (upd5, upd15) pair for the caller to apply or evaluate.
+
+        ``advance_carry=True`` folds with the carry-advancing step so a
+        multi-bar drain of clean appends (e.g. three 5m bars per 15m tick)
+        keeps the incremental indicator state in sync — only valid when
+        the caller verified every sub-batch is a strictly-newer append."""
+        fold = apply_updates_carry_step if advance_carry else apply_updates_step
         empty = self._empty_updates()
         upd5 = [pad_updates(*b) for b in batches5] or [empty]
         upd15 = [pad_updates(*b) for b in batches15] or [empty]
         n = max(len(upd5), len(upd15))
         for i in range(n - 1):
-            self.state = apply_updates_step(
+            self.state = fold(
                 self.state,
                 upd5[i] if i < len(upd5) else empty,
                 upd15[i] if i < len(upd15) else empty,
@@ -423,10 +454,45 @@ class SignalEngine:
             upd15[n - 1] if n - 1 < len(upd15) else empty,
         )
 
+    def _note_applied(self, batches5: list, batches15: list) -> bool:
+        """Update the host-side per-row latest-open-time mirror with the
+        sub-batches about to be applied; returns True when EVERY update is
+        a clean strictly-newer append (carry-advance safe). Must be called
+        exactly once per drained batch set, in apply order."""
+        clean = True
+        for key, batches in (("5m", batches5), ("15m", batches15)):
+            latest = self._host_latest[key]
+            for rows, ts, _ in batches:
+                if len(rows) == 0:
+                    continue
+                rows = np.asarray(rows, dtype=np.int64)
+                ts64 = np.asarray(ts, dtype=np.int64)
+                ok = (rows >= 0) & (rows < self.capacity)
+                rows, ts64 = rows[ok], ts64[ok]
+                if np.any(ts64 <= latest[rows]):
+                    clean = False
+                np.maximum.at(latest, rows, ts64)
+        return clean
+
     def _flush_batchers(self) -> None:
-        """Drain both batchers into the device buffers (update-only)."""
-        u5, u15 = self._fold_updates(self.batcher5.drain(), self.batcher15.drain())
+        """Drain both batchers into the device buffers (update-only).
+
+        Used by backfill: the carry is NOT advanced here (hundreds of bars
+        fold in), so the next evaluated tick runs the full recompute,
+        which re-anchors it from the final windows."""
+        batches5, batches15 = self.batcher5.drain(), self.batcher15.drain()
+        if batches5 or batches15:
+            self._note_applied(batches5, batches15)
+            self._mark_carry_desynced("backfill")
+        u5, u15 = self._fold_updates(batches5, batches15)
         self.state = apply_updates_step(self.state, u5, u15)
+
+    def _mark_carry_desynced(self, reason: str) -> None:
+        """Record that the carried indicator state no longer matches the
+        windows; the next tick dispatches the full recompute (which
+        resyncs). First reason wins until a full tick clears it."""
+        if self._carry_desync_reason is None:
+            self._carry_desync_reason = reason
 
     def backfill(
         self,
@@ -669,6 +735,14 @@ class SignalEngine:
             QUEUE_DEPTH.labels(queue="batcher15").set(len(self.batcher15))
             batches5 = self.batcher5.drain()
             batches15 = self.batcher15.drain()
+            # incremental-path eligibility: every update this tick must be
+            # a clean strictly-newer append, judged against the host-side
+            # latest-ts mirror (a mid-history rewrite is invisible to the
+            # device-side carry — the window's interior changes without
+            # the latest bar moving)
+            clean_appends = self._note_applied(batches5, batches15)
+            if not clean_appends:
+                self._mark_carry_desynced("rewrite")
             # OI growth for symbols with fresh 15m candles (reference
             # cadence). Cache-only reads: the background refresh_forever
             # loop owns the REST traffic — a 15m boundary with 2000 fresh
@@ -702,9 +776,36 @@ class SignalEngine:
         _btc = self.registry.row_of(self.btc_symbol)
         btc_row = -1 if _btc is None else int(_btc)
 
+        # Resolve this tick's evaluation path. The drift audit fires on the
+        # engine's own tick counter so replay determinism is preserved
+        # (same stream → same audit ticks).
+        audit_due = (
+            self.carry_audit_every > 0
+            and self.ticks_processed > 0
+            and self.ticks_processed % self.carry_audit_every == 0
+        )
+        if not self.incremental:
+            use_incremental, reason = False, None
+        elif self._carry_desync_reason is not None:
+            use_incremental, reason = False, self._carry_desync_reason
+        elif audit_due:
+            use_incremental, reason = False, "audit"
+        else:
+            use_incremental, reason = True, None
+        if self.incremental:
+            if use_incremental:
+                self.incremental_ticks += 1
+            else:
+                self.full_recompute_ticks += 1
+                FULL_RECOMPUTE.labels(reason=reason).inc()
+
         # Ordered sub-batch replay: fold all but the FINAL sub-batch into
         # the buffers, then run ONE full evaluation on the final state.
-        u5, u15 = self._fold_updates(batches5, batches15)
+        # On the fast path the folds advance the carry too, so multi-bar
+        # clean-append drains stay incremental.
+        u5, u15 = self._fold_updates(
+            batches5, batches15, advance_carry=use_incremental
+        )
         t_inputs0 = time.perf_counter()
         if self._base_inputs is None:
             self._base_inputs = default_host_inputs(self.capacity)
@@ -782,6 +883,8 @@ class SignalEngine:
             observe_dispatch(
                 prev_state, u5, u15, self._wire_enabled_key(),
                 cfg=self.context_config,
+                incremental=use_incremental,
+                maintain_carry=self.incremental,
             )
             self.state, wire = tick_step_wire(
                 prev_state,
@@ -791,7 +894,14 @@ class SignalEngine:
                 self.context_config,
                 # device-side wire compaction must match the host's enabled set
                 wire_enabled=self._wire_enabled_key(),
+                incremental=use_incremental,
+                # classic-path deployments (BQT_INCREMENTAL=0) never read
+                # the carry — skip its full-window re-init entirely
+                maintain_carry=self.incremental,
             )
+            if not use_incremental:
+                # the full step re-initialized the carry from the windows
+                self._carry_desync_reason = None
             # start the wire's D2H immediately; by the time this tick is
             # finalized (depth ticks later) the transfer has landed and the
             # host-side np.asarray is a copy, not a round trip
@@ -806,10 +916,18 @@ class SignalEngine:
         # per in-flight tick (~0.4% of a v5e's HBM at depth 1; scale depth
         # with that in mind).
         cfg, key = self.context_config, self._wire_enabled_key()
+        # the fallback re-evaluates with the SAME static variant the wire
+        # step ran: full-window vs carried readouts differ by f32 epsilon,
+        # and an overflow tick's emitted set must match the stream the
+        # incremental path certified
+        incr_args = (use_incremental, self.incremental)
 
-        def fallback(_args=(prev_state, u5, u15, inputs, cfg, key)):
-            st, upd5, upd15, inp, cfg_, key_ = _args
-            _, full = tick_step(st, upd5, upd15, inp, cfg_, wire_enabled=key_)
+        def fallback(_args=(prev_state, u5, u15, inputs, cfg, key, incr_args)):
+            st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = _args
+            _, full = tick_step(
+                st, upd5, upd15, inp, cfg_, wire_enabled=key_,
+                incremental=incr_, maintain_carry=maint_,
+            )
             return full
 
         # Pre-warm the fallback's jit cache in the background the first
@@ -821,15 +939,18 @@ class SignalEngine:
         # (skipped under CI/replay stubs — a surprise compile there only
         # costs a test second, and the suite would otherwise pay a full
         # background compile per stub engine)
-        warm_sig = (key, u5[0].shape, u15[0].shape)
+        warm_sig = (key, u5[0].shape, u15[0].shape, incr_args)
         if not self.config.is_ci and warm_sig not in self._fallback_warmed:
             self._fallback_warmed.add(warm_sig)
             import threading
 
-            def _warm(args=(prev_state, u5, u15, inputs, cfg, key)):
+            def _warm(args=(prev_state, u5, u15, inputs, cfg, key, incr_args)):
                 try:
-                    st, upd5, upd15, inp, cfg_, key_ = args
-                    tick_step(st, upd5, upd15, inp, cfg_, wire_enabled=key_)
+                    st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = args
+                    tick_step(
+                        st, upd5, upd15, inp, cfg_, wire_enabled=key_,
+                        incremental=incr_, maintain_carry=maint_,
+                    )
                 except Exception:
                     logging.exception("fallback pre-warm failed (non-fatal)")
 
@@ -1093,11 +1214,18 @@ class SignalEngine:
             return 0
         for sym, _ in stale:
             self.registry.remove(sym)
-        rows = jnp.asarray(np.array([row for _, row in stale], np.int32))
+        rows_np = np.array([row for _, row in stale], np.int32)
+        rows = jnp.asarray(rows_np)
         self.state = self.state._replace(
             buf5=reset_rows(self.state.buf5, rows),
             buf15=reset_rows(self.state.buf15, rows),
         )
+        # cleared rows can be reclaimed by NEW symbols whose first append
+        # the stale per-row carry would misread — force one full recompute
+        # (which re-inits every row's carry) before going incremental again
+        for latest in self._host_latest.values():
+            latest[rows_np] = -1
+        self._mark_carry_desynced("churn")
         logging.info("pruned %d symbols that left the universe", len(stale))
         return len(stale)
 
@@ -1125,6 +1253,29 @@ class SignalEngine:
             ],
             "notifier_last_transition": self.notifier.last_transition_sent,
         }
+
+    def note_state_restored(self, migrated: bool = False) -> None:
+        """Post-checkpoint-restore hook: rebuild the host-side latest-ts
+        mirror from the restored device buffers (one D2H at boot) and set
+        the carry sync state. A v2 restore carries the indicator state in
+        the EngineState pytree (synced); a migrated v1 restore has only the
+        empty template carry — the first tick runs the full recompute."""
+        carry_synced = not migrated
+        for key, buf in (("5m", self.state.buf5), ("15m", self.state.buf15)):
+            latest = np.asarray(buf.times[:, -1]).astype(np.int64)
+            self._host_latest[key] = latest
+            # a v2 archive written by a classic-path deployment
+            # (BQT_INCREMENTAL=0 skips carry maintenance) holds a stale/
+            # empty carry: trust it only if it matches the restored windows
+            carry_ts = np.asarray(
+                getattr(
+                    self.state.indicator_carry,
+                    "pack5" if key == "5m" else "pack15",
+                ).last_ts
+            ).astype(np.int64)
+            if not np.array_equal(carry_ts, latest):
+                carry_synced = False
+        self._carry_desync_reason = None if carry_synced else "cold_start"
 
     def restore_host_carries(self, carries: dict) -> None:
         self.ticks_processed = int(carries.get("ticks_processed", 0))
@@ -1205,6 +1356,11 @@ class SignalEngine:
             "signals_emitted": self.signals_emitted,
             "overflow_ticks": self.overflow_ticks,
             "pending_ticks": len(self._pending),
+            # incremental indicator path health: how often the fast path
+            # actually ran vs fell back to the full-window recompute
+            "incremental_enabled": self.incremental,
+            "incremental_ticks": self.incremental_ticks,
+            "full_recompute_ticks": self.full_recompute_ticks,
         }
 
     # -- loops (main.py:37-57) ------------------------------------------------

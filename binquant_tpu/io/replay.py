@@ -69,8 +69,12 @@ def make_stub_engine(
     pipeline_depth: int = 0,
     enabled_strategies: set[str] | None = None,
     context_config=None,
+    incremental: bool | None = None,
 ):
-    """A SignalEngine wired entirely to stubs (no network)."""
+    """A SignalEngine wired entirely to stubs (no network).
+
+    ``incremental`` overrides the config's BQT_INCREMENTAL default so the
+    A/B harness can pin either evaluation path explicitly."""
     import os
 
     os.environ.setdefault("ENV", "CI")
@@ -89,6 +93,8 @@ def make_stub_engine(
     config = Config()
     config.__dict__["max_symbols"] = capacity
     config.__dict__["window_bars"] = window
+    if incremental is not None:
+        config.__dict__["incremental_enabled"] = bool(incremental)
     binbot_api = BinbotApi("http://stub", session=StubSession(breadth=breadth))
 
     sent: list[str] = []
@@ -159,6 +165,7 @@ def run_replay(
     dominance_is_losers: bool = False,
     market_domination_reversal: bool = False,
     context_config=None,
+    incremental: bool | None = None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -180,6 +187,7 @@ def run_replay(
         pipeline_depth=pipeline_depth,
         enabled_strategies=enabled_strategies,
         context_config=context_config,
+        incremental=incremental,
     )
     # scripted dominance state (reference: attrs on the evaluator/consumer,
     # NEUTRAL/False in production — scriptable here so the dominance-gated
@@ -224,6 +232,11 @@ def run_replay(
     overflow = engine.latency.stats().get("overflow_fallback", {})
     return {
         "ticks": engine.ticks_processed,
+        # incremental indicator path accounting: the A/B parity tests
+        # assert the fast path actually engaged (a vacuously-full run
+        # would not be testing the incremental engine at all)
+        "incremental_ticks": engine.incremental_ticks,
+        "full_recompute_ticks": engine.full_recompute_ticks,
         "signals": fired_total,
         "telegram_messages": len(engine._telegram_sent),  # type: ignore[attr-defined]
         "wall_s": round(wall, 3),
@@ -313,6 +326,7 @@ def run_replay_ab(
     enabled_strategies: set | None = None,
     dominance_is_losers: bool = False,
     market_domination_reversal: bool = False,
+    incremental: bool | None = None,
 ) -> dict:
     """A/B parity: the TPU batch path and the per-symbol pandas oracle run
     the same replay and must emit the identical signal set (SURVEY.md §7
@@ -331,6 +345,7 @@ def run_replay_ab(
         enabled_strategies=enabled_strategies,
         dominance_is_losers=dominance_is_losers,
         market_domination_reversal=market_domination_reversal,
+        incremental=incremental,
     )
     oracle_signals = run_replay_oracle(
         path, window=window, breadth=breadth,

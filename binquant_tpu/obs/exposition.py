@@ -162,8 +162,10 @@ class MetricsServer:
         try:
             request_line = await asyncio.wait_for(reader.readline(), timeout=5)
             parts = request_line.decode("latin-1").split()
-            # drain headers (bounded — a scraper sends a handful of lines)
-            while True:
+            # drain headers, HARD-capped: a slow-drip client feeding one
+            # header line per <5s would otherwise hold this task (and its
+            # socket) open forever — a scraper sends a handful of lines
+            for _ in range(100):
                 line = await asyncio.wait_for(reader.readline(), timeout=5)
                 if line in (b"\r\n", b"\n", b""):
                     break

@@ -51,6 +51,13 @@ HEARTBEAT_FAILURES = REGISTRY.counter(
 
 # -- device step (engine/step.py) -------------------------------------------
 
+FULL_RECOMPUTE = REGISTRY.counter(
+    "bqt_full_recompute_total",
+    "Ticks routed to the full-window recompute while the incremental "
+    "fast path is enabled, by reason (cold_start / rewrite / backfill / "
+    "churn / audit). Full ticks re-anchor the carried indicator state.",
+    labels=("reason",),
+)
 SYMBOLS_PER_TICK = REGISTRY.gauge(
     "bqt_symbols_per_tick",
     "Symbols with fresh candles applied in the last dispatched tick.",

@@ -195,6 +195,106 @@ class SupertrendResult(NamedTuple):
     direction: jnp.ndarray  # +1 uptrend, -1 downtrend
 
 
+def _supertrend_step(
+    carry: tuple,
+    hb: jnp.ndarray,
+    lb_: jnp.ndarray,
+    cb: jnp.ndarray,
+    active: jnp.ndarray,
+    window: int,
+    multiplier: float,
+) -> tuple[tuple, jnp.ndarray, jnp.ndarray]:
+    """ONE bar of the path-dependent supertrend recursion, elementwise over
+    any lane shape. The single copy shared by the full-window scan below
+    and the incremental carry (``ops/incremental.py:supertrend_advance``).
+    Returns (carry', line, direction) with outputs NaN until the ATR
+    recursion is warm."""
+    atr, n_seen, fu, fl, d, prev_close = carry
+    alpha = 1.0 / window
+    hl2 = (hb + lb_) / 2.0
+    tr_first = hb - lb_
+    tr = jnp.where(
+        n_seen == 0,
+        tr_first,
+        jnp.maximum(
+            tr_first,
+            jnp.maximum(jnp.abs(hb - prev_close), jnp.abs(lb_ - prev_close)),
+        ),
+    )
+    atr_new = jnp.where(n_seen == 0, tr, atr + alpha * (tr - atr))
+    n_new = n_seen + 1
+    atr_ready = n_new >= window
+    ub = jnp.where(atr_ready, hl2 + multiplier * atr_new, jnp.inf)
+    lb = jnp.where(atr_ready, hl2 - multiplier * atr_new, -jnp.inf)
+    fu_new = jnp.where((ub < fu) | (prev_close > fu), ub, fu)
+    fl_new = jnp.where((lb > fl) | (prev_close < fl), lb, fl)
+    d_new = jnp.where(cb > fu_new, 1.0, jnp.where(cb < fl_new, -1.0, d))
+    # inactive lanes (before their start) keep the initial carry
+    keep = lambda new, old: jnp.where(active, new, old)
+    new_carry = (
+        keep(atr_new, atr),
+        keep(n_new, n_seen).astype(jnp.int32),
+        keep(fu_new, fu),
+        keep(fl_new, fl),
+        keep(d_new, d),
+        keep(cb, prev_close),
+    )
+    line = jnp.where(d_new > 0, fl_new, fu_new)
+    # a mid-series NaN bar poisons the ATR recursion permanently (the
+    # pandas mirror dropna()s such rows away entirely); masking on ATR
+    # finiteness keeps the output NaN from the gap onward instead of
+    # serving frozen pre-gap bands as live values
+    valid = active & atr_ready & jnp.isfinite(atr_new)
+    return (
+        new_carry,
+        jnp.where(valid, line, jnp.nan),
+        jnp.where(valid, d_new, jnp.nan),
+    )
+
+
+def _supertrend_scan(
+    high: jnp.ndarray,
+    low: jnp.ndarray,
+    close: jnp.ndarray,
+    start: jnp.ndarray,
+    window: int,
+    multiplier: float,
+) -> tuple[tuple, jnp.ndarray, jnp.ndarray]:
+    """Scan the recursion over the window; returns the FINAL carry (each
+    leaf reshaped to the lane batch — the seed for incremental advance)
+    plus the full (…, W) line/direction series."""
+    import jax
+
+    W = close.shape[-1]
+    batch_shape = close.shape[:-1]
+    flat = lambda z: jnp.reshape(z, (-1, W)).T  # (W, B)
+    h, lo, c = flat(high), flat(low), flat(close)
+    start_b = jnp.reshape(jnp.broadcast_to(start, batch_shape), (-1,))
+    B = c.shape[1]
+
+    def step(carry, inputs):
+        hb, lb_, cb, idx = inputs
+        new_carry, line, dirn = _supertrend_step(
+            carry, hb, lb_, cb, idx >= start_b, window, multiplier
+        )
+        return new_carry, (line, dirn)
+
+    init = (
+        jnp.zeros((B,)),
+        jnp.zeros((B,), dtype=jnp.int32),
+        jnp.full((B,), jnp.inf),
+        jnp.full((B,), -jnp.inf),
+        jnp.ones((B,)),
+        jnp.zeros((B,)),
+    )
+    final, (st, dirn) = jax.lax.scan(
+        step, init, (h, lo, c, jnp.arange(W, dtype=jnp.int32))
+    )
+    unflat = lambda z: jnp.reshape(z.T, batch_shape + (W,))
+    final = tuple(jnp.reshape(leaf, batch_shape) for leaf in final)
+    return final, unflat(st), unflat(dirn)
+
+
 def supertrend_from(
     high: jnp.ndarray,
     low: jnp.ndarray,
@@ -214,72 +314,8 @@ def supertrend_from(
     all restart at ``start``: bars before it are ignored entirely,
     matching ``Indicators.set_supertrend`` applied to ``df.iloc[s:]``.
     """
-    import jax
-
-    W = close.shape[-1]
-    batch_shape = close.shape[:-1]
-    flat = lambda z: jnp.reshape(z, (-1, W)).T  # (W, B)
-    h, lo, c = flat(high), flat(low), flat(close)
-    start_b = jnp.reshape(jnp.broadcast_to(start, batch_shape), (-1,))
-    B = c.shape[1]
-    alpha = 1.0 / window
-
-    def step(carry, inputs):
-        atr, n_seen, fu, fl, d, prev_close = carry
-        hb, lb_, cb, idx = inputs
-        active = idx >= start_b
-        hl2 = (hb + lb_) / 2.0
-        tr_first = hb - lb_
-        tr = jnp.where(
-            n_seen == 0,
-            tr_first,
-            jnp.maximum(
-                tr_first,
-                jnp.maximum(jnp.abs(hb - prev_close), jnp.abs(lb_ - prev_close)),
-            ),
-        )
-        atr_new = jnp.where(n_seen == 0, tr, atr + alpha * (tr - atr))
-        n_new = n_seen + 1
-        atr_ready = n_new >= window
-        ub = jnp.where(atr_ready, hl2 + multiplier * atr_new, jnp.inf)
-        lb = jnp.where(atr_ready, hl2 - multiplier * atr_new, -jnp.inf)
-        fu_new = jnp.where((ub < fu) | (prev_close > fu), ub, fu)
-        fl_new = jnp.where((lb > fl) | (prev_close < fl), lb, fl)
-        d_new = jnp.where(cb > fu_new, 1.0, jnp.where(cb < fl_new, -1.0, d))
-        # inactive lanes (before their start) keep the initial carry
-        keep = lambda new, old: jnp.where(active, new, old)
-        carry = (
-            keep(atr_new, atr),
-            keep(n_new, n_seen),
-            keep(fu_new, fu),
-            keep(fl_new, fl),
-            keep(d_new, d),
-            keep(cb, prev_close),
-        )
-        line = jnp.where(d_new > 0, fl_new, fu_new)
-        # a mid-series NaN bar poisons the ATR recursion permanently (the
-        # pandas mirror dropna()s such rows away entirely); masking on ATR
-        # finiteness keeps the output NaN from the gap onward instead of
-        # serving frozen pre-gap bands as live values
-        valid = active & atr_ready & jnp.isfinite(atr_new)
-        return carry, (
-            jnp.where(valid, line, jnp.nan),
-            jnp.where(valid, d_new, jnp.nan),
-        )
-
-    init = (
-        jnp.zeros((B,)),
-        jnp.zeros((B,), dtype=jnp.int32),
-        jnp.full((B,), jnp.inf),
-        jnp.full((B,), -jnp.inf),
-        jnp.ones((B,)),
-        jnp.zeros((B,)),
-    )
-    _, (st, dirn) = jax.lax.scan(
-        step, init, (h, lo, c, jnp.arange(W, dtype=jnp.int32))
-    )
-    unflat = lambda z: jnp.reshape(z.T, batch_shape + (W,))
-    return SupertrendResult(unflat(st), unflat(dirn))
+    _, st, dirn = _supertrend_scan(high, low, close, start, window, multiplier)
+    return SupertrendResult(st, dirn)
 
 
 def supertrend(

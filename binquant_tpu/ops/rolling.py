@@ -316,27 +316,19 @@ def ewm_mean(
     return jnp.where(ok, y, jnp.nan)
 
 
-def ewm_mean_last(
-    x: jnp.ndarray,
-    alpha: float | None = None,
-    span: float | None = None,
-    min_periods: int = 0,
-) -> jnp.ndarray:
-    """Last value of :func:`ewm_mean` in O(W) per row instead of O(W²).
-
-    The hot per-tick path only consumes the latest EMA; this contracts
-    against the decay matrix's final row (a plain weighted sum) plus the same
-    closed-form warm-start correction.
-    """
-    if alpha is None:
-        if span is None:
-            raise ValueError("ewm_mean_last requires alpha or span")
-        alpha = 2.0 / (span + 1.0)
+def ewm_last_state(
+    x: jnp.ndarray, alpha: float
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(mean, rel, any_valid) of the ``adjust=False`` recursion at the last
+    window position: the closed form behind :func:`ewm_mean_last`, exposed
+    unmasked so ``ops.incremental.ewm_init`` seeds its carry from the SAME
+    expressions (init-tick bit-parity is structural, not copy-maintained).
+    ``rel`` is the last column's offset from the first valid sample."""
     W = x.shape[-1]
     d = 1.0 - alpha
     # weights[s] = alpha * d^(W-1-s)
     w = jnp.asarray(
-        alpha * np.power(1.0 - alpha, np.arange(W - 1, -1, -1), dtype=np.float64),
+        alpha * np.power(d, np.arange(W - 1, -1, -1), dtype=np.float64),
         dtype=jnp.float32,
     )
     m = _finite(x)
@@ -353,7 +345,26 @@ def ewm_mean_last(
     x0 = jnp.take_along_axis(x, s0[..., None], axis=-1)[..., 0]
     rel = (W - 1) - s0  # position of the last column relative to first valid
     corr = jnp.power(jnp.float32(d), (rel + 1).astype(jnp.float32)) * x0
-    y = base + corr
+    return base + corr, rel, any_valid
+
+
+def ewm_mean_last(
+    x: jnp.ndarray,
+    alpha: float | None = None,
+    span: float | None = None,
+    min_periods: int = 0,
+) -> jnp.ndarray:
+    """Last value of :func:`ewm_mean` in O(W) per row instead of O(W²).
+
+    The hot per-tick path only consumes the latest EMA; this contracts
+    against the decay matrix's final row (a plain weighted sum) plus the same
+    closed-form warm-start correction.
+    """
+    if alpha is None:
+        if span is None:
+            raise ValueError("ewm_mean_last requires alpha or span")
+        alpha = 2.0 / (span + 1.0)
+    y, rel, any_valid = ewm_last_state(x, float(alpha))
     ok = any_valid & (rel + 1 >= max(min_periods, 1))
     return jnp.where(ok, y, jnp.nan)
 

@@ -68,10 +68,12 @@ def _shard_buffer(buf: MarketBuffer, mesh: Mesh) -> MarketBuffer:
     )
 
 
-def _shard_carry(carry: RegimeCarry, mesh: Mesh, num_symbols: int) -> RegimeCarry:
+def _shard_carry(carry, mesh: Mesh, num_symbols: int):
     """Classify carry leaves by shape: (S, ...) arrays shard over symbols,
     scalars and the (4,) score vectors replicate. Shape-based so future
-    carry fields are placed correctly without a manual registry."""
+    carry fields are placed correctly without a manual registry — the
+    regime carry AND the incremental indicator carry both route through
+    here (every IndicatorCarry leaf is (S,) or (S, k))."""
     # the (4,) market-score vectors must not be mistaken for a symbol axis
     assert num_symbols != 4, "capacity of 4 is ambiguous with score vectors"
     s1 = symbol_sharding(mesh, 1)
@@ -96,6 +98,9 @@ def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
         ),
         mrf_last_emitted=jax.device_put(state.mrf_last_emitted, s1),
         pt_last_signal_close=jax.device_put(state.pt_last_signal_close, s1),
+        indicator_carry=_shard_carry(
+            state.indicator_carry, mesh, state.buf15.capacity
+        ),
     )
 
 

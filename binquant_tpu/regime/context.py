@@ -172,32 +172,21 @@ def initial_regime_carry(num_symbols: int) -> RegimeCarry:
 # ---------------------------------------------------------------------------
 
 
-def compute_symbol_features(
-    buf: MarketBuffer, eligible: jnp.ndarray
+def _assemble_symbol_features(
+    buf: MarketBuffer,
+    eligible: jnp.ndarray,
+    ema20: jnp.ndarray,
+    ema50: jnp.ndarray,
+    atr: jnp.ndarray,
+    mid: jnp.ndarray,
+    std: jnp.ndarray,
 ) -> SymbolFeatureArrays:
-    """Batched `_compute_symbol_features` over every buffer row.
-
-    ``eligible`` is the fresh mask; a row is valid when additionally it has
-    ≥2 bars (the reference's ``len(history) < 2`` early-out). RS-vs-BTC is
-    filled by :func:`compute_market_context` (it needs BTC's return).
-    """
+    """Derived per-symbol features from last-bar indicator values — shared
+    by the full-window path and the incremental-carry path so the two can
+    only diverge in the (parity-tested) indicator readouts themselves."""
     close = buf.values[:, :, Field.CLOSE]
-    high = buf.values[:, :, Field.HIGH]
-    low = buf.values[:, :, Field.LOW]
-
     latest_close = close[:, -1]
     prev_close = close[:, -2]
-
-    # last-value kernels: the per-tick path reads only the latest bar's
-    # indicator values, so avoid materializing full-window series (O(W) per
-    # row instead of O(W²) for the EWM matmuls).
-    ema20 = ewm_mean_last(close, span=20, min_periods=1)
-    ema50 = ewm_mean_last(close, span=50, min_periods=1)
-    tr_tail = true_range(high[:, -15:], low[:, -15:], close[:, -15:])
-    atr = rolling_mean_last(tr_tail, 14, min_periods=1)
-    mid = rolling_mean_last(close, 20, min_periods=1)
-    std = rolling_std_last(close, 20, min_periods=1, ddof=0)
-    std = jnp.where(jnp.isfinite(std), std, 0.0)  # pandas .fillna(0.0)
 
     bb_upper = mid + 2.0 * std
     bb_lower = mid - 2.0 * std
@@ -224,6 +213,60 @@ def compute_symbol_features(
         micro_transition=jnp.full(latest_close.shape, -1, dtype=jnp.int32),
         micro_transition_strength=jnp.zeros_like(latest_close),
     )
+
+
+def compute_symbol_features(
+    buf: MarketBuffer, eligible: jnp.ndarray
+) -> SymbolFeatureArrays:
+    """Batched `_compute_symbol_features` over every buffer row.
+
+    ``eligible`` is the fresh mask; a row is valid when additionally it has
+    ≥2 bars (the reference's ``len(history) < 2`` early-out). RS-vs-BTC is
+    filled by :func:`compute_market_context` (it needs BTC's return).
+    """
+    close = buf.values[:, :, Field.CLOSE]
+    high = buf.values[:, :, Field.HIGH]
+    low = buf.values[:, :, Field.LOW]
+
+    # last-value kernels: the per-tick path reads only the latest bar's
+    # indicator values, so avoid materializing full-window series (O(W) per
+    # row instead of O(W²) for the EWM matmuls).
+    ema20 = ewm_mean_last(close, span=20, min_periods=1)
+    ema50 = ewm_mean_last(close, span=50, min_periods=1)
+    tr_tail = true_range(high[:, -15:], low[:, -15:], close[:, -15:])
+    atr = rolling_mean_last(tr_tail, 14, min_periods=1)
+    mid = rolling_mean_last(close, 20, min_periods=1)
+    std = rolling_std_last(close, 20, min_periods=1, ddof=0)
+    std = jnp.where(jnp.isfinite(std), std, 0.0)  # pandas .fillna(0.0)
+    return _assemble_symbol_features(buf, eligible, ema20, ema50, atr, mid, std)
+
+
+def symbol_features_from_carry(
+    buf: MarketBuffer, carry, eligible: jnp.ndarray, stale: jnp.ndarray
+) -> SymbolFeatureArrays:
+    """The same symbol features read from the 15m ``FeatureCarry`` in O(1)
+    bytes per symbol (the incremental tick's path). ``min_periods=1``
+    readouts of the SAME carried sums the feature pack uses — no second
+    advance. Rows flagged ``stale`` (carry desynced from the window) are
+    excluded from ``valid`` so they cannot feed the market aggregates with
+    stale values before the host's full-recompute resync lands."""
+    from binquant_tpu.ops.incremental import (
+        ewm_value,
+        moment_mean,
+        moment_std,
+    )
+    from binquant_tpu.strategies.features import ATR_WINDOW, BB_WINDOW
+
+    ema20 = ewm_value(carry.ema20, 1)
+    ema50 = ewm_value(carry.ema50, 1)
+    atr = moment_mean(carry.tr_m, ATR_WINDOW, min_periods=1)
+    mid = moment_mean(carry.close_m, BB_WINDOW, min_periods=1)
+    std = moment_std(carry.close_m, BB_WINDOW, min_periods=1, ddof=0)
+    std = jnp.where(jnp.isfinite(std), std, 0.0)
+    feats = _assemble_symbol_features(
+        buf, eligible & ~stale, ema20, ema50, atr, mid, std
+    )
+    return feats
 
 
 def _masked_mean(x: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
@@ -488,15 +531,21 @@ def compute_market_context(
     timestamp: jnp.ndarray,  # int32 seconds tick being evaluated
     carry: RegimeCarry,
     cfg: ContextConfig = ContextConfig(),
+    feats: SymbolFeatureArrays | None = None,
 ) -> tuple[MarketContext, RegimeCarry]:
     """One tick's LiveMarketContext for the whole market + updated carry.
 
     When the coverage gates fail, ``context.valid`` is False and the carry is
     returned unchanged (the reference returns None and keeps the previous
     context as the transition anchor).
+
+    ``feats`` lets the incremental tick path inject symbol features read
+    from carried indicator state (:func:`symbol_features_from_carry`)
+    instead of the full-window recompute; None = compute here.
     """
     S = buf.capacity
-    feats = compute_symbol_features(buf, fresh & tracked)
+    if feats is None:
+        feats = compute_symbol_features(buf, fresh & tracked)
 
     # --- BTC features: taken from its row even when BTC itself is not fresh
     # (the reference computes them from the store regardless, l.105-106).

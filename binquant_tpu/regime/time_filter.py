@@ -1,23 +1,32 @@
 """Time-of-day autotrade filter (host edge).
 
-Equivalent of ``/root/reference/shared/time_of_day_filter.py``: suppress
-autotrade activation during the 20:00–23:00 London quiet window unless the
-market is in a strong, stable trend. Wall-clock-dependent by design, so it
-stays host-side; the engine applies it when turning trigger masks into
-Signal emissions. The structured block message keeps the reference's
-key/value line shape so downstream Telegram parsers stay uniform.
+Covers the reference's ``shared/time_of_day_filter.py`` surface: autotrade
+activations are suppressed inside the 20:00–23:00 London quiet window
+unless the market is in a strong, stable trend. The decision is
+wall-clock-dependent by design, so it stays host-side; the device-side
+tick step applies the SAME strong-trend override against the context
+computed that tick (engine/step.py imports the constants below), and the
+oracle A/B mirrors this module — three consumers, one set of constants.
+
+Structure mirrors the repo's other host-edge policies (grid_policy,
+routing): a frozen decision value (:class:`QuietHoursDecision`) produced
+by one resolver, with thin boolean helpers kept for the existing call
+sites, and the structured Telegram block template preserved verbatim so
+downstream parsers stay uniform.
 """
 
 from __future__ import annotations
 
 import os
 from datetime import datetime
+from typing import NamedTuple
 from zoneinfo import ZoneInfo
 
 from binquant_tpu.enums import MarketRegimeCode, MarketTransitionCode
 
 LONDON = ZoneInfo("Europe/London")
 
+# The quiet window, London local hours: [start, end).
 QUIET_START_HOUR = 20
 QUIET_END_HOUR = 23
 
@@ -29,15 +38,53 @@ OVERRIDE_REGIMES = {int(MarketRegimeCode.TREND_UP), int(MarketRegimeCode.TREND_D
 MIN_TRANSITION_STRENGTH = 0.7
 
 
-def _now_london(now: datetime | None = None) -> datetime:
-    if now is None:
-        now = datetime.now(tz=LONDON)
-    return now.astimezone(LONDON)
+class QuietHoursDecision(NamedTuple):
+    """Resolved quiet-hours verdict for one instant + context snapshot."""
+
+    suppressed: bool
+    in_window: bool  # wall clock inside the London quiet window
+    override: bool  # strong-stable-trend override engaged
+    reason: str  # short machine-readable cause
+
+
+def _as_london(now: datetime | None = None) -> datetime:
+    return (now or datetime.now(tz=LONDON)).astimezone(LONDON)
 
 
 def is_quiet_hours(now: datetime | None = None) -> bool:
     """True when London-local hour is within [QUIET_START_HOUR, QUIET_END_HOUR)."""
-    return QUIET_START_HOUR <= _now_london(now).hour < QUIET_END_HOUR
+    return QUIET_START_HOUR <= _as_london(now).hour < QUIET_END_HOUR
+
+
+def resolve_quiet_hours(
+    market_regime: int | None,
+    transition_strength: float,
+    now: datetime | None = None,
+) -> QuietHoursDecision:
+    """Full quiet-hours resolution (time_of_day_filter.py:60-76 semantics).
+
+    ``market_regime`` is the device int code; None / negative means no
+    valid context, which always suppresses inside the window. The override
+    requires BOTH a trending regime and transition strength at or above
+    :data:`MIN_TRANSITION_STRENGTH`.
+    """
+    if not is_quiet_hours(now):
+        return QuietHoursDecision(
+            suppressed=False, in_window=False, override=False, reason="outside_window"
+        )
+    if market_regime is None or market_regime < 0:
+        return QuietHoursDecision(
+            suppressed=True, in_window=True, override=False, reason="no_context"
+        )
+    if market_regime in OVERRIDE_REGIMES and (
+        transition_strength >= MIN_TRANSITION_STRENGTH
+    ):
+        return QuietHoursDecision(
+            suppressed=False, in_window=True, override=True, reason="strong_trend"
+        )
+    return QuietHoursDecision(
+        suppressed=True, in_window=True, override=False, reason="quiet_window"
+    )
 
 
 def is_autotrade_suppressed(
@@ -45,18 +92,25 @@ def is_autotrade_suppressed(
     transition_strength: float,
     now: datetime | None = None,
 ) -> bool:
-    """Quiet-hours suppression with the strong-stable-trend override
-    (time_of_day_filter.py:60-76). ``market_regime`` is the device int code;
-    None means no valid context (always suppressed in quiet hours)."""
-    if not is_quiet_hours(now):
-        return False
+    """Boolean view of :func:`resolve_quiet_hours` (the legacy call shape
+    the oracle and the host emission edge consume)."""
+    return resolve_quiet_hours(market_regime, transition_strength, now).suppressed
+
+
+def _regime_name(market_regime: int | None) -> str:
     if market_regime is None or market_regime < 0:
-        return True
-    if market_regime in OVERRIDE_REGIMES and (
-        transition_strength >= MIN_TRANSITION_STRENGTH
-    ):
-        return False
-    return True
+        return "UNAVAILABLE"
+    return MarketRegimeCode(market_regime).name
+
+
+def _transition_name(transition: int | None) -> str:
+    if transition is None or transition < 0:
+        return "None"
+    return MarketTransitionCode(transition).name
+
+
+def _fmt3(value: float | None) -> str:
+    return f"{value:.3f}" if value is not None else "n/a"
 
 
 def build_quiet_hours_signal_msg(
@@ -70,31 +124,18 @@ def build_quiet_hours_signal_msg(
     now: datetime | None = None,
 ) -> str:
     """Structured Telegram alert for a suppressed activation
-    (time_of_day_filter.py:79-114)."""
-    london_now = _now_london(now)
-    regime_name = (
-        MarketRegimeCode(market_regime).name
-        if market_regime is not None and market_regime >= 0
-        else "UNAVAILABLE"
-    )
-    transition_name = (
-        MarketTransitionCode(transition).name
-        if transition is not None and transition >= 0
-        else "None"
-    )
-    strength_txt = (
-        f"{transition_strength:.3f}" if transition_strength is not None else "n/a"
-    )
-    stress_txt = f"{stress:.3f}" if stress is not None else "n/a"
+    (time_of_day_filter.py:79-114). The key/value line shape is
+    load-bearing — downstream Telegram parsers key on it."""
+    london_now = _as_london(now)
     return f"""
         - [{os.getenv("ENV", "")}] <strong>#time_of_day_block</strong>
         - Symbol: {symbol}
         - Algorithm: {algo}
         - Side: {side}
         - Reason: London time {london_now.strftime("%H:%M")} falls in the {QUIET_START_HOUR:02d}:00-{QUIET_END_HOUR:02d}:00 quiet window
-        - Market regime: {regime_name}
-        - Market transition: {transition_name}
-        - Transition strength: {strength_txt}
-        - Market stress: {stress_txt}
+        - Market regime: {_regime_name(market_regime)}
+        - Market transition: {_transition_name(transition)}
+        - Transition strength: {_fmt3(transition_strength)}
+        - Market stress: {_fmt3(stress)}
         - Action: autotrade suppressed (signal kept as alert only)
     """

@@ -22,9 +22,26 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.ops.incremental import (
+    EwmCarry,
+    MomentCarry,
+    SumCarry,
+    ewm_advance,
+    ewm_init,
+    ewm_value,
+    moment_advance,
+    moment_init,
+    moment_mean,
+    moment_std,
+    sum_advance,
+    sum_init,
+    sum_mean,
+    sum_value,
+)
 from binquant_tpu.ops.indicators import true_range
 from binquant_tpu.ops.rolling import (
     ewm_mean,
@@ -38,6 +55,23 @@ from binquant_tpu.utils import jsafe_div
 # Bars of BB-width history retained for LadderDeployer's stability check
 # (reference MIN_BB_WIDTH_STABILITY_CANDLES=8, ladder_deployer.py:23).
 BB_WIDTH_HISTORY = 8
+
+# Shared window/span constants (one source of truth for the full-window
+# pack, the incremental carry, and the context features that read the same
+# carry — see symbol_features_from_carry in regime/context.py).
+RSI_WINDOW = 14
+MFI_WINDOW = 14
+BB_WINDOW = 20
+ATR_WINDOW = 14
+ATR_MA_WINDOW = 20
+VOLUME_MA_WINDOW = 20
+MACD_FAST, MACD_SLOW, MACD_SIGNAL = 12, 26, 9
+
+# ewm alpha for a pandas span
+_A = lambda span: 2.0 / (span + 1.0)
+# The deepest buffer column the one-bar advance reads: the leaver of the
+# widest sum/moment window plus its own prev-close lookback.
+MIN_INCREMENTAL_WINDOW = max(BB_WINDOW, VOLUME_MA_WINDOW) + 2
 
 
 class FeaturePack(NamedTuple):
@@ -178,3 +212,386 @@ def compute_feature_pack(buf: MarketBuffer) -> FeaturePack:
         filled=buf.filled,
         valid=buf.filled > 0,
     )
+
+
+# ---------------------------------------------------------------------------
+# Incremental carry: the same pack in O(1) bytes per symbol per tick
+# ---------------------------------------------------------------------------
+
+
+class FeatureCarry(NamedTuple):
+    """Carried indicator state for ONE timeframe buffer, (S,)/(S, k) leaves.
+
+    ``last_ts`` is the bar open-time the carry is synced to (-1 = empty /
+    never synced); :func:`advance_feature_carry` advances a row only on a
+    clean single-bar append (new latest ts whose previous slot holds
+    exactly ``last_ts``). ema20/ema50 ride here too so the 15m carry also
+    feeds the market-context symbol features (one advance, two consumers).
+    """
+
+    last_ts: jnp.ndarray  # (S,) int32
+    ema9: EwmCarry
+    ema21: EwmCarry
+    ema20: EwmCarry
+    ema50: EwmCarry
+    macd_fast: EwmCarry
+    macd_slow: EwmCarry
+    macd_sig: EwmCarry
+    gain_w: EwmCarry  # Wilder RSI avg gain (alpha=1/14)
+    loss_w: EwmCarry
+    gain_s: SumCarry  # simple-RSI rolling gain sum (14)
+    loss_s: SumCarry
+    pos_flow: SumCarry  # MFI flows (14)
+    neg_flow: SumCarry
+    close_m: MomentCarry  # Bollinger mid/std + context mid/std (20)
+    vol_m: MomentCarry  # volume MA (20)
+    tr_m: MomentCarry  # SMA-of-TR ATR (14)
+    atr_hist: jnp.ndarray  # (S, ATR_MA_WINDOW) trailing ATR values
+    bb_width_hist: jnp.ndarray  # (S, BB_WIDTH_HISTORY) trailing widths
+
+
+def _empty_ewm(num_symbols: int) -> EwmCarry:
+    return EwmCarry(
+        mean=jnp.zeros((num_symbols,), jnp.float32),
+        rel=jnp.full((num_symbols,), -1, jnp.int32),
+    )
+
+
+def _empty_sum(num_symbols: int) -> SumCarry:
+    return SumCarry(
+        wsum=jnp.zeros((num_symbols,), jnp.float32),
+        cnt=jnp.zeros((num_symbols,), jnp.int32),
+    )
+
+
+def _empty_moment(num_symbols: int) -> MomentCarry:
+    return MomentCarry(
+        center=jnp.zeros((num_symbols,), jnp.float32),
+        wsum=jnp.zeros((num_symbols,), jnp.float32),
+        wsq=jnp.zeros((num_symbols,), jnp.float32),
+        cnt=jnp.zeros((num_symbols,), jnp.int32),
+    )
+
+
+def empty_feature_carry(num_symbols: int) -> FeatureCarry:
+    return FeatureCarry(
+        last_ts=jnp.full((num_symbols,), -1, jnp.int32),
+        ema9=_empty_ewm(num_symbols),
+        ema21=_empty_ewm(num_symbols),
+        ema20=_empty_ewm(num_symbols),
+        ema50=_empty_ewm(num_symbols),
+        macd_fast=_empty_ewm(num_symbols),
+        macd_slow=_empty_ewm(num_symbols),
+        macd_sig=_empty_ewm(num_symbols),
+        gain_w=_empty_ewm(num_symbols),
+        loss_w=_empty_ewm(num_symbols),
+        gain_s=_empty_sum(num_symbols),
+        loss_s=_empty_sum(num_symbols),
+        pos_flow=_empty_sum(num_symbols),
+        neg_flow=_empty_sum(num_symbols),
+        close_m=_empty_moment(num_symbols),
+        vol_m=_empty_moment(num_symbols),
+        tr_m=_empty_moment(num_symbols),
+        atr_hist=jnp.full((num_symbols, ATR_MA_WINDOW), jnp.nan, jnp.float32),
+        bb_width_hist=jnp.full(
+            (num_symbols, BB_WIDTH_HISTORY), jnp.nan, jnp.float32
+        ),
+    )
+
+
+def init_feature_carry(buf: MarketBuffer) -> FeatureCarry:
+    """Carry from the full window — every sub-carry evaluates the SAME
+    expressions as the full-window pack, so a full recompute re-anchors
+    the incremental path bit-identically (the resync the engine's fallback
+    and drift audit rely on)."""
+    W = buf.window
+    assert W >= 36, f"window {W} too short for carry init (need >= 36)"
+    close = buf.values[:, :, Field.CLOSE]
+    high = buf.values[:, :, Field.HIGH]
+    low = buf.values[:, :, Field.LOW]
+    volume = buf.values[:, :, Field.VOLUME]
+
+    delta = close - shift(close, 1)
+    gain = jnp.maximum(delta, 0.0)
+    loss = jnp.maximum(-delta, 0.0)
+
+    macd_fast = ewm_init(close, _A(MACD_FAST))
+    macd_slow = ewm_init(close, _A(MACD_SLOW))
+    macd_line = ewm_mean(close, span=MACD_FAST, min_periods=1) - ewm_mean(
+        close, span=MACD_SLOW, min_periods=1
+    )
+    tp = (high + low + close) / 3.0
+    flow = tp * volume
+    tp_delta = tp - shift(tp, 1)
+    pos_flow_series = jnp.where(
+        jnp.isfinite(tp_delta), jnp.where(tp_delta > 0, flow, 0.0), jnp.nan
+    )
+    neg_flow_series = jnp.where(
+        jnp.isfinite(tp_delta), jnp.where(tp_delta < 0, flow, 0.0), jnp.nan
+    )
+    tr = true_range(high[:, -35:], low[:, -35:], close[:, -35:])[:, 1:]
+    atr_series = rolling_mean(tr, ATR_WINDOW)
+
+    k = BB_WIDTH_HISTORY
+    tail = close[:, -(BB_WINDOW + k - 1):]
+    mids = rolling_mean(tail, BB_WINDOW)[:, -k:]
+    from binquant_tpu.ops.rolling import rolling_std
+
+    stds = rolling_std(tail, BB_WINDOW, ddof=0)[:, -k:]
+    widths = jsafe_div(4.0 * stds, mids)  # (upper-lower)/mid = 4σ/mid
+
+    return FeatureCarry(
+        last_ts=buf.times[:, -1].astype(jnp.int32),
+        ema9=ewm_init(close, _A(9)),
+        ema21=ewm_init(close, _A(21)),
+        ema20=ewm_init(close, _A(20)),
+        ema50=ewm_init(close, _A(50)),
+        macd_fast=macd_fast,
+        macd_slow=macd_slow,
+        macd_sig=ewm_init(macd_line, _A(MACD_SIGNAL)),
+        gain_w=ewm_init(gain, 1.0 / RSI_WINDOW),
+        loss_w=ewm_init(loss, 1.0 / RSI_WINDOW),
+        gain_s=sum_init(gain, RSI_WINDOW),
+        loss_s=sum_init(loss, RSI_WINDOW),
+        pos_flow=sum_init(pos_flow_series, MFI_WINDOW),
+        neg_flow=sum_init(neg_flow_series, MFI_WINDOW),
+        close_m=moment_init(close, BB_WINDOW),
+        vol_m=moment_init(volume, VOLUME_MA_WINDOW),
+        tr_m=moment_init(tr, ATR_WINDOW),
+        atr_hist=atr_series[:, -ATR_MA_WINDOW:].astype(jnp.float32),
+        bb_width_hist=widths.astype(jnp.float32),
+    )
+
+
+def _col(buf: MarketBuffer, pos: int, f: Field) -> jnp.ndarray:
+    """(S,) column read — O(1) bytes per symbol, the whole point."""
+    return buf.values[:, pos, int(f)]
+
+
+def _tr_at(buf: MarketBuffer, pos: int) -> jnp.ndarray:
+    """True range of the bar at ``pos`` from its own + previous columns."""
+    h, lo = _col(buf, pos, Field.HIGH), _col(buf, pos, Field.LOW)
+    pc = _col(buf, pos - 1, Field.CLOSE)
+    hl = h - lo
+    tr = jnp.maximum(hl, jnp.maximum(jnp.abs(h - pc), jnp.abs(lo - pc)))
+    return jnp.where(jnp.isfinite(pc), tr, hl)
+
+
+def _gain_loss_at(buf: MarketBuffer, pos: int):
+    delta = _col(buf, pos, Field.CLOSE) - _col(buf, pos - 1, Field.CLOSE)
+    fin = jnp.isfinite(delta)
+    gain = jnp.where(fin, jnp.maximum(delta, 0.0), jnp.nan)
+    loss = jnp.where(fin, jnp.maximum(-delta, 0.0), jnp.nan)
+    return gain, loss
+
+
+def _flows_at(buf: MarketBuffer, pos: int):
+    tp = (
+        _col(buf, pos, Field.HIGH)
+        + _col(buf, pos, Field.LOW)
+        + _col(buf, pos, Field.CLOSE)
+    ) / 3.0
+    tp_prev = (
+        _col(buf, pos - 1, Field.HIGH)
+        + _col(buf, pos - 1, Field.LOW)
+        + _col(buf, pos - 1, Field.CLOSE)
+    ) / 3.0
+    tpd = tp - tp_prev
+    flow = tp * _col(buf, pos, Field.VOLUME)
+    fin = jnp.isfinite(tpd)
+    pos_f = jnp.where(fin, jnp.where(tpd > 0, flow, 0.0), jnp.nan)
+    neg_f = jnp.where(fin, jnp.where(tpd < 0, flow, 0.0), jnp.nan)
+    return pos_f, neg_f
+
+
+def advance_feature_carry(
+    buf: MarketBuffer, carry: FeatureCarry
+) -> tuple[FeatureCarry, jnp.ndarray]:
+    """Advance per-symbol carries by the buffer's newest bar.
+
+    Reads ~a dozen (S,) columns instead of the (S, W) window. Per row:
+
+    * clean append (new latest ts, previous slot == ``last_ts``) → advance;
+    * unchanged latest ts → keep (no new bar this tick);
+    * anything else (reset row reclaimed, desync) → keep and flag STALE in
+      the returned (S,) bool mask — readers NaN-mask stale rows and the
+      host schedules a full recompute, which re-inits every row.
+
+    Returns (carry', stale_mask). Mid-history rewrites do NOT change the
+    latest ts and are invisible here by design — the HOST detects them
+    from the update stream and routes the tick to the full step
+    (io/pipeline.py), which is the only way to rebuild windowed sums whose
+    interior changed.
+    """
+    W = buf.window
+    assert W >= MIN_INCREMENTAL_WINDOW, (
+        f"window {W} too short for incremental advance "
+        f"(need >= {MIN_INCREMENTAL_WINDOW})"
+    )
+    ts = buf.times[:, -1]
+    prev_ts = buf.times[:, -2]
+    advanced = (ts >= 0) & (ts != carry.last_ts) & (prev_ts == carry.last_ts)
+    stale = (ts != carry.last_ts) & ~advanced
+
+    close_new = _col(buf, -1, Field.CLOSE)
+    vol_new = _col(buf, -1, Field.VOLUME)
+    gain_new, loss_new = _gain_loss_at(buf, -1)
+    gain_old, loss_old = _gain_loss_at(buf, -(RSI_WINDOW + 1))
+    pos_new, neg_new = _flows_at(buf, -1)
+    pos_old, neg_old = _flows_at(buf, -(MFI_WINDOW + 1))
+    tr_new = _tr_at(buf, -1)
+    tr_old = _tr_at(buf, -(ATR_WINDOW + 1))
+
+    ema9 = ewm_advance(carry.ema9, close_new, _A(9))
+    ema21 = ewm_advance(carry.ema21, close_new, _A(21))
+    ema20 = ewm_advance(carry.ema20, close_new, _A(20))
+    ema50 = ewm_advance(carry.ema50, close_new, _A(50))
+    macd_fast = ewm_advance(carry.macd_fast, close_new, _A(MACD_FAST))
+    macd_slow = ewm_advance(carry.macd_slow, close_new, _A(MACD_SLOW))
+    line_new = ewm_value(macd_fast, 1) - ewm_value(macd_slow, 1)
+    macd_sig = ewm_advance(carry.macd_sig, line_new, _A(MACD_SIGNAL))
+    gain_w = ewm_advance(carry.gain_w, gain_new, 1.0 / RSI_WINDOW)
+    loss_w = ewm_advance(carry.loss_w, loss_new, 1.0 / RSI_WINDOW)
+    gain_s = sum_advance(carry.gain_s, gain_new, gain_old)
+    loss_s = sum_advance(carry.loss_s, loss_new, loss_old)
+    pos_flow = sum_advance(carry.pos_flow, pos_new, pos_old)
+    neg_flow = sum_advance(carry.neg_flow, neg_new, neg_old)
+    close_m = moment_advance(
+        carry.close_m, close_new, _col(buf, -(BB_WINDOW + 1), Field.CLOSE)
+    )
+    vol_m = moment_advance(
+        carry.vol_m, vol_new, _col(buf, -(VOLUME_MA_WINDOW + 1), Field.VOLUME)
+    )
+    tr_m = moment_advance(carry.tr_m, tr_new, tr_old)
+
+    atr_today = moment_mean(tr_m, ATR_WINDOW)
+    atr_hist = jnp.concatenate(
+        [carry.atr_hist[:, 1:], atr_today[:, None]], axis=1
+    )
+    mid = moment_mean(close_m, BB_WINDOW)
+    std = moment_std(close_m, BB_WINDOW, ddof=0)
+    width_today = jsafe_div(4.0 * std, mid)
+    bb_width_hist = jnp.concatenate(
+        [carry.bb_width_hist[:, 1:], width_today[:, None]], axis=1
+    )
+
+    new = FeatureCarry(
+        last_ts=ts.astype(jnp.int32),
+        ema9=ema9,
+        ema21=ema21,
+        ema20=ema20,
+        ema50=ema50,
+        macd_fast=macd_fast,
+        macd_slow=macd_slow,
+        macd_sig=macd_sig,
+        gain_w=gain_w,
+        loss_w=loss_w,
+        gain_s=gain_s,
+        loss_s=loss_s,
+        pos_flow=pos_flow,
+        neg_flow=neg_flow,
+        close_m=close_m,
+        vol_m=vol_m,
+        tr_m=tr_m,
+        atr_hist=atr_hist,
+        bb_width_hist=bb_width_hist,
+    )
+
+    def sel(n, o):
+        mask = advanced if n.ndim == 1 else advanced[:, None]
+        return jnp.where(mask, n, o)
+
+    return jax.tree_util.tree_map(sel, new, carry), stale
+
+
+def _ratio_100(num: jnp.ndarray, den_other: jnp.ndarray) -> jnp.ndarray:
+    """The pack's 100·a/(a+b) with the 50.0 flat-case override and NaN
+    propagation (shared by both RSI variants and MFI)."""
+    denom = num + den_other
+    out = jnp.where(
+        denom != 0, 100.0 * num / jnp.where(denom != 0, denom, 1.0), 50.0
+    )
+    return jnp.where(jnp.isfinite(num) & jnp.isfinite(den_other), out, jnp.nan)
+
+
+def feature_pack_from_carry(
+    buf: MarketBuffer, carry: FeatureCarry, stale: jnp.ndarray
+) -> FeaturePack:
+    """The FeaturePack readout from carried state — the fast-path twin of
+    :func:`compute_feature_pack` (same masks, same formulas; parity pinned
+    in tests/test_ops_parity.py + tests/test_incremental.py). Raw bar
+    fields come from the buffer's last columns; indicator fields of STALE
+    rows are NaN-masked (defense in depth — the host already routes
+    desynced ticks to the full step)."""
+    close = buf.values[:, -1, Field.CLOSE]
+
+    avg_gain_w = ewm_value(carry.gain_w, RSI_WINDOW)
+    avg_loss_w = ewm_value(carry.loss_w, RSI_WINDOW)
+    rsi_wilder = _ratio_100(avg_gain_w, avg_loss_w)
+    rsi_sma = _ratio_100(
+        sum_mean(carry.gain_s, RSI_WINDOW), sum_mean(carry.loss_s, RSI_WINDOW)
+    )
+
+    macd_last = ewm_value(carry.macd_fast, 1) - ewm_value(carry.macd_slow, 1)
+    macd_signal = ewm_value(carry.macd_sig, 1)
+
+    mfi = _ratio_100(
+        sum_value(carry.pos_flow, MFI_WINDOW),
+        sum_value(carry.neg_flow, MFI_WINDOW),
+    )
+
+    bb_mid = moment_mean(carry.close_m, BB_WINDOW)
+    bb_std = moment_std(carry.close_m, BB_WINDOW, ddof=0)
+    bb_upper = bb_mid + 2.0 * bb_std
+    bb_lower = bb_mid - 2.0 * bb_std
+
+    atr = moment_mean(carry.tr_m, ATR_WINDOW)
+    hist_fin = jnp.isfinite(carry.atr_hist)
+    hist_cnt = jnp.sum(hist_fin, axis=-1)
+    atr_ma = jnp.where(
+        hist_cnt >= ATR_MA_WINDOW,
+        jnp.sum(jnp.where(hist_fin, carry.atr_hist, 0.0), axis=-1)
+        / jnp.maximum(hist_cnt, 1),
+        jnp.nan,
+    )
+    volume_ma = moment_mean(carry.vol_m, VOLUME_MA_WINDOW)
+
+    nanify = lambda v: jnp.where(stale, jnp.nan, v)
+    duration = buf.values[:, -1, Field.DURATION_S]
+    duration = jnp.where(jnp.isfinite(duration), duration, 0.0).astype(jnp.int32)
+    return FeaturePack(
+        open_time=buf.times[:, -1],
+        close_time=buf.times[:, -1] + duration,
+        open=buf.values[:, -1, Field.OPEN],
+        high=buf.values[:, -1, Field.HIGH],
+        low=buf.values[:, -1, Field.LOW],
+        close=close,
+        prev_close=buf.values[:, -2, Field.CLOSE],
+        volume=buf.values[:, -1, Field.VOLUME],
+        quote_volume=buf.values[:, -1, Field.QUOTE_VOLUME],
+        num_trades=buf.values[:, -1, Field.NUM_TRADES],
+        rsi=nanify(rsi_sma),
+        rsi_wilder=nanify(rsi_wilder),
+        macd=nanify(macd_last),
+        macd_signal=nanify(macd_signal),
+        mfi=nanify(mfi),
+        bb_upper=nanify(bb_upper),
+        bb_mid=nanify(bb_mid),
+        bb_lower=nanify(bb_lower),
+        bb_widths=jnp.where(stale[:, None], jnp.nan, carry.bb_width_hist),
+        atr=nanify(atr),
+        atr_ma=nanify(atr_ma),
+        volume_ma=nanify(volume_ma),
+        ema9=nanify(ewm_value(carry.ema9, 1)),
+        ema21=nanify(ewm_value(carry.ema21, 1)),
+        filled=buf.filled,
+        valid=buf.filled > 0,
+    )
+
+
+def compute_feature_pack_incremental(
+    buf: MarketBuffer, carry: FeatureCarry
+) -> tuple[FeaturePack, FeatureCarry]:
+    """One-bar advance + readout: the O(1)-bytes-per-symbol pack."""
+    new_carry, stale = advance_feature_carry(buf, carry)
+    return feature_pack_from_carry(buf, new_carry, stale), new_carry

@@ -17,6 +17,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("ENV", "CI")
+# Tier-1 compile budget: an engine on the incremental fast path compiles
+# TWO wire executables (full for the cold-start/fallback resync + the
+# incremental variant), which nearly doubles the suite's jit-compile time
+# across the dozens of stub engines tests construct. Default the fast
+# path OFF for the test lane (production default stays ON —
+# binquant_tpu/config.py); the incremental coverage opts in explicitly:
+# tests/test_incremental.py (step parity + pipeline gating),
+# tests/test_ab_parity.py (oracle A/B with the fast path pinned on), and
+# tests/test_obs.py (fallback-counter smoke).
+os.environ.setdefault("BQT_INCREMENTAL", "0")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -46,8 +46,15 @@ def _assert_match(result):
 
 
 def test_ab_signal_sets_identical(replay_path):
-    result = run_replay_ab(replay_path, capacity=CAPACITY, window=WINDOW)
+    # ISSUE 2 acceptance: the tier-1 oracle A/B runs with the incremental
+    # indicator fast path pinned ON (conftest defaults it off for compile
+    # budget) — and asserts it actually ENGAGED, so this parity can never
+    # silently degrade to full-path-only coverage.
+    result = run_replay_ab(
+        replay_path, capacity=CAPACITY, window=WINDOW, incremental=True
+    )
     _assert_match(result)
+    assert result["tpu_stats"]["incremental_ticks"] > 0
     # these three engage even without a scripted breadth series — assert
     # it, or their parity could silently become vacuous (VERDICT r2 item 5)
     for name in (
@@ -151,3 +158,22 @@ def test_oracle_emits_crafted_signals(replay_path):
         if strategy == "liquidation_sweep_pump"
     ]
     assert ("S003USDT", "LONG") in lsp
+
+
+@pytest.mark.slow
+def test_ab_parity_holds_on_both_indicator_paths(replay_path):
+    """Both evaluation paths pinned EXPLICITLY against the oracle (the
+    tier-1 lane covers incremental-by-default in the tests above and
+    incremental==full engine-vs-engine in tests/test_incremental.py;
+    this slow-lane drill closes the triangle directly)."""
+    result_incr = run_replay_ab(
+        replay_path, capacity=CAPACITY, window=WINDOW, incremental=True
+    )
+    _assert_match(result_incr)
+    assert result_incr["tpu_stats"]["incremental_ticks"] > 0
+    result_full = run_replay_ab(
+        replay_path, capacity=CAPACITY, window=WINDOW, incremental=False
+    )
+    _assert_match(result_full)
+    assert result_full["tpu_stats"]["incremental_ticks"] == 0
+    assert result_incr["tpu_count"] == result_full["tpu_count"]

@@ -1,0 +1,437 @@
+"""Incremental indicator engine: fast-path parity + host gating (ISSUE 2).
+
+Three layers of coverage on top of the ops-level property tests in
+test_ops_parity.py::TestIncrementalOps:
+
+* the jit'd step: ``tick_step(..., incremental=True)`` must agree with the
+  full recompute on every strategy verdict over streamed ticks (the fast
+  CPU smoke of the incremental path in the tier-1 lane);
+* the pipeline: the host routes cold start / mid-history rewrites /
+  backfill folds / the drift audit to the full step (counted in
+  ``bqt_full_recompute_total``) and stays incremental otherwise — and the
+  emitted signal stream is identical either way, including across rewrite
+  streams;
+* checkpoint: the v2 archive round-trips the carry; a v1 archive migrates
+  (carry rebuilt from the windows on the first tick).
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from binquant_tpu.engine.buffer import NUM_FIELDS, Field
+from binquant_tpu.engine.step import (
+    default_host_inputs,
+    init_indicator_carry,
+    initial_engine_state,
+    pad_updates,
+    tick_step,
+)
+from binquant_tpu.obs.instruments import FULL_RECOMPUTE
+from binquant_tpu.regime.context import ContextConfig
+from tests.conftest import make_ohlcv
+
+S_CAP = 16
+WINDOW = 130
+CFG = ContextConfig(required_fresh_symbols=4, min_coverage_ratio=0.5)
+
+
+def _updates(rng, num, ts_s, px, duration=900):
+    closes = px * (1 + rng.normal(0, 0.004, num))
+    vals = np.zeros((num, NUM_FIELDS), np.float32)
+    vals[:, Field.OPEN] = px
+    vals[:, Field.CLOSE] = closes
+    vals[:, Field.HIGH] = np.maximum(px, closes) * 1.002
+    vals[:, Field.LOW] = np.minimum(px, closes) * 0.998
+    vals[:, Field.VOLUME] = np.abs(rng.normal(1000, 150, num))
+    vals[:, Field.QUOTE_VOLUME] = vals[:, Field.VOLUME] * closes
+    vals[:, Field.NUM_TRADES] = 150
+    vals[:, Field.DURATION_S] = duration
+    rows = np.arange(num, dtype=np.int32)
+    return rows, np.full(num, ts_s, np.int32), vals, closes
+
+
+def _inputs(ts, tracked):
+    return default_host_inputs(S_CAP)._replace(
+        tracked=jnp.asarray(tracked),
+        btc_row=np.int32(0),
+        timestamp_s=np.int32(ts),
+        timestamp5_s=np.int32(ts),
+    )
+
+
+def _seeded_state(rng, n_rows=8, bars=WINDOW - 10):
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    t0 = 1_753_000_200
+    px = 20.0 + rng.random(n_rows) * 100
+    tracked = np.zeros(S_CAP, dtype=bool)
+    tracked[:n_rows] = True
+    ts = t0
+    for b in range(bars):
+        ts = t0 + b * 900
+        rows, tss, vals, px = _updates(rng, n_rows, ts, px)
+        upd = pad_updates(rows, tss, vals, size=S_CAP)
+        state, _ = tick_step(state, upd, upd, _inputs(ts, tracked), CFG)
+    return state, tracked, ts, px
+
+
+def test_incremental_step_matches_full_stream():
+    """Fast CPU smoke + parity: stream ticks through BOTH static variants
+    from the same seeded state; every strategy verdict and the carried
+    dedupe state must agree, and the incremental state's carry must stay
+    equivalent to a window re-init (drift below f32 tolerance)."""
+    rng = np.random.default_rng(77)
+    state, tracked, ts, px = _seeded_state(rng)
+    state_full = state
+    state_incr = state  # carry already synced: seeding ran full ticks
+
+    for i in range(12):
+        ts += 900
+        # symbol 3 skips every third tick (freshness-hole coverage)
+        rows, tss, vals, px = _updates(rng, len(px), ts, px)
+        if i % 3 == 0:
+            keep = rows != 3
+            rows, tss, vals = rows[keep], tss[keep], vals[keep]
+        upd = pad_updates(rows, tss, vals, size=S_CAP)
+        inputs = _inputs(ts, tracked)
+        state_full, out_full = tick_step(state_full, upd, upd, inputs, CFG)
+        state_incr, out_incr = tick_step(
+            state_incr, upd, upd, inputs, CFG, incremental=True
+        )
+
+        np.testing.assert_array_equal(
+            np.asarray(out_incr.summary.trigger), np.asarray(out_full.summary.trigger)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_incr.summary.autotrade),
+            np.asarray(out_full.summary.autotrade),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_incr.summary.direction),
+            np.asarray(out_full.summary.direction),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_incr.summary.score),
+            np.asarray(out_full.summary.score),
+            rtol=1e-4,
+            atol=1e-4,
+        )
+        # regime scalars ride the wire — they must agree too
+        assert int(out_incr.context.market_regime) == int(
+            out_full.context.market_regime
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state_incr.mrf_last_emitted),
+            np.asarray(state_full.mrf_last_emitted),
+        )
+
+    # drift-audit resync is seamless: swap the streamed carry for a fresh
+    # window re-init (what a full/audit tick produces) and the NEXT
+    # incremental tick's verdicts are unchanged
+    state_resync = state_incr._replace(
+        indicator_carry=init_indicator_carry(state_incr.buf5, state_incr.buf15)
+    )
+    ts += 900
+    rows, tss, vals, px = _updates(rng, len(px), ts, px)
+    upd = pad_updates(rows, tss, vals, size=S_CAP)
+    inputs = _inputs(ts, tracked)
+    _, out_a = tick_step(state_incr, upd, upd, inputs, CFG, incremental=True)
+    _, out_b = tick_step(state_resync, upd, upd, inputs, CFG, incremental=True)
+    np.testing.assert_array_equal(
+        np.asarray(out_a.summary.trigger), np.asarray(out_b.summary.trigger)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_a.summary.score),
+        np.asarray(out_b.summary.score),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_incremental_pack_parity_on_stream():
+    """FeaturePack readout parity over a streamed buffer (NaN masks equal,
+    values within f32 tolerance — ULP-scaled for the near-zero MACD)."""
+    from binquant_tpu.engine.buffer import apply_updates, empty_buffer
+    from binquant_tpu.strategies.features import (
+        advance_feature_carry,
+        compute_feature_pack,
+        feature_pack_from_carry,
+        init_feature_carry,
+    )
+
+    rng = np.random.default_rng(5)
+    S = 8
+    buf = empty_buffer(S, WINDOW)
+    t0 = 1_753_000_200
+    px = 20.0 + rng.random(S) * 100
+    px[0] = 68_000.0  # BTC-scale row: exercises the centered moments
+    for b in range(80):
+        rows, tss, vals, px = _updates(rng, S, t0 + b * 900, px)
+        buf = apply_updates(buf, rows, tss, vals)
+    carry = init_feature_carry(buf)
+
+    for b in range(80, 140):
+        rows, tss, vals, px = _updates(rng, S, t0 + b * 900, px)
+        if b % 5 == 0:  # a symbol missing a bar stays parity-exact
+            keep = rows != 2
+            rows, tss, vals = rows[keep], tss[keep], vals[keep]
+        buf = apply_updates(buf, rows, tss, vals)
+        carry, stale = advance_feature_carry(buf, carry)
+        assert not np.asarray(stale).any()
+        got = feature_pack_from_carry(buf, carry, stale)
+        want = compute_feature_pack(buf)
+        close = np.asarray(want.close, np.float64)
+        for name in want._fields:
+            a = np.asarray(getattr(got, name), np.float64)
+            w = np.asarray(getattr(want, name), np.float64)
+            np.testing.assert_array_equal(
+                np.isfinite(a), np.isfinite(w), err_msg=f"{name} NaN mask @ bar {b}"
+            )
+            mask = np.isfinite(w)
+            if not mask.any():
+                continue
+            # ULP-scaled absolute floor: macd is a difference of price-
+            # scale EMAs, so its error floor is ULPs of the CLOSE price
+            atol = 1e-6 + 2e-5 * np.max(
+                np.broadcast_to(close[:, None] if a.ndim == 2 else close, a.shape)[
+                    mask
+                ]
+            )
+            np.testing.assert_allclose(
+                a[mask], w[mask], rtol=2e-4, atol=atol, err_msg=f"{name} @ bar {b}"
+            )
+
+
+def test_stale_row_is_nan_masked_not_wrong():
+    """Device-side defense in depth: a carry that desyncs from its row
+    (reclaimed registry slot) NaN-masks that row's indicators instead of
+    serving another symbol's state."""
+    from binquant_tpu.engine.buffer import apply_updates, empty_buffer
+    from binquant_tpu.strategies.features import (
+        advance_feature_carry,
+        feature_pack_from_carry,
+        init_feature_carry,
+    )
+
+    rng = np.random.default_rng(9)
+    S = 4
+    buf = empty_buffer(S, WINDOW)
+    t0 = 1_753_000_200
+    px = 50.0 + rng.random(S)
+    for b in range(40):
+        rows, tss, vals, px = _updates(rng, S, t0 + b * 900, px)
+        buf = apply_updates(buf, rows, tss, vals)
+    carry = init_feature_carry(buf)
+    # row 1 is wiped (symbol left) and reclaimed by a NEW symbol whose
+    # first bar lands at a much later timestamp — the carry still holds
+    # the old symbol's state
+    from binquant_tpu.engine.buffer import reset_rows
+
+    buf = reset_rows(buf, jnp.asarray(np.array([1], np.int32)))
+    rows = np.array([1], np.int32)
+    tss = np.array([t0 + 100 * 900], np.int32)
+    vals = np.zeros((1, NUM_FIELDS), np.float32)
+    vals[0, Field.CLOSE] = 123.0
+    vals[0, Field.OPEN] = 123.0
+    vals[0, Field.HIGH] = 124.0
+    vals[0, Field.LOW] = 122.0
+    vals[0, Field.VOLUME] = 10.0
+    buf = apply_updates(buf, rows, tss, vals)
+    carry, stale = advance_feature_carry(buf, carry)
+    assert bool(np.asarray(stale)[1])
+    pack = feature_pack_from_carry(buf, carry, stale)
+    assert np.isnan(float(np.asarray(pack.rsi)[1]))
+    assert np.isnan(float(np.asarray(pack.ema9)[1]))
+    # untouched rows unaffected
+    assert not np.asarray(stale)[[0, 2, 3]].any()
+
+
+# ---------------------------------------------------------------------------
+# Pipeline gating (io/pipeline.py host-side routing)
+# ---------------------------------------------------------------------------
+
+
+def _counter_totals():
+    return {labels: child.value for labels, child in FULL_RECOMPUTE.children()}
+
+
+def _drive(engine, klines_by_tick):
+    async def go():
+        fired = []
+        for bucket in sorted(klines_by_tick):
+            for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
+                engine.ingest(k)
+            fired.extend(await engine.process_tick(now_ms=(bucket + 1) * 900 * 1000))
+        fired.extend(await engine.flush_pending())
+        return fired
+
+    return asyncio.run(go())
+
+
+@pytest.fixture(scope="module")
+def replay_file(tmp_path_factory):
+    from binquant_tpu.io.replay import generate_replay_file
+
+    path = tmp_path_factory.mktemp("incr") / "incr.jsonl"
+    generate_replay_file(path, n_symbols=12, n_ticks=60, seed=11)
+    return path
+
+
+def test_pipeline_gating_reasons(replay_file):
+    """Cold start → full; steady clean appends → incremental; an audit
+    cadence tick → full; a re-sent corrected candle → full (rewrite)."""
+    from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
+
+    engine = make_stub_engine(capacity=32, window=WINDOW, incremental=True)
+    engine.carry_audit_every = 7
+    by_tick = load_klines_by_tick(replay_file)
+    buckets = sorted(by_tick)
+
+    before = _counter_totals()
+    _drive(engine, {b: by_tick[b] for b in buckets[:20]})
+    after = _counter_totals()
+
+    assert engine.incremental_ticks > 0
+    assert engine.full_recompute_ticks > 0
+    cold = after.get(("cold_start",), 0) - before.get(("cold_start",), 0)
+    audit = after.get(("audit",), 0) - before.get(("audit",), 0)
+    assert cold >= 1
+    assert audit >= 2  # 20 ticks at every_ticks=7
+    # steady state: the majority of ticks took the fast path
+    assert engine.incremental_ticks > engine.full_recompute_ticks
+
+    # a mid-history rewrite (exchange re-sends a corrected candle) routes
+    # the next tick to the full recompute
+    incr_before = engine.incremental_ticks
+    rewrite_bucket = buckets[20]
+    klines = [dict(k) for k in by_tick[rewrite_bucket]]
+    old = dict(klines[0])
+    old["close"] = old["close"] * 1.01  # corrected candle, SAME open_time
+    _drive(engine, {rewrite_bucket: klines})
+    assert engine.incremental_ticks == incr_before + 1  # clean tick first
+    pre = _counter_totals().get(("rewrite",), 0)
+    # re-send the already-applied bucket: every ts <= host latest mirror
+    _drive(engine, {rewrite_bucket: [old]})
+    assert _counter_totals().get(("rewrite",), 0) == pre + 1
+    hs = engine.health_snapshot()
+    assert hs["incremental_enabled"] and hs["full_recompute_ticks"] > 0
+
+
+def test_pipeline_signals_identical_with_rewrites(replay_file):
+    """End-to-end: the same stream INCLUDING re-sent corrected candles
+    yields the identical signal set with the fast path on and off."""
+    from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
+
+    by_tick = load_klines_by_tick(replay_file)
+    buckets = sorted(by_tick)
+
+    def run(incremental):
+        engine = make_stub_engine(
+            capacity=32, window=WINDOW, incremental=incremental
+        )
+        collected = []
+        for i, bucket in enumerate(buckets):
+            klines = [dict(k) for k in by_tick[bucket]]
+            if i == 30:
+                # re-send the previous bucket's first candle, corrected —
+                # a mid-history rewrite mid-stream
+                stale = dict(by_tick[buckets[i - 1]][0])
+                stale["close"] *= 1.02
+                stale["high"] = max(stale["high"], stale["close"])
+                klines.append(stale)
+            fired = _drive(engine, {bucket: klines})
+            collected.extend(
+                (s.tick_ms, s.strategy, s.symbol, str(s.value.direction)) for s in fired
+            )
+        return engine, collected
+
+    eng_incr, sig_incr = run(True)
+    eng_full, sig_full = run(False)
+    assert set(sig_incr) == set(sig_full)
+    assert eng_incr.incremental_ticks > 0
+    assert eng_full.incremental_ticks == 0
+
+
+def test_backfill_fold_forces_full_recompute(replay_file):
+    """_flush_batchers (the backfill path) desyncs the carry; the next
+    evaluated tick must run the full recompute with reason=backfill."""
+    from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
+
+    engine = make_stub_engine(capacity=32, window=WINDOW, incremental=True)
+    by_tick = load_klines_by_tick(replay_file)
+    buckets = sorted(by_tick)
+    _drive(engine, {b: by_tick[b] for b in buckets[:5]})
+    assert engine._carry_desync_reason is None
+
+    # route some history through the backfill-style flush
+    for k in by_tick[buckets[5]]:
+        engine.ingest(k)
+    engine._flush_batchers()
+    assert engine._carry_desync_reason == "backfill"
+    before = _counter_totals().get(("backfill",), 0)
+    _drive(engine, {buckets[6]: by_tick[buckets[6]]})
+    assert _counter_totals().get(("backfill",), 0) == before + 1
+    assert engine._carry_desync_reason is None  # full tick resynced
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint: v2 round-trip + v1 migration
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_v1_migration(tmp_path):
+    """A v1 archive (no indicator carry) restores: prefix leaves load, the
+    carry stays at the template's empty state, and the engine is told to
+    rebuild (``_carry_rebuilt``) so its first tick runs the full step."""
+    import json
+
+    import jax
+
+    from binquant_tpu.engine.buffer import SymbolRegistry
+    from binquant_tpu.io.checkpoint import load_state, save_state
+
+    rng = np.random.default_rng(21)
+    state, tracked, ts, px = _seeded_state(rng, n_rows=4, bars=45)
+    registry = SymbolRegistry(S_CAP)
+    for i in range(4):
+        registry.add(f"S{i}USDT")
+
+    # craft a v1 archive: the non-carry leaf prefix under version 1
+    n_carry = len(jax.tree_util.tree_leaves(state.indicator_carry))
+    leaves = jax.tree_util.tree_leaves(state)
+    v1_leaves = leaves[: len(leaves) - n_carry]
+    meta = {
+        "version": 1,
+        "n_leaves": len(v1_leaves),
+        "registry": registry.to_mapping(),
+        "host_carries": {"ticks_processed": 45},
+    }
+    path = tmp_path / "v1.ckpt.npz"
+    np.savez(
+        path,
+        __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(v1_leaves)},
+    )
+
+    template = initial_engine_state(S_CAP, window=WINDOW)
+    restored, carries = load_state(path, template, SymbolRegistry(S_CAP))
+    assert carries["_carry_rebuilt"] is True
+    assert carries["ticks_processed"] == 45
+    np.testing.assert_array_equal(
+        np.asarray(restored.buf15.times), np.asarray(state.buf15.times)
+    )
+    # carry is the empty template (rebuilt on the first full tick)
+    assert int(np.asarray(restored.indicator_carry.pack15.last_ts).max()) == -1
+
+    # and a CURRENT-version round trip preserves the carry exactly
+    path2 = tmp_path / "v2.ckpt.npz"
+    save_state(path2, state, registry)
+    restored2, carries2 = load_state(path2, template, SymbolRegistry(S_CAP))
+    assert "_carry_rebuilt" not in carries2
+    np.testing.assert_array_equal(
+        np.asarray(restored2.indicator_carry.pack15.last_ts),
+        np.asarray(state.indicator_carry.pack15.last_ts),
+    )

@@ -411,7 +411,11 @@ def test_obs_smoke_scrape_replay_tick(tmp_path):
 
     path = tmp_path / "rp.jsonl"
     generate_replay_file(path, n_symbols=8, n_ticks=6)
-    engine = make_stub_engine(capacity=CAP, window=WIN, pipeline_depth=0)
+    # incremental pinned ON: the smoke also asserts the fast path's
+    # fallback counter + /healthz path accounting below
+    engine = make_stub_engine(
+        capacity=CAP, window=WIN, pipeline_depth=0, incremental=True
+    )
     by_tick = load_klines_by_tick(path)
 
     async def go() -> tuple[str, int, dict]:
@@ -451,6 +455,13 @@ def test_obs_smoke_scrape_replay_tick(tmp_path):
     assert recompiles and recompiles >= 1
     assert _sample_value(body, "bqt_queue_depth", '{queue="batcher15"}') is not None
     assert _sample_value(body, "bqt_registry_symbols") >= 8
+    # incremental indicator path: the cold-start tick is a counted full
+    # recompute; the engine reports both path counters via /healthz too
+    assert "# TYPE bqt_full_recompute_total counter" in body
+    cold = _sample_value(
+        body, "bqt_full_recompute_total", '{reason="cold_start"}'
+    )
+    assert cold and cold >= 1
 
     # the full catalogue is always exposed, used or not
     for family, kind in (
@@ -475,6 +486,11 @@ def test_obs_smoke_scrape_replay_tick(tmp_path):
     assert payload["status"] == "ok"
     assert payload["ticks_processed"] >= 6
     assert payload["heartbeat_age_s"] is not None
+    assert payload["incremental_enabled"] is True
+    assert (
+        payload["incremental_ticks"] + payload["full_recompute_ticks"]
+        == payload["ticks_processed"]
+    )
 
 
 def test_health_snapshot_degrades_on_heartbeat_failure(tmp_path):

@@ -360,3 +360,191 @@ def test_supertrend_matches_pandas():
     high2[80] = np.nan
     got_gap = supertrend(high2[None, :], low2[None, :], close2[None, :])
     assert np.isnan(np.asarray(got_gap.direction)[0, 80:]).all()
+
+
+# ---------------------------------------------------------------------------
+# Incremental carries (ops/incremental.py): init_from_window + one-bar
+# advance must track the full-window kernels over random update streams,
+# including NaN warm-up, mid-stream NaN gaps, and rewrite-triggered
+# re-initialization (ISSUE 2 tentpole parity gate).
+# ---------------------------------------------------------------------------
+
+
+class TestIncrementalOps:
+    W = 256  # sliding-window length: long enough that EWM window
+    # forgetting ((1-a)^W) is far below the assertion tolerances
+
+    def _stream(self, rng, n, scale=100.0, vol=0.01, nan_gaps=()):
+        x = scale * np.exp(np.cumsum(rng.normal(0, vol, n)))
+        x[:17] = np.nan  # warm-up
+        for g in nan_gaps:
+            x[g] = np.nan
+        return x
+
+    def _window(self, x, t):
+        lo = t + 1 - self.W
+        if lo >= 0:
+            return x[lo : t + 1]
+        return np.concatenate([np.full(-lo, np.nan), x[: t + 1]])
+
+    @pytest.mark.parametrize("alpha", [2.0 / 10, 1.0 / 14, 2.0 / 27])
+    def test_ewm_advance_tracks_full_window(self, rng, alpha):
+        from binquant_tpu.ops import incremental as inc
+
+        x = self._stream(rng, self.W + 80, nan_gaps=(40, 41, 200))
+        carry = inc.ewm_init(jnp.asarray(self._window(x, self.W - 1)), alpha)
+        for t in range(self.W, len(x)):
+            carry = inc.ewm_advance(carry, jnp.asarray(x[t]), alpha)
+            full = roll.ewm_mean_last(
+                jnp.asarray(self._window(x, t)), alpha=alpha, min_periods=14
+            )
+            np.testing.assert_allclose(
+                np.asarray(inc.ewm_value(carry, 14)),
+                np.asarray(full),
+                rtol=2e-4,
+                atol=2e-3,
+                equal_nan=True,
+            )
+
+    def test_sum_and_mean_advance(self, rng):
+        from binquant_tpu.ops import incremental as inc
+
+        window = 14
+        x = self._stream(rng, self.W + 80, nan_gaps=(300,))
+        carry = inc.sum_init(jnp.asarray(self._window(x, self.W - 1)), window)
+        for t in range(self.W, len(x)):
+            leaver = self._window(x, t)[-(window + 1)]
+            carry = inc.sum_advance(carry, jnp.asarray(x[t]), jnp.asarray(leaver))
+            full = roll.rolling_mean_last(jnp.asarray(self._window(x, t)), window)
+            np.testing.assert_allclose(
+                np.asarray(inc.sum_mean(carry, window)),
+                np.asarray(full),
+                rtol=1e-5,
+                atol=1e-4,
+                equal_nan=True,
+            )
+
+    @pytest.mark.parametrize("scale", [100.0, 68_000.0])
+    def test_moment_advance_mean_std(self, rng, scale):
+        """Centered sum-of-squares stays f32-exact even at BTC-scale
+        prices (the uncentered form loses ~8% of a 20-bar variance)."""
+        from binquant_tpu.ops import incremental as inc
+
+        window = 20
+        x = self._stream(rng, self.W + 100, scale=scale, vol=0.004, nan_gaps=(290,))
+        carry = inc.moment_init(jnp.asarray(self._window(x, self.W - 1)), window)
+        for t in range(self.W, len(x)):
+            leaver = self._window(x, t)[-(window + 1)]
+            carry = inc.moment_advance(carry, jnp.asarray(x[t]), jnp.asarray(leaver))
+            win = jnp.asarray(self._window(x, t))
+            np.testing.assert_allclose(
+                np.asarray(inc.moment_mean(carry, window)),
+                np.asarray(roll.rolling_mean_last(win, window)),
+                rtol=1e-5,
+                atol=scale * 1e-5,
+                equal_nan=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(inc.moment_std(carry, window, ddof=0)),
+                np.asarray(roll.rolling_std_last(win, window, ddof=0)),
+                rtol=5e-3,
+                atol=scale * 1e-5,
+                equal_nan=True,
+            )
+
+    def test_rewrite_requires_reinit_and_reinit_matches(self, rng):
+        """A mid-window rewrite invalidates carried sums; re-init from the
+        rewritten window (what the engine's full-recompute fallback does)
+        restores exact parity on the same tick AND on subsequent advances."""
+        from binquant_tpu.ops import incremental as inc
+
+        window = 14
+        x = self._stream(rng, self.W + 40)
+        carry = inc.sum_init(jnp.asarray(self._window(x, self.W - 1)), window)
+        for t in range(self.W, self.W + 10):
+            carry = inc.sum_advance(
+                carry, jnp.asarray(x[t]), jnp.asarray(self._window(x, t)[-(window + 1)])
+            )
+        t = self.W + 9
+        x[t - 5] *= 1.5  # exchange re-sent a corrected mid-window candle
+        full = roll.rolling_mean_last(jnp.asarray(self._window(x, t)), window)
+        stale = inc.sum_mean(carry, window)
+        assert not np.allclose(np.asarray(stale), np.asarray(full))
+        carry = inc.sum_init(jnp.asarray(self._window(x, t)), window)  # resync
+        for t in range(self.W + 10, len(x)):
+            carry = inc.sum_advance(
+                carry, jnp.asarray(x[t]), jnp.asarray(self._window(x, t)[-(window + 1)])
+            )
+            np.testing.assert_allclose(
+                np.asarray(inc.sum_mean(carry, window)),
+                np.asarray(
+                    roll.rolling_mean_last(jnp.asarray(self._window(x, t)), window)
+                ),
+                rtol=1e-5,
+                atol=1e-4,
+                equal_nan=True,
+            )
+
+    def test_supertrend_advance_extends_scan(self, rng):
+        """advance == extending the path-dependent scan by exactly one bar
+        (the contract that makes the carry a drop-in for the recursion)."""
+        from binquant_tpu.ops import incremental as inc
+
+        n = 140
+        close = 100 * np.exp(np.cumsum(rng.normal(0.001, 0.01, (3, n)), axis=1))
+        spread = np.abs(rng.normal(0, 0.004, (3, n))) * close
+        high, low = close + spread, close - spread
+        high[1, :9] = np.nan
+        low[1, :9] = np.nan
+        close[1, :9] = np.nan
+        H, L, C = jnp.asarray(high), jnp.asarray(low), jnp.asarray(close)
+        # the scan is causal, so one full-series run supplies the expected
+        # value at EVERY prefix length (per-prefix scans would jit-compile
+        # a fresh program per t)
+        full = ind.supertrend(H, L, C)
+        full_line = np.asarray(full.supertrend)
+        full_dir = np.asarray(full.direction)
+        carry = inc.supertrend_init(H[:, :60], L[:, :60], C[:, :60])
+        for t in range(60, n):
+            carry, line, dirn = inc.supertrend_advance(
+                carry, H[:, t], L[:, t], C[:, t]
+            )
+            np.testing.assert_allclose(
+                np.asarray(line), full_line[:, t], rtol=1e-5, equal_nan=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(dirn), full_dir[:, t], equal_nan=True
+            )
+
+    def test_beta_corr_advance(self, rng):
+        from binquant_tpu.ops import incremental as inc
+
+        window = 50
+        n = self.W + 60
+        x = rng.normal(0, 0.01, (3, n))
+        y = rng.normal(0, 0.01, n)
+        x[2, 310] = np.nan  # asymmetric gap: pair masking must hold
+        X, Y = jnp.asarray(x), jnp.asarray(y)
+        carry = inc.beta_corr_init(X[:, : self.W], Y[None, : self.W], window)
+        for t in range(self.W, n):
+            carry = inc.beta_corr_advance(
+                carry, X[:, t], Y[t], X[:, t - window], Y[t - window]
+            )
+            full = ind.rolling_beta_corr(
+                X[:, t - self.W + 1 : t + 1], Y[None, t - self.W + 1 : t + 1], window
+            )
+            beta, corr = inc.beta_corr_value(carry, window)
+            np.testing.assert_allclose(
+                np.asarray(beta),
+                np.asarray(full.beta[:, -1]),
+                rtol=1e-3,
+                atol=1e-3,
+                equal_nan=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(corr),
+                np.asarray(full.corr[:, -1]),
+                rtol=1e-3,
+                atol=1e-3,
+                equal_nan=True,
+            )

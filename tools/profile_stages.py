@@ -140,6 +140,18 @@ def main() -> None:
     jitted_pack = jax.jit(compute_feature_pack)
     stage("compute_feature_pack", jitted_pack, state.buf15)
 
+    # incremental twin: carried indicator state advanced by the newest bar
+    # (the live fast path — engine/step.py incremental=True)
+    from binquant_tpu.strategies.features import (
+        compute_feature_pack_incremental,
+        init_feature_carry,
+    )
+
+    carry15 = jax.jit(init_feature_carry)(state.buf15)
+    _sync(carry15)
+    jitted_pack_incr = jax.jit(compute_feature_pack_incremental)
+    stage("compute_feature_pack (incremental)", jitted_pack_incr, state.buf15, carry15)
+
     jitted_spikes = jax.jit(detect_spikes)
     stage("detect_spikes", jitted_spikes, state.buf15)
 
@@ -216,12 +228,27 @@ def main() -> None:
     stage("range_failed_breakout_fade", jax.jit(range_failed_breakout_fade), spikes, ctx)
     stage("relative_strength_reversal_range", jax.jit(relative_strength_reversal_range), state.buf15, pack15, ctx)
 
-    # --- end-to-end
+    # --- end-to-end: full recompute vs the incremental fast path
     def full_dev():
         s2, out = tick_step(state, upd_dev, upd_dev, inputs_dev, cfg)
         return out.summary.trigger
 
     stage("tick_step (device-resident inputs)", full_dev)
+
+    from binquant_tpu.engine.step import init_indicator_carry
+
+    state_sync = state._replace(
+        indicator_carry=jax.jit(init_indicator_carry)(state.buf5, state.buf15)
+    )
+    _sync(state_sync.indicator_carry)
+
+    def incr_dev():
+        s2, out = tick_step(
+            state_sync, upd_dev, upd_dev, inputs_dev, cfg, incremental=True
+        )
+        return out.summary.trigger
+
+    stage("tick_step (incremental carry)", incr_dev)
 
     def full_host():
         s2, out = tick_step(state, upd, upd, inputs, cfg)
@@ -238,6 +265,30 @@ def main() -> None:
 
     total_compute = sum(m for n, m, _ in results if not n.startswith(("rtt", "h2d", "tick_step")))
     print(f"{'sum of compute stages':38s} p50={total_compute:9.3f} ms", file=sys.stderr)
+
+    by_name = {n: m for n, m, _ in results}
+    full_ms = by_name.get("tick_step (device-resident inputs)")
+    incr_ms = by_name.get("tick_step (incremental carry)")
+    if full_ms and incr_ms:
+        print(
+            f"{'full vs incremental step':38s} "
+            f"{full_ms:9.3f} ms vs {incr_ms:9.3f} ms "
+            f"({full_ms / max(incr_ms, 1e-9):.2f}x)",
+            file=sys.stderr,
+        )
+    # fallback accounting the live engine would report for this session —
+    # zero here (no engine ran), printed so the obs wiring is visible from
+    # the profiling workflow too
+    from binquant_tpu.obs.instruments import FULL_RECOMPUTE, TICKS
+
+    recompute = {
+        labels: child.value for labels, child in FULL_RECOMPUTE.children()
+    }
+    print(
+        f"bqt_full_recompute_total={recompute or 0} "
+        f"bqt_ticks_total={TICKS.value}",
+        file=sys.stderr,
+    )
 
 
 if __name__ == "__main__":

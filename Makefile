@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke trace-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -15,6 +15,9 @@ help:
 	@echo "               tests/test_obs.py)"
 	@echo "  incr-smoke - fast CPU smoke of the incremental indicator path"
 	@echo "               (step parity + pipeline gating, tier-1 lane)"
+	@echo "  trace-smoke- replay with tracing on and BQT_TRACE_SLOW_MS=0"
+	@echo "               (every tick flight-recorded), then render the 3"
+	@echo "               slowest ticks with tools/trace_report.py"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run"
 	@echo "  lint       - ruff check"
 	@echo "offline kernel profiling: tools/profile_stages.py captures"
@@ -30,7 +33,16 @@ smoke:
 	python bench.py --smoke
 
 obs-smoke:
-	python -m pytest tests/test_obs.py -q -m "not slow" -k "obs_smoke or healthz"
+	python -m pytest tests/test_obs.py tests/test_tracing.py -q -m "not slow" \
+		-k "obs_smoke or healthz or provenance or flight"
+
+trace-smoke:
+	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay_trace.jsonl', n_symbols=8, n_ticks=6)"
+	rm -f /tmp/bqt_trace_events.jsonl
+	BQT_TRACE_SAMPLE=1 BQT_TRACE_SLOW_MS=0 \
+	BQT_EVENT_LOG=/tmp/bqt_trace_events.jsonl JAX_PLATFORMS=cpu \
+	python main.py --replay /tmp/replay_trace.jsonl
+	python tools/trace_report.py /tmp/bqt_trace_events.jsonl --slowest 3
 
 incr-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_incremental.py -q -m "not slow"

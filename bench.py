@@ -41,6 +41,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 
@@ -1028,6 +1029,10 @@ def _device_preflight(
 
 
 def main() -> int | None:
+    # The bench quotes the UNTRACED hot path (the shape the p99<50ms
+    # budget is judged against); an explicit BQT_TRACE_SAMPLE still wins,
+    # so the tracing overhead itself can be measured by setting it to 1.
+    os.environ.setdefault("BQT_TRACE_SAMPLE", "0")
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny shapes")
     parser.add_argument(
@@ -1074,8 +1079,6 @@ def main() -> int | None:
     # pandas baseline and must stay runnable during outages), and only
     # when a hang is possible (a forced-CPU backend can't hang, so CI's
     # smoke job pays nothing).
-    import os
-
     needs_device = not args.config1
     may_hang = os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
     if needs_device and may_hang:

@@ -198,6 +198,39 @@ class Config:
         return int(self._get("BQT_METRICS_PORT", "0") or 0)
 
     @cached_property
+    def trace_sample(self) -> float:
+        """Tick-trace sampling rate: 1 traces every tick (production
+        default — the span tree is ~a dozen dict/perf_counter ops), 0.25
+        every 4th (deterministic accumulator, no RNG), 0 disables tracing
+        entirely (the hot path sees only no-op context managers)."""
+        return float(self._get("BQT_TRACE_SAMPLE", "1") or "1")
+
+    @cached_property
+    def trace_slow_ms(self) -> float:
+        """Flight-recorder budget: a traced tick whose BUSY time (span
+        work, excluding pipeline dwell) reaches this many ms — or that
+        errors — is force-emitted with an engine snapshot and counted in
+        bqt_slow_ticks_total{stage}. 0 force-emits every traced tick."""
+        return float(self._get("BQT_TRACE_SLOW_MS", "50") or "50")
+
+    @cached_property
+    def trace_ring(self) -> int:
+        """Completed-trace ring size (the flight recorder's memory)."""
+        return int(self._get("BQT_TRACE_RING", "256") or "256")
+
+    @cached_property
+    def profile_dir(self) -> str:
+        """Output directory for on-demand jax.profiler capture windows
+        (/debug/profile?seconds=N and SIGUSR2)."""
+        return self._get("BQT_PROFILE_DIR", "/tmp/bqt_profile")
+
+    @cached_property
+    def profile_remote_ok(self) -> bool:
+        """Allow non-loopback peers to open /debug/profile windows (the
+        route is side-effectful; default loopback-only)."""
+        return self._get("BQT_PROFILE_REMOTE", "0") == "1"
+
+    @cached_property
     def event_log(self) -> str:
         """Structured JSONL event sink: "" disables, "stderr"/"-" writes
         to stderr, anything else is a rotating file path."""

@@ -992,6 +992,7 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
 
     from binquant_tpu.obs.events import get_event_log
     from binquant_tpu.obs.instruments import JIT_RECOMPILES, SYMBOLS_PER_TICK
+    from binquant_tpu.obs.tracing import current_trace_id
 
     SYMBOLS_PER_TICK.labels(interval="5m").set(
         int(np.count_nonzero(np.asarray(upd5[0]) >= 0))
@@ -1021,6 +1022,8 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
         update5_rows=signature[5][0],
         update15_rows=signature[6][0],
         wire_enabled=list(wire_enabled),
+        # the tick whose dispatch is paying this compile (None off-tick)
+        trace_id=current_trace_id(),
     )
     return True
 

@@ -39,6 +39,28 @@ class BinbotApi:
     # -- plumbing -----------------------------------------------------------
 
     def _request(self, method: str, path: str, **kwargs) -> Any:
+        """One REST round trip. When a tick trace is active (the call is
+        on the tick's emission path), the request gets its own span —
+        attributed HTTP latency per backend call, joined to the producing
+        tick by trace_id — and failures mark the span (and therefore the
+        trace) errored. Off-tick calls (boot, background workers) see only
+        the counters, as before."""
+        from binquant_tpu.obs.tracing import current_trace
+
+        trace = current_trace()
+        if trace is None:
+            return self._request_inner(method, path, **kwargs)
+        with trace.span(
+            f"binbot.{method.lower()}", path=path
+        ) as span:
+            try:
+                payload = self._request_inner(method, path, **kwargs)
+            except Exception as exc:
+                span.set(error=str(exc))
+                raise
+            return payload
+
+    def _request_inner(self, method: str, path: str, **kwargs) -> Any:
         url = f"{self.base_url}{path}"
         try:
             resp = self.session.request(method, url, **kwargs)

@@ -7,6 +7,14 @@ fired (strategy, symbol) pairs, the ``SignalsConsumer`` payload (with the
 strategy's bot params), the structured Telegram message (uniform key/value
 line shape the reference's downstream parsers rely on), and the analytics
 record body (``producers/context_evaluator.py:268-333``).
+
+Deliberate extension over the reference formats (README §Tracing): when
+the producing tick was traced, ``SignalEngine._finalize_tick`` appends a
+``- Trace: <trace_id>/<tick_seq>`` bullet to the Telegram message and
+adds ``trace_id``/``tick_seq`` keys to the analytics record and
+``SignalsConsumer.metadata`` — additive only, so the reference's keyed
+bullet lines and field set are preserved; parsers must tolerate the
+extra line/keys (the fingerprint dedupe in io/telegram.py does).
 """
 
 from __future__ import annotations
@@ -90,6 +98,11 @@ class FiredSignal:
         # wall-clock ms (pipelined emission happens one process_tick call
         # after dispatch, so callers can't infer this from call order)
         self.tick_ms: int | None = None
+        # trace provenance (also stamped by _finalize_tick, when the tick
+        # was traced): joins this signal — and every sink payload built
+        # from it — back to the engine tick's span tree in the event log
+        self.trace_id: str | None = None
+        self.tick_seq: int | None = None
 
 
 def _cast_diag(kind: str, v: float):

@@ -13,6 +13,7 @@ bucket (klines_provider.py:244-250,305-319), KuCoin OI with a 5 s TTL cache
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import math
 import time
@@ -60,6 +61,11 @@ from binquant_tpu.obs.instruments import (
     QUEUE_DEPTH,
     SIGNALS,
     TICKS,
+)
+from binquant_tpu.obs.tracing import (
+    Tracer,
+    profiler_window_active,
+    step_annotation,
 )
 from binquant_tpu.regime.context import ContextConfig
 from binquant_tpu.regime.grid_policy import GridOnlyPolicy
@@ -236,6 +242,9 @@ class _PendingTick(NamedTuple):
     dispatched_at: float  # perf_counter at dispatch (signal-lag metric)
     rows: Any  # FrozenRows — row→symbol AS OF dispatch (registry churn
     # between dispatch and finalize must not re-attribute fired rows)
+    trace: Any  # TickTrace (or NULL_TRACE when sampled out) — opened at
+    # dispatch, closed when this tick finalizes; its trace_id is the
+    # provenance every sink payload carries
 
 
 class SignalEngine:
@@ -346,6 +355,18 @@ class SignalEngine:
         # per-stage latency histograms (SURVEY §5: the p99<50ms budget is
         # measured in production, not guessed)
         self.latency = LatencyTracker()
+        # per-tick span traces + slow-tick flight recorder (obs/tracing.py);
+        # histograms prove the p99 budget is breached, the trace says WHERE
+        self.tracer = Tracer(
+            sample=float(getattr(config, "trace_sample", 1.0)),
+            slow_ms=float(getattr(config, "trace_slow_ms", 50.0)),
+            ring=int(getattr(config, "trace_ring", 256)),
+        )
+        # tick_seq source for traces: advances on every dispatch ATTEMPT
+        # (ticks_processed only counts successes — deriving the seq from
+        # it would hand a failed tick's number to the retry, and tick_seq
+        # is the human-facing join key trace_report filters on)
+        self._tick_seq = 0
         # Fired-tick fast path: consume_loop lands + emits a dispatched
         # tick's wire as soon as it arrives instead of waiting for the next
         # tick to evict it — cuts the depth-1 emission lag from one full
@@ -632,7 +653,14 @@ class SignalEngine:
             except Exception:
                 logging.exception("leverage calibration crashed; continuing")
             return
-        self._calibration_task = loop.create_task(_job())
+        # detach the tick's trace first: the worker (a thread, via
+        # to_thread) would otherwise inherit it through the contextvar and
+        # race the tick thread's unsynchronized span stack with its
+        # per-symbol REST-call spans
+        from binquant_tpu.obs.tracing import detached
+
+        with detached():
+            self._calibration_task = loop.create_task(_job())
 
     # -- breadth-derived inputs ----------------------------------------------
 
@@ -715,10 +743,28 @@ class SignalEngine:
         return []
 
     async def _dispatch_tick(self, now_ms: int | None = None) -> _PendingTick:
-        """Drain batchers and launch the jit'd step + async wire transfer."""
+        """Drain batchers and launch the jit'd step + async wire transfer.
+
+        A dispatch-phase failure (fold, input build, the jit launch)
+        completes the tick's trace as errored before propagating — those
+        are exactly the ticks the flight recorder must capture, and no
+        ``_PendingTick`` will ever carry this trace to finalize."""
+        ts_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        # one trace per tick (or NULL_TRACE when sampled out); on success
+        # it rides the _PendingTick and is completed — flight-recorder
+        # check included — when the tick finalizes
+        self._tick_seq += 1
+        trace = self.tracer.begin_tick(self._tick_seq, tick_ms=ts_ms)
+        try:
+            return await self._dispatch_tick_inner(ts_ms, trace)
+        except BaseException as exc:
+            trace.mark_error(exc)
+            self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+            raise
+
+    async def _dispatch_tick_inner(self, ts_ms: int, trace) -> _PendingTick:
         import jax.numpy as jnp
 
-        ts_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         ts_s = ts_ms // 1000
         # Evaluate against the bar that just CLOSED: its open time is one
         # full interval behind the current wall-clock bucket.
@@ -726,10 +772,10 @@ class SignalEngine:
         ts15 = bucket15 * FIFTEEN_MIN_S - FIFTEEN_MIN_S
         ts5 = (ts_s // FIVE_MIN_S) * FIVE_MIN_S - FIVE_MIN_S
 
-        with self.latency.stage("breadth_refresh"):
+        with self.latency.stage("breadth_refresh"), trace.span("breadth_refresh"):
             await self._refresh_market_breadth(bucket15)
 
-        with self.latency.stage("ingest_drain"):
+        with self.latency.stage("ingest_drain"), trace.span("ingest_drain") as sp_drain:
             # backlog at dispatch: how many deduped candles this tick drains
             QUEUE_DEPTH.labels(queue="batcher5").set(len(self.batcher5))
             QUEUE_DEPTH.labels(queue="batcher15").set(len(self.batcher15))
@@ -741,6 +787,11 @@ class SignalEngine:
             # device-side carry — the window's interior changes without
             # the latest bar moving)
             clean_appends = self._note_applied(batches5, batches15)
+            sp_drain.set(
+                batches5=len(batches5),
+                batches15=len(batches15),
+                clean_appends=clean_appends,
+            )
             if not clean_appends:
                 self._mark_carry_desynced("rewrite")
             # OI growth for symbols with fresh 15m candles (reference
@@ -784,28 +835,34 @@ class SignalEngine:
             and self.ticks_processed > 0
             and self.ticks_processed % self.carry_audit_every == 0
         )
-        if not self.incremental:
-            use_incremental, reason = False, None
-        elif self._carry_desync_reason is not None:
-            use_incremental, reason = False, self._carry_desync_reason
-        elif audit_due:
-            use_incremental, reason = False, "audit"
-        else:
-            use_incremental, reason = True, None
-        if self.incremental:
-            if use_incremental:
-                self.incremental_ticks += 1
+        with trace.span("route_decision") as sp_route:
+            if not self.incremental:
+                use_incremental, reason = False, None
+            elif self._carry_desync_reason is not None:
+                use_incremental, reason = False, self._carry_desync_reason
+            elif audit_due:
+                use_incremental, reason = False, "audit"
             else:
-                self.full_recompute_ticks += 1
-                FULL_RECOMPUTE.labels(reason=reason).inc()
+                use_incremental, reason = True, None
+            if self.incremental:
+                if use_incremental:
+                    self.incremental_ticks += 1
+                else:
+                    self.full_recompute_ticks += 1
+                    FULL_RECOMPUTE.labels(reason=reason).inc()
+            path = "incremental" if use_incremental else "full"
+            sp_route.set(path=path, full_recompute_reason=reason)
+            # root attr: the ring summary / healthz "carry path taken"
+            trace.set_attr(path=path if reason is None else f"{path}:{reason}")
 
         # Ordered sub-batch replay: fold all but the FINAL sub-batch into
         # the buffers, then run ONE full evaluation on the final state.
         # On the fast path the folds advance the carry too, so multi-bar
         # clean-append drains stay incremental.
-        u5, u15 = self._fold_updates(
-            batches5, batches15, advance_carry=use_incremental
-        )
+        with trace.span("buffer_fold", advance_carry=use_incremental):
+            u5, u15 = self._fold_updates(
+                batches5, batches15, advance_carry=use_incremental
+            )
         t_inputs0 = time.perf_counter()
         if self._base_inputs is None:
             self._base_inputs = default_host_inputs(self.capacity)
@@ -869,7 +926,10 @@ class SignalEngine:
         self.latency.record(
             "inputs_build", (time.perf_counter() - t_inputs0) * 1000.0
         )
-        with self.latency.stage("device_dispatch"):
+        trace.record_span("inputs_build", t_inputs0)
+        with self.latency.stage("device_dispatch"), trace.span(
+            "device_dispatch", incremental=use_incremental
+        ), trace.activate():
             # Wire-only step: the full TickOutputs pytree is ~400 output
             # buffers whose handle creation dominates dispatch (measured
             # ~6.6 ms vs ~2.9 ms at S=2048 through the tunneled chip). The
@@ -886,19 +946,29 @@ class SignalEngine:
                 incremental=use_incremental,
                 maintain_carry=self.incremental,
             )
-            self.state, wire = tick_step_wire(
-                prev_state,
-                u5,
-                u15,
-                inputs,
-                self.context_config,
-                # device-side wire compaction must match the host's enabled set
-                wire_enabled=self._wire_enabled_key(),
-                incremental=use_incremental,
-                # classic-path deployments (BQT_INCREMENTAL=0) never read
-                # the carry — skip its full-window re-init entirely
-                maintain_carry=self.incremental,
+            # StepTraceAnnotation groups this tick's XLA work in profiler
+            # captures; skipped entirely on untraced ticks outside a
+            # /debug/profile window (hot path stays annotation-free)
+            step_ctx = (
+                step_annotation(self._tick_seq)
+                if trace.active or profiler_window_active()
+                else contextlib.nullcontext()
             )
+            with step_ctx:
+                self.state, wire = tick_step_wire(
+                    prev_state,
+                    u5,
+                    u15,
+                    inputs,
+                    self.context_config,
+                    # device-side wire compaction must match the host's
+                    # enabled set
+                    wire_enabled=self._wire_enabled_key(),
+                    incremental=use_incremental,
+                    # classic-path deployments (BQT_INCREMENTAL=0) never
+                    # read the carry — skip its full-window re-init entirely
+                    maintain_carry=self.incremental,
+                )
             if not use_incremental:
                 # the full step re-initialized the carry from the windows
                 self._carry_desync_reason = None
@@ -965,17 +1035,35 @@ class SignalEngine:
             bucket15=bucket15,
             dispatched_at=time.perf_counter(),
             rows=self.registry.frozen_rows(),
+            trace=trace,
         )
 
     async def _finalize_tick(self, pending: _PendingTick) -> list:
         """Consume one dispatched tick's wire: refresh host policy state and
-        emit its fired signals through the three sinks."""
+        emit its fired signals through the three sinks. Afterwards the
+        tick's trace is completed — ring append, ``trace`` event, and the
+        slow-tick flight-recorder check — even if finalize raised (an
+        errored tick is exactly what the recorder must capture)."""
+        trace = pending.trace
+        with trace.activate():
+            try:
+                return await self._finalize_tick_inner(pending, trace)
+            except BaseException as exc:
+                # ANY exception escaping finalize — spanned or not — must
+                # flag the trace, or the recorder would file the tick ok
+                trace.mark_error(exc)
+                raise
+            finally:
+                self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+
+    async def _finalize_tick_inner(self, pending: _PendingTick, trace) -> list:
         ts5, ts15 = pending.ts5, pending.ts15
         # ONE device fetch per tick: the packed wire (context scalars +
         # compacted fired entries). Everything host-side below reads it.
-        with self.latency.stage("wire_fetch"):
+        with self.latency.stage("wire_fetch"), trace.span("wire_fetch") as sp_wire:
             unpacked = unpack_wire(pending.wire)
         fired_w, ctx_scalars = unpacked
+        sp_wire.set(overflow=bool(fired_w.overflow))
         # The full TickOutputs exists only if a degenerate path needs it:
         # compaction overflow (>WIRE_MAX_FIRED fired pairs) or a wire
         # without the emission payload. Re-running the full step costs one
@@ -986,7 +1074,9 @@ class SignalEngine:
             if fired_w.overflow:
                 self.overflow_ticks += 1
                 OVERFLOW_TICKS.inc()
-            with self.latency.stage("overflow_fallback"):
+            with self.latency.stage("overflow_fallback"), trace.span(
+                "overflow_fallback", overflow=bool(fired_w.overflow)
+            ):
                 outputs = pending.fallback()
         regime = ctx_scalars["market_regime"]
         has_ctx = ctx_scalars["valid"]
@@ -1044,44 +1134,80 @@ class SignalEngine:
         settings = self.at_consumer.autotrade_settings
         from binquant_tpu.engine.step import EMISSION_LAYOUTS
 
-        fired = extract_fired(
-            outputs,
-            # row→symbol AS OF dispatch: a row freed and re-claimed between
-            # dispatch and finalize must not attribute this tick's signal
-            # to the new occupant
-            pending.rows,
-            env=self.config.env,
-            exchange=self.at_consumer.exchange,
-            # use_enum_values schemas store the plain value string; raw
-            # enums (tests, direct construction) need .value
-            market_type=getattr(
-                settings.market_type, "value", settings.market_type
-            ),
-            settings=settings,
-            enabled=self.enabled_strategies,
-            # pre-materialization skip: standing triggers already emitted
-            # for this bar cost nothing (no diagnostics fetch, no payloads)
-            skip=lambda strategy, row: self._already_emitted(
-                strategy, pending.rows.name_of(row), ts5, ts15
-            ),
-            unpacked=unpacked,
-            # diagnostics slot layout recorded when this wire_enabled combo
-            # was traced — lets emission decode the wire's per-slot payload
-            # instead of fetching arrays from the device
-            diag_layout=EMISSION_LAYOUTS.get(self._wire_enabled_key()),
-        )
-        fired = self._dedupe_fired(fired, ts5, ts15)
-        for signal in fired:
-            dispatch_signal_record(self.binbot_api, signal.analytics)
-            self.telegram_consumer.dispatch_signal(signal.message)
-            try:
-                await self.at_consumer.process_autotrade_restrictions(signal.value)
-            except Exception:
-                logging.exception(
-                    "autotrade processing crashed for %s/%s; continuing",
-                    signal.strategy,
-                    signal.symbol,
+        with trace.span("extract_fired") as sp_extract:
+            fired = extract_fired(
+                outputs,
+                # row→symbol AS OF dispatch: a row freed and re-claimed
+                # between dispatch and finalize must not attribute this
+                # tick's signal to the new occupant
+                pending.rows,
+                env=self.config.env,
+                exchange=self.at_consumer.exchange,
+                # use_enum_values schemas store the plain value string; raw
+                # enums (tests, direct construction) need .value
+                market_type=getattr(
+                    settings.market_type, "value", settings.market_type
+                ),
+                settings=settings,
+                enabled=self.enabled_strategies,
+                # pre-materialization skip: standing triggers already
+                # emitted for this bar cost nothing (no diagnostics fetch,
+                # no payloads)
+                skip=lambda strategy, row: self._already_emitted(
+                    strategy, pending.rows.name_of(row), ts5, ts15
+                ),
+                unpacked=unpacked,
+                # diagnostics slot layout recorded when this wire_enabled
+                # combo was traced — lets emission decode the wire's
+                # per-slot payload instead of fetching device arrays
+                diag_layout=EMISSION_LAYOUTS.get(self._wire_enabled_key()),
+            )
+            sp_extract.set(fired=len(fired))
+        with trace.span("dedupe") as sp_dedupe:
+            fired = self._dedupe_fired(fired, ts5, ts15)
+            sp_dedupe.set(kept=len(fired))
+        if trace.active:
+            # signal provenance: every outbound payload joins back to the
+            # tick that produced it — stamped BEFORE any sink sees it
+            for signal in fired:
+                signal.trace_id = trace.trace_id
+                signal.tick_seq = trace.tick_seq
+                signal.value.metadata["trace_id"] = trace.trace_id
+                signal.value.metadata["tick_seq"] = trace.tick_seq
+                signal.analytics["trace_id"] = trace.trace_id
+                signal.analytics["tick_seq"] = trace.tick_seq
+                signal.message += (
+                    f"\n- Trace: {trace.trace_id}/{trace.tick_seq}"
                 )
+        with trace.span("emission", signals=len(fired)):
+            for signal in fired:
+                with trace.span(
+                    "sink.analytics",
+                    strategy=signal.strategy,
+                    symbol=signal.symbol,
+                ):
+                    dispatch_signal_record(self.binbot_api, signal.analytics)
+                with trace.span(
+                    "sink.telegram",
+                    strategy=signal.strategy,
+                    symbol=signal.symbol,
+                ):
+                    self.telegram_consumer.dispatch_signal(signal.message)
+                try:
+                    with trace.span(
+                        "sink.autotrade",
+                        strategy=signal.strategy,
+                        symbol=signal.symbol,
+                    ):
+                        await self.at_consumer.process_autotrade_restrictions(
+                            signal.value
+                        )
+                except Exception:
+                    logging.exception(
+                        "autotrade processing crashed for %s/%s; continuing",
+                        signal.strategy,
+                        signal.symbol,
+                    )
         self.latency.record("emission", (time.perf_counter() - t_emit0) * 1000.0)
         self.signals_emitted += len(fired)
         # Signal-latency accounting (the number a trading system cares
@@ -1105,6 +1231,8 @@ class SignalEngine:
                 direction=str(signal.value.direction),
                 autotrade=bool(signal.value.autotrade),
                 tick_ms=pending.ts_ms,
+                trace_id=signal.trace_id,
+                tick_seq=signal.tick_seq,
             )
             bar_close_ms = (
                 (ts5 + FIVE_MIN_S) * 1000
@@ -1324,6 +1452,25 @@ class SignalEngine:
                     self._HB_WARN_EVERY_S,
                 )
 
+    def _flight_snapshot(self) -> dict:
+        """Engine state attached to a flight-recorder (slow/errored tick)
+        force-emit: what the engine looked like when the breach happened.
+        Attribute reads only — computed lazily, never on healthy ticks."""
+        return {
+            "queue_depth": {
+                "batcher5": len(self.batcher5),
+                "batcher15": len(self.batcher15),
+            },
+            "symbols": len(self.registry.names),
+            "pending_ticks": len(self._pending),
+            "ticks_processed": self.ticks_processed,
+            "signals_emitted": self.signals_emitted,
+            "overflow_ticks": self.overflow_ticks,
+            "incremental_ticks": self.incremental_ticks,
+            "full_recompute_ticks": self.full_recompute_ticks,
+            "carry_desync_reason": self._carry_desync_reason,
+        }
+
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
         """Liveness JSON for the /healthz endpoint (obs.exposition).
 
@@ -1361,6 +1508,12 @@ class SignalEngine:
             "incremental_enabled": self.incremental,
             "incremental_ticks": self.incremental_ticks,
             "full_recompute_ticks": self.full_recompute_ticks,
+            # event-log drops (write failures / emit-after-close) — zero
+            # in a healthy deployment
+            "eventlog_dropped": get_event_log().dropped,
+            # the latest completed tick's trace summary (total ms, slowest
+            # stage, carry path) — None while tracing is sampled off
+            "last_tick_trace": self.tracer.last_tick_trace(),
         }
 
     # -- loops (main.py:37-57) ------------------------------------------------

@@ -9,6 +9,9 @@ Three dependency-free pieces, importable everywhere (no jax, no httpx):
 * :mod:`events` — a structured JSONL event log for discrete facts
   (reconnects, signals, autotrade attempts, checkpoint saves, JIT
   compiles), each stamped with wall + monotonic time and the tick number.
+* :mod:`tracing` — per-tick ``Tracer``/``Span`` trees with trace_id
+  provenance, the slow-tick flight recorder ring, and the on-demand
+  ``jax.profiler`` capture window (``/debug/profile`` + SIGUSR2).
 
 The metric name catalogue lives in :mod:`instruments` (one definition per
 family — importing any instrumented module registers the whole catalogue,

@@ -22,6 +22,9 @@ Sinks: ``None`` disables (emit is a cheap no-op — safe on hot paths),
 size-based rotation (``path`` -> ``path.1``). The process default is
 configured by ``BQT_EVENT_LOG`` and reachable via :func:`get_event_log`;
 ``emit`` never raises — a full disk must not take down the tick loop.
+Records lost that way are not silent: every failed write, and every emit
+after :meth:`EventLog.close`, increments :attr:`EventLog.dropped` and the
+``bqt_eventlog_dropped_total`` counter (surfaced by ``health_snapshot``).
 """
 
 from __future__ import annotations
@@ -48,11 +51,13 @@ class EventLog:
         self.max_bytes = int(max_bytes)
         self.backups = max(int(backups), 0)
         self.tick = 0
+        self.dropped = 0
         self._seq = 0
         self._lock = threading.Lock()
         self._fh: IO[str] | None = None
         self._path: Path | None = None
         self._warned = False
+        self._closed = False
         if sink in (None, ""):
             self.enabled = False
         elif str(sink) in ("stderr", "-"):
@@ -68,6 +73,9 @@ class EventLog:
         if not self.enabled:
             return None
         with self._lock:
+            if self._closed:
+                self._drop()
+                return None
             self._seq += 1
             record = {
                 "event": event,
@@ -83,11 +91,21 @@ class EventLog:
                 fh.write(line + "\n")
                 fh.flush()
             except Exception:
+                self._drop()
                 if not self._warned:
                     self._warned = True
-                    log.exception("event log write failed; further failures silent")
+                    log.exception(
+                        "event log write failed; further failures counted "
+                        "in bqt_eventlog_dropped_total, not logged"
+                    )
                 return None
             return record
+
+    def _drop(self) -> None:
+        from binquant_tpu.obs.instruments import EVENTLOG_DROPPED
+
+        self.dropped += 1
+        EVENTLOG_DROPPED.inc()
 
     def _file(self) -> IO[str]:
         if self._path is None:
@@ -114,10 +132,15 @@ class EventLog:
                 os.replace(src, f"{self._path}.{i}")
 
     def close(self) -> None:
+        """Close a path sink. Later emits are DROPPED (counted in
+        ``dropped`` / ``bqt_eventlog_dropped_total``) rather than silently
+        reopening the file a shutdown sequence believes is closed."""
         with self._lock:
-            if self._path is not None and self._fh is not None:
-                self._fh.close()
-                self._fh = None
+            if self._path is not None:
+                self._closed = True
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
 
 
 _default_log: EventLog | None = None

@@ -11,7 +11,16 @@ client) that serves:
   HTTP 200 while the process is live (``status`` of ``ok`` or
   ``degraded`` — a ticking engine whose heartbeat writes fail is alive;
   restarting it would not fix a full disk) and 503 otherwise, so
-  orchestrators can probe it directly without killing live engines.
+  orchestrators can probe it directly without killing live engines;
+* ``GET /debug/profile?seconds=N`` — opens an on-demand ``jax.profiler``
+  capture window through an injected
+  :class:`~binquant_tpu.obs.tracing.ProfileController` (400 on a
+  missing/invalid/out-of-range ``seconds``, 409 while a window is already
+  open, and a JSON no-op when the profiler is unavailable). Unlike the
+  read-only routes this one has a side effect (profiling overhead on the
+  live tick loop + capture files on disk), so it only answers loopback
+  peers unless ``profile_remote_ok`` is set (``BQT_PROFILE_REMOTE=1``) —
+  the scrape port is commonly reachable by the whole cluster.
 
 Started from ``main.py`` when ``BQT_METRICS_PORT`` is set; ``port=0``
 binds an ephemeral port (tests), reported by :meth:`MetricsServer.start`.
@@ -96,11 +105,15 @@ class MetricsServer:
         health_fn: Callable[[], dict] | None = None,
         port: int = 9464,
         host: str = "0.0.0.0",
+        profiler=None,
+        profile_remote_ok: bool = False,
     ) -> None:
         self.registry = registry if registry is not None else REGISTRY
         self.health_fn = health_fn
         self.host = host
         self.port = port
+        self.profiler = profiler
+        self.profile_remote_ok = profile_remote_ok
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -130,7 +143,10 @@ class MetricsServer:
         )
         return head.encode("ascii") + payload
 
-    def _route(self, path: str) -> bytes:
+    def _route(self, target: str, peer: tuple | None = None) -> bytes:
+        path, _, query = target.partition("?")
+        if path == "/debug/profile":
+            return self._route_profile(query, peer)
         if path == "/metrics":
             return self._respond(
                 200, "OK", CONTENT_TYPE_LATEST, render_text(self.registry)
@@ -156,6 +172,57 @@ class MetricsServer:
             )
         return self._respond(404, "Not Found", "text/plain", "not found\n")
 
+    @staticmethod
+    def _is_loopback(peer: tuple | None) -> bool:
+        if peer is None:  # non-inet transport (tests, unix sockets)
+            return True
+        host = str(peer[0])
+        return host in ("127.0.0.1", "::1") or host.startswith("::ffff:127.")
+
+    def _route_profile(self, query: str, peer: tuple | None = None) -> bytes:
+        """``/debug/profile?seconds=N``: open one jax.profiler capture
+        window. Arg validation is strict (400) — a typo'd probe must not
+        silently start a multi-minute trace; an unavailable profiler is a
+        200 no-op so probing the endpoint is always safe. The route is
+        side-effectful (live profiling overhead + capture files on disk),
+        so non-loopback peers are refused unless ``profile_remote_ok``."""
+        from urllib.parse import parse_qs
+
+        if not self.profile_remote_ok and not self._is_loopback(peer):
+            return self._respond(
+                403, "Forbidden", "application/json",
+                json.dumps({"error": "profiling is loopback-only "
+                            "(set BQT_PROFILE_REMOTE=1 to allow remote)"}),
+            )
+        if self.profiler is None:
+            return self._respond(
+                200, "OK", "application/json",
+                json.dumps({"started": False, "reason": "profiler_not_configured"}),
+            )
+        raw = parse_qs(query).get("seconds", [])
+        try:
+            seconds = float(raw[0])
+        except (IndexError, ValueError):
+            return self._respond(
+                400, "Bad Request", "application/json",
+                json.dumps({"error": "seconds=N required (0 < N <= "
+                            f"{self.profiler.MAX_SECONDS:g})"}),
+            )
+        if not (0 < seconds <= self.profiler.MAX_SECONDS):
+            return self._respond(
+                400, "Bad Request", "application/json",
+                json.dumps({"error": "seconds out of range (0 < N <= "
+                            f"{self.profiler.MAX_SECONDS:g})"}),
+            )
+        result = self.profiler.start_window(seconds)
+        busy = result.get("reason") == "already_active"
+        return self._respond(
+            409 if busy else 200,
+            "Conflict" if busy else "OK",
+            "application/json",
+            json.dumps(result),
+        )
+
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
@@ -176,8 +243,8 @@ class MetricsServer:
                     )
                 )
             else:
-                path = parts[1].split("?", 1)[0]
-                writer.write(self._route(path))
+                peer = writer.get_extra_info("peername")
+                writer.write(self._route(parts[1], peer=peer))
             await writer.drain()
         except (TimeoutError, asyncio.TimeoutError, ConnectionError, OSError):
             pass  # scraper went away (or never spoke); nothing to salvage

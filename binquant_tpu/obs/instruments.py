@@ -48,6 +48,21 @@ HEARTBEAT_FAILURES = REGISTRY.counter(
     "bqt_heartbeat_write_failures_total",
     "Failed heartbeat-file writes (persistent failure degrades /healthz).",
 )
+SLOW_TICKS = REGISTRY.counter(
+    "bqt_slow_ticks_total",
+    "Traced ticks whose busy time breached BQT_TRACE_SLOW_MS (or that "
+    "errored), attributed to the dominant top-level stage; the flight "
+    "recorder force-emits each one's span tree + engine snapshot.",
+    labels=("stage",),
+)
+
+# -- event log (obs/events.py) ----------------------------------------------
+
+EVENTLOG_DROPPED = REGISTRY.counter(
+    "bqt_eventlog_dropped_total",
+    "Event-log records dropped: the sink write failed, or emit was "
+    "called after close().",
+)
 
 # -- device step (engine/step.py) -------------------------------------------
 

@@ -1,0 +1,514 @@
+"""Per-tick tracing: span trees, a slow-tick flight recorder, and an
+on-demand jax.profiler window.
+
+The metrics registry answers *how often* and the histograms *how slow in
+aggregate*; this module answers **why was THIS tick slow** and **which
+tick produced THIS order**. Three pieces, all dependency-free (no jax on
+the hot path — the profiler import is lazy and only taken when a capture
+window is actually requested):
+
+* :class:`TickTrace` / :class:`Span` — one monotonic-clock span tree per
+  engine tick (``trace_id`` / ``span_id`` / parent links, attributes,
+  status). The pipeline opens the root in ``_dispatch_tick``, carries the
+  trace on the ``_PendingTick``, and closes it when the tick finalizes —
+  so a trace covers dispatch work, the pipeline dwell, and emission.
+* :class:`Tracer` — sampling (``BQT_TRACE_SAMPLE``; deterministic
+  accumulator, no RNG, so replays trace the same ticks), a bounded
+  in-memory ring of completed traces, and the **flight recorder**: a tick
+  whose busy time breaches ``BQT_TRACE_SLOW_MS`` (or whose any span
+  errored) is force-emitted to the event log with an engine snapshot and
+  attributed to its dominant stage in ``bqt_slow_ticks_total{stage}``.
+  Every completed trace also lands as one ``trace`` event (span tree
+  inlined) so ``tools/trace_report.py`` can render waterfalls offline.
+* :class:`ProfileController` — an on-demand ``jax.profiler`` capture
+  window (``/debug/profile?seconds=N`` on the metrics server, or
+  SIGUSR2), for XLA-level detail below the host spans.
+
+Budget semantics: a pipelined tick's *wall* time includes up to a full
+cadence of intentional dwell between dispatch and finalize, so the
+breach check uses **busy** time — the sum of the root's direct children,
+which only cover actual work. Both numbers ride the summary.
+
+Sampling OFF (``BQT_TRACE_SAMPLE=0``) must cost nothing on the hot path:
+``begin_tick`` returns the shared :data:`NULL_TRACE`, whose ``span`` /
+``activate`` are allocation-free no-ops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Callable
+
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.instruments import SLOW_TICKS
+
+log = logging.getLogger(__name__)
+
+# Process-unique ids: a random 64-bit base plus an atomic counter — two
+# syscall-free hex ids per span instead of an os.urandom round per id.
+_IDS = itertools.count(int.from_bytes(os.urandom(8), "big"))
+
+
+def _next_id(hex_chars: int = 16) -> str:
+    return format(next(_IDS) & ((1 << (4 * hex_chars)) - 1), f"0{hex_chars}x")
+
+
+class Span:
+    """One timed operation inside a tick trace."""
+
+    __slots__ = ("name", "span_id", "parent_id", "start", "end", "attrs", "status")
+
+    def __init__(self, name: str, parent_id: str | None) -> None:
+        self.name = name
+        self.span_id = _next_id(8)
+        self.parent_id = parent_id
+        self.start = time.perf_counter()
+        self.end: float | None = None
+        self.attrs: dict[str, Any] = {}
+        self.status = "ok"
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    @property
+    def duration_ms(self) -> float:
+        end = self.end if self.end is not None else time.perf_counter()
+        return (end - self.start) * 1000.0
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+class _NullTrace:
+    """Shared no-op trace for unsampled ticks: every method is free."""
+
+    __slots__ = ()
+    active = False
+    trace_id = None
+    tick_seq = None
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        yield _NULL_SPAN
+
+    def set_attr(self, **attrs: Any) -> None:
+        pass
+
+    def mark_error(self, exc: BaseException | None = None) -> None:
+        pass
+
+    def record_span(self, name: str, start: float, end: float | None = None,
+                    **attrs: Any):
+        return _NULL_SPAN
+
+    @contextmanager
+    def activate(self):
+        yield self
+
+
+_NULL_SPAN = _NullSpan()
+NULL_TRACE = _NullTrace()
+
+# The trace of the tick currently being dispatched/finalized: sink code
+# (binbot REST, autotrade events, jit-compile telemetry) reads it to join
+# its records back to the producing tick without plumbing a parameter
+# through every call signature.
+_CURRENT: ContextVar[Any] = ContextVar("bqt_current_trace", default=None)
+
+
+def current_trace():
+    """The active TickTrace of the tick being processed, or None."""
+    trace = _CURRENT.get()
+    return trace if trace is not None and trace.active else None
+
+
+def current_trace_id() -> str | None:
+    trace = current_trace()
+    return None if trace is None else trace.trace_id
+
+
+@contextmanager
+def detached():
+    """Clear the current trace for work handed to another task or thread.
+
+    A TickTrace is single-threaded by design (its span stack is
+    unsynchronized); background work spawned while a tick's trace is
+    still active — the leverage-calibration worker in particular — must
+    be created under this guard so its inherited context does not let a
+    worker thread race the tick thread's span stack."""
+    token = _CURRENT.set(None)
+    try:
+        yield
+    finally:
+        _CURRENT.reset(token)
+
+
+class TickTrace:
+    """The span tree of one engine tick (root span ``tick``).
+
+    Spans nest via a stack — tick processing is sequential within a tick
+    (dispatch, then finalize), even though several ticks' traces can be
+    open at once under pipelining (each rides its own ``_PendingTick``).
+
+    ``status`` semantics: a span that sees an exception is marked errored
+    in the tree, but only :meth:`mark_error` — called by the pipeline's
+    dispatch/finalize wrappers for exceptions that escape the tick —
+    flags the TRACE as errored. Failures a caller deliberately catches
+    and tolerates (fire-and-forget analytics, the grid-deploy race) stay
+    visible as errored spans without tripping the flight recorder on
+    every tick a flaky backend is down.
+
+    ``Tracer.complete`` deactivates the trace: background work that
+    inherited it via the contextvar (the leverage-calibration worker's
+    REST calls land after the tick is filed) can no longer append spans
+    to — or flip the status of — a tree that was already serialized.
+    """
+
+    def __init__(self, tick_seq: int, tick_ms: int | None = None) -> None:
+        self.active = True
+        self.trace_id = _next_id(16)
+        self.tick_seq = int(tick_seq)
+        self.status = "ok"
+        self.root = Span("tick", None)
+        if tick_ms is not None:
+            self.root.attrs["tick_ms"] = int(tick_ms)
+        self.spans: list[Span] = [self.root]
+        self._stack: list[Span] = [self.root]
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        span = Span(name, self._stack[-1].span_id)
+        if attrs:
+            span.attrs.update(attrs)
+        self.spans.append(span)
+        self._stack.append(span)
+        try:
+            yield span
+        except BaseException:
+            span.status = "error"
+            raise
+        finally:
+            span.end = time.perf_counter()
+            self._stack.pop()
+
+    def set_attr(self, **attrs: Any) -> None:
+        self.root.attrs.update(attrs)
+
+    def mark_error(self, exc: BaseException | None = None) -> None:
+        """Flag the whole trace errored (force-emits on completion). For
+        exceptions that escape the tick's dispatch/finalize — handled
+        per-span failures only mark their own span."""
+        self.status = "error"
+        if exc is not None:
+            self.root.attrs["error"] = repr(exc)
+
+    def record_span(
+        self, name: str, start: float, end: float | None = None, **attrs: Any
+    ) -> Span:
+        """A completed span from explicit ``perf_counter`` readings — for
+        sections that already time themselves (shared timer, one span)."""
+        span = Span(name, self._stack[-1].span_id)
+        span.start = start
+        span.end = end if end is not None else time.perf_counter()
+        if attrs:
+            span.attrs.update(attrs)
+        self.spans.append(span)
+        return span
+
+    @contextmanager
+    def activate(self):
+        token = _CURRENT.set(self)
+        try:
+            yield self
+        finally:
+            _CURRENT.reset(token)
+
+    # -- completion ---------------------------------------------------------
+
+    def finish(self) -> None:
+        while len(self._stack) > 1:  # leaked span (error path): close it
+            self._stack.pop().end = time.perf_counter()
+        if self.root.end is None:
+            self.root.end = time.perf_counter()
+
+    def _child_index(self) -> dict[str | None, list[Span]]:
+        """parent_id → children, built in ONE pass over the span list —
+        a burst tick holds hundreds of spans, and per-node rescans would
+        make completion O(n²) on exactly the signal-heavy ticks the
+        latency budget cares about. Spans keep insertion order."""
+        index: dict[str | None, list[Span]] = {}
+        for span in self.spans:
+            if span.parent_id is not None:
+                index.setdefault(span.parent_id, []).append(span)
+        return index
+
+    def children_of(self, span: Span) -> list[Span]:
+        return self._child_index().get(span.span_id, [])
+
+    def busy_ms(self) -> float:
+        """Work attributable to this tick: the root's direct children.
+        Excludes the intentional pipeline dwell between dispatch and
+        finalize that the root's wall time includes."""
+        return sum(s.duration_ms for s in self.children_of(self.root))
+
+    def dominant_stage(self) -> str:
+        """The top-level stage that cost the most — the label a slow-tick
+        breach is attributed to (bounded cardinality: stage names are a
+        small fixed set)."""
+        children = self.children_of(self.root)
+        if not children:
+            return "untracked"
+        return max(children, key=lambda s: s.duration_ms).name
+
+    def tree(self, index: dict[str | None, list[Span]] | None = None) -> dict:
+        """The nested span tree, JSON-ready (inlined into trace events)."""
+        index = index if index is not None else self._child_index()
+
+        def node(span: Span) -> dict:
+            out: dict[str, Any] = {
+                "name": span.name,
+                "span_id": span.span_id,
+                "ms": round(span.duration_ms, 3),
+                "status": span.status,
+            }
+            if span.attrs:
+                out["attrs"] = dict(span.attrs)
+            kids = [node(s) for s in index.get(span.span_id, ())]
+            if kids:
+                out["children"] = kids
+            return out
+
+        return node(self.root)
+
+
+class Tracer:
+    """Per-tick trace lifecycle: sampling, the completed-trace ring, and
+    the slow-tick flight recorder."""
+
+    def __init__(
+        self,
+        sample: float = 1.0,
+        slow_ms: float = 50.0,
+        ring: int = 256,
+    ) -> None:
+        self.sample = max(float(sample), 0.0)
+        self.slow_ms = float(slow_ms)
+        self._ring: deque[dict] = deque(maxlen=max(int(ring), 1))
+        self._accum = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0.0
+
+    def begin_tick(self, tick_seq: int, tick_ms: int | None = None):
+        """A TickTrace for this tick, or NULL_TRACE when sampled out.
+        Sampling is a deterministic accumulator (sample=0.25 traces every
+        4th tick) — no RNG, so a replayed stream traces the same ticks."""
+        if not self.enabled:
+            return NULL_TRACE
+        self._accum += self.sample
+        if self._accum < 1.0:
+            return NULL_TRACE
+        self._accum -= 1.0
+        return TickTrace(tick_seq, tick_ms=tick_ms)
+
+    def complete(
+        self, trace, snapshot_fn: Callable[[], dict] | None = None
+    ) -> dict | None:
+        """Close a tick's trace: ring it, emit the ``trace`` event, and
+        run the flight recorder (force-emit + ``bqt_slow_ticks_total``)
+        when the busy time breached the budget or any span errored.
+        ``snapshot_fn`` is only called on a breach (lazy — the engine
+        snapshot is not hot-path work). Deactivates the trace: late
+        arrivals from background work that inherited it (contextvar) must
+        not mutate an already-serialized tree; double-complete is a
+        no-op."""
+        if not trace.active:
+            return None
+        trace.active = False
+        trace.finish()
+        # one child-index pass serves busy/slowest/dominant/tree alike
+        index = trace._child_index()
+        stage_spans = index.get(trace.root.span_id, [])
+        busy = sum(s.duration_ms for s in stage_spans)
+        wall = trace.root.duration_ms
+        slowest = (
+            max(stage_spans, key=lambda s: s.duration_ms) if stage_spans else None
+        )
+        summary = {
+            "trace_id": trace.trace_id,
+            "tick_seq": trace.tick_seq,
+            "busy_ms": round(busy, 3),
+            "wall_ms": round(wall, 3),
+            "status": trace.status,
+            "slowest_stage": None if slowest is None else slowest.name,
+            "slowest_stage_ms": (
+                None if slowest is None else round(slowest.duration_ms, 3)
+            ),
+            "path": trace.root.attrs.get("path"),
+        }
+        tree = trace.tree(index)
+        with self._lock:
+            self._ring.append({"summary": summary, "spans": tree})
+        event_log = get_event_log()
+        event_log.emit("trace", **summary, spans=tree)
+        if trace.status == "error" or busy >= self.slow_ms:
+            stage = (
+                max(stage_spans, key=lambda s: s.duration_ms).name
+                if stage_spans
+                else "untracked"
+            )
+            SLOW_TICKS.labels(stage=stage).inc()
+            event_log.emit(
+                "slow_tick",
+                **summary,
+                budget_ms=self.slow_ms,
+                stage=stage,
+                engine=snapshot_fn() if snapshot_fn is not None else {},
+                spans=tree,
+            )
+        return summary
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def last_tick_trace(self) -> dict | None:
+        """The newest completed tick's summary (``/healthz`` block)."""
+        with self._lock:
+            return dict(self._ring[-1]["summary"]) if self._ring else None
+
+
+# -- jax.profiler integration -------------------------------------------------
+
+_STEP_ANNOTATION: Any = None  # resolved lazily; False = jax unavailable
+_PROFILE_WINDOW = threading.Event()
+
+
+def profiler_window_active() -> bool:
+    """True while an on-demand capture window is open — the pipeline
+    annotates device steps during a window even when tick-trace sampling
+    is off."""
+    return _PROFILE_WINDOW.is_set()
+
+
+@contextmanager
+def step_annotation(step_num: int):
+    """``jax.profiler.StepTraceAnnotation`` around the jit step, so XLA
+    traces group device work per engine tick; a plain no-op when jax (or
+    its profiler) is unavailable."""
+    global _STEP_ANNOTATION
+    if _STEP_ANNOTATION is None:
+        try:
+            from jax.profiler import StepTraceAnnotation
+
+            _STEP_ANNOTATION = StepTraceAnnotation
+        except Exception:  # pragma: no cover - jax is baked into the image
+            _STEP_ANNOTATION = False
+    if _STEP_ANNOTATION is False:
+        yield
+        return
+    with _STEP_ANNOTATION("bqt_tick", step_num=int(step_num)):
+        yield
+
+
+_AUTO = object()
+
+
+class ProfileController:
+    """On-demand ``jax.profiler`` capture windows.
+
+    ``start_window(seconds)`` opens one trace window and schedules its
+    close (asyncio task when a loop is running — the /debug/profile
+    handler; a daemon timer thread otherwise — the SIGUSR2 path in odd
+    contexts). One window at a time; the start/stop callables are
+    injectable for tests and resolve to ``jax.profiler`` by default.
+    """
+
+    MAX_SECONDS = 300.0
+
+    def __init__(
+        self,
+        log_dir: str = "/tmp/bqt_profile",
+        start_fn: Any = _AUTO,
+        stop_fn: Any = _AUTO,
+    ) -> None:
+        self.log_dir = log_dir
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self._stop_task: Any = None
+
+    def _resolve(self):
+        if self._start_fn is not _AUTO:
+            return self._start_fn, self._stop_fn
+        try:
+            from jax import profiler
+
+            return profiler.start_trace, profiler.stop_trace
+        except Exception:  # pragma: no cover - jax is baked into the image
+            return None, None
+
+    @property
+    def active(self) -> bool:
+        return _PROFILE_WINDOW.is_set()
+
+    def start_window(self, seconds: float) -> dict:
+        """Open a capture window for ``seconds``; returns a status dict
+        (never raises — the exposition layer serves it as JSON)."""
+        start, stop = self._resolve()
+        if start is None:
+            return {"started": False, "reason": "profiler_unavailable"}
+        if _PROFILE_WINDOW.is_set():
+            return {"started": False, "reason": "already_active"}
+        try:
+            start(self.log_dir)
+        except Exception as exc:
+            log.exception("profiler start_trace failed")
+            return {"started": False, "reason": f"start_failed: {exc}"}
+        _PROFILE_WINDOW.set()
+        get_event_log().emit(
+            "profile_window", seconds=float(seconds), log_dir=self.log_dir
+        )
+
+        def _close() -> None:
+            try:
+                if stop is not None:
+                    stop()
+            except Exception:
+                log.exception("profiler stop_trace failed")
+            finally:
+                _PROFILE_WINDOW.clear()
+
+        async def _close_later() -> None:
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                _close()
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            timer = threading.Timer(seconds, _close)
+            timer.daemon = True
+            timer.start()
+            self._stop_task = timer
+        else:
+            self._stop_task = loop.create_task(_close_later())
+        return {
+            "started": True,
+            "seconds": float(seconds),
+            "log_dir": self.log_dir,
+        }

@@ -160,6 +160,23 @@ async def run_live() -> None:
             api_symbol_of=api_symbol_of,
         ),
     )
+    # On-demand jax.profiler capture windows: /debug/profile?seconds=N on
+    # the exporter below, and SIGUSR2 for a default 10 s window when the
+    # exporter is disabled or unreachable (output under BQT_PROFILE_DIR).
+    import signal as signal_module
+
+    from binquant_tpu.obs.tracing import ProfileController
+
+    profile_controller = ProfileController(log_dir=config.profile_dir)
+    sigusr2 = getattr(signal_module, "SIGUSR2", None)
+    if sigusr2 is not None:
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                sigusr2, lambda: profile_controller.start_window(10.0)
+            )
+        except (NotImplementedError, RuntimeError):
+            pass  # platform without loop signal handlers
+
     # Observability exporter: /metrics (Prometheus text) + /healthz
     # (heartbeat age + last-tick status), enabled by BQT_METRICS_PORT.
     metrics_server = None
@@ -169,6 +186,10 @@ async def run_live() -> None:
         metrics_server = MetricsServer(
             health_fn=lambda: engine.health_snapshot(config.heartbeat_max_age_s),
             port=config.metrics_port,
+            profiler=profile_controller,
+            # /debug/profile is side-effectful: loopback-only unless the
+            # deploy explicitly opens it to the network
+            profile_remote_ok=config.profile_remote_ok,
         )
         await metrics_server.start()
 

@@ -27,6 +27,12 @@ os.environ.setdefault("ENV", "CI")
 # tests/test_ab_parity.py (oracle A/B with the fast path pinned on), and
 # tests/test_obs.py (fallback-counter smoke).
 os.environ.setdefault("BQT_INCREMENTAL", "0")
+# Tick tracing defaults OFF for the tier-1 lane (same rationale as
+# BQT_INCREMENTAL: dozens of stub engines must not each pay the span-tree
+# bookkeeping). Production default stays ON (binquant_tpu/config.py);
+# tracing coverage opts in explicitly by installing a Tracer(sample=1.0)
+# on the engine under test (tests/test_tracing.py, tests/test_obs.py).
+os.environ.setdefault("BQT_TRACE_SAMPLE", "0")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -321,6 +321,45 @@ def test_event_log_never_raises(tmp_path):
     log.close()
 
 
+def _eventlog_dropped_total() -> float:
+    from binquant_tpu.obs.registry import REGISTRY
+
+    return REGISTRY.get("bqt_eventlog_dropped_total").value
+
+
+def test_event_log_counts_drops_after_close(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    log = EventLog(path)
+    log.emit("kept", i=1)
+    log.close()
+    before = _eventlog_dropped_total()
+    assert log.emit("lost", i=2) is None
+    assert log.emit("lost", i=3) is None
+    assert log.dropped == 2
+    assert _eventlog_dropped_total() == before + 2
+    # the closed file was NOT silently reopened
+    records = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [r["event"] for r in records] == ["kept"]
+
+
+def test_event_log_counts_drops_on_write_failure(tmp_path):
+    # the sink path's parent is a FILE: open() fails on every emit
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    log = EventLog(blocker / "ev.jsonl")
+    before = _eventlog_dropped_total()
+    assert log.emit("unwritable", i=1) is None
+    assert log.emit("unwritable", i=2) is None
+    assert log.dropped == 2
+    assert _eventlog_dropped_total() == before + 2
+    # a disabled log is NOT a drop — disabling is intentional
+    disabled = EventLog(None)
+    before = _eventlog_dropped_total()
+    assert disabled.emit("nothing") is None
+    assert disabled.dropped == 0
+    assert _eventlog_dropped_total() == before
+
+
 # ---------------------------------------------------------------------------
 # healthcheck.py probe
 # ---------------------------------------------------------------------------
@@ -477,6 +516,8 @@ def test_obs_smoke_scrape_replay_tick(tmp_path):
         ("bqt_checkpoint_saves_total", "counter"),
         ("bqt_ingest_dedup_overwrites_total", "counter"),
         ("bqt_registry_capacity_errors_total", "counter"),
+        ("bqt_slow_ticks_total", "counter"),
+        ("bqt_eventlog_dropped_total", "counter"),
     ):
         assert f"# TYPE {family} {kind}" in body, family
 
@@ -491,6 +532,82 @@ def test_obs_smoke_scrape_replay_tick(tmp_path):
         payload["incremental_ticks"] + payload["full_recompute_ticks"]
         == payload["ticks_processed"]
     )
+    # tracing is sampled off in the tier-1 lane (conftest) — the summary
+    # block is present but empty, and no event-log records were dropped
+    assert payload["last_tick_trace"] is None
+    assert payload["eventlog_dropped"] == 0
+
+
+def test_obs_smoke_flight_recorder(tmp_path):
+    """One flight-recorder capture end-to-end on the CPU lane: every tick
+    traced with a zero budget force-emits a slow_tick record, the breach
+    shows up in bqt_slow_ticks_total{stage}, and /healthz carries the
+    last tick's trace summary."""
+    from binquant_tpu.io.replay import (
+        generate_replay_file,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+    from binquant_tpu.obs.events import EventLog, set_event_log
+    from binquant_tpu.obs.tracing import Tracer
+
+    path = tmp_path / "rp.jsonl"
+    generate_replay_file(path, n_symbols=8, n_ticks=3)
+    engine = make_stub_engine(capacity=CAP, window=WIN, pipeline_depth=0)
+    engine.tracer = Tracer(sample=1.0, slow_ms=0.0, ring=16)
+    event_log = EventLog(tmp_path / "events.jsonl")
+    set_event_log(event_log)
+    by_tick = load_klines_by_tick(path)
+
+    async def go() -> tuple[str, dict]:
+        server = MetricsServer(
+            health_fn=lambda: engine.health_snapshot(max_age_s=1500),
+            port=0,
+            host="127.0.0.1",
+        )
+        port = await server.start()
+        try:
+            for bucket in sorted(by_tick):
+                for k in sorted(by_tick[bucket], key=lambda k: k["open_time"]):
+                    engine.ingest(k)
+                await engine.process_tick(now_ms=(bucket + 1) * 900 * 1000)
+            _, body = await _http_get(port, "/metrics")
+            _, hz_body = await _http_get(port, "/healthz")
+            return body, json.loads(hz_body)
+        finally:
+            await server.stop()
+
+    try:
+        body, hz = asyncio.run(go())
+    finally:
+        event_log.close()
+        set_event_log(None)
+
+    # the scrape shows the breach attributed to a real stage
+    slow_lines = [
+        ln for ln in body.splitlines()
+        if ln.startswith("bqt_slow_ticks_total{stage=")
+    ]
+    assert slow_lines, "breaches must be attributed to a stage"
+    assert sum(
+        float(ln.rsplit(" ", 1)[1]) for ln in slow_lines
+    ) >= engine.ticks_processed
+    # one slow_tick record per tick, engine snapshot attached
+    records = [
+        json.loads(ln)
+        for ln in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    slow = [r for r in records if r["event"] == "slow_tick"]
+    assert len(slow) == engine.ticks_processed
+    assert all("queue_depth" in r["engine"] for r in slow)
+    assert len([r for r in records if r["event"] == "trace"]) == (
+        engine.ticks_processed
+    )
+    # /healthz: the latest tick's shape without grepping the log
+    last = hz["last_tick_trace"]
+    assert last["tick_seq"] == engine.ticks_processed
+    assert last["slowest_stage"] is not None
+    assert last["busy_ms"] > 0
 
 
 def test_health_snapshot_degrades_on_heartbeat_failure(tmp_path):

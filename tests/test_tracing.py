@@ -1,0 +1,662 @@
+"""Tick tracing: span trees + ids, sampling, ring eviction, the slow-tick
+flight recorder, end-to-end signal provenance through a replayed session,
+the /debug/profile guard, and the trace_report waterfall golden."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from binquant_tpu.obs.events import EventLog, set_event_log
+from binquant_tpu.obs.registry import REGISTRY
+from binquant_tpu.obs.tracing import (
+    NULL_TRACE,
+    ProfileController,
+    Tracer,
+    current_trace,
+    current_trace_id,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import trace_report  # noqa: E402
+
+# shared suite shape (tests/test_obs.py) — tick_step compile cache hit
+CAP, WIN = 16, 130
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    """Install a fresh file event log as the process default; restore the
+    env-driven default (disabled under CI) afterwards."""
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    set_event_log(log)
+    try:
+        yield path
+    finally:
+        log.close()
+        set_event_log(None)
+
+
+def _read_events(path) -> list[dict]:
+    return [json.loads(ln) for ln in Path(path).read_text().splitlines()]
+
+
+def _counter_value(name: str, **labels) -> float:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels) if labels else fam._solo()
+    return child.value
+
+
+# ---------------------------------------------------------------------------
+# span trees
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_ids_and_summary(event_log):
+    tracer = Tracer(sample=1.0, slow_ms=1e9, ring=4)
+    trace = tracer.begin_tick(7, tick_ms=123000)
+    assert trace.active
+    with trace.span("outer") as outer:
+        with trace.span("inner", k=1) as inner:
+            time.sleep(0.002)
+        outer.set(n=2)
+    with trace.span("second"):
+        pass
+    summary = tracer.complete(trace)
+
+    assert summary["tick_seq"] == 7
+    assert summary["status"] == "ok"
+    assert summary["trace_id"] == trace.trace_id
+    # busy time counts only the root's direct children
+    assert summary["busy_ms"] >= 2.0
+    assert summary["wall_ms"] >= summary["busy_ms"] > 0
+
+    entry = tracer.entries()[-1]
+    tree = entry["spans"]
+    assert tree["name"] == "tick"
+    assert tree["attrs"]["tick_ms"] == 123000
+    names = [c["name"] for c in tree["children"]]
+    assert names == ["outer", "second"]
+    outer_node = tree["children"][0]
+    assert outer_node["attrs"] == {"n": 2}
+    (inner_node,) = outer_node["children"]
+    assert inner_node["attrs"] == {"k": 1}
+    assert inner_node["ms"] <= outer_node["ms"]
+    # ids are unique and parentage is structural (tree already encodes it)
+    ids = {tree["span_id"], outer_node["span_id"], inner_node["span_id"]}
+    assert len(ids) == 3
+
+    # the trace event landed in the log, span tree inlined
+    traces = [e for e in _read_events(event_log) if e["event"] == "trace"]
+    assert len(traces) == 1 and traces[0]["trace_id"] == trace.trace_id
+    assert traces[0]["spans"]["children"][0]["name"] == "outer"
+
+
+def test_handled_span_error_stays_span_local(event_log):
+    """A failure the caller catches and tolerates (fire-and-forget
+    analytics, the grid-deploy race) marks its SPAN errored but not the
+    trace — a flaky backend must not trip the flight recorder per tick."""
+    tracer = Tracer(sample=1.0, slow_ms=1e9, ring=4)
+    trace = tracer.begin_tick(1)
+    try:
+        with trace.span("sink.analytics"):
+            raise RuntimeError("backend down")
+    except RuntimeError:
+        pass  # tolerated, like dispatch_signal_record does
+    summary = tracer.complete(trace)
+    assert summary["status"] == "ok"
+    events = _read_events(event_log)
+    assert [e["event"] for e in events] == ["trace"]  # no slow_tick
+    (span,) = events[0]["spans"]["children"]
+    assert span["status"] == "error"
+
+
+def test_mark_error_force_emits(event_log):
+    """mark_error — the pipeline's escape-path hook — flags the trace and
+    force-emits even under an infinite budget."""
+    tracer = Tracer(sample=1.0, slow_ms=1e9, ring=4)
+    trace = tracer.begin_tick(1)
+    with pytest.raises(RuntimeError):
+        with trace.span("boom"):
+            raise RuntimeError("kaput")
+    trace.mark_error(RuntimeError("kaput"))
+    before = _counter_value("bqt_slow_ticks_total", stage="boom")
+    summary = tracer.complete(trace, snapshot_fn=lambda: {"q": 3})
+    assert summary["status"] == "error"
+    slow = [e for e in _read_events(event_log) if e["event"] == "slow_tick"]
+    assert len(slow) == 1
+    assert slow[0]["engine"] == {"q": 3}
+    assert slow[0]["stage"] == "boom"
+    assert slow[0]["spans"]["attrs"]["error"] == "RuntimeError('kaput')"
+    assert _counter_value("bqt_slow_ticks_total", stage="boom") == before + 1
+    # completion deactivated the trace: late background work that
+    # inherited it can no longer attach spans or flip its status
+    assert not trace.active
+    with trace.activate():  # a worker's inherited context, running late
+        assert current_trace() is None
+    assert tracer.complete(trace) is None  # double-complete is a no-op
+
+
+def test_slow_budget_breach_and_dominant_stage(event_log):
+    tracer = Tracer(sample=1.0, slow_ms=0.0, ring=4)  # everything breaches
+    trace = tracer.begin_tick(2)
+    with trace.span("fast"):
+        pass
+    with trace.span("slow"):
+        time.sleep(0.003)
+    tracer.complete(trace, snapshot_fn=lambda: {"queue_depth": {"b5": 0}})
+    events = _read_events(event_log)
+    slow = [e for e in events if e["event"] == "slow_tick"]
+    assert len(slow) == 1
+    assert slow[0]["stage"] == "slow"
+    assert slow[0]["budget_ms"] == 0.0
+    assert slow[0]["engine"]["queue_depth"] == {"b5": 0}
+    # under a generous budget the same shape emits NO slow_tick
+    calm = Tracer(sample=1.0, slow_ms=1e9, ring=4)
+    t2 = calm.begin_tick(3)
+    with t2.span("fast"):
+        pass
+    calm.complete(t2)
+    assert len(
+        [e for e in _read_events(event_log) if e["event"] == "slow_tick"]
+    ) == 1
+
+
+def test_ring_eviction():
+    tracer = Tracer(sample=1.0, slow_ms=1e9, ring=3)
+    for seq in range(1, 6):
+        trace = tracer.begin_tick(seq)
+        with trace.span("s"):
+            pass
+        tracer.complete(trace)
+    entries = tracer.entries()
+    assert len(entries) == 3
+    assert [e["summary"]["tick_seq"] for e in entries] == [3, 4, 5]
+    assert tracer.last_tick_trace()["tick_seq"] == 5
+
+
+def test_sampling_is_deterministic_and_cheap():
+    off = Tracer(sample=0.0)
+    assert off.begin_tick(1) is NULL_TRACE
+    assert not NULL_TRACE.active
+    # the null trace is free to use and never records anything
+    with NULL_TRACE.span("x") as sp:
+        sp.set(a=1)
+    with NULL_TRACE.activate():
+        assert current_trace() is None
+        assert current_trace_id() is None
+    assert off.complete(NULL_TRACE) is None
+
+    half = Tracer(sample=0.5, slow_ms=1e9)
+    active = [half.begin_tick(i).active for i in range(1, 9)]
+    assert active == [False, True] * 4  # accumulator, not RNG
+
+
+def test_current_trace_contextvar():
+    tracer = Tracer(sample=1.0, slow_ms=1e9)
+    trace = tracer.begin_tick(1)
+    assert current_trace() is None
+    with trace.activate():
+        assert current_trace() is trace
+        assert current_trace_id() == trace.trace_id
+    assert current_trace() is None
+    tracer.complete(trace)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: provenance through a replayed session
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_end_to_end_replay(tmp_path, event_log):
+    """The acceptance drill: a replayed session with every tick traced and
+    BQT_TRACE_SLOW_MS=0 — every tick emits a span tree; fired signals carry
+    trace_id/tick_seq into the telegram message, analytics payload, and
+    autotrade sink; the sink-level spans live in the SAME trace."""
+    from binquant_tpu.io.replay import (
+        generate_burst_replay,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+
+    path = tmp_path / "burst.jsonl"
+    generate_burst_replay(path, n_symbols=8, n_ticks=108)
+    engine = make_stub_engine(capacity=CAP, window=WIN, pipeline_depth=0)
+    engine.tracer = Tracer(sample=1.0, slow_ms=0.0, ring=256)
+    by_tick = load_klines_by_tick(path)
+
+    # telegram sends are fire-and-forget paced tasks: drop the pacing and
+    # drain them before the loop exits so the sent texts can be asserted
+    engine.telegram_consumer._min_send_interval_seconds = 0.0
+
+    async def go() -> list:
+        fired = []
+        for bucket in sorted(by_tick):
+            for k in sorted(by_tick[bucket], key=lambda k: k["open_time"]):
+                engine.ingest(k)
+            fired.extend(await engine.process_tick(now_ms=(bucket + 1) * 900 * 1000))
+        fired.extend(await engine.flush_pending())
+        await asyncio.gather(
+            *engine.telegram_consumer._background_tasks,
+            return_exceptions=True,
+        )
+        return fired
+
+    fired = asyncio.run(go())
+    assert fired, "burst fixture must fire signals for the provenance check"
+
+    events = _read_events(event_log)
+    traces = {e["trace_id"]: e for e in events if e["event"] == "trace"}
+    # one span tree per tick (BQT_TRACE_SLOW_MS=0 also force-emits each)
+    assert len(traces) == engine.ticks_processed
+    assert (
+        len([e for e in events if e["event"] == "slow_tick"])
+        == engine.ticks_processed
+    )
+
+    signal_events = [e for e in events if e["event"] == "signal"]
+    autotrade_events = [e for e in events if e["event"] == "autotrade_attempt"]
+    for signal in fired:
+        # provenance fields on the FiredSignal and every sink payload
+        assert signal.trace_id in traces
+        assert signal.tick_seq is not None
+        assert signal.value.metadata["trace_id"] == signal.trace_id
+        assert signal.value.metadata["tick_seq"] == signal.tick_seq
+        assert signal.analytics["trace_id"] == signal.trace_id
+        assert f"- Trace: {signal.trace_id}/{signal.tick_seq}" in signal.message
+
+        # the producing tick's trace contains the sink spans for this tick
+        tree = traces[signal.trace_id]["spans"]
+        names = {c["name"] for c in tree["children"]}
+        assert {"device_dispatch", "wire_fetch", "emission"} <= names
+        emission = next(
+            c for c in tree["children"] if c["name"] == "emission"
+        )
+        sink_names = {c["name"] for c in emission.get("children", ())}
+        assert {"sink.analytics", "sink.telegram", "sink.autotrade"} <= sink_names
+        # the analytics POST rode the same trace as a binbot span
+        analytics = next(
+            c for c in emission["children"] if c["name"] == "sink.analytics"
+        )
+        assert any(
+            c["name"] == "binbot.post"
+            for c in analytics.get("children", ())
+        )
+    # telegram sink: the dispatched message text carries the trace line
+    assert any("- Trace: " in m for m in engine._telegram_sent)
+    # event-log records joined by the same ids
+    assert {e["trace_id"] for e in signal_events} <= set(traces)
+    assert {e["trace_id"] for e in autotrade_events} <= set(traces)
+
+    # /healthz summary block reflects the newest tick
+    last = engine.health_snapshot()["last_tick_trace"]
+    assert last is not None
+    assert last["tick_seq"] == engine.ticks_processed
+    assert last["slowest_stage"] is not None
+
+    # trace_report renders the slowest ticks from the same log
+    assert trace_report.main([str(event_log), "--slowest", "3"]) == 0
+
+
+def test_calibration_worker_runs_detached_from_the_trace():
+    """The leverage-calibration worker is spawned while the tick's trace
+    is still active; its task must be created with the trace DETACHED —
+    a worker thread appending REST spans would race the tick thread's
+    unsynchronized span stack and pollute busy_ms."""
+    from binquant_tpu.io.replay import make_stub_engine
+
+    engine = make_stub_engine(capacity=CAP, window=WIN)
+    seen = []
+    engine.leverage_calibrator.calibrate_all = (
+        lambda ctx, rows, syms: seen.append(current_trace_id())
+    )
+    tracer = Tracer(sample=1.0, slow_ms=1e9)
+    trace = tracer.begin_tick(1)
+
+    async def go():
+        with trace.activate():
+            assert current_trace() is trace
+            engine._run_leverage_calibration(
+                7, object(), rows=engine.registry.frozen_rows()
+            )
+            assert current_trace() is trace  # detach didn't leak outward
+            await engine._calibration_task
+        tracer.complete(trace)
+
+    asyncio.run(go())
+    assert seen == [None], "worker must not inherit the live trace"
+
+
+def test_trace_sample_empty_env_means_default(monkeypatch):
+    """BQT_TRACE_SAMPLE= (set but empty — a templating artifact) falls
+    back to the production default of 1, like its sibling knobs, instead
+    of silently disabling tracing."""
+    from binquant_tpu.config import Config
+
+    monkeypatch.setenv("BQT_TRACE_SAMPLE", "")
+    monkeypatch.setenv("BQT_TRACE_SLOW_MS", "")
+    monkeypatch.setenv("BQT_TRACE_RING", "")
+    Config.reset()
+    try:
+        config = Config()
+        assert config.trace_sample == 1.0
+        assert config.trace_slow_ms == 50.0
+        assert config.trace_ring == 256
+    finally:
+        Config.reset()
+
+
+def test_dispatch_and_finalize_errors_reach_the_recorder(tmp_path, event_log):
+    """An exception in the UNSPANNED parts of dispatch or finalize must
+    still complete the trace as errored — those ticks are exactly what the
+    flight recorder exists to capture."""
+    from binquant_tpu.io.replay import (
+        generate_replay_file,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+
+    path = tmp_path / "rp.jsonl"
+    generate_replay_file(path, n_symbols=8, n_ticks=2)
+    engine = make_stub_engine(capacity=CAP, window=WIN, pipeline_depth=0)
+    engine.tracer = Tracer(sample=1.0, slow_ms=1e9, ring=8)
+    by_tick = load_klines_by_tick(path)
+    buckets = sorted(by_tick)
+
+    def feed(bucket):
+        for k in sorted(by_tick[bucket], key=lambda k: k["open_time"]):
+            engine.ingest(k)
+
+    async def go():
+        # tick 1: _breadth_scalars raises BETWEEN spans during dispatch
+        feed(buckets[0])
+        orig = engine._breadth_scalars
+        engine._breadth_scalars = lambda: 1 / 0
+        with pytest.raises(ZeroDivisionError):
+            await engine.process_tick(now_ms=(buckets[0] + 1) * 900 * 1000)
+        engine._breadth_scalars = orig
+        engine._pending.clear()  # the failed dispatch left nothing valid
+        # tick 2: the notifier raises in finalize's unspanned policy region
+        feed(buckets[1])
+        engine.notifier.build_message = lambda ctx: 1 / 0
+        with pytest.raises(ZeroDivisionError):
+            await engine.process_tick(now_ms=(buckets[1] + 1) * 900 * 1000)
+
+    asyncio.run(go())
+    events = _read_events(event_log)
+    errored = [
+        e for e in events if e["event"] == "slow_tick" and e["status"] == "error"
+    ]
+    assert len(errored) == 2, "both failure modes must force-emit"
+    assert all("error" in e["spans"]["attrs"] for e in errored)
+    assert all("queue_depth" in e["engine"] for e in errored)
+
+
+# ---------------------------------------------------------------------------
+# /debug/profile endpoint + controller
+# ---------------------------------------------------------------------------
+
+
+async def _http_get(port: int, path: str, method: str = "GET") -> tuple[int, str]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body.decode("utf-8")
+
+
+def test_debug_profile_endpoint_guards(tmp_path):
+    from binquant_tpu.obs.exposition import MetricsServer
+    from binquant_tpu.obs.registry import MetricsRegistry
+
+    calls = []
+
+    def fake_start(log_dir):
+        calls.append(("start", log_dir))
+
+    def fake_stop():
+        calls.append(("stop",))
+
+    controller = ProfileController(
+        log_dir=str(tmp_path), start_fn=fake_start, stop_fn=fake_stop
+    )
+
+    async def go():
+        server = MetricsServer(
+            registry=MetricsRegistry(), port=0, host="127.0.0.1",
+            profiler=controller,
+        )
+        port = await server.start()
+        try:
+            # bad args: missing, non-numeric, non-positive, over the cap
+            for qs in ("", "?seconds=abc", "?seconds=0", "?seconds=-3",
+                       "?seconds=9999"):
+                status, body = await _http_get(port, f"/debug/profile{qs}")
+                assert status == 400, (qs, body)
+                assert "seconds" in json.loads(body)["error"]
+            assert calls == []  # no window was ever opened
+
+            # non-GET is rejected by the server-wide method guard
+            status, _ = await _http_get(port, "/debug/profile?seconds=1", "POST")
+            assert status == 405
+
+            # good args open a window; a second request conflicts
+            status, body = await _http_get(port, "/debug/profile?seconds=0.2")
+            assert status == 200
+            assert json.loads(body)["started"] is True
+            assert controller.active
+            status, body = await _http_get(port, "/debug/profile?seconds=0.2")
+            assert status == 409
+            assert json.loads(body)["reason"] == "already_active"
+            # the scheduled close fires and stops the profiler
+            await asyncio.sleep(0.4)
+            assert not controller.active
+            assert calls == [("start", str(tmp_path)), ("stop",)]
+        finally:
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_debug_profile_unavailable_is_noop(tmp_path):
+    from binquant_tpu.obs.exposition import MetricsServer
+    from binquant_tpu.obs.registry import MetricsRegistry
+
+    # start_fn=None models "no jax profiler in this runtime"
+    controller = ProfileController(start_fn=None, stop_fn=None)
+
+    async def go():
+        server = MetricsServer(
+            registry=MetricsRegistry(), port=0, host="127.0.0.1",
+            profiler=controller,
+        )
+        port = await server.start()
+        try:
+            status, body = await _http_get(port, "/debug/profile?seconds=1")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload == {
+                "started": False, "reason": "profiler_unavailable",
+            }
+            assert not controller.active
+            # no controller wired at all: same safe no-op shape
+            server.profiler = None
+            status, body = await _http_get(port, "/debug/profile?seconds=1")
+            assert status == 200
+            assert json.loads(body)["started"] is False
+        finally:
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_debug_profile_is_loopback_only(tmp_path):
+    """The only side-effectful route refuses non-loopback peers unless the
+    deploy opts in (the scrape port is typically cluster-reachable)."""
+    from binquant_tpu.obs.exposition import MetricsServer
+    from binquant_tpu.obs.registry import MetricsRegistry
+
+    opened = []
+    controller = ProfileController(
+        log_dir=str(tmp_path),
+        start_fn=lambda d: opened.append(d),
+        stop_fn=lambda: None,
+    )
+    server = MetricsServer(registry=MetricsRegistry(), profiler=controller)
+    assert server._is_loopback(("127.0.0.1", 1)) is True
+    assert server._is_loopback(("::1", 1, 0, 0)) is True
+    assert server._is_loopback(("::ffff:127.0.0.1", 1, 0, 0)) is True
+    assert server._is_loopback(None) is True
+    assert server._is_loopback(("10.1.2.3", 1)) is False
+
+    remote = ("10.1.2.3", 5555)
+    raw = server._route_profile("seconds=1", peer=remote)
+    assert raw.startswith(b"HTTP/1.1 403")
+    assert opened == []
+    # loopback passes through to the controller; opt-in admits remotes
+    server._route_profile("seconds=0.01", peer=("127.0.0.1", 5555))
+    assert opened == [str(tmp_path)]
+    import time as _time
+
+    deadline = _time.monotonic() + 2
+    while controller.active and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    server.profile_remote_ok = True
+    server._route_profile("seconds=0.01", peer=remote)
+    assert len(opened) == 2
+    # drain the window: the active flag is process-global, and leaving it
+    # set would race whichever profiler test runs next
+    deadline = _time.monotonic() + 2
+    while controller.active and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert not controller.active
+
+
+def test_profile_controller_sync_context(tmp_path):
+    """SIGUSR2-style invocation without a running loop: the close falls
+    back to a timer thread."""
+    calls = []
+    controller = ProfileController(
+        log_dir=str(tmp_path),
+        start_fn=lambda d: calls.append(("start", d)),
+        stop_fn=lambda: calls.append(("stop",)),
+    )
+    result = controller.start_window(0.05)
+    assert result["started"] is True
+    assert controller.active
+    deadline = time.monotonic() + 2.0
+    while controller.active and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not controller.active
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+
+
+# ---------------------------------------------------------------------------
+# trace_report golden
+# ---------------------------------------------------------------------------
+
+_GOLDEN_EVENT = {
+    "event": "trace",
+    "trace_id": "00c0ffee00c0ffee",
+    "tick_seq": 42,
+    "busy_ms": 10.0,
+    "wall_ms": 12.5,
+    "status": "ok",
+    "path": "incremental",
+    "spans": {
+        "name": "tick",
+        "span_id": "aaaaaaaa",
+        "ms": 12.5,
+        "status": "ok",
+        "children": [
+            {
+                "name": "ingest_drain",
+                "span_id": "bbbbbbbb",
+                "ms": 1.5,
+                "status": "ok",
+                "attrs": {"batches5": 3, "clean_appends": True},
+            },
+            {
+                "name": "device_dispatch",
+                "span_id": "cccccccc",
+                "ms": 6.0,
+                "status": "ok",
+                "attrs": {"incremental": True},
+            },
+            {
+                "name": "emission",
+                "span_id": "dddddddd",
+                "ms": 2.5,
+                "status": "ok",
+                "children": [
+                    {
+                        "name": "sink.telegram",
+                        "span_id": "eeeeeeee",
+                        "ms": 0.5,
+                        "status": "error",
+                        "attrs": {"symbol": "BTCUSDT"},
+                    }
+                ],
+            },
+        ],
+    },
+}
+
+_GOLDEN_RENDERED = """\
+trace 00c0ffee00c0ffee  tick 42  status ok  busy 10.0ms  wall 12.5ms  path incremental
+  ingest_drain                 1.500ms  15.0%  batches5=3 clean_appends=True
+  device_dispatch              6.000ms  60.0%  incremental=True
+  emission                     2.500ms  25.0%
+    sink.telegram                0.500ms   5.0% !ERROR  symbol=BTCUSDT"""
+
+
+def test_trace_report_golden_waterfall():
+    assert trace_report.render_trace(_GOLDEN_EVENT) == _GOLDEN_RENDERED
+
+
+def test_trace_report_slowest_and_filters(tmp_path, capsys):
+    log = tmp_path / "ev.jsonl"
+    events = []
+    for seq, busy in ((1, 5.0), (2, 50.0), (3, 20.0)):
+        ev = json.loads(json.dumps(_GOLDEN_EVENT))
+        ev["tick_seq"], ev["busy_ms"] = seq, busy
+        ev["trace_id"] = f"{seq:016x}"
+        events.append(ev)
+    # corrupt line + unrelated event are skipped, not fatal
+    lines = [json.dumps(e) for e in events]
+    lines.insert(1, '{"torn":')
+    lines.insert(0, json.dumps({"event": "signal", "symbol": "X"}))
+    log.write_text("\n".join(lines) + "\n")
+
+    assert trace_report.main([str(log), "--slowest", "2"]) == 0
+    out = capsys.readouterr().out
+    blocks = out.strip().split("\n\n")
+    assert len(blocks) == 2
+    assert "tick 2" in blocks[0] and "tick 3" in blocks[1]
+
+    assert trace_report.main([str(log), "--tick", "1"]) == 0
+    assert "tick 1" in capsys.readouterr().out
+
+    assert trace_report.main([str(log), "--trace", f"{3:016x}"]) == 0
+    assert "tick 3" in capsys.readouterr().out
+
+    assert trace_report.main([str(log), "--trace", "feedfeedfeedfeed"]) == 1
+    # default: the latest trace
+    assert trace_report.main([str(log)]) == 0
+    assert "tick 3" in capsys.readouterr().out
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert trace_report.main([str(empty)]) == 1

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Render tick traces from the JSONL event log as indented waterfalls.
+
+The engine emits one ``trace`` event per sampled tick (span tree inlined
+— ``binquant_tpu/obs/tracing.py``); this tool turns them back into the
+"why was THIS tick slow" view without any service in the loop:
+
+    python tools/trace_report.py /var/log/bqt/events.jsonl            # latest tick
+    python tools/trace_report.py events.jsonl --slowest 3             # worst offenders
+    python tools/trace_report.py events.jsonl --trace cc73e595f7047dee
+    python tools/trace_report.py events.jsonl --tick 42
+
+Each line of the waterfall is one span: duration, share of the tick's
+busy time, then the span's attributes — so a slow tick reads straight
+down from the dominant stage to the sink call (and, through
+``trace_id``, across to the ``signal`` / ``autotrade_*`` / ``slow_tick``
+records carrying the same id).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_trace_events(path: str | Path) -> list[dict]:
+    """All ``trace`` events from a JSONL event log, in file order.
+    Corrupt lines (a torn write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") == "trace" and "spans" in record:
+                out.append(record)
+    return out
+
+
+def _attr_str(attrs: dict | None) -> str:
+    if not attrs:
+        return ""
+    return "  " + " ".join(f"{k}={v}" for k, v in attrs.items())
+
+
+def render_trace(event: dict) -> str:
+    """One trace event → a deterministic indented waterfall (pinned by
+    the golden test — keep format changes deliberate)."""
+    busy = float(event.get("busy_ms") or 0.0)
+    header = (
+        f"trace {event['trace_id']}  tick {event['tick_seq']}  "
+        f"status {event.get('status', 'ok')}  "
+        f"busy {event.get('busy_ms')}ms  wall {event.get('wall_ms')}ms"
+    )
+    path = event.get("path")
+    if path:
+        header += f"  path {path}"
+    lines = [header]
+
+    def walk(node: dict, depth: int) -> None:
+        ms = float(node.get("ms") or 0.0)
+        pct = (ms / busy * 100.0) if busy > 0 else 0.0
+        mark = "" if node.get("status", "ok") == "ok" else " !ERROR"
+        lines.append(
+            f"{'  ' * depth}{node['name']:<24} {ms:>9.3f}ms {pct:>5.1f}%"
+            f"{mark}{_attr_str(node.get('attrs'))}"
+        )
+        for child in node.get("children", ()):
+            walk(child, depth + 1)
+
+    for child in event["spans"].get("children", ()):
+        walk(child, 1)
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument("--trace", help="render the tick with this trace_id")
+    parser.add_argument(
+        "--tick", type=int, help="render the tick with this tick_seq"
+    )
+    parser.add_argument(
+        "--slowest",
+        type=int,
+        metavar="N",
+        help="render the N ticks with the highest busy time",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_trace_events(args.log)
+    if not events:
+        print(f"no trace events in {args.log} (tracing sampled off?)",
+              file=sys.stderr)
+        return 1
+
+    if args.trace:
+        chosen = [e for e in events if e["trace_id"] == args.trace]
+        if not chosen:
+            print(f"trace {args.trace} not found", file=sys.stderr)
+            return 1
+    elif args.tick is not None:
+        chosen = [e for e in events if e.get("tick_seq") == args.tick]
+        if not chosen:
+            print(f"tick {args.tick} not found", file=sys.stderr)
+            return 1
+    elif args.slowest:
+        chosen = sorted(
+            events, key=lambda e: float(e.get("busy_ms") or 0.0), reverse=True
+        )[: args.slowest]
+    else:
+        chosen = [events[-1]]
+
+    print("\n\n".join(render_trace(e) for e in chosen))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke trace-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -15,6 +15,11 @@ help:
 	@echo "               tests/test_obs.py)"
 	@echo "  incr-smoke - fast CPU smoke of the incremental indicator path"
 	@echo "               (step parity + pipeline gating, tier-1 lane)"
+	@echo "  strat-smoke- CPU smoke of the ISSUE-4 strategy-stage carries +"
+	@echo "               donated dispatch: ABP/LSP twin parity through"
+	@echo "               engineered bursts, sorted-window order-statistic"
+	@echo "               props, donated bit-identity + replay equality,"
+	@echo "               and the compile-time cost budget gate"
 	@echo "  trace-smoke- replay with tracing on and BQT_TRACE_SLOW_MS=0"
 	@echo "               (every tick flight-recorded), then render the 3"
 	@echo "               slowest ticks with tools/trace_report.py"
@@ -46,6 +51,18 @@ trace-smoke:
 
 incr-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_incremental.py -q -m "not slow"
+
+# The strategy-carry/donated lane: ALL the slow-marked opt-ins the 870s
+# tier-1 budget cannot absorb (ABP/LSP twin parity sweeps, sorted-window
+# pandas props, donated bit-identity + replay equality, checkpoint v2
+# migration, the direct classic-vs-incremental cost ratio). Tier-1 keeps
+# only the compile-time budget gate (tests/test_cost_budget.py).
+strat-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cost_budget.py -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu python -m pytest tests/test_incremental.py tests/test_ops_parity.py \
+		-q -k "twin or Donated or sorted_window or checkpoint_v2" \
+		-p no:cacheprovider
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -114,7 +114,10 @@ MEASUREMENT_EPOCH = {
 
 
 def device_cost_breakdown(
-    num_symbols: int = 2048, window: int = 400, iters: int = 30
+    num_symbols: int = 2048,
+    window: int = 400,
+    iters: int = 30,
+    per_strategy: bool = False,
 ) -> dict:
     """Device-side cost of the tick step (VERDICT r4 item 2).
 
@@ -136,12 +139,24 @@ def device_cost_breakdown(
     * ``duty_cycle_1s`` — step_ms / 1000 ms cadence: the fraction of the
       chip the engine occupies at the live cadence (single-chip headroom);
     * ``incremental`` — the SAME wire step with ``incremental=True`` (the
-      live fast path: carried indicator state advanced by the newest bar
-      instead of full-window recompute): step time, cost_analysis bytes/
-      flops, and the reduction ratios vs the full step. This is the
-      bytes-per-tick phase ISSUE 2 prescribes — the tick was measured
-      bytes-bound (VERDICT r5: ~11.8 GB/tick for 1.9 GFLOP), so
-      ``bytes_reduction_x`` is the number that predicts the headroom win.
+      live fast path: carried indicator + strategy-stage state advanced by
+      the newest bar instead of full-window recompute): step time,
+      cost_analysis bytes/flops, and the reduction ratios vs the full
+      step. This is the bytes-per-tick phase ISSUE 2 prescribes — the
+      tick was measured bytes-bound (VERDICT r5: ~11.8 GB/tick for
+      1.9 GFLOP), so ``bytes_reduction_x`` is the number that predicts the
+      headroom win.
+    * ``donated`` — the incremental wire step through the DONATED
+      executable (the live default since ISSUE 4): ring buffers update in
+      place, erasing the functional scatter's allocate+copy. Step time is
+      measured by threading the state through back-to-back donated calls
+      (exactly the live pipeline's usage).
+    * ``per_strategy_bytes`` (opt-in: ``per_strategy=True``, the
+      ``--device`` mode) — bytes attribution BY EXCLUSION: recompile the
+      wire with each live strategy removed from ``wire_enabled`` and
+      report the delta, for the classic and incremental variants. Proves
+      where the bytes went (ISSUE 4: the ABP windowed-sort residue must
+      vanish from the incremental column).
     """
     import jax
 
@@ -152,6 +167,7 @@ def device_cost_breakdown(
         pad_updates,
         tick_step,
         tick_step_wire,
+        tick_step_wire_donated,
     )
     from binquant_tpu.regime.context import compute_market_context
     from binquant_tpu.strategies.features import (
@@ -192,9 +208,12 @@ def device_cost_breakdown(
     inputs = jax.device_put(inputs)
     state = engine.state
     # sync the indicator carry to the seeded windows (the seed path writes
-    # buffers directly, bypassing the engine's full-tick resync)
+    # buffers directly, bypassing the engine's full-tick resync); BTC is
+    # registry row 0 in the seeded universe
     state = state._replace(
-        indicator_carry=jax.jit(init_indicator_carry)(state.buf5, state.buf15)
+        indicator_carry=jax.jit(
+            lambda b5, b15: init_indicator_carry(b5, b15, 0)
+        )(state.buf5, state.buf15)
     )
 
     from binquant_tpu.engine.buffer import fresh_mask
@@ -300,6 +319,23 @@ def device_cost_breakdown(
     # classic pipeline (per-stage cost = increment between consecutive
     # rows); the incremental pack stage is a sibling measurement and
     # reports under detail.incremental instead
+    def timed_donated(iters_d: int = iters) -> float:
+        """Back-to-back donated steps threading the state (the live
+        pipeline's usage — each call consumes its input state)."""
+        st = jax.tree_util.tree_map(jnp.copy, state)
+        # compile + warm
+        st, r = tick_step_wire_donated(
+            st, u5, u15, inputs, cfg, wire_enabled=key, incremental=True
+        )
+        np.asarray(r)
+        t0 = time.perf_counter()
+        for _ in range(iters_d):
+            st, r = tick_step_wire_donated(
+                st, u5, u15, inputs, cfg, wire_enabled=key, incremental=True
+            )
+        np.asarray(r)
+        return (time.perf_counter() - t0) / iters_d * 1000.0
+
     stages = {
         "buffer_update": timed(f_update, state, u5, u15),
         "plus_feature_packs": timed(f_packs, state, u5, u15),
@@ -310,12 +346,15 @@ def device_cost_breakdown(
     packs_incr_ms = timed(f_packs_incr, state, u5, u15)
     step_incr_ms = timed(f_wire_incr, state, u5, u15, inputs)
     step_resync_ms = timed(f_wire_resync, state, u5, u15, inputs)
+    step_donated_ms = timed_donated()
     step_all_ms = timed(f_all, state, u5, u15, inputs)
 
-    def _cost_of(**lower_kwargs) -> dict:
+    def _cost_of(fn=tick_step_wire, wire_key=None, **lower_kwargs) -> dict:
         try:
-            compiled = tick_step_wire.lower(
-                state, u5, u15, inputs, cfg, wire_enabled=key, **lower_kwargs
+            compiled = fn.lower(
+                state, u5, u15, inputs, cfg,
+                wire_enabled=key if wire_key is None else wire_key,
+                **lower_kwargs,
             ).compile()
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
@@ -330,11 +369,34 @@ def device_cost_breakdown(
     # classic baseline: pre-ISSUE-2 semantics (no carry maintenance)
     cost = _cost_of(maintain_carry=False)
     cost_incr = _cost_of(incremental=True)
+    cost_donated = _cost_of(fn=tick_step_wire_donated, incremental=True)
 
     def _ratio(full, incr):
         if not full or not incr or incr != incr or full != full:
             return None
         return round(full / incr, 2) if incr > 0 else None
+
+    # bytes attribution by exclusion: recompile with one strategy removed
+    # and credit the delta to it (XLA fusion makes deltas approximate; a
+    # negative rounding residue reads as ~0)
+    per_strategy_bytes = None
+    if per_strategy:
+        per_strategy_bytes = {}
+        for name in key:
+            reduced = tuple(s for s in key if s != name)
+            drop_classic = _cost_of(wire_key=reduced, maintain_carry=False)
+            drop_incr = _cost_of(wire_key=reduced, incremental=True)
+
+            def _delta(full_c, red_c):
+                f, r = full_c.get("bytes_accessed"), red_c.get("bytes_accessed")
+                if f is None or r is None or f != f or r != r:
+                    return None
+                return round(max(f - r, 0.0) / 1e9, 4)
+
+            per_strategy_bytes[name] = {
+                "classic_gb": _delta(cost, drop_classic),
+                "incremental_gb": _delta(cost_incr, drop_incr),
+            }
 
     return {
         "symbols": num_symbols,
@@ -371,6 +433,16 @@ def device_cost_breakdown(
             ),
             "step_time_cut_x": _ratio(step_ms, step_incr_ms),
         },
+        # the live default since ISSUE 4: incremental + donated buffers
+        "donated": {
+            "step_ms": round(step_donated_ms, 3),
+            **cost_donated,
+            "bytes_reduction_x_vs_classic": _ratio(
+                cost.get("bytes_accessed"), cost_donated.get("bytes_accessed")
+            ),
+            "step_time_cut_x_vs_classic": _ratio(step_ms, step_donated_ms),
+        },
+        "per_strategy_bytes": per_strategy_bytes,
     }
 
 
@@ -1134,7 +1206,7 @@ def main() -> int | None:
         return
 
     if args.device:
-        d = device_cost_breakdown(args.symbols, args.window)
+        d = device_cost_breakdown(args.symbols, args.window, per_strategy=True)
         print(
             json.dumps(
                 {

@@ -176,6 +176,18 @@ class Config:
         return self._get("BQT_INCREMENTAL", "1") != "0"
 
     @cached_property
+    def donate_enabled(self) -> bool:
+        """Donate the engine state to the live wire step: the ring buffers
+        update IN PLACE instead of the functional allocate+copy scatter
+        (~0.23 GB/tick of the incremental tick's residual bytes at
+        2048×400). The pipeline engages it only when safe — pipeline depth
+        <= 1 and single chip — and re-derives the rare overflow-fallback
+        outputs from the post-tick state plus pre-tick small-carry
+        snapshots, never from the donated buffers. BQT_DONATE=0 pins the
+        copying step (the pre-ISSUE-4 behavior)."""
+        return self._get("BQT_DONATE", "1") != "0"
+
+    @cached_property
     def carry_audit_every_ticks(self) -> int:
         """Drift audit cadence for the incremental path: every N processed
         ticks the engine dispatches a FULL recompute, which re-anchors the

@@ -27,6 +27,17 @@ import jax
 import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer, apply_updates, fresh_mask
+from binquant_tpu.ops.incremental import (
+    BetaCorrCarry,
+    SupertrendCarry,
+    beta_corr_advance,
+    beta_corr_init,
+    beta_corr_value,
+    empty_beta_corr_carry,
+    empty_supertrend_carry,
+    supertrend_advance,
+    supertrend_init,
+)
 from binquant_tpu.ops.indicators import log_returns, rolling_beta_corr
 from binquant_tpu.regime.context import (
     ContextConfig,
@@ -36,7 +47,16 @@ from binquant_tpu.regime.context import (
     initial_regime_carry,
 )
 from binquant_tpu.regime.routing import allows_long_autotrade_mask
-from binquant_tpu.strategies.activity_burst_pump import activity_burst_pump
+from binquant_tpu.strategies.activity_burst_pump import (
+    ABP_INIT_MIN_WINDOW,
+    ABP_MIN_WINDOW,
+    ABPCarry,
+    abp_advance_one_bar,
+    abp_init_from_window,
+    activity_burst_pump,
+    activity_burst_pump_from_carry,
+    empty_abp_carry,
+)
 from binquant_tpu.strategies.base import StrategyOutputs
 from binquant_tpu.strategies.dormant import (
     bb_extreme_reversion,
@@ -57,7 +77,16 @@ from binquant_tpu.strategies.features import (
     init_feature_carry,
 )
 from binquant_tpu.strategies.ladder_deployer import ladder_deployer
-from binquant_tpu.strategies.liquidation_sweep_pump import liquidation_sweep_pump
+from binquant_tpu.strategies.liquidation_sweep_pump import (
+    LSP_INIT_MIN_WINDOW,
+    LSP_MIN_WINDOW,
+    LSPCarry,
+    empty_lsp_carry,
+    liquidation_sweep_pump,
+    liquidation_sweep_pump_from_carry,
+    lsp_advance_one_bar,
+    lsp_init_from_window,
+)
 from binquant_tpu.strategies.mean_reversion_fade import mean_reversion_fade
 from binquant_tpu.strategies.price_tracker import price_tracker
 from binquant_tpu.strategies.spike_hunter import SpikeSignal, detect_spikes
@@ -66,20 +95,47 @@ from binquant_tpu.strategies.spike_hunter import SpikeSignal, detect_spikes
 # carries a full MA-100 (context_evaluator.py:361-365).
 MIN_BARS = 100
 
+# Supertrend/beta-corr carry constants — the consumers' static params
+# (supertrend_swing_reversal's (10, 3.0); rolling_beta_corr's 50-bar
+# window in the BTC-relative block below).
+ST_WINDOW, ST_MULT = 10, 3.0
+BC_WINDOW = 50
+
 
 class IndicatorCarry(NamedTuple):
-    """Per-timeframe incremental indicator state (ops/incremental.py).
+    """Incremental indicator + strategy-stage state (ops/incremental.py).
 
     Rebuilt from the windows by every FULL tick (``init_indicator_carry``),
-    advanced in O(1) bytes per symbol by the incremental tick. The beta/
-    corr and supertrend carries defined in ops/incremental.py are NOT
-    resident here yet: the wire path DCEs btc-beta entirely and the
-    supertrend consumer is a dormant strategy — they join when a live
-    consumer does.
+    advanced in O(1)-ish bytes per symbol by the incremental tick — the
+    sorted-window strategy carries pay O(window) merges instead of the
+    full path's O(TAIL·window·log window) sorts:
+
+    * ``pack5``/``pack15`` — the per-timeframe feature packs (ISSUE 2);
+    * ``abp5``/``lsp15`` — ActivityBurstPump / LiquidationSweepPump
+      order-statistic carries (median baselines, score-quantile windows,
+      cooldown rings) — the post-ISSUE-2 wire step's dominant bytes residue;
+    * ``st5`` — the supertrend scan carry feeding
+      ``supertrend_swing_reversal`` when that strategy is wire-enabled.
+      NOTE its semantics: the full path re-runs the scan from the sliding
+      dropna'd-frame seed every tick (the reference recomputes per kline);
+      the carry continues ONE recursion and is re-anchored to the sliding
+      seed by every full-recompute tick — between resyncs the two differ
+      by the Wilder-ATR's exponentially-forgotten prefix;
+    * ``bc15``/``bc_dirty`` — the BTC beta/corr windowed sums. The full
+      kernel pairs each symbol's returns with BTC's POSITIONALLY, so a
+      tick where a row and the BTC row advance asymmetrically re-pairs
+      that row's whole window — no O(1) advance can follow; such rows set
+      ``bc_dirty`` and read 0 (the full kernel's not-finite fill) until
+      the next full recompute re-anchors them.
     """
 
     pack5: FeatureCarry
     pack15: FeatureCarry
+    abp5: ABPCarry
+    lsp15: LSPCarry
+    st5: SupertrendCarry
+    bc15: BetaCorrCarry
+    bc_dirty: jnp.ndarray  # (S,) bool
 
 
 class EngineState(NamedTuple):
@@ -207,10 +263,14 @@ WIRE_MAX_FIRED = 128
 # trip, which through a tunneled chip turned fired ticks into multi-second
 # stalls. Now a tick is ONE transfer whether or not anything fired.
 EMISSION_DIAG_WIDTH = 16  # per-strategy diagnostics slots (padded)
+# btc_beta/btc_corr ride every fired slot since ISSUE 4 — the wire-path
+# consumer of the beta/corr readouts (full path: rolling_beta_corr's last
+# values; incremental path: the carried windowed sums): signal analytics
+# gain the fired symbol's BTC-relative posture with zero extra fetches.
 EMISSION_BASE_FIELDS: tuple[str, ...] = (
     "close5", "volume5", "bb_upper5", "bb_mid5", "bb_lower5",
     "close15", "volume15", "bb_upper15", "bb_mid15", "bb_lower15",
-    "micro_regime", "micro_transition",
+    "micro_regime", "micro_transition", "btc_beta", "btc_corr",
 )
 EMISSION_SLOT_WIDTH = len(EMISSION_BASE_FIELDS) + EMISSION_DIAG_WIDTH
 # (key, kind) per strategy, kind in {"b","i","f"} — recorded at trace time
@@ -338,6 +398,18 @@ def default_host_inputs(num_symbols: int) -> HostInputs:
     )
 
 
+def empty_indicator_carry(num_symbols: int) -> IndicatorCarry:
+    return IndicatorCarry(
+        pack5=empty_feature_carry(num_symbols),
+        pack15=empty_feature_carry(num_symbols),
+        abp5=empty_abp_carry(num_symbols),
+        lsp15=empty_lsp_carry(num_symbols),
+        st5=empty_supertrend_carry(num_symbols),
+        bc15=empty_beta_corr_carry(num_symbols),
+        bc_dirty=jnp.zeros((num_symbols,), bool),
+    )
+
+
 def initial_engine_state(
     num_symbols: int, window: int = 400
 ) -> EngineState:
@@ -349,17 +421,152 @@ def initial_engine_state(
         regime_carry=initial_regime_carry(num_symbols),
         mrf_last_emitted=jnp.full((num_symbols,), -1, dtype=jnp.int32),
         pt_last_signal_close=jnp.full((num_symbols,), -1, dtype=jnp.int32),
-        indicator_carry=IndicatorCarry(
-            pack5=empty_feature_carry(num_symbols),
-            pack15=empty_feature_carry(num_symbols),
-        ),
+        indicator_carry=empty_indicator_carry(num_symbols),
     )
 
 
-def init_indicator_carry(buf5: MarketBuffer, buf15: MarketBuffer) -> IndicatorCarry:
-    """Carry rebuilt from both windows (what every full tick emits)."""
+def _btc_row_mask(btc_row: jnp.ndarray, num_symbols: int):
+    """(onehot (S,), ok scalar) for the masked-reduction BTC row extract
+    (a dynamic row index would make the SPMD partitioner all-gather)."""
+    safe = jnp.clip(btc_row, 0, num_symbols - 1)
+    ok = (btc_row >= 0) & (btc_row < num_symbols)
+    return jnp.arange(num_symbols) == safe, ok
+
+
+def _ret_at(buf: MarketBuffer, pos: int) -> jnp.ndarray:
+    """Log return of the bar at ``pos`` from two close columns — the
+    column-read twin of :func:`ops.indicators.log_returns`."""
+    c = buf.values[:, pos, Field.CLOSE]
+    prev = buf.values[:, pos - 1, Field.CLOSE]
+    ok = (c > 0) & (prev > 0)
+    return jnp.where(
+        ok, jnp.log(jnp.where(ok, c / jnp.where(prev > 0, prev, 1.0), 1.0)), jnp.nan
+    )
+
+
+def init_indicator_carry(
+    buf5: MarketBuffer, buf15: MarketBuffer, btc_row: jnp.ndarray | int = -1
+) -> IndicatorCarry:
+    """Carry rebuilt from both windows (what every full tick emits).
+    ``btc_row`` seeds the beta/corr pair sums; -1 (tests/bench seeding
+    without a BTC row) leaves them empty — readouts then report 0, the
+    full kernel's no-BTC fill."""
+    S = buf15.capacity
+    close15 = buf15.values[:, :, Field.CLOSE]
+    rets = log_returns(close15)
+    onehot, btc_ok = _btc_row_mask(jnp.asarray(btc_row, jnp.int32), S)
+    btc_rets = jnp.where(
+        btc_ok, jnp.sum(jnp.where(onehot[:, None], rets, 0.0), axis=0), jnp.nan
+    )
+    W5 = buf5.times.shape[1]
+    st_start = (W5 - buf5.filled + (MIN_BARS - 1)).astype(jnp.int32)
     return IndicatorCarry(
-        pack5=init_feature_carry(buf5), pack15=init_feature_carry(buf15)
+        pack5=init_feature_carry(buf5),
+        pack15=init_feature_carry(buf15),
+        abp5=abp_init_from_window(buf5),
+        lsp15=lsp_init_from_window(buf15),
+        # the strategy's dropna'd-frame seed: the series starts MIN_BARS-1
+        # rows past each lane's first available bar (dormant.py)
+        st5=supertrend_init(
+            buf5.values[:, :, Field.HIGH],
+            buf5.values[:, :, Field.LOW],
+            buf5.values[:, :, Field.CLOSE],
+            window=ST_WINDOW,
+            multiplier=ST_MULT,
+            start=st_start,
+        ),
+        bc15=beta_corr_init(rets, btc_rets[None, :], window=BC_WINDOW),
+        bc_dirty=jnp.zeros((S,), bool),
+    )
+
+
+# The smallest ring window the incremental engine supports — the max over
+# every carried family's init AND advance needs. The binding constraint is
+# the ABP carry init's score ring (score_lookback+1 trailing scores): the
+# FIRST tick of a carry-maintaining engine is a full recompute through
+# init_indicator_carry, so a window that only covers the one-bar advances
+# (beta/corr's -(BC_WINDOW+2) close, LSP's -(3·window_hours) volume) would
+# wedge the engine at cold start, not at the advance guard below.
+MIN_INCR_ENGINE_WINDOW = max(
+    BC_WINDOW + 2,
+    ABP_MIN_WINDOW,
+    ABP_INIT_MIN_WINDOW,
+    LSP_MIN_WINDOW,
+    LSP_INIT_MIN_WINDOW,
+)
+
+
+def advance_indicator_carry(
+    buf5: MarketBuffer,
+    buf15: MarketBuffer,
+    carry: IndicatorCarry,
+    btc_row: jnp.ndarray,
+) -> tuple[IndicatorCarry, jnp.ndarray, jnp.ndarray]:
+    """One-bar advance of EVERY carried family under the shared clean-append
+    masks (``features.carry_advance_masks``). Returns
+    ``(carry', stale5, stale15)`` — stale rows kept their state and must be
+    NaN-masked/suppressed by readers until the host's full-recompute resync.
+    """
+    from binquant_tpu.strategies.features import (
+        advance_feature_carry,
+        carry_advance_masks,
+    )
+
+    assert buf15.times.shape[1] >= MIN_INCR_ENGINE_WINDOW, (
+        f"window {buf15.times.shape[1]} too short for the engine-level "
+        f"incremental advance (need >= {MIN_INCR_ENGINE_WINDOW})"
+    )
+    S = buf15.capacity
+    adv5, stale5 = carry_advance_masks(buf5, carry.pack5.last_ts)
+    adv15, stale15 = carry_advance_masks(buf15, carry.pack15.last_ts)
+    pack5, _ = advance_feature_carry(buf5, carry.pack5, masks=(adv5, stale5))
+    pack15, _ = advance_feature_carry(
+        buf15, carry.pack15, masks=(adv15, stale15)
+    )
+    abp5 = abp_advance_one_bar(buf5, carry.abp5, adv5)
+    lsp15 = lsp_advance_one_bar(buf15, carry.lsp15, adv15)
+
+    # supertrend: a lane's series starts once MIN_BARS of history exist —
+    # exactly when the dropna'd-frame seed reaches the newest bar
+    st5, _, _ = supertrend_advance(
+        carry.st5,
+        buf5.values[:, -1, Field.HIGH],
+        buf5.values[:, -1, Field.LOW],
+        buf5.values[:, -1, Field.CLOSE],
+        window=ST_WINDOW,
+        multiplier=ST_MULT,
+        active=adv5 & (buf5.filled >= MIN_BARS),
+    )
+
+    # beta/corr: positional pairing — only rows advancing IN LOCKSTEP with
+    # the BTC row can slide their window; asymmetric rows go dirty
+    onehot, btc_ok = _btc_row_mask(btc_row, S)
+    btc_adv = jnp.any(onehot & adv15) & btc_ok
+    ret_new = _ret_at(buf15, -1)
+    ret_old = _ret_at(buf15, -(BC_WINDOW + 1))
+    y_new = jnp.where(btc_ok, jnp.sum(jnp.where(onehot, ret_new, 0.0)), jnp.nan)
+    y_old = jnp.where(btc_ok, jnp.sum(jnp.where(onehot, ret_old, 0.0)), jnp.nan)
+    bc_new = beta_corr_advance(carry.bc15, ret_new, y_new, ret_old, y_old)
+    pair_adv = adv15 & btc_adv
+    bc15 = jax.tree_util.tree_map(
+        lambda n, o: jnp.where(pair_adv, n, o), bc_new, carry.bc15
+    )
+    bc_dirty = carry.bc_dirty | (
+        (adv15 != btc_adv) & (buf15.filled > 0)
+    )
+
+    return (
+        IndicatorCarry(
+            pack5=pack5,
+            pack15=pack15,
+            abp5=abp5,
+            lsp15=lsp15,
+            st5=st5,
+            bc15=bc15,
+            bc_dirty=bc_dirty,
+        ),
+        stale5,
+        stale15,
     )
 
 
@@ -420,32 +627,26 @@ def _tick_step_impl(
 
     if incremental:
         from binquant_tpu.regime.context import symbol_features_from_carry
-        from binquant_tpu.strategies.features import (
-            advance_feature_carry,
-            feature_pack_from_carry,
-        )
+        from binquant_tpu.strategies.features import feature_pack_from_carry
 
-        carry5, stale5 = advance_feature_carry(
-            buf5, state.indicator_carry.pack5
+        indicator_carry, stale5, stale15 = advance_indicator_carry(
+            buf5, buf15, state.indicator_carry, inputs.btc_row
         )
-        carry15, stale15 = advance_feature_carry(
-            buf15, state.indicator_carry.pack15
-        )
-        pack5 = feature_pack_from_carry(buf5, carry5, stale5)
-        pack15 = feature_pack_from_carry(buf15, carry15, stale15)
+        pack5 = feature_pack_from_carry(buf5, indicator_carry.pack5, stale5)
+        pack15 = feature_pack_from_carry(buf15, indicator_carry.pack15, stale15)
         feats15 = symbol_features_from_carry(
-            buf15, carry15, fresh15 & inputs.tracked, stale15
+            buf15, indicator_carry.pack15, fresh15 & inputs.tracked, stale15
         )
-        indicator_carry = IndicatorCarry(pack5=carry5, pack15=carry15)
     else:
         pack5 = compute_feature_pack(buf5)
         pack15 = compute_feature_pack(buf15)
         feats15 = None
+        stale5 = stale15 = None
         # full recompute re-anchors the carry from the updated windows —
         # the resync every fallback/audit tick provides for free; skipped
         # (passthrough) when the caller will never consume it
         indicator_carry = (
-            init_indicator_carry(buf5, buf15)
+            init_indicator_carry(buf5, buf15, inputs.btc_row)
             if maintain_carry
             else state.indicator_carry
         )
@@ -466,32 +667,48 @@ def _tick_step_impl(
 
     # --- BTC-relative metrics (context_evaluator.py:144-184, 415-418)
     S = buf15.capacity
-    close15 = buf15.values[:, :, Field.CLOSE]
-    rets = log_returns(close15)
-    safe_btc = jnp.clip(inputs.btc_row, 0, S - 1)
-    btc_ok = (inputs.btc_row >= 0) & (inputs.btc_row < S)
+    W = buf15.times.shape[1]
     # Extract the BTC row as a masked reduction, not `rets[btc_row]`: a
     # dynamic row index on a symbol-sharded matrix makes the SPMD
     # partitioner all-gather the full (S, W) array (~3 MB at production
     # shape — caught by __graft_entry__._collective_audit); the one-hot
     # sum communicates only the (W,) result.
-    btc_onehot = (jnp.arange(S) == safe_btc)[:, None]
-    btc_rets_row = jnp.where(btc_onehot, rets, 0.0).sum(axis=0)
-    btc_close_row = jnp.where(btc_onehot, close15, 0.0).sum(axis=0)
-    btc_rets = jnp.where(btc_ok, btc_rets_row, jnp.nan)
-    bc = rolling_beta_corr(rets, btc_rets[None, :], window=50)
-    btc_beta = jnp.where(jnp.isfinite(bc.beta[:, -1]), bc.beta[:, -1], 0.0)
-    btc_corr = jnp.where(jnp.isfinite(bc.corr[:, -1]), bc.corr[:, -1], 0.0)
-    btc_close = jnp.where(btc_ok, btc_close_row, jnp.nan)
-    W = close15.shape[-1]
-    if W > 96:
-        base = btc_close[-97]
-        ok96 = btc_ok & jnp.isfinite(base) & (base != 0) & jnp.isfinite(btc_close[-1])
-        btc_change_96 = jnp.where(
-            ok96, (btc_close[-1] / jnp.where(ok96, base, 1.0) - 1.0) * 100.0, 0.0
+    onehot_rows, btc_ok = _btc_row_mask(inputs.btc_row, S)
+    if incremental:
+        # carried beta/corr readout (O(S)); the three BTC close scalars the
+        # momentum/24h-change formulas need come from single columns —
+        # the (S, W) returns matrix never materializes on the fast path
+        beta, corr = beta_corr_value(indicator_carry.bc15, BC_WINDOW)
+        bc_ok = ~indicator_carry.bc_dirty & ~stale15
+        btc_beta = jnp.where(jnp.isfinite(beta) & bc_ok, beta, 0.0)
+        btc_corr = jnp.where(jnp.isfinite(corr) & bc_ok, corr, 0.0)
+        pick = lambda pos: jnp.where(
+            btc_ok,
+            jnp.sum(jnp.where(onehot_rows, buf15.values[:, pos, Field.CLOSE], 0.0)),
+            jnp.nan,
         )
+        btc_last, btc_prev = pick(-1), pick(-2)
+        if W > 96:
+            btc_change_96 = _btc_change_96(btc_last, pick(-97), btc_ok)
+        else:
+            btc_change_96 = jnp.asarray(0.0, dtype=jnp.float32)
+        btc_mom = _btc_momentum_pair(btc_last, btc_prev)
     else:
-        btc_change_96 = jnp.asarray(0.0, dtype=jnp.float32)
+        close15 = buf15.values[:, :, Field.CLOSE]
+        rets = log_returns(close15)
+        btc_onehot = onehot_rows[:, None]
+        btc_rets_row = jnp.where(btc_onehot, rets, 0.0).sum(axis=0)
+        btc_close_row = jnp.where(btc_onehot, close15, 0.0).sum(axis=0)
+        btc_rets = jnp.where(btc_ok, btc_rets_row, jnp.nan)
+        bc = rolling_beta_corr(rets, btc_rets[None, :], window=BC_WINDOW)
+        btc_beta = jnp.where(jnp.isfinite(bc.beta[:, -1]), bc.beta[:, -1], 0.0)
+        btc_corr = jnp.where(jnp.isfinite(bc.corr[:, -1]), bc.corr[:, -1], 0.0)
+        btc_close = jnp.where(btc_ok, btc_close_row, jnp.nan)
+        if W > 96:
+            btc_change_96 = _btc_change_96(btc_close[-1], btc_close[-97], btc_ok)
+        else:
+            btc_change_96 = jnp.asarray(0.0, dtype=jnp.float32)
+        btc_mom = _btc_momentum_pair(btc_close[-1], btc_close[-2])
 
     ok5 = pack5.filled >= MIN_BARS
     ok15 = pack15.filled >= MIN_BARS
@@ -527,7 +744,14 @@ def _tick_step_impl(
 
     # --- live 5m set (dispatch order l.369-389)
     abp = (
-        _mask_outputs(activity_burst_pump(buf5, context), ok5 & fresh5)
+        _mask_outputs(
+            activity_burst_pump_from_carry(
+                buf5, indicator_carry.abp5, context, stale5
+            )
+            if incremental
+            else activity_burst_pump(buf5, context),
+            ok5 & fresh5,
+        )
         if want("activity_burst_pump")
         else skipped
     )
@@ -542,13 +766,24 @@ def _tick_step_impl(
     # --- live 15m set (dispatch order l.434-479)
     lsp = (
         _mask_outputs(
-            liquidation_sweep_pump(
+            liquidation_sweep_pump_from_carry(
+                buf15,
+                indicator_carry.lsp15,
+                context,
+                inputs.oi_growth,
+                inputs.adp_latest,
+                inputs.adp_prev,
+                btc_mom,
+                stale15,
+            )
+            if incremental
+            else liquidation_sweep_pump(
                 buf15,
                 context,
                 inputs.oi_growth,
                 inputs.adp_latest,
                 inputs.adp_prev,
-                _btc_momentum(btc_close),
+                btc_mom,
             ),
             ok15 & fresh15,
         )
@@ -572,6 +807,18 @@ def _tick_step_impl(
     )
 
     # --- dormant capability set
+    if incremental:
+        # carried supertrend readout (the scan's own validity rules:
+        # ATR warm + unpoisoned); stale rows read not-up
+        stc = indicator_carry.st5
+        st_up_carry = (
+            (stc.n_seen >= ST_WINDOW)
+            & jnp.isfinite(stc.atr)
+            & (stc.direction > 0)
+            & ~stale5
+        )
+    else:
+        st_up_carry = None
     sts = (
         _mask_outputs(
             supertrend_swing_reversal(
@@ -582,6 +829,7 @@ def _tick_step_impl(
                 inputs.adp_diff,
                 inputs.adp_diff_prev,
                 inputs.dominance_is_losers,
+                st_up=st_up_carry,
             ),
             ok5 & fresh5,
         )
@@ -782,9 +1030,11 @@ def _tick_step_impl(
             pack15.bb_lower,
             context.features.micro_regime.astype(jnp.float32),
             context.features.micro_transition.astype(jnp.float32),
+            btc_beta.astype(jnp.float32),
+            btc_corr.astype(jnp.float32),
         ]
-    )  # (12, S)
-    slot_base = base_feats[:, row].T  # (K, 12)
+    )  # (len(EMISSION_BASE_FIELDS), S)
+    slot_base = base_feats[:, row].T  # (K, len(EMISSION_BASE_FIELDS))
     slot_diag = diag_all[si, :, row]  # (K, D)
     slot_payload = jnp.where(
         valid_idx[:, None],
@@ -881,17 +1131,25 @@ tick_step_wire = partial(
     static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
 )(_tick_step_wire_impl)
 
-# Bench/throughput variant: donates the carried EngineState so the ring
-# buffers update in place instead of allocating+copying ~66 MB per tick.
-# Callers must NOT reuse the passed state afterwards. The live SignalEngine
-# deliberately uses the PLAIN tick_step: its crash-isolation ring
-# (consume_loop catches a failed tick and carries on with the pre-tick
-# state) requires the old state to survive a tick that throws mid-flight.
+# Donated variants: the carried EngineState's buffers update in place
+# instead of allocating+copying ~66 MB per tick. Callers must NOT reuse the
+# passed state afterwards. ``tick_step_wire_donated`` IS the live engine's
+# step since ISSUE 4 (BQT_DONATE, default on when safe — io/pipeline.py
+# documents the safety conditions and the audited fallback that re-derives
+# overflow outputs from the post-tick state + pre-tick small-carry
+# snapshots instead of the donated buffers). ``tick_step_donated`` remains
+# the bench/full-outputs variant.
 tick_step_donated = jax.jit(
     _tick_step_impl,
     static_argnames=(
         "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry"
     ),
+    donate_argnums=(0,),
+)
+
+tick_step_wire_donated = jax.jit(
+    _tick_step_wire_impl,
+    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
     donate_argnums=(0,),
 )
 
@@ -921,26 +1179,40 @@ def apply_updates_step(
 
 
 @jax.jit
+def _apply_updates_carry_impl(
+    state: EngineState,
+    upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    upd15: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    btc_row: jnp.ndarray,
+) -> EngineState:
+    buf5 = apply_updates(state.buf5, *upd5)
+    buf15 = apply_updates(state.buf15, *upd15)
+    carry, _, _ = advance_indicator_carry(
+        buf5, buf15, state.indicator_carry, btc_row
+    )
+    return state._replace(buf5=buf5, buf15=buf15, indicator_carry=carry)
+
+
 def apply_updates_carry_step(
     state: EngineState,
     upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     upd15: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    btc_row=None,
 ) -> EngineState:
-    """Sub-batch fold that ALSO advances the indicator carry (O(1) bytes
-    per symbol on top of the buffer scatter). Used for ordered catch-up
-    replay of clean strictly-newer appends so a multi-bar drain — e.g.
-    three 5m bars landing in one 15m tick — stays on the incremental path
-    instead of desyncing the carry."""
-    from binquant_tpu.strategies.features import advance_feature_carry
-
-    buf5 = apply_updates(state.buf5, *upd5)
-    buf15 = apply_updates(state.buf15, *upd15)
-    carry5, _ = advance_feature_carry(buf5, state.indicator_carry.pack5)
-    carry15, _ = advance_feature_carry(buf15, state.indicator_carry.pack15)
-    return state._replace(
-        buf5=buf5,
-        buf15=buf15,
-        indicator_carry=IndicatorCarry(pack5=carry5, pack15=carry15),
+    """Sub-batch fold that ALSO advances every carried family — feature
+    packs AND the strategy-stage/supertrend/beta-corr carries (O(1)-ish
+    bytes per symbol on top of the buffer scatter). Used for ordered
+    catch-up replay of clean strictly-newer appends so a multi-bar drain —
+    e.g. three 5m bars landing in one 15m tick — stays on the incremental
+    path instead of desyncing the carry. ``btc_row`` keeps the beta/corr
+    pairing advancing through folds; None (legacy callers) marks the
+    beta/corr rows dirty for the next resync instead of mis-pairing them.
+    """
+    return _apply_updates_carry_impl(
+        state,
+        upd5,
+        upd15,
+        jnp.asarray(-1 if btc_row is None else btc_row, jnp.int32),
     )
 
 
@@ -1028,8 +1300,18 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
     return True
 
 
-def _btc_momentum(btc_close: jnp.ndarray) -> jnp.ndarray:
+def _btc_momentum_pair(last: jnp.ndarray, prev: jnp.ndarray) -> jnp.ndarray:
     """BTC close pct_change at the last bar (liquidation_sweep_pump.py:166)."""
-    prev = btc_close[-2]
-    ok = jnp.isfinite(prev) & (prev != 0) & jnp.isfinite(btc_close[-1])
-    return jnp.where(ok, btc_close[-1] / jnp.where(ok, prev, 1.0) - 1.0, 0.0)
+    ok = jnp.isfinite(prev) & (prev != 0) & jnp.isfinite(last)
+    return jnp.where(ok, last / jnp.where(ok, prev, 1.0) - 1.0, 0.0)
+
+
+def _btc_change_96(
+    last: jnp.ndarray, base: jnp.ndarray, btc_ok: jnp.ndarray
+) -> jnp.ndarray:
+    """BTC 24h %-change (96 15m bars back; context_evaluator.py:415-418) —
+    the ONE copy both _tick_step_impl branches share: they differ only in
+    how the two close scalars are sourced (carried column picks vs the
+    full path's masked row)."""
+    ok = btc_ok & jnp.isfinite(base) & (base != 0) & jnp.isfinite(last)
+    return jnp.where(ok, (last / jnp.where(ok, base, 1.0) - 1.0) * 100.0, 0.0)

@@ -31,7 +31,12 @@ import numpy as np
 # restores by filling the leading leaves and keeping the template's empty
 # carry; the engine then rebuilds it from the windows on the first tick
 # (load returns ``_carry_rebuilt`` in host_carries).
-CKPT_VERSION = 2
+# v3: IndicatorCarry grew the strategy-stage/supertrend/beta-corr carries
+# (abp5/lsp15/st5/bc15/bc_dirty) — again appended AFTER the v2 leaves in
+# tree order (IndicatorCarry is EngineState's last field and the new
+# sub-carries follow pack5/pack15), so both older versions migrate by the
+# same prefix-fill + first-tick carry rebuild.
+CKPT_VERSION = 3
 
 
 def save_state(
@@ -75,20 +80,29 @@ def load_state(path: str | Path, template_state, registry):
 
     with np.load(path) as data:
         meta = json.loads(bytes(data["__meta"].tobytes()).decode())
-        if meta["version"] not in (1, CKPT_VERSION):
+        if meta["version"] not in (1, 2, CKPT_VERSION):
             raise ValueError(f"checkpoint version {meta['version']} unsupported")
         t_leaves, treedef = jax.tree_util.tree_flatten(template_state)
         migrated = meta["version"] < CKPT_VERSION
-        if migrated:
+        if meta["version"] == 1:
             # v1 predates the indicator carry, whose leaves sit at the END
             # of the EngineState flatten order (it is the last field): the
             # archive must cover exactly the non-carry prefix; the carry
             # keeps the template's empty state and is rebuilt from the
             # windows by the first (full-recompute) tick.
-            n_carry = len(
+            n_missing = len(
                 jax.tree_util.tree_leaves(template_state.indicator_carry)
             )
-            expected = len(t_leaves) - n_carry
+            expected = len(t_leaves) - n_missing
+        elif meta["version"] == 2:
+            # v2 carries only the feature packs: the v3 strategy/supertrend
+            # /beta-corr sub-carries follow them in tree order and keep the
+            # template's empty state until the first-tick rebuild.
+            ic = template_state.indicator_carry
+            n_missing = len(jax.tree_util.tree_leaves(ic)) - len(
+                jax.tree_util.tree_leaves((ic.pack5, ic.pack15))
+            )
+            expected = len(t_leaves) - n_missing
         else:
             expected = len(t_leaves)
         if meta["n_leaves"] != expected:

@@ -143,7 +143,11 @@ def extract_fired(
     happen only when the payload is absent (fabricated test wires) or in
     the >WIRE_MAX_FIRED overflow case.
     """
-    from binquant_tpu.engine.step import EMISSION_BASE_FIELDS, unpack_wire
+    from binquant_tpu.engine.step import (
+        EMISSION_BASE_FIELDS,
+        EMISSION_DIAG_WIDTH,
+        unpack_wire,
+    )
 
     if enabled is None:
         enabled = LIVE_STRATEGIES
@@ -212,6 +216,7 @@ def extract_fired(
     }
     # direct-fetch caches, resolved lazily ONLY for payload-less entries
     micro_np = micro_trans_np = None
+    btc_beta_np = btc_corr_np = None
 
     fired: list[FiredSignal] = []
     for si in sorted(by_strategy):
@@ -234,13 +239,22 @@ def extract_fired(
                 feats = outputs.context.features
                 micro_np = np.asarray(feats.micro_regime)
                 micro_trans_np = np.asarray(feats.micro_transition)
+                btc_beta_np = np.asarray(outputs.btc_beta)
+                btc_corr_np = np.asarray(outputs.btc_corr)
 
         for row, autotrade, direction_code, score, stop_loss, slot in by_strategy[si]:
             symbol = registry.name_of(row)
             if symbol is None:
                 continue
             if slot is not None:
-                base = slot[:n_base]
+                # older fabricated wires may predate the btc_beta/corr
+                # payload columns — the slot is shorter by those two, so
+                # derive ITS base width from the (layout-stable) trailing
+                # diagnostics block; slicing at n_base would misread the
+                # first two diagnostics as btc_beta/corr and shift every
+                # diagnostic key by two
+                slot_base = len(slot) - EMISSION_DIAG_WIDTH
+                base = slot[:slot_base]
                 off = 0 if five_min else 5
                 current_price = float(base[0 + off])
                 volume = float(base[1 + off])
@@ -249,7 +263,12 @@ def extract_fired(
                 bb_low_v = float(base[4 + off])
                 micro = int(base[10])
                 micro_trans = int(base[11])
-                diag_vec = slot[n_base:]
+                btc_rel = (
+                    (float(base[12]), float(base[13]))
+                    if slot_base >= n_base
+                    else None
+                )
+                diag_vec = slot[slot_base:]
                 diag_row = {
                     key: _cast_diag(kind, float(diag_vec[t]))
                     for t, (key, kind) in enumerate(diag_layout[strategy])
@@ -263,6 +282,7 @@ def extract_fired(
                 bb_low_v = float(bb_l[row])
                 micro = int(micro_np[row])
                 micro_trans = int(micro_trans_np[row])
+                btc_rel = (float(btc_beta_np[row]), float(btc_corr_np[row]))
                 # some diagnostics are market-wide scalars (0-d arrays,
                 # e.g. PriceTracker's breadth_stable/confidence) — the
                 # same value applies to every row
@@ -317,7 +337,9 @@ def extract_fired(
                 strategy, symbol, value, diag_row, ctx_np,
                 micro, micro_trans, env, exchange, market_type,
             )
-            analytics = _analytics_record(strategy, symbol, value, diag_row, ctx_np)
+            analytics = _analytics_record(
+                strategy, symbol, value, diag_row, ctx_np, btc_rel=btc_rel
+            )
             fired.append(
                 FiredSignal(strategy, symbol, row, value, message, analytics)
             )
@@ -425,15 +447,21 @@ def _build_message(
 
 
 def _analytics_record(
-    strategy, symbol, value, diag_row, ctx_np
+    strategy, symbol, value, diag_row, ctx_np, btc_rel=None
 ) -> dict[str, Any]:
-    """POST /signals body (context_evaluator.py:302-328)."""
+    """POST /signals body (context_evaluator.py:302-328). ``btc_rel`` is
+    the fired row's (btc_beta, btc_corr) pair off the wire's per-slot
+    payload — an additive indicator enrichment over the reference body
+    (the 50-bar BTC-relative posture, context_evaluator.py:144-184)."""
     merged_indicators: dict[str, Any] = {}
     for key, val in diag_row.items():
         try:
             merged_indicators[key] = float(val)
         except (TypeError, ValueError, IndexError):
             continue
+    if btc_rel is not None:
+        merged_indicators.setdefault("btc_beta", float(btc_rel[0]))
+        merged_indicators.setdefault("btc_corr", float(btc_rel[1]))
     if value.bb_spreads is not None:
         merged_indicators.setdefault(
             "bb_spreads", value.bb_spreads.model_dump(mode="json")

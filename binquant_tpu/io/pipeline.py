@@ -32,6 +32,7 @@ import numpy as np
 from binquant_tpu.config import Config
 from binquant_tpu.engine.buffer import IngestBatcher, SymbolRegistry
 from binquant_tpu.engine.step import (
+    MIN_INCR_ENGINE_WINDOW,
     apply_updates_carry_step,
     apply_updates_step,
     default_host_inputs,
@@ -40,6 +41,7 @@ from binquant_tpu.engine.step import (
     pad_updates,
     tick_step,
     tick_step_wire,
+    tick_step_wire_donated,
     unpack_wire,
 )
 from binquant_tpu.io.autotrade import AutotradeConsumer
@@ -229,6 +231,34 @@ class OpenInterestCache:
             await asyncio.sleep(self.batch_interval_s)
 
 
+_copy_small_carries = None
+
+
+def _snapshot_small_carries(state):
+    """Pre-donation device copies of the NON-buffer EngineState leaves —
+    regime carry, dedupe carries, indicator carry; all (S,)/(S, k)-scale.
+    The donated dispatch's overflow fallback re-evaluates from these plus
+    the post-tick buffers (updates only feed the buffer scatter, which by
+    then has already happened), so no code path ever reads a donated
+    buffer. One jitted dispatch; ~60 small output buffers, O(100 KB)."""
+    global _copy_small_carries
+    if _copy_small_carries is None:
+        import jax
+        import jax.numpy as jnp
+
+        _copy_small_carries = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        )
+    return _copy_small_carries(
+        (
+            state.regime_carry,
+            state.mrf_last_emitted,
+            state.pt_last_signal_close,
+            state.indicator_carry,
+        )
+    )
+
+
 class _PendingTick(NamedTuple):
     """A dispatched-but-not-yet-emitted tick riding the device pipeline."""
 
@@ -269,6 +299,7 @@ class SignalEngine:
         self.telegram_consumer = telegram_consumer
         self.at_consumer = at_consumer
         self.capacity = config.max_symbols
+        self.window = int(window)
         self.registry = registry or SymbolRegistry(self.capacity)
         self.batcher5 = IngestBatcher(self.registry)
         self.batcher15 = IngestBatcher(self.registry)
@@ -404,6 +435,16 @@ class SignalEngine:
         # tick to the full step (counted in bqt_full_recompute_total),
         # which re-anchors the carry from the windows.
         self.incremental = bool(getattr(config, "incremental_enabled", True))
+        if self.incremental and self.window < MIN_INCR_ENGINE_WINDOW:
+            # fail fast: a too-short ring would pass dispatch and wedge the
+            # consume loop on the FIRST full-recompute tick instead (the
+            # ABP carry init needs score_lookback+1 trailing bars)
+            raise ValueError(
+                f"window {self.window} < {MIN_INCR_ENGINE_WINDOW}, the "
+                "incremental engine's minimum ring size (engine/step.py "
+                "MIN_INCR_ENGINE_WINDOW) — grow the window or set "
+                "BQT_INCREMENTAL=0"
+            )
         self.carry_audit_every = int(
             getattr(config, "carry_audit_every_ticks", 256) or 0
         )
@@ -419,6 +460,14 @@ class SignalEngine:
         # exact counters surfaced by health_snapshot / tests
         self.incremental_ticks = 0
         self.full_recompute_ticks = 0
+        # -- donated live buffers (engine/step.py tick_step_wire_donated)
+        # BQT_DONATE: the wire step updates the ring buffers IN PLACE
+        # (erases the functional scatter's allocate+copy — ~0.23 GB/tick of
+        # residual bytes at 2048×400). Engaged per dispatch only when safe
+        # (_use_donated_step); counters below are test/health introspection.
+        self._donate_cfg = bool(getattr(config, "donate_enabled", True))
+        self.donated_ticks = 0
+        self.donated_state_resets = 0
 
     # -- ingest -------------------------------------------------------------
 
@@ -449,7 +498,13 @@ class SignalEngine:
             np.zeros((0, 10), np.float32), size=4,
         )
 
-    def _fold_updates(self, batches5: list, batches15: list, advance_carry: bool = False):
+    def _fold_updates(
+        self,
+        batches5: list,
+        batches15: list,
+        advance_carry: bool = False,
+        btc_row: int = -1,
+    ):
         """Apply all but the FINAL sub-batch pair with the cheap
         update-only step (ordered sub-batch replay — evaluating each would
         advance dedupe carries and discard earlier signals); returns the
@@ -458,8 +513,15 @@ class SignalEngine:
         ``advance_carry=True`` folds with the carry-advancing step so a
         multi-bar drain of clean appends (e.g. three 5m bars per 15m tick)
         keeps the incremental indicator state in sync — only valid when
-        the caller verified every sub-batch is a strictly-newer append."""
-        fold = apply_updates_carry_step if advance_carry else apply_updates_step
+        the caller verified every sub-batch is a strictly-newer append.
+        ``btc_row`` keeps the beta/corr positional pairing advancing
+        through the folds (engine/step.py advance_indicator_carry)."""
+        if advance_carry:
+            fold = lambda st, a, b: apply_updates_carry_step(
+                st, a, b, btc_row=btc_row
+            )
+        else:
+            fold = apply_updates_step
         empty = self._empty_updates()
         upd5 = [pad_updates(*b) for b in batches5] or [empty]
         upd15 = [pad_updates(*b) for b in batches15] or [empty]
@@ -861,7 +923,8 @@ class SignalEngine:
         # clean-append drains stay incremental.
         with trace.span("buffer_fold", advance_carry=use_incremental):
             u5, u15 = self._fold_updates(
-                batches5, batches15, advance_carry=use_incremental
+                batches5, batches15, advance_carry=use_incremental,
+                btc_row=btc_row,
             )
         t_inputs0 = time.perf_counter()
         if self._base_inputs is None:
@@ -927,22 +990,24 @@ class SignalEngine:
             "inputs_build", (time.perf_counter() - t_inputs0) * 1000.0
         )
         trace.record_span("inputs_build", t_inputs0)
+        donate = self._use_donated_step()
         with self.latency.stage("device_dispatch"), trace.span(
-            "device_dispatch", incremental=use_incremental
+            "device_dispatch", incremental=use_incremental, donated=donate
         ), trace.activate():
             # Wire-only step: the full TickOutputs pytree is ~400 output
             # buffers whose handle creation dominates dispatch (measured
             # ~6.6 ms vs ~2.9 ms at S=2048 through the tunneled chip). The
             # host consumes only the wire; the rare overflow/payload-less
-            # paths re-run the full step via the fallback closure below
-            # (pure function of the captured pre-tick state).
+            # paths re-run the full step via the fallback closure below.
             prev_state = self.state
+            small = _snapshot_small_carries(prev_state) if donate else None
             # recompile counter + symbols-per-tick gauge (engine/step.py's
             # shape-signature cache — a True return means the launch below
             # pays a jax trace+compile)
             observe_dispatch(
                 prev_state, u5, u15, self._wire_enabled_key(),
                 cfg=self.context_config,
+                fn="tick_step_wire_donated" if donate else "tick_step_wire",
                 incremental=use_incremental,
                 maintain_carry=self.incremental,
             )
@@ -954,21 +1019,36 @@ class SignalEngine:
                 if trace.active or profiler_window_active()
                 else contextlib.nullcontext()
             )
-            with step_ctx:
-                self.state, wire = tick_step_wire(
-                    prev_state,
-                    u5,
-                    u15,
-                    inputs,
-                    self.context_config,
-                    # device-side wire compaction must match the host's
-                    # enabled set
-                    wire_enabled=self._wire_enabled_key(),
-                    incremental=use_incremental,
-                    # classic-path deployments (BQT_INCREMENTAL=0) never
-                    # read the carry — skip its full-window re-init entirely
-                    maintain_carry=self.incremental,
-                )
+            step_fn = tick_step_wire_donated if donate else tick_step_wire
+            try:
+                with step_ctx:
+                    self.state, wire = step_fn(
+                        prev_state,
+                        u5,
+                        u15,
+                        inputs,
+                        self.context_config,
+                        # device-side wire compaction must match the host's
+                        # enabled set
+                        wire_enabled=self._wire_enabled_key(),
+                        incremental=use_incremental,
+                        # classic-path deployments (BQT_INCREMENTAL=0) never
+                        # read the carry — skip its full-window re-init
+                        maintain_carry=self.incremental,
+                    )
+            except BaseException:
+                if donate:
+                    # a launch that failed AFTER consuming the donated
+                    # buffers leaves no usable pre-tick state — detect and
+                    # reset instead of crash-looping on deleted arrays
+                    self._recover_after_donated_failure(prev_state)
+                raise
+            if donate:
+                self.donated_ticks += 1
+                # the only live references to the donated buffers are gone
+                # past this point — the audit the donated path relies on:
+                # fallback/prewarm/checkpoint all read self.state (post)
+                prev_state = None
             if not use_incremental:
                 # the full step re-initialized the carry from the windows
                 self._carry_desync_reason = None
@@ -980,11 +1060,6 @@ class SignalEngine:
             except AttributeError:
                 pass  # non-jax array (tests with stubbed steps)
 
-        # NOTE the retention cost: the closure pins the pre-tick state
-        # (dominated by the ~66 MB of ring buffers at production shape) in
-        # device memory until this tick finalizes — one extra state copy
-        # per in-flight tick (~0.4% of a v5e's HBM at depth 1; scale depth
-        # with that in mind).
         cfg, key = self.context_config, self._wire_enabled_key()
         # the fallback re-evaluates with the SAME static variant the wire
         # step ran: full-window vs carried readouts differ by f32 epsilon,
@@ -992,13 +1067,47 @@ class SignalEngine:
         # incremental path certified
         incr_args = (use_incremental, self.incremental)
 
-        def fallback(_args=(prev_state, u5, u15, inputs, cfg, key, incr_args)):
-            st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = _args
-            _, full = tick_step(
-                st, upd5, upd15, inp, cfg_, wire_enabled=key_,
-                incremental=incr_, maintain_carry=maint_,
-            )
-            return full
+        if donate:
+            # Donated dispatch: the pre-tick buffers no longer exist, so
+            # the fallback rebuilds this tick's evaluation from the
+            # POST-tick buffers (updates only feed apply_updates, already
+            # applied) + the pre-tick small-carry snapshots, with EMPTY
+            # update batches. ``self.state`` is read lazily at CALL time —
+            # correct because donation is only engaged at depth<=1, where
+            # a tick always finalizes before the next dispatch can donate
+            # the post state (_use_donated_step).
+            empty = self._empty_updates()
+
+            def fallback(_args=(small, inputs, cfg, key, incr_args, empty)):
+                small_, inp, cfg_, key_, (incr_, maint_), emp = _args
+                st = self.state._replace(
+                    regime_carry=small_[0],
+                    mrf_last_emitted=small_[1],
+                    pt_last_signal_close=small_[2],
+                    indicator_carry=small_[3],
+                )
+                _, full = tick_step(
+                    st, emp, emp, inp, cfg_, wire_enabled=key_,
+                    incremental=incr_, maintain_carry=maint_,
+                )
+                return full
+
+            warm_sig = (key, "donated", empty[0].shape, incr_args)
+        else:
+            # NOTE the retention cost of the copying path: the closure pins
+            # the pre-tick state (dominated by the ~66 MB of ring buffers
+            # at production shape) in device memory until this tick
+            # finalizes — one extra state copy per in-flight tick.
+
+            def fallback(_args=(prev_state, u5, u15, inputs, cfg, key, incr_args)):
+                st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = _args
+                _, full = tick_step(
+                    st, upd5, upd15, inp, cfg_, wire_enabled=key_,
+                    incremental=incr_, maintain_carry=maint_,
+                )
+                return full
+
+            warm_sig = (key, u5[0].shape, u15[0].shape, incr_args)
 
         # Pre-warm the fallback's jit cache in the background the first
         # time each (wire key, update-bucket shape) appears: without this,
@@ -1006,15 +1115,26 @@ class SignalEngine:
         # market burst, exactly when signals matter) would pay the full
         # step's trace+compile (tens of seconds) inside finalize. One
         # throwaway execution per shape bucket (~60 ms device time).
+        # The donated variant warms on a THROWAWAY empty state of the same
+        # shapes — the jit cache keys on shapes/dtypes, and the real
+        # fallback args must never leak into a background thread that
+        # could still hold them when the next dispatch donates them.
         # (skipped under CI/replay stubs — a surprise compile there only
         # costs a test second, and the suite would otherwise pay a full
         # background compile per stub engine)
-        warm_sig = (key, u5[0].shape, u15[0].shape, incr_args)
         if not self.config.is_ci and warm_sig not in self._fallback_warmed:
             self._fallback_warmed.add(warm_sig)
             import threading
 
-            def _warm(args=(prev_state, u5, u15, inputs, cfg, key, incr_args)):
+            if donate:
+                warm_args = (
+                    initial_engine_state(self.capacity, window=self.window),
+                    empty, empty, inputs, cfg, key, incr_args,
+                )
+            else:
+                warm_args = (prev_state, u5, u15, inputs, cfg, key, incr_args)
+
+            def _warm(args=warm_args):
                 try:
                     st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = args
                     tick_step(
@@ -1244,6 +1364,102 @@ class SignalEngine:
                 (pending.ts_ms - bar_close_ms) + emit_lag_ms,
             )
         return fired
+
+    def _use_donated_step(self) -> bool:
+        """Whether THIS dispatch may donate the engine state (BQT_DONATE).
+
+        Safety conditions, audited against every post-dispatch reader of
+        the pre-tick state:
+
+        * ``pipeline_depth <= 1`` — process_tick finalizes tick i before
+          dispatching i+1, so a donated fallback's lazy ``self.state``
+          read at finalize still sees tick i's post state. At depth >= 2
+          the NEXT dispatch donates that state before tick i finalizes.
+        * single chip — keeps the donated executable's layout identical to
+          the warm path; the sharded engine keeps the copying step.
+
+        The crash ring's semantics change under donation: a launch that
+        fails after consuming its buffers cannot carry on with the
+        pre-tick state — _recover_after_donated_failure resets cold
+        (logged loudly, counted) instead of crash-looping on deleted
+        arrays. Host-side errors before the launch leave state intact
+        either way.
+        """
+        return (
+            self._donate_cfg
+            and self.pipeline_depth <= 1
+            and self.mesh is None
+        )
+
+    def _reset_state_cold(self, why: str) -> None:
+        """Replace an unrecoverable engine state with a cold empty one —
+        the engine recovers like a restart without a checkpoint
+        (strategy-blind until buffers refill). Logged loudly, counted."""
+        self.donated_state_resets += 1
+        logging.error(
+            "%s; resetting engine state cold (reset #%d — buffers must "
+            "refill before strategies re-arm)",
+            why,
+            self.donated_state_resets,
+        )
+        self.state = initial_engine_state(self.capacity, window=self.window)
+        if self.mesh is not None:
+            # re-apply the symbol-axis sharding __init__ installed — an
+            # unsharded replacement state would silently repin the whole
+            # ~66 MB-per-copy state on one chip (and force a fresh
+            # sharding-signature recompile) for the rest of the process
+            from binquant_tpu.parallel.mesh import shard_engine_state
+
+            self.state = shard_engine_state(self.state, self.mesh)
+        for latest in self._host_latest.values():
+            latest[:] = -1
+        self._carry_desync_reason = "cold_start"
+
+    def _recover_after_donated_failure(self, prev_state) -> None:
+        """A donated launch raised SYNCHRONOUSLY: if the pre-tick buffers
+        were actually consumed (deleted), reset cold rather than
+        crash-looping on deleted arrays."""
+        import jax
+
+        deleted = any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree_util.tree_leaves(prev_state)
+        )
+        if not deleted:
+            return  # launch failed before donation; pre-tick state intact
+        self._reset_state_cold(
+            "donated tick failed after consuming its buffers"
+        )
+
+    def recover_if_state_poisoned(self) -> None:
+        """Crash-ring follow-up for ASYNC device faults. Dispatch is
+        asynchronous, so a device-side failure in a launched tick is NOT
+        raised by the launch — it surfaces at the first use of its
+        outputs (the wire fetch at finalize). By then ``self.state``
+        already holds the failed computation's outputs, and under
+        donation the pre-tick buffers are gone too, so every subsequent
+        fold/dispatch would crash-loop on an unusable state with no
+        reset ever firing. Called by the tick loop after it swallows a
+        processing error: probe the state (deleted-buffer flags plus one
+        small-leaf materialization, which re-raises the device error iff
+        the producing computation failed) and reset cold when unusable.
+        Healthy states pass the probe for a few microseconds; intact
+        failures (host-side errors before a launch) are left alone."""
+        import jax
+
+        try:
+            poisoned = any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(self.state)
+            )
+            if not poisoned:
+                np.asarray(self.state.mrf_last_emitted)  # (S,) probe
+                return
+        except Exception:
+            pass
+        self._reset_state_cold(
+            "engine state poisoned by a failed device computation"
+        )
 
     def _dev_scalar(self, name: str, value):
         """Device scalar cached per input name, re-uploaded only when the
@@ -1508,6 +1724,11 @@ class SignalEngine:
             "incremental_enabled": self.incremental,
             "incremental_ticks": self.incremental_ticks,
             "full_recompute_ticks": self.full_recompute_ticks,
+            # donated live buffers: ticks dispatched through the donated
+            # executable, and cold resets after a post-donation failure
+            # (zero in a healthy deployment)
+            "donated_ticks": self.donated_ticks,
+            "donated_state_resets": self.donated_state_resets,
             # event-log drops (write failures / emit-after-close) — zero
             # in a healthy deployment
             "eventlog_dropped": get_event_log().dropped,
@@ -1600,3 +1821,7 @@ class SignalEngine:
                 raise
             except Exception:
                 logging.exception("tick processing failed; continuing")
+                # an async device fault surfaces here (first use of the
+                # failed launch's outputs) — after donation the state may
+                # be unrecoverable; reset cold instead of crash-looping
+                self.recover_if_state_poisoned()

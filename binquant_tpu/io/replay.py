@@ -70,11 +70,13 @@ def make_stub_engine(
     enabled_strategies: set[str] | None = None,
     context_config=None,
     incremental: bool | None = None,
+    donate: bool | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
-    ``incremental`` overrides the config's BQT_INCREMENTAL default so the
-    A/B harness can pin either evaluation path explicitly."""
+    ``incremental``/``donate`` override the config's BQT_INCREMENTAL /
+    BQT_DONATE defaults so the A/B harness can pin either evaluation path
+    and either dispatch variant explicitly."""
     import os
 
     os.environ.setdefault("ENV", "CI")
@@ -95,6 +97,8 @@ def make_stub_engine(
     config.__dict__["window_bars"] = window
     if incremental is not None:
         config.__dict__["incremental_enabled"] = bool(incremental)
+    if donate is not None:
+        config.__dict__["donate_enabled"] = bool(donate)
     binbot_api = BinbotApi("http://stub", session=StubSession(breadth=breadth))
 
     sent: list[str] = []
@@ -166,6 +170,7 @@ def run_replay(
     market_domination_reversal: bool = False,
     context_config=None,
     incremental: bool | None = None,
+    donate: bool | None = None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -188,6 +193,7 @@ def run_replay(
         enabled_strategies=enabled_strategies,
         context_config=context_config,
         incremental=incremental,
+        donate=donate,
     )
     # scripted dominance state (reference: attrs on the evaluator/consumer,
     # NEUTRAL/False in production — scriptable here so the dominance-gated
@@ -237,6 +243,8 @@ def run_replay(
         # would not be testing the incremental engine at all)
         "incremental_ticks": engine.incremental_ticks,
         "full_recompute_ticks": engine.full_recompute_ticks,
+        "donated_ticks": engine.donated_ticks,
+        "donated_state_resets": engine.donated_state_resets,
         "signals": fired_total,
         "telegram_messages": len(engine._telegram_sent),  # type: ignore[attr-defined]
         "wall_s": round(wall, 3),
@@ -327,6 +335,7 @@ def run_replay_ab(
     dominance_is_losers: bool = False,
     market_domination_reversal: bool = False,
     incremental: bool | None = None,
+    donate: bool | None = None,
 ) -> dict:
     """A/B parity: the TPU batch path and the per-symbol pandas oracle run
     the same replay and must emit the identical signal set (SURVEY.md §7
@@ -346,6 +355,7 @@ def run_replay_ab(
         dominance_is_losers=dominance_is_losers,
         market_domination_reversal=market_domination_reversal,
         incremental=incremental,
+        donate=donate,
     )
     oracle_signals = run_replay_oracle(
         path, window=window, breadth=breadth,

@@ -26,6 +26,14 @@ carried state that advances with ONE new bar per symbol:
   recursion — see ``indicators._supertrend_step``).
 * **Beta/corr** (``BetaCorrCarry``) — the five windowed sums behind
   :func:`ops.indicators.rolling_beta_corr`'s last value.
+* **Order statistics** (``SortedCarry``) — a per-lane SORTED sliding
+  window (finite values ascending, ``+inf`` sentinel padding) advanced by
+  evict-one/insert-one merges: two O(window) gathers per bar instead of
+  the full path's O(TAIL·window·log window) windowed sorts. The readouts
+  (:func:`sorted_quantile` / :func:`sorted_median`) interpolate exactly
+  like :func:`ops.rolling.rolling_quantile` — same rank clamps, same
+  NaN-aware ``min_periods`` count — so a carry holding the same multiset
+  as a window reads out bit-identically to sorting that window.
 
 Every carry has ``*_init`` (from a full window — bit-identical to the
 full-window kernels at the init tick, since both evaluate the same
@@ -70,6 +78,13 @@ __all__ = [
     "beta_corr_init",
     "beta_corr_advance",
     "beta_corr_value",
+    "empty_supertrend_carry",
+    "empty_beta_corr_carry",
+    "SortedCarry",
+    "sorted_init",
+    "sorted_advance",
+    "sorted_quantile",
+    "sorted_median",
 ]
 
 
@@ -279,23 +294,36 @@ class SupertrendCarry(NamedTuple):
     prev_close: jnp.ndarray  # (...,) f32
 
 
+def empty_supertrend_carry(num_symbols: int) -> SupertrendCarry:
+    """The scan's initial carry at (S,) batch — delegated to
+    :func:`ops.indicators.supertrend_scan_init` so the empty state can
+    never drift from the recursion's actual seed."""
+    from binquant_tpu.ops.indicators import supertrend_scan_init
+
+    return SupertrendCarry(*supertrend_scan_init((num_symbols,)))
+
+
 def supertrend_init(
     high: jnp.ndarray,
     low: jnp.ndarray,
     close: jnp.ndarray,
     window: int = 10,
     multiplier: float = 3.0,
+    start: jnp.ndarray | None = None,
 ) -> SupertrendCarry:
     """Run the full-window scan once and keep its final carry: the series
     starts at each lane's first finite bar, exactly like
-    :func:`ops.indicators.supertrend`."""
+    :func:`ops.indicators.supertrend` — or at an explicit per-lane
+    ``start`` (the dropna'd-frame seed strategy consumers use,
+    ``strategies/dormant.py:supertrend_swing_reversal``)."""
     from binquant_tpu.ops.indicators import _supertrend_scan
 
     W = close.shape[-1]
-    finite = _fin(high) & _fin(low) & _fin(close)
-    start = jnp.min(
-        jnp.where(finite, jnp.arange(W, dtype=jnp.int32), W), axis=-1
-    )
+    if start is None:
+        finite = _fin(high) & _fin(low) & _fin(close)
+        start = jnp.min(
+            jnp.where(finite, jnp.arange(W, dtype=jnp.int32), W), axis=-1
+        )
     carry, _, _ = _supertrend_scan(high, low, close, start, window, multiplier)
     return SupertrendCarry(*carry)
 
@@ -333,6 +361,16 @@ class BetaCorrCarry(NamedTuple):
     sxx: jnp.ndarray
     syy: jnp.ndarray
     cnt: jnp.ndarray  # int32 — both-finite pairs in window
+
+
+def empty_beta_corr_carry(num_symbols: int) -> BetaCorrCarry:
+    """All-zero sums/count — what ``beta_corr_init`` yields on an empty
+    window (readouts report the not-enough-pairs NaN until seeded)."""
+    z = jnp.zeros((num_symbols,), jnp.float32)
+    return BetaCorrCarry(
+        sx=z, sy=z, sxy=z, sxx=z, syy=z,
+        cnt=jnp.zeros((num_symbols,), jnp.int32),
+    )
 
 
 def _pairs(x: jnp.ndarray, y: jnp.ndarray):
@@ -396,3 +434,99 @@ def beta_corr_value(
     )
     ok = carry.cnt >= window
     return jnp.where(ok, beta, jnp.nan), jnp.where(ok, corr, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# Sorted sliding window (rolling median / quantile order statistics)
+# ---------------------------------------------------------------------------
+
+
+class SortedCarry(NamedTuple):
+    """Per-lane sorted sliding window for O(window)-merge order statistics.
+
+    ``sorted`` holds the window's finite values ascending with ``+inf``
+    sentinels in the remaining slots (exactly how the full-window kernels
+    sort NaN to the end); ``cnt`` is the finite count the ``min_periods``
+    gate and the interpolation rank read. Eviction is by VALUE: the caller
+    must pass the bit-identical f32 that entered ``window`` advances ago
+    (a ring-buffer column or a companion history ring provides it — both
+    return the stored bits unchanged). An evict value that is no longer
+    present (carry drifted) silently removes the nearest >= entry; the
+    engine's periodic full-recompute resync bounds that failure mode the
+    same way it bounds f32 accumulation drift in the sum carries.
+    """
+
+    sorted: jnp.ndarray  # (..., window) f32 ascending, +inf padding
+    cnt: jnp.ndarray  # (...,) int32 finite values in window
+
+
+def sorted_init(x: jnp.ndarray, window: int) -> SortedCarry:
+    """Carry from the trailing ``window`` samples of ``x`` (..., W>=window):
+    the same sort the full-window kernels run, so readouts at the init tick
+    are bit-identical by construction."""
+    tail = x[..., -window:]
+    m = _fin(tail)
+    return SortedCarry(
+        sorted=jnp.sort(jnp.where(m, tail, jnp.inf), axis=-1).astype(
+            jnp.float32
+        ),
+        cnt=jnp.sum(m, axis=-1).astype(jnp.int32),
+    )
+
+
+def sorted_advance(
+    carry: SortedCarry, x_new: jnp.ndarray, x_old: jnp.ndarray
+) -> SortedCarry:
+    """One bar: remove ``x_old`` (the sample leaving the window), insert
+    ``x_new`` — two rank computations + two O(window) gathers per lane.
+    Non-finite samples map to the ``+inf`` sentinel on both sides, so a
+    NaN entering or leaving shifts only the padding region and ``cnt``.
+    """
+    s = carry.sorted
+    window = s.shape[-1]
+    fn, fo = _fin(x_new), _fin(x_old)
+    xo = jnp.where(fo, x_old, jnp.inf).astype(jnp.float32)
+    xn = jnp.where(fn, x_new, jnp.inf).astype(jnp.float32)
+
+    idx = jnp.arange(window)
+    # evict: first index holding a value >= x_old is x_old's slot (it is
+    # present by the carry invariant); shift everything after it left.
+    e = jnp.sum(s < xo[..., None], axis=-1, keepdims=True)  # (..., 1)
+    t = jnp.take_along_axis(
+        s, jnp.minimum(idx + (idx >= e), window - 1), axis=-1
+    )  # (..., window); only [0, window-2] meaningful after removal
+    # insert: rank among the window-1 survivors, then shift right from it.
+    i = jnp.sum(t[..., : window - 1] < xn[..., None], axis=-1, keepdims=True)
+    u = jnp.where(
+        idx == i,
+        xn[..., None],
+        jnp.take_along_axis(t, idx - (idx > i), axis=-1),
+    )
+    cnt = carry.cnt + fn.astype(jnp.int32) - fo.astype(jnp.int32)
+    return SortedCarry(sorted=u.astype(jnp.float32), cnt=cnt)
+
+
+def sorted_quantile(
+    carry: SortedCarry, q: float, min_periods: int = 1
+) -> jnp.ndarray:
+    """Linear-interpolated quantile at rank ``q·(cnt−1)`` — the SAME
+    clamps/indexing as :func:`ops.rolling.rolling_quantile` (and the
+    inline LSP sort it mirrors), so a carry holding a window's multiset
+    reads out bit-identically to sorting that window."""
+    s = carry.sorted
+    window = s.shape[-1]
+    cnt = carry.cnt
+    rank = q * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, window - 1)
+    hi = jnp.clip(lo + 1, 0, window - 1)
+    frac = rank - lo.astype(s.dtype)
+    v_lo = jnp.take_along_axis(s, lo[..., None], axis=-1)[..., 0]
+    v_hi = jnp.take_along_axis(
+        s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[..., None], axis=-1
+    )[..., 0]
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(cnt >= max(min_periods, 1), out, jnp.nan)
+
+
+def sorted_median(carry: SortedCarry, min_periods: int = 1) -> jnp.ndarray:
+    return sorted_quantile(carry, 0.5, min_periods)

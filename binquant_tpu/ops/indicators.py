@@ -252,6 +252,26 @@ def _supertrend_step(
     )
 
 
+def supertrend_scan_init(batch_shape: tuple[int, ...]) -> tuple:
+    """The recursion's initial carry (atr, n_seen, final_upper,
+    final_lower, direction, prev_close) — the ONE source shared by the
+    full-window scan below and ``ops.incremental``'s empty-carry
+    constructor (``SupertrendCarry`` leaf order/dtypes/values must match
+    this tuple exactly). Every float leaf is EXPLICITLY f32: an inferred
+    (weak) dtype here would give a carry-holding EngineState different jit
+    avals than its checkpoint-restored twin (np round-trips come back
+    strong), and every restart with a checkpoint would silently pay a
+    second full wire compile."""
+    return (
+        jnp.zeros(batch_shape, dtype=jnp.float32),
+        jnp.zeros(batch_shape, dtype=jnp.int32),
+        jnp.full(batch_shape, jnp.inf, dtype=jnp.float32),
+        jnp.full(batch_shape, -jnp.inf, dtype=jnp.float32),
+        jnp.ones(batch_shape, dtype=jnp.float32),
+        jnp.zeros(batch_shape, dtype=jnp.float32),
+    )
+
+
 def _supertrend_scan(
     high: jnp.ndarray,
     low: jnp.ndarray,
@@ -279,14 +299,7 @@ def _supertrend_scan(
         )
         return new_carry, (line, dirn)
 
-    init = (
-        jnp.zeros((B,)),
-        jnp.zeros((B,), dtype=jnp.int32),
-        jnp.full((B,), jnp.inf),
-        jnp.full((B,), -jnp.inf),
-        jnp.ones((B,)),
-        jnp.zeros((B,)),
-    )
+    init = supertrend_scan_init((B,))
     final, (st, dirn) = jax.lax.scan(
         step, init, (h, lo, c, jnp.arange(W, dtype=jnp.int32))
     )

@@ -8,15 +8,39 @@ percentile (l.123-148), and the 3-bar cooldown via the shifted rolling max of
 the raw signal (l.149-156). Long-only; the market-context gate mirrors l.175-179:
 a valid context that denies long autotrade suppresses the signal entirely,
 while a missing context emits with autotrade disabled.
+
+Two evaluation paths share one copy of the per-bar math (``_abp_last_bar``):
+
+* :func:`activity_burst_pump` — the full-tail kernel (cold start, resync,
+  audit, and the classic ``BQT_INCREMENTAL=0`` deployment);
+* the carry twins — :func:`abp_init_from_window` /
+  :func:`abp_advance_one_bar` / :func:`activity_burst_pump_from_carry` —
+  replace the TAIL windowed sorts (the post-ISSUE-2 wire step's dominant
+  bytes residue, ~0.43 GB/tick at 2048×400 on the CPU cost model) with
+  O(window) sorted-window merges (``ops.incremental.SortedCarry``) plus two
+  small history rings (scores for the shifted quantile window, raw signals
+  for the cooldown). The score series is position-local (no cumsums), so a
+  carried score is bit-identical to the full path's recompute of the same
+  position; ring evictions feed back the stored bits, keeping the sorted
+  windows' multisets exact until the engine's periodic resync re-anchors
+  them anyway.
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer
+from binquant_tpu.ops.incremental import (
+    SortedCarry,
+    sorted_advance,
+    sorted_init,
+    sorted_median,
+    sorted_quantile,
+)
 from binquant_tpu.ops.pallas_rolling import rolling_quantile_tail_auto
 from binquant_tpu.ops.rolling import rolling_median, shift
 from binquant_tpu.regime.context import MarketContext
@@ -50,6 +74,162 @@ ROUTE_UNAVAILABLE = 0  # "market_context_unavailable"
 ROUTE_ALLOWED = 1  # "long_autotrade_allowed"
 
 
+def _baseline_window(p: ABPParams) -> int:
+    """Rolling window after shift(2) — the reference's ``bw``."""
+    return max(p.lookback_window, 2) - 1
+
+
+class _LastBar(NamedTuple):
+    """Last-position intermediates shared by the carry advance (score/raw
+    computation) and the carry readout (diagnostics + trigger)."""
+
+    baseline_safe: jnp.ndarray
+    volume_ratio: jnp.ndarray
+    quote_ratio: jnp.ndarray
+    price_jump: jnp.ndarray
+    range_frac: jnp.ndarray
+    body_frac: jnp.ndarray
+    score: jnp.ndarray
+    threshold_filled: jnp.ndarray
+    raw: jnp.ndarray
+    volume: jnp.ndarray
+
+
+def _col(buf: MarketBuffer, pos: int, f: Field) -> jnp.ndarray:
+    """(S,) column read — O(1) bytes per symbol (features.py idiom)."""
+    return buf.values[:, pos, int(f)]
+
+
+def _abp_last_bar(
+    buf5: MarketBuffer,
+    vol_med: SortedCarry,
+    qvol_med: SortedCarry,
+    score_q: SortedCarry,
+    has_qav: jnp.ndarray,
+    p: ABPParams,
+) -> _LastBar:
+    """The kernel's newest-position math from carried order statistics and
+    a dozen (S,) column reads — expression-for-expression the formulas of
+    :func:`activity_burst_pump` evaluated at the last tail position, so a
+    carried score is bit-identical to the full path's recompute (the only
+    rolling inputs are the medians/quantile, which are exact sorts of the
+    same multisets)."""
+    bw = _baseline_window(p)
+    minb = p.min_baseline_volume
+    volume = _col(buf5, -1, Field.VOLUME)
+    quote_volume = _col(buf5, -1, Field.QUOTE_VOLUME)
+    close = _col(buf5, -1, Field.CLOSE)
+    open_ = _col(buf5, -1, Field.OPEN)
+    high = _col(buf5, -1, Field.HIGH)
+    low = _col(buf5, -1, Field.LOW)
+    c1 = _col(buf5, -2, Field.CLOSE)
+    c2 = _col(buf5, -3, Field.CLOSE)
+    c3 = _col(buf5, -4, Field.CLOSE)
+
+    baseline = sorted_median(vol_med, min_periods=bw)
+    baseline_safe = jnp.maximum(baseline, minb)
+    volume_ratio = volume / baseline_safe
+    q_baseline = sorted_median(qvol_med, min_periods=bw)
+    q_baseline_safe = jnp.maximum(q_baseline, minb)
+    quote_ratio = jnp.where(has_qav, quote_volume / q_baseline_safe, 1.0)
+
+    prev_close = jnp.maximum(c1, minb)
+    candle_range = jnp.maximum(high - low, minb)
+    body = jnp.abs(close - open_)
+
+    price_jump = (close - c1) / prev_close
+    range_frac = candle_range / jnp.maximum(close, minb)
+    body_frac = body / candle_range
+    close_to_high = (high - close) / candle_range
+    is_bullish = close > open_
+    # NaN closes compare False -> 0.0, exactly the full path's shift-fill
+    recent_up = (
+        (close > c1).astype(jnp.float32)
+        + (c1 > c2).astype(jnp.float32)
+        + (c2 > c3).astype(jnp.float32)
+    )
+
+    vol_spike = volume > p.volume_multiplier * baseline_safe
+    quote_spike = jnp.where(
+        has_qav, quote_volume > p.quote_volume_multiplier * q_baseline_safe, True
+    )
+    jump_flag = price_jump > p.price_threshold
+    range_flag = range_frac > p.min_range_frac
+    body_flag = (
+        is_bullish & (body_frac > p.min_body_frac) & (close_to_high < p.max_close_to_high)
+    )
+    trend_flag = recent_up >= jnp.where(has_qav, p.min_recent_up_closes, 1)
+
+    score = jnp.where(
+        has_qav,
+        volume_ratio * quote_ratio * jnp.maximum(price_jump, 0.0) * (1.0 + body_frac),
+        volume_ratio * jnp.maximum(price_jump, 0.0),
+    )
+    threshold = sorted_quantile(
+        score_q, p.score_quantile, min_periods=p.lookback_window
+    )
+    threshold_filled = jnp.where(jnp.isfinite(threshold), threshold, 0.0)
+    raw = (
+        vol_spike
+        & quote_spike
+        & jump_flag
+        & range_flag
+        & body_flag
+        & trend_flag
+        & jnp.isfinite(score)
+        & (score >= threshold_filled)
+    )
+    return _LastBar(
+        baseline_safe=baseline_safe,
+        volume_ratio=volume_ratio,
+        quote_ratio=quote_ratio,
+        price_jump=price_jump,
+        range_frac=range_frac,
+        body_frac=body_frac,
+        score=score,
+        threshold_filled=threshold_filled,
+        raw=raw,
+        volume=volume,
+    )
+
+
+def _abp_outputs(
+    buf5: MarketBuffer,
+    context: MarketContext,
+    qualified: jnp.ndarray,
+    score_last: jnp.ndarray,
+    diag: dict[str, jnp.ndarray],
+    p: ABPParams,
+) -> StrategyOutputs:
+    """Trigger gating + output assembly shared by both paths (the layout —
+    keys, order, dtypes — must be identical: the wire's emission layout is
+    recorded once per wire_enabled combo regardless of the path traced)."""
+    fired = qualified
+    # data sufficiency: len(df) >= lookback+1 (l.164)
+    fired = fired & (buf5.filled >= p.lookback_window + 1)
+
+    # context gate (l.175-179): valid context + denied long -> suppress;
+    # valid + allowed -> autotrade; no context -> emit, autotrade off.
+    gate = allows_long_autotrade_mask(context)
+    has_context = context.valid
+    fired = fired & (~has_context | gate)
+    autotrade = fired & has_context & gate
+    route = jnp.where(has_context, ROUTE_ALLOWED, ROUTE_UNAVAILABLE)
+
+    S = buf5.capacity
+    return StrategyOutputs(
+        trigger=fired,
+        direction=jnp.zeros((S,), dtype=jnp.int32),  # long-only
+        score=jnp.where(jnp.isfinite(score_last), score_last, 0.0),
+        autotrade=autotrade,
+        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
+        diagnostics={
+            **diag,
+            "route": jnp.broadcast_to(route, (S,)).astype(jnp.int32),
+        },
+    )
+
+
 def activity_burst_pump(
     buf5: MarketBuffer,
     context: MarketContext,
@@ -63,7 +243,7 @@ def activity_burst_pump(
     high = buf5.values[:, -TAIL:, Field.HIGH]
     low = buf5.values[:, -TAIL:, Field.LOW]
 
-    bw = max(p.lookback_window, 2) - 1  # rolling window after shift(2)
+    bw = _baseline_window(p)  # rolling window after shift(2)
     baseline = rolling_median(shift(volume, 2), bw, min_periods=bw)
     baseline_safe = jnp.maximum(baseline, p.min_baseline_volume)
     volume_ratio = volume / baseline_safe
@@ -132,26 +312,12 @@ def activity_burst_pump(
     # 3-bar cooldown: any raw signal in the previous cooldown_bars bars
     qualified = raw[:, -1] & ~jnp.any(raw[:, :-1], axis=-1)
 
-    fired = qualified
-    # data sufficiency: len(df) >= lookback+1 (l.164)
-    fired = fired & (buf5.filled >= p.lookback_window + 1)
-
-    # context gate (l.175-179): valid context + denied long -> suppress;
-    # valid + allowed -> autotrade; no context -> emit, autotrade off.
-    gate = allows_long_autotrade_mask(context)
-    has_context = context.valid
-    fired = fired & (~has_context | gate)
-    autotrade = fired & has_context & gate
-    route = jnp.where(has_context, ROUTE_ALLOWED, ROUTE_UNAVAILABLE)
-
-    S = buf5.capacity
-    return StrategyOutputs(
-        trigger=fired,
-        direction=jnp.zeros((S,), dtype=jnp.int32),  # long-only
-        score=jnp.where(jnp.isfinite(score[:, -1]), score[:, -1], 0.0),
-        autotrade=autotrade,
-        stop_loss_pct=jnp.zeros((S,), dtype=jnp.float32),
-        diagnostics={
+    return _abp_outputs(
+        buf5,
+        context,
+        qualified,
+        score[:, -1],
+        {
             "baseline_volume": baseline_safe[:, -1],
             "volume_ratio": volume_ratio[:, -1],
             "quote_volume_ratio": quote_ratio[:, -1],
@@ -160,6 +326,251 @@ def activity_burst_pump(
             "body_frac": body_frac[:, -1],
             "score_threshold": threshold_filled[:, -1],
             "volume": volume[:, -1],
-            "route": jnp.broadcast_to(route, (S,)).astype(jnp.int32),
         },
+        p,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Incremental carry: the same kernel in O(window) merges per symbol per tick
+# ---------------------------------------------------------------------------
+
+
+class ABPCarry(NamedTuple):
+    """Carried ActivityBurstPump state, (S,)/(S, k) leaves.
+
+    The sorted windows track the SHIFTED series the kernel thresholds on:
+    ``vol_med``/``qvol_med`` hold ``shift(volume, 2)``'s trailing window
+    (entering sample = the bar two back, read from the ring buffer), and
+    ``score_q`` holds ``shift(score, 1)``'s trailing window whose entering/
+    evicted samples come from ``score_ring`` (scores are derived, not
+    buffer-resident). ``raw_ring`` is the cooldown's bounded history of the
+    raw signal at the trailing ``cooldown_bars+1`` positions.
+
+    ``has_qav``/``dirty``: the kernel's no-quote-volume branch switches the
+    ENTIRE score formula per row; a flip (a feed starting/stopping quote
+    volume — listing quirks, essentially never mid-stream) invalidates the
+    carried score history, which no O(1) advance can rewrite. The flip sets
+    ``dirty``, the readout suppresses that row's trigger, and the engine's
+    next full recompute (audit at the latest) re-anchors and clears it.
+    """
+
+    vol_med: SortedCarry
+    qvol_med: SortedCarry
+    score_q: SortedCarry
+    score_ring: jnp.ndarray  # (S, score_lookback+1) f32, oldest first
+    raw_ring: jnp.ndarray  # (S, cooldown_bars+1) bool, oldest first
+    has_qav: jnp.ndarray  # (S,) bool
+    dirty: jnp.ndarray  # (S,) bool
+
+
+# The deepest column the one-bar advance reads: the shifted baseline
+# window's leaver at -(bw+3).
+ABP_MIN_WINDOW = _baseline_window(ABPParams()) + 3
+# The init's deeper need: the score ring keeps score_lookback+1 trailing
+# scores (abp_init_from_window's shape-pinning assert).
+ABP_INIT_MIN_WINDOW = ABPParams().score_lookback + 1
+
+
+def empty_abp_carry(num_symbols: int, p: ABPParams = ABPParams()) -> ABPCarry:
+    bw = _baseline_window(p)
+    empty_sorted = lambda w: SortedCarry(
+        sorted=jnp.full((num_symbols, w), jnp.inf, jnp.float32),
+        cnt=jnp.zeros((num_symbols,), jnp.int32),
+    )
+    return ABPCarry(
+        vol_med=empty_sorted(bw),
+        qvol_med=empty_sorted(bw),
+        score_q=empty_sorted(p.score_lookback),
+        score_ring=jnp.full(
+            (num_symbols, p.score_lookback + 1), jnp.nan, jnp.float32
+        ),
+        raw_ring=jnp.zeros((num_symbols, p.cooldown_bars + 1), bool),
+        has_qav=jnp.zeros((num_symbols,), bool),
+        dirty=jnp.zeros((num_symbols,), bool),
+    )
+
+
+def abp_init_from_window(
+    buf5: MarketBuffer, p: ABPParams = ABPParams()
+) -> ABPCarry:
+    """Carry from the full tail — the SAME series expressions the full
+    kernel evaluates, so every readout at the init tick is bit-identical
+    (the resync contract every full/audit tick provides for free)."""
+    bw = _baseline_window(p)
+    # the ring slices below pin the carry's leaf shapes (score_lookback+1
+    # columns, the deepest need — a shorter buffer would silently build a
+    # narrower pytree than empty_abp_carry's template, breaking checkpoint
+    # shape checks and duplicating jit cache entries)
+    assert buf5.window >= p.score_lookback + 1, (
+        f"window {buf5.window} too short for the ABP carry init "
+        f"(need >= {p.score_lookback + 1})"
+    )
+    volume = buf5.values[:, -TAIL:, Field.VOLUME]
+    quote_volume = buf5.values[:, -TAIL:, Field.QUOTE_VOLUME]
+    close = buf5.values[:, -TAIL:, Field.CLOSE]
+    open_ = buf5.values[:, -TAIL:, Field.OPEN]
+    high = buf5.values[:, -TAIL:, Field.HIGH]
+    low = buf5.values[:, -TAIL:, Field.LOW]
+
+    baseline_safe = jnp.maximum(
+        rolling_median(shift(volume, 2), bw, min_periods=bw),
+        p.min_baseline_volume,
+    )
+    volume_ratio = volume / baseline_safe
+    has_qav = jnp.any(quote_volume > 0, axis=-1, keepdims=True)
+    q_baseline_safe = jnp.maximum(
+        rolling_median(shift(quote_volume, 2), bw, min_periods=bw),
+        p.min_baseline_volume,
+    )
+    quote_ratio = jnp.where(has_qav, quote_volume / q_baseline_safe, 1.0)
+    prev_close = jnp.maximum(shift(close, 1), p.min_baseline_volume)
+    candle_range = jnp.maximum(high - low, p.min_baseline_volume)
+    price_jump = (close - shift(close, 1)) / prev_close
+    body_frac = jnp.abs(close - open_) / candle_range
+    score = jnp.where(
+        has_qav,
+        volume_ratio * quote_ratio * jnp.maximum(price_jump, 0.0) * (1.0 + body_frac),
+        volume_ratio * jnp.maximum(price_jump, 0.0),
+    )
+
+    # the cooldown ring seeds from the full kernel's trailing raw values
+    n_out = p.cooldown_bars + 1
+    threshold_tail = rolling_quantile_tail_auto(
+        shift(score, 1), p.score_lookback, p.score_quantile,
+        num_out=n_out, min_periods=p.lookback_window,
+    )
+    threshold_filled = jnp.where(jnp.isfinite(threshold_tail), threshold_tail, 0.0)
+    range_frac = candle_range / jnp.maximum(close, p.min_baseline_volume)
+    close_to_high = (high - close) / candle_range
+    up_close = (close > shift(close, 1)).astype(jnp.float32)
+    recent_up = up_close + shift(up_close, 1, 0.0) + shift(up_close, 2, 0.0)
+    tail_n = lambda a: a[:, -n_out:]
+    raw = (
+        tail_n(volume > p.volume_multiplier * baseline_safe)
+        & tail_n(
+            jnp.where(
+                has_qav,
+                quote_volume > p.quote_volume_multiplier * q_baseline_safe,
+                True,
+            )
+        )
+        & tail_n(price_jump > p.price_threshold)
+        & tail_n(range_frac > p.min_range_frac)
+        & tail_n(
+            (close > open_)
+            & (body_frac > p.min_body_frac)
+            & (close_to_high < p.max_close_to_high)
+        )
+        & tail_n(recent_up >= jnp.where(has_qav, p.min_recent_up_closes, 1))
+        & jnp.isfinite(tail_n(score))
+        & (tail_n(score) >= threshold_filled)
+    )
+
+    return ABPCarry(
+        vol_med=sorted_init(shift(volume, 2), bw),
+        qvol_med=sorted_init(shift(quote_volume, 2), bw),
+        score_q=sorted_init(shift(score, 1), p.score_lookback),
+        score_ring=score[:, -(p.score_lookback + 1):].astype(jnp.float32),
+        raw_ring=raw,
+        has_qav=has_qav[:, 0],
+        dirty=jnp.zeros((buf5.capacity,), bool),
+    )
+
+
+def abp_advance_one_bar(
+    buf5: MarketBuffer,
+    carry: ABPCarry,
+    advanced: jnp.ndarray,
+    p: ABPParams = ABPParams(),
+) -> ABPCarry:
+    """Advance per-symbol carries by the buffer's newest bar (rows where
+    ``advanced`` is False keep their state — same contract as
+    ``features.advance_feature_carry``, whose mask the engine shares)."""
+    bw = _baseline_window(p)
+    assert buf5.window >= bw + 3, (  # == ABP_MIN_WINDOW at default params
+        f"window {buf5.window} too short for the ABP carry advance "
+        f"(deepest read -(bw+3) = -{bw + 3})"
+    )
+    # the shifted baseline window ends two bars back: entering sample is
+    # the ring column at -3, the leaver at -(bw+3)
+    vol_med = sorted_advance(
+        carry.vol_med,
+        _col(buf5, -3, Field.VOLUME),
+        _col(buf5, -(bw + 3), Field.VOLUME),
+    )
+    qvol_med = sorted_advance(
+        carry.qvol_med,
+        _col(buf5, -3, Field.QUOTE_VOLUME),
+        _col(buf5, -(bw + 3), Field.QUOTE_VOLUME),
+    )
+    # shift(score,1) window: enters last tick's score, evicts the oldest
+    score_q = sorted_advance(
+        carry.score_q, carry.score_ring[:, -1], carry.score_ring[:, 0]
+    )
+
+    has_qav = jnp.any(
+        buf5.values[:, -TAIL:, Field.QUOTE_VOLUME] > 0, axis=-1
+    )
+    dirty = carry.dirty | (has_qav != carry.has_qav)
+
+    last = _abp_last_bar(buf5, vol_med, qvol_med, score_q, has_qav, p)
+    new = ABPCarry(
+        vol_med=vol_med,
+        qvol_med=qvol_med,
+        score_q=score_q,
+        score_ring=jnp.concatenate(
+            [carry.score_ring[:, 1:], last.score[:, None].astype(jnp.float32)],
+            axis=1,
+        ),
+        raw_ring=jnp.concatenate(
+            [carry.raw_ring[:, 1:], last.raw[:, None]], axis=1
+        ),
+        has_qav=has_qav,
+        dirty=dirty,
+    )
+
+    def sel(n, o):
+        mask = advanced if n.ndim == 1 else advanced[:, None]
+        return jnp.where(mask, n, o)
+
+    return jax.tree_util.tree_map(sel, new, carry)
+
+
+def activity_burst_pump_from_carry(
+    buf5: MarketBuffer,
+    carry: ABPCarry,
+    context: MarketContext,
+    stale: jnp.ndarray,
+    params: ABPParams = ABPParams(),
+) -> StrategyOutputs:
+    """The fast-path twin of :func:`activity_burst_pump`: same formulas
+    from carried order statistics + column reads. STALE rows (carry
+    desynced — the host is already routing to a full recompute) and DIRTY
+    rows (has_qav flip) cannot fire."""
+    p = params
+    last = _abp_last_bar(
+        buf5, carry.vol_med, carry.qvol_med, carry.score_q, carry.has_qav, p
+    )
+    # cooldown: the ring's last entry IS this bar's raw (pushed by the
+    # advance); the previous cooldown_bars entries veto
+    qualified = (
+        last.raw & ~jnp.any(carry.raw_ring[:, :-1], axis=-1) & ~stale & ~carry.dirty
+    )
+    return _abp_outputs(
+        buf5,
+        context,
+        qualified,
+        last.score,
+        {
+            "baseline_volume": last.baseline_safe,
+            "volume_ratio": last.volume_ratio,
+            "quote_volume_ratio": last.quote_ratio,
+            "price_jump": last.price_jump,
+            "range_frac": last.range_frac,
+            "body_frac": last.body_frac,
+            "score_threshold": last.threshold_filled,
+            "volume": last.volume,
+        },
+        p,
     )

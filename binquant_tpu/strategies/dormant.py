@@ -156,25 +156,34 @@ def supertrend_swing_reversal(
     adp_diff: jnp.ndarray,  # scalar — breadth[-1]-breadth[-2], NaN if missing
     adp_diff_prev: jnp.ndarray,  # scalar — breadth[-2]-breadth[-3]
     dominance_is_losers: jnp.ndarray,  # scalar bool
+    st_up: jnp.ndarray | None = None,  # (S,) bool — carried readout override
 ) -> StrategyOutputs:
     """Supertrend(10,3) uptrend ∧ RSI<30 ∧ trades>5 ∧ rising ADP twice ∧
-    LOSERS dominance. Long; autotrade via the standard long gate."""
+    LOSERS dominance. Long; autotrade via the standard long gate.
+
+    ``st_up`` lets the incremental engine inject the supertrend direction
+    read from carried scan state (``ops.incremental.SupertrendCarry`` —
+    advanced one bar per tick, re-anchored by every full-recompute tick)
+    instead of re-running the O(S·W) path-dependent scan here."""
     S = buf5.capacity
     W = buf5.times.shape[1]
-    # The reference runs supertrend on the dropna'd enriched frame
-    # (coinrule.py:140-143): the series starts after the ma_100 warm-up —
-    # 99 rows past the first available bar. The ratchet is path-dependent,
-    # so the seed point must match, not just the tail.
-    start = (W - pack5.filled + 99).astype(jnp.int32)
-    st = supertrend_from(
-        buf5.values[:, :, Field.HIGH],
-        buf5.values[:, :, Field.LOW],
-        buf5.values[:, :, Field.CLOSE],
-        start,
-        window=10,
-        multiplier=3.0,
-    )
-    st_up = jnp.where(jnp.isfinite(st.direction[:, -1]), st.direction[:, -1] > 0, False)
+    if st_up is None:
+        # The reference runs supertrend on the dropna'd enriched frame
+        # (coinrule.py:140-143): the series starts after the ma_100 warm-up
+        # — 99 rows past the first available bar. The ratchet is
+        # path-dependent, so the seed point must match, not just the tail.
+        start = (W - pack5.filled + 99).astype(jnp.int32)
+        st = supertrend_from(
+            buf5.values[:, :, Field.HIGH],
+            buf5.values[:, :, Field.LOW],
+            buf5.values[:, :, Field.CLOSE],
+            start,
+            window=10,
+            multiplier=3.0,
+        )
+        st_up = jnp.where(
+            jnp.isfinite(st.direction[:, -1]), st.direction[:, -1] > 0, False
+        )
 
     breadth_ok = (
         jnp.isfinite(adp_diff)

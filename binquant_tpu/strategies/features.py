@@ -404,8 +404,30 @@ def _flows_at(buf: MarketBuffer, pos: int):
     return pos_f, neg_f
 
 
+def carry_advance_masks(
+    buf: MarketBuffer, last_ts: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(advanced, stale) row masks for a one-bar carry advance — the single
+    copy of the clean-append test every carry family shares (the pack carry
+    here, the strategy/supertrend/beta-corr carries in engine/step.py):
+
+    * ``advanced`` — new latest ts whose previous slot holds exactly
+      ``last_ts`` (a clean single-bar append; safe to advance);
+    * ``stale`` — the latest ts moved any other way (reset row reclaimed,
+      desync): keep the carry and let readers NaN-mask until the host's
+      full-recompute resync lands.
+    """
+    ts = buf.times[:, -1]
+    prev_ts = buf.times[:, -2]
+    advanced = (ts >= 0) & (ts != last_ts) & (prev_ts == last_ts)
+    stale = (ts != last_ts) & ~advanced
+    return advanced, stale
+
+
 def advance_feature_carry(
-    buf: MarketBuffer, carry: FeatureCarry
+    buf: MarketBuffer,
+    carry: FeatureCarry,
+    masks: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> tuple[FeatureCarry, jnp.ndarray]:
     """Advance per-symbol carries by the buffer's newest bar.
 
@@ -422,6 +444,12 @@ def advance_feature_carry(
     from the update stream and routes the tick to the full step
     (io/pipeline.py), which is the only way to rebuild windowed sums whose
     interior changed.
+
+    ``masks`` lets a caller that already ran :func:`carry_advance_masks`
+    (engine/step.py advances every carry family under ONE copy of the
+    clean-append decision) pass its ``(advanced, stale)`` through instead
+    of recomputing them here — keeping a single mask source the strategy
+    carries can never silently desync from.
     """
     W = buf.window
     assert W >= MIN_INCREMENTAL_WINDOW, (
@@ -429,9 +457,9 @@ def advance_feature_carry(
         f"(need >= {MIN_INCREMENTAL_WINDOW})"
     )
     ts = buf.times[:, -1]
-    prev_ts = buf.times[:, -2]
-    advanced = (ts >= 0) & (ts != carry.last_ts) & (prev_ts == carry.last_ts)
-    stale = (ts != carry.last_ts) & ~advanced
+    advanced, stale = (
+        masks if masks is not None else carry_advance_masks(buf, carry.last_ts)
+    )
 
     close_new = _col(buf, -1, Field.CLOSE)
     vol_new = _col(buf, -1, Field.VOLUME)

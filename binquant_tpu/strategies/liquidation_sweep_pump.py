@@ -9,16 +9,33 @@ ADP fading + BTC stalled + weak symbol → short, washed-out ADP recovering +
 BTC up → long (l.76-108). ADP (advancers-decliners pressure) comes from the
 REST breadth series when available, else from the context's
 advancers−decliners ratio (l.56-63) — the host passes the resolved pair.
+
+Two evaluation paths share the routing/gating block: the full-tail kernel
+(:func:`liquidation_sweep_pump`) and the carry twins
+(:func:`lsp_init_from_window` / :func:`lsp_advance_one_bar` /
+:func:`liquidation_sweep_pump_from_carry`). The carry tracks the 48-bar
+sorted window of the UNSCALED smoothed score (OI growth is a per-row
+positive scalar multiplying the whole series uniformly, so the quantile
+scales linearly and the factor is applied at readout — exact when the OI
+factor is 1.0, the no-futures/replay case); entering values come from ~20
+(S,) column reads per bar instead of the full-tail rolling pipeline.
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from binquant_tpu.engine.buffer import Field, MarketBuffer
 from binquant_tpu.enums import Direction, MicroRegimeCode
+from binquant_tpu.ops.incremental import (
+    SortedCarry,
+    sorted_advance,
+    sorted_init,
+    sorted_quantile,
+)
 from binquant_tpu.ops.rolling import rolling_mean, rolling_max, rolling_min, shift
 from binquant_tpu.regime.context import MarketContext
 from binquant_tpu.strategies.base import StrategyOutputs
@@ -53,62 +70,23 @@ class LSPParams(NamedTuple):
 # volume 9 bars back -> 64 covers 49+9 with margin.
 TAIL = 64
 
+# The deepest column the one-bar advance reads: the shifted volume-mean
+# window's oldest sample at -(3*window_hours).
+LSP_MIN_WINDOW = 3 * LSPParams().window_hours + 1
+# The init's deeper need: the sorted score window keeps score_window
+# trailing smooth scores (lsp_init_from_window's shape-pinning assert).
+LSP_INIT_MIN_WINDOW = LSPParams().score_window
 
-def liquidation_sweep_pump(
-    buf15: MarketBuffer,
+
+def _routing(
     context: MarketContext,
-    oi_growth: jnp.ndarray,  # (S,) f32, NaN = unavailable (KuCoin OI cache)
-    adp_latest: jnp.ndarray,  # scalar f32 — resolved ADP (breadth or context)
-    adp_prev: jnp.ndarray,  # scalar f32, NaN = no history
-    btc_momentum: jnp.ndarray,  # scalar f32 — BTC close pct_change last bar
-    params: LSPParams = LSPParams(),
-) -> StrategyOutputs:
-    p = params
-    wh = p.window_hours
-    volume = buf15.values[:, -TAIL:, Field.VOLUME]
-    close = buf15.values[:, -TAIL:, Field.CLOSE]
-    high = buf15.values[:, -TAIL:, Field.HIGH]
-    low = buf15.values[:, -TAIL:, Field.LOW]
-
-    # --- pump score pipeline (l.120-145)
-    rel_volume = volume / shift(rolling_mean(volume, wh * 2), wh)
-    momentum = close / shift(close, wh) - 1.0
-    range_frac = (rolling_max(high, wh * 2) - rolling_min(low, wh * 2)) / close
-
-    oi_factor = jnp.where(
-        jnp.isfinite(oi_growth), 1.0 + jnp.maximum(0.0, oi_growth - 1.0), 1.0
-    )[:, None]
-    pump_score = rel_volume * (1.0 + momentum) * oi_factor / range_frac
-    smooth = rolling_mean(pump_score, 2)
-
-    # --- trigger: top-quintile of last 48 smoothed scores (l.165-181)
-    recent = smooth[:, -p.score_window:]
-    finite = jnp.isfinite(recent)
-    cnt = jnp.sum(finite, axis=-1)
-    s = jnp.sort(jnp.where(finite, recent, jnp.inf), axis=-1)
-    rank = p.score_quantile * (cnt - 1.0)
-    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, p.score_window - 1)
-    hi = jnp.clip(lo + 1, 0, p.score_window - 1)
-    frac = rank - lo
-    v_lo = jnp.take_along_axis(s, lo[:, None], axis=-1)[:, 0]
-    v_hi = jnp.take_along_axis(
-        s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[:, None], axis=-1
-    )[:, 0]
-    threshold = v_lo + (v_hi - v_lo) * frac
-
-    latest_smooth = smooth[:, -1]
-    latest_raw = pump_score[:, -1]
-    trigger_score = jnp.maximum(latest_smooth, latest_raw)
-    score_ok = (
-        jnp.isfinite(latest_smooth)
-        & (cnt > 0)
-        & (trigger_score >= threshold)
-    )
-
-    # OI confirmation (l.184-185)
-    oi_ok = ~jnp.isfinite(oi_growth) | (oi_growth >= p.min_oi_growth)
-
-    # --- breadth-fade routing (l.76-108)
+    adp_latest: jnp.ndarray,
+    adp_prev: jnp.ndarray,
+    btc_momentum: jnp.ndarray,
+    p: LSPParams,
+):
+    """Breadth-fade routing (l.76-108) — one copy shared by both paths.
+    Returns (routed, short_ok, route, has_context)."""
     feats = context.features
     has_context = context.valid
     stress_ok = context.market_stress_score < 0.35
@@ -170,6 +148,33 @@ def liquidation_sweep_pump(
     ).astype(jnp.int32)
 
     routed = has_context & stress_ok & (short_ok | long_ok)
+    return routed, short_ok, route, has_context
+
+
+def _oi_factor(oi_growth: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(
+        jnp.isfinite(oi_growth), 1.0 + jnp.maximum(0.0, oi_growth - 1.0), 1.0
+    )
+
+
+def _lsp_outputs(
+    buf15: MarketBuffer,
+    score_ok: jnp.ndarray,
+    trigger_score: jnp.ndarray,
+    threshold: jnp.ndarray,
+    routed: jnp.ndarray,
+    short_ok: jnp.ndarray,
+    route: jnp.ndarray,
+    oi_growth: jnp.ndarray,
+    adp_latest: jnp.ndarray,
+    btc_momentum: jnp.ndarray,
+    volume_last: jnp.ndarray,
+    p: LSPParams,
+) -> StrategyOutputs:
+    """Shared output assembly (keys/order/dtypes identical across paths —
+    the wire's emission layout is recorded once per wire_enabled combo)."""
+    # OI confirmation (l.184-185)
+    oi_ok = ~jnp.isfinite(oi_growth) | (oi_growth >= p.min_oi_growth)
     fired = score_ok & oi_ok & routed & (buf15.filled > 0)
     direction = jnp.where(short_ok, Direction.SHORT, Direction.LONG).astype(jnp.int32)
 
@@ -187,6 +192,240 @@ def liquidation_sweep_pump(
             "adp": jnp.broadcast_to(adp_latest, (S,)),
             "btc_momentum": jnp.broadcast_to(btc_momentum, (S,)),
             "route": route,
-            "volume": volume[:, -1],
+            "volume": volume_last,
         },
+    )
+
+
+def liquidation_sweep_pump(
+    buf15: MarketBuffer,
+    context: MarketContext,
+    oi_growth: jnp.ndarray,  # (S,) f32, NaN = unavailable (KuCoin OI cache)
+    adp_latest: jnp.ndarray,  # scalar f32 — resolved ADP (breadth or context)
+    adp_prev: jnp.ndarray,  # scalar f32, NaN = no history
+    btc_momentum: jnp.ndarray,  # scalar f32 — BTC close pct_change last bar
+    params: LSPParams = LSPParams(),
+) -> StrategyOutputs:
+    p = params
+    wh = p.window_hours
+    volume = buf15.values[:, -TAIL:, Field.VOLUME]
+    close = buf15.values[:, -TAIL:, Field.CLOSE]
+    high = buf15.values[:, -TAIL:, Field.HIGH]
+    low = buf15.values[:, -TAIL:, Field.LOW]
+
+    # --- pump score pipeline (l.120-145)
+    rel_volume = volume / shift(rolling_mean(volume, wh * 2), wh)
+    momentum = close / shift(close, wh) - 1.0
+    range_frac = (rolling_max(high, wh * 2) - rolling_min(low, wh * 2)) / close
+
+    oi_factor = _oi_factor(oi_growth)[:, None]
+    pump_score = rel_volume * (1.0 + momentum) * oi_factor / range_frac
+    smooth = rolling_mean(pump_score, 2)
+
+    # --- trigger: top-quintile of last 48 smoothed scores (l.165-181)
+    recent = smooth[:, -p.score_window:]
+    finite = jnp.isfinite(recent)
+    cnt = jnp.sum(finite, axis=-1)
+    s = jnp.sort(jnp.where(finite, recent, jnp.inf), axis=-1)
+    rank = p.score_quantile * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, p.score_window - 1)
+    hi = jnp.clip(lo + 1, 0, p.score_window - 1)
+    frac = rank - lo
+    v_lo = jnp.take_along_axis(s, lo[:, None], axis=-1)[:, 0]
+    v_hi = jnp.take_along_axis(
+        s, jnp.minimum(hi, jnp.maximum(cnt - 1, 0))[:, None], axis=-1
+    )[:, 0]
+    threshold = v_lo + (v_hi - v_lo) * frac
+
+    latest_smooth = smooth[:, -1]
+    latest_raw = pump_score[:, -1]
+    trigger_score = jnp.maximum(latest_smooth, latest_raw)
+    score_ok = (
+        jnp.isfinite(latest_smooth)
+        & (cnt > 0)
+        & (trigger_score >= threshold)
+    )
+
+    routed, short_ok, route, _ = _routing(
+        context, adp_latest, adp_prev, btc_momentum, p
+    )
+    return _lsp_outputs(
+        buf15, score_ok, trigger_score, threshold, routed, short_ok, route,
+        oi_growth, adp_latest, btc_momentum, volume[:, -1], p,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Incremental carry: the same kernel from column reads + one sorted merge
+# ---------------------------------------------------------------------------
+
+
+class LSPCarry(NamedTuple):
+    """Carried LiquidationSweepPump state, (S,)/(S, k) leaves.
+
+    All carried scores are UNSCALED (no OI factor): the factor is a
+    per-row positive scalar multiplying the whole series uniformly, so
+    linear-interpolated quantiles commute with it and the readout applies
+    it once. ``smooth_ring`` is the eviction source for the sorted window
+    (its newest entry is also this bar's smooth readout); ``prev_raw`` is
+    the previous bar's unscaled raw score, feeding the 2-bar smoother.
+    """
+
+    score_q: SortedCarry  # (S, score_window) — unscaled smooth scores
+    smooth_ring: jnp.ndarray  # (S, score_window) f32, oldest first
+    prev_raw: jnp.ndarray  # (S,) f32 — unscaled pump score, newest bar
+
+
+def empty_lsp_carry(num_symbols: int, p: LSPParams = LSPParams()) -> LSPCarry:
+    return LSPCarry(
+        score_q=SortedCarry(
+            sorted=jnp.full((num_symbols, p.score_window), jnp.inf, jnp.float32),
+            cnt=jnp.zeros((num_symbols,), jnp.int32),
+        ),
+        smooth_ring=jnp.full(
+            (num_symbols, p.score_window), jnp.nan, jnp.float32
+        ),
+        prev_raw=jnp.full((num_symbols,), jnp.nan, jnp.float32),
+    )
+
+
+def lsp_init_from_window(
+    buf15: MarketBuffer, p: LSPParams = LSPParams()
+) -> LSPCarry:
+    """Carry from the full tail: the kernel's series with the OI factor
+    pinned to 1.0 (multiplying by 1.0 is exact, so the stored history is
+    bit-identical to the full path's oi_factor==1 series)."""
+    wh = p.window_hours
+    # score_window columns pin the carry's leaf shapes (see the ABP twin)
+    assert buf15.window >= p.score_window, (
+        f"window {buf15.window} too short for the LSP carry init "
+        f"(need >= {p.score_window})"
+    )
+    volume = buf15.values[:, -TAIL:, Field.VOLUME]
+    close = buf15.values[:, -TAIL:, Field.CLOSE]
+    high = buf15.values[:, -TAIL:, Field.HIGH]
+    low = buf15.values[:, -TAIL:, Field.LOW]
+
+    rel_volume = volume / shift(rolling_mean(volume, wh * 2), wh)
+    momentum = close / shift(close, wh) - 1.0
+    range_frac = (rolling_max(high, wh * 2) - rolling_min(low, wh * 2)) / close
+    pump_u = rel_volume * (1.0 + momentum) / range_frac
+    smooth_u = rolling_mean(pump_u, 2)
+
+    return LSPCarry(
+        score_q=sorted_init(smooth_u, p.score_window),
+        smooth_ring=smooth_u[:, -p.score_window:].astype(jnp.float32),
+        prev_raw=pump_u[:, -1].astype(jnp.float32),
+    )
+
+
+def _lsp_new_bar(
+    buf15: MarketBuffer, prev_raw: jnp.ndarray, p: LSPParams
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(pump_u, smooth_u) at the newest bar from ~20 column reads — the
+    full kernel's formulas with the NaN-aware min_periods semantics of the
+    rolling primitives reproduced on stacked columns."""
+    wh = p.window_hours
+    col = lambda pos, f: buf15.values[:, pos, int(f)]
+
+    # shift(rolling_mean(volume, 2*wh), wh) at the last position: the mean
+    # over the 2*wh bars ending wh+1 back (all finite required, mp=window)
+    vols = jnp.stack(
+        [col(-(wh + 1) - k, Field.VOLUME) for k in range(2 * wh)], axis=-1
+    )
+    vm = jnp.isfinite(vols)
+    v_ok = jnp.sum(vm, axis=-1) >= 2 * wh
+    v_mean = jnp.where(
+        v_ok, jnp.sum(jnp.where(vm, vols, 0.0), axis=-1) / (2.0 * wh), jnp.nan
+    )
+    rel_u = col(-1, Field.VOLUME) / v_mean
+
+    momentum = col(-1, Field.CLOSE) / col(-(wh + 1), Field.CLOSE) - 1.0
+
+    highs = jnp.stack([col(-1 - k, Field.HIGH) for k in range(2 * wh)], axis=-1)
+    lows = jnp.stack([col(-1 - k, Field.LOW) for k in range(2 * wh)], axis=-1)
+    hm, lm = jnp.isfinite(highs), jnp.isfinite(lows)
+    h_max = jnp.where(
+        jnp.sum(hm, axis=-1) >= 2 * wh,
+        jnp.max(jnp.where(hm, highs, -jnp.inf), axis=-1),
+        jnp.nan,
+    )
+    l_min = jnp.where(
+        jnp.sum(lm, axis=-1) >= 2 * wh,
+        jnp.min(jnp.where(lm, lows, jnp.inf), axis=-1),
+        jnp.nan,
+    )
+    range_frac = (h_max - l_min) / col(-1, Field.CLOSE)
+
+    pump_u = rel_u * (1.0 + momentum) / range_frac
+    both = jnp.isfinite(pump_u) & jnp.isfinite(prev_raw)
+    smooth_u = jnp.where(both, (pump_u + prev_raw) / 2.0, jnp.nan)
+    return pump_u.astype(jnp.float32), smooth_u.astype(jnp.float32)
+
+
+def lsp_advance_one_bar(
+    buf15: MarketBuffer,
+    carry: LSPCarry,
+    advanced: jnp.ndarray,
+    p: LSPParams = LSPParams(),
+) -> LSPCarry:
+    """Advance per-symbol carries by the buffer's newest bar."""
+    # == LSP_MIN_WINDOW at default params
+    assert buf15.window >= 3 * p.window_hours + 1, (
+        f"window {buf15.window} too short for the LSP carry advance "
+        f"(deepest read -(3*window_hours) with the shifted mean's +1)"
+    )
+    pump_u, smooth_u = _lsp_new_bar(buf15, carry.prev_raw, p)
+    new = LSPCarry(
+        score_q=sorted_advance(carry.score_q, smooth_u, carry.smooth_ring[:, 0]),
+        smooth_ring=jnp.concatenate(
+            [carry.smooth_ring[:, 1:], smooth_u[:, None]], axis=1
+        ),
+        prev_raw=pump_u,
+    )
+
+    def sel(n, o):
+        mask = advanced if n.ndim == 1 else advanced[:, None]
+        return jnp.where(mask, n, o)
+
+    return jax.tree_util.tree_map(sel, new, carry)
+
+
+def liquidation_sweep_pump_from_carry(
+    buf15: MarketBuffer,
+    carry: LSPCarry,
+    context: MarketContext,
+    oi_growth: jnp.ndarray,
+    adp_latest: jnp.ndarray,
+    adp_prev: jnp.ndarray,
+    btc_momentum: jnp.ndarray,
+    stale: jnp.ndarray,
+    params: LSPParams = LSPParams(),
+) -> StrategyOutputs:
+    """The fast-path twin of :func:`liquidation_sweep_pump`: latest raw/
+    smooth scores read back from the carry the advance just pushed, the
+    threshold from one sorted-window quantile, the OI factor applied at
+    readout. STALE rows cannot fire (the host is already routing to a
+    full recompute)."""
+    p = params
+    oi = _oi_factor(oi_growth)
+    latest_raw = carry.prev_raw * oi
+    latest_smooth = carry.smooth_ring[:, -1] * oi
+    threshold = sorted_quantile(carry.score_q, p.score_quantile, min_periods=1) * oi
+
+    trigger_score = jnp.maximum(latest_smooth, latest_raw)
+    score_ok = (
+        jnp.isfinite(latest_smooth)
+        & (carry.score_q.cnt > 0)
+        & (trigger_score >= threshold)
+        & ~stale
+    )
+
+    routed, short_ok, route, _ = _routing(
+        context, adp_latest, adp_prev, btc_momentum, p
+    )
+    return _lsp_outputs(
+        buf15, score_ok, trigger_score, threshold, routed, short_ok, route,
+        oi_growth, adp_latest, btc_momentum,
+        buf15.values[:, -1, Field.VOLUME], p,
     )

@@ -27,12 +27,33 @@ os.environ.setdefault("ENV", "CI")
 # tests/test_ab_parity.py (oracle A/B with the fast path pinned on), and
 # tests/test_obs.py (fallback-counter smoke).
 os.environ.setdefault("BQT_INCREMENTAL", "0")
+# Donated live buffers (BQT_DONATE) likewise default OFF for the tier-1
+# lane: the donated wire step is a SEPARATE jit cache entry (an engine that
+# crosses a depth/config boundary would compile both variants), several
+# tests pin dispatch-telemetry labels to the plain step, and fixtures that
+# hold pre-tick state references would be invalidated by donation.
+# Production default stays ON (binquant_tpu/config.py); donated coverage
+# opts in explicitly (tests/test_incremental.py::TestDonated).
+os.environ.setdefault("BQT_DONATE", "0")
 # Tick tracing defaults OFF for the tier-1 lane (same rationale as
 # BQT_INCREMENTAL: dozens of stub engines must not each pay the span-tree
 # bookkeeping). Production default stays ON (binquant_tpu/config.py);
 # tracing coverage opts in explicitly by installing a Tracer(sample=1.0)
 # on the engine under test (tests/test_tracing.py, tests/test_obs.py).
 os.environ.setdefault("BQT_TRACE_SAMPLE", "0")
+# Persistent XLA compilation cache: jit compiles dominate the tier-1
+# lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
+# this box), and the cache key covers the optimized HLO + compile options,
+# so edits that change a traced graph miss cleanly while repeat runs of
+# unchanged executables deserialize in ~100 ms. Opt out (or redirect) with
+# JAX_COMPILATION_CACHE_DIR=, which jax reads before this default.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "bqt-xla-cache"
+    ),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

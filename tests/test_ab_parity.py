@@ -49,12 +49,16 @@ def test_ab_signal_sets_identical(replay_path):
     # ISSUE 2 acceptance: the tier-1 oracle A/B runs with the incremental
     # indicator fast path pinned ON (conftest defaults it off for compile
     # budget) — and asserts it actually ENGAGED, so this parity can never
-    # silently degrade to full-path-only coverage.
+    # silently degrade to full-path-only coverage. Since ISSUE 4 the
+    # donated dispatch is pinned ON too (the production default pair), so
+    # this compile is shared with the breadth run below.
     result = run_replay_ab(
-        replay_path, capacity=CAPACITY, window=WINDOW, incremental=True
+        replay_path, capacity=CAPACITY, window=WINDOW, incremental=True,
+        donate=True,
     )
     _assert_match(result)
     assert result["tpu_stats"]["incremental_ticks"] > 0
+    assert result["tpu_stats"]["donated_ticks"] > 0
     # these three engage even without a scripted breadth series — assert
     # it, or their parity could silently become vacuous (VERDICT r2 item 5)
     for name in (
@@ -75,11 +79,22 @@ def test_ab_with_breadth_all_five_live_strategies_engage(replay_path):
     """With a scripted breadth series the breadth-gated paths (LSP
     routing, grid-only policy lag) run in BOTH backends and must agree —
     and ALL FIVE live strategies must actually ENGAGE in the matching run,
-    or the parity is vacuous for the missing ones (VERDICT r2 item 5)."""
+    or the parity is vacuous for the missing ones (VERDICT r2 item 5).
+
+    ISSUE 4 acceptance: this run pins the INCREMENTAL path ON (so the
+    carried ABP order-statistic and LSP quantile strategy stages — not
+    just the indicator packs — are what the oracle certifies, for all five
+    strategies including both carried ones) AND the donated dispatch ON
+    (the production default): the replayed burst's signal set must be
+    identical through donated ticks too."""
     result = run_replay_ab(
-        replay_path, capacity=CAPACITY, window=WINDOW, breadth=WASHED_BREADTH
+        replay_path, capacity=CAPACITY, window=WINDOW, breadth=WASHED_BREADTH,
+        incremental=True, donate=True,
     )
     _assert_match(result)
+    assert result["tpu_stats"]["incremental_ticks"] > 0
+    assert result["tpu_stats"]["donated_ticks"] > 0
+    assert result["tpu_stats"]["donated_state_resets"] == 0
     for name in (
         "activity_burst_pump",
         "coinrule_price_tracker",

@@ -1,0 +1,86 @@
+"""Compile-time cost-analysis regression gate for the incremental wire step.
+
+ISSUE 4 acceptance: the incremental tick must stay >=3x fewer bytes than
+the classic (pre-ISSUE-2, no-carry) wire step. The full-scale numbers live
+in the bench record (BENCH_STRAT_CPU.json, ``python bench.py --device``);
+this test compiles BOTH executables at a small symbol count on the CPU
+backend and asserts the ratio plus an absolute per-compile budget — so an
+accidental de-incrementalization (a strategy reverting to full-tail
+windowed sorts, a carry readout re-materializing (S, W) series) fails at
+PR time with no silicon involved.
+
+Scope notes: the XLA CPU cost model's bytes differ from TPU lowering
+(sort accounting especially), so the thresholds carry generous headroom —
+this is a tripwire, not a benchmark. Measured at pin time (S=64, W=400,
+jax 0.4.37 CPU): incremental 10.8 MB / 2.3 MF, classic 50.1 MB / 68.9 MF
+per tick (4.6x bytes, 30x flops).
+"""
+
+import numpy as np
+import pytest
+
+S, W = 64, 400
+
+# Pinned at measurement time: incremental 10.8 MB, classic 50.1 MB at this
+# shape. The budget is classic/3 (the ISSUE 4 acceptance ratio expressed
+# as an absolute, so tier-1 pays ONE compile): staying under it means the
+# incremental step remains >=3x fewer bytes than the recorded classic.
+BYTES_BUDGET_INCREMENTAL = 16.7e6
+FLOPS_BUDGET_INCREMENTAL = 23e6  # classic/3 (68.9 MF); measured 2.3 MF
+
+
+def _cost(**kwargs):
+    from binquant_tpu.engine.step import (
+        LIVE_STRATEGIES,
+        default_host_inputs,
+        initial_engine_state,
+        pad_updates,
+        tick_step_wire,
+    )
+    from binquant_tpu.regime.context import ContextConfig
+
+    state = initial_engine_state(S, window=W)
+    upd = pad_updates(
+        np.zeros(0, np.int32), np.zeros(0, np.int32),
+        np.zeros((0, 10), np.float32), size=S,
+    )
+    inputs = default_host_inputs(S)
+    key = tuple(sorted(LIVE_STRATEGIES))
+    compiled = tick_step_wire.lower(
+        state, upd, upd, inputs, ContextConfig(), wire_enabled=key, **kwargs
+    ).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("bytes accessed", float("nan"))), float(
+        ca.get("flops", float("nan"))
+    )
+
+
+def test_incremental_wire_bytes_within_budget():
+    bytes_incr, flops_incr = _cost(incremental=True)
+    assert np.isfinite(bytes_incr), "cost_analysis unavailable on this backend"
+    assert bytes_incr < BYTES_BUDGET_INCREMENTAL, (
+        f"incremental wire step reads {bytes_incr / 1e6:.1f} MB at "
+        f"{S}x{W} — over the {BYTES_BUDGET_INCREMENTAL / 1e6:.1f} MB "
+        "budget (classic/3); something de-incrementalized (a full-tail "
+        "recompute reached the fast path)"
+    )
+    assert flops_incr < FLOPS_BUDGET_INCREMENTAL
+
+
+@pytest.mark.slow
+def test_incremental_vs_classic_bytes_ratio():
+    """Slow lane + `make strat-smoke`: the ratio measured directly (a
+    second full compile the tier-1 budget cannot absorb — the tier-1 gate
+    above encodes the same floor against the recorded classic)."""
+    bytes_incr, flops_incr = _cost(incremental=True)
+    bytes_classic, flops_classic = _cost(maintain_carry=False)
+    assert np.isfinite(bytes_classic)
+    ratio = bytes_classic / bytes_incr
+    assert ratio >= 3.0, (
+        f"incremental wire step is only {ratio:.2f}x fewer bytes than the "
+        f"classic step at {S}x{W} — the strategy-stage carries are not "
+        "carrying their weight"
+    )
+    assert flops_incr < flops_classic

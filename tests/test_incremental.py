@@ -280,6 +280,27 @@ def replay_file(tmp_path_factory):
     return path
 
 
+def test_incremental_engine_rejects_too_short_window():
+    """A ring shorter than MIN_INCR_ENGINE_WINDOW must fail at
+    CONSTRUCTION, not wedge the consume loop on the first full-recompute
+    tick (the ABP carry init's score ring needs score_lookback+1 bars —
+    deeper than any one-bar advance, so the advance guard alone would
+    accept windows the cold-start tick cannot survive)."""
+    import pytest as _pytest
+
+    from binquant_tpu.engine.step import MIN_INCR_ENGINE_WINDOW
+    from binquant_tpu.io.replay import make_stub_engine
+
+    with _pytest.raises(ValueError, match="incremental engine"):
+        make_stub_engine(
+            capacity=8, window=MIN_INCR_ENGINE_WINDOW - 1, incremental=True
+        )
+    # the classic path has no carry to seed — same window is fine there
+    make_stub_engine(
+        capacity=8, window=MIN_INCR_ENGINE_WINDOW - 1, incremental=False
+    )
+
+
 def test_pipeline_gating_reasons(replay_file):
     """Cold start → full; steady clean appends → incremental; an audit
     cadence tick → full; a re-sent corrected candle → full (rewrite)."""
@@ -378,6 +399,274 @@ def test_backfill_fold_forces_full_recompute(replay_file):
 
 
 # ---------------------------------------------------------------------------
+# Strategy-stage carries (ISSUE 4): ABP/LSP twins vs the full-tail kernels
+# ---------------------------------------------------------------------------
+
+
+def _context_for(buf15, ts, tracked):
+    """A real MarketContext over the streamed buffer (valid at small-
+    universe thresholds) — both strategy paths consume the SAME object, so
+    twin parity isolates the kernel math."""
+    from binquant_tpu.engine.buffer import fresh_mask
+    from binquant_tpu.regime.context import (
+        compute_market_context,
+        initial_regime_carry,
+    )
+
+    ctx, _ = compute_market_context(
+        buf15,
+        fresh_mask(buf15, jnp.asarray(np.int32(ts))),
+        jnp.asarray(tracked),
+        jnp.asarray(np.int32(0)),
+        jnp.asarray(np.int32(ts)),
+        initial_regime_carry(buf15.capacity),
+        CFG,
+    )
+    return ctx
+
+
+def _stream_buffer(rng, n_rows, bars, burst_at=(), t0=1_753_000_200):
+    """Stream a buffer bar-by-bar, yielding (buf, ts) after each append.
+    ``burst_at`` bars get an ABP-shaped pump: 8x volume, +2% bullish close
+    near the high, following two mild up-closes."""
+    from binquant_tpu.engine.buffer import apply_updates, empty_buffer
+
+    buf = empty_buffer(S_CAP, WINDOW)
+    px = 50.0 + rng.random(n_rows) * 10
+    for b in range(bars):
+        ts = t0 + b * 900
+        closes = px * (1 + np.abs(rng.normal(0.0005, 0.002, n_rows)))
+        vol = np.abs(rng.normal(1000, 30, n_rows))
+        if b in burst_at:
+            closes = px * 1.02
+            vol = vol * 8.0
+        vals = np.zeros((n_rows, NUM_FIELDS), np.float32)
+        vals[:, Field.OPEN] = px
+        vals[:, Field.CLOSE] = closes
+        vals[:, Field.HIGH] = np.maximum(px, closes) * 1.001
+        vals[:, Field.LOW] = np.minimum(px, closes) * 0.998
+        vals[:, Field.VOLUME] = vol
+        vals[:, Field.QUOTE_VOLUME] = vol * closes
+        vals[:, Field.NUM_TRADES] = 150
+        vals[:, Field.DURATION_S] = 900
+        rows = np.arange(n_rows, dtype=np.int32)
+        buf = apply_updates(
+            buf, rows, np.full(n_rows, ts, np.int32), vals
+        )
+        px = closes
+        yield buf, ts
+
+
+def _assert_outputs_match(got, want, label, rtol=2e-4, atol=1e-4):
+    np.testing.assert_array_equal(
+        np.asarray(got.trigger), np.asarray(want.trigger), err_msg=label
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.autotrade), np.asarray(want.autotrade), err_msg=label
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.direction), np.asarray(want.direction), err_msg=label
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.score), np.asarray(want.score),
+        rtol=rtol, atol=atol, err_msg=label,
+    )
+    for key in want.diagnostics:
+        a = np.asarray(got.diagnostics[key], np.float64)
+        w = np.asarray(want.diagnostics[key], np.float64)
+        np.testing.assert_array_equal(
+            np.isfinite(a), np.isfinite(w), err_msg=f"{label}:{key} NaN mask"
+        )
+        m = np.isfinite(w)
+        if m.any():
+            np.testing.assert_allclose(
+                a[m], w[m], rtol=rtol, atol=atol, err_msg=f"{label}:{key}"
+            )
+
+
+@pytest.mark.slow
+def test_abp_carry_twin_parity_through_burst():
+    """ActivityBurstPump carry vs full-tail kernel, bar by bar through an
+    engineered pump: the burst bar FIRES on both paths (non-vacuous), the
+    cooldown suppresses the trailing bars identically, and every
+    diagnostic matches. The score series is position-local, so parity is
+    exact up to the shared f32 formulas. Slow lane + ``make strat-smoke``
+    (the tier-1 870s budget keeps only the compile-time cost gate,
+    tests/test_cost_budget.py — the bar-by-bar sweeps opt in)."""
+    from binquant_tpu.strategies.activity_burst_pump import (
+        abp_advance_one_bar,
+        abp_init_from_window,
+        activity_burst_pump,
+        activity_burst_pump_from_carry,
+    )
+    from binquant_tpu.strategies.features import carry_advance_masks
+
+    rng = np.random.default_rng(13)
+    n = 6
+    tracked = np.zeros(S_CAP, bool)
+    tracked[:n] = True
+    stream = _stream_buffer(rng, n, 106, burst_at=(93, 101))
+    carry = None
+    context = None
+    fired_bars = 0
+    last_ts = None
+    for b, (buf, ts) in enumerate(stream):
+        if b == 88:
+            carry = abp_init_from_window(buf)
+            last_ts = buf.times[:, -1].astype(jnp.int32)
+            # an INVALID context (nothing tracked): ABP then emits with
+            # autotrade off regardless of the long gate, so the burst
+            # firing cannot be suppressed by regime state — non-vacuous by
+            # construction. Constant across bars is fine: both paths
+            # consume the same object.
+            context = _context_for(buf, ts, np.zeros(S_CAP, bool))
+        elif b > 88:
+            advanced, stale = carry_advance_masks(buf, last_ts)
+            assert not np.asarray(stale).any()
+            carry = abp_advance_one_bar(buf, carry, advanced)
+            last_ts = buf.times[:, -1].astype(jnp.int32)
+            want = activity_burst_pump(buf, context)
+            got = activity_burst_pump_from_carry(
+                buf, carry, context, jnp.asarray(stale)
+            )
+            _assert_outputs_match(got, want, f"bar {b}")
+            fired_bars += int(np.asarray(want.trigger).any())
+    assert fired_bars >= 1, "the engineered burst never fired — vacuous"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "oi",
+    [
+        float("nan"),
+        # oi=1.05 exercises the scaled-quantile readout — the OI factor
+        # commutes through the sorted window
+        1.05,
+    ],
+)
+def test_lsp_carry_twin_parity(oi):
+    """LiquidationSweepPump carry vs full-tail kernel bar by bar — washed
+    breadth + positive BTC momentum so the LONG route engages and the
+    trigger comparison is live."""
+    from binquant_tpu.strategies.features import carry_advance_masks
+    from binquant_tpu.strategies.liquidation_sweep_pump import (
+        liquidation_sweep_pump,
+        liquidation_sweep_pump_from_carry,
+        lsp_advance_one_bar,
+        lsp_init_from_window,
+    )
+
+    rng = np.random.default_rng(29)
+    n = 6
+    tracked = np.zeros(S_CAP, bool)
+    tracked[:n] = True
+    oi_growth = jnp.full((S_CAP,), oi, jnp.float32)
+    adp_latest = jnp.asarray(np.float32(-0.5))
+    adp_prev = jnp.asarray(np.float32(-0.6))
+    btc_mom = jnp.asarray(np.float32(0.01))
+    stream = _stream_buffer(rng, n, 100, burst_at=(90,))
+    carry = None
+    context = None
+    fired_bars = 0
+    last_ts = None
+    for b, (buf, ts) in enumerate(stream):
+        if b == 84:
+            carry = lsp_init_from_window(buf)
+            last_ts = buf.times[:, -1].astype(jnp.int32)
+            context = _context_for(buf, ts, tracked)
+        elif b > 84:
+            advanced, stale = carry_advance_masks(buf, last_ts)
+            carry = lsp_advance_one_bar(buf, carry, advanced)
+            last_ts = buf.times[:, -1].astype(jnp.int32)
+            want = liquidation_sweep_pump(
+                buf, context, oi_growth, adp_latest, adp_prev, btc_mom
+            )
+            got = liquidation_sweep_pump_from_carry(
+                buf, carry, context, oi_growth, adp_latest, adp_prev,
+                btc_mom, jnp.asarray(stale),
+            )
+            _assert_outputs_match(got, want, f"bar {b} oi={oi}", rtol=2e-3, atol=2e-3)
+            fired_bars += int(np.asarray(want.trigger).any())
+    assert fired_bars >= 1, "the engineered pump never fired — vacuous"
+
+
+# ---------------------------------------------------------------------------
+# Donated live buffers (ISSUE 4, BQT_DONATE)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDonated:
+    """Slow lane + `make strat-smoke`: each test compiles a fresh donated
+    wire executable, which the 870s tier-1 budget cannot absorb — tier-1's
+    donated coverage is the oracle A/B with BQT_DONATE pinned ON
+    (test_ab_parity.py, signal-level parity + donated_ticks asserted)."""
+
+    def test_donated_wire_bit_identical(self):
+        """tick_step_wire_donated is the SAME program as tick_step_wire
+        modulo buffer aliasing: streamed ticks produce bit-identical wires
+        (the acceptance criterion for promoting donation to the live
+        path). The donated engine's state threads through the loop — its
+        inputs are consumed each tick, like the live pipeline."""
+        from binquant_tpu.engine.step import (
+            tick_step_wire,
+            tick_step_wire_donated,
+        )
+
+        def seeded():
+            return _seeded_state(np.random.default_rng(55), n_rows=6, bars=60)
+
+        state_p, tracked, ts, px_p = seeded()
+        state_d, _, _, _ = seeded()
+        rng = np.random.default_rng(91)
+        px = px_p
+        for i in range(4):
+            ts += 900
+            rows, tss, vals, px = _updates(rng, len(px), ts, px)
+            upd = pad_updates(rows, tss, vals, size=S_CAP)
+            inputs = _inputs(ts, tracked)
+            state_p, wire_p = tick_step_wire(
+                state_p, upd, upd, inputs, CFG, incremental=True
+            )
+            state_d, wire_d = tick_step_wire_donated(
+                state_d, upd, upd, inputs, CFG, incremental=True
+            )
+            a, b = np.asarray(wire_p), np.asarray(wire_d)
+            same = (a == b) | (np.isnan(a) & np.isnan(b))
+            assert same.all(), f"tick {i}: {np.argwhere(~same)[:5]}"
+
+    def test_donated_replay_matches_plain_and_snapshot_survives(self, replay_file):
+        """The donated pipeline (BQT_DONATE) emits the identical signal
+        stream as the copying pipeline on the same replay, actually takes
+        the donated dispatch every tick, and never trips a poisoned-state
+        reset — i.e. the small-carry snapshots and the post-state fallback
+        satisfy the no-donated-buffer-read audit in practice."""
+        from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
+
+        by_tick = load_klines_by_tick(replay_file)
+        buckets = sorted(by_tick)
+
+        def run(donate):
+            engine = make_stub_engine(
+                capacity=32, window=WINDOW, incremental=True, donate=donate
+            )
+            fired = _drive(engine, {b: by_tick[b] for b in buckets[:30]})
+            return engine, [
+                (s.tick_ms, s.strategy, s.symbol, str(s.value.direction))
+                for s in fired
+            ]
+
+        eng_d, sig_d = run(True)
+        eng_p, sig_p = run(False)
+        assert sig_d == sig_p
+        assert eng_d.donated_ticks == eng_d.ticks_processed > 0
+        assert eng_d.donated_state_resets == 0
+        assert eng_p.donated_ticks == 0
+        hs = eng_d.health_snapshot()
+        assert hs["donated_ticks"] == eng_d.donated_ticks
+
+
+# ---------------------------------------------------------------------------
 # Checkpoint: v2 round-trip + v1 migration
 # ---------------------------------------------------------------------------
 
@@ -427,7 +716,7 @@ def test_checkpoint_v1_migration(tmp_path):
     assert int(np.asarray(restored.indicator_carry.pack15.last_ts).max()) == -1
 
     # and a CURRENT-version round trip preserves the carry exactly
-    path2 = tmp_path / "v2.ckpt.npz"
+    path2 = tmp_path / "v3.ckpt.npz"
     save_state(path2, state, registry)
     restored2, carries2 = load_state(path2, template, SymbolRegistry(S_CAP))
     assert "_carry_rebuilt" not in carries2
@@ -435,3 +724,66 @@ def test_checkpoint_v1_migration(tmp_path):
         np.asarray(restored2.indicator_carry.pack15.last_ts),
         np.asarray(state.indicator_carry.pack15.last_ts),
     )
+    # the strategy-stage carries round-trip too (v3 leaves)
+    np.testing.assert_array_equal(
+        np.asarray(restored2.indicator_carry.abp5.score_ring),
+        np.asarray(state.indicator_carry.abp5.score_ring),
+    )
+
+
+@pytest.mark.slow
+def test_checkpoint_v2_migration(tmp_path):
+    """A v2 archive (feature-pack carries only, no strategy-stage/
+    supertrend/beta-corr leaves) restores: the prefix INCLUDING pack5/
+    pack15 loads, the new sub-carries keep the template's empty state, and
+    ``_carry_rebuilt`` forces the first tick's full recompute to rebuild
+    them (the same migration contract v1 archives use). Slow lane +
+    ``make strat-smoke`` (tier-1 budget)."""
+    import json
+
+    import jax
+
+    from binquant_tpu.engine.buffer import SymbolRegistry
+    from binquant_tpu.io.checkpoint import load_state
+
+    rng = np.random.default_rng(23)
+    state, tracked, ts, px = _seeded_state(rng, n_rows=4, bars=45)
+    registry = SymbolRegistry(S_CAP)
+    for i in range(4):
+        registry.add(f"S{i}USDT")
+
+    # craft a v2 archive: every leaf up to and including the pack carries
+    ic = state.indicator_carry
+    n_new = len(jax.tree_util.tree_leaves(ic)) - len(
+        jax.tree_util.tree_leaves((ic.pack5, ic.pack15))
+    )
+    leaves = jax.tree_util.tree_leaves(state)
+    v2_leaves = leaves[: len(leaves) - n_new]
+    meta = {
+        "version": 2,
+        "n_leaves": len(v2_leaves),
+        "registry": registry.to_mapping(),
+        "host_carries": {"ticks_processed": 45},
+    }
+    path = tmp_path / "v2.ckpt.npz"
+    np.savez(
+        path,
+        __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(v2_leaves)},
+    )
+
+    template = initial_engine_state(S_CAP, window=WINDOW)
+    restored, carries = load_state(path, template, SymbolRegistry(S_CAP))
+    assert carries["_carry_rebuilt"] is True
+    # the v2-covered prefix restored (buffers + pack carries)...
+    np.testing.assert_array_equal(
+        np.asarray(restored.buf15.times), np.asarray(state.buf15.times)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.indicator_carry.pack15.last_ts),
+        np.asarray(state.indicator_carry.pack15.last_ts),
+    )
+    # ...while the v3 sub-carries stayed at the empty template (rebuilt by
+    # the first full tick)
+    assert int(np.asarray(restored.indicator_carry.abp5.score_q.cnt).max()) == 0
+    assert int(np.asarray(restored.indicator_carry.bc15.cnt).max()) == 0

@@ -516,6 +516,89 @@ class TestIncrementalOps:
                 np.asarray(dirn), full_dir[:, t], equal_nan=True
             )
 
+    @pytest.mark.slow
+    @pytest.mark.parametrize("q,mp", [(0.5, 19), (0.92, 20)])
+    def test_sorted_window_tracks_pandas_quantiles(self, rng, q, mp):
+        """SortedCarry advance == pandas rolling().median()/.quantile(q,
+        'linear') over a stream with NaN warm-up + mid-stream gaps and
+        min_periods edges (the ABP baseline and threshold configurations;
+        LSP's cnt>0 edge rides the strategy twin test). Slow lane +
+        ``make strat-smoke``, with the other sorted-window props (tier-1
+        budget — the 870s lane keeps tests/test_cost_budget.py as the
+        ISSUE-4 gate; the per-bar pandas sweeps opt in)."""
+        import pandas as pd
+
+        from binquant_tpu.ops import incremental as inc
+
+        window = 19 if q == 0.5 else 48
+        x = self._stream(rng, self.W + 64, nan_gaps=(280, 281, 300))
+        ref = (
+            pd.Series(np.asarray(x, np.float64))
+            .rolling(window, min_periods=mp)
+            .quantile(q, interpolation="linear")
+            .to_numpy()
+        )
+        carry = inc.sorted_init(jnp.asarray(self._window(x, self.W - 1)), window)
+        for t in range(self.W, len(x)):
+            leaver = self._window(x, t)[-(window + 1)]
+            carry = inc.sorted_advance(carry, jnp.asarray(x[t]), jnp.asarray(leaver))
+            got = np.asarray(inc.sorted_quantile(carry, q, min_periods=mp))
+            np.testing.assert_allclose(
+                got, ref[t], rtol=1e-5, atol=1e-4, equal_nan=True,
+                err_msg=f"t={t}",
+            )
+
+    @pytest.mark.slow
+    def test_sorted_window_eviction_order_with_duplicates(self, rng):
+        """Duplicate values: each advance must evict exactly ONE instance
+        of the leaving value — the carried multiset stays equal to a fresh
+        sort of the trailing window (bit-for-bit, so readouts match the
+        full path's windowed sort exactly)."""
+        from binquant_tpu.ops import incremental as inc
+
+        window = 8
+        # heavy duplication: values drawn from 4 distinct levels
+        x = rng.choice([1.0, 2.0, 2.0, 3.0, 7.0], size=120).astype(np.float32)
+        x[[30, 31, 60]] = np.nan
+        carry = inc.sorted_init(jnp.asarray(x[:40]), window)
+        for t in range(40, len(x)):
+            carry = inc.sorted_advance(
+                carry, jnp.asarray(x[t]), jnp.asarray(x[t - window])
+            )
+            ref = inc.sorted_init(jnp.asarray(x[: t + 1]), window)
+            np.testing.assert_array_equal(
+                np.asarray(carry.sorted), np.asarray(ref.sorted), err_msg=f"t={t}"
+            )
+            assert int(carry.cnt) == int(ref.cnt)
+
+    @pytest.mark.slow
+    def test_sorted_window_reinit_resync(self, rng):
+        """A mid-window rewrite desyncs the carried multiset; re-init from
+        the rewritten series (the engine's full-recompute resync) restores
+        bit parity on the same tick and on subsequent advances."""
+        from binquant_tpu.ops import incremental as inc
+
+        window = 19
+        x = self._stream(rng, self.W + 40)
+        carry = inc.sorted_init(jnp.asarray(x[: self.W]), window)
+        for t in range(self.W, self.W + 10):
+            carry = inc.sorted_advance(
+                carry, jnp.asarray(x[t]), jnp.asarray(x[t - window])
+            )
+        t = self.W + 9
+        x[t - 5] *= 1.5  # corrected mid-window candle
+        ref = inc.sorted_init(jnp.asarray(x[: t + 1]), window)
+        assert not np.array_equal(np.asarray(carry.sorted), np.asarray(ref.sorted))
+        carry = ref  # resync
+        for t in range(self.W + 10, len(x)):
+            carry = inc.sorted_advance(
+                carry, jnp.asarray(x[t]), jnp.asarray(x[t - window])
+            )
+            ref = inc.sorted_init(jnp.asarray(x[: t + 1]), window)
+            np.testing.assert_array_equal(
+                np.asarray(carry.sorted), np.asarray(ref.sorted)
+            )
+
     def test_beta_corr_advance(self, rng):
         from binquant_tpu.ops import incremental as inc
 

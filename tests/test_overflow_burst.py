@@ -46,3 +46,29 @@ def test_overflow_burst_emits_identical_set(tmp_path):
     assert mrf, "the crash tick must fire MeanReversionFade"
     # ONE tick fired more pairs than the wire holds (not just the session)
     assert result["per_tick_max"] > WIRE_MAX_FIRED
+
+
+@pytest.mark.slow
+def test_overflow_burst_through_donated_incremental_path(tmp_path):
+    """ISSUE 4's hardest corner: the SAME >128-fire burst through the
+    production default pair — incremental strategy carries + DONATED
+    dispatch. The overflow fallback here cannot touch the pre-tick buffers
+    (donated); it re-evaluates from the post-tick state + the small-carry
+    snapshots. The emitted set must still match the uncapped oracle
+    signal-for-signal."""
+    path = tmp_path / "burst_donated.jsonl"
+    generate_burst_replay(path, n_symbols=N_SYMBOLS, n_ticks=108)
+
+    result = run_replay_ab(
+        path, capacity=256, window=200, incremental=True, donate=True
+    )
+    stats = result["tpu_stats"]
+    assert stats["overflow_ticks"] >= 1, "burst never overflowed the wire"
+    assert stats["donated_ticks"] > 0
+    assert stats["donated_state_resets"] == 0
+    assert stats["incremental_ticks"] > 0
+    assert result["match"], {
+        "only_tpu": result["only_tpu"][:5],
+        "only_oracle": result["only_oracle"][:5],
+    }
+    assert result["per_tick_max"] > WIRE_MAX_FIRED

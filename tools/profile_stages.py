@@ -238,7 +238,9 @@ def main() -> None:
     from binquant_tpu.engine.step import init_indicator_carry
 
     state_sync = state._replace(
-        indicator_carry=jax.jit(init_indicator_carry)(state.buf5, state.buf15)
+        indicator_carry=jax.jit(
+            lambda b5, b15: init_indicator_carry(b5, b15, 0)
+        )(state.buf5, state.buf15)
     )
     _sync(state_sync.indicator_carry)
 

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -23,6 +23,11 @@ help:
 	@echo "  trace-smoke- replay with tracing on and BQT_TRACE_SLOW_MS=0"
 	@echo "               (every tick flight-recorded), then render the 3"
 	@echo "               slowest ticks with tools/trace_report.py"
+	@echo "  replay-smoke- scanned replay lane (ISSUE 5): scanned-vs-serial"
+	@echo "               signal equality on the A/B fixture + the overflow"
+	@echo "               re-run + supertrend carry-divergence pin + the"
+	@echo "               slow-marked alternate-seed A/B, then a small-shape"
+	@echo "               serial-vs-scanned throughput report"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run"
 	@echo "  lint       - ruff check"
 	@echo "offline kernel profiling: tools/profile_stages.py captures"
@@ -63,6 +68,19 @@ strat-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_incremental.py tests/test_ops_parity.py \
 		-q -k "twin or Donated or sorted_window or checkpoint_v2" \
 		-p no:cacheprovider
+
+# The scanned-replay lane: tier-1 keeps only the small rewrite-break
+# equality drill; this target runs the heavy fixtures (A/B fixture
+# equality, the >WIRE_MAX_FIRED overflow re-run, the supertrend
+# carry-divergence pin, the slow-marked alternate-seed A/B) plus a quick
+# throughput report. The 2048x400 acceptance bench is
+# `python bench.py --replay-throughput` (writes BENCH_REPLAY_CPU.json).
+replay-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_scan_replay.py \
+		tests/test_ab_parity.py::test_ab_alternate_seed -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu python bench.py --replay-throughput \
+		--symbols 256 --window 120 --ticks 64
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -482,6 +482,141 @@ def run_sweep(window: int = 400, sizes: tuple[int, ...] = (1024, 2048, 4096, 819
     }
 
 
+def run_replay_throughput(
+    num_symbols: int = 2048,
+    window: int = 400,
+    ticks: int = 256,
+    scan_chunk: int = 64,
+) -> dict:
+    """Replay/backtest throughput: serial per-tick drive vs fused scan
+    chunks (ISSUE 5 acceptance phase).
+
+    Both arms drive the PRODUCTION engine over the identical synthetic
+    stream (same seed → same updates): the serial arm is the per-tick
+    ``process_tick`` loop every multi-tick lane used to run (one Python
+    iteration + one device dispatch per tick, depth 0 — the replay/refdiff
+    shape); the scanned arm is ``process_ticks_scanned`` (one ``lax.scan``
+    dispatch per ``scan_chunk`` ticks). Warmup ticks run each arm's full
+    compile set (cold-start full tick, per-tick incremental step, the scan
+    executable) before the measured window, so the quoted ticks/sec is
+    steady-state — the regime a months-of-candles backtest amortizes into.
+    Candles/sec counts every ingested bar (two intervals per tick)."""
+    import os
+
+    # the scanned drive requires the incremental path; the serial arm runs
+    # the live default pair (incremental + donated dispatch)
+    os.environ.setdefault("BQT_INCREMENTAL", "1")
+    os.environ.setdefault("BQT_DONATE", "1")
+
+    def drive_arm(scanned: bool) -> dict:
+        engine, make_updates, now, px = _seed_engine(num_symbols, window, 0)
+        engine.scan_chunk = scan_chunk
+        px_box = [px]
+
+        def feed(i: int) -> int:
+            eval_s = now + i * 900
+            rows, ts15, vals15, px2 = make_updates(eval_s - 900, px_box[0], 900)
+            engine.batcher15.add_batch(rows, ts15, vals15)
+            rows5, ts5, vals5, _ = make_updates(eval_s - 300, px2, 300)
+            engine.batcher5.add_batch(rows5, ts5, vals5)
+            px_box[0] = px2
+            return eval_s * 1000
+
+        # warm every executable the measured window will hit: the cold
+        # full-recompute tick, the per-tick incremental step (serial arm +
+        # the scanned drive's short-run/overflow re-drives), and — for the
+        # scanned arm — one full scan chunk
+        warmup = (scan_chunk + 4) if scanned else 4
+        signals = 0
+
+        async def run_arm() -> float:
+            nonlocal signals
+            if scanned:
+
+                def tick_item(i):
+                    # feed at PLAN time: now_ms must be computed eagerly,
+                    # batcher loads lazily in drive order
+                    eval_ms = (now + i * 900) * 1000
+                    return (eval_ms, lambda i=i: feed(i))
+
+                signals += len(
+                    await engine.process_ticks_scanned(
+                        [tick_item(i) for i in range(warmup)]
+                    )
+                )
+                await engine.flush_pending()
+                t0 = time.perf_counter()
+                signals += len(
+                    await engine.process_ticks_scanned(
+                        [tick_item(warmup + i) for i in range(ticks)]
+                    )
+                )
+                await engine.flush_pending()
+                return time.perf_counter() - t0
+            for i in range(warmup):
+                now_ms = feed(i)
+                signals += len(await engine.process_tick(now_ms=now_ms))
+            signals += len(await engine.flush_pending())
+            t0 = time.perf_counter()
+            for i in range(ticks):
+                now_ms = feed(warmup + i)
+                signals += len(await engine.process_tick(now_ms=now_ms))
+            signals += len(await engine.flush_pending())
+            return time.perf_counter() - t0
+
+        wall = asyncio.run(run_arm())
+        return {
+            "wall_s": round(wall, 3),
+            "ticks": ticks,
+            "ticks_per_sec": round(ticks / wall, 2),
+            # one 5m + one 15m bar per symbol per tick
+            "candles_per_sec": round(ticks * num_symbols * 2 / wall),
+            "per_tick_ms": round(wall / ticks * 1000.0, 3),
+            "signals": signals,
+            "scan_chunks": engine.scan_chunks,
+            "scanned_ticks": engine.scanned_ticks,
+            "scan_overflow_reruns": engine.scan_overflow_reruns,
+            "donated_ticks": engine.donated_ticks,
+        }
+
+    serial = drive_arm(scanned=False)
+    scanned = drive_arm(scanned=True)
+    speedup = (
+        round(scanned["ticks_per_sec"] / serial["ticks_per_sec"], 2)
+        if serial["ticks_per_sec"]
+        else None
+    )
+    return {
+        "symbols": num_symbols,
+        "window": window,
+        "ticks": ticks,
+        "scan_chunk": scan_chunk,
+        "serial": serial,
+        "scanned": scanned,
+        "scanned_vs_serial_x": speedup,
+        "measurement": (
+            "production SignalEngine over one synthetic stream per arm "
+            "(identical seeds): serial = per-tick process_tick at depth 0 "
+            "(the pre-ISSUE-5 replay drive); scanned = "
+            "process_ticks_scanned lax.scan chunks. Steady-state: all "
+            "compiles paid in warmup. CPU-model numbers — rerun on "
+            "silicon when the tunnel returns."
+        ),
+        "cpu_model_floor_note": (
+            "on the 2-core CPU model the scan body is floored by the ring "
+            "shift's memory traffic (~144 MB/tick ≈ 28.5 ms at 2048x400; "
+            "measured via an apply_updates-only scan) plus a ~5-8 ms/tick "
+            "XLA-CPU per-iteration op overhead at small shapes, so the "
+            "scanned-vs-serial ratio caps near serial_per_tick/body_floor "
+            "(~2.5x here) at ANY shape. The >=5x acceptance floor is a "
+            "dispatch-bound-link number: on silicon the same body is a few "
+            "ms against a ~150 ms tunneled RTT per serial dispatch — "
+            "rerun bench.py --replay-throughput on the TPU to record it."
+        ),
+        "measurement_epoch": MEASUREMENT_EPOCH,
+    }
+
+
 def _rtt_probe(iters: int = 15) -> tuple[float, float]:
     """Round-trip tax of the device link: tiny jit + blocking 4-byte fetch.
 
@@ -1134,6 +1269,19 @@ def main() -> int | None:
         action="store_true",
         help="device-side cost breakdown only (stages, FLOPs, duty cycle)",
     )
+    parser.add_argument(
+        "--replay-throughput",
+        action="store_true",
+        help="replay/backtest throughput: serial per-tick drive vs fused "
+        "scan chunks over an identical stream; writes BENCH_REPLAY_CPU.json"
+        " when run on the CPU model (silicon runs print only)",
+    )
+    parser.add_argument(
+        "--scan-chunk",
+        type=int,
+        default=64,
+        help="ticks fused per scan dispatch in --replay-throughput",
+    )
     parser.add_argument("--symbols", type=int, default=2048)
     parser.add_argument("--window", type=int, default=400)
     parser.add_argument("--ticks", type=int, default=240)
@@ -1159,6 +1307,7 @@ def main() -> int | None:
             metric = (
                 "device_step_ms_at_2048" if args.sweep
                 else "device_step_ms" if args.device
+                else "replay_scanned_vs_serial_x" if args.replay_throughput
                 else "indicator_batch_pass_ms" if args.config2
                 else "context_scoring_4tf_p99_ms" if args.config4
                 else "tick_p99_ms"
@@ -1186,6 +1335,56 @@ def main() -> int | None:
 
     if args.smoke:
         args.symbols, args.window, args.ticks, args.warmup = 32, 120, 5, 2
+
+    if args.replay_throughput:
+        import jax
+
+        # the documented zero-arg invocation measures (and records) the
+        # acceptance shape's >=256 ticks; an EXPLICIT --ticks still wins
+        # (smoke runs pass small counts and are print-only below)
+        ticks = (
+            256 if args.ticks == parser.get_default("ticks")
+            else max(args.ticks, 16)
+        )
+        r = run_replay_throughput(
+            args.symbols,
+            args.window,
+            ticks=ticks,
+            scan_chunk=args.scan_chunk,
+        )
+        if args.symbols >= 2048:
+            # companion point in the dispatch-bound regime (refdiff-scale
+            # shapes, where per-tick compute is small next to the Python+
+            # dispatch overhead the scan erases) — the 2048x400 headline
+            # sits on the CPU model's bandwidth floor instead (see
+            # cpu_model_floor_note), so the record carries both
+            r["dispatch_bound_point"] = run_replay_throughput(
+                256, 120, ticks=ticks, scan_chunk=args.scan_chunk
+            )
+        record = {
+            "metric": "replay_scanned_vs_serial_x",
+            "value": r["scanned_vs_serial_x"],
+            "unit": "x",
+            # ISSUE 5 acceptance floor: >= 5x the serial drive
+            "vs_baseline": (
+                round(r["scanned_vs_serial_x"] / 5.0, 3)
+                if r["scanned_vs_serial_x"]
+                else None
+            ),
+            "detail": r,
+        }
+        print(json.dumps(record))
+        # only the acceptance shape overwrites the checked-in record —
+        # smoke-shape runs (make replay-smoke) print only
+        if (
+            jax.default_backend() == "cpu"
+            and args.symbols >= 2048
+            and args.window >= 400
+            and ticks >= 256
+        ):
+            with open("BENCH_REPLAY_CPU.json", "w") as f:
+                json.dump(record, f, indent=1)
+        return
 
     if args.sweep:
         sweep = run_sweep(window=args.window)

@@ -188,6 +188,16 @@ class Config:
         return self._get("BQT_DONATE", "1") != "0"
 
     @cached_property
+    def scan_chunk(self) -> int:
+        """Max ticks fused into one lax.scan dispatch by the multi-tick
+        lanes (replay / A/B drives / refdiff / restore catch-up /
+        backtesting — engine/step.py tick_step_scan): T ticks cost one
+        dispatch instead of T. Larger chunks amortize dispatch further but
+        grow the stacked-input upload and the all-or-nothing overflow
+        re-run; the live per-tick path never scans."""
+        return int(self._get("BQT_SCAN_CHUNK", "64") or "64")
+
+    @cached_property
     def carry_audit_every_ticks(self) -> int:
         """Drift audit cadence for the incremental path: every N processed
         ticks the engine dispatches a FULL recompute, which re-anchors the

@@ -298,6 +298,10 @@ WIRE_SCALARS_B: tuple[str, ...] = (
     "stress_regime_score",
     "btc_regime_score",
     "btc_price_change_96",
+    # count of beta/corr rows whose carry is dirty this tick (incremental
+    # path only; 0 on the full path, which re-anchors every row) — the
+    # host surfaces it as the bqt_bc_dirty_rows resync-pressure gauge
+    "bc_dirty_rows",
 )
 _WIRE_TS_BASE = 65536
 
@@ -680,8 +684,20 @@ def _tick_step_impl(
         # the (S, W) returns matrix never materializes on the fast path
         beta, corr = beta_corr_value(indicator_carry.bc15, BC_WINDOW)
         bc_ok = ~indicator_carry.bc_dirty & ~stale15
-        btc_beta = jnp.where(jnp.isfinite(beta) & bc_ok, beta, 0.0)
-        btc_corr = jnp.where(jnp.isfinite(corr) & bc_ok, corr, 0.0)
+        # a DIRTY row's posture is UNKNOWN, not zero: decode it as NaN so
+        # analytics can serialize null — the full kernel's 0.0 fill is a
+        # legitimate measured value and the two must stay distinguishable
+        btc_beta = jnp.where(
+            indicator_carry.bc_dirty,
+            jnp.nan,
+            jnp.where(jnp.isfinite(beta) & bc_ok, beta, 0.0),
+        )
+        btc_corr = jnp.where(
+            indicator_carry.bc_dirty,
+            jnp.nan,
+            jnp.where(jnp.isfinite(corr) & bc_ok, corr, 0.0),
+        )
+        bc_dirty_rows = jnp.sum(indicator_carry.bc_dirty).astype(jnp.float32)
         pick = lambda pos: jnp.where(
             btc_ok,
             jnp.sum(jnp.where(onehot_rows, buf15.values[:, pos, Field.CLOSE], 0.0)),
@@ -703,6 +719,7 @@ def _tick_step_impl(
         bc = rolling_beta_corr(rets, btc_rets[None, :], window=BC_WINDOW)
         btc_beta = jnp.where(jnp.isfinite(bc.beta[:, -1]), bc.beta[:, -1], 0.0)
         btc_corr = jnp.where(jnp.isfinite(bc.corr[:, -1]), bc.corr[:, -1], 0.0)
+        bc_dirty_rows = jnp.asarray(0.0, dtype=jnp.float32)
         btc_close = jnp.where(btc_ok, btc_close_row, jnp.nan)
         if W > 96:
             btc_change_96 = _btc_change_96(btc_close[-1], btc_close[-97], btc_ok)
@@ -953,6 +970,7 @@ def _tick_step_impl(
         "stress_regime_score": context.stress_regime_score,
         "btc_regime_score": context.btc_regime_score,
         "btc_price_change_96": btc_change_96,
+        "bc_dirty_rows": bc_dirty_rows,
     }
     ts32 = context.timestamp.astype(jnp.int32)
     ss32 = context.regime_stable_since.astype(jnp.int32)
@@ -1152,6 +1170,210 @@ tick_step_wire_donated = jax.jit(
     static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
     donate_argnums=(0,),
 )
+
+
+def wire_length(num_symbols: int) -> int:
+    """Length of one tick's packed wire at capacity ``num_symbols`` —
+    scalars + fired-compaction blocks + per-slot emission payload + the
+    (3, S) calibration block. The scan step needs it statically to shape
+    its inactive-tick zero wire."""
+    na, nb = len(WIRE_SCALARS_A), len(WIRE_SCALARS_B)
+    return (
+        na + nb + 4 + 1
+        + 6 * WIRE_MAX_FIRED
+        + WIRE_MAX_FIRED * EMISSION_SLOT_WIDTH
+        + 3 * num_symbols
+    )
+
+
+# wire offset of the device-side fired count (reads back per tick from the
+# scanned stack without a full unpack)
+WIRE_FIRED_COUNT_OFF = len(WIRE_SCALARS_A) + len(WIRE_SCALARS_B) + 4
+
+
+def _empty_update_slot(num_fields: int):
+    """Static (4,)-padded empty update batch (all rows -1 → dropped by
+    apply_updates) — the scan body's filler for depth-padded fold slots."""
+    return (
+        jnp.full((4,), -1, dtype=jnp.int32),
+        jnp.full((4,), -1, dtype=jnp.int32),
+        jnp.zeros((4, num_fields), dtype=jnp.float32),
+    )
+
+
+def _fold_and_step_wire(
+    state: EngineState,
+    upd5_slots,
+    upd15_slots,
+    inputs: HostInputs,
+    cfg: ContextConfig,
+    wire_enabled: tuple[str, ...],
+    incremental: bool,
+    maintain_carry: bool,
+) -> tuple[EngineState, jnp.ndarray]:
+    """One replayed tick inside the scan: fold all but the final update
+    sub-batch slot (mirroring ``SignalEngine._fold_updates`` — on the
+    incremental path the folds advance every carry family), then evaluate
+    the wire step on the final slot. ``upd5_slots``/``upd15_slots`` are
+    (rows (N, U), ts (N, U), vals (N, U, F)) with a STATIC slot depth N;
+    empty slots (all rows -1) are exact no-ops on buffers and carries
+    (``carry_advance_masks``: an unchanged latest ts neither advances nor
+    stales a row), which is what makes depth padding sound."""
+    n = upd5_slots[0].shape[0]
+    assert n == upd15_slots[0].shape[0]
+    for d in range(n - 1):
+        u5 = tuple(x[d] for x in upd5_slots)
+        u15 = tuple(x[d] for x in upd15_slots)
+        buf5 = apply_updates(state.buf5, *u5)
+        buf15 = apply_updates(state.buf15, *u15)
+        if incremental:
+            carry, _, _ = advance_indicator_carry(
+                buf5, buf15, state.indicator_carry, inputs.btc_row
+            )
+        else:
+            carry = state.indicator_carry
+        state = state._replace(buf5=buf5, buf15=buf15, indicator_carry=carry)
+    u5 = tuple(x[n - 1] for x in upd5_slots)
+    u15 = tuple(x[n - 1] for x in upd15_slots)
+    return _tick_step_wire_impl(
+        state,
+        u5,
+        u15,
+        inputs,
+        cfg,
+        wire_enabled,
+        incremental=incremental,
+        maintain_carry=maintain_carry,
+    )
+
+
+def _tick_step_scan_impl(
+    state: EngineState,
+    upd5_seq,
+    upd15_seq,
+    inputs_seq: HostInputs,
+    active: jnp.ndarray,
+    momentum_ok: jnp.ndarray,
+    policy_prev: tuple[jnp.ndarray, jnp.ndarray],
+    cfg: ContextConfig = ContextConfig(),
+    wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+    incremental: bool = True,
+    maintain_carry: bool = True,
+) -> tuple[EngineState, jnp.ndarray, jnp.ndarray]:
+    """T replayed ticks fused into ONE dispatch (ISSUE 5 tentpole).
+
+    ``lax.scan`` threads the full ``EngineState`` through the incremental
+    tick body without ever returning to the host — one dispatch replaces T,
+    which is the whole cost story of the historical-data lanes (replay,
+    A/B oracle drives, refdiff, post-restore catch-up, backtesting): their
+    device compute is a fraction of the per-tick Python + dispatch
+    overhead they used to pay.
+
+    * ``upd5_seq``/``upd15_seq`` — (rows (T, N, U), ts (T, N, U),
+      vals (T, N, U, F)) stacked per-tick update sub-batch slots; slot
+      depth N mirrors the serial drive's ordered sub-batch folds (all but
+      the last slot fold, the last evaluates). Shorter ticks are
+      front-padded with empty slots (exact no-ops).
+    * ``inputs_seq`` — ``HostInputs`` with every leaf stacked to (T, ...).
+    * ``active`` — (T,) bool; padding ticks (chunk rounded up to a size
+      bucket) skip the body entirely via ``lax.cond`` and emit a zero
+      wire.
+    * ``momentum_ok``/``policy_prev`` — the grid-only policy's device-side
+      recursion. The serial drive resolves ``GridOnlyPolicy`` on the host
+      from the PREVIOUS tick's regime after every finalize; inside a chunk
+      that feedback cannot round-trip, so the scan carries (valid, regime)
+      of the previous tick and combines them with the host-resolved
+      breadth-momentum verdict per tick (breadth itself only changes
+      between ticks on the host): ``allow = momentum_ok[t] & prev_valid &
+      regime in {RANGE, TRANSITIONAL}`` — exactly ``GridOnlyPolicy.
+      resolve``'s ladder. ``policy_prev`` seeds tick 0 from the host's
+      last finalized tick.
+
+    Returns ``(final_state, wires (T, wire_length), fired_count (T,))``.
+    Ticks whose fired count exceeds ``WIRE_MAX_FIRED`` must be re-driven
+    through the per-tick overflow fallback by the caller (the chunked
+    drive keeps the pre-chunk state alive for exactly that reason — the
+    scan dispatch itself is never donated)."""
+    from binquant_tpu.enums import MarketRegimeCode
+
+    S = state.buf15.capacity
+    L = wire_length(S)
+    range_code = jnp.int32(int(MarketRegimeCode.RANGE))
+    trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
+
+    def body(carry, xs):
+        st, prev_valid, prev_regime = carry
+        u5_slots, u15_slots, inp, act, mok = xs
+        allow = (
+            mok
+            & prev_valid
+            & ((prev_regime == range_code) | (prev_regime == trans_code))
+        )
+        inp = inp._replace(grid_policy_allows=allow)
+
+        def live(operand):
+            return _fold_and_step_wire(
+                operand, u5_slots, u15_slots, inp, cfg, wire_enabled,
+                incremental, maintain_carry,
+            )
+
+        def idle(operand):
+            return operand, jnp.zeros((L,), dtype=jnp.float32)
+
+        new_st, wire = jax.lax.cond(act, live, idle, st)
+        valid = jnp.where(act, wire[0] > 0.5, prev_valid)
+        regime = jnp.where(act, wire[1].astype(jnp.int32), prev_regime)
+        return (new_st, valid, regime), wire
+
+    (new_state, _, _), wires = jax.lax.scan(
+        body,
+        (state, policy_prev[0], policy_prev[1]),
+        (upd5_seq, upd15_seq, inputs_seq, active, momentum_ok),
+    )
+    return new_state, wires, wires[:, WIRE_FIRED_COUNT_OFF]
+
+
+tick_step_scan = partial(
+    jax.jit,
+    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+)(_tick_step_scan_impl)
+
+# Donated scan: for state-threading loops that keep NO pre-chunk anchor
+# (bench throughput arms). The chunked replay drive deliberately does NOT
+# donate — it holds the pre-chunk state as the overflow re-run anchor, and
+# the copy costs 1/T of the per-tick copying path's (amortized to noise).
+tick_step_scan_donated = jax.jit(
+    _tick_step_scan_impl,
+    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+    donate_argnums=(0,),
+)
+
+
+@jax.jit
+def apply_updates_scan(
+    state: EngineState,
+    upd5_seq,
+    upd15_seq,
+) -> EngineState:
+    """Buffer-only fold of T stacked sub-batch pairs in ONE dispatch — the
+    scanned twin of repeating :func:`apply_updates_step` T times. Used by
+    backfill / post-restore gap catch-up, where an N-bar gap used to cost
+    N dispatches; empty padding slots (rows -1) are no-ops, so callers can
+    bucket T freely. Leaves the indicator carry untouched (callers mark it
+    desynced; the next evaluated tick full-recomputes)."""
+
+    def body(st, xs):
+        u5, u15 = xs
+        return (
+            st._replace(
+                buf5=apply_updates(st.buf5, *u5),
+                buf15=apply_updates(st.buf15, *u15),
+            ),
+            None,
+        )
+
+    new_state, _ = jax.lax.scan(body, state, (upd5_seq, upd15_seq))
+    return new_state
 
 
 @jax.jit

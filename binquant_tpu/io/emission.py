@@ -460,8 +460,17 @@ def _analytics_record(
         except (TypeError, ValueError, IndexError):
             continue
     if btc_rel is not None:
-        merged_indicators.setdefault("btc_beta", float(btc_rel[0]))
-        merged_indicators.setdefault("btc_corr", float(btc_rel[1]))
+        # NaN marks a carry-dirty row (engine/step.py bc_dirty): the
+        # BTC-relative posture is UNKNOWN this tick, which must serialize
+        # as null — a raw NaN is invalid JSON, and 0.0 would be
+        # indistinguishable from a measured zero
+        beta_v, corr_v = (float(btc_rel[0]), float(btc_rel[1]))
+        merged_indicators.setdefault(
+            "btc_beta", None if beta_v != beta_v else beta_v
+        )
+        merged_indicators.setdefault(
+            "btc_corr", None if corr_v != corr_v else corr_v
+        )
     if value.bb_spreads is not None:
         merged_indicators.setdefault(
             "bb_spreads", value.bb_spreads.model_dump(mode="json")

@@ -33,13 +33,17 @@ from binquant_tpu.config import Config
 from binquant_tpu.engine.buffer import IngestBatcher, SymbolRegistry
 from binquant_tpu.engine.step import (
     MIN_INCR_ENGINE_WINDOW,
+    WIRE_FIRED_COUNT_OFF,
+    WIRE_MAX_FIRED,
     apply_updates_carry_step,
+    apply_updates_scan,
     apply_updates_step,
     default_host_inputs,
     initial_engine_state,
     observe_dispatch,
     pad_updates,
     tick_step,
+    tick_step_scan,
     tick_step_wire,
     tick_step_wire_donated,
     unpack_wire,
@@ -57,14 +61,19 @@ from binquant_tpu.io.metrics import LatencyTracker
 from binquant_tpu.io.telegram import TelegramConsumer
 from binquant_tpu.obs.events import get_event_log
 from binquant_tpu.obs.instruments import (
+    BC_DIRTY_ROWS,
     FULL_RECOMPUTE,
     HEARTBEAT_FAILURES,
     OVERFLOW_TICKS,
     QUEUE_DEPTH,
+    SCAN_CHUNKS,
+    SCAN_OVERFLOW_RERUNS,
+    SCANNED_TICKS,
     SIGNALS,
     TICKS,
 )
 from binquant_tpu.obs.tracing import (
+    NULL_TRACE,
     Tracer,
     profiler_window_active,
     step_annotation,
@@ -277,6 +286,54 @@ class _PendingTick(NamedTuple):
     # provenance every sink payload carries
 
 
+def _pow2_bucket(m: int, floor: int = 4) -> int:
+    """Power-of-two size bucket (min ``floor``) shared by every scanned
+    array dimension — slot rows, fold depth chunks, scan length. One copy:
+    the bucketing policy directly controls the jit executable count."""
+    size = floor
+    while size < m:
+        size *= 2
+    return size
+
+
+def _scan_fallback_unavailable():
+    """Fallback slot of a scanned tick's fabricated _PendingTick. Never
+    reachable: the chunked drive re-drives overflowed chunks serially
+    BEFORE any decode, and every scanned wire carries the full payload."""
+    raise RuntimeError(
+        "scanned tick has no per-tick fallback — overflow chunks must be "
+        "re-driven serially (SignalEngine._flush_scan_plan)"
+    )
+
+
+class _ScanTickPlan(NamedTuple):
+    """One replayed tick staged for a fused scan-chunk dispatch: its
+    drained update sub-batches plus every host-resolved input the serial
+    dispatch would have built, captured at PLAN time with the serial
+    drive's exact ordering (breadth momentum BEFORE this tick's bucket
+    refresh, adp scalars after — see ``SignalEngine.process_ticks_scanned``)."""
+
+    now_ms: int
+    ts5: int
+    ts15: int
+    bucket15: int
+    batches5: list
+    batches15: list
+    momentum_ok: bool  # grid-policy breadth verdict as of finalize(t-1)
+    breadth: Any  # MarketBreadthSeries as of this tick's dispatch
+    tracked: Any  # (S,) bool registry occupancy AS OF this tick — a
+    # later chunk-breaking tick's registry add must not leak backwards
+    # (the context coverage gate counts tracked rows)
+    oi: Any  # (S,) np f32 or None (cache empty)
+    adp: tuple  # breadth_scalars() at dispatch
+    quiet: bool
+    btc_row: int
+    rows: Any  # FrozenRows at plan time (no churn inside a chunk)
+    is_futures: bool
+    dominance_is_losers: bool
+    market_domination_reversal: bool
+
+
 class SignalEngine:
     """Owns the device state and drives ticks from queued klines."""
 
@@ -468,6 +525,14 @@ class SignalEngine:
         self._donate_cfg = bool(getattr(config, "donate_enabled", True))
         self.donated_ticks = 0
         self.donated_state_resets = 0
+        # -- scanned replay chunks (engine/step.py tick_step_scan, ISSUE 5)
+        # Multi-tick lanes (replay, catch-up, backtesting) fuse runs of
+        # clean-append incremental ticks into one lax.scan dispatch of up
+        # to BQT_SCAN_CHUNK ticks; counters are test/health introspection.
+        self.scan_chunk = max(int(getattr(config, "scan_chunk", 64) or 64), 2)
+        self.scanned_ticks = 0
+        self.scan_chunks = 0
+        self.scan_overflow_reruns = 0
 
     # -- ingest -------------------------------------------------------------
 
@@ -526,25 +591,82 @@ class SignalEngine:
         upd5 = [pad_updates(*b) for b in batches5] or [empty]
         upd15 = [pad_updates(*b) for b in batches15] or [empty]
         n = max(len(upd5), len(upd15))
-        for i in range(n - 1):
-            self.state = fold(
-                self.state,
-                upd5[i] if i < len(upd5) else empty,
-                upd15[i] if i < len(upd15) else empty,
-            )
+        if not advance_carry and n - 1 >= self._SCAN_FOLD_MIN:
+            # deep update-only folds (backfill chunks, post-restore gap
+            # catch-up) collapse into ~⌈(n-1)/chunk⌉ scanned dispatches
+            # instead of n-1 — an N-bar gap at restart costs ~N/T launches
+            self._scan_fold_prefix(batches5, batches15, n)
+        else:
+            for i in range(n - 1):
+                self.state = fold(
+                    self.state,
+                    upd5[i] if i < len(upd5) else empty,
+                    upd15[i] if i < len(upd15) else empty,
+                )
         return (
             upd5[n - 1] if n - 1 < len(upd5) else empty,
             upd15[n - 1] if n - 1 < len(upd15) else empty,
         )
 
-    def _note_applied(self, batches5: list, batches15: list) -> bool:
+    # update-only folds shorter than this keep the per-sub-batch dispatch
+    # loop (a fresh scan compile isn't worth a handful of launches)
+    _SCAN_FOLD_MIN = 8
+
+    def _scan_fold_prefix(self, batches5: list, batches15: list, n: int) -> None:
+        """Fold sub-batch slot pairs [0, n-2] through ``apply_updates_scan``
+        in ``scan_chunk``-bounded dispatches. Slot lengths are padded to one
+        power-of-two row bucket per interval and the scan length to a
+        power-of-two bucket (both bound the executable count); padding
+        slots are all-(-1) rows, which ``apply_updates`` drops — exact
+        no-ops, so no validity mask is needed."""
+        from binquant_tpu.engine.buffer import NUM_FIELDS
+
+        bucket = _pow2_bucket
+        prefix5 = batches5[: n - 1]
+        prefix15 = batches15[: n - 1]
+        u5_rows = bucket(max((len(b[0]) for b in prefix5), default=1))
+        u15_rows = bucket(max((len(b[0]) for b in prefix15), default=1))
+        total = n - 1
+        chunk = max(self.scan_chunk, self._SCAN_FOLD_MIN)
+        for start in range(0, total, chunk):
+            stop = min(start + chunk, total)
+            tb = bucket(stop - start)
+            r5 = np.full((tb, u5_rows), -1, np.int32)
+            t5 = np.full((tb, u5_rows), -1, np.int32)
+            v5 = np.zeros((tb, u5_rows, NUM_FIELDS), np.float32)
+            r15 = np.full((tb, u15_rows), -1, np.int32)
+            t15 = np.full((tb, u15_rows), -1, np.int32)
+            v15 = np.zeros((tb, u15_rows, NUM_FIELDS), np.float32)
+            for i in range(start, stop):
+                if i < len(prefix5):
+                    rows, ts, vals = pad_updates(*prefix5[i], size=u5_rows)
+                    r5[i - start], t5[i - start], v5[i - start] = rows, ts, vals
+                if i < len(prefix15):
+                    rows, ts, vals = pad_updates(*prefix15[i], size=u15_rows)
+                    r15[i - start], t15[i - start], v15[i - start] = (
+                        rows, ts, vals,
+                    )
+            self.state = apply_updates_scan(
+                self.state, (r5, t5, v5), (r15, t15, v15)
+            )
+
+    def _note_applied(
+        self, batches5: list, batches15: list, commit: bool = True
+    ) -> bool:
         """Update the host-side per-row latest-open-time mirror with the
         sub-batches about to be applied; returns True when EVERY update is
         a clean strictly-newer append (carry-advance safe). Must be called
-        exactly once per drained batch set, in apply order."""
+        exactly once per drained batch set, in apply order.
+
+        ``commit=False`` computes the verdict on a scratch copy without
+        mutating the mirror — the scanned drive peeks before deciding
+        whether a tick joins a chunk (committed then) or re-enters the
+        serial path (which judges and commits itself)."""
         clean = True
         for key, batches in (("5m", batches5), ("15m", batches15)):
             latest = self._host_latest[key]
+            if not commit:
+                latest = latest.copy()
             for rows, ts, _ in batches:
                 if len(rows) == 0:
                     continue
@@ -803,6 +925,371 @@ class SignalEngine:
             self._pending.popleft()
             return await self._finalize_tick(head)
         return []
+
+    # -- scanned multi-tick drive (ISSUE 5) ----------------------------------
+    #
+    # Historical-data lanes (replay, A/B oracle drives, refdiff, restore
+    # catch-up, backtesting) used to pay one Python loop iteration + one
+    # device dispatch PER TICK even though their device compute is a
+    # fraction of that. process_ticks_scanned partitions the recorded
+    # stream into maximal clean-append runs and dispatches each run as ONE
+    # jit'd lax.scan (engine/step.py tick_step_scan) — the EngineState
+    # threads through the scan without returning to the host — then decodes
+    # the stacked wires tick-by-tick through the standard finalize path
+    # (emission, dedupe, policy, notifier, calibration: one copy of the
+    # semantics). Chunk-break rules: cold start, mid-history rewrites,
+    # registry churn, backfill folds, drift-audit ticks, and classic-path
+    # engines (BQT_INCREMENTAL=0) all route through the per-tick path.
+
+    # runs shorter than this re-enter the serial path (a scan compile is
+    # not worth a handful of ticks)
+    _SCAN_MIN_TICKS = 4
+
+    async def process_ticks_scanned(self, ticks) -> list:
+        """Drive a sequence of replayed ticks, fusing eligible runs.
+
+        ``ticks`` iterates ``(now_ms, feed)`` pairs where ``feed`` is either
+        a list of kline dicts (ingested one by one) or a zero-arg callable
+        that loads the batchers itself (the bench's vectorized
+        ``add_batch`` path). Returns every emitted signal, in tick order,
+        each stamped with its producing ``tick_ms`` — the same contract as
+        a serial ``process_tick`` loop, and (by construction plus the
+        overflow re-run below) the identical signal set."""
+        fired_all: list = []
+        # in-flight ticks from BEFORE this drive still belong to the
+        # caller — a serial process_tick loop would have returned them too
+        fired_all.extend(await self.flush_pending())
+        plan: dict | None = None
+        for now_ms, feed in ticks:
+            if callable(feed):
+                feed()
+            else:
+                for k in feed:
+                    self.ingest(k)
+            version0 = self.registry.version
+            batches5 = self.batcher5.drain()
+            batches15 = self.batcher15.drain()
+            churn = self.registry.version != version0
+            clean = self._note_applied(batches5, batches15, commit=False)
+            planned = 0 if plan is None else len(plan["ticks"])
+            seq = self.ticks_processed + planned
+            audit_due = (
+                self.carry_audit_every > 0
+                and seq > 0
+                and seq % self.carry_audit_every == 0
+            )
+            scannable = (
+                self.incremental
+                and self.mesh is None
+                and clean
+                and not churn
+                and self._carry_desync_reason is None
+                and not audit_due
+            )
+            if not scannable:
+                if plan is not None:
+                    fired_all.extend(await self._flush_scan_plan(plan))
+                    plan = None
+                # the per-tick path re-judges cleanliness itself — hand the
+                # drained sub-batches back (prebuilt batches drain in order)
+                self._requeue_batches(batches5, batches15)
+                fired_all.extend(await self.process_tick(now_ms=now_ms))
+                continue
+            if plan is None:
+                plan = self._begin_scan_plan()
+            self._note_applied(batches5, batches15)
+            # grid-policy momentum is judged on the breadth the PREVIOUS
+            # finalize saw (refresh below happens at this tick's dispatch)
+            momentum_ok = self._grid_momentum_ok()
+            bucket15 = (now_ms // 1000) // FIFTEEN_MIN_S
+            await self._refresh_market_breadth(bucket15)
+            plan["ticks"].append(
+                self._plan_scan_tick(now_ms, batches5, batches15, momentum_ok)
+            )
+            if len(plan["ticks"]) >= self.scan_chunk:
+                fired_all.extend(await self._flush_scan_plan(plan))
+                plan = None
+        if plan is not None:
+            fired_all.extend(await self._flush_scan_plan(plan))
+        return fired_all
+
+    def _begin_scan_plan(self) -> dict:
+        """Plan-start snapshots: enough host state to re-judge the run's
+        ticks serially (overflow re-runs, too-short runs). The DEVICE
+        anchor needs no snapshot — nothing dispatches while a plan
+        accumulates, so ``self.state`` still holds the pre-chunk state at
+        flush time (the scan dispatch is deliberately not donated)."""
+        return {
+            "ticks": [],
+            "host_latest": {
+                key: arr.copy() for key, arr in self._host_latest.items()
+            },
+        }
+
+    def _requeue_batches(self, batches5: list, batches15: list) -> None:
+        for b in batches5:
+            self.batcher5.add_batch(*b)
+        for b in batches15:
+            self.batcher15.add_batch(*b)
+
+    def _grid_momentum_ok(self) -> bool:
+        """Host half of the grid-only ladder (``GridOnlyPolicy.resolve``):
+        is a non-flat breadth-momentum reading available? The regime half
+        is recomputed per tick device-side inside the scan."""
+        from binquant_tpu.regime.grid_policy import read_breadth_momentum
+
+        momentum = read_breadth_momentum(self.market_breadth)
+        return momentum is not None and momentum.leaning != "flat"
+
+    def _plan_scan_tick(
+        self, now_ms: int, batches5: list, batches15: list, momentum_ok: bool
+    ) -> _ScanTickPlan:
+        ts_s = now_ms // 1000
+        bucket15 = ts_s // FIFTEEN_MIN_S
+        oi = None
+        if self.oi_cache.has_data:
+            oi = np.full(self.capacity, np.nan, dtype=np.float32)
+            for rows, _, _ in batches15:
+                for row in rows:
+                    symbol = self.registry.name_of(int(row))
+                    if symbol:
+                        oi[int(row)] = self.oi_cache.growth(symbol)
+        settings = self.at_consumer.autotrade_settings
+        _btc = self.registry.row_of(self.btc_symbol)
+        return _ScanTickPlan(
+            now_ms=now_ms,
+            ts5=(ts_s // FIVE_MIN_S) * FIVE_MIN_S - FIVE_MIN_S,
+            ts15=bucket15 * FIFTEEN_MIN_S - FIFTEEN_MIN_S,
+            bucket15=bucket15,
+            batches5=batches5,
+            batches15=batches15,
+            momentum_ok=momentum_ok,
+            breadth=self.market_breadth,
+            tracked=self.registry.active_rows,
+            oi=oi,
+            adp=self._breadth_scalars(),
+            quiet=bool(
+                is_quiet_hours(now=datetime.fromtimestamp(now_ms / 1000, tz=UTC))
+            ),
+            btc_row=-1 if _btc is None else int(_btc),
+            rows=self.registry.frozen_rows(),
+            is_futures=str(settings.market_type).lower().endswith("futures"),
+            dominance_is_losers=bool(
+                getattr(
+                    self.at_consumer, "current_market_dominance_is_losers", False
+                )
+            ),
+            market_domination_reversal=bool(
+                self.at_consumer.market_domination_reversal
+            ),
+        )
+
+    async def _redrive_serial(self, plan: dict) -> list:
+        """Run a plan's ticks through the standard per-tick path (runs too
+        short to scan; overflow re-runs). The latest-ts mirror is restored
+        to its plan-start snapshot first so the serial pass re-judges every
+        tick exactly as the original stream did — each stays on the
+        incremental route, keeping the emitted set identical to a
+        never-scanned drive."""
+        self._host_latest = {
+            key: arr.copy() for key, arr in plan["host_latest"].items()
+        }
+        fired: list = []
+        for p in plan["ticks"]:
+            self._requeue_batches(p.batches5, p.batches15)
+            fired.extend(await self.process_tick(now_ms=p.now_ms))
+        return fired
+
+    async def _flush_scan_plan(self, plan: dict) -> list:
+        ticks = plan["ticks"]
+        if not ticks:
+            return []
+        if len(ticks) < self._SCAN_MIN_TICKS or self.mesh is not None:
+            return await self._redrive_serial(plan)
+        # signals from still-pending serial ticks belong in the returned
+        # stream too (depth>=1 engines)
+        fired_all: list = await self.flush_pending()
+
+        from binquant_tpu.engine.buffer import NUM_FIELDS
+
+        bucket = _pow2_bucket
+        T = len(ticks)
+        n_slots = [max(len(p.batches5), len(p.batches15), 1) for p in ticks]
+        depth = max(n_slots)
+        u5_rows = bucket(
+            max((len(b[0]) for p in ticks for b in p.batches5), default=1)
+        )
+        u15_rows = bucket(
+            max((len(b[0]) for p in ticks for b in p.batches15), default=1)
+        )
+        tb = bucket(T)
+        S = self.capacity
+
+        r5 = np.full((tb, depth, u5_rows), -1, np.int32)
+        t5 = np.full((tb, depth, u5_rows), -1, np.int32)
+        v5 = np.zeros((tb, depth, u5_rows, NUM_FIELDS), np.float32)
+        r15 = np.full((tb, depth, u15_rows), -1, np.int32)
+        t15 = np.full((tb, depth, u15_rows), -1, np.int32)
+        v15 = np.zeros((tb, depth, u15_rows, NUM_FIELDS), np.float32)
+        for i, p in enumerate(ticks):
+            # serial pairing preserved: the tick's own slots sit at the
+            # TAIL (front-padded with exact-no-op empties), so its last
+            # slot is always the evaluated one — _fold_updates semantics
+            off = depth - n_slots[i]
+            for d, b in enumerate(p.batches5):
+                r5[i, off + d], t5[i, off + d], v5[i, off + d] = pad_updates(
+                    *b, size=u5_rows
+                )
+            for d, b in enumerate(p.batches15):
+                r15[i, off + d], t15[i, off + d], v15[i, off + d] = (
+                    pad_updates(*b, size=u15_rows)
+                )
+
+        from binquant_tpu.engine.step import HostInputs
+
+        nan_oi = np.full((S,), np.nan, dtype=np.float32)
+        no_rows = np.zeros((S,), np.bool_)
+        inputs_seq = HostInputs(
+            tracked=np.stack(
+                [p.tracked for p in ticks] + [no_rows] * (tb - T)
+            ),
+            btc_row=self._stack_scalar(
+                [p.btc_row for p in ticks], tb, np.int32, -1
+            ),
+            timestamp_s=self._stack_scalar(
+                [p.ts15 for p in ticks], tb, np.int32, 0
+            ),
+            timestamp5_s=self._stack_scalar(
+                [p.ts5 for p in ticks], tb, np.int32, 0
+            ),
+            oi_growth=np.stack(
+                [p.oi if p.oi is not None else nan_oi for p in ticks]
+                + [nan_oi] * (tb - T)
+            ),
+            adp_latest=self._stack_scalar(
+                [p.adp[0] for p in ticks], tb, np.float32, np.nan
+            ),
+            adp_prev=self._stack_scalar(
+                [p.adp[1] for p in ticks], tb, np.float32, np.nan
+            ),
+            adp_diff=self._stack_scalar(
+                [p.adp[2] for p in ticks], tb, np.float32, np.nan
+            ),
+            adp_diff_prev=self._stack_scalar(
+                [p.adp[3] for p in ticks], tb, np.float32, np.nan
+            ),
+            breadth_momentum_points=self._stack_scalar(
+                [p.adp[4] for p in ticks], tb, np.float32, np.nan
+            ),
+            quiet_hours=self._stack_scalar(
+                [p.quiet for p in ticks], tb, np.bool_, False
+            ),
+            # recomputed device-side per tick from the scan's policy carry
+            grid_policy_allows=np.zeros((tb,), np.bool_),
+            is_futures=self._stack_scalar(
+                [p.is_futures for p in ticks], tb, np.bool_, False
+            ),
+            dominance_is_losers=self._stack_scalar(
+                [p.dominance_is_losers for p in ticks], tb, np.bool_, False
+            ),
+            market_domination_reversal=self._stack_scalar(
+                [p.market_domination_reversal for p in ticks],
+                tb, np.bool_, False,
+            ),
+        )
+        active = np.zeros((tb,), np.bool_)
+        active[:T] = True
+        momentum_seq = self._stack_scalar(
+            [p.momentum_ok for p in ticks], tb, np.bool_, False
+        )
+        policy_prev = (
+            np.bool_(self._last_regime is not None),
+            np.int32(-1 if self._last_regime is None else self._last_regime),
+        )
+
+        key = self._wire_enabled_key()
+        self._tick_seq += 1
+        trace = self.tracer.begin_tick(self._tick_seq, tick_ms=ticks[-1].now_ms)
+        trace.set_attr(path="scanned")
+        t_chunk0 = time.perf_counter()
+        try:
+            with self.latency.stage("scan_chunk"), trace.span(
+                "scan_chunk", ticks=T, padded=tb, depth=depth,
+            ), trace.activate():
+                observe_dispatch(
+                    self.state, (r5, t5, v5), (r15, t15, v15), key,
+                    cfg=self.context_config, fn="tick_step_scan",
+                    incremental=True, maintain_carry=True,
+                )
+                # NOT donated: self.state stays alive as the pre-chunk
+                # anchor the overflow re-run below rewinds to
+                new_state, wires_dev, _counts = tick_step_scan(
+                    self.state,
+                    (r5, t5, v5),
+                    (r15, t15, v15),
+                    inputs_seq,
+                    active,
+                    momentum_seq,
+                    policy_prev,
+                    self.context_config,
+                    wire_enabled=key,
+                    incremental=True,
+                    maintain_carry=True,
+                )
+                wires = np.asarray(wires_dev)
+        except BaseException as exc:
+            trace.mark_error(exc)
+            self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+            raise
+        counts = wires[:T, WIRE_FIRED_COUNT_OFF]
+        if np.any(counts > WIRE_MAX_FIRED):
+            # a tick's fired set overflowed the wire's compaction slots:
+            # drop the chunk's outputs on the floor (self.state was never
+            # advanced) and re-drive serially — the per-tick path runs its
+            # audited overflow fallback, so the emitted set stays exact
+            trace.set_attr(overflow_rerun=True)
+            self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+            self.scan_overflow_reruns += 1
+            SCAN_OVERFLOW_RERUNS.inc()
+            fired_all.extend(await self._redrive_serial(plan))
+            return fired_all
+        self.state = new_state
+        self.scan_chunks += 1
+        SCAN_CHUNKS.inc()
+        self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+
+        per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
+        for i, p in enumerate(ticks):
+            # finalize reads the breadth this tick's dispatch saw
+            self.market_breadth = p.breadth
+            pending = _PendingTick(
+                wire=wires[i],
+                fallback=_scan_fallback_unavailable,
+                ts_ms=p.now_ms,
+                ts5=p.ts5,
+                ts15=p.ts15,
+                bucket15=p.bucket15,
+                dispatched_at=t_chunk0,
+                rows=p.rows,
+                trace=NULL_TRACE,
+            )
+            fired_all.extend(await self._finalize_tick(pending))
+            self.latency.record("tick_total", per_tick_ms)
+            self.ticks_processed += 1
+            self._last_tick_wall_s = time.time()
+            TICKS.inc()
+            get_event_log().tick = self.ticks_processed
+            self.incremental_ticks += 1
+            self.scanned_ticks += 1
+            SCANNED_TICKS.inc()
+        self.touch_heartbeat()
+        return fired_all
+
+    @staticmethod
+    def _stack_scalar(values: list, tb: int, dtype, fill) -> np.ndarray:
+        out = np.full((tb,), fill, dtype=dtype)
+        out[: len(values)] = np.asarray(values, dtype=dtype)
+        return out
 
     async def _dispatch_tick(self, now_ms: int | None = None) -> _PendingTick:
         """Drain batchers and launch the jit'd step + async wire transfer.
@@ -1184,6 +1671,9 @@ class SignalEngine:
             unpacked = unpack_wire(pending.wire)
         fired_w, ctx_scalars = unpacked
         sp_wire.set(overflow=bool(fired_w.overflow))
+        # resync pressure: beta/corr rows reading null until the next full
+        # recompute (absent from older/fabricated wires → 0)
+        BC_DIRTY_ROWS.set(int(ctx_scalars.get("bc_dirty_rows", 0) or 0))
         # The full TickOutputs exists only if a degenerate path needs it:
         # compaction overflow (>WIRE_MAX_FIRED fired pairs) or a wire
         # without the emission payload. Re-running the full step costs one
@@ -1684,6 +2174,7 @@ class SignalEngine:
             "overflow_ticks": self.overflow_ticks,
             "incremental_ticks": self.incremental_ticks,
             "full_recompute_ticks": self.full_recompute_ticks,
+            "scanned_ticks": self.scanned_ticks,
             "carry_desync_reason": self._carry_desync_reason,
         }
 
@@ -1729,6 +2220,11 @@ class SignalEngine:
             # (zero in a healthy deployment)
             "donated_ticks": self.donated_ticks,
             "donated_state_resets": self.donated_state_resets,
+            # scanned replay chunks: ticks evaluated inside fused lax.scan
+            # dispatches (multi-tick lanes only; 0 in a live deployment)
+            "scanned_ticks": self.scanned_ticks,
+            "scan_chunks": self.scan_chunks,
+            "scan_overflow_reruns": self.scan_overflow_reruns,
             # event-log drops (write failures / emit-after-close) — zero
             # in a healthy deployment
             "eventlog_dropped": get_event_log().dropped,

@@ -71,12 +71,17 @@ def make_stub_engine(
     context_config=None,
     incremental: bool | None = None,
     donate: bool | None = None,
+    carry_audit_every: int | None = None,
+    scan_chunk: int | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
     ``incremental``/``donate`` override the config's BQT_INCREMENTAL /
     BQT_DONATE defaults so the A/B harness can pin either evaluation path
-    and either dispatch variant explicitly."""
+    and either dispatch variant explicitly; ``carry_audit_every`` /
+    ``scan_chunk`` override the drift-audit cadence and the fused-scan
+    chunk size (BQT_CARRY_AUDIT_EVERY / BQT_SCAN_CHUNK) for drills that
+    need resync boundaries or chunk breaks at test scale."""
     import os
 
     os.environ.setdefault("ENV", "CI")
@@ -99,6 +104,10 @@ def make_stub_engine(
         config.__dict__["incremental_enabled"] = bool(incremental)
     if donate is not None:
         config.__dict__["donate_enabled"] = bool(donate)
+    if carry_audit_every is not None:
+        config.__dict__["carry_audit_every_ticks"] = int(carry_audit_every)
+    if scan_chunk is not None:
+        config.__dict__["scan_chunk"] = int(scan_chunk)
     binbot_api = BinbotApi("http://stub", session=StubSession(breadth=breadth))
 
     sent: list[str] = []
@@ -171,6 +180,9 @@ def run_replay(
     context_config=None,
     incremental: bool | None = None,
     donate: bool | None = None,
+    scanned: bool = False,
+    carry_audit_every: int | None = None,
+    scan_chunk: int | None = None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -184,6 +196,14 @@ def run_replay(
     oracle models); fired signals are attributed to their producing tick
     via ``FiredSignal.tick_ms`` either way, and in-flight ticks are flushed
     at end of file.
+
+    ``scanned=True`` drives the SAME stream through the fused scan engine
+    (``SignalEngine.process_ticks_scanned``): runs of clean-append
+    incremental ticks collapse into one ``lax.scan`` dispatch each, the
+    dispatch-overhead lever for every historical-data lane. The emitted
+    signal set is identical to the serial drive by construction (chunk
+    breaks + the serial overflow re-run) — pinned by
+    tests/test_scan_replay.py. Requires the incremental path.
     """
     engine = make_stub_engine(
         capacity=capacity,
@@ -194,6 +214,8 @@ def run_replay(
         context_config=context_config,
         incremental=incremental,
         donate=donate,
+        carry_audit_every=carry_audit_every,
+        scan_chunk=scan_chunk,
     )
     # scripted dominance state (reference: attrs on the evaluator/consumer,
     # NEUTRAL/False in production — scriptable here so the dominance-gated
@@ -233,11 +255,26 @@ def run_replay(
             record(fired)
         record(await engine.flush_pending())
 
-    asyncio.run(drive())
+    async def drive_scanned() -> None:
+        seq = [
+            (
+                (bucket + 1) * 900 * 1000,
+                sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]),
+            )
+            for bucket in sorted(klines_by_tick)
+        ]
+        record(await engine.process_ticks_scanned(seq))
+        record(await engine.flush_pending())
+
+    asyncio.run(drive_scanned() if scanned else drive())
     wall = time.perf_counter() - t_start
     overflow = engine.latency.stats().get("overflow_fallback", {})
     return {
         "ticks": engine.ticks_processed,
+        # fused-scan accounting (scanned=True lanes; 0 on the serial drive)
+        "scanned_ticks": engine.scanned_ticks,
+        "scan_chunks": engine.scan_chunks,
+        "scan_overflow_reruns": engine.scan_overflow_reruns,
         # incremental indicator path accounting: the A/B parity tests
         # assert the fast path actually engaged (a vacuously-full run
         # would not be testing the incremental engine at all)
@@ -336,6 +373,8 @@ def run_replay_ab(
     market_domination_reversal: bool = False,
     incremental: bool | None = None,
     donate: bool | None = None,
+    scanned: bool = False,
+    oracle_signals: list | None = None,
 ) -> dict:
     """A/B parity: the TPU batch path and the per-symbol pandas oracle run
     the same replay and must emit the identical signal set (SURVEY.md §7
@@ -343,7 +382,12 @@ def run_replay_ab(
     ``enabled_strategies`` overrides the live dispatch set in BOTH backends
     (e.g. to A/B the dormant oracle set — VERDICT r2 item 6); the dominance
     flags script the host-resolved market-domination state both backends
-    consume."""
+    consume. ``scanned=True`` drives the TPU arm through the fused
+    scan-chunk engine. ``oracle_signals`` supplies a precomputed oracle run
+    for these exact (path, window, breadth, strategy, dominance) arguments —
+    the pandas arm costs tens of seconds per sweep, so callers running
+    several A/Bs over one fixture compute it once (tests/test_ab_parity.py
+    shares one module-scoped run; pass None to compute here)."""
     tpu_signals: list[tuple] = []
     stats = run_replay(
         path,
@@ -356,13 +400,15 @@ def run_replay_ab(
         market_domination_reversal=market_domination_reversal,
         incremental=incremental,
         donate=donate,
+        scanned=scanned,
     )
-    oracle_signals = run_replay_oracle(
-        path, window=window, breadth=breadth,
-        enabled_strategies=enabled_strategies,
-        dominance_is_losers=dominance_is_losers,
-        market_domination_reversal=market_domination_reversal,
-    )
+    if oracle_signals is None:
+        oracle_signals = run_replay_oracle(
+            path, window=window, breadth=breadth,
+            enabled_strategies=enabled_strategies,
+            dominance_is_losers=dominance_is_losers,
+            market_domination_reversal=market_domination_reversal,
+        )
     tpu_set, oracle_set = set(tpu_signals), set(oracle_signals)
     from collections import Counter
 

@@ -84,6 +84,28 @@ JIT_RECOMPILES = REGISTRY.counter(
     "jax trace+compile of the tick step.",
     labels=("fn",),
 )
+BC_DIRTY_ROWS = REGISTRY.gauge(
+    "bqt_bc_dirty_rows",
+    "Beta/corr carry rows marked dirty on the last incremental tick "
+    "(asymmetric append vs the BTC row; they decode as null until the "
+    "next full-recompute resync) — sustained non-zero means resync "
+    "pressure.",
+)
+SCANNED_TICKS = REGISTRY.counter(
+    "bqt_scanned_ticks_total",
+    "Replayed ticks evaluated inside fused lax.scan chunks (replay, "
+    "catch-up, backtesting lanes) instead of one dispatch each.",
+)
+SCAN_CHUNKS = REGISTRY.counter(
+    "bqt_scan_chunks_total",
+    "Fused scan-chunk dispatches (each replaces chunk-length per-tick "
+    "dispatches).",
+)
+SCAN_OVERFLOW_RERUNS = REGISTRY.counter(
+    "bqt_scan_overflow_reruns_total",
+    "Scan chunks re-driven through the serial per-tick path because a "
+    "tick's fired set overflowed the wire's compaction slots.",
+)
 
 # -- ingest buffers + registry (engine/buffer.py) ---------------------------
 

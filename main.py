@@ -217,10 +217,20 @@ def main() -> int:
         help="replay evaluation backend: the TPU batch path, the legacy "
         "per-symbol pandas oracle, or an A/B diff of both (BASELINE #1)",
     )
+    parser.add_argument(
+        "--scanned",
+        action="store_true",
+        help="drive the TPU replay arm through fused lax.scan chunks "
+        "(ISSUE 5): runs of clean-append incremental ticks cost one "
+        "dispatch per BQT_SCAN_CHUNK ticks; the emitted signal set is "
+        "identical to the serial drive",
+    )
     args = parser.parse_args()
 
     if args.backend != "tpu" and not args.replay:
         parser.error("--backend reference/ab requires --replay")
+    if args.scanned and not args.replay:
+        parser.error("--scanned requires --replay")
 
     if args.replay:
         if args.backend == "reference":
@@ -232,12 +242,12 @@ def main() -> int:
         if args.backend == "ab":
             from binquant_tpu.io.replay import run_replay_ab
 
-            result = run_replay_ab(args.replay)
+            result = run_replay_ab(args.replay, scanned=args.scanned)
             print(result)
             return 0 if result["match"] else 1
         from binquant_tpu.io.replay import run_replay
 
-        stats = run_replay(args.replay)
+        stats = run_replay(args.replay, scanned=args.scanned)
         print(stats)
         return 0
 

@@ -35,6 +35,25 @@ def replay_path(tmp_path_factory):
     return path
 
 
+@pytest.fixture(scope="module")
+def oracle_cache(replay_path):
+    """Memoized pandas-oracle sweeps over the module fixture (ISSUE 5
+    tier-1 wall-time relief): each oracle run costs ~45 s of per-symbol
+    pandas and the same (breadth,) argument sets are swept by THREE tests
+    in this module — compute each once, share the result (run_replay_ab
+    accepts it via ``oracle_signals``)."""
+    cache: dict = {}
+
+    def get(key: str, breadth: dict | None):
+        if key not in cache:
+            cache[key] = run_replay_oracle(
+                replay_path, window=WINDOW, breadth=breadth
+            )
+        return cache[key]
+
+    return get
+
+
 def _assert_match(result):
     assert result["match"], {
         "only_tpu": result["only_tpu"][:5],
@@ -45,7 +64,7 @@ def _assert_match(result):
     assert result["tpu_count"] > 0
 
 
-def test_ab_signal_sets_identical(replay_path):
+def test_ab_signal_sets_identical(replay_path, oracle_cache):
     # ISSUE 2 acceptance: the tier-1 oracle A/B runs with the incremental
     # indicator fast path pinned ON (conftest defaults it off for compile
     # budget) — and asserts it actually ENGAGED, so this parity can never
@@ -54,7 +73,7 @@ def test_ab_signal_sets_identical(replay_path):
     # this compile is shared with the breadth run below.
     result = run_replay_ab(
         replay_path, capacity=CAPACITY, window=WINDOW, incremental=True,
-        donate=True,
+        donate=True, oracle_signals=oracle_cache("none", None),
     )
     _assert_match(result)
     assert result["tpu_stats"]["incremental_ticks"] > 0
@@ -69,13 +88,19 @@ def test_ab_signal_sets_identical(replay_path):
         assert name in result["strategies"], result["strategies"]
 
 
+@pytest.mark.slow
 def test_ab_alternate_seed(tmp_path):
+    """Redundancy drill (same parity surface, different seed) — slow-marked
+    since ISSUE 5 for tier-1 wall-time relief (the primary-seed tests above
+    keep the coverage); run by ``make replay-smoke``."""
     path = tmp_path / "ab_99.jsonl"
     generate_replay_file(path, n_symbols=24, n_ticks=120, seed=99)
     _assert_match(run_replay_ab(path, capacity=CAPACITY, window=WINDOW))
 
 
-def test_ab_with_breadth_all_five_live_strategies_engage(replay_path):
+def test_ab_with_breadth_all_five_live_strategies_engage(
+    replay_path, oracle_cache
+):
     """With a scripted breadth series the breadth-gated paths (LSP
     routing, grid-only policy lag) run in BOTH backends and must agree —
     and ALL FIVE live strategies must actually ENGAGE in the matching run,
@@ -90,6 +115,7 @@ def test_ab_with_breadth_all_five_live_strategies_engage(replay_path):
     result = run_replay_ab(
         replay_path, capacity=CAPACITY, window=WINDOW, breadth=WASHED_BREADTH,
         incremental=True, donate=True,
+        oracle_signals=oracle_cache("washed", WASHED_BREADTH),
     )
     _assert_match(result)
     assert result["tpu_stats"]["incremental_ticks"] > 0
@@ -152,10 +178,11 @@ def test_ab_dormant_extended_oracle_set(tmp_path):
     assert sorted(result["strategies"]) == sorted(DORMANT_ORACLE_EXTENDED)
 
 
-def test_oracle_emits_crafted_signals(replay_path):
+def test_oracle_emits_crafted_signals(replay_path, oracle_cache):
     """The oracle independently finds the crafted setups: the MRF hammer
-    on S005 and — with breadth — the LSP pump on S003."""
-    signals = run_replay_oracle(replay_path, window=WINDOW)
+    on S005 and — with breadth — the LSP pump on S003. Reads the
+    module-shared oracle sweeps (same arguments as the A/B tests above)."""
+    signals = oracle_cache("none", None)
     by_strategy = {}
     for _, strategy, sym, direction, _ in signals:
         by_strategy.setdefault(strategy, []).append((sym, direction))
@@ -164,9 +191,7 @@ def test_oracle_emits_crafted_signals(replay_path):
         for sym, direction in by_strategy.get("mean_reversion_fade", [])
     )
 
-    with_breadth = run_replay_oracle(
-        replay_path, window=WINDOW, breadth=WASHED_BREADTH
-    )
+    with_breadth = oracle_cache("washed", WASHED_BREADTH)
     lsp = [
         (sym, direction)
         for _, strategy, sym, direction, _ in with_breadth

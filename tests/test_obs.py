@@ -518,6 +518,11 @@ def test_obs_smoke_scrape_replay_tick(tmp_path):
         ("bqt_registry_capacity_errors_total", "counter"),
         ("bqt_slow_ticks_total", "counter"),
         ("bqt_eventlog_dropped_total", "counter"),
+        # ISSUE 5: bc_dirty resync-pressure gauge + scanned-replay lane
+        ("bqt_bc_dirty_rows", "gauge"),
+        ("bqt_scanned_ticks_total", "counter"),
+        ("bqt_scan_chunks_total", "counter"),
+        ("bqt_scan_overflow_reruns_total", "counter"),
     ):
         assert f"# TYPE {family} {kind}" in body, family
 

@@ -1,0 +1,266 @@
+"""Scanned replay engine drills (ISSUE 5).
+
+The chunked drive (``SignalEngine.process_ticks_scanned`` over
+``engine/step.py tick_step_scan``) must emit the BIT-IDENTICAL signal set
+to the serial per-tick drive on any stream: chunk-break rules (cold start,
+rewrites, churn, audits) route ineligible ticks through the per-tick path,
+and a chunk containing a wire-overflow tick is re-driven serially from the
+pre-chunk anchor. The tier-1 test pins equality through a rewrite-induced
+chunk break at small scale; the slow lane (make replay-smoke) adds the
+A/B-fixture run, the overflow re-run drill, and the supertrend
+carry-divergence pin.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from binquant_tpu.io.replay import (
+    generate_replay_file,
+    load_klines_by_tick,
+    make_stub_engine,
+    run_replay,
+)
+
+CAPACITY, WINDOW = 32, 120
+
+
+def _tick_seq(path):
+    by_tick = load_klines_by_tick(path)
+    return [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(by_tick[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(by_tick)
+    ]
+
+
+def _signal_tuples(fired):
+    return [
+        (s.tick_ms, s.strategy, s.symbol, str(s.value.direction),
+         bool(s.value.autotrade))
+        for s in fired
+    ]
+
+
+def _drive_serial(engine, seq):
+    out = []
+
+    async def drive():
+        for now_ms, klines in seq:
+            for k in klines:
+                engine.ingest(k)
+            out.extend(await engine.process_tick(now_ms=now_ms))
+        out.extend(await engine.flush_pending())
+
+    asyncio.run(drive())
+    return _signal_tuples(out)
+
+
+def _drive_scanned(engine, seq):
+    out = []
+
+    async def drive():
+        out.extend(await engine.process_ticks_scanned(seq))
+        out.extend(await engine.flush_pending())
+
+    asyncio.run(drive())
+    return _signal_tuples(out)
+
+
+@pytest.fixture(scope="module")
+def replay_with_rewrite(tmp_path_factory):
+    """The crafted small market PLUS one mid-stream rewrite: a corrected
+    copy of an already-applied 15m candle (same open_time, shifted close)
+    re-sent two ticks later — the exchange's re-send pattern the host's
+    latest-ts mirror must catch and route to the full recompute."""
+    path = tmp_path_factory.mktemp("scan") / "scan_16.jsonl"
+    generate_replay_file(path, n_symbols=16, n_ticks=112)
+    seq = _tick_seq(path)
+    donor_tick = len(seq) - 6
+    donor = next(
+        k for k in seq[donor_tick][1]
+        if k["symbol"] == "S002USDT"
+        and (k["close_time"] - k["open_time"]) // 1000 >= 899
+    )
+    corrected = dict(donor)
+    corrected["close"] = round(donor["close"] * 1.004, 6)
+    corrected["high"] = max(corrected["high"], corrected["close"])
+    seq[donor_tick + 2][1].append(corrected)
+    return seq
+
+
+def test_scanned_drive_matches_serial_with_rewrite_break(replay_with_rewrite):
+    """ISSUE 5 acceptance (tier-1 half): scanned == serial signal sets on
+    a stream that EXERCISES a rewrite-induced chunk break — the rewrite
+    tick must leave the scan, run the full recompute serially, and the
+    drive must keep fusing afterwards."""
+    serial_engine = make_stub_engine(
+        capacity=CAPACITY, window=WINDOW, incremental=True, scan_chunk=32
+    )
+    serial = _drive_serial(serial_engine, replay_with_rewrite)
+
+    scanned_engine = make_stub_engine(
+        capacity=CAPACITY, window=WINDOW, incremental=True, scan_chunk=32
+    )
+    scanned = _drive_scanned(scanned_engine, replay_with_rewrite)
+
+    assert set(serial) == set(scanned), {
+        "only_serial": sorted(set(serial) - set(scanned))[:5],
+        "only_scanned": sorted(set(scanned) - set(serial))[:5],
+    }
+    # non-vacuous: signals fired, the scan actually fused ticks, and the
+    # rewrite actually broke a chunk (cold start + rewrite = 2 full ticks)
+    assert len(serial) > 0
+    assert scanned_engine.scanned_ticks > 0
+    assert scanned_engine.scan_chunks >= 2
+    assert scanned_engine.full_recompute_ticks >= 2
+    assert scanned_engine.ticks_processed == serial_engine.ticks_processed
+    # both drives saw identical routing outside the scan fusion itself
+    assert (
+        scanned_engine.full_recompute_ticks
+        == serial_engine.full_recompute_ticks
+    )
+
+
+def test_bc_dirty_rows_decode_as_null_not_zero():
+    """Satellite: a NaN btc_beta/corr (carry-dirty row) serializes as null
+    in the analytics record — distinguishable from a measured 0.0 — and
+    the record stays valid JSON."""
+    from binquant_tpu.io.emission import _analytics_record
+
+    class _Value:
+        direction = "LONG"
+        autotrade = False
+        bb_spreads = None
+        current_price = 1.0
+        score = 0.5
+        signal_kind = "standard"
+        bot_params = None
+        grid_params = None
+
+    ctx = {
+        "market_regime": 2, "transition": -1, "transition_strength": 0.0,
+        "stress": 0.1, "timestamp_ms": 0, "valid": True,
+        "advancers_ratio": 0.5, "long_tailwind": 0.0, "short_tailwind": 0.0,
+    }
+    dirty = _analytics_record(
+        "activity_burst_pump", "XUSDT", _Value(), {}, ctx,
+        btc_rel=(float("nan"), float("nan")),
+    )
+    assert dirty["indicators"]["btc_beta"] is None
+    assert dirty["indicators"]["btc_corr"] is None
+    json.dumps(dirty["indicators"])  # null, not NaN — valid JSON
+    measured = _analytics_record(
+        "activity_burst_pump", "XUSDT", _Value(), {}, ctx, btc_rel=(0.0, 0.0)
+    )
+    assert measured["indicators"]["btc_beta"] == 0.0
+
+
+@pytest.mark.slow
+def test_scanned_ab_fixture_signal_set(tmp_path):
+    """ISSUE 5 acceptance (slow half): on the A/B fixture the scanned
+    drive emits the identical signal set to the serial drive — same
+    stream, breadth engaged, production default pair semantics."""
+    from tests.test_ab_parity import WASHED_BREADTH
+
+    path = tmp_path / "ab_7.jsonl"
+    generate_replay_file(path, n_symbols=24, n_ticks=120, seed=7)
+    serial_signals: list = []
+    run_replay(
+        path, capacity=64, window=200, collect=serial_signals,
+        breadth=WASHED_BREADTH, incremental=True,
+    )
+    scanned_signals: list = []
+    stats = run_replay(
+        path, capacity=64, window=200, collect=scanned_signals,
+        breadth=WASHED_BREADTH, incremental=True, scanned=True,
+    )
+    assert set(serial_signals) == set(scanned_signals), {
+        "only_serial": sorted(set(serial_signals) - set(scanned_signals))[:5],
+        "only_scanned": sorted(set(scanned_signals) - set(serial_signals))[:5],
+    }
+    assert len(serial_signals) > 0
+    assert stats["scanned_ticks"] > 0
+    assert stats["scan_chunks"] >= 1
+
+
+@pytest.mark.slow
+def test_scanned_overflow_chunk_redrives_serially(tmp_path):
+    """ISSUE 5 acceptance (overflow half): a market-wide crash tick fires
+    more pairs than the wire's compaction slots INSIDE a scan chunk — the
+    chunk must rewind to its pre-chunk anchor, re-drive serially through
+    the audited per-tick overflow fallback, and still emit the identical
+    set."""
+    from binquant_tpu.io.replay import generate_burst_replay
+
+    path = tmp_path / "burst.jsonl"
+    generate_burst_replay(path, n_symbols=160, n_ticks=108)
+    serial_signals: list = []
+    s_stats = run_replay(
+        path, capacity=192, window=200, collect=serial_signals,
+        incremental=True,
+    )
+    scanned_signals: list = []
+    c_stats = run_replay(
+        path, capacity=192, window=200, collect=scanned_signals,
+        incremental=True, scanned=True,
+    )
+    assert set(serial_signals) == set(scanned_signals)
+    assert s_stats["overflow_ticks"] >= 1  # the drill actually overflowed
+    assert c_stats["scan_overflow_reruns"] >= 1  # ...inside a chunk
+    assert c_stats["overflow_ticks"] >= 1  # the serial re-run paid it
+    assert c_stats["scanned_ticks"] > 0  # earlier chunks still fused
+
+
+@pytest.mark.slow
+def test_supertrend_carry_divergence_pin(tmp_path):
+    """Satellite: coinrule_supertrend_swing_reversal wire-ENABLED on the
+    incremental fast path (its carried ``st_up`` readout finally has a
+    wire consumer) vs the full path, across several resync boundaries.
+
+    The supertrend carry continues ONE Wilder-ATR recursion between
+    resyncs while the full path restarts the scan at the sliding seed
+    every tick — they differ by the exponentially-forgotten prefix (PR 4's
+    NOTE). This pins that on an engineered stream that actually fires the
+    strategy, the divergence stays below every trigger threshold: the two
+    paths emit the identical signal set. A short audit cadence forces
+    resyncs mid-stream so re-anchoring is exercised, not avoided."""
+    from binquant_tpu.io.replay import generate_dormant_extended_replay
+    from binquant_tpu.oracle.evaluator import DORMANT_ORACLE_EXTENDED
+
+    rising_breadth = {
+        "timestamp": [1, 2, 3, 4],
+        "market_breadth": [0.30, 0.34, 0.38, 0.42],
+        "market_breadth_ma": [0.30, 0.36],
+    }
+    path = tmp_path / "st_pin.jsonl"
+    generate_dormant_extended_replay(path)
+    kwargs = dict(
+        capacity=64, window=200,
+        enabled_strategies=set(DORMANT_ORACLE_EXTENDED),
+        breadth=rising_breadth,
+        dominance_is_losers=True,
+        market_domination_reversal=True,
+    )
+    carried: list = []
+    c_stats = run_replay(
+        path, collect=carried, incremental=True, carry_audit_every=16,
+        **kwargs,
+    )
+    full: list = []
+    run_replay(path, collect=full, incremental=False, **kwargs)
+
+    assert set(carried) == set(full), {
+        "only_carried": sorted(set(carried) - set(full))[:5],
+        "only_full": sorted(set(full) - set(carried))[:5],
+    }
+    # non-vacuous: the strategy fired, the fast path ran, and the audit
+    # cadence produced several resync boundaries
+    assert any(
+        s == "coinrule_supertrend_swing_reversal" for _, s, _, _, _ in carried
+    )
+    assert c_stats["incremental_ticks"] > 0
+    assert c_stats["full_recompute_ticks"] >= 4

@@ -37,6 +37,12 @@ def main() -> int:
         "--skip-tpu", action="store_true",
         help="diff reference vs oracle only (no device runs)",
     )
+    ap.add_argument(
+        "--scanned", action="store_true",
+        help="drive the TPU arm through fused lax.scan chunks (ISSUE 5) — "
+        "the 48.5 s serial drive's dispatch overhead collapses to one "
+        "launch per BQT_SCAN_CHUNK ticks; signal-set exact by construction",
+    )
     args = ap.parse_args()
 
     from binquant_tpu.io.replay import run_replay, run_replay_oracle
@@ -69,9 +75,11 @@ def main() -> int:
         tpu_list: list = []
         run_replay(
             args.fixture, capacity=args.capacity, window=args.window,
-            collect=tpu_list,
+            collect=tpu_list, scanned=args.scanned,
+            incremental=True if args.scanned else None,
         )
         tpu = set(tpu_list)
+        results["tpu_scanned"] = bool(args.scanned)
         results["tpu_wall_s"] = round(time.time() - t0, 1)
         results["tpu_count"] = len(tpu)
         results["only_tpu_vs_ref"] = sorted(tpu - ref)[:50]

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -28,7 +28,14 @@ help:
 	@echo "               re-run + supertrend carry-divergence pin + the"
 	@echo "               slow-marked alternate-seed A/B, then a small-shape"
 	@echo "               serial-vs-scanned throughput report"
-	@echo "  dryrun     - 8-device virtual-mesh multichip dry run"
+	@echo "  backtest-smoke- time-batched backtest lane (ISSUE 6): the"
+	@echo "               slow-marked backtest-vs-serial-FULL equality"
+	@echo "               drills (recorded 36h fixture, overflow burst,"
+	@echo "               rewrite chunk break) + the 64-combo vmapped grid"
+	@echo "               smoke, then a small-shape throughput + sweep"
+	@echo "               report (bench.py --backtest-throughput)"
+	@echo "  dryrun     - 8-device virtual-mesh multichip dry run (incl."
+	@echo "               one scan chunk + one backtest chunk)"
 	@echo "  lint       - ruff check"
 	@echo "offline kernel profiling: tools/profile_stages.py captures"
 	@echo "per-stage jax.profiler traces (see README.md section Observability)"
@@ -81,6 +88,20 @@ replay-smoke:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu python bench.py --replay-throughput \
 		--symbols 256 --window 120 --ticks 64
+
+# The time-batched backtest lane: tier-1 keeps only the small-shape
+# equality drill + the params-default bit-parity unit
+# (tests/test_backtest.py -m "not slow"); this target runs the heavy
+# fixtures (recorded-stream equality, the >WIRE_MAX_FIRED overflow
+# re-drive, the rewrite chunk break, the >=64-combo vmapped grid smoke)
+# plus a quick throughput + sweep report. The record-shape acceptance
+# bench is `python bench.py --backtest-throughput` (writes
+# BENCH_BACKTEST_CPU.json).
+backtest-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_backtest.py -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu python bench.py --backtest-throughput \
+		--symbols 64 --window 160 --ticks 32 --best-of 1
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

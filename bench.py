@@ -48,7 +48,8 @@ import time
 import numpy as np
 
 
-def _seed_engine(num_symbols: int, window: int, depth: int):
+def _seed_engine(num_symbols: int, window: int, depth: int,
+                 incremental: bool | None = None):
     """A production SignalEngine (stub network sinks) with full windows."""
     import jax
 
@@ -57,7 +58,9 @@ def _seed_engine(num_symbols: int, window: int, depth: int):
 
     rng = np.random.default_rng(7)
     engine = make_stub_engine(
-        capacity=num_symbols, window=window, pipeline_depth=depth
+        capacity=num_symbols, window=window, pipeline_depth=depth,
+        incremental=incremental,
+        donate=False if incremental is False else None,
     )
     names = ["BTCUSDT"] + [f"S{i:04d}USDT" for i in range(1, num_symbols)]
     rows_all = engine.registry.rows_for(names)
@@ -612,6 +615,180 @@ def run_replay_throughput(
             "dispatch-bound-link number: on silicon the same body is a few "
             "ms against a ~150 ms tunneled RTT per serial dispatch — "
             "rerun bench.py --replay-throughput on the TPU to record it."
+        ),
+        "measurement_epoch": MEASUREMENT_EPOCH,
+    }
+
+
+def run_backtest_throughput(
+    num_symbols: int = 512,
+    window: int = 240,
+    ticks: int = 96,
+    backtest_chunk: int = 12,
+    best_of: int = 3,
+    sweep_combos: int = 64,
+) -> dict:
+    """Backtest throughput (ISSUE 6 acceptance): serial full-recompute
+    drive vs the time-batched ``(S, W+T)`` backend over identical streams,
+    plus a vmapped ≥64-combo parameter-grid arm.
+
+    Both engine arms run FULL-recompute semantics (incremental off) — the
+    backend's contract. Each arm runs ``best_of`` times and quotes its
+    best run: this box carries intermittent neighbor load, so a single
+    sample under-reports (the arms run strictly serialized, never
+    concurrently). Candles/sec counts every ingested bar (two intervals
+    per tick); the sweep arm additionally quotes combo-candles/sec =
+    P × candles/sec — the hyperparameter-search workload's true rate."""
+
+    def drive_arm(backtest: bool) -> dict:
+        best = None
+        for _rep in range(max(best_of, 1)):
+            engine, make_updates, now, px = _seed_engine(
+                num_symbols, window, 0, incremental=False
+            )
+            engine.backtest_chunk = backtest_chunk
+            px_box = [px]
+
+            def feed(i: int, engine=engine, make_updates=make_updates,
+                     now=now, px_box=px_box) -> int:
+                eval_s = now + i * 900
+                rows, ts15, vals15, px2 = make_updates(
+                    eval_s - 900, px_box[0], 900
+                )
+                engine.batcher15.add_batch(rows, ts15, vals15)
+                rows5, ts5, vals5, _ = make_updates(eval_s - 300, px2, 300)
+                engine.batcher5.add_batch(rows5, ts5, vals5)
+                px_box[0] = px2
+                return eval_s * 1000
+
+            warmup = (backtest_chunk + 4) if backtest else 4
+            signals = 0
+
+            async def run_arm(engine=engine, feed=feed,
+                              warmup=warmup) -> float:
+                nonlocal signals
+                if backtest:
+
+                    def tick_item(i):
+                        eval_ms = (now + i * 900) * 1000
+                        return (eval_ms, lambda i=i: feed(i))
+
+                    signals += len(
+                        await engine.process_ticks_backtest(
+                            [tick_item(i) for i in range(warmup)]
+                        )
+                    )
+                    await engine.flush_pending()
+                    t0 = time.perf_counter()
+                    signals += len(
+                        await engine.process_ticks_backtest(
+                            [tick_item(warmup + i) for i in range(ticks)]
+                        )
+                    )
+                    await engine.flush_pending()
+                    return time.perf_counter() - t0
+                for i in range(warmup):
+                    now_ms = feed(i)
+                    signals += len(await engine.process_tick(now_ms=now_ms))
+                signals += len(await engine.flush_pending())
+                t0 = time.perf_counter()
+                for i in range(ticks):
+                    now_ms = feed(warmup + i)
+                    signals += len(await engine.process_tick(now_ms=now_ms))
+                signals += len(await engine.flush_pending())
+                return time.perf_counter() - t0
+
+            wall = asyncio.run(run_arm())
+            arm = {
+                "wall_s": round(wall, 3),
+                "ticks": ticks,
+                "ticks_per_sec": round(ticks / wall, 2),
+                "candles_per_sec": round(ticks * num_symbols * 2 / wall),
+                "per_tick_ms": round(wall / ticks * 1000.0, 3),
+                "signals": signals,
+                "backtest_chunks": engine.backtest_chunks,
+                "backtest_ticks": engine.backtest_ticks,
+                "backtest_overflow_reruns": engine.backtest_overflow_reruns,
+            }
+            if best is None or arm["ticks_per_sec"] > best["ticks_per_sec"]:
+                best = arm
+        best["best_of"] = best_of
+        return best
+
+    serial = drive_arm(backtest=False)
+    batched = drive_arm(backtest=True)
+    speedup = (
+        round(batched["ticks_per_sec"] / serial["ticks_per_sec"], 2)
+        if serial["ticks_per_sec"]
+        else None
+    )
+
+    # --- vmapped parameter-grid arm: one dispatch scores the whole grid
+    from binquant_tpu.backtest import run_param_sweep
+    from binquant_tpu.io.replay import generate_replay_file
+
+    import math
+    import tempfile
+
+    side = max(2, round(sweep_combos ** (1.0 / 3.0)))
+    axes = {
+        "pt.rsi_oversold": list(np.linspace(15.0, 60.0, side)),
+        "mrf.rsi_long_max": list(np.linspace(10.0, 40.0, side)),
+        "abp.volume_multiplier": list(
+            np.linspace(1.5, 6.0, math.ceil(sweep_combos / side / side))
+        ),
+    }
+    sweep_best = None
+    with tempfile.TemporaryDirectory() as td:
+        sweep_path = f"{td}/sweep.jsonl"
+        sweep_syms, sweep_ticks = 48, 96
+        generate_replay_file(
+            sweep_path, n_symbols=sweep_syms, n_ticks=sweep_ticks
+        )
+        for _rep in range(max(best_of, 1)):
+            r = run_param_sweep(
+                sweep_path,
+                axes=axes,
+                capacity=sweep_syms,
+                window=window,
+                chunk=sweep_ticks + 8,  # whole stream in ONE dispatch
+            )
+            if (
+                sweep_best is None
+                or (r["combo_candles_per_sec"] or 0)
+                > (sweep_best["combo_candles_per_sec"] or 0)
+            ):
+                sweep_best = r
+    sweep_summary = {
+        "P": sweep_best["P"],
+        "dispatches": sweep_best["dispatches"],
+        "evaluated_ticks": sweep_best["evaluated_ticks"],
+        "candles": sweep_best["candles"],
+        "wall_s": sweep_best["wall_s"],
+        "combo_candles_per_sec": sweep_best["combo_candles_per_sec"],
+        "distinct_fire_totals": len(set(sweep_best["total_fired"])),
+        "best_of": best_of,
+        "axes": sweep_best["axes"],
+    }
+
+    return {
+        "symbols": num_symbols,
+        "window": window,
+        "ticks": ticks,
+        "backtest_chunk": backtest_chunk,
+        "serial_full": serial,
+        "backtest": batched,
+        "backtest_vs_serial_x": speedup,
+        "param_sweep": sweep_summary,
+        "measurement": (
+            "production SignalEngine over one synthetic stream per arm "
+            "(identical seeds), both arms full-recompute "
+            "(BQT_INCREMENTAL=0): serial = per-tick process_tick at depth "
+            "0; backtest = process_ticks_backtest (S, W+T) chunks. "
+            "Steady-state (compiles in warmup), best-of-N serialized runs "
+            "(neighbor noise). Sweep arm: run_param_sweep over a "
+            f"{sweep_combos}-combo grid, whole stream per dispatch. "
+            "CPU-model numbers — rerun on silicon when the tunnel returns."
         ),
         "measurement_epoch": MEASUREMENT_EPOCH,
     }
@@ -1282,6 +1459,28 @@ def main() -> int | None:
         default=64,
         help="ticks fused per scan dispatch in --replay-throughput",
     )
+    parser.add_argument(
+        "--backtest-throughput",
+        action="store_true",
+        help="time-batched backtest backend vs the serial full-recompute "
+        "drive (+ the vmapped 64-combo parameter-grid arm); writes "
+        "BENCH_BACKTEST_CPU.json when run at the record shape on the CPU "
+        "model (smoke shapes print only)",
+    )
+    parser.add_argument(
+        "--backtest-chunk",
+        type=int,
+        default=12,
+        help="ticks per time-batched dispatch in --backtest-throughput "
+        "(the backend's memory knob)",
+    )
+    parser.add_argument(
+        "--best-of",
+        type=int,
+        default=3,
+        help="serialized repetitions per arm in --backtest-throughput; "
+        "best run is quoted (the box carries neighbor noise)",
+    )
     parser.add_argument("--symbols", type=int, default=2048)
     parser.add_argument("--window", type=int, default=400)
     parser.add_argument("--ticks", type=int, default=240)
@@ -1335,6 +1534,41 @@ def main() -> int | None:
 
     if args.smoke:
         args.symbols, args.window, args.ticks, args.warmup = 32, 120, 5, 2
+
+    if args.backtest_throughput:
+        import jax
+
+        # documented zero-arg invocation measures the record shape; an
+        # explicit --symbols/--window/--ticks makes a print-only smoke run
+        record_shape = (
+            args.symbols == parser.get_default("symbols")
+            and args.window == parser.get_default("window")
+            and args.ticks == parser.get_default("ticks")
+        )
+        if record_shape:
+            symbols, window, ticks = 512, 240, 96
+        else:
+            symbols, window, ticks = args.symbols, args.window, max(args.ticks, 8)
+        r = run_backtest_throughput(
+            symbols,
+            window,
+            ticks=ticks,
+            backtest_chunk=args.backtest_chunk,
+            best_of=args.best_of,
+        )
+        record = {
+            "metric": "backtest_vs_serial_full_x",
+            "value": r["backtest_vs_serial_x"],
+            "unit": "x",
+            # acceptance: the backend must beat the serial full drive
+            "vs_baseline": r["backtest_vs_serial_x"],
+            "detail": r,
+        }
+        print(json.dumps(record))
+        if jax.default_backend() == "cpu" and record_shape:
+            with open("BENCH_BACKTEST_CPU.json", "w") as f:
+                json.dump(record, f, indent=1)
+        return
 
     if args.replay_throughput:
         import jax

@@ -1,0 +1,22 @@
+"""Time-batched backtest subsystem (ISSUE 6).
+
+A distinct *backtest backend* next to the per-tick live step and the
+ISSUE-5 scanned replay: instead of threading a carried recursion serially
+through time, it evaluates the FULL-recompute tick semantics over an
+``(S, W+T)`` extended buffer — per-tick right-aligned window views are
+gathers, the heavy windowed math time-vectorizes across the whole chunk,
+and only the genuinely sequential recursions (market-regime carry, the
+PT/MRF dedupe cooldowns, the grid-policy feedback) ride a cheap
+``lax.scan``. ``vmap`` over a strategy-parameter axis scores a whole
+hyperparameter grid in one dispatch.
+"""
+
+from binquant_tpu.backtest.driver import (  # noqa: F401
+    run_backtest,
+    run_param_sweep,
+)
+from binquant_tpu.backtest.kernel import (  # noqa: F401
+    BACKTEST_STRATEGIES,
+    backtest_chunk,
+    backtest_chunk_sweep,
+)

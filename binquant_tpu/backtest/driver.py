@@ -1,0 +1,629 @@
+"""Host driver for the time-batched backtest backend.
+
+Mirrors the scanned drive's shape (``SignalEngine.process_ticks_scanned``):
+runs of clean-append ticks accumulate into a plan, each tick's host inputs
+captured with the serial drive's exact ordering via the SAME
+``_plan_scan_tick`` planner; ineligible ticks (cold-start churn, rewrites,
+mesh) flush the plan and re-enter the serial per-tick path, which — on the
+full-recompute engines this backend requires — evaluates identically to a
+never-batched drive. A chunk whose fired count overflows the wire's
+compaction slots is re-driven serially from the plan-start snapshot
+(``_redrive_serial``), so the emitted signal set stays exact.
+
+What differs from the scanned drive: instead of stacked update slots
+feeding a serial ``lax.scan`` of the carried tick body, the planner lays
+the chunk's appends out as an ``(S, W+N)`` extended buffer + per-tick
+cumulative bar counts, and dispatches ``backtest_chunk`` — the
+time-vectorized FULL-recompute kernel. Post-chunk, the engine's ring
+buffers are rebuilt host-side from the extension's final window (bit-equal
+to serially applied appends) and the scan's regime/dedupe carries are
+committed, so serial ticks can interleave freely.
+
+``run_backtest`` is the top-level entry (stub-sinked engine over a JSONL
+stream, same contract as ``run_replay``); ``run_param_sweep`` drives the
+``vmap``-over-params kernel, scoring a whole parameter grid per dispatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from binquant_tpu.engine.buffer import NUM_FIELDS, MarketBuffer
+from binquant_tpu.engine.step import (
+    STRATEGY_ORDER,
+    WIRE_FIRED_COUNT_OFF,
+    WIRE_MAX_FIRED,
+    EngineState,
+)
+from binquant_tpu.backtest.kernel import (
+    BACKTEST_STRATEGIES,
+    backtest_chunk,
+    backtest_chunk_sweep,
+)
+from binquant_tpu.strategies.params import (
+    StrategyParams,
+    dynamic_params,
+    grid_size,
+    make_param_grid,
+)
+
+
+def _build_extension(
+    base_times: np.ndarray,
+    base_vals: np.ndarray,
+    ticks_batches: list[list],
+    window: int,
+):
+    """Lay a chunk's clean appends out past the pre-chunk ring.
+
+    Returns ``(ext_times (S, W+N), ext_vals (S, W+N, F), counts (T, S))``
+    where ``counts[t, s]`` is how many bars symbol s has applied through
+    tick t — the window-view gather offset. Column ``W + k`` holds symbol
+    s's k-th appended bar; a tick-t window ``[counts[t], counts[t]+W)``
+    then reproduces the serially-applied ring bit for bit (appends only —
+    the drive routes anything else to the serial path)."""
+    S = base_times.shape[0]
+    T = len(ticks_batches)
+    totals = np.zeros(S, np.int64)
+    for batches in ticks_batches:
+        for rows, _, _ in batches:
+            rows = np.asarray(rows)
+            ok = (rows >= 0) & (rows < S)
+            np.add.at(totals, rows[ok], 1)
+    n_ext = int(totals.max()) if S else 0
+    ext_t = np.full((S, window + n_ext), -1, np.int32)
+    ext_t[:, :window] = base_times
+    ext_v = np.full((S, window + n_ext, NUM_FIELDS), np.nan, np.float32)
+    ext_v[:, :window] = base_vals
+    cnt = np.zeros(S, np.int64)
+    counts = np.zeros((T, S), np.int32)
+    for t, batches in enumerate(ticks_batches):
+        for rows, ts, vals in batches:
+            rows = np.asarray(rows, np.int64)
+            ok = (rows >= 0) & (rows < S)
+            r = rows[ok]
+            cols = window + cnt[r]
+            ext_t[r, cols] = np.asarray(ts)[ok]
+            ext_v[r, cols] = np.asarray(vals, np.float32)[ok]
+            cnt[r] += 1
+        counts[t] = cnt
+    return ext_t, ext_v, counts
+
+
+def _final_window(
+    ext_t: np.ndarray,
+    ext_v: np.ndarray,
+    start: np.ndarray,
+    filled0: np.ndarray,
+    window: int,
+) -> MarketBuffer:
+    """The post-chunk ring: each symbol's last W extension columns —
+    exactly what serial shift-appends would have produced."""
+    cols = start.astype(np.int64)[:, None] + np.arange(window)
+    times = np.take_along_axis(ext_t, cols, axis=1)
+    vals = np.take_along_axis(ext_v, cols[:, :, None], axis=1)
+    filled = np.minimum(filled0.astype(np.int64) + start, window).astype(
+        np.int32
+    )
+    return MarketBuffer(
+        times=jnp.asarray(times), values=jnp.asarray(vals),
+        filled=jnp.asarray(filled),
+    )
+
+
+def _stack_inputs(engine, ticks, tb):
+    """Stacked (tb, ...) HostInputs + active/momentum vectors — the ONE
+    shared stacking on the engine (``_stack_plan_inputs``, also used by
+    the scanned flush) so the two multi-tick backends can never drift."""
+    return engine._stack_plan_inputs(ticks, tb)
+
+
+def _pad_counts(counts: np.ndarray, tb: int) -> np.ndarray:
+    """Pad the (T, S) cumulative counts to the scan bucket by repeating
+    the final row — padded (inactive) ticks gather a valid window and are
+    skipped by the scan's cond."""
+    T = counts.shape[0]
+    if tb == T:
+        return counts
+    return np.vstack([counts, np.repeat(counts[-1:], tb - T, axis=0)])
+
+
+async def _flush_backtest_plan(engine, plan, params) -> list:
+    """Dispatch one planned chunk through the time-batched kernel, commit
+    the post-chunk state, and finalize tick-by-tick through the standard
+    decode path. Overflow ⇒ serial re-drive from the plan-start snapshot."""
+    from binquant_tpu.io.pipeline import (
+        _PendingTick,
+        _pow2_bucket,
+        _scan_fallback_unavailable,
+    )
+    from binquant_tpu.obs.events import get_event_log
+    from binquant_tpu.obs.instruments import TICKS
+    from binquant_tpu.obs.tracing import NULL_TRACE
+
+    ticks = plan["ticks"]
+    if not ticks:
+        return []
+    if len(ticks) < engine._SCAN_MIN_TICKS or engine.mesh is not None:
+        return await engine._redrive_serial(plan)
+    fired_all: list = await engine.flush_pending()
+
+    T = len(ticks)
+    tb = _pow2_bucket(T)
+    W = engine.window
+    state = engine.state
+    base5_t = np.asarray(state.buf5.times)
+    base5_v = np.asarray(state.buf5.values)
+    base15_t = np.asarray(state.buf15.times)
+    base15_v = np.asarray(state.buf15.values)
+    ext5_t, ext5_v, counts5 = _build_extension(
+        base5_t, base5_v, [p.batches5 for p in ticks], W
+    )
+    ext15_t, ext15_v, counts15 = _build_extension(
+        base15_t, base15_v, [p.batches15 for p in ticks], W
+    )
+    filled0 = (np.asarray(state.buf5.filled), np.asarray(state.buf15.filled))
+    inputs_seq, active, momentum_seq = _stack_inputs(engine, ticks, tb)
+    policy_prev = (
+        np.bool_(engine._last_regime is not None),
+        np.int32(-1 if engine._last_regime is None else engine._last_regime),
+    )
+    key = engine._wire_enabled_key()
+    t_chunk0 = time.perf_counter()
+    carries, _policy, wires_dev, _fired, _counts = backtest_chunk(
+        (ext5_t, ext5_v),
+        (ext15_t, ext15_v),
+        _pad_counts(counts5, tb),
+        _pad_counts(counts15, tb),
+        filled0,
+        (state.regime_carry, state.mrf_last_emitted,
+         state.pt_last_signal_close),
+        inputs_seq,
+        active,
+        momentum_seq,
+        policy_prev,
+        engine.context_config,
+        wire_enabled=key,
+        window=W,
+        params=None if params is None else dynamic_params(params),
+    )
+    wires = np.asarray(wires_dev)
+    if np.any(wires[:T, WIRE_FIRED_COUNT_OFF] > WIRE_MAX_FIRED):
+        # a tick's fired set overflowed the wire's compaction slots: drop
+        # the chunk's outputs (engine.state never advanced) and re-drive
+        # serially through the audited per-tick overflow fallback
+        engine.backtest_overflow_reruns += 1
+        fired_all.extend(await engine._redrive_serial(plan))
+        return fired_all
+
+    regime_carry, mrf_carry, pt_carry = carries
+    engine.state = EngineState(
+        buf5=_final_window(ext5_t, ext5_v, counts5[-1], filled0[0], W),
+        buf15=_final_window(ext15_t, ext15_v, counts15[-1], filled0[1], W),
+        regime_carry=regime_carry,
+        mrf_last_emitted=mrf_carry,
+        pt_last_signal_close=pt_carry,
+        # full-recompute backend: the indicator carry is never consumed
+        # (the drive requires BQT_INCREMENTAL=0) — passed through untouched
+        indicator_carry=state.indicator_carry,
+    )
+    engine.backtest_chunks += 1
+
+    per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
+    for i, p in enumerate(ticks):
+        engine.market_breadth = p.breadth
+        pending = _PendingTick(
+            wire=wires[i],
+            fallback=_scan_fallback_unavailable,
+            ts_ms=p.now_ms,
+            ts5=p.ts5,
+            ts15=p.ts15,
+            bucket15=p.bucket15,
+            dispatched_at=t_chunk0,
+            rows=p.rows,
+            trace=NULL_TRACE,
+        )
+        fired_all.extend(await engine._finalize_tick(pending))
+        engine.latency.record("tick_total", per_tick_ms)
+        engine.ticks_processed += 1
+        engine._last_tick_wall_s = time.time()
+        TICKS.inc()
+        get_event_log().tick = engine.ticks_processed
+        engine.backtest_ticks += 1
+    engine.touch_heartbeat()
+    return fired_all
+
+
+def _check_supported(enabled, window: int | None = None) -> None:
+    unsupported = set(enabled) - BACKTEST_STRATEGIES
+    if unsupported:
+        raise ValueError(
+            f"backtest backend cannot evaluate {sorted(unsupported)}; "
+            f"supported: {sorted(BACKTEST_STRATEGIES)} (use the serial "
+            "replay drives for buffer-consuming dormant strategies)"
+        )
+    from binquant_tpu.strategies.activity_burst_pump import (
+        ABP_EXT_MIN_WINDOW,
+    )
+
+    if (
+        window is not None
+        and "activity_burst_pump" in enabled
+        and window < ABP_EXT_MIN_WINDOW
+    ):
+        raise ValueError(
+            f"window {window} too short for the backtest backend's "
+            f"extended-series ABP core (need >= {ABP_EXT_MIN_WINDOW}); "
+            "grow the window or disable activity_burst_pump"
+        )
+
+
+async def drive_ticks_backtest(engine, ticks, params=None, chunk=None) -> list:
+    """Drive a replayed tick sequence through the time-batched backend.
+
+    Same contract as ``process_ticks_scanned``: ``ticks`` iterates
+    ``(now_ms, feed)`` pairs, every emitted signal is returned in tick
+    order stamped with its producing tick. Requires a FULL-recompute
+    engine (``incremental=False``) — this backend evaluates full-path
+    semantics and commits chunk state the carried fast path could not
+    resync from."""
+    from binquant_tpu.io.pipeline import FIFTEEN_MIN_S
+
+    if engine.incremental:
+        raise ValueError(
+            "the backtest backend requires a full-recompute engine — "
+            "construct it with incremental=False (BQT_INCREMENTAL=0)"
+        )
+    _check_supported(engine._wire_enabled_key(), engine.window)
+    chunk = int(chunk or engine.backtest_chunk)
+    # Serial re-entries (cold start, rewrites, overflow re-drives) go
+    # through process_tick — install the params on the engine for the
+    # DURATION of this drive so those ticks evaluate with the SAME
+    # thresholds as the batched chunks, then restore: a later drive (or a
+    # resumed live loop) at defaults must not inherit a stale override.
+    prev_params = engine.strategy_params
+    if params is not None:
+        engine.strategy_params = params
+    try:
+        fired_all: list = []
+        fired_all.extend(await engine.flush_pending())
+        plan: dict | None = None
+        for now_ms, feed in ticks:
+            if callable(feed):
+                feed()
+            else:
+                for k in feed:
+                    engine.ingest(k)
+            version0 = engine.registry.version
+            batches5 = engine.batcher5.drain()
+            batches15 = engine.batcher15.drain()
+            churn = engine.registry.version != version0
+            clean = engine._note_applied(batches5, batches15, commit=False)
+            eligible = clean and not churn and engine.mesh is None
+            if not eligible:
+                if plan is not None:
+                    fired_all.extend(
+                        await _flush_backtest_plan(engine, plan, params)
+                    )
+                    plan = None
+                engine._requeue_batches(batches5, batches15)
+                fired_all.extend(await engine.process_tick(now_ms=now_ms))
+                continue
+            if plan is None:
+                plan = engine._begin_scan_plan()
+            engine._note_applied(batches5, batches15)
+            momentum_ok = engine._grid_momentum_ok()
+            bucket15 = (now_ms // 1000) // FIFTEEN_MIN_S
+            await engine._refresh_market_breadth(bucket15)
+            plan["ticks"].append(
+                engine._plan_scan_tick(
+                    now_ms, batches5, batches15, momentum_ok
+                )
+            )
+            if len(plan["ticks"]) >= chunk:
+                fired_all.extend(
+                    await _flush_backtest_plan(engine, plan, params)
+                )
+                plan = None
+        if plan is not None:
+            fired_all.extend(await _flush_backtest_plan(engine, plan, params))
+        return fired_all
+    finally:
+        if params is not None:
+            engine.strategy_params = prev_params
+
+
+def run_backtest(
+    path: str | Path,
+    capacity: int = 256,
+    window: int = 200,
+    collect: list | None = None,
+    breadth: dict | None = None,
+    enabled_strategies: set | None = None,
+    dominance_is_losers: bool = False,
+    market_domination_reversal: bool = False,
+    context_config=None,
+    params: StrategyParams | None = None,
+    chunk: int | None = None,
+) -> dict:
+    """Backtest a JSONL kline stream through the time-batched backend.
+
+    The ``run_replay`` twin for the backtest subsystem: stubbed sinks, one
+    engine tick per 15m bucket, fired signals appended to ``collect`` as
+    ``(tick_ms, strategy, symbol, direction, autotrade)`` tuples. At
+    default ``params`` the emitted signal set is EXACTLY the serial
+    full-recompute drive's (``run_replay(incremental=False)``) — pinned by
+    tests/test_backtest.py."""
+    from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
+
+    if enabled_strategies is not None:
+        _check_supported(
+            frozenset(enabled_strategies) or frozenset()
+        )
+    engine = make_stub_engine(
+        capacity=capacity,
+        window=window,
+        breadth=breadth,
+        pipeline_depth=0,
+        enabled_strategies=enabled_strategies,
+        context_config=context_config,
+        incremental=False,
+        donate=False,
+    )
+    engine.at_consumer.market_domination_reversal = market_domination_reversal
+    engine.at_consumer.current_market_dominance_is_losers = dominance_is_losers
+    klines_by_tick = load_klines_by_tick(path)
+    candles = sum(len(v) for v in klines_by_tick.values())
+    seq = [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(klines_by_tick)
+    ]
+
+    fired_total = 0
+
+    def record(fired) -> None:
+        nonlocal fired_total
+        fired_total += len(fired)
+        if collect is not None:
+            for s in fired:
+                collect.append(
+                    (
+                        s.tick_ms,
+                        s.strategy,
+                        s.symbol,
+                        str(s.value.direction),
+                        bool(s.value.autotrade),
+                    )
+                )
+
+    async def drive() -> None:
+        record(
+            await drive_ticks_backtest(engine, seq, params=params, chunk=chunk)
+        )
+        record(await engine.flush_pending())
+
+    t_start = time.perf_counter()
+    asyncio.run(drive())
+    wall = time.perf_counter() - t_start
+    return {
+        "ticks": engine.ticks_processed,
+        "backtest_ticks": engine.backtest_ticks,
+        "backtest_chunks": engine.backtest_chunks,
+        "backtest_overflow_reruns": engine.backtest_overflow_reruns,
+        "serial_ticks": engine.ticks_processed - engine.backtest_ticks,
+        "signals": fired_total,
+        "candles": candles,
+        "wall_s": round(wall, 3),
+        "candles_per_sec": round(candles / wall, 1) if wall > 0 else None,
+    }
+
+
+def _apply_host_updates(times, vals, filled, batches, window):
+    """apply_updates semantics on host numpy rings (the sweep's state):
+    strictly-newer append → shift-append; matching-timestamp bar →
+    overwrite in place; stale no-match → dropped."""
+    for rows, ts, v in batches:
+        rows = np.asarray(rows)
+        for i, row in enumerate(np.asarray(rows, np.int64)):
+            if not 0 <= row < times.shape[0]:
+                continue
+            t_i = int(np.asarray(ts)[i])
+            if filled[row] == 0 or t_i > times[row, -1]:
+                times[row, :-1] = times[row, 1:]
+                times[row, -1] = t_i
+                vals[row, :-1] = vals[row, 1:]
+                vals[row, -1] = np.asarray(v, np.float32)[i]
+                filled[row] = min(filled[row] + 1, window)
+            else:
+                match = np.nonzero(times[row] == t_i)[0]
+                if len(match):
+                    vals[row, match[0]] = np.asarray(v, np.float32)[i]
+
+
+def run_param_sweep(
+    path: str | Path,
+    axes: dict,
+    capacity: int = 64,
+    window: int = 200,
+    breadth: dict | None = None,
+    enabled_strategies: set | None = None,
+    context_config=None,
+    chunk: int | None = None,
+    base_params: StrategyParams | None = None,
+) -> dict:
+    """Score a strategy-parameter grid over a kline stream: ONE vmapped
+    dispatch per chunk evaluates every combo (``backtest_chunk_sweep``).
+
+    The per-combo scan carries (regime state, dedupe cooldowns, grid
+    policy) are ``(P,)``-batched across chunks, so combos evolve
+    independent histories; buffers and features are shared (no batch dim).
+    Non-append ticks (rewrites) flush the chunk, apply host-side, and keep
+    sweeping — there is no serial path here (nothing to emit; the sweep
+    SCORES, it does not emit signals). Returns per-combo per-strategy
+    trigger/autotrade counts plus the combo labels for
+    ``tools/sweep_report.py``."""
+    from binquant_tpu.io.pipeline import FIFTEEN_MIN_S
+    from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
+    from binquant_tpu.regime.context import initial_regime_carry
+
+    grid, combos = make_param_grid(axes, base_params)
+    P = max(grid_size(grid), 1)
+    engine = make_stub_engine(
+        capacity=capacity,
+        window=window,
+        breadth=breadth,
+        pipeline_depth=0,
+        enabled_strategies=enabled_strategies,
+        context_config=context_config,
+        incremental=False,
+        donate=False,
+    )
+    key = engine._wire_enabled_key()
+    _check_supported(key, window)
+    chunk = int(chunk or engine.backtest_chunk)
+    S, W = capacity, window
+
+    # host ring state shared by every combo (params never touch buffers)
+    times5 = np.full((S, W), -1, np.int32)
+    vals5 = np.full((S, W, NUM_FIELDS), np.nan, np.float32)
+    filled5 = np.zeros(S, np.int64)
+    times15 = np.full((S, W), -1, np.int32)
+    vals15 = np.full((S, W, NUM_FIELDS), np.nan, np.float32)
+    filled15 = np.zeros(S, np.int64)
+
+    # per-combo sequential carries, (P,)-batched leaves
+    def tile(leaf):
+        return jnp.broadcast_to(leaf, (P,) + leaf.shape)
+
+    carriesP = jax.tree_util.tree_map(
+        tile,
+        (
+            initial_regime_carry(S),
+            jnp.full((S,), -1, jnp.int32),
+            jnp.full((S,), -1, jnp.int32),
+        ),
+    )
+    policyP = (np.zeros(P, np.bool_), np.full(P, -1, np.int32))
+
+    n_strat = len(STRATEGY_ORDER)
+    trig_totals = np.zeros((P, n_strat), np.int64)
+    at_totals = np.zeros((P, n_strat), np.int64)
+    evaluated = 0
+    dispatches = 0
+    candles = 0
+
+    klines_by_tick = load_klines_by_tick(path)
+    seq = [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(klines_by_tick)
+    ]
+
+    plan: list = []  # (scan_tick_plan, append_batches5, append_batches15)
+
+    def flush() -> None:
+        nonlocal carriesP, policyP, evaluated, dispatches
+        nonlocal times5, vals5, filled5, times15, vals15, filled15
+        nonlocal trig_totals, at_totals
+        if not plan:
+            return
+        from binquant_tpu.io.pipeline import _pow2_bucket
+
+        ticks = [p for p, _, _ in plan]
+        T = len(ticks)
+        tb = _pow2_bucket(T)
+        ext5_t, ext5_v, counts5 = _build_extension(
+            times5, vals5, [b5 for _, b5, _ in plan], W
+        )
+        ext15_t, ext15_v, counts15 = _build_extension(
+            times15, vals15, [b15 for _, _, b15 in plan], W
+        )
+        inputs_seq, active, momentum_seq = _stack_inputs(engine, ticks, tb)
+        carriesP, policyP, _fired, tc, ac = backtest_chunk_sweep(
+            (ext5_t, ext5_v),
+            (ext15_t, ext15_v),
+            _pad_counts(counts5, tb),
+            _pad_counts(counts15, tb),
+            (filled5.astype(np.int32), filled15.astype(np.int32)),
+            carriesP,
+            inputs_seq,
+            active,
+            momentum_seq,
+            policyP,
+            engine.context_config,
+            wire_enabled=key,
+            window=W,
+            params=dynamic_params(grid),
+        )
+        trig_totals += np.asarray(tc)[:, :T].sum(axis=1)
+        at_totals += np.asarray(ac)[:, :T].sum(axis=1)
+        evaluated += T
+        dispatches += 1
+        # commit the post-chunk rings
+        buf5 = _final_window(ext5_t, ext5_v, counts5[-1], filled5, W)
+        buf15 = _final_window(ext15_t, ext15_v, counts15[-1], filled15, W)
+        times5, vals5 = np.asarray(buf5.times), np.asarray(buf5.values)
+        filled5 = np.asarray(buf5.filled).astype(np.int64)
+        times15, vals15 = np.asarray(buf15.times), np.asarray(buf15.values)
+        filled15 = np.asarray(buf15.filled).astype(np.int64)
+        plan.clear()
+
+    t_start = time.perf_counter()
+    for now_ms, klines in seq:
+        for k in klines:
+            engine.ingest(k)
+        candles += len(klines)
+        batches5 = engine.batcher5.drain()
+        batches15 = engine.batcher15.drain()
+        clean = engine._note_applied(batches5, batches15)
+        momentum_ok = engine._grid_momentum_ok()
+        bucket15 = (now_ms // 1000) // FIFTEEN_MIN_S
+        asyncio.run(engine._refresh_market_breadth(bucket15))
+        tick_plan = engine._plan_scan_tick(
+            now_ms, batches5, batches15, momentum_ok
+        )
+        if not clean:
+            # rewrite/out-of-order: flush, apply with overwrite semantics,
+            # then evaluate this tick against the corrected rings (its
+            # appends — if any — ride the extension as usual only when
+            # clean; here everything lands host-side, zero appends)
+            flush()
+            _apply_host_updates(times5, vals5, filled5, batches5, W)
+            _apply_host_updates(times15, vals15, filled15, batches15, W)
+            plan.append((tick_plan, [], []))
+        else:
+            plan.append((tick_plan, batches5, batches15))
+        if len(plan) >= chunk:
+            flush()
+    flush()
+    wall = time.perf_counter() - t_start
+
+    order = np.argsort(-trig_totals.sum(axis=1), kind="stable")
+    return {
+        "P": P,
+        "combos": combos,
+        "axes": {k: [float(v) for v in vs] for k, vs in axes.items()},
+        "strategies": list(STRATEGY_ORDER),
+        "trig_counts": trig_totals.tolist(),
+        "autotrade_counts": at_totals.tolist(),
+        "total_fired": trig_totals.sum(axis=1).tolist(),
+        "ranking": [int(i) for i in order],
+        "evaluated_ticks": evaluated,
+        "dispatches": dispatches,
+        "candles": candles,
+        "wall_s": round(wall, 3),
+        "combo_candles_per_sec": (
+            round(P * candles / wall, 1) if wall > 0 else None
+        ),
+    }

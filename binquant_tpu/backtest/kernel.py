@@ -1,0 +1,508 @@
+"""The time-batched backtest kernel: T full-recompute ticks in one dispatch.
+
+The scanned replay (engine/step.py ``tick_step_scan``) fuses T ticks into
+one dispatch but still threads the carried per-tick recursion *serially*
+through time — every tick's windowed math waits for the previous tick's
+state. This backend exploits what a backtest knows up front (the whole
+candle stream) to break that dependency:
+
+* **Extended buffers**: the chunk's clean appends are laid out once as an
+  ``(S, W+N)`` extension of the pre-chunk ring; the right-aligned window
+  the serial drive would hold at tick t is exactly the column slice
+  ``[c_t, c_t+W)`` where ``c_t`` counts that symbol's bars applied so far.
+  Window views are gathers, bit-identical to the serial buffers.
+* **Time-vectorized precompute**: everything context-free in the full
+  tick — feature packs, symbol features, the LSP heavy core, the BTC
+  beta/corr block — evaluates via ``vmap`` over the tick axis on those
+  views, calling the SAME kernels the serial full path calls; the ABP
+  heavy core (the dominant cost: full-tail rolling medians + quantile
+  sorts) goes further and collapses the T heavily-overlapping per-tick
+  tails into ONE extended-series pass (``abp_core_batch`` — bit-exact
+  because every ABP rolling input is position-local and sort/shift based;
+  LSP's cumsum-anchored means/extrema are NOT view-invariant in f32 and
+  therefore stay vmapped). The windowed sorts/EWM matmuls for all T ticks
+  run as one batched kernel each instead of T dependent dispatches.
+* **Sequential residue**: only the genuinely cross-tick recursions remain
+  in a ``lax.scan`` — the market-regime carry, PriceTracker/
+  MeanReversionFade dedupe cooldowns, and the grid-only-policy feedback
+  (the same device-side recursion the scanned drive carries) — each a few
+  (S,)-sized ops per tick.
+
+The chunk emits the SAME stacked ``(T, wire_length)`` wire format as
+``tick_step_scan`` (one shared ``pack_wire``), so the standard host decode
+(``unpack_wire`` → ``_finalize_tick`` → emission) consumes it unchanged,
+and equality against the serial FULL-recompute drive is pinned end-to-end
+on emitted signal sets (tests/test_backtest.py). NOTE the pin is against
+the full path, NOT the carried fast path — the supertrend and ABP/beta-
+corr carries have documented divergences from full recompute (CHANGES.md
+PR 4/5 NOTEs) that a full-recompute backend must not inherit.
+
+``vmap`` over a :class:`strategies.params.StrategyParams` float axis
+(``backtest_chunk_sweep``) scores P parameter combos in the one dispatch;
+everything params-independent (buffers, packs, features) has no batch dim
+and is computed once.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, MarketBuffer, fresh_mask
+from binquant_tpu.engine.step import (
+    BC_WINDOW,
+    LIVE_STRATEGIES,
+    MIN_BARS,
+    STRATEGY_ORDER,
+    WIRE_FIRED_COUNT_OFF,
+    HostInputs,
+    _btc_change_96,
+    _btc_momentum_pair,
+    _btc_row_mask,
+    _mask_outputs,
+    build_summary,
+    pack_wire,
+    quiet_suppression,
+    wire_length,
+)
+from binquant_tpu.ops.indicators import log_returns, rolling_beta_corr
+from binquant_tpu.regime.context import (
+    ContextConfig,
+    RegimeCarry,
+    compute_market_context,
+    compute_symbol_features,
+)
+from binquant_tpu.strategies.activity_burst_pump import (
+    _abp_outputs,
+    abp_core_batch,
+)
+from binquant_tpu.strategies.base import no_signal
+from binquant_tpu.strategies.dormant import inverse_price_tracker
+from binquant_tpu.strategies.ladder_deployer import ladder_deployer
+from binquant_tpu.strategies.liquidation_sweep_pump import (
+    _lsp_outputs,
+    _routing,
+    lsp_core,
+)
+from binquant_tpu.strategies.mean_reversion_fade import mean_reversion_fade
+from binquant_tpu.strategies.params import resolve_params
+from binquant_tpu.strategies.price_tracker import price_tracker
+
+# Strategies the backtest backend evaluates exactly. The live five plus the
+# pack-only dormant InversePriceTracker; the remaining dormant kernels read
+# raw buffer windows inside the *gated* half of the tick, which this
+# backend's precompute/evaluate split does not thread through (enable them
+# via the serial drives instead).
+BACKTEST_STRATEGIES: frozenset[str] = frozenset(LIVE_STRATEGIES) | {
+    "inverse_price_tracker"
+}
+
+
+class TickPre(NamedTuple):
+    """One tick's context-free precompute — (S,)-scale leaves stacked to
+    (T, ...) by the vmap, then consumed tick-by-tick by the scan."""
+
+    fresh5: jnp.ndarray
+    fresh15: jnp.ndarray
+    filled5: jnp.ndarray
+    filled15: jnp.ndarray
+    pack5: object  # FeaturePack
+    pack15: object
+    feats15: object  # SymbolFeatureArrays (pre RS-vs-BTC rewrite)
+    lsp_score_ok: jnp.ndarray
+    lsp_trigger_score: jnp.ndarray
+    lsp_threshold: jnp.ndarray
+    lsp_volume_last: jnp.ndarray
+    btc_beta: jnp.ndarray
+    btc_corr: jnp.ndarray
+    btc_mom: jnp.ndarray
+    btc_change_96: jnp.ndarray
+
+
+def _window_views(
+    ext_times: jnp.ndarray,
+    ext_vals: jnp.ndarray,
+    counts: jnp.ndarray,  # (T, S)
+    filled0: jnp.ndarray,
+    window: int,
+) -> MarketBuffer:
+    """The right-aligned (S, W) rings the serial drive would hold at every
+    tick, stacked to (T, S, W(, F)): tick t's window is columns
+    ``[counts[t], counts[t]+window)`` of the extended arrays, gathered
+    per-symbol (each row has its own offset — symbols miss bars
+    independently).
+
+    Built OUTSIDE the vmapped precompute and pinned behind an
+    ``optimization_barrier``: XLA CPU otherwise fuses the gather into each
+    of the pack/strategy kernels' ~30 window reads and re-executes it per
+    consumer — the exact failure mode PR 5 measured at 7x on
+    dynamic-slice views. The barrier materializes ONE (T, S, W, F) buffer
+    that every consumer then reads. Returns a (T,)-leading MarketBuffer
+    pytree (vmap consumes it with in_axes=0)."""
+    T = counts.shape[0]
+    cols = counts[:, :, None] + jnp.arange(window, dtype=jnp.int32)[None, None, :]
+    times = jnp.take_along_axis(
+        jnp.broadcast_to(ext_times[None], (T,) + ext_times.shape), cols, axis=2
+    )
+    vals = jnp.take_along_axis(
+        jnp.broadcast_to(ext_vals[None], (T,) + ext_vals.shape),
+        cols[:, :, :, None],
+        axis=2,
+    )
+    times, vals = jax.lax.optimization_barrier((times, vals))
+    filled = jnp.minimum(filled0[None, :] + counts, window).astype(jnp.int32)
+    return MarketBuffer(times=times, values=vals, filled=filled)
+
+
+def _precompute_one(
+    buf5: MarketBuffer,
+    buf15: MarketBuffer,
+    inp: HostInputs,
+    sp,
+) -> TickPre:
+    """Everything the full tick computes that does NOT depend on the
+    market context or any cross-tick carry — the same expressions as
+    ``_tick_step_impl``'s full path, on one tick's gathered window views."""
+    from binquant_tpu.strategies.features import compute_feature_pack
+
+    fresh5 = fresh_mask(buf5, inp.timestamp5_s)
+    fresh15 = fresh_mask(buf15, inp.timestamp_s)
+    pack5 = compute_feature_pack(buf5)
+    pack15 = compute_feature_pack(buf15)
+    feats15 = compute_symbol_features(buf15, fresh15 & inp.tracked)
+
+    # LSP's heavy core stays per-tick (vmapped): its rolling means/extrema
+    # are cumsum/view-anchored, so an extended-series pass would differ by
+    # f32 ulps from the serial kernel — and it is cheap (~6 ms/tick at
+    # 256x120, vs ABP's ~140 ms, which IS shared — see abp_core_batch)
+    lsp_score_ok, lsp_score, lsp_thr, lsp_vol = lsp_core(
+        buf15, inp.oi_growth, sp.lsp
+    )
+
+    # --- BTC-relative block: expression-for-expression the full path's
+    # else-branch in _tick_step_impl
+    S = buf15.capacity
+    W = buf15.times.shape[1]
+    onehot_rows, btc_ok = _btc_row_mask(inp.btc_row, S)
+    close15 = buf15.values[:, :, Field.CLOSE]
+    rets = log_returns(close15)
+    btc_onehot = onehot_rows[:, None]
+    btc_rets_row = jnp.where(btc_onehot, rets, 0.0).sum(axis=0)
+    btc_close_row = jnp.where(btc_onehot, close15, 0.0).sum(axis=0)
+    btc_rets = jnp.where(btc_ok, btc_rets_row, jnp.nan)
+    bc = rolling_beta_corr(rets, btc_rets[None, :], window=BC_WINDOW)
+    btc_beta = jnp.where(jnp.isfinite(bc.beta[:, -1]), bc.beta[:, -1], 0.0)
+    btc_corr = jnp.where(jnp.isfinite(bc.corr[:, -1]), bc.corr[:, -1], 0.0)
+    btc_close = jnp.where(btc_ok, btc_close_row, jnp.nan)
+    if W > 96:
+        btc_change = _btc_change_96(btc_close[-1], btc_close[-97], btc_ok)
+    else:
+        btc_change = jnp.asarray(0.0, dtype=jnp.float32)
+    btc_mom = _btc_momentum_pair(btc_close[-1], btc_close[-2])
+
+    return TickPre(
+        fresh5=fresh5,
+        fresh15=fresh15,
+        filled5=buf5.filled,
+        filled15=buf15.filled,
+        pack5=pack5,
+        pack15=pack15,
+        feats15=feats15,
+        lsp_score_ok=lsp_score_ok,
+        lsp_trigger_score=lsp_score,
+        lsp_threshold=lsp_thr,
+        lsp_volume_last=lsp_vol,
+        btc_beta=btc_beta,
+        btc_corr=btc_corr,
+        btc_mom=btc_mom,
+        btc_change_96=btc_change,
+    )
+
+
+def _evaluate_tick(
+    pre: TickPre,
+    abp_pre: tuple,
+    inp: HostInputs,
+    regime_carry: RegimeCarry,
+    mrf_carry: jnp.ndarray,
+    pt_carry: jnp.ndarray,
+    cfg: ContextConfig,
+    wire_enabled: tuple[str, ...],
+    sp,
+):
+    """The gated half of the full tick from precomputed features: market
+    context (same ``compute_market_context``, symbol features injected),
+    the strategy gates, and the shared wire packing. Mirrors
+    ``_tick_step_impl``'s post-precompute structure line for line."""
+    S = pre.filled15.shape[0]
+    from binquant_tpu.engine.buffer import NUM_FIELDS
+
+    # compute_market_context with injected feats reads only capacity +
+    # filled from the buffer — a thin (S, 1) shell carries both
+    thin15 = MarketBuffer(
+        times=jnp.zeros((S, 1), jnp.int32),
+        values=jnp.zeros((S, 1, NUM_FIELDS), jnp.float32),
+        filled=pre.filled15,
+    )
+    context, regime_carry2 = compute_market_context(
+        thin15,
+        pre.fresh15,
+        inp.tracked,
+        inp.btc_row,
+        inp.timestamp_s,
+        regime_carry,
+        cfg,
+        feats=pre.feats15,
+    )
+
+    ok5 = pre.pack5.filled >= MIN_BARS
+    ok15 = pre.pack15.filled >= MIN_BARS
+    quiet_suppressed = quiet_suppression(context, inp.quiet_hours)
+    skipped = no_signal(S)
+
+    def want(name: str) -> bool:
+        return name in wire_enabled
+
+    abp_qualified, abp_score, abp_diag = abp_pre
+    abp = (
+        _mask_outputs(
+            _abp_outputs(
+                pre.filled5, context, abp_qualified, abp_score, abp_diag,
+                sp.abp,
+            ),
+            ok5 & pre.fresh5,
+        )
+        if want("activity_burst_pump")
+        else skipped
+    )
+    pt, pt_carry2 = price_tracker(
+        pre.pack5, context, quiet_suppressed, pt_carry, params=sp.pt
+    )
+    pt = _mask_outputs(pt, ok5 & pre.fresh5)
+    pt_carry2 = jnp.where(ok5 & pre.fresh5, pt_carry2, pt_carry)
+
+    if want("liquidation_sweep_pump"):
+        routed, short_ok, route, _ = _routing(
+            context, inp.adp_latest, inp.adp_prev, pre.btc_mom, sp.lsp
+        )
+        lsp = _mask_outputs(
+            _lsp_outputs(
+                pre.filled15, pre.lsp_score_ok, pre.lsp_trigger_score,
+                pre.lsp_threshold, routed, short_ok, route, inp.oi_growth,
+                inp.adp_latest, pre.btc_mom, pre.lsp_volume_last, sp.lsp,
+            ),
+            ok15 & pre.fresh15,
+        )
+    else:
+        lsp = skipped
+    mrf, mrf_carry2 = mean_reversion_fade(
+        pre.pack15, inp.is_futures, mrf_carry, sp.mrf
+    )
+    mrf = _mask_outputs(mrf, ok15 & pre.fresh15)
+    mrf_carry2 = jnp.where(ok15 & pre.fresh15, mrf_carry2, mrf_carry)
+    ladder = (
+        _mask_outputs(
+            ladder_deployer(
+                pre.pack15, context, inp.grid_policy_allows, inp.is_futures,
+                sp.ladder,
+            ),
+            ok15 & pre.fresh15,
+        )
+        if want("grid_ladder")
+        else skipped
+    )
+    ipt = (
+        _mask_outputs(inverse_price_tracker(pre.pack5, context), ok5 & pre.fresh5)
+        if want("inverse_price_tracker")
+        else skipped
+    )
+
+    strategies = {
+        "activity_burst_pump": abp,
+        "coinrule_price_tracker": pt,
+        "liquidation_sweep_pump": lsp,
+        "mean_reversion_fade": mrf,
+        "grid_ladder": ladder,
+        "coinrule_supertrend_swing_reversal": skipped,
+        "coinrule_twap_momentum_sniper": skipped,
+        "coinrule_buy_low_sell_high": skipped,
+        "coinrule_buy_the_dip": skipped,
+        "bb_extreme_reversion": skipped,
+        "inverse_price_tracker": ipt,
+        "range_bb_rsi_mean_reversion": skipped,
+        "range_failed_breakout_fade": skipped,
+        "relative_strength_reversal_range": skipped,
+    }
+    summary = build_summary(strategies)
+    wire = pack_wire(
+        context, strategies, summary, pre.pack5, pre.pack15,
+        pre.btc_beta, pre.btc_corr, pre.btc_change_96,
+        jnp.asarray(0.0, dtype=jnp.float32),  # full path: no dirty bc rows
+        wire_enabled,
+    )
+    enabled_mask = jnp.asarray(
+        [s in wire_enabled for s in STRATEGY_ORDER], dtype=bool
+    )
+    trig_counts = jnp.sum(
+        summary.trigger & enabled_mask[:, None], axis=1
+    ).astype(jnp.int32)
+    at_counts = jnp.sum(
+        summary.autotrade & summary.trigger & enabled_mask[:, None], axis=1
+    ).astype(jnp.int32)
+    return (regime_carry2, mrf_carry2, pt_carry2), wire, trig_counts, at_counts
+
+
+def _backtest_chunk_impl(
+    ext5: tuple[jnp.ndarray, jnp.ndarray],
+    ext15: tuple[jnp.ndarray, jnp.ndarray],
+    counts5: jnp.ndarray,  # (T, S) int32 — bars applied through tick t
+    counts15: jnp.ndarray,
+    filled0: tuple[jnp.ndarray, jnp.ndarray],  # (S,) per interval
+    carries: tuple[RegimeCarry, jnp.ndarray, jnp.ndarray],
+    inputs_seq: HostInputs,  # (T, ...) leaves
+    active: jnp.ndarray,  # (T,) bool
+    momentum_ok: jnp.ndarray,  # (T,) bool
+    policy_prev: tuple[jnp.ndarray, jnp.ndarray],
+    cfg: ContextConfig = ContextConfig(),
+    wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+    window: int = 400,
+    params=None,
+):
+    """T full-recompute ticks in one dispatch over the extended buffers.
+
+    Returns ``(carries', (valid, regime), wires (T, L), fired_count (T,),
+    (trig_counts, autotrade_counts) (T, N))``. Ticks whose fired count
+    exceeds ``WIRE_MAX_FIRED`` must be re-driven serially by the caller
+    (pre-chunk state stays the anchor — nothing here is donated).
+    """
+    from binquant_tpu.enums import MarketRegimeCode
+
+    sp = resolve_params(params)
+    unsupported = set(wire_enabled) - BACKTEST_STRATEGIES
+    assert not unsupported, (
+        f"backtest backend cannot evaluate {sorted(unsupported)} — "
+        "buffer-consuming dormant kernels run via the serial drives"
+    )
+    S = ext5[0].shape[0]
+    L = wire_length(S)
+    n_strat = len(STRATEGY_ORDER)
+    range_code = jnp.int32(int(MarketRegimeCode.RANGE))
+    trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
+
+    views5 = _window_views(*ext5, counts5, filled0[0], window)
+    views15 = _window_views(*ext15, counts15, filled0[1], window)
+    pre = jax.vmap(
+        lambda b5, b15, inp: _precompute_one(b5, b15, inp, sp)
+    )(views5, views15, inputs_seq)
+    # ABP's heavy core is position-local and sort-based, so the T
+    # overlapping per-tick tails collapse into ONE extended-series pass
+    # (bit-exact; the dominant precompute cost otherwise). Skipped at
+    # trace time when the strategy is disabled — its window guard must not
+    # fire for a wire set that never evaluates it.
+    if "activity_burst_pump" in wire_enabled:
+        abp_pre = abp_core_batch(ext5[1], counts5, window, sp.abp)
+    else:
+        T = counts5.shape[0]
+        zeros = jnp.zeros((T, S), jnp.float32)
+        abp_pre = (jnp.zeros((T, S), bool), zeros, {})
+
+    def body(carry, xs):
+        regime_c, mrf_c, pt_c, prev_valid, prev_regime = carry
+        pre_t, abp_t, inp, act, mok = xs
+        allow = (
+            mok
+            & prev_valid
+            & ((prev_regime == range_code) | (prev_regime == trans_code))
+        )
+        inp = inp._replace(grid_policy_allows=allow)
+
+        def live(op):
+            rc, mc, pc = op
+            (rc2, mc2, pc2), wire, tc, ac = _evaluate_tick(
+                pre_t, abp_t, inp, rc, mc, pc, cfg, wire_enabled, sp
+            )
+            return rc2, mc2, pc2, wire, tc, ac
+
+        def idle(op):
+            rc, mc, pc = op
+            return (
+                rc, mc, pc,
+                jnp.zeros((L,), jnp.float32),
+                jnp.zeros((n_strat,), jnp.int32),
+                jnp.zeros((n_strat,), jnp.int32),
+            )
+
+        rc2, mc2, pc2, wire, tc, ac = jax.lax.cond(
+            act, live, idle, (regime_c, mrf_c, pt_c)
+        )
+        valid = jnp.where(act, wire[0] > 0.5, prev_valid)
+        regime = jnp.where(act, wire[1].astype(jnp.int32), prev_regime)
+        return (rc2, mc2, pc2, valid, regime), (wire, tc, ac)
+
+    regime_c, mrf_c, pt_c = carries
+    (regime_c, mrf_c, pt_c, valid, regime), (wires, tcounts, acounts) = (
+        jax.lax.scan(
+            body,
+            (regime_c, mrf_c, pt_c, policy_prev[0], policy_prev[1]),
+            (pre, abp_pre, inputs_seq, active, momentum_ok),
+        )
+    )
+    return (
+        (regime_c, mrf_c, pt_c),
+        (valid, regime),
+        wires,
+        wires[:, WIRE_FIRED_COUNT_OFF],
+        (tcounts, acounts),
+    )
+
+
+backtest_chunk = partial(
+    jax.jit, static_argnames=("cfg", "wire_enabled", "window")
+)(_backtest_chunk_impl)
+
+
+@partial(jax.jit, static_argnames=("cfg", "wire_enabled", "window"))
+def backtest_chunk_sweep(
+    ext5,
+    ext15,
+    counts5,
+    counts15,
+    filled0,
+    carries,  # (P,)-batched leaves (RegimeCarry, mrf, pt)
+    inputs_seq,
+    active,
+    momentum_ok,
+    policy_prev,  # ((P,) bool, (P,) int32)
+    cfg: ContextConfig = ContextConfig(),
+    wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+    window: int = 400,
+    params=None,  # DynamicParams with (P,) float leaves on swept axes
+):
+    """One dispatch scoring P strategy-parameter combos over the chunk.
+
+    ``vmap`` over the params' dynamic (float) leaves + the per-combo scan
+    carries; buffers, packs, symbol features and every other
+    params-independent intermediate carries no batch dim and is computed
+    ONCE. Returns ``(carries', policy', fired_count (P, T), trig_counts
+    (P, T, N), autotrade_counts (P, T, N))`` — wires are deliberately NOT
+    returned (P × T × L would dominate memory; XLA dead-code-eliminates
+    the per-combo payload gathers this way).
+    """
+    dyn_leaves, treedef = jax.tree_util.tree_flatten(params)
+    axes = [0 if getattr(v, "ndim", 0) >= 1 else None for v in dyn_leaves]
+
+    def run_one(carries_one, policy_one, *leaves):
+        p = jax.tree_util.tree_unflatten(treedef, leaves)
+        carries2, policy2, _wires, fired, (tc, ac) = _backtest_chunk_impl(
+            ext5, ext15, counts5, counts15, filled0, carries_one,
+            inputs_seq, active, momentum_ok, policy_one,
+            cfg, wire_enabled, window, p,
+        )
+        return carries2, policy2, fired, tc, ac
+
+    return jax.vmap(run_one, in_axes=(0, 0, *axes))(
+        carries, policy_prev, *dyn_leaves
+    )

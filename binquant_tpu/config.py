@@ -198,6 +198,15 @@ class Config:
         return int(self._get("BQT_SCAN_CHUNK", "64") or "64")
 
     @cached_property
+    def backtest_chunk(self) -> int:
+        """Ticks per time-batched backtest dispatch (binquant_tpu/backtest).
+        Each chunk materializes (T, S, W, F) gathered window views on
+        device, so this is the backend's memory knob: halve it if a
+        production-shape backtest OOMs, raise it on HBM-rich silicon to
+        amortize dispatch further."""
+        return int(self._get("BQT_BACKTEST_CHUNK", "16") or "16")
+
+    @cached_property
     def carry_audit_every_ticks(self) -> int:
         """Drift audit cadence for the incremental path: every N processed
         ticks the engine dispatches a FULL recompute, which re-anchors the

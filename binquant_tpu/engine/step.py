@@ -449,12 +449,21 @@ def _ret_at(buf: MarketBuffer, pos: int) -> jnp.ndarray:
 
 
 def init_indicator_carry(
-    buf5: MarketBuffer, buf15: MarketBuffer, btc_row: jnp.ndarray | int = -1
+    buf5: MarketBuffer,
+    buf15: MarketBuffer,
+    btc_row: jnp.ndarray | int = -1,
+    params=None,
 ) -> IndicatorCarry:
     """Carry rebuilt from both windows (what every full tick emits).
     ``btc_row`` seeds the beta/corr pair sums; -1 (tests/bench seeding
     without a BTC row) leaves them empty — readouts then report 0, the
-    full kernel's no-BTC fill."""
+    full kernel's no-BTC fill. ``params`` (StrategyParams) feeds the
+    ABP/LSP carry seeds; None = the baked defaults (carry leaf SHAPES
+    always come from the static int fields, so a float-only override
+    changes values, never shapes)."""
+    from binquant_tpu.strategies.params import resolve_params
+
+    sp = resolve_params(params)
     S = buf15.capacity
     close15 = buf15.values[:, :, Field.CLOSE]
     rets = log_returns(close15)
@@ -467,8 +476,8 @@ def init_indicator_carry(
     return IndicatorCarry(
         pack5=init_feature_carry(buf5),
         pack15=init_feature_carry(buf15),
-        abp5=abp_init_from_window(buf5),
-        lsp15=lsp_init_from_window(buf15),
+        abp5=abp_init_from_window(buf5, sp.abp),
+        lsp15=lsp_init_from_window(buf15, sp.lsp),
         # the strategy's dropna'd-frame seed: the series starts MIN_BARS-1
         # rows past each lane's first available bar (dormant.py)
         st5=supertrend_init(
@@ -505,6 +514,7 @@ def advance_indicator_carry(
     buf15: MarketBuffer,
     carry: IndicatorCarry,
     btc_row: jnp.ndarray,
+    params=None,
 ) -> tuple[IndicatorCarry, jnp.ndarray, jnp.ndarray]:
     """One-bar advance of EVERY carried family under the shared clean-append
     masks (``features.carry_advance_masks``). Returns
@@ -515,7 +525,9 @@ def advance_indicator_carry(
         advance_feature_carry,
         carry_advance_masks,
     )
+    from binquant_tpu.strategies.params import resolve_params
 
+    sp = resolve_params(params)
     assert buf15.times.shape[1] >= MIN_INCR_ENGINE_WINDOW, (
         f"window {buf15.times.shape[1]} too short for the engine-level "
         f"incremental advance (need >= {MIN_INCR_ENGINE_WINDOW})"
@@ -527,8 +539,8 @@ def advance_indicator_carry(
     pack15, _ = advance_feature_carry(
         buf15, carry.pack15, masks=(adv15, stale15)
     )
-    abp5 = abp_advance_one_bar(buf5, carry.abp5, adv5)
-    lsp15 = lsp_advance_one_bar(buf15, carry.lsp15, adv15)
+    abp5 = abp_advance_one_bar(buf5, carry.abp5, adv5, sp.abp)
+    lsp15 = lsp_advance_one_bar(buf15, carry.lsp15, adv15, sp.lsp)
 
     # supertrend: a lane's series starts once MIN_BARS of history exist —
     # exactly when the dropna'd-frame seed reaches the newest bar
@@ -581,6 +593,195 @@ def _mask_outputs(out: StrategyOutputs, ok: jnp.ndarray) -> StrategyOutputs:
     )
 
 
+def quiet_suppression(context: MarketContext, quiet_hours) -> jnp.ndarray:
+    """Quiet-hours suppression with the strong-stable-trend override judged
+    against the context computed THIS tick (reference semantics:
+    time_of_day_filter.py:60-76 reads the live context; an invalid context
+    always suppresses inside the window). Constants shared with the host
+    filter so the oracle A/B and the device can never diverge. One copy for
+    the per-tick step and the backtest backend's evaluate stage."""
+    from binquant_tpu.regime.time_filter import (
+        MIN_TRANSITION_STRENGTH,
+        OVERRIDE_REGIMES,
+    )
+
+    strong_trend = jnp.zeros((), dtype=bool)
+    for code in sorted(OVERRIDE_REGIMES):
+        strong_trend = strong_trend | (context.market_regime == code)
+    trend_override = (
+        context.valid
+        & strong_trend
+        & (context.market_regime_transition_strength >= MIN_TRANSITION_STRENGTH)
+    )
+    return quiet_hours & ~trend_override
+
+
+def build_summary(strategies: dict[str, StrategyOutputs]) -> TriggerSummary:
+    """Stack every strategy's verdicts in STRATEGY_ORDER — the packed
+    (N, S) summary both the per-tick step and the backtest backend compact
+    onto the wire."""
+    ordered = [strategies[name] for name in STRATEGY_ORDER]
+    return TriggerSummary(
+        trigger=jnp.stack([so.trigger for so in ordered]),
+        autotrade=jnp.stack([so.autotrade for so in ordered]),
+        direction=jnp.stack([so.direction for so in ordered]),
+        score=jnp.stack([so.score for so in ordered]),
+        stop_loss_pct=jnp.stack([so.stop_loss_pct for so in ordered]),
+    )
+
+
+def pack_wire(
+    context: MarketContext,
+    strategies: dict[str, StrategyOutputs],
+    summary: TriggerSummary,
+    pack5,
+    pack15,
+    btc_beta: jnp.ndarray,
+    btc_corr: jnp.ndarray,
+    btc_change_96: jnp.ndarray,
+    bc_dirty_rows: jnp.ndarray,
+    wire_enabled: tuple[str, ...],
+) -> jnp.ndarray:
+    """Pack one tick's evaluation into the single wire array: context
+    scalars + device-side fired compaction + per-slot emission payload +
+    the (3, S) calibration block. Extracted from the tick step so the
+    backtest backend emits the EXACT stacked wire format the standard
+    decode path (io/emission.py via unpack_wire) already consumes.
+    Records the per-``wire_enabled`` emission layout as a tracing side
+    effect, exactly as the inline block did."""
+    S = summary.trigger.shape[1]
+    scalar_values = {
+        "valid": context.valid,
+        "market_regime": context.market_regime,
+        "previous_market_regime": context.previous_market_regime,
+        "market_regime_transition": context.market_regime_transition,
+        "market_regime_transition_strength": context.market_regime_transition_strength,
+        "regime_is_transitioning": context.regime_is_transitioning,
+        "market_stress_score": context.market_stress_score,
+        "advancers_ratio": context.advancers_ratio,
+        "long_tailwind": context.long_tailwind,
+        "short_tailwind": context.short_tailwind,
+        "fresh_count": context.fresh_count,
+        "average_return": context.average_return,
+        "long_regime_score": context.long_regime_score,
+        "short_regime_score": context.short_regime_score,
+        "range_regime_score": context.range_regime_score,
+        "stress_regime_score": context.stress_regime_score,
+        "btc_regime_score": context.btc_regime_score,
+        "btc_price_change_96": btc_change_96,
+        "bc_dirty_rows": bc_dirty_rows,
+    }
+    ts32 = context.timestamp.astype(jnp.int32)
+    ss32 = context.regime_stable_since.astype(jnp.int32)
+    scalars = jnp.stack(
+        [scalar_values[k].astype(jnp.float32) for k in WIRE_SCALARS_A]
+        + [scalar_values[k].astype(jnp.float32) for k in WIRE_SCALARS_B]
+        + [
+            (ts32 // _WIRE_TS_BASE).astype(jnp.float32),
+            (ts32 % _WIRE_TS_BASE).astype(jnp.float32),
+            (ss32 // _WIRE_TS_BASE).astype(jnp.float32),
+            (ss32 % _WIRE_TS_BASE).astype(jnp.float32),
+        ]
+    )
+
+    # device-side compaction of fired (strategy, row) pairs — restricted to
+    # the enabled (emitting) strategies so dormant triggers neither consume
+    # compaction slots nor trip the overflow fallback (the host only
+    # materializes enabled strategies anyway)
+    K = WIRE_MAX_FIRED
+    enabled_mask = jnp.asarray(
+        [s in wire_enabled for s in STRATEGY_ORDER], dtype=bool
+    )
+    flat_trig = (summary.trigger & enabled_mask[:, None]).reshape(-1)  # (N*S,)
+    n_fired = jnp.sum(flat_trig).astype(jnp.float32)
+    (idx,) = jnp.nonzero(flat_trig, size=K, fill_value=-1)
+    valid_idx = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    si = safe // S
+    row = safe % S
+    gather = lambda arr: arr.reshape(-1)[safe].astype(jnp.float32)
+    fired_block = jnp.stack(
+        [
+            jnp.where(valid_idx, si.astype(jnp.float32), -1.0),
+            jnp.where(valid_idx, row.astype(jnp.float32), -1.0),
+            jnp.where(valid_idx, gather(summary.autotrade), 0.0),
+            jnp.where(valid_idx, gather(summary.direction), 0.0),
+            jnp.where(valid_idx, gather(summary.score), 0.0),
+            jnp.where(valid_idx, gather(summary.stop_loss_pct), 0.0),
+        ]
+    )  # (6, K)
+
+    # --- per-slot emission payload: gather, for each fired slot, the
+    # pack/micro features and the firing strategy's diagnostics so the
+    # host emits signals with ZERO further device fetches
+    layout: dict[str, list[tuple[str, str]]] = {}
+    diag_mats = []
+    for name in STRATEGY_ORDER:
+        entries: list[tuple[str, str]] = []
+        diag_rows = []
+        for key, arr in strategies[name].diagnostics.items():
+            if arr.ndim == 0:
+                arr = jnp.broadcast_to(arr, (S,))
+            kind = (
+                "b"
+                if arr.dtype == jnp.bool_
+                else "i"
+                if jnp.issubdtype(arr.dtype, jnp.integer)
+                else "f"
+            )
+            entries.append((key, kind))
+            diag_rows.append(arr.astype(jnp.float32))
+        assert len(entries) <= EMISSION_DIAG_WIDTH, (name, len(entries))
+        diag_rows += [jnp.zeros((S,), jnp.float32)] * (
+            EMISSION_DIAG_WIDTH - len(diag_rows)
+        )
+        layout[name] = entries
+        diag_mats.append(jnp.stack(diag_rows))
+    EMISSION_LAYOUTS[wire_enabled] = layout
+    diag_all = jnp.stack(diag_mats)  # (N, D, S)
+    base_feats = jnp.stack(
+        [
+            pack5.close, pack5.volume, pack5.bb_upper, pack5.bb_mid,
+            pack5.bb_lower,
+            pack15.close, pack15.volume, pack15.bb_upper, pack15.bb_mid,
+            pack15.bb_lower,
+            context.features.micro_regime.astype(jnp.float32),
+            context.features.micro_transition.astype(jnp.float32),
+            btc_beta.astype(jnp.float32),
+            btc_corr.astype(jnp.float32),
+        ]
+    )  # (len(EMISSION_BASE_FIELDS), S)
+    slot_base = base_feats[:, row].T  # (K, len(EMISSION_BASE_FIELDS))
+    slot_diag = diag_all[si, :, row]  # (K, D)
+    slot_payload = jnp.where(
+        valid_idx[:, None],
+        jnp.concatenate([slot_base, slot_diag], axis=1),
+        0.0,
+    )  # (K, EMISSION_SLOT_WIDTH)
+
+    # per-symbol calibration rows: the leverage calibrator consumes these
+    # once per 15m bucket — riding the wire keeps that path free of device
+    # fetches too (round 2's calibrate_all pulled five arrays per bucket,
+    # ~0.6 s of blocking round trips through a tunneled chip)
+    calib_block = jnp.stack(
+        [
+            context.features.valid.astype(jnp.float32),
+            context.features.close.astype(jnp.float32),
+            context.features.atr_pct.astype(jnp.float32),
+        ]
+    )  # (3, S)
+
+    return jnp.concatenate(
+        [
+            scalars,
+            n_fired[None],
+            fired_block.reshape(-1),
+            slot_payload.reshape(-1),
+            calib_block.reshape(-1),
+        ]
+    )
+
+
 def _tick_step_impl(
     state: EngineState,
     upd5: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
@@ -591,6 +792,7 @@ def _tick_step_impl(
     compute_all: bool = True,
     incremental: bool = False,
     maintain_carry: bool = True,
+    params=None,
 ) -> tuple[EngineState, TickOutputs]:
     """One tick: apply candle updates, rebuild context, evaluate everything.
 
@@ -620,7 +822,19 @@ def _tick_step_impl(
     tick for dead state XLA cannot DCE (the carry rides the returned
     EngineState). Never pass False on a tick whose carry a later
     incremental tick will consume.
+
+    ``params`` is an optional :class:`strategies.params.StrategyParams`
+    pytree. None (the live engine) leaves every kernel on its baked
+    Python-float defaults — the traced graph is unchanged, so the default
+    wire is bit-identical (tests/test_backtest.py pins this). An explicit
+    pytree threads traced thresholds through the live-five kernels AND the
+    carry init/advance (float-only overrides are consistent across resyncs;
+    the structural int fields must stay at defaults — they size carry
+    leaves).
     """
+    from binquant_tpu.strategies.params import resolve_params
+
+    sp = resolve_params(params)
     buf5 = apply_updates(state.buf5, *upd5)
     buf15 = apply_updates(state.buf15, *upd15)
 
@@ -634,7 +848,7 @@ def _tick_step_impl(
         from binquant_tpu.strategies.features import feature_pack_from_carry
 
         indicator_carry, stale5, stale15 = advance_indicator_carry(
-            buf5, buf15, state.indicator_carry, inputs.btc_row
+            buf5, buf15, state.indicator_carry, inputs.btc_row, params
         )
         pack5 = feature_pack_from_carry(buf5, indicator_carry.pack5, stale5)
         pack15 = feature_pack_from_carry(buf15, indicator_carry.pack15, stale15)
@@ -650,7 +864,7 @@ def _tick_step_impl(
         # the resync every fallback/audit tick provides for free; skipped
         # (passthrough) when the caller will never consume it
         indicator_carry = (
-            init_indicator_carry(buf5, buf15, inputs.btc_row)
+            init_indicator_carry(buf5, buf15, inputs.btc_row, params)
             if maintain_carry
             else state.indicator_carry
         )
@@ -730,25 +944,7 @@ def _tick_step_impl(
     ok5 = pack5.filled >= MIN_BARS
     ok15 = pack15.filled >= MIN_BARS
 
-    # Quiet-hours suppression with the strong-stable-trend override judged
-    # against the context computed THIS tick (reference semantics:
-    # time_of_day_filter.py:60-76 reads the live context; an invalid
-    # context always suppresses inside the window). Constants shared with
-    # the host filter so the oracle A/B and the device can never diverge.
-    from binquant_tpu.regime.time_filter import (
-        MIN_TRANSITION_STRENGTH,
-        OVERRIDE_REGIMES,
-    )
-
-    strong_trend = jnp.zeros((), dtype=bool)
-    for code in sorted(OVERRIDE_REGIMES):
-        strong_trend = strong_trend | (context.market_regime == code)
-    trend_override = (
-        context.valid
-        & strong_trend
-        & (context.market_regime_transition_strength >= MIN_TRANSITION_STRENGTH)
-    )
-    quiet_suppressed = inputs.quiet_hours & ~trend_override
+    quiet_suppressed = quiet_suppression(context, inputs.quiet_hours)
 
     from binquant_tpu.strategies.base import no_signal
 
@@ -763,10 +959,10 @@ def _tick_step_impl(
     abp = (
         _mask_outputs(
             activity_burst_pump_from_carry(
-                buf5, indicator_carry.abp5, context, stale5
+                buf5, indicator_carry.abp5, context, stale5, sp.abp
             )
             if incremental
-            else activity_burst_pump(buf5, context),
+            else activity_burst_pump(buf5, context, sp.abp),
             ok5 & fresh5,
         )
         if want("activity_burst_pump")
@@ -775,7 +971,8 @@ def _tick_step_impl(
     # PriceTracker/MeanReversionFade own device carries (cooldown/dedupe)
     # and therefore always run — see docstring.
     pt, pt_carry = price_tracker(
-        pack5, context, quiet_suppressed, state.pt_last_signal_close
+        pack5, context, quiet_suppressed, state.pt_last_signal_close,
+        params=sp.pt,
     )
     pt = _mask_outputs(pt, ok5 & fresh5)
     pt_carry = jnp.where(ok5 & fresh5, pt_carry, state.pt_last_signal_close)
@@ -792,6 +989,7 @@ def _tick_step_impl(
                 inputs.adp_prev,
                 btc_mom,
                 stale15,
+                sp.lsp,
             )
             if incremental
             else liquidation_sweep_pump(
@@ -801,6 +999,7 @@ def _tick_step_impl(
                 inputs.adp_latest,
                 inputs.adp_prev,
                 btc_mom,
+                sp.lsp,
             ),
             ok15 & fresh15,
         )
@@ -808,14 +1007,15 @@ def _tick_step_impl(
         else skipped
     )
     mrf, mrf_carry = mean_reversion_fade(
-        pack15, inputs.is_futures, state.mrf_last_emitted
+        pack15, inputs.is_futures, state.mrf_last_emitted, sp.mrf
     )
     mrf = _mask_outputs(mrf, ok15 & fresh15)
     mrf_carry = jnp.where(ok15 & fresh15, mrf_carry, state.mrf_last_emitted)
     ladder = (
         _mask_outputs(
             ladder_deployer(
-                pack15, context, inputs.grid_policy_allows, inputs.is_futures
+                pack15, context, inputs.grid_policy_allows, inputs.is_futures,
+                sp.ladder,
             ),
             ok15 & fresh15,
         )
@@ -939,147 +1139,15 @@ def _tick_step_impl(
         "range_failed_breakout_fade": rfbf,
         "relative_strength_reversal_range": rsr,
     }
-    ordered = [strategies[name] for name in STRATEGY_ORDER]
-    summary = TriggerSummary(
-        trigger=jnp.stack([so.trigger for so in ordered]),
-        autotrade=jnp.stack([so.autotrade for so in ordered]),
-        direction=jnp.stack([so.direction for so in ordered]),
-        score=jnp.stack([so.score for so in ordered]),
-        stop_loss_pct=jnp.stack([so.stop_loss_pct for so in ordered]),
-    )
+    summary = build_summary(strategies)
 
     # --- wire: pack the summary + every host-consumed context scalar into
     # ONE array so the per-tick D2H is a single transfer (SURVEY §7 "keep
-    # the trigger-extraction D2H tiny").
-    scalar_values = {
-        "valid": context.valid,
-        "market_regime": context.market_regime,
-        "previous_market_regime": context.previous_market_regime,
-        "market_regime_transition": context.market_regime_transition,
-        "market_regime_transition_strength": context.market_regime_transition_strength,
-        "regime_is_transitioning": context.regime_is_transitioning,
-        "market_stress_score": context.market_stress_score,
-        "advancers_ratio": context.advancers_ratio,
-        "long_tailwind": context.long_tailwind,
-        "short_tailwind": context.short_tailwind,
-        "fresh_count": context.fresh_count,
-        "average_return": context.average_return,
-        "long_regime_score": context.long_regime_score,
-        "short_regime_score": context.short_regime_score,
-        "range_regime_score": context.range_regime_score,
-        "stress_regime_score": context.stress_regime_score,
-        "btc_regime_score": context.btc_regime_score,
-        "btc_price_change_96": btc_change_96,
-        "bc_dirty_rows": bc_dirty_rows,
-    }
-    ts32 = context.timestamp.astype(jnp.int32)
-    ss32 = context.regime_stable_since.astype(jnp.int32)
-    scalars = jnp.stack(
-        [scalar_values[k].astype(jnp.float32) for k in WIRE_SCALARS_A]
-        + [scalar_values[k].astype(jnp.float32) for k in WIRE_SCALARS_B]
-        + [
-            (ts32 // _WIRE_TS_BASE).astype(jnp.float32),
-            (ts32 % _WIRE_TS_BASE).astype(jnp.float32),
-            (ss32 // _WIRE_TS_BASE).astype(jnp.float32),
-            (ss32 % _WIRE_TS_BASE).astype(jnp.float32),
-        ]
-    )
-
-    # device-side compaction of fired (strategy, row) pairs — restricted to
-    # the enabled (emitting) strategies so dormant triggers neither consume
-    # compaction slots nor trip the overflow fallback (the host only
-    # materializes enabled strategies anyway)
-    K = WIRE_MAX_FIRED
-    enabled_mask = jnp.asarray(
-        [s in wire_enabled for s in STRATEGY_ORDER], dtype=bool
-    )
-    flat_trig = (summary.trigger & enabled_mask[:, None]).reshape(-1)  # (N*S,)
-    n_fired = jnp.sum(flat_trig).astype(jnp.float32)
-    (idx,) = jnp.nonzero(flat_trig, size=K, fill_value=-1)
-    valid_idx = idx >= 0
-    safe = jnp.maximum(idx, 0)
-    si = safe // S
-    row = safe % S
-    gather = lambda arr: arr.reshape(-1)[safe].astype(jnp.float32)
-    fired_block = jnp.stack(
-        [
-            jnp.where(valid_idx, si.astype(jnp.float32), -1.0),
-            jnp.where(valid_idx, row.astype(jnp.float32), -1.0),
-            jnp.where(valid_idx, gather(summary.autotrade), 0.0),
-            jnp.where(valid_idx, gather(summary.direction), 0.0),
-            jnp.where(valid_idx, gather(summary.score), 0.0),
-            jnp.where(valid_idx, gather(summary.stop_loss_pct), 0.0),
-        ]
-    )  # (6, K)
-
-    # --- per-slot emission payload: gather, for each fired slot, the
-    # pack/micro features and the firing strategy's diagnostics so the
-    # host emits signals with ZERO further device fetches
-    layout: dict[str, list[tuple[str, str]]] = {}
-    diag_mats = []
-    for name in STRATEGY_ORDER:
-        entries: list[tuple[str, str]] = []
-        diag_rows = []
-        for key, arr in strategies[name].diagnostics.items():
-            if arr.ndim == 0:
-                arr = jnp.broadcast_to(arr, (S,))
-            kind = (
-                "b"
-                if arr.dtype == jnp.bool_
-                else "i"
-                if jnp.issubdtype(arr.dtype, jnp.integer)
-                else "f"
-            )
-            entries.append((key, kind))
-            diag_rows.append(arr.astype(jnp.float32))
-        assert len(entries) <= EMISSION_DIAG_WIDTH, (name, len(entries))
-        diag_rows += [jnp.zeros((S,), jnp.float32)] * (
-            EMISSION_DIAG_WIDTH - len(diag_rows)
-        )
-        layout[name] = entries
-        diag_mats.append(jnp.stack(diag_rows))
-    EMISSION_LAYOUTS[wire_enabled] = layout
-    diag_all = jnp.stack(diag_mats)  # (N, D, S)
-    base_feats = jnp.stack(
-        [
-            pack5.close, pack5.volume, pack5.bb_upper, pack5.bb_mid,
-            pack5.bb_lower,
-            pack15.close, pack15.volume, pack15.bb_upper, pack15.bb_mid,
-            pack15.bb_lower,
-            context.features.micro_regime.astype(jnp.float32),
-            context.features.micro_transition.astype(jnp.float32),
-            btc_beta.astype(jnp.float32),
-            btc_corr.astype(jnp.float32),
-        ]
-    )  # (len(EMISSION_BASE_FIELDS), S)
-    slot_base = base_feats[:, row].T  # (K, len(EMISSION_BASE_FIELDS))
-    slot_diag = diag_all[si, :, row]  # (K, D)
-    slot_payload = jnp.where(
-        valid_idx[:, None],
-        jnp.concatenate([slot_base, slot_diag], axis=1),
-        0.0,
-    )  # (K, EMISSION_SLOT_WIDTH)
-
-    # per-symbol calibration rows: the leverage calibrator consumes these
-    # once per 15m bucket — riding the wire keeps that path free of device
-    # fetches too (round 2's calibrate_all pulled five arrays per bucket,
-    # ~0.6 s of blocking round trips through a tunneled chip)
-    calib_block = jnp.stack(
-        [
-            context.features.valid.astype(jnp.float32),
-            context.features.close.astype(jnp.float32),
-            context.features.atr_pct.astype(jnp.float32),
-        ]
-    )  # (3, S)
-
-    wire = jnp.concatenate(
-        [
-            scalars,
-            n_fired[None],
-            fired_block.reshape(-1),
-            slot_payload.reshape(-1),
-            calib_block.reshape(-1),
-        ]
+    # the trigger-extraction D2H tiny"). One copy of the packing shared
+    # with the backtest backend (pack_wire above).
+    wire = pack_wire(
+        context, strategies, summary, pack5, pack15,
+        btc_beta, btc_corr, btc_change_96, bc_dirty_rows, wire_enabled,
     )
 
     outputs = TickOutputs(
@@ -1117,6 +1185,7 @@ def _tick_step_wire_impl(
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
     incremental: bool = False,
     maintain_carry: bool = True,
+    params=None,
 ) -> tuple[EngineState, jnp.ndarray]:
     """The live engine's step: identical evaluation, but only the wire
     leaves the computation. The full ``TickOutputs`` pytree is ~400 output
@@ -1140,6 +1209,7 @@ def _tick_step_wire_impl(
         compute_all=False,
         incremental=incremental,
         maintain_carry=maintain_carry,
+        params=params,
     )
     return new_state, outputs.wire
 
@@ -1210,6 +1280,7 @@ def _fold_and_step_wire(
     wire_enabled: tuple[str, ...],
     incremental: bool,
     maintain_carry: bool,
+    params=None,
 ) -> tuple[EngineState, jnp.ndarray]:
     """One replayed tick inside the scan: fold all but the final update
     sub-batch slot (mirroring ``SignalEngine._fold_updates`` — on the
@@ -1228,7 +1299,7 @@ def _fold_and_step_wire(
         buf15 = apply_updates(state.buf15, *u15)
         if incremental:
             carry, _, _ = advance_indicator_carry(
-                buf5, buf15, state.indicator_carry, inputs.btc_row
+                buf5, buf15, state.indicator_carry, inputs.btc_row, params
             )
         else:
             carry = state.indicator_carry
@@ -1244,6 +1315,7 @@ def _fold_and_step_wire(
         wire_enabled,
         incremental=incremental,
         maintain_carry=maintain_carry,
+        params=params,
     )
 
 
@@ -1259,6 +1331,7 @@ def _tick_step_scan_impl(
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
     incremental: bool = True,
     maintain_carry: bool = True,
+    params=None,
 ) -> tuple[EngineState, jnp.ndarray, jnp.ndarray]:
     """T replayed ticks fused into ONE dispatch (ISSUE 5 tentpole).
 
@@ -1314,7 +1387,7 @@ def _tick_step_scan_impl(
         def live(operand):
             return _fold_and_step_wire(
                 operand, u5_slots, u15_slots, inp, cfg, wire_enabled,
-                incremental, maintain_carry,
+                incremental, maintain_carry, params,
             )
 
         def idle(operand):

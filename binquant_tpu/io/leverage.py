@@ -117,6 +117,34 @@ class LeverageCalibrator:
         )
         return np.where(capped, 1, regime_leverage).astype(np.int64)
 
+    @staticmethod
+    def _row_models(
+        registry: SymbolRegistry | object, all_symbols: list[SymbolModel]
+    ) -> tuple[np.ndarray, list[SymbolModel]]:
+        """(rows, models) pairs resolved in ONE pass over the symbol list
+        instead of a per-valid-row name_of + rows_by_id walk every bucket —
+        O(len(all_symbols)) Python, zero when the list is empty (replay /
+        bench engines). Accepts a live :class:`SymbolRegistry` or the
+        engine's FrozenRows snapshot (both expose the row↔name mapping)."""
+        if not all_symbols:
+            return np.empty(0, np.int64), []
+        if hasattr(registry, "row_of"):
+            lookup = registry.row_of
+        else:  # FrozenRows
+            mapping = {
+                name: row
+                for row, name in registry._row_to_name.items()  # type: ignore[attr-defined]
+            }
+            lookup = mapping.get
+        rows: list[int] = []
+        models: list[SymbolModel] = []
+        for row_model in all_symbols:
+            row = lookup(row_model.id)
+            if row is not None and int(row) >= 0:
+                rows.append(int(row))
+                models.append(row_model)
+        return np.asarray(rows, np.int64), models
+
     def calibrate_all(
         self,
         context: MarketContext | CalibrationInputs,
@@ -130,8 +158,15 @@ class LeverageCalibrator:
         ``MarketContext`` (tests / direct use — fetched here). Safe to run
         off the tick thread against a :class:`FrozenRows` snapshot — the
         engine schedules it as a background worker so a bucket-boundary
-        tick costs the same as any other."""
-        rows_by_id = {row.id: row for row in all_symbols}
+        tick costs the same as any other.
+
+        The diff itself is vectorized: targets come from
+        :meth:`target_leverage_batch` and the no-change verdict from one
+        numpy comparison, so the Python loop below walks only rows whose
+        leverage actually CHANGES (the PUTs). Replay/bench engines with an
+        empty symbol list — every bucket on compressed clocks — now cost
+        ~zero instead of an O(S) per-row walk stealing a core from the
+        tick thread."""
         applied = no_change = skipped = 0
 
         if isinstance(context, CalibrationInputs):
@@ -156,22 +191,30 @@ class LeverageCalibrator:
             float(stress),
             float(confidence),
         )
-        for row_idx in np.nonzero(valid)[0]:
-            symbol = registry.name_of(int(row_idx))
-            if symbol is None:
-                skipped += 1
-                continue
-            row = rows_by_id.get(symbol)
-            if row is None:
-                skipped += 1
-                continue
+        valid = np.asarray(valid, bool)
+        model_rows, model_refs = self._row_models(registry, all_symbols)
+        in_range = model_rows < valid.shape[0]
+        model_rows = model_rows[in_range]
+        model_refs = [m for m, ok in zip(model_refs, in_range) if ok]
+        model_of: dict[int, SymbolModel] = dict(zip(model_rows.tolist(), model_refs))
+        covered = np.zeros(valid.shape, bool)
+        covered[model_rows] = True
+        # float dtype: SymbolModel.futures_leverage is a float field — an
+        # int array would truncate 2.5 -> 2 and misreport it as no_change
+        # against an integer target, skipping the correcting PUT forever
+        current = np.full(valid.shape, -1.0, np.float64)
+        if len(model_rows):
+            current[model_rows] = [m.futures_leverage for m in model_refs]
+        skipped += int(np.count_nonzero(valid & ~covered))
+        no_change += int(np.count_nonzero(valid & covered & (targets == current)))
+        # only genuinely-changing rows reach Python (the PUT loop) — a
+        # steady-state or symbol-less (replay/bench) bucket walks nothing
+        for row_idx in np.nonzero(valid & covered & (targets != current))[0]:
+            row = model_of[int(row_idx)]
             target = int(targets[row_idx])
-            if target == row.futures_leverage:
-                no_change += 1
-                continue
             try:
                 self.binbot_api.edit_symbol(
-                    symbol,
+                    row.id,
                     exchange_id=self.exchange,
                     futures_leverage=target,
                 )
@@ -179,7 +222,9 @@ class LeverageCalibrator:
                 applied += 1
             except Exception:
                 logging.exception(
-                    "[LeverageCalibrator] failed to update %s -> %s", symbol, target
+                    "[LeverageCalibrator] failed to update %s -> %s",
+                    row.id,
+                    target,
                 )
                 skipped += 1
 

@@ -533,6 +533,27 @@ class SignalEngine:
         self.scanned_ticks = 0
         self.scan_chunks = 0
         self.scan_overflow_reruns = 0
+        # -- time-batched backtest backend (binquant_tpu/backtest, ISSUE 6)
+        # Full-recompute chunks over (S, W+T) extended buffers; requires
+        # BQT_INCREMENTAL=0 engines. Chunk bounds the (T, S, W, F) gathered
+        # window views' memory — the knob to drop on small boxes.
+        # floor at _SCAN_MIN_TICKS: a smaller chunk would make every plan
+        # fail the too-short check and silently degrade to the fully
+        # serial path (backtest_chunks=0 with no error pointing at it)
+        self.backtest_chunk = max(
+            int(getattr(config, "backtest_chunk", 16) or 16),
+            self._SCAN_MIN_TICKS,
+        )
+        self.backtest_ticks = 0
+        self.backtest_chunks = 0
+        self.backtest_overflow_reruns = 0
+        # Explicit StrategyParams override (None = the kernels' baked
+        # defaults, the live graph). Set by the backtest driver when a run
+        # carries non-default params so the SERIAL re-entries (cold start,
+        # rewrites, overflow re-drives) evaluate with the SAME thresholds
+        # as the batched chunks — a custom-params run must never silently
+        # mix two parameter sets.
+        self.strategy_params = None
 
     # -- ingest -------------------------------------------------------------
 
@@ -1013,6 +1034,18 @@ class SignalEngine:
             fired_all.extend(await self._flush_scan_plan(plan))
         return fired_all
 
+    async def process_ticks_backtest(
+        self, ticks, params=None, chunk=None
+    ) -> list:
+        """Drive replayed ticks through the time-batched backtest backend
+        (full-recompute semantics over (S, W+T) extended buffers; see
+        binquant_tpu/backtest). Same contract as process_ticks_scanned."""
+        from binquant_tpu.backtest.driver import drive_ticks_backtest
+
+        return await drive_ticks_backtest(
+            self, ticks, params=params, chunk=chunk
+        )
+
     def _begin_scan_plan(self) -> dict:
         """Plan-start snapshots: enough host state to re-judge the run's
         ticks serially (overflow re-runs, too-short runs). The DEVICE
@@ -1145,63 +1178,7 @@ class SignalEngine:
                     pad_updates(*b, size=u15_rows)
                 )
 
-        from binquant_tpu.engine.step import HostInputs
-
-        nan_oi = np.full((S,), np.nan, dtype=np.float32)
-        no_rows = np.zeros((S,), np.bool_)
-        inputs_seq = HostInputs(
-            tracked=np.stack(
-                [p.tracked for p in ticks] + [no_rows] * (tb - T)
-            ),
-            btc_row=self._stack_scalar(
-                [p.btc_row for p in ticks], tb, np.int32, -1
-            ),
-            timestamp_s=self._stack_scalar(
-                [p.ts15 for p in ticks], tb, np.int32, 0
-            ),
-            timestamp5_s=self._stack_scalar(
-                [p.ts5 for p in ticks], tb, np.int32, 0
-            ),
-            oi_growth=np.stack(
-                [p.oi if p.oi is not None else nan_oi for p in ticks]
-                + [nan_oi] * (tb - T)
-            ),
-            adp_latest=self._stack_scalar(
-                [p.adp[0] for p in ticks], tb, np.float32, np.nan
-            ),
-            adp_prev=self._stack_scalar(
-                [p.adp[1] for p in ticks], tb, np.float32, np.nan
-            ),
-            adp_diff=self._stack_scalar(
-                [p.adp[2] for p in ticks], tb, np.float32, np.nan
-            ),
-            adp_diff_prev=self._stack_scalar(
-                [p.adp[3] for p in ticks], tb, np.float32, np.nan
-            ),
-            breadth_momentum_points=self._stack_scalar(
-                [p.adp[4] for p in ticks], tb, np.float32, np.nan
-            ),
-            quiet_hours=self._stack_scalar(
-                [p.quiet for p in ticks], tb, np.bool_, False
-            ),
-            # recomputed device-side per tick from the scan's policy carry
-            grid_policy_allows=np.zeros((tb,), np.bool_),
-            is_futures=self._stack_scalar(
-                [p.is_futures for p in ticks], tb, np.bool_, False
-            ),
-            dominance_is_losers=self._stack_scalar(
-                [p.dominance_is_losers for p in ticks], tb, np.bool_, False
-            ),
-            market_domination_reversal=self._stack_scalar(
-                [p.market_domination_reversal for p in ticks],
-                tb, np.bool_, False,
-            ),
-        )
-        active = np.zeros((tb,), np.bool_)
-        active[:T] = True
-        momentum_seq = self._stack_scalar(
-            [p.momentum_ok for p in ticks], tb, np.bool_, False
-        )
+        inputs_seq, active, momentum_seq = self._stack_plan_inputs(ticks, tb)
         policy_prev = (
             np.bool_(self._last_regime is not None),
             np.int32(-1 if self._last_regime is None else self._last_regime),
@@ -1284,6 +1261,74 @@ class SignalEngine:
             SCANNED_TICKS.inc()
         self.touch_heartbeat()
         return fired_all
+
+    def _stack_plan_inputs(self, ticks: list, tb: int):
+        """Stacked (tb, ...) HostInputs + active/momentum vectors from a
+        list of _ScanTickPlan — the ONE copy of the per-tick host-input
+        stacking both multi-tick backends share (the scanned lax.scan
+        chunks and the time-batched backtest chunks).
+        ``grid_policy_allows`` is zeroed: both backends recompute it
+        device-side per tick from their policy carry."""
+        from binquant_tpu.engine.step import HostInputs
+
+        T = len(ticks)
+        S = self.capacity
+        nan_oi = np.full((S,), np.nan, dtype=np.float32)
+        no_rows = np.zeros((S,), np.bool_)
+        inputs_seq = HostInputs(
+            tracked=np.stack(
+                [p.tracked for p in ticks] + [no_rows] * (tb - T)
+            ),
+            btc_row=self._stack_scalar(
+                [p.btc_row for p in ticks], tb, np.int32, -1
+            ),
+            timestamp_s=self._stack_scalar(
+                [p.ts15 for p in ticks], tb, np.int32, 0
+            ),
+            timestamp5_s=self._stack_scalar(
+                [p.ts5 for p in ticks], tb, np.int32, 0
+            ),
+            oi_growth=np.stack(
+                [p.oi if p.oi is not None else nan_oi for p in ticks]
+                + [nan_oi] * (tb - T)
+            ),
+            adp_latest=self._stack_scalar(
+                [p.adp[0] for p in ticks], tb, np.float32, np.nan
+            ),
+            adp_prev=self._stack_scalar(
+                [p.adp[1] for p in ticks], tb, np.float32, np.nan
+            ),
+            adp_diff=self._stack_scalar(
+                [p.adp[2] for p in ticks], tb, np.float32, np.nan
+            ),
+            adp_diff_prev=self._stack_scalar(
+                [p.adp[3] for p in ticks], tb, np.float32, np.nan
+            ),
+            breadth_momentum_points=self._stack_scalar(
+                [p.adp[4] for p in ticks], tb, np.float32, np.nan
+            ),
+            quiet_hours=self._stack_scalar(
+                [p.quiet for p in ticks], tb, np.bool_, False
+            ),
+            # recomputed device-side per tick from the policy carry
+            grid_policy_allows=np.zeros((tb,), np.bool_),
+            is_futures=self._stack_scalar(
+                [p.is_futures for p in ticks], tb, np.bool_, False
+            ),
+            dominance_is_losers=self._stack_scalar(
+                [p.dominance_is_losers for p in ticks], tb, np.bool_, False
+            ),
+            market_domination_reversal=self._stack_scalar(
+                [p.market_domination_reversal for p in ticks],
+                tb, np.bool_, False,
+            ),
+        )
+        active = np.zeros((tb,), np.bool_)
+        active[:T] = True
+        momentum_seq = self._stack_scalar(
+            [p.momentum_ok for p in ticks], tb, np.bool_, False
+        )
+        return inputs_seq, active, momentum_seq
 
     @staticmethod
     def _stack_scalar(values: list, tb: int, dtype, fill) -> np.ndarray:
@@ -1478,6 +1523,14 @@ class SignalEngine:
         )
         trace.record_span("inputs_build", t_inputs0)
         donate = self._use_donated_step()
+        # explicit params override (backtest drives) — None stays the
+        # baked-constant live graph
+        if self.strategy_params is None:
+            sp_arg = None
+        else:
+            from binquant_tpu.strategies.params import dynamic_params
+
+            sp_arg = dynamic_params(self.strategy_params)
         with self.latency.stage("device_dispatch"), trace.span(
             "device_dispatch", incremental=use_incremental, donated=donate
         ), trace.activate():
@@ -1522,6 +1575,7 @@ class SignalEngine:
                         # classic-path deployments (BQT_INCREMENTAL=0) never
                         # read the carry — skip its full-window re-init
                         maintain_carry=self.incremental,
+                        params=sp_arg,
                     )
             except BaseException:
                 if donate:
@@ -1565,8 +1619,10 @@ class SignalEngine:
             # the post state (_use_donated_step).
             empty = self._empty_updates()
 
-            def fallback(_args=(small, inputs, cfg, key, incr_args, empty)):
-                small_, inp, cfg_, key_, (incr_, maint_), emp = _args
+            def fallback(
+                _args=(small, inputs, cfg, key, incr_args, empty, sp_arg)
+            ):
+                small_, inp, cfg_, key_, (incr_, maint_), emp, sp_ = _args
                 st = self.state._replace(
                     regime_carry=small_[0],
                     mrf_last_emitted=small_[1],
@@ -1575,7 +1631,7 @@ class SignalEngine:
                 )
                 _, full = tick_step(
                     st, emp, emp, inp, cfg_, wire_enabled=key_,
-                    incremental=incr_, maintain_carry=maint_,
+                    incremental=incr_, maintain_carry=maint_, params=sp_,
                 )
                 return full
 
@@ -1586,11 +1642,14 @@ class SignalEngine:
             # at production shape) in device memory until this tick
             # finalizes — one extra state copy per in-flight tick.
 
-            def fallback(_args=(prev_state, u5, u15, inputs, cfg, key, incr_args)):
-                st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = _args
+            def fallback(
+                _args=(prev_state, u5, u15, inputs, cfg, key, incr_args,
+                       sp_arg)
+            ):
+                st, upd5, upd15, inp, cfg_, key_, (incr_, maint_), sp_ = _args
                 _, full = tick_step(
                     st, upd5, upd15, inp, cfg_, wire_enabled=key_,
-                    incremental=incr_, maintain_carry=maint_,
+                    incremental=incr_, maintain_carry=maint_, params=sp_,
                 )
                 return full
 
@@ -1621,12 +1680,12 @@ class SignalEngine:
             else:
                 warm_args = (prev_state, u5, u15, inputs, cfg, key, incr_args)
 
-            def _warm(args=warm_args):
+            def _warm(args=warm_args, sp_=sp_arg):
                 try:
                     st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = args
                     tick_step(
                         st, upd5, upd15, inp, cfg_, wire_enabled=key_,
-                        incremental=incr_, maintain_carry=maint_,
+                        incremental=incr_, maintain_carry=maint_, params=sp_,
                     )
                 except Exception:
                     logging.exception("fallback pre-warm failed (non-fatal)")

@@ -73,6 +73,7 @@ def make_stub_engine(
     donate: bool | None = None,
     carry_audit_every: int | None = None,
     scan_chunk: int | None = None,
+    backtest_chunk: int | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -108,6 +109,8 @@ def make_stub_engine(
         config.__dict__["carry_audit_every_ticks"] = int(carry_audit_every)
     if scan_chunk is not None:
         config.__dict__["scan_chunk"] = int(scan_chunk)
+    if backtest_chunk is not None:
+        config.__dict__["backtest_chunk"] = int(backtest_chunk)
     binbot_api = BinbotApi("http://stub", session=StubSession(breadth=breadth))
 
     sent: list[str] = []

@@ -194,19 +194,21 @@ def _abp_last_bar(
 
 
 def _abp_outputs(
-    buf5: MarketBuffer,
+    filled: jnp.ndarray,
     context: MarketContext,
     qualified: jnp.ndarray,
     score_last: jnp.ndarray,
     diag: dict[str, jnp.ndarray],
     p: ABPParams,
 ) -> StrategyOutputs:
-    """Trigger gating + output assembly shared by both paths (the layout —
+    """Trigger gating + output assembly shared by ALL paths — full tail,
+    carry twins, and the backtest backend's precompute/evaluate split,
+    which is why it takes ``filled`` rather than a buffer (the layout —
     keys, order, dtypes — must be identical: the wire's emission layout is
     recorded once per wire_enabled combo regardless of the path traced)."""
     fired = qualified
     # data sufficiency: len(df) >= lookback+1 (l.164)
-    fired = fired & (buf5.filled >= p.lookback_window + 1)
+    fired = fired & (filled >= p.lookback_window + 1)
 
     # context gate (l.175-179): valid context + denied long -> suppress;
     # valid + allowed -> autotrade; no context -> emit, autotrade off.
@@ -216,7 +218,7 @@ def _abp_outputs(
     autotrade = fired & has_context & gate
     route = jnp.where(has_context, ROUTE_ALLOWED, ROUTE_UNAVAILABLE)
 
-    S = buf5.capacity
+    S = filled.shape[0]
     return StrategyOutputs(
         trigger=fired,
         direction=jnp.zeros((S,), dtype=jnp.int32),  # long-only
@@ -230,11 +232,15 @@ def _abp_outputs(
     )
 
 
-def activity_burst_pump(
+def abp_core(
     buf5: MarketBuffer,
-    context: MarketContext,
     params: ABPParams = ABPParams(),
-) -> StrategyOutputs:
+) -> tuple[jnp.ndarray, jnp.ndarray, dict[str, jnp.ndarray]]:
+    """The kernel's context-free heavy half: the full-tail windowed math up
+    to the cooldown-gated ``qualified`` mask. Returns ``(qualified,
+    score_last, diagnostics)`` for :func:`_abp_outputs` to gate — split out
+    so the backtest backend can time-vectorize this half over a chunk of
+    ticks while the context gate rides its sequential scan."""
     p = params
     volume = buf5.values[:, -TAIL:, Field.VOLUME]
     quote_volume = buf5.values[:, -TAIL:, Field.QUOTE_VOLUME]
@@ -312,9 +318,7 @@ def activity_burst_pump(
     # 3-bar cooldown: any raw signal in the previous cooldown_bars bars
     qualified = raw[:, -1] & ~jnp.any(raw[:, :-1], axis=-1)
 
-    return _abp_outputs(
-        buf5,
-        context,
+    return (
         qualified,
         score[:, -1],
         {
@@ -327,8 +331,191 @@ def activity_burst_pump(
             "score_threshold": threshold_filled[:, -1],
             "volume": volume[:, -1],
         },
-        p,
     )
+
+
+def activity_burst_pump(
+    buf5: MarketBuffer,
+    context: MarketContext,
+    params: ABPParams = ABPParams(),
+) -> StrategyOutputs:
+    qualified, score_last, diag = abp_core(buf5, params)
+    return _abp_outputs(buf5.filled, context, qualified, score_last, diag, params)
+
+
+# The extended-series twin needs every consumed rolling window to fit
+# inside the ring without touching its left edge (where the per-tick view
+# and the extended series differ — the view truncates, the extension holds
+# older real bars): threshold at the earliest cooldown position reads
+# scores score_lookback back, each score reads the shifted baseline
+# window's oldest volume another bw+2 back.
+def _abp_ext_min_window(p: ABPParams) -> int:
+    return p.score_lookback + _baseline_window(p) + 2 + p.cooldown_bars + 1
+
+
+ABP_EXT_MIN_WINDOW = _abp_ext_min_window(ABPParams())
+
+
+def abp_core_batch(
+    ext_vals: jnp.ndarray,  # (S, L, F) extended series (ring + appends)
+    counts: jnp.ndarray,  # (T, S) int32 — bars applied through tick t
+    window: int,  # ring width W (tick t's view = columns [counts_t, +W))
+    params: ABPParams = ABPParams(),
+) -> tuple[jnp.ndarray, jnp.ndarray, dict[str, jnp.ndarray]]:
+    """T ticks of :func:`abp_core` from ONE pass over the extended series.
+
+    Every rolling input of the kernel is position-local and sort/shift
+    based (medians, quantiles, shifts — no cumsum anchoring), so a value
+    computed at extended position ``p`` is bit-identical to the per-tick
+    view's value at the matching position whenever the consumed windows
+    stay inside the ring (guarded below): the T heavily-overlapping
+    per-tick tails collapse into one series pass + (T, S) gathers. The one
+    per-tick (non-positional) input is ``has_qav`` — a whole-window any —
+    which becomes a rolling any; the score/threshold/raw series are
+    computed for BOTH qav variants and selected per (tick, row) at
+    readout, exactly reproducing the kernel's row-wide formula switch.
+
+    Returns ``(qualified (T, S), score_last (T, S), diag of (T, S))`` —
+    the stacked twins of :func:`abp_core`'s outputs.
+    """
+    p = params
+    assert window >= _abp_ext_min_window(p), (
+        f"window {window} too short for the extended-series ABP core "
+        f"(need >= {_abp_ext_min_window(p)})"
+    )
+    S, L, _ = ext_vals.shape
+    n_ext = L - window
+    # trailing working slice: the union of every tick's consumed tail
+    K = min(L, TAIL + n_ext)
+    off = L - K
+    col = lambda f: ext_vals[:, off:, int(f)]
+    volume = col(Field.VOLUME)
+    quote_volume = col(Field.QUOTE_VOLUME)
+    close = col(Field.CLOSE)
+    open_ = col(Field.OPEN)
+    high = col(Field.HIGH)
+    low = col(Field.LOW)
+
+    bw = _baseline_window(p)
+    minb = p.min_baseline_volume
+    baseline_safe = jnp.maximum(
+        rolling_median(shift(volume, 2), bw, min_periods=bw), minb
+    )
+    volume_ratio = volume / baseline_safe
+    q_baseline_safe = jnp.maximum(
+        rolling_median(shift(quote_volume, 2), bw, min_periods=bw), minb
+    )
+    quote_ratio_q = quote_volume / q_baseline_safe
+
+    prev_close = jnp.maximum(shift(close, 1), minb)
+    candle_range = jnp.maximum(high - low, minb)
+    body = jnp.abs(close - open_)
+    price_jump = (close - shift(close, 1)) / prev_close
+    range_frac = candle_range / jnp.maximum(close, minb)
+    body_frac = body / candle_range
+    close_to_high = (high - close) / candle_range
+    is_bullish = close > open_
+    up_close = (close > shift(close, 1)).astype(jnp.float32)
+    recent_up = up_close + shift(up_close, 1, 0.0) + shift(up_close, 2, 0.0)
+
+    score_q = (
+        volume_ratio * quote_ratio_q * jnp.maximum(price_jump, 0.0)
+        * (1.0 + body_frac)
+    )
+    score_n = volume_ratio * jnp.maximum(price_jump, 0.0)
+
+    vol_spike = volume > p.volume_multiplier * baseline_safe
+    quote_spike_q = quote_volume > p.quote_volume_multiplier * q_baseline_safe
+    jump_flag = price_jump > p.price_threshold
+    range_flag = range_frac > p.min_range_frac
+    body_flag = (
+        is_bullish
+        & (body_frac > p.min_body_frac)
+        & (close_to_high < p.max_close_to_high)
+    )
+    trend_q = recent_up >= p.min_recent_up_closes
+    trend_n = recent_up >= 1
+
+    # thresholds at the union of every tick's cooldown positions
+    n_out = min(n_ext + p.cooldown_bars + 1, K)
+    thr_q = rolling_quantile_tail_auto(
+        shift(score_q, 1), p.score_lookback, p.score_quantile,
+        num_out=n_out, min_periods=p.lookback_window,
+    )
+    thr_n = rolling_quantile_tail_auto(
+        shift(score_n, 1), p.score_lookback, p.score_quantile,
+        num_out=n_out, min_periods=p.lookback_window,
+    )
+    thr_q_f = jnp.where(jnp.isfinite(thr_q), thr_q, 0.0)
+    thr_n_f = jnp.where(jnp.isfinite(thr_n), thr_n, 0.0)
+    tail_n = lambda a: a[:, -n_out:]
+    base_flags = (
+        tail_n(vol_spike) & tail_n(jump_flag) & tail_n(range_flag)
+        & tail_n(body_flag)
+    )
+    raw_q = (
+        base_flags
+        & tail_n(quote_spike_q)
+        & tail_n(trend_q)
+        & jnp.isfinite(tail_n(score_q))
+        & (tail_n(score_q) >= thr_q_f)
+    )
+    raw_n = (
+        base_flags
+        & tail_n(trend_n)
+        & jnp.isfinite(tail_n(score_n))
+        & (tail_n(score_n) >= thr_n_f)
+    )
+
+    # per-tick has_qav: the kernel's whole-view any over the last
+    # min(W, TAIL) columns, as a rolling any over the full extension
+    TW = min(window, TAIL)
+    qpos = (ext_vals[:, :, int(Field.QUOTE_VOLUME)] > 0).astype(jnp.float32)
+    from binquant_tpu.ops.rolling import rolling_max
+
+    any_q = rolling_max(qpos, TW, min_periods=1) > 0  # (S, L)
+
+    # (T, S) gathers at each tick's last-view position
+    T = counts.shape[0]
+    last_idx = counts + jnp.int32(window - 1)  # absolute extended position
+
+    def g_abs(arr: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+        """arr (S, N) gathered at per-(tick,row) absolute positions minus
+        the array's leading offset -> (T, S)."""
+        rel = idx - (L - arr.shape[1])
+        return jnp.take_along_axis(
+            jnp.broadcast_to(arr[None], (T,) + arr.shape),
+            rel[:, :, None],
+            axis=2,
+        )[..., 0]
+
+    has_qav = g_abs(any_q, last_idx)
+    sel = lambda a_q, a_n: jnp.where(has_qav, g_abs(a_q, last_idx),
+                                     g_abs(a_n, last_idx))
+
+    raws = []
+    for k in range(p.cooldown_bars + 1):
+        rq = g_abs(raw_q, last_idx - k)
+        rn = g_abs(raw_n, last_idx - k)
+        raws.append(jnp.where(has_qav, rq, rn))
+    qualified = raws[0]
+    for r in raws[1:]:
+        qualified = qualified & ~r
+
+    score_last = sel(score_q, score_n)
+    diag = {
+        "baseline_volume": g_abs(baseline_safe, last_idx),
+        "volume_ratio": g_abs(volume_ratio, last_idx),
+        "quote_volume_ratio": jnp.where(
+            has_qav, g_abs(quote_ratio_q, last_idx), 1.0
+        ),
+        "price_jump": g_abs(price_jump, last_idx),
+        "range_frac": g_abs(range_frac, last_idx),
+        "body_frac": g_abs(body_frac, last_idx),
+        "score_threshold": sel(thr_q_f, thr_n_f),
+        "volume": g_abs(volume, last_idx),
+    }
+    return qualified, score_last, diag
 
 
 # ---------------------------------------------------------------------------
@@ -558,7 +745,7 @@ def activity_burst_pump_from_carry(
         last.raw & ~jnp.any(carry.raw_ring[:, :-1], axis=-1) & ~stale & ~carry.dirty
     )
     return _abp_outputs(
-        buf5,
+        buf5.filled,
         context,
         qualified,
         last.score,

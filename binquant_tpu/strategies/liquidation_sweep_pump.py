@@ -64,6 +64,8 @@ class LSPParams(NamedTuple):
     score_window: int = 48
     score_quantile: float = 0.80
     min_oi_growth: float = 1.02
+    # routing's market-stress veto (l.92; was a literal in _routing)
+    max_stress: float = 0.35
 
 
 # score series needs rel_volume back score_window+1 bars, each needing
@@ -89,7 +91,7 @@ def _routing(
     Returns (routed, short_ok, route, has_context)."""
     feats = context.features
     has_context = context.valid
-    stress_ok = context.market_stress_score < 0.35
+    stress_ok = context.market_stress_score < p.max_stress
     has_breadth_pair = jnp.isfinite(adp_prev)
     falling = has_breadth_pair & (adp_latest < adp_prev)
     increasing = has_breadth_pair & (adp_latest > adp_prev)
@@ -158,7 +160,7 @@ def _oi_factor(oi_growth: jnp.ndarray) -> jnp.ndarray:
 
 
 def _lsp_outputs(
-    buf15: MarketBuffer,
+    filled: jnp.ndarray,
     score_ok: jnp.ndarray,
     trigger_score: jnp.ndarray,
     threshold: jnp.ndarray,
@@ -172,13 +174,15 @@ def _lsp_outputs(
     p: LSPParams,
 ) -> StrategyOutputs:
     """Shared output assembly (keys/order/dtypes identical across paths —
-    the wire's emission layout is recorded once per wire_enabled combo)."""
+    the wire's emission layout is recorded once per wire_enabled combo).
+    Takes ``filled`` rather than a buffer so the backtest backend's
+    sequential half can gate precomputed cores without window views."""
     # OI confirmation (l.184-185)
     oi_ok = ~jnp.isfinite(oi_growth) | (oi_growth >= p.min_oi_growth)
-    fired = score_ok & oi_ok & routed & (buf15.filled > 0)
+    fired = score_ok & oi_ok & routed & (filled > 0)
     direction = jnp.where(short_ok, Direction.SHORT, Direction.LONG).astype(jnp.int32)
 
-    S = buf15.capacity
+    S = filled.shape[0]
     return StrategyOutputs(
         trigger=fired,
         direction=direction,
@@ -197,15 +201,16 @@ def _lsp_outputs(
     )
 
 
-def liquidation_sweep_pump(
+def lsp_core(
     buf15: MarketBuffer,
-    context: MarketContext,
-    oi_growth: jnp.ndarray,  # (S,) f32, NaN = unavailable (KuCoin OI cache)
-    adp_latest: jnp.ndarray,  # scalar f32 — resolved ADP (breadth or context)
-    adp_prev: jnp.ndarray,  # scalar f32, NaN = no history
-    btc_momentum: jnp.ndarray,  # scalar f32 — BTC close pct_change last bar
+    oi_growth: jnp.ndarray,
     params: LSPParams = LSPParams(),
-) -> StrategyOutputs:
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The kernel's context-free heavy half: pump-score pipeline + the
+    48-bar quantile trigger (OI factor applied; OI rides HostInputs, not
+    the context). Returns ``(score_ok, trigger_score, threshold,
+    volume_last)`` for the routing/gating half — split out so the backtest
+    backend can time-vectorize this over a chunk of ticks."""
     p = params
     wh = p.window_hours
     volume = buf15.values[:, -TAIL:, Field.VOLUME]
@@ -245,13 +250,28 @@ def liquidation_sweep_pump(
         & (cnt > 0)
         & (trigger_score >= threshold)
     )
+    return score_ok, trigger_score, threshold, volume[:, -1]
 
+
+def liquidation_sweep_pump(
+    buf15: MarketBuffer,
+    context: MarketContext,
+    oi_growth: jnp.ndarray,  # (S,) f32, NaN = unavailable (KuCoin OI cache)
+    adp_latest: jnp.ndarray,  # scalar f32 — resolved ADP (breadth or context)
+    adp_prev: jnp.ndarray,  # scalar f32, NaN = no history
+    btc_momentum: jnp.ndarray,  # scalar f32 — BTC close pct_change last bar
+    params: LSPParams = LSPParams(),
+) -> StrategyOutputs:
+    p = params
+    score_ok, trigger_score, threshold, volume_last = lsp_core(
+        buf15, oi_growth, p
+    )
     routed, short_ok, route, _ = _routing(
         context, adp_latest, adp_prev, btc_momentum, p
     )
     return _lsp_outputs(
-        buf15, score_ok, trigger_score, threshold, routed, short_ok, route,
-        oi_growth, adp_latest, btc_momentum, volume[:, -1], p,
+        buf15.filled, score_ok, trigger_score, threshold, routed, short_ok,
+        route, oi_growth, adp_latest, btc_momentum, volume_last, p,
     )
 
 
@@ -425,7 +445,7 @@ def liquidation_sweep_pump_from_carry(
         context, adp_latest, adp_prev, btc_momentum, p
     )
     return _lsp_outputs(
-        buf15, score_ok, trigger_score, threshold, routed, short_ok, route,
-        oi_growth, adp_latest, btc_momentum,
+        buf15.filled, score_ok, trigger_score, threshold, routed, short_ok,
+        route, oi_growth, adp_latest, btc_momentum,
         buf15.values[:, -1, Field.VOLUME], p,
     )

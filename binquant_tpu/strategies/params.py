@@ -1,0 +1,210 @@
+"""Strategy-parameter pytree: every tunable threshold in one place.
+
+The live strategy kernels historically baked their thresholds as Python
+constants (each strategy's ``*Params`` NamedTuple default). This module
+aggregates those per-strategy tuples into ONE :class:`StrategyParams`
+pytree that threads through ``engine/step.py`` and the backtest backend:
+
+* ``None`` (the live engine's default) leaves every kernel on its baked
+  Python-float constants — the traced graph is unchanged, so the live
+  wire step stays bit-identical (pinned by
+  tests/test_backtest.py::test_params_default_bit_parity);
+* an explicit pytree turns the float leaves into traced device scalars —
+  the enabling change for the vmapped parameter sweeps: a ``(P,)``-leaved
+  grid plus ``param_axes`` evaluates P strategy variants in one dispatch
+  (``binquant_tpu/backtest/kernel.py``).
+
+**Sweepable vs structural**: float leaves may be swept (vmapped); int and
+bool leaves are STRUCTURAL — they size rolling windows, rings and carry
+shapes, so they stay static Python values and cannot ride a grid axis
+(``make_param_grid`` rejects them).
+"""
+
+from __future__ import annotations
+
+from itertools import product
+from typing import NamedTuple, Sequence
+
+import jax
+import numpy as np
+
+from binquant_tpu.strategies.activity_burst_pump import ABPParams
+from binquant_tpu.strategies.ladder_deployer import LadderParams
+from binquant_tpu.strategies.liquidation_sweep_pump import LSPParams
+from binquant_tpu.strategies.mean_reversion_fade import MRFParams
+from binquant_tpu.strategies.price_tracker import PTParams
+
+
+class StrategyParams(NamedTuple):
+    """The live dispatch set's tunables, one sub-tuple per strategy.
+
+    Defaults ARE the reference's class constants — evaluating at the
+    default pytree must reproduce the constant-folded kernels exactly.
+    """
+
+    abp: ABPParams = ABPParams()
+    pt: PTParams = PTParams()
+    lsp: LSPParams = LSPParams()
+    mrf: MRFParams = MRFParams()
+    ladder: LadderParams = LadderParams()
+
+
+def default_strategy_params() -> StrategyParams:
+    return StrategyParams()
+
+
+def _is_static_leaf(value) -> bool:
+    """int/bool leaves are STRUCTURAL (window lengths, ring sizes, enable
+    flags) — they steer Python control flow and array shapes inside the
+    kernels, so they must never become tracers."""
+    return isinstance(value, (bool, int)) and not isinstance(value, float)
+
+
+@jax.tree_util.register_pytree_node_class
+class DynamicParams:
+    """jit/vmap-safe carrier for an explicit :class:`StrategyParams`.
+
+    Flattens the float leaves as pytree children (traced scalars — or
+    ``(P,)`` grid axes under vmap) while the int/bool leaves ride the
+    treedef as static aux data, hashable into the jit cache key. Passing a
+    raw ``StrategyParams`` through ``jax.jit`` would trace ``int`` fields
+    like ``lookback_window`` and crash the kernels' static window
+    arithmetic — wrap with :func:`dynamic_params` instead.
+    """
+
+    __slots__ = ("tree",)
+
+    def __init__(self, tree: StrategyParams) -> None:
+        self.tree = tree
+
+    def tree_flatten(self):
+        leaves, treedef = jax.tree_util.tree_flatten(self.tree)
+        statics = tuple(_is_static_leaf(v) for v in leaves)
+        dyn = [v for v, s in zip(leaves, statics) if not s]
+        aux = (
+            treedef,
+            statics,
+            tuple(v if s else None for v, s in zip(leaves, statics)),
+        )
+        return dyn, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, dyn):
+        treedef, statics, static_vals = aux
+        it = iter(dyn)
+        leaves = [
+            static_vals[i] if statics[i] else next(it)
+            for i in range(len(statics))
+        ]
+        return cls(jax.tree_util.tree_unflatten(treedef, leaves))
+
+
+def dynamic_params(params: StrategyParams) -> DynamicParams:
+    """Wrap an explicit params pytree for a jit boundary (see
+    :class:`DynamicParams`)."""
+    return DynamicParams(params)
+
+
+def resolve_params(params) -> StrategyParams:
+    """The kernels' unwrap: None → baked defaults, DynamicParams → its
+    tree, a raw StrategyParams passes through (non-jit callers)."""
+    if params is None:
+        return StrategyParams()
+    if isinstance(params, DynamicParams):
+        return params.tree
+    return params
+
+
+def _leaf_path_items(params: StrategyParams):
+    """Yield ("strategy.field", sub_name, field_name, value) per leaf of
+    the two-level params pytree (ScorerWeights nests one level deeper and
+    is addressed as e.g. ``pt.weights.context_weight``)."""
+    for sub_name, sub in params._asdict().items():
+        for field, value in sub._asdict().items():
+            if hasattr(value, "_asdict"):  # nested NamedTuple (weights)
+                for f2, v2 in value._asdict().items():
+                    yield f"{sub_name}.{field}.{f2}", sub_name, (field, f2), v2
+            else:
+                yield f"{sub_name}.{field}", sub_name, (field,), value
+
+
+def sweepable_axes(params: StrategyParams | None = None) -> list[str]:
+    """Dotted names of every float leaf (the legal grid axes)."""
+    params = params or StrategyParams()
+    return [
+        path
+        for path, _, _, value in _leaf_path_items(params)
+        if isinstance(value, float)
+    ]
+
+
+def _set_leaf(params: StrategyParams, sub: str, fields: tuple, value):
+    sub_tuple = getattr(params, sub)
+    if len(fields) == 1:
+        sub_tuple = sub_tuple._replace(**{fields[0]: value})
+    else:
+        inner = getattr(sub_tuple, fields[0])._replace(**{fields[1]: value})
+        sub_tuple = sub_tuple._replace(**{fields[0]: inner})
+    return params._replace(**{sub: sub_tuple})
+
+
+def make_param_grid(
+    axes: dict[str, Sequence[float]],
+    base: StrategyParams | None = None,
+) -> tuple[StrategyParams, list[dict[str, float]]]:
+    """Cartesian-product parameter grid as one batched pytree.
+
+    ``axes`` maps dotted float-leaf names (see :func:`sweepable_axes`) to
+    value sequences. Returns ``(params, combos)`` where the swept leaves
+    of ``params`` are ``(P,)`` float32 arrays (P = product of axis
+    lengths), every other leaf keeps its static Python value, and
+    ``combos[i]`` names combo i's axis values (the sweep report's label
+    row). Feed ``params`` + :func:`param_axes` to ``jax.vmap``.
+    """
+    base = base or StrategyParams()
+    legal = set(sweepable_axes(base))
+    by_path = {path: (s, f) for path, s, f, _ in _leaf_path_items(base)}
+    for name in axes:
+        if name not in by_path:
+            raise KeyError(f"unknown param axis {name!r}")
+        if name not in legal:
+            raise ValueError(
+                f"param axis {name!r} is structural (int/bool) — only float "
+                "leaves can be swept"
+            )
+    names = list(axes)
+    grids = [np.asarray(axes[n], dtype=np.float32) for n in names]
+    combos_nd = list(product(*[range(len(g)) for g in grids]))
+    params = base
+    for j, (name, grid) in enumerate(zip(names, grids)):
+        sub, fields = by_path[name]
+        col = np.asarray([grid[idx[j]] for idx in combos_nd], dtype=np.float32)
+        params = _set_leaf(params, sub, fields, col)
+    combos = [
+        {name: float(grids[j][idx[j]]) for j, name in enumerate(names)}
+        for idx in combos_nd
+    ]
+    return params, combos
+
+
+def param_axes(params: StrategyParams):
+    """The matching ``jax.vmap`` in_axes pytree: 0 for batched ``(P,)``
+    leaves, None for static scalars."""
+    return jax.tree_util.tree_map(
+        lambda leaf: 0 if (hasattr(leaf, "ndim") and leaf.ndim >= 1) else None,
+        params,
+    )
+
+
+def grid_size(params: StrategyParams) -> int:
+    """P of a batched grid (1 for an unbatched params pytree)."""
+    sizes = {
+        leaf.shape[0]
+        for leaf in jax.tree_util.tree_leaves(params)
+        if hasattr(leaf, "ndim") and getattr(leaf, "ndim", 0) >= 1
+    }
+    if not sizes:
+        return 1
+    if len(sizes) > 1:
+        raise ValueError(f"inconsistent grid axis lengths: {sorted(sizes)}")
+    return int(sizes.pop())

@@ -43,11 +43,15 @@ ROUTE_QUIET_HOURS = 10
 
 
 class PTParams(NamedTuple):
-    """Class constants (l.33-36, 119) + scorer weights (l.54-58)."""
+    """Class constants (l.33-36, 119) + scorer weights (l.54-58) + the
+    entry thresholds (l.186, 190-195 — were literals in the kernel)."""
 
     entry_cooldown_bars: int = 12
     min_rs_vs_btc: float = 0.005
     stress_threshold: float = 0.3  # min(autotrade_stress_threshold, 0.3)
+    rsi_oversold: float = 30.0  # entry: RSI(14) < this
+    mfi_oversold: float = 20.0  # entry: MFI(14) < this
+    macd_entry_max: float = 0.0  # entry: MACD line < this
     weights: ScorerWeights = ScorerWeights(
         context_weight=0.35, risk_weight=0.35, support_weight=0.2
     )
@@ -75,12 +79,16 @@ def price_tracker(
     # data sufficiency: >=30 bars and recent values present (l.166-173)
     enough = (f.filled >= 30) & jnp.isfinite(f.rsi) & jnp.isfinite(f.macd) & jnp.isfinite(f.mfi)
 
-    entry = (f.rsi < 30.0) & (f.macd < 0.0) & (f.mfi < 20.0)
+    entry = (
+        (f.rsi < p.rsi_oversold)
+        & (f.macd < p.macd_entry_max)
+        & (f.mfi < p.mfi_oversold)
+    )
 
     local_score = (
         1.0
-        + jnp.maximum(0.0, (30.0 - f.rsi) / 30.0) * 0.35
-        + jnp.maximum(0.0, (20.0 - f.mfi) / 20.0) * 0.35
+        + jnp.maximum(0.0, (p.rsi_oversold - f.rsi) / p.rsi_oversold) * 0.35
+        + jnp.maximum(0.0, (p.mfi_oversold - f.mfi) / p.mfi_oversold) * 0.35
         + jnp.minimum(jnp.abs(f.macd) * 100.0, 1.0) * 0.3
     )
     trend_score = jnp.where(

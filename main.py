@@ -225,12 +225,27 @@ def main() -> int:
         "dispatch per BQT_SCAN_CHUNK ticks; the emitted signal set is "
         "identical to the serial drive",
     )
+    parser.add_argument(
+        "--backtest",
+        action="store_true",
+        help="drive the replay through the time-batched backtest backend "
+        "(ISSUE 6): FULL-recompute semantics over (S, W+T) extended "
+        "buffers, one dispatch per BQT_BACKTEST_CHUNK ticks; the emitted "
+        "signal set is identical to the serial full-recompute drive",
+    )
     args = parser.parse_args()
 
     if args.backend != "tpu" and not args.replay:
         parser.error("--backend reference/ab requires --replay")
     if args.scanned and not args.replay:
         parser.error("--scanned requires --replay")
+    if args.backtest and (not args.replay or args.scanned):
+        parser.error("--backtest requires --replay and excludes --scanned")
+    if args.backtest and args.backend != "tpu":
+        parser.error(
+            "--backtest drives the TPU backend only (it would be silently "
+            "ignored with --backend reference/ab)"
+        )
 
     if args.replay:
         if args.backend == "reference":
@@ -245,6 +260,11 @@ def main() -> int:
             result = run_replay_ab(args.replay, scanned=args.scanned)
             print(result)
             return 0 if result["match"] else 1
+        if args.backtest:
+            from binquant_tpu.backtest import run_backtest
+
+            print(run_backtest(args.replay))
+            return 0
         from binquant_tpu.io.replay import run_replay
 
         stats = run_replay(args.replay, scanned=args.scanned)

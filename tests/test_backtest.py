@@ -1,0 +1,362 @@
+"""Time-batched backtest backend drills (ISSUE 6).
+
+The backend (``binquant_tpu/backtest``) evaluates FULL-recompute tick
+semantics over an ``(S, W+T)`` extended buffer — per-tick window views as
+gathers, heavy math vmapped over the tick axis, sequential recursions in a
+light scan — and must emit the EXACT signal set of the serial
+full-recompute drive (``run_replay(incremental=False)``). Tier-1 pins one
+small-shape equality drill plus the params-pytree default bit-parity; the
+slow lane (``make backtest-smoke``) adds the recorded-stream equality, the
+engineered overflow burst, the rewrite chunk break, and the ≥64-combo
+vmapped grid smoke.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from binquant_tpu.io.replay import (
+    generate_replay_file,
+    load_klines_by_tick,
+    make_stub_engine,
+    run_replay,
+)
+
+CAPACITY, WINDOW = 32, 120
+FIXTURE = Path(__file__).parent / "fixtures" / "market_36h_100sym.jsonl.gz"
+
+
+def _tick_seq(path):
+    by_tick = load_klines_by_tick(path)
+    return [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(by_tick[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(by_tick)
+    ]
+
+
+def _signal_tuples(fired):
+    return [
+        (s.tick_ms, s.strategy, s.symbol, str(s.value.direction),
+         bool(s.value.autotrade))
+        for s in fired
+    ]
+
+
+@pytest.fixture(scope="module")
+def small_stream(tmp_path_factory):
+    path = tmp_path_factory.mktemp("bt") / "bt_16.jsonl"
+    generate_replay_file(path, n_symbols=16, n_ticks=112)
+    return path
+
+
+def test_backtest_matches_serial_full_drive(small_stream):
+    """ISSUE 6 acceptance (tier-1 half): the time-batched backend emits
+    the exact signal set of the serial full-recompute drive on a
+    rewrite-free stream, with the cold-start churn tick routed serially
+    and every other tick riding batched chunks."""
+    from binquant_tpu.backtest import run_backtest
+
+    serial: list = []
+    s_stats = run_replay(
+        small_stream, capacity=CAPACITY, window=WINDOW, collect=serial,
+        incremental=False,
+    )
+    bt: list = []
+    b_stats = run_backtest(
+        small_stream, capacity=CAPACITY, window=WINDOW, collect=bt, chunk=16,
+    )
+    assert set(serial) == set(bt), {
+        "only_serial": sorted(set(serial) - set(bt))[:5],
+        "only_backtest": sorted(set(bt) - set(serial))[:5],
+    }
+    # non-vacuous: signals fired, the backend actually batched, and only
+    # the cold-start churn tick re-entered the serial path
+    assert len(serial) > 0
+    assert b_stats["backtest_chunks"] >= 2
+    assert b_stats["backtest_ticks"] > 0
+    assert b_stats["serial_ticks"] == 1
+    assert b_stats["ticks"] == s_stats["ticks"]
+    assert b_stats["backtest_overflow_reruns"] == 0
+
+
+def test_params_default_bit_parity():
+    """Tentpole guard: threading an EXPLICIT default StrategyParams pytree
+    through the live wire step produces the bit-identical wire (and carried
+    state) as the baked-constant path — lifting the constants changed
+    nothing at defaults."""
+    import jax
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.step import (
+        default_host_inputs,
+        initial_engine_state,
+        pad_updates,
+        tick_step_wire,
+    )
+    from binquant_tpu.strategies.params import (
+        default_strategy_params,
+        dynamic_params,
+    )
+
+    S, W = 8, 120
+    rng = np.random.default_rng(0)
+    inputs0 = default_host_inputs(S)._replace(
+        tracked=jnp.ones((S,), bool), btc_row=jnp.asarray(0, jnp.int32)
+    )
+    t0 = 1_780_272_000
+    px = 20 + rng.random(S) * 50
+    st1 = st2 = initial_engine_state(S, window=W)
+    explicit = dynamic_params(default_strategy_params())
+    for t in range(108):
+        ts15 = t0 + t * 900
+        newpx = px * (1 + rng.normal(0, 0.003, S))
+        vals = np.zeros((S, 10), np.float32)
+        vals[:, 0] = px
+        vals[:, 1] = np.maximum(px, newpx) * 1.001
+        vals[:, 2] = np.minimum(px, newpx) * 0.999
+        vals[:, 3] = newpx
+        vals[:, 4] = 1000.0
+        vals[:, 5] = 1000.0 * newpx
+        vals[:, 6] = 300.0
+        vals[:, 9] = 900.0
+        rows = np.arange(S, dtype=np.int32)
+        u15 = pad_updates(rows, np.full(S, ts15, np.int32), vals)
+        u5 = pad_updates(rows, np.full(S, ts15 + 600, np.int32), vals)
+        inp = inputs0._replace(
+            timestamp_s=jnp.asarray(ts15, jnp.int32),
+            timestamp5_s=jnp.asarray(ts15 + 600, jnp.int32),
+        )
+        px = newpx
+        st1, w1 = tick_step_wire(st1, u5, u15, inp)
+        st2, w2 = tick_step_wire(st2, u5, u15, inp, params=explicit)
+    assert np.array_equal(np.asarray(w1), np.asarray(w2), equal_nan=True)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st1), jax.tree_util.tree_leaves(st2)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+def test_param_grid_helpers():
+    """Grid builder contract: float axes sweep, structural axes refuse,
+    combos enumerate the cartesian product."""
+    from binquant_tpu.strategies.params import (
+        grid_size,
+        make_param_grid,
+        sweepable_axes,
+    )
+
+    axes = sweepable_axes()
+    assert "abp.volume_multiplier" in axes
+    assert "pt.rsi_oversold" in axes  # lifted entry threshold
+    assert "lsp.max_stress" in axes  # lifted routing veto
+    assert "pt.weights.context_weight" in axes  # nested ScorerWeights
+    assert "abp.lookback_window" not in axes  # structural int
+
+    grid, combos = make_param_grid(
+        {"mrf.rsi_long_max": [20.0, 25.0], "pt.rsi_oversold": [25.0, 30.0, 35.0]}
+    )
+    assert grid_size(grid) == 6 == len(combos)
+    assert grid.mrf.rsi_long_max.shape == (6,)
+    assert isinstance(grid.abp.lookback_window, int)
+    with pytest.raises(KeyError):
+        make_param_grid({"abp.nope": [1.0]})
+    with pytest.raises(ValueError):
+        make_param_grid({"abp.lookback_window": [10, 20]})
+
+
+def test_backtest_rejects_incremental_engines_and_dormant_sets(small_stream):
+    """Guard rails: the backend is full-recompute only, and only the
+    strategies whose gated half is buffer-free are evaluable."""
+    from binquant_tpu.backtest import run_backtest
+    from binquant_tpu.backtest.driver import drive_ticks_backtest
+
+    engine = make_stub_engine(
+        capacity=CAPACITY, window=WINDOW, incremental=True
+    )
+    with pytest.raises(ValueError, match="full-recompute"):
+        asyncio.run(drive_ticks_backtest(engine, []))
+    with pytest.raises(ValueError, match="cannot evaluate"):
+        run_backtest(
+            small_stream, capacity=CAPACITY, window=WINDOW,
+            enabled_strategies={"coinrule_buy_the_dip"},
+        )
+
+
+@pytest.mark.slow
+def test_backtest_recorded_stream_equality():
+    """ISSUE 6 acceptance (slow half): on the checked-in rewrite-free
+    36 h recorded-market fixture the backend's emitted signal set equals
+    the serial full-recompute drive's."""
+    from binquant_tpu.backtest import run_backtest
+
+    serial: list = []
+    run_replay(
+        FIXTURE, capacity=128, window=200, collect=serial, incremental=False,
+    )
+    bt: list = []
+    b_stats = run_backtest(
+        FIXTURE, capacity=128, window=200, collect=bt, chunk=16,
+    )
+    assert set(serial) == set(bt), {
+        "only_serial": sorted(set(serial) - set(bt))[:5],
+        "only_backtest": sorted(set(bt) - set(serial))[:5],
+    }
+    assert len(serial) > 0
+    assert b_stats["backtest_chunks"] >= 2
+
+
+@pytest.mark.slow
+def test_backtest_breadth_engaged_equality(tmp_path):
+    """Equality with the breadth-gated paths LIVE: scripted washed-out
+    breadth engages LSP's routing ladder and the grid-only policy's
+    device-side momentum recursion — the sequential half this backend
+    reimplements in its scan."""
+    from binquant_tpu.backtest import run_backtest
+    from tests.test_ab_parity import WASHED_BREADTH
+
+    path = tmp_path / "breadth.jsonl"
+    generate_replay_file(path, n_symbols=24, n_ticks=120, seed=7)
+    serial: list = []
+    run_replay(
+        path, capacity=64, window=200, collect=serial,
+        breadth=WASHED_BREADTH, incremental=False,
+    )
+    bt: list = []
+    b_stats = run_backtest(
+        path, capacity=64, window=200, collect=bt, breadth=WASHED_BREADTH,
+    )
+    assert set(serial) == set(bt), {
+        "only_serial": sorted(set(serial) - set(bt))[:5],
+        "only_backtest": sorted(set(bt) - set(serial))[:5],
+    }
+    assert len(serial) > 0
+    assert b_stats["backtest_chunks"] >= 1
+
+
+@pytest.mark.slow
+def test_backtest_burst_overflow_redrives_serially(tmp_path):
+    """A market-wide crash tick fires more pairs than the wire's
+    compaction slots inside a chunk: the chunk must rewind (engine state
+    never advanced) and re-drive serially through the audited per-tick
+    overflow fallback — emitted set still exact."""
+    from binquant_tpu.backtest import run_backtest
+    from binquant_tpu.io.replay import generate_burst_replay
+
+    path = tmp_path / "burst.jsonl"
+    generate_burst_replay(path, n_symbols=160, n_ticks=108)
+    serial: list = []
+    s_stats = run_replay(
+        path, capacity=192, window=200, collect=serial, incremental=False,
+    )
+    bt: list = []
+    b_stats = run_backtest(path, capacity=192, window=200, collect=bt)
+    assert set(serial) == set(bt)
+    assert s_stats["overflow_ticks"] >= 1  # the drill actually overflowed
+    assert b_stats["backtest_overflow_reruns"] >= 1  # ...inside a chunk
+    assert b_stats["backtest_ticks"] > 0  # earlier chunks still batched
+
+
+@pytest.mark.slow
+def test_backtest_rewrite_break(small_stream):
+    """A corrected candle re-sent two ticks later (the exchange's re-send
+    pattern) must break the chunk, route through the serial path, and
+    leave the emitted set identical to a never-batched drive."""
+    seq = _tick_seq(small_stream)
+    donor_tick = len(seq) - 6
+    donor = next(
+        k for k in seq[donor_tick][1]
+        if k["symbol"] == "S002USDT"
+        and (k["close_time"] - k["open_time"]) // 1000 >= 899
+    )
+    corrected = dict(donor)
+    corrected["close"] = round(donor["close"] * 1.004, 6)
+    corrected["high"] = max(corrected["high"], corrected["close"])
+    seq = [(ms, list(ks)) for ms, ks in seq]
+    seq[donor_tick + 2][1].append(corrected)
+
+    def drive_serial():
+        engine = make_stub_engine(
+            capacity=CAPACITY, window=WINDOW, incremental=False
+        )
+        out: list = []
+
+        async def drive():
+            for now_ms, klines in seq:
+                for k in klines:
+                    engine.ingest(k)
+                out.extend(await engine.process_tick(now_ms=now_ms))
+            out.extend(await engine.flush_pending())
+
+        asyncio.run(drive())
+        return _signal_tuples(out)
+
+    def drive_backtest():
+        engine = make_stub_engine(
+            capacity=CAPACITY, window=WINDOW, incremental=False,
+            backtest_chunk=16,
+        )
+        out: list = []
+
+        async def drive():
+            out.extend(await engine.process_ticks_backtest(seq))
+            out.extend(await engine.flush_pending())
+
+        asyncio.run(drive())
+        return _signal_tuples(out), engine
+
+    serial = drive_serial()
+    bt, engine = drive_backtest()
+    assert set(serial) == set(bt), {
+        "only_serial": sorted(set(serial) - set(bt))[:5],
+        "only_backtest": sorted(set(bt) - set(serial))[:5],
+    }
+    assert len(serial) > 0
+    assert engine.backtest_chunks >= 2
+    # cold-start churn + the rewrite tick both re-entered the serial path
+    assert engine.ticks_processed - engine.backtest_ticks >= 2
+
+
+@pytest.mark.slow
+def test_sweep_grid_64_combos_single_dispatch(small_stream):
+    """ISSUE 6 acceptance: ONE vmapped dispatch scores ≥64 parameter
+    combos, and the combos genuinely diverge — the PriceTracker oversold
+    axis must move its fire count monotonically."""
+    from binquant_tpu.backtest import run_param_sweep
+    from binquant_tpu.engine.step import STRATEGY_ORDER
+
+    res = run_param_sweep(
+        small_stream,
+        axes={
+            "pt.rsi_oversold": [10.0, 30.0, 60.0, 95.0],
+            "pt.mfi_oversold": [5.0, 20.0, 60.0, 95.0],
+            "mrf.rsi_long_max": [5.0, 25.0, 45.0, 65.0],
+        },
+        capacity=CAPACITY,
+        window=WINDOW,
+        # the whole stream in ONE chunk → one vmapped dispatch per plan
+        chunk=128,
+    )
+    assert res["P"] == 64
+    assert res["dispatches"] >= 1
+    assert res["evaluated_ticks"] > 0
+    totals = np.asarray(res["total_fired"])
+    assert len(set(totals.tolist())) > 4  # combos genuinely diverge
+
+    tc = np.asarray(res["trig_counts"])  # (P, N)
+    pt_col = list(STRATEGY_ORDER).index("coinrule_price_tracker")
+    # average PT fires per rsi_oversold level must be non-decreasing in
+    # the threshold (a looser oversold gate can only fire more)
+    pt_by_level = [
+        tc[[i for i, c in enumerate(res["combos"])
+            if c["pt.rsi_oversold"] == level], pt_col].mean()
+        for level in (10.0, 30.0, 60.0, 95.0)
+    ]
+    assert pt_by_level == sorted(pt_by_level)
+    assert pt_by_level[-1] > pt_by_level[0]

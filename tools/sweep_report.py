@@ -1,0 +1,148 @@
+"""Strategy-parameter sweep report (ISSUE 6 satellite).
+
+Runs ``binquant_tpu.backtest.run_param_sweep`` over a kline stream and
+prints a per-combo table of signal fire counts — the human surface of the
+vmapped grid backend. One dispatch per chunk scores EVERY combo.
+
+Usage::
+
+    python tools/sweep_report.py STREAM.jsonl \
+        --axis pt.rsi_oversold=20,30,40 \
+        --axis mrf.rsi_long_max=15,25,35 \
+        [--capacity 64] [--window 200] [--chunk 32] [--top 10] [--json OUT]
+
+    python tools/sweep_report.py --demo   # synthesize a stream + default grid
+
+Axis names are dotted float leaves of ``strategies.params.StrategyParams``
+(``--list-axes`` prints them); int/bool leaves are structural and cannot
+be swept.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parse_axis(spec: str) -> tuple[str, list[float]]:
+    if "=" not in spec:
+        raise SystemExit(f"bad --axis {spec!r}: expected name=v1,v2,...")
+    name, _, values = spec.partition("=")
+    try:
+        parsed = [float(v) for v in values.split(",") if v.strip()]
+    except ValueError as exc:
+        raise SystemExit(f"bad --axis {spec!r}: {exc}") from exc
+    if not parsed:
+        raise SystemExit(f"bad --axis {spec!r}: no values")
+    return name.strip(), parsed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="vmapped strategy-parameter sweep report"
+    )
+    parser.add_argument("stream", nargs="?", help="JSONL kline stream")
+    parser.add_argument(
+        "--axis", action="append", default=[],
+        metavar="name=v1,v2,...",
+        help="grid axis (repeatable); dotted StrategyParams float leaf",
+    )
+    parser.add_argument("--capacity", type=int, default=64)
+    parser.add_argument("--window", type=int, default=200)
+    parser.add_argument("--chunk", type=int, default=None)
+    parser.add_argument(
+        "--top", type=int, default=10, help="combos shown (by total fires)"
+    )
+    parser.add_argument("--json", help="also dump the full result as JSON")
+    parser.add_argument(
+        "--list-axes", action="store_true",
+        help="print the sweepable axis names and exit",
+    )
+    parser.add_argument(
+        "--demo", action="store_true",
+        help="synthesize a small market and sweep a default grid",
+    )
+    args = parser.parse_args()
+
+    from binquant_tpu.strategies.params import sweepable_axes
+
+    if args.list_axes:
+        for name in sweepable_axes():
+            print(name)
+        return 0
+
+    axes = dict(_parse_axis(spec) for spec in args.axis)
+    if args.demo:
+        import tempfile
+
+        from binquant_tpu.io.replay import generate_replay_file
+
+        td = tempfile.mkdtemp(prefix="bqt_sweep_")
+        args.stream = f"{td}/demo.jsonl"
+        generate_replay_file(args.stream, n_symbols=24, n_ticks=112)
+        args.capacity, args.window = 32, 160
+        axes = axes or {
+            "pt.rsi_oversold": [15.0, 30.0, 45.0, 60.0],
+            "mrf.rsi_long_max": [10.0, 25.0, 40.0, 55.0],
+            "abp.volume_multiplier": [1.5, 2.75, 4.0, 8.0],
+        }
+    if not args.stream or not axes:
+        parser.error("need a stream and at least one --axis (or --demo)")
+
+    from binquant_tpu.backtest import run_param_sweep
+
+    res = run_param_sweep(
+        args.stream,
+        axes=axes,
+        capacity=args.capacity,
+        window=args.window,
+        chunk=args.chunk,
+    )
+
+    strategies = res["strategies"]
+    live_cols = [
+        i for i, s in enumerate(strategies)
+        if any(res["trig_counts"][p][i] for p in range(res["P"]))
+    ]
+    axis_names = list(axes)
+    print(
+        f"sweep: P={res['P']} combos x {res['evaluated_ticks']} ticks "
+        f"({res['candles']} candles) in {res['dispatches']} dispatches, "
+        f"{res['wall_s']}s "
+        f"({res['combo_candles_per_sec']} combo-candles/s)"
+    )
+    header = (
+        ["#", "total"]
+        + [strategies[i] for i in live_cols]
+        + axis_names
+    )
+    rows = []
+    for rank, p in enumerate(res["ranking"][: args.top]):
+        combo = res["combos"][p]
+        rows.append(
+            [str(rank + 1), str(res["total_fired"][p])]
+            + [str(res["trig_counts"][p][i]) for i in live_cols]
+            + [f"{combo[name]:g}" for name in axis_names]
+        )
+    widths = [
+        max(len(header[c]), *(len(r[c]) for r in rows)) if rows else len(header[c])
+        for c in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:>{w}}}" for w in widths)
+    print(fmt.format(*header))
+    for r in rows:
+        print(fmt.format(*r))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+        print(f"full result written to {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

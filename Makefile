@@ -12,7 +12,14 @@ help:
 	@echo "               asserts the core metric families are present and"
 	@echo "               non-zero, incl. the incremental-path fallback"
 	@echo "               counter bqt_full_recompute_total (tier-1 test,"
-	@echo "               tests/test_obs.py)"
+	@echo "               tests/test_obs.py); then the ISSUE-7 numeric-"
+	@echo "               health lane: tests/test_numeric_health.py (digest"
+	@echo "               parity + NaN-injection anomaly + drift meters +"
+	@echo "               executable ledger incl. the slow scanned/backtest"
+	@echo "               digest ride-along), a digest+drift replay with a"
+	@echo "               NaN-poisoned candle (numeric_anomaly force-emit,"
+	@echo "               audit-tick carry_drift events), and the event log"
+	@echo "               rendered by tools/health_report.py"
 	@echo "  incr-smoke - fast CPU smoke of the incremental indicator path"
 	@echo "               (step parity + pipeline gating, tier-1 lane)"
 	@echo "  strat-smoke- CPU smoke of the ISSUE-4 strategy-stage carries +"
@@ -52,6 +59,16 @@ smoke:
 obs-smoke:
 	python -m pytest tests/test_obs.py tests/test_tracing.py -q -m "not slow" \
 		-k "obs_smoke or healthz or provenance or flight"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_numeric_health.py -q \
+		-p no:cacheprovider
+	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay_health.jsonl', n_symbols=8, n_ticks=110)"
+	python -c "import json; lines=open('/tmp/replay_health.jsonl').read().splitlines(); k=json.loads(lines[-1]); k['close']=float('nan'); lines[-1]=json.dumps(k); open('/tmp/replay_health.jsonl','w').write('\n'.join(lines)+'\n')"
+	rm -f /tmp/bqt_health_events.jsonl
+	BQT_NUMERIC_DIGEST=1 BQT_DRIFT_METER=1 BQT_INCREMENTAL=1 \
+	BQT_CARRY_AUDIT_EVERY=16 BQT_NUMERIC_NAN_BUDGET=0 \
+	BQT_EVENT_LOG=/tmp/bqt_health_events.jsonl JAX_PLATFORMS=cpu \
+	python main.py --replay /tmp/replay_health.jsonl
+	python tools/health_report.py /tmp/bqt_health_events.jsonl
 
 trace-smoke:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay_trace.jsonl', n_symbols=8, n_ticks=6)"

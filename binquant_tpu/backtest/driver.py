@@ -137,14 +137,25 @@ def _pad_counts(counts: np.ndarray, tb: int) -> np.ndarray:
 async def _flush_backtest_plan(engine, plan, params) -> list:
     """Dispatch one planned chunk through the time-batched kernel, commit
     the post-chunk state, and finalize tick-by-tick through the standard
-    decode path. Overflow ⇒ serial re-drive from the plan-start snapshot."""
+    decode path. Overflow ⇒ serial re-drive from the plan-start snapshot.
+
+    Trace-span parity with the scanned drive (ISSUE 7 satellite): one
+    ``backtest_chunk`` span per chunk (ticks/padded/overflow_rerun attrs,
+    ``path=backtest`` root attr), so ``tools/trace_report.py`` renders
+    backtest drives exactly like scanned ones."""
     from binquant_tpu.io.pipeline import (
         _PendingTick,
         _pow2_bucket,
         _scan_fallback_unavailable,
     )
     from binquant_tpu.obs.events import get_event_log
-    from binquant_tpu.obs.instruments import TICKS
+    from binquant_tpu.obs.instruments import (
+        BACKTEST_CHUNKS,
+        BACKTEST_OVERFLOW_RERUNS,
+        BACKTEST_TICKS,
+        TICKS,
+    )
+    from binquant_tpu.obs.ledger import LEDGER, abstract_args, lowered_cost
     from binquant_tpu.obs.tracing import NULL_TRACE
 
     ticks = plan["ticks"]
@@ -175,8 +186,7 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
         np.int32(-1 if engine._last_regime is None else engine._last_regime),
     )
     key = engine._wire_enabled_key()
-    t_chunk0 = time.perf_counter()
-    carries, _policy, wires_dev, _fired, _counts = backtest_chunk(
+    chunk_args = (
         (ext5_t, ext5_v),
         (ext15_t, ext15_v),
         _pad_counts(counts5, tb),
@@ -188,19 +198,62 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
         active,
         momentum_seq,
         policy_prev,
-        engine.context_config,
+    )
+    chunk_kwargs = dict(
         wire_enabled=key,
         window=W,
         params=None if params is None else dynamic_params(params),
+        numeric_digest=engine.numeric_digest,
     )
-    wires = np.asarray(wires_dev)
+    ledger_sig = (
+        f"S{engine.capacity}xW{W} T{tb} ext5[{ext5_t.shape[1] - W}]"
+        f" ext15[{ext15_t.shape[1] - W}]"
+        f" digest={int(engine.numeric_digest)}"
+    )
+
+    def cost_fn(args=chunk_args, kwargs=chunk_kwargs, cfg=engine.context_config):
+        # abstract-ify lazily: this thunk is only consumed when the watch
+        # actually observed a compile — the steady-state chunk loop must
+        # not pay a per-chunk tree_map over the extended buffers
+        a_args, a_kwargs = abstract_args(args, kwargs)
+        return lowered_cost(backtest_chunk, *a_args, cfg, **a_kwargs)
+
+    engine._tick_seq += 1
+    trace = engine.tracer.begin_tick(
+        engine._tick_seq, tick_ms=ticks[-1].now_ms
+    )
+    trace.set_attr(path="backtest")
+    t_chunk0 = time.perf_counter()
+    try:
+        with engine.latency.stage("backtest_chunk"), trace.span(
+            "backtest_chunk", ticks=T, padded=tb,
+        ), trace.activate():
+            # newness is detected by the ledger's compile monitoring (the
+            # kernel's jit cache keys on shapes the drive doesn't mirror
+            # host-side the way observe_dispatch does for the tick steps)
+            with LEDGER.watch(
+                "backtest_chunk", ledger_sig, expect_compile=False,
+                cost_fn=cost_fn, tick=engine.ticks_processed,
+            ):
+                carries, _policy, wires_dev, _fired, _counts = backtest_chunk(
+                    *chunk_args, engine.context_config, **chunk_kwargs
+                )
+            wires = np.asarray(wires_dev)
+    except BaseException as exc:
+        trace.mark_error(exc)
+        engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
+        raise
     if np.any(wires[:T, WIRE_FIRED_COUNT_OFF] > WIRE_MAX_FIRED):
         # a tick's fired set overflowed the wire's compaction slots: drop
         # the chunk's outputs (engine.state never advanced) and re-drive
         # serially through the audited per-tick overflow fallback
+        trace.set_attr(overflow_rerun=True)
+        engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
         engine.backtest_overflow_reruns += 1
+        BACKTEST_OVERFLOW_RERUNS.inc()
         fired_all.extend(await engine._redrive_serial(plan))
         return fired_all
+    engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
 
     regime_carry, mrf_carry, pt_carry = carries
     engine.state = EngineState(
@@ -214,6 +267,7 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
         indicator_carry=state.indicator_carry,
     )
     engine.backtest_chunks += 1
+    BACKTEST_CHUNKS.inc()
 
     per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
     for i, p in enumerate(ticks):
@@ -236,6 +290,7 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
         TICKS.inc()
         get_event_log().tick = engine.ticks_processed
         engine.backtest_ticks += 1
+        BACKTEST_TICKS.inc()
     engine.touch_heartbeat()
     return fired_all
 

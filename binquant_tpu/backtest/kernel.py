@@ -63,6 +63,7 @@ from binquant_tpu.engine.step import (
     _btc_momentum_pair,
     _btc_row_mask,
     _mask_outputs,
+    _numeric_digest_block,
     build_summary,
     pack_wire,
     quiet_suppression,
@@ -232,11 +233,14 @@ def _evaluate_tick(
     cfg: ContextConfig,
     wire_enabled: tuple[str, ...],
     sp,
+    numeric_digest: bool = False,
 ):
     """The gated half of the full tick from precomputed features: market
     context (same ``compute_market_context``, symbol features injected),
     the strategy gates, and the shared wire packing. Mirrors
-    ``_tick_step_impl``'s post-precompute structure line for line."""
+    ``_tick_step_impl``'s post-precompute structure line for line
+    (including the trailing numeric-health digest when that static flag
+    is on — the backtest wires decode through the same finalize path)."""
     S = pre.filled15.shape[0]
     from binquant_tpu.engine.buffer import NUM_FIELDS
 
@@ -337,11 +341,20 @@ def _evaluate_tick(
         "relative_strength_reversal_range": skipped,
     }
     summary = build_summary(strategies)
+    if numeric_digest:
+        digest = _numeric_digest_block(
+            pre.pack5, pre.pack15, summary, pre.btc_beta, pre.btc_corr,
+            inp.tracked, ok5, ok15, pre.fresh5, pre.fresh15,
+            jnp.zeros((S,), bool),  # full path: no expected-NaN beta rows
+        )
+    else:
+        digest = None
     wire = pack_wire(
         context, strategies, summary, pre.pack5, pre.pack15,
         pre.btc_beta, pre.btc_corr, pre.btc_change_96,
         jnp.asarray(0.0, dtype=jnp.float32),  # full path: no dirty bc rows
         wire_enabled,
+        digest=digest,
     )
     enabled_mask = jnp.asarray(
         [s in wire_enabled for s in STRATEGY_ORDER], dtype=bool
@@ -370,6 +383,7 @@ def _backtest_chunk_impl(
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
     window: int = 400,
     params=None,
+    numeric_digest: bool = False,
 ):
     """T full-recompute ticks in one dispatch over the extended buffers.
 
@@ -387,7 +401,7 @@ def _backtest_chunk_impl(
         "buffer-consuming dormant kernels run via the serial drives"
     )
     S = ext5[0].shape[0]
-    L = wire_length(S)
+    L = wire_length(S, numeric_digest=numeric_digest)
     n_strat = len(STRATEGY_ORDER)
     range_code = jnp.int32(int(MarketRegimeCode.RANGE))
     trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
@@ -422,7 +436,8 @@ def _backtest_chunk_impl(
         def live(op):
             rc, mc, pc = op
             (rc2, mc2, pc2), wire, tc, ac = _evaluate_tick(
-                pre_t, abp_t, inp, rc, mc, pc, cfg, wire_enabled, sp
+                pre_t, abp_t, inp, rc, mc, pc, cfg, wire_enabled, sp,
+                numeric_digest,
             )
             return rc2, mc2, pc2, wire, tc, ac
 
@@ -460,7 +475,8 @@ def _backtest_chunk_impl(
 
 
 backtest_chunk = partial(
-    jax.jit, static_argnames=("cfg", "wire_enabled", "window")
+    jax.jit,
+    static_argnames=("cfg", "wire_enabled", "window", "numeric_digest"),
 )(_backtest_chunk_impl)
 
 

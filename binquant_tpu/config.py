@@ -207,6 +207,47 @@ class Config:
         return int(self._get("BQT_BACKTEST_CHUNK", "16") or "16")
 
     @cached_property
+    def numeric_digest(self) -> bool:
+        """Device-side numeric-health digest riding the wire: per-stage
+        NaN/Inf leakage counts, per-strategy non-finite/fired counts, and
+        min/max/absmax of key intermediates, decoded into bqt_numeric_*
+        metrics + the /healthz ``numeric`` section every tick.
+        BQT_NUMERIC_DIGEST=0 compiles the pre-digest wire bit-identically
+        (the tier-1 test lane's default)."""
+        return self._get("BQT_NUMERIC_DIGEST", "1") != "0"
+
+    @cached_property
+    def numeric_nan_budget(self) -> int:
+        """NaN/Inf leakage tolerance per digest-carrying tick: a tick whose
+        total leakage rows exceed this force-emits a numeric_anomaly event
+        (flight-recorder style, with an engine snapshot) and counts in
+        bqt_numeric_anomaly_ticks_total. Default 0 — any leakage past the
+        sufficiency gates is anomalous."""
+        return int(self._get("BQT_NUMERIC_NAN_BUDGET", "0") or "0")
+
+    @cached_property
+    def drift_meter(self) -> bool:
+        """Measure per-family carried-vs-fresh indicator drift on every
+        audit tick BEFORE the resync overwrites the carry (exported as
+        bqt_carry_drift{family}); BQT_DRIFT_METER=0 keeps the audit a
+        blind reset (and skips the meter's one extra jit executable)."""
+        return self._get("BQT_DRIFT_METER", "1") != "0"
+
+    @cached_property
+    def drift_tol(self) -> float:
+        """Scale-normalized per-family drift tolerance: each carried
+        leaf's max-abs gap vs the fresh recompute, divided by that leaf's
+        magnitude scale (largest compared |value|), maxed over the
+        family's leaves — see engine/step.py _drift_of for why neither
+        per-element nor per-family normalization works. Breaches
+        force-emit carry_drift_alarm and count in
+        bqt_carry_drift_alarms_total{family}. The supertrend family's
+        documented forgotten-prefix divergence (including a carried
+        direction flip, which reads ~2.0 here) is measured against the
+        same tolerance — tune per deployment."""
+        return float(self._get("BQT_DRIFT_TOL", "0.05") or "0.05")
+
+    @cached_property
     def carry_audit_every_ticks(self) -> int:
         """Drift audit cadence for the incremental path: every N processed
         ticks the engine dispatches a FULL recompute, which re-anchors the

@@ -305,6 +305,185 @@ WIRE_SCALARS_B: tuple[str, ...] = (
 )
 _WIRE_TS_BASE = 65536
 
+# Strategies evaluated on the 5m timeframe (emission bar attribution AND
+# the numeric digest's per-strategy sufficiency gate read this; io.emission
+# re-exports it — one source of truth next to STRATEGY_ORDER).
+FIVE_MIN_STRATEGIES: frozenset[str] = frozenset(
+    {
+        "activity_burst_pump",
+        "coinrule_price_tracker",
+        "coinrule_supertrend_swing_reversal",
+        "coinrule_twap_momentum_sniper",
+        "inverse_price_tracker",
+    }
+)
+
+# --- numeric-health digest (ISSUE 7) ---------------------------------------
+# A fused device-computed stats block appended to the wire when the STATIC
+# ``numeric_digest`` flag is on (BQT_NUMERIC_DIGEST): per-stage NaN/Inf row
+# counts among rows whose data sufficiency says the values SHOULD be
+# finite, per-strategy non-finite output counts + fired counts, and
+# min/max/absmax of key intermediates. Disabled (the default argument) the
+# wire is bit-identical to the pre-digest layout — the block is appended
+# strictly after the calibration rows, so every existing offset
+# (WIRE_FIRED_COUNT_OFF, payload, calib) is unchanged either way.
+NUMERIC_STAGES: tuple[str, ...] = ("features5", "features15", "indicators")
+NUMERIC_SERIES: tuple[str, ...] = (
+    "close5", "close15", "volume5", "volume15", "score",
+)
+NUMERIC_DIGEST_WIDTH = (
+    2 * len(NUMERIC_STAGES) + 2 * len(STRATEGY_ORDER) + 3 * len(NUMERIC_SERIES)
+)
+
+
+def numeric_digest_layout() -> list[str]:
+    """Field names of the digest block, in wire order (decode + docs)."""
+    names: list[str] = []
+    for stage in NUMERIC_STAGES:
+        names += [f"{stage}.nan_rows", f"{stage}.inf_rows"]
+    names += [f"nonfinite.{s}" for s in STRATEGY_ORDER]
+    names += [f"fired.{s}" for s in STRATEGY_ORDER]
+    for series in NUMERIC_SERIES:
+        names += [f"{series}.min", f"{series}.max", f"{series}.absmax"]
+    return names
+
+
+def _series_stats(x: jnp.ndarray, mask: jnp.ndarray) -> list[jnp.ndarray]:
+    """(min, max, absmax) over ``mask``-selected finite entries; NaN when
+    nothing qualifies (decoded to null — distinguishable from measured 0)."""
+    m = mask & jnp.isfinite(x)
+    any_m = jnp.any(m)
+    mn = jnp.min(jnp.where(m, x, jnp.inf))
+    mx = jnp.max(jnp.where(m, x, -jnp.inf))
+    am = jnp.max(jnp.where(m, jnp.abs(x), 0.0))
+    nan = jnp.float32(jnp.nan)
+    return [
+        jnp.where(any_m, mn, nan).astype(jnp.float32),
+        jnp.where(any_m, mx, nan).astype(jnp.float32),
+        jnp.where(any_m, am, nan).astype(jnp.float32),
+    ]
+
+
+def _numeric_digest_block(
+    pack5,
+    pack15,
+    summary: TriggerSummary,
+    btc_beta: jnp.ndarray,
+    btc_corr: jnp.ndarray,
+    tracked: jnp.ndarray,
+    ok5: jnp.ndarray,
+    ok15: jnp.ndarray,
+    fresh5: jnp.ndarray,
+    fresh15: jnp.ndarray,
+    beta_expected_nan: jnp.ndarray,
+) -> jnp.ndarray:
+    """The (NUMERIC_DIGEST_WIDTH,) f32 stats block.
+
+    NaN/Inf counting is restricted to rows where the engine's own
+    sufficiency gates promise finite values (tracked + ``filled >=
+    MIN_BARS``): warm-up NaN is by design, a NaN past the gate is leakage.
+    ``beta_expected_nan`` masks the incremental path's deliberate
+    dirty-row NaN decode (engine/step.py bc_dirty) out of the indicators
+    stage — those rows are *unknown*, not poisoned."""
+    suff5 = tracked & ok5
+    suff15 = tracked & ok15
+
+    def stage_counts(fields, sufficient):
+        nan_any = jnp.zeros_like(sufficient)
+        inf_any = jnp.zeros_like(sufficient)
+        for f in fields:
+            nan_any = nan_any | jnp.isnan(f)
+            inf_any = inf_any | jnp.isinf(f)
+        return [
+            jnp.sum(nan_any & sufficient).astype(jnp.float32),
+            jnp.sum(inf_any & sufficient).astype(jnp.float32),
+        ]
+
+    def pack_fields(pack):
+        # every field the sufficiency gate (MIN_BARS) makes finite; quote
+        # volume is excluded — feeds legitimately omit it (has_qav)
+        return (
+            pack.close, pack.volume, pack.rsi, pack.mfi,
+            pack.macd, pack.macd_signal,
+            pack.bb_upper, pack.bb_mid, pack.bb_lower,
+            pack.atr, pack.ema9, pack.ema21,
+        )
+
+    out: list[jnp.ndarray] = []
+    out += stage_counts(pack_fields(pack5), suff5)
+    out += stage_counts(pack_fields(pack15), suff15)
+    ind_mask = suff15 & ~beta_expected_nan
+    out += [
+        jnp.sum((jnp.isnan(btc_beta) | jnp.isnan(btc_corr)) & ind_mask).astype(
+            jnp.float32
+        ),
+        jnp.sum((jnp.isinf(btc_beta) | jnp.isinf(btc_corr)) & ind_mask).astype(
+            jnp.float32
+        ),
+    ]
+    for k, name in enumerate(STRATEGY_ORDER):
+        gate = (
+            suff5 & fresh5 if name in FIVE_MIN_STRATEGIES else suff15 & fresh15
+        )
+        bad = (
+            ~jnp.isfinite(summary.score[k])
+            | ~jnp.isfinite(summary.stop_loss_pct[k])
+        )
+        out.append(jnp.sum(bad & gate).astype(jnp.float32))
+    for k in range(len(STRATEGY_ORDER)):
+        out.append(jnp.sum(summary.trigger[k]).astype(jnp.float32))
+    out += _series_stats(pack5.close, suff5)
+    out += _series_stats(pack15.close, suff15)
+    out += _series_stats(pack5.volume, suff5)
+    out += _series_stats(pack15.volume, suff15)
+    out += _series_stats(
+        summary.score, jnp.broadcast_to(tracked, summary.score.shape)
+    )
+    return jnp.stack(out)
+
+
+def decode_numeric_digest(block) -> dict:
+    """Host-side decode of one tick's digest block → nested dict (gauges,
+    /healthz ``numeric`` section, ``numeric_anomaly`` events). Non-finite
+    series stats decode to None (JSON-safe)."""
+    import numpy as np
+
+    vec = np.asarray(block, dtype=np.float64)
+    assert vec.shape == (NUMERIC_DIGEST_WIDTH,), vec.shape
+    i = 0
+    nan_rows: dict[str, int] = {}
+    inf_rows: dict[str, int] = {}
+    for stage in NUMERIC_STAGES:
+        nan_rows[stage] = int(vec[i])
+        inf_rows[stage] = int(vec[i + 1])
+        i += 2
+    nonfinite = {}
+    for name in STRATEGY_ORDER:
+        nonfinite[name] = int(vec[i])
+        i += 1
+    fired = {}
+    for name in STRATEGY_ORDER:
+        fired[name] = int(vec[i])
+        i += 1
+    series = {}
+    for name in NUMERIC_SERIES:
+        mn, mx, am = vec[i], vec[i + 1], vec[i + 2]
+        series[name] = {
+            "min": None if mn != mn else float(mn),
+            "max": None if mx != mx else float(mx),
+            "absmax": None if am != am else float(am),
+        }
+        i += 3
+    return {
+        "nan_rows": nan_rows,
+        "inf_rows": inf_rows,
+        "strategy_nonfinite": nonfinite,
+        "fired": fired,
+        "series": series,
+        "nan_total": sum(nan_rows.values()) + sum(nonfinite.values()),
+        "inf_total": sum(inf_rows.values()),
+    }
+
 
 class WireFired(NamedTuple):
     """Host-side (numpy) compacted fired entries; first ``n`` rows valid."""
@@ -323,16 +502,23 @@ class WireFired(NamedTuple):
     payload: object = None
 
 
-def unpack_wire(wire) -> tuple[WireFired, dict]:
+def unpack_wire(wire, numeric_digest: bool = False) -> tuple[WireFired, dict]:
     """Split one fetched wire array into fired entries + context scalars.
 
     The scalar dict mirrors the reference's per-tick context consumption
     (market_regime_notifier.py fields + routing inputs) so the host never
     touches individual device scalars (each fetch is a round trip through
-    a tunneled device)."""
+    a tunneled device). ``numeric_digest=True`` (the engine knows — the
+    flag is static per executable) strips the trailing
+    ``NUMERIC_DIGEST_WIDTH`` health block into ``ctx["numeric_digest"]``
+    first, so the calib-block inference below sees the pre-digest layout."""
     import numpy as np
 
     w = np.asarray(wire)
+    digest = None
+    if numeric_digest:
+        digest = w[-NUMERIC_DIGEST_WIDTH:]
+        w = w[:-NUMERIC_DIGEST_WIDTH]
     na, nb = len(WIRE_SCALARS_A), len(WIRE_SCALARS_B)
     a = w[:na]
     b = w[na : na + nb + 4]
@@ -368,6 +554,8 @@ def unpack_wire(wire) -> tuple[WireFired, dict]:
             ctx["calib_valid"] = calib[0] > 0.5
             ctx["calib_close"] = calib[1]
             ctx["calib_atr_pct"] = calib[2]
+    if digest is not None:
+        ctx["numeric_digest"] = digest
     fired = WireFired(
         n=n,
         overflow=n > K,
@@ -641,6 +829,7 @@ def pack_wire(
     btc_change_96: jnp.ndarray,
     bc_dirty_rows: jnp.ndarray,
     wire_enabled: tuple[str, ...],
+    digest: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Pack one tick's evaluation into the single wire array: context
     scalars + device-side fired compaction + per-slot emission payload +
@@ -648,7 +837,10 @@ def pack_wire(
     backtest backend emits the EXACT stacked wire format the standard
     decode path (io/emission.py via unpack_wire) already consumes.
     Records the per-``wire_enabled`` emission layout as a tracing side
-    effect, exactly as the inline block did."""
+    effect, exactly as the inline block did. ``digest`` (trace-time
+    optional — None compiles the pre-digest wire unchanged) appends the
+    (NUMERIC_DIGEST_WIDTH,) numeric-health block strictly at the END so
+    every pre-digest offset survives."""
     S = summary.trigger.shape[1]
     scalar_values = {
         "valid": context.valid,
@@ -771,15 +963,16 @@ def pack_wire(
         ]
     )  # (3, S)
 
-    return jnp.concatenate(
-        [
-            scalars,
-            n_fired[None],
-            fired_block.reshape(-1),
-            slot_payload.reshape(-1),
-            calib_block.reshape(-1),
-        ]
-    )
+    parts = [
+        scalars,
+        n_fired[None],
+        fired_block.reshape(-1),
+        slot_payload.reshape(-1),
+        calib_block.reshape(-1),
+    ]
+    if digest is not None:
+        parts.append(digest.astype(jnp.float32))
+    return jnp.concatenate(parts)
 
 
 def _tick_step_impl(
@@ -793,6 +986,7 @@ def _tick_step_impl(
     incremental: bool = False,
     maintain_carry: bool = True,
     params=None,
+    numeric_digest: bool = False,
 ) -> tuple[EngineState, TickOutputs]:
     """One tick: apply candle updates, rebuild context, evaluate everything.
 
@@ -831,6 +1025,10 @@ def _tick_step_impl(
     carry init/advance (float-only overrides are consistent across resyncs;
     the structural int fields must stay at defaults — they size carry
     leaves).
+
+    ``numeric_digest`` (static) appends the device-computed numeric-health
+    block to the wire (``_numeric_digest_block``); False compiles a graph
+    bit-identical to the pre-digest step.
     """
     from binquant_tpu.strategies.params import resolve_params
 
@@ -1145,9 +1343,24 @@ def _tick_step_impl(
     # ONE array so the per-tick D2H is a single transfer (SURVEY §7 "keep
     # the trigger-extraction D2H tiny"). One copy of the packing shared
     # with the backtest backend (pack_wire above).
+    if numeric_digest:
+        # the incremental path's dirty/stale beta rows decode NaN by
+        # design — mask them out of the leakage count
+        beta_expected_nan = (
+            indicator_carry.bc_dirty | stale15
+            if incremental
+            else jnp.zeros((S,), bool)
+        )
+        digest = _numeric_digest_block(
+            pack5, pack15, summary, btc_beta, btc_corr,
+            inputs.tracked, ok5, ok15, fresh5, fresh15, beta_expected_nan,
+        )
+    else:
+        digest = None
     wire = pack_wire(
         context, strategies, summary, pack5, pack15,
         btc_beta, btc_corr, btc_change_96, bc_dirty_rows, wire_enabled,
+        digest=digest,
     )
 
     outputs = TickOutputs(
@@ -1171,7 +1384,8 @@ def _tick_step_impl(
 tick_step = partial(
     jax.jit,
     static_argnames=(
-        "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry"
+        "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry",
+        "numeric_digest",
     ),
 )(_tick_step_impl)
 
@@ -1186,6 +1400,7 @@ def _tick_step_wire_impl(
     incremental: bool = False,
     maintain_carry: bool = True,
     params=None,
+    numeric_digest: bool = False,
 ) -> tuple[EngineState, jnp.ndarray]:
     """The live engine's step: identical evaluation, but only the wire
     leaves the computation. The full ``TickOutputs`` pytree is ~400 output
@@ -1210,13 +1425,17 @@ def _tick_step_wire_impl(
         incremental=incremental,
         maintain_carry=maintain_carry,
         params=params,
+        numeric_digest=numeric_digest,
     )
     return new_state, outputs.wire
 
 
 tick_step_wire = partial(
     jax.jit,
-    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+    static_argnames=(
+        "cfg", "wire_enabled", "incremental", "maintain_carry",
+        "numeric_digest",
+    ),
 )(_tick_step_wire_impl)
 
 # Donated variants: the carried EngineState's buffers update in place
@@ -1230,29 +1449,35 @@ tick_step_wire = partial(
 tick_step_donated = jax.jit(
     _tick_step_impl,
     static_argnames=(
-        "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry"
+        "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry",
+        "numeric_digest",
     ),
     donate_argnums=(0,),
 )
 
 tick_step_wire_donated = jax.jit(
     _tick_step_wire_impl,
-    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+    static_argnames=(
+        "cfg", "wire_enabled", "incremental", "maintain_carry",
+        "numeric_digest",
+    ),
     donate_argnums=(0,),
 )
 
 
-def wire_length(num_symbols: int) -> int:
+def wire_length(num_symbols: int, numeric_digest: bool = False) -> int:
     """Length of one tick's packed wire at capacity ``num_symbols`` —
     scalars + fired-compaction blocks + per-slot emission payload + the
-    (3, S) calibration block. The scan step needs it statically to shape
-    its inactive-tick zero wire."""
+    (3, S) calibration block (+ the numeric-health digest when that
+    static flag is on). The scan step needs it statically to shape its
+    inactive-tick zero wire."""
     na, nb = len(WIRE_SCALARS_A), len(WIRE_SCALARS_B)
     return (
         na + nb + 4 + 1
         + 6 * WIRE_MAX_FIRED
         + WIRE_MAX_FIRED * EMISSION_SLOT_WIDTH
         + 3 * num_symbols
+        + (NUMERIC_DIGEST_WIDTH if numeric_digest else 0)
     )
 
 
@@ -1281,6 +1506,7 @@ def _fold_and_step_wire(
     incremental: bool,
     maintain_carry: bool,
     params=None,
+    numeric_digest: bool = False,
 ) -> tuple[EngineState, jnp.ndarray]:
     """One replayed tick inside the scan: fold all but the final update
     sub-batch slot (mirroring ``SignalEngine._fold_updates`` — on the
@@ -1316,6 +1542,7 @@ def _fold_and_step_wire(
         incremental=incremental,
         maintain_carry=maintain_carry,
         params=params,
+        numeric_digest=numeric_digest,
     )
 
 
@@ -1332,6 +1559,7 @@ def _tick_step_scan_impl(
     incremental: bool = True,
     maintain_carry: bool = True,
     params=None,
+    numeric_digest: bool = False,
 ) -> tuple[EngineState, jnp.ndarray, jnp.ndarray]:
     """T replayed ticks fused into ONE dispatch (ISSUE 5 tentpole).
 
@@ -1370,7 +1598,7 @@ def _tick_step_scan_impl(
     from binquant_tpu.enums import MarketRegimeCode
 
     S = state.buf15.capacity
-    L = wire_length(S)
+    L = wire_length(S, numeric_digest=numeric_digest)
     range_code = jnp.int32(int(MarketRegimeCode.RANGE))
     trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
 
@@ -1387,7 +1615,7 @@ def _tick_step_scan_impl(
         def live(operand):
             return _fold_and_step_wire(
                 operand, u5_slots, u15_slots, inp, cfg, wire_enabled,
-                incremental, maintain_carry, params,
+                incremental, maintain_carry, params, numeric_digest,
             )
 
         def idle(operand):
@@ -1408,7 +1636,10 @@ def _tick_step_scan_impl(
 
 tick_step_scan = partial(
     jax.jit,
-    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+    static_argnames=(
+        "cfg", "wire_enabled", "incremental", "maintain_carry",
+        "numeric_digest",
+    ),
 )(_tick_step_scan_impl)
 
 # Donated scan: for state-threading loops that keep NO pre-chunk anchor
@@ -1417,7 +1648,10 @@ tick_step_scan = partial(
 # the copy costs 1/T of the per-tick copying path's (amortized to noise).
 tick_step_scan_donated = jax.jit(
     _tick_step_scan_impl,
-    static_argnames=("cfg", "wire_enabled", "incremental", "maintain_carry"),
+    static_argnames=(
+        "cfg", "wire_enabled", "incremental", "maintain_carry",
+        "numeric_digest",
+    ),
     donate_argnums=(0,),
 )
 
@@ -1552,7 +1786,8 @@ _DISPATCH_SIGNATURES: set[tuple] = set()
 def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
                      fn: str = "tick_step_wire",
                      incremental: bool = False,
-                     maintain_carry: bool = True) -> bool:
+                     maintain_carry: bool = True,
+                     numeric_digest: bool = False) -> bool:
     """Record per-dispatch telemetry; True when this signature is new
     (i.e. the launch below it will trace+compile)."""
     import numpy as np
@@ -1577,6 +1812,7 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
         tuple(np.asarray(upd15[0]).shape),
         tuple(wire_enabled),
         cfg,
+        bool(numeric_digest),
     )
     if signature in _DISPATCH_SIGNATURES:
         return False
@@ -1593,6 +1829,235 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
         trace_id=current_trace_id(),
     )
     return True
+
+
+# -- carry-drift audit meters (ISSUE 7) --------------------------------------
+#
+# The periodic full-recompute audit (BQT_CARRY_AUDIT_EVERY) re-anchors the
+# carried indicator state from the windows — but until now it never
+# MEASURED how far the carry had drifted before overwriting it. These
+# meters compare, on an audit tick, the carried state advanced by that
+# tick's updates against a fresh init from the post-update windows: the
+# exact pair of values the incremental and full paths would have consumed.
+# One small extra dispatch per audit tick (every ~256 ticks), fetched as a
+# handful of scalars.
+
+DRIFT_FAMILIES: tuple[str, ...] = (
+    "ewm", "sums", "moments", "supertrend", "beta_corr",
+    "abp_sorted", "lsp_sorted",
+)
+
+_EWM_LEAVES = (
+    "ema9", "ema21", "ema20", "ema50",
+    "macd_fast", "macd_slow", "macd_sig", "gain_w", "loss_w",
+)
+_SUM_LEAVES = ("gain_s", "loss_s", "pos_flow", "neg_flow")
+_MOMENT_LEAVES = ("close_m", "vol_m", "tr_m")
+
+
+def _ulp_distance(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """f32 ULP distance via the lexicographically-ordered integer mapping
+    (sign-magnitude bits folded so adjacent floats differ by 1). The
+    same-sign difference is taken in EXACT int32 arithmetic (bit patterns
+    cast to f32 first would quantize small distances to 0 — f32's ulp at
+    bit-pattern magnitude ~1e9 is 64); only the cross-sign case — already
+    a huge distance — sums magnitudes in f32. Returned as f32 (x64 is
+    disabled engine-wide; distances past f32's 2^24 integer range are
+    "astronomically diverged" either way)."""
+
+    def ordered(x):
+        bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+        mag = bits & jnp.int32(0x7FFFFFFF)
+        return jnp.where(bits >= 0, bits, -mag)
+
+    ka, kb = ordered(a), ordered(b)
+    same_sign = (ka >= 0) == (kb >= 0)
+    # same sign ⇒ both keys in [0, 2^31) or both in (-2^31, 0] ⇒ the int32
+    # difference cannot overflow and is exact
+    exact = jnp.abs(ka - kb).astype(jnp.float32)
+    crossed = jnp.abs(ka.astype(jnp.float32)) + jnp.abs(kb.astype(jnp.float32))
+    return jnp.where(same_sign, exact, crossed)
+
+
+def _drift_of(pairs) -> dict:
+    """Max-abs, scale-normalized, and max-ULP drift over (carried, fresh,
+    mask) array triples. Only positions finite on BOTH sides compare (the
+    sorted carries' +inf sentinels and warm-up NaN are structure, not
+    drift).
+
+    ``max_rel`` — the number the BQT_DRIFT_TOL alarm judges — is each
+    LEAF's max-abs drift normalized by that leaf's magnitude scale (the
+    largest |value| among its compared positions), maxed over the
+    family's leaves. Per-leaf, not per-element: an element-wise
+    |c−f|/max(|c|,|f|) reads 1.0 whenever a windowed sum whose true
+    value is exactly 0 carries a harmless f32 add/sub residue (e.g. an
+    RSI loss-sum through a monotonic run), alarming on every audit of a
+    healthy stream. And per-leaf rather than per-family: one family mixes
+    units (supertrend direction ±1 next to price-scale bands, macd next
+    to ema) — a family-wide scale would dilute a carried direction FLIP
+    (abs 2, scale 1 → rel 2, loud) down to price-scale noise."""
+    max_abs = jnp.float32(0.0)
+    max_rel = jnp.float32(0.0)
+    max_ulp = jnp.float32(0.0)
+    compared = jnp.int32(0)
+    for c, f, m in pairs:
+        both = (
+            jnp.broadcast_to(m, c.shape) & jnp.isfinite(c) & jnp.isfinite(f)
+        )
+        cf = c.astype(jnp.float32)
+        ff = f.astype(jnp.float32)
+        d = jnp.abs(cf - ff)
+        leaf_abs = jnp.max(jnp.where(both, d, 0.0), initial=0.0)
+        max_abs = jnp.maximum(max_abs, leaf_abs)
+        mag = jnp.maximum(jnp.abs(cf), jnp.abs(ff))
+        leaf_scale = jnp.max(jnp.where(both, mag, 0.0), initial=0.0)
+        max_rel = jnp.maximum(
+            max_rel, leaf_abs / jnp.maximum(leaf_scale, jnp.float32(1e-30))
+        )
+        u = _ulp_distance(c, f)
+        max_ulp = jnp.maximum(
+            max_ulp, jnp.max(jnp.where(both, u, 0.0), initial=0.0)
+        )
+        compared = compared + jnp.sum(both, dtype=jnp.int32)
+    return {
+        "max_abs": max_abs,
+        "max_rel": max_rel,
+        "max_ulp": max_ulp,
+        "compared": compared,
+    }
+
+
+def _carry_drift_impl(
+    state: EngineState,
+    upd5,
+    upd15,
+    btc_row: jnp.ndarray,
+    params=None,
+) -> dict:
+    """Per-family drift between the carried indicator state (advanced by
+    this tick's updates — what the incremental path WOULD read) and a
+    fresh full-recompute init from the post-update windows (what the
+    audit tick's resync installs). Rows the advance marked stale, dirty
+    beta/corr rows, and ABP dirty rows are excluded — their divergence is
+    documented semantics, not drift."""
+    from binquant_tpu.ops.incremental import (
+        beta_corr_value,
+        moment_mean,
+        moment_std,
+    )
+
+    buf5 = apply_updates(state.buf5, *upd5)
+    buf15 = apply_updates(state.buf15, *upd15)
+    carried, stale5, stale15 = advance_indicator_carry(
+        buf5, buf15, state.indicator_carry, btc_row, params
+    )
+    fresh = init_indicator_carry(buf5, buf15, btc_row, params)
+    live5 = ~stale5 & (buf5.filled > 0)
+    live15 = ~stale15 & (buf15.filled > 0)
+
+    ewm_pairs, sum_pairs, moment_pairs = [], [], []
+    for pc, pf, mask in (
+        (carried.pack5, fresh.pack5, live5),
+        (carried.pack15, fresh.pack15, live15),
+    ):
+        for name in _EWM_LEAVES:
+            c, f = getattr(pc, name), getattr(pf, name)
+            ewm_pairs.append(
+                (c.mean, f.mean, mask & (c.rel >= 0) & (f.rel >= 0))
+            )
+        for name in _SUM_LEAVES:
+            c, f = getattr(pc, name), getattr(pf, name)
+            sum_pairs.append(
+                (c.wsum, f.wsum, mask & (c.cnt == f.cnt) & (c.cnt > 0))
+            )
+        for name in _MOMENT_LEAVES:
+            c, f = getattr(pc, name), getattr(pf, name)
+            m = mask & (c.cnt == f.cnt) & (c.cnt > 0)
+            moment_pairs.append(
+                (moment_mean(c, 1, 1), moment_mean(f, 1, 1), m)
+            )
+            moment_pairs.append(
+                (moment_std(c, 1, 1), moment_std(f, 1, 1), m)
+            )
+
+    stc, stf = carried.st5, fresh.st5
+    st_mask = live5 & (stc.n_seen >= ST_WINDOW) & (stf.n_seen >= ST_WINDOW)
+    st_pairs = [
+        (stc.atr, stf.atr, st_mask),
+        (stc.final_upper, stf.final_upper, st_mask),
+        (stc.final_lower, stf.final_lower, st_mask),
+        (stc.direction, stf.direction, st_mask),
+    ]
+
+    cb, cc = beta_corr_value(carried.bc15, BC_WINDOW)
+    fb, fc = beta_corr_value(fresh.bc15, BC_WINDOW)
+    bc_mask = (
+        live15
+        & ~carried.bc_dirty
+        & (carried.bc15.cnt >= BC_WINDOW)
+        & (fresh.bc15.cnt >= BC_WINDOW)
+    )
+    bc_pairs = [(cb, fb, bc_mask), (cc, fc, bc_mask)]
+
+    abpc, abpf = carried.abp5, fresh.abp5
+    abp_mask = live5 & ~abpc.dirty
+    abp_pairs = [
+        (
+            c.sorted,
+            f.sorted,
+            (abp_mask & (c.cnt == f.cnt))[:, None],
+        )
+        for c, f in (
+            (abpc.vol_med, abpf.vol_med),
+            (abpc.qvol_med, abpf.qvol_med),
+            (abpc.score_q, abpf.score_q),
+        )
+    ]
+
+    lspc, lspf = carried.lsp15, fresh.lsp15
+    lsp_pairs = [
+        (
+            lspc.score_q.sorted,
+            lspf.score_q.sorted,
+            (live15 & (lspc.score_q.cnt == lspf.score_q.cnt))[:, None],
+        ),
+        (lspc.prev_raw, lspf.prev_raw, live15),
+    ]
+
+    return {
+        "ewm": _drift_of(ewm_pairs),
+        "sums": _drift_of(sum_pairs),
+        "moments": _drift_of(moment_pairs),
+        "supertrend": _drift_of(st_pairs),
+        "beta_corr": _drift_of(bc_pairs),
+        "abp_sorted": _drift_of(abp_pairs),
+        "lsp_sorted": _drift_of(lsp_pairs),
+    }
+
+
+_carry_drift_jit = jax.jit(_carry_drift_impl)
+
+
+def measure_carry_drift(state, upd5, upd15, btc_row, params=None) -> dict:
+    """Host entry: run the jitted drift measurement and land the scalars.
+    Returns ``{family: {"max_abs": float, "max_rel": float, "max_ulp":
+    int, "compared": int}}`` for every :data:`DRIFT_FAMILIES` entry —
+    ``max_rel`` (the per-leaf scale-normalized number, ``_drift_of``) is
+    the field the BQT_DRIFT_TOL alarm judges."""
+    import numpy as np
+
+    out = _carry_drift_jit(
+        state, upd5, upd15, jnp.asarray(btc_row, jnp.int32), params
+    )
+    return {
+        fam: {
+            "max_abs": float(np.asarray(v["max_abs"])),
+            "max_rel": float(np.asarray(v["max_rel"])),
+            "max_ulp": int(np.asarray(v["max_ulp"])),
+            "compared": int(np.asarray(v["compared"])),
+        }
+        for fam, v in out.items()
+    }
 
 
 def _btc_momentum_pair(last: jnp.ndarray, prev: jnp.ndarray) -> jnp.ndarray:

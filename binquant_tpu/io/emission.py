@@ -57,7 +57,13 @@ from binquant_tpu.utils import (
 # computed device-side as capability surface but are NOT materialized into
 # emissions unless explicitly enabled. Defined next to STRATEGY_ORDER so
 # the device wire compaction shares it; re-exported here for the io layer.
-from binquant_tpu.engine.step import LIVE_STRATEGIES  # noqa: F401
+# FIVE_MIN_STRATEGIES likewise moved next to STRATEGY_ORDER (the numeric
+# digest's per-strategy sufficiency gate reads it device-side) and is
+# re-exported here for its established io-layer consumers.
+from binquant_tpu.engine.step import (  # noqa: F401
+    FIVE_MIN_STRATEGIES,
+    LIVE_STRATEGIES,
+)
 
 # Strategies that trade FUTURES market type in their bot params
 _FUTURES_BOT_STRATEGIES = {"activity_burst_pump", "mean_reversion_fade"}
@@ -344,15 +350,6 @@ def extract_fired(
                 FiredSignal(strategy, symbol, row, value, message, analytics)
             )
     return fired
-
-
-FIVE_MIN_STRATEGIES = {
-    "activity_burst_pump",
-    "coinrule_price_tracker",
-    "coinrule_supertrend_swing_reversal",
-    "coinrule_twap_momentum_sniper",
-    "inverse_price_tracker",
-}
 
 
 def _grid_signal(

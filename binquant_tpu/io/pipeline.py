@@ -40,6 +40,7 @@ from binquant_tpu.engine.step import (
     apply_updates_step,
     default_host_inputs,
     initial_engine_state,
+    measure_carry_drift,
     observe_dispatch,
     pad_updates,
     tick_step,
@@ -72,6 +73,8 @@ from binquant_tpu.obs.instruments import (
     SIGNALS,
     TICKS,
 )
+from binquant_tpu.obs.ledger import LEDGER, abstract_args, lowered_cost
+from binquant_tpu.obs.numeric import DriftMeter, NumericHealthMonitor
 from binquant_tpu.obs.tracing import (
     NULL_TRACE,
     Tracer,
@@ -554,6 +557,29 @@ class SignalEngine:
         # as the batched chunks — a custom-params run must never silently
         # mix two parameter sets.
         self.strategy_params = None
+        # -- numeric-health observatory (ISSUE 7)
+        # Device-side digest riding the wire (BQT_NUMERIC_DIGEST; a STATIC
+        # flag — off compiles the pre-digest wire bit-identically), decoded
+        # every finalize into bqt_numeric_* metrics + /healthz; leakage
+        # past BQT_NUMERIC_NAN_BUDGET force-emits numeric_anomaly events.
+        self.numeric_digest = bool(getattr(config, "numeric_digest", True))
+        self.numeric = NumericHealthMonitor(
+            nan_budget=int(getattr(config, "numeric_nan_budget", 0) or 0),
+            event_every=self.carry_audit_every or 256,
+        )
+        # Carry-drift audit meters (BQT_DRIFT_METER): every audit tick
+        # measures per-family carried-vs-fresh drift BEFORE the resync
+        # overwrites the carry — the audit becomes a measured correctness
+        # signal instead of a blind reset. Incremental engines only (a
+        # classic engine has no carry to drift).
+        self.drift_meter_enabled = (
+            bool(getattr(config, "drift_meter", True)) and self.incremental
+        )
+        self.drift = DriftMeter(tol=float(getattr(config, "drift_tol", 0.05)))
+        # update-bucket shapes whose drift-measurement compile has been
+        # background-warmed (see _dispatch_tick_inner — the meter must not
+        # stall the audit tick it instruments with its own first compile)
+        self._drift_warmed: set[tuple] = set()
 
     # -- ingest -------------------------------------------------------------
 
@@ -1193,26 +1219,53 @@ class SignalEngine:
             with self.latency.stage("scan_chunk"), trace.span(
                 "scan_chunk", ticks=T, padded=tb, depth=depth,
             ), trace.activate():
-                observe_dispatch(
+                is_new_sig = observe_dispatch(
                     self.state, (r5, t5, v5), (r15, t15, v15), key,
                     cfg=self.context_config, fn="tick_step_scan",
                     incremental=True, maintain_carry=True,
+                    numeric_digest=self.numeric_digest,
                 )
+                scan_sig = (
+                    f"{self._ledger_sig((r5,), (r15,), True)}"
+                    f" T{tb}xD{depth}"
+                )
+                cost_fn = None
+                if is_new_sig:
+                    a_args, _ = abstract_args(
+                        (
+                            self.state, (r5, t5, v5), (r15, t15, v15),
+                            inputs_seq, active, momentum_seq, policy_prev,
+                        )
+                    )
+                    cfg_, dig_ = self.context_config, self.numeric_digest
+
+                    def cost_fn(args=a_args):
+                        return lowered_cost(
+                            tick_step_scan, *args, cfg_,
+                            wire_enabled=key, incremental=True,
+                            maintain_carry=True, numeric_digest=dig_,
+                        )
+
                 # NOT donated: self.state stays alive as the pre-chunk
                 # anchor the overflow re-run below rewinds to
-                new_state, wires_dev, _counts = tick_step_scan(
-                    self.state,
-                    (r5, t5, v5),
-                    (r15, t15, v15),
-                    inputs_seq,
-                    active,
-                    momentum_seq,
-                    policy_prev,
-                    self.context_config,
-                    wire_enabled=key,
-                    incremental=True,
-                    maintain_carry=True,
-                )
+                with LEDGER.watch(
+                    "tick_step_scan", scan_sig, expect_compile=is_new_sig,
+                    cost_fn=cost_fn, tick=self.ticks_processed,
+                ):
+                    new_state, wires_dev, _counts = tick_step_scan(
+                        self.state,
+                        (r5, t5, v5),
+                        (r15, t15, v15),
+                        inputs_seq,
+                        active,
+                        momentum_seq,
+                        policy_prev,
+                        self.context_config,
+                        wire_enabled=key,
+                        incremental=True,
+                        maintain_carry=True,
+                        numeric_digest=self.numeric_digest,
+                    )
                 wires = np.asarray(wires_dev)
         except BaseException as exc:
             trace.mark_error(exc)
@@ -1449,6 +1502,142 @@ class SignalEngine:
             # root attr: the ring summary / healthz "carry path taken"
             trace.set_attr(path=path if reason is None else f"{path}:{reason}")
 
+        # explicit params override (backtest drives) — None stays the
+        # baked-constant live graph. Resolved before the drift meter so an
+        # audit tick under custom params compares carry twins built with
+        # the SAME thresholds.
+        if self.strategy_params is None:
+            sp_arg = None
+        else:
+            from binquant_tpu.strategies.params import dynamic_params
+
+            sp_arg = dynamic_params(self.strategy_params)
+
+        # Carry-drift audit meter (ISSUE 7): on an audit tick, measure the
+        # per-family gap between the carried state advanced by THIS tick's
+        # updates — replaying the exact carry-advancing folds the
+        # incremental path would have run, on a FUNCTIONAL copy that never
+        # touches self.state — and a fresh full-recompute init from the
+        # same post-update windows, BEFORE the full dispatch below resyncs
+        # the carry. Costs (slots-1) fold dispatches + one measurement
+        # dispatch per audit tick (every BQT_CARRY_AUDIT_EVERY ticks).
+        if reason == "audit" and self.drift_meter_enabled:
+            try:
+                with self.latency.stage("carry_audit"), trace.span(
+                    "carry_audit"
+                ) as sp_audit:
+                    empty = self._empty_updates()
+                    slots5 = [pad_updates(*b) for b in batches5] or [empty]
+                    slots15 = [pad_updates(*b) for b in batches15] or [empty]
+                    n = max(len(slots5), len(slots15))
+                    st = self.state
+                    for i in range(n - 1):
+                        st = apply_updates_carry_step(
+                            st,
+                            slots5[i] if i < len(slots5) else empty,
+                            slots15[i] if i < len(slots15) else empty,
+                            btc_row=btc_row,
+                        )
+                    # the measured args resolved ONCE: the ledger watch's
+                    # signature must name the buckets actually dispatched
+                    # (the shorter interval's final slot is the padded
+                    # empty, not its own last batch)
+                    mu5 = slots5[-1] if len(slots5) == n else empty
+                    mu15 = slots15[-1] if len(slots15) == n else empty
+                    # any residual compile (a bucket the pre-warm below
+                    # missed) is at least attributed on the ledger
+                    with LEDGER.watch(
+                        "carry_drift_meter",
+                        self._ledger_sig(mu5, mu15, True),
+                        expect_compile=False,
+                        tick=self.ticks_processed,
+                    ):
+                        drift = measure_carry_drift(
+                            st, mu5, mu15, btc_row, params=sp_arg
+                        )
+                    breached = self.drift.observe(
+                        drift,
+                        tick_ms=ts_ms,
+                        trace_id=trace.trace_id,
+                        snapshot_fn=self._flight_snapshot,
+                    )
+                    sp_audit.set(
+                        breached=len(breached),
+                        **{
+                            f"drift_{fam}": v["max_abs"]
+                            for fam, v in drift.items()
+                        },
+                    )
+            except Exception:
+                # metering must never take down the tick — the audit's
+                # resync below proceeds either way
+                self.drift.note_skipped()
+                logging.exception("carry-drift metering failed; audit "
+                                  "proceeds unmeasured")
+
+        # Drift-meter pre-warm: the measurement's jit entry (carry advance
+        # + full-window init + the comparison reductions) would otherwise
+        # compile SYNCHRONOUSLY inside the first audit tick — a
+        # multi-second stall on exactly the path the meter instruments.
+        # Warm it in the background on a THROWAWAY same-shape state (the
+        # jit cache keys on shapes; real state must not leak to a thread
+        # that could outlive the next donation), once per update-bucket
+        # shape, the first time that shape appears on an incremental tick.
+        if (
+            self.drift_meter_enabled
+            and use_incremental
+            and not self.config.is_ci
+        ):
+            # mirror the audit block's measured-arg resolution exactly:
+            # the measurement runs on each interval's LAST slot — which is
+            # the (4,)-padded empty slot when that interval has fewer
+            # sub-batches than the other — NOT the per-tick max bucket (a
+            # max-bucket warm would miss the audit's actual shape and the
+            # synchronous compile this block exists to prevent would run
+            # inside the audit tick anyway)
+            n_slots = max(len(batches5) or 1, len(batches15) or 1)
+
+            def _measured_bucket(batches):
+                if not batches or (len(batches) or 1) != n_slots:
+                    return 4  # the padded _empty_updates slot
+                m = len(batches[-1][0])
+                size = 1
+                while size < max(m, 1):
+                    size *= 2
+                return size
+
+            wsig = (_measured_bucket(batches5), _measured_bucket(batches15))
+            if wsig not in self._drift_warmed:
+                self._drift_warmed.add(wsig)
+                import threading
+
+                def _warm_drift(s5=wsig[0], s15=wsig[1]):
+                    try:
+                        st = initial_engine_state(
+                            self.capacity, window=self.window
+                        )
+                        e5 = pad_updates(
+                            np.zeros(0, np.int32), np.zeros(0, np.int32),
+                            np.zeros((0, 10), np.float32), size=s5,
+                        )
+                        e15 = pad_updates(
+                            np.zeros(0, np.int32), np.zeros(0, np.int32),
+                            np.zeros((0, 10), np.float32), size=s15,
+                        )
+                        with LEDGER.watch(
+                            "carry_drift_meter",
+                            f"S{self.capacity}xW{self.window} "
+                            f"u5[{s5}] u15[{s15}] warm",
+                            expect_compile=True,
+                        ):
+                            measure_carry_drift(st, e5, e15, -1)
+                    except Exception:
+                        logging.exception(
+                            "drift-meter pre-warm failed (non-fatal)"
+                        )
+
+                threading.Thread(target=_warm_drift, daemon=True).start()
+
         # Ordered sub-batch replay: fold all but the FINAL sub-batch into
         # the buffers, then run ONE full evaluation on the final state.
         # On the fast path the folds advance the carry too, so multi-bar
@@ -1523,14 +1712,6 @@ class SignalEngine:
         )
         trace.record_span("inputs_build", t_inputs0)
         donate = self._use_donated_step()
-        # explicit params override (backtest drives) — None stays the
-        # baked-constant live graph
-        if self.strategy_params is None:
-            sp_arg = None
-        else:
-            from binquant_tpu.strategies.params import dynamic_params
-
-            sp_arg = dynamic_params(self.strategy_params)
         with self.latency.stage("device_dispatch"), trace.span(
             "device_dispatch", incremental=use_incremental, donated=donate
         ), trace.activate():
@@ -1543,13 +1724,16 @@ class SignalEngine:
             small = _snapshot_small_carries(prev_state) if donate else None
             # recompile counter + symbols-per-tick gauge (engine/step.py's
             # shape-signature cache — a True return means the launch below
-            # pays a jax trace+compile)
-            observe_dispatch(
+            # pays a jax trace+compile, which the executable ledger then
+            # times and costs)
+            fn_name = "tick_step_wire_donated" if donate else "tick_step_wire"
+            is_new_sig = observe_dispatch(
                 prev_state, u5, u15, self._wire_enabled_key(),
                 cfg=self.context_config,
-                fn="tick_step_wire_donated" if donate else "tick_step_wire",
+                fn=fn_name,
                 incremental=use_incremental,
                 maintain_carry=self.incremental,
+                numeric_digest=self.numeric_digest,
             )
             # StepTraceAnnotation groups this tick's XLA work in profiler
             # captures; skipped entirely on untraced ticks outside a
@@ -1560,8 +1744,32 @@ class SignalEngine:
                 else contextlib.nullcontext()
             )
             step_fn = tick_step_wire_donated if donate else tick_step_wire
+            ledger_sig = self._ledger_sig(u5, u15, use_incremental)
+            cost_fn = None
+            if is_new_sig:
+                # cost thunk over ABSTRACT avals captured before the launch
+                # can donate the state — lowering is a re-trace, not a
+                # recompile, and runs on the ledger's background worker
+                (a_state, a_u5, a_u15, a_inputs), _ = abstract_args(
+                    (prev_state, u5, u15, inputs)
+                )
+                cfg_, key_ = self.context_config, self._wire_enabled_key()
+                incr_, maint_ = use_incremental, self.incremental
+                dig_ = self.numeric_digest
+
+                def cost_fn(fn=step_fn):
+                    return lowered_cost(
+                        fn, a_state, a_u5, a_u15, a_inputs, cfg_,
+                        wire_enabled=key_, incremental=incr_,
+                        maintain_carry=maint_, params=sp_arg,
+                        numeric_digest=dig_,
+                    )
+
             try:
-                with step_ctx:
+                with LEDGER.watch(
+                    fn_name, ledger_sig, expect_compile=is_new_sig,
+                    cost_fn=cost_fn, tick=self.ticks_processed,
+                ), step_ctx:
                     self.state, wire = step_fn(
                         prev_state,
                         u5,
@@ -1576,6 +1784,7 @@ class SignalEngine:
                         # read the carry — skip its full-window re-init
                         maintain_carry=self.incremental,
                         params=sp_arg,
+                        numeric_digest=self.numeric_digest,
                     )
             except BaseException:
                 if donate:
@@ -1605,8 +1814,9 @@ class SignalEngine:
         # the fallback re-evaluates with the SAME static variant the wire
         # step ran: full-window vs carried readouts differ by f32 epsilon,
         # and an overflow tick's emitted set must match the stream the
-        # incremental path certified
-        incr_args = (use_incremental, self.incremental)
+        # incremental path certified (numeric_digest rides along so the
+        # fallback wire keeps the engine's layout)
+        incr_args = (use_incremental, self.incremental, self.numeric_digest)
 
         if donate:
             # Donated dispatch: the pre-tick buffers no longer exist, so
@@ -1622,7 +1832,7 @@ class SignalEngine:
             def fallback(
                 _args=(small, inputs, cfg, key, incr_args, empty, sp_arg)
             ):
-                small_, inp, cfg_, key_, (incr_, maint_), emp, sp_ = _args
+                small_, inp, cfg_, key_, (incr_, maint_, dig_), emp, sp_ = _args
                 st = self.state._replace(
                     regime_carry=small_[0],
                     mrf_last_emitted=small_[1],
@@ -1632,6 +1842,7 @@ class SignalEngine:
                 _, full = tick_step(
                     st, emp, emp, inp, cfg_, wire_enabled=key_,
                     incremental=incr_, maintain_carry=maint_, params=sp_,
+                    numeric_digest=dig_,
                 )
                 return full
 
@@ -1646,10 +1857,12 @@ class SignalEngine:
                 _args=(prev_state, u5, u15, inputs, cfg, key, incr_args,
                        sp_arg)
             ):
-                st, upd5, upd15, inp, cfg_, key_, (incr_, maint_), sp_ = _args
+                st, upd5, upd15, inp, cfg_, key_, incrs, sp_ = _args
+                incr_, maint_, dig_ = incrs
                 _, full = tick_step(
                     st, upd5, upd15, inp, cfg_, wire_enabled=key_,
                     incremental=incr_, maintain_carry=maint_, params=sp_,
+                    numeric_digest=dig_,
                 )
                 return full
 
@@ -1680,13 +1893,20 @@ class SignalEngine:
             else:
                 warm_args = (prev_state, u5, u15, inputs, cfg, key, incr_args)
 
-            def _warm(args=warm_args, sp_=sp_arg):
+            def _warm(args=warm_args, sp_=sp_arg,
+                      sig_=f"{self._ledger_sig(u5, u15, use_incremental)} "
+                           "fallback"):
                 try:
-                    st, upd5, upd15, inp, cfg_, key_, (incr_, maint_) = args
-                    tick_step(
-                        st, upd5, upd15, inp, cfg_, wire_enabled=key_,
-                        incremental=incr_, maintain_carry=maint_, params=sp_,
-                    )
+                    st, upd5, upd15, inp, cfg_, key_, incrs = args
+                    incr_, maint_, dig_ = incrs
+                    # the ledger watch runs on THIS thread — compile events
+                    # attribute to the fallback entry, not the tick's
+                    with LEDGER.watch("tick_step", sig_, expect_compile=True):
+                        tick_step(
+                            st, upd5, upd15, inp, cfg_, wire_enabled=key_,
+                            incremental=incr_, maintain_carry=maint_,
+                            params=sp_, numeric_digest=dig_,
+                        )
                 except Exception:
                     logging.exception("fallback pre-warm failed (non-fatal)")
 
@@ -1727,12 +1947,29 @@ class SignalEngine:
         # ONE device fetch per tick: the packed wire (context scalars +
         # compacted fired entries). Everything host-side below reads it.
         with self.latency.stage("wire_fetch"), trace.span("wire_fetch") as sp_wire:
-            unpacked = unpack_wire(pending.wire)
+            unpacked = unpack_wire(
+                pending.wire, numeric_digest=self.numeric_digest
+            )
         fired_w, ctx_scalars = unpacked
         sp_wire.set(overflow=bool(fired_w.overflow))
         # resync pressure: beta/corr rows reading null until the next full
-        # recompute (absent from older/fabricated wires → 0)
+        # recompute (absent from older/fabricated wires → 0). This decode
+        # runs for EVERY backend — serial, donated, scanned, and backtest
+        # ticks all finalize here.
         BC_DIRTY_ROWS.set(int(ctx_scalars.get("bc_dirty_rows", 0) or 0))
+        # numeric-health digest (same trailing block on every backend's
+        # wire): gauges + anomaly force-emit (obs/numeric.py)
+        if "numeric_digest" in ctx_scalars:
+            with trace.span("numeric_digest") as sp_num:
+                digest = self.numeric.observe(
+                    ctx_scalars["numeric_digest"],
+                    tick_ms=pending.ts_ms,
+                    trace_id=trace.trace_id,
+                    snapshot_fn=self._flight_snapshot,
+                )
+                sp_num.set(
+                    nan_rows=digest["nan_total"], inf_rows=digest["inf_total"]
+                )
         # The full TickOutputs exists only if a degenerate path needs it:
         # compaction overflow (>WIRE_MAX_FIRED fired pairs) or a wire
         # without the emission payload. Re-running the full step costs one
@@ -2047,6 +2284,18 @@ class SignalEngine:
         self._tracked_cache = (self.registry.version, arr)
         return arr
 
+    def _ledger_sig(self, u5, u15, incremental: bool) -> str:
+        """Human-readable arg-shape signature for the executable ledger —
+        the same axes the jit cache keys on (buffer shape, padded update
+        buckets, path flags), compact enough for a metric-adjacent JSON."""
+        return (
+            f"S{self.capacity}xW{self.window}"
+            f" u5[{int(np.asarray(u5[0]).shape[-1])}]"
+            f" u15[{int(np.asarray(u15[0]).shape[-1])}]"
+            f" incr={int(bool(incremental))}"
+            f" digest={int(self.numeric_digest)}"
+        )
+
     def _wire_enabled_key(self) -> tuple[str, ...]:
         """The static wire_enabled tuple this engine compiles with — also
         the key into ``EMISSION_LAYOUTS`` for payload decoding."""
@@ -2196,7 +2445,18 @@ class SignalEngine:
         /healthz reports degraded liveness while they persist) and the
         warning is rate-limited — a full disk at a 1 s tick cadence must
         not turn the log into a firehose that buries real errors.
+
+        Also the boot compile_summary's polling point (every backend's
+        tick loop passes through here): emitted at the first heartbeat
+        where no ledger watch is in flight, so the fallback pre-warm's
+        background compile — which routinely outlives the first tick —
+        makes it into the once-per-boot totals.
         """
+        # >= 2 ticks: the incremental engine's SECOND tick compiles the
+        # fast-path wire variant (tick 1 is always the cold-start full
+        # recompute) — a summary cut at tick 1 would miss it
+        if self.ticks_processed > 1 and not LEDGER.summary_emitted:
+            LEDGER.emit_summary_when_quiet(reason="boot")
         try:
             self.heartbeat_path.write_text(str(time.time()))
             self._last_heartbeat_s = time.time()
@@ -2234,7 +2494,10 @@ class SignalEngine:
             "incremental_ticks": self.incremental_ticks,
             "full_recompute_ticks": self.full_recompute_ticks,
             "scanned_ticks": self.scanned_ticks,
+            "backtest_ticks": self.backtest_ticks,
             "carry_desync_reason": self._carry_desync_reason,
+            "numeric_anomaly_ticks": self.numeric.anomaly_ticks,
+            "drift_alarms": self.drift.alarms,
         }
 
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
@@ -2284,6 +2547,25 @@ class SignalEngine:
             "scanned_ticks": self.scanned_ticks,
             "scan_chunks": self.scan_chunks,
             "scan_overflow_reruns": self.scan_overflow_reruns,
+            # time-batched backtest chunks (multi-tick lanes only)
+            "backtest_ticks": self.backtest_ticks,
+            "backtest_chunks": self.backtest_chunks,
+            "backtest_overflow_reruns": self.backtest_overflow_reruns,
+            # numeric-health observatory (ISSUE 7): the last decoded wire
+            # digest, anomaly/alarm tallies, and the last audit tick's
+            # per-family carried-vs-fresh drift
+            "numeric": {
+                "digest_enabled": self.numeric_digest,
+                "nan_budget": self.numeric.nan_budget,
+                "anomaly_ticks": self.numeric.anomaly_ticks,
+                "last_digest": self.numeric.last,
+                "drift_meter": self.drift_meter_enabled,
+                "drift_tol": self.drift.tol,
+                "drift_audits": self.drift.audits,
+                "drift_alarms": self.drift.alarms,
+                "drift_audits_unmeasured": self.drift.skipped,
+                "last_drift": self.drift.last,
+            },
             # event-log drops (write failures / emit-after-close) — zero
             # in a healthy deployment
             "eventlog_dropped": get_event_log().dropped,

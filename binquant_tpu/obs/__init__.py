@@ -12,6 +12,12 @@ Three dependency-free pieces, importable everywhere (no jax, no httpx):
 * :mod:`tracing` — per-tick ``Tracer``/``Span`` trees with trace_id
   provenance, the slow-tick flight recorder ring, and the on-demand
   ``jax.profiler`` capture window (``/debug/profile`` + SIGUSR2).
+* :mod:`numeric` — the numeric-health observatory's host side: wire
+  digest decode → ``bqt_numeric_*`` metrics + ``numeric_anomaly``
+  force-emits, and the carry-drift audit meters (``bqt_carry_drift``).
+* :mod:`ledger` — the executable/compile ledger: per-jit-entry compile
+  wall time, persistent-cache warm/cold verdicts, lowered cost_analysis
+  bytes/flops (``/debug/executables``).
 
 The metric name catalogue lives in :mod:`instruments` (one definition per
 family — importing any instrumented module registers the whole catalogue,

@@ -20,7 +20,12 @@ client) that serves:
   read-only routes this one has a side effect (profiling overhead on the
   live tick loop + capture files on disk), so it only answers loopback
   peers unless ``profile_remote_ok`` is set (``BQT_PROFILE_REMOTE=1``) —
-  the scrape port is commonly reachable by the whole cluster.
+  the scrape port is commonly reachable by the whole cluster;
+* ``GET /debug/executables`` — the executable/compile ledger
+  (:data:`binquant_tpu.obs.ledger.LEDGER` by default): every jit entry
+  the engine owns with compile wall-time, warm-vs-cold persistent-cache
+  outcome, and per-dispatch ``cost_analysis`` bytes/flops. Read-only —
+  served to any peer like ``/metrics``.
 
 Started from ``main.py`` when ``BQT_METRICS_PORT`` is set; ``port=0``
 binds an ephemeral port (tests), reported by :meth:`MetricsServer.start`.
@@ -107,6 +112,7 @@ class MetricsServer:
         host: str = "0.0.0.0",
         profiler=None,
         profile_remote_ok: bool = False,
+        ledger=None,
     ) -> None:
         self.registry = registry if registry is not None else REGISTRY
         self.health_fn = health_fn
@@ -114,6 +120,9 @@ class MetricsServer:
         self.port = port
         self.profiler = profiler
         self.profile_remote_ok = profile_remote_ok
+        if ledger is None:
+            from binquant_tpu.obs.ledger import LEDGER as ledger
+        self.ledger = ledger
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -147,6 +156,17 @@ class MetricsServer:
         path, _, query = target.partition("?")
         if path == "/debug/profile":
             return self._route_profile(query, peer)
+        if path == "/debug/executables":
+            # read-only like /metrics; snapshot() is attribute reads under
+            # a lock, safe inline on the event loop
+            try:
+                payload = self.ledger.snapshot()
+            except Exception:
+                log.exception("executable-ledger snapshot crashed")
+                payload = {"error": "ledger_snapshot_failed"}
+            return self._respond(
+                200, "OK", "application/json", json.dumps(payload)
+            )
         if path == "/metrics":
             return self._respond(
                 200, "OK", CONTENT_TYPE_LATEST, render_text(self.registry)

@@ -107,6 +107,98 @@ SCAN_OVERFLOW_RERUNS = REGISTRY.counter(
     "tick's fired set overflowed the wire's compaction slots.",
 )
 
+# -- time-batched backtest backend (binquant_tpu/backtest) --------------------
+
+BACKTEST_TICKS = REGISTRY.counter(
+    "bqt_backtest_ticks_total",
+    "Replayed ticks evaluated inside time-batched backtest chunks "
+    "(full-recompute (S, W+T) kernel) instead of one dispatch each.",
+)
+BACKTEST_CHUNKS = REGISTRY.counter(
+    "bqt_backtest_chunks_total",
+    "Time-batched backtest chunk dispatches.",
+)
+BACKTEST_OVERFLOW_RERUNS = REGISTRY.counter(
+    "bqt_backtest_overflow_reruns_total",
+    "Backtest chunks re-driven through the serial per-tick path because "
+    "a tick's fired set overflowed the wire's compaction slots.",
+)
+
+# -- numeric-health observatory (ISSUE 7) -------------------------------------
+
+NUMERIC_NONFINITE = REGISTRY.gauge(
+    "bqt_numeric_nonfinite_rows",
+    "Rows with NaN/Inf leakage on the last digest-carrying tick, per "
+    "pipeline stage (features5 / features15 / indicators / strategies), "
+    "counted only among rows whose data-sufficiency gates promise finite "
+    "values — warm-up NaN is excluded by construction.",
+    labels=("stage", "kind"),
+)
+NUMERIC_ANOMALIES = REGISTRY.counter(
+    "bqt_numeric_anomaly_ticks_total",
+    "Ticks whose digest NaN/Inf leakage exceeded BQT_NUMERIC_NAN_BUDGET "
+    "(each force-emits a numeric_anomaly event with the decoded digest "
+    "and an engine snapshot).",
+)
+NUMERIC_ABSMAX = REGISTRY.gauge(
+    "bqt_numeric_absmax",
+    "Absolute-max of key device intermediates on the last digest-carrying "
+    "tick (close5 / close15 / volume5 / volume15 / score) — a runaway "
+    "series shows here before it NaNs.",
+    labels=("series",),
+)
+FIRED_PER_TICK = REGISTRY.histogram(
+    "bqt_fired_rows_per_tick",
+    "Device-side per-strategy trigger counts per digest-carrying tick "
+    "(pre-dedupe, pre-enablement-filter) — the fired-breadth histogram.",
+    labels=("strategy",),
+    buckets=(0.0, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0),
+)
+CARRY_DRIFT = REGISTRY.histogram(
+    "bqt_carry_drift",
+    "Max-abs drift between the carried indicator state and the fresh "
+    "full-recompute values, measured per family at every audit tick "
+    "BEFORE the resync overwrites the carry (BQT_CARRY_AUDIT_EVERY).",
+    labels=("family",),
+    buckets=(1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 100.0),
+)
+CARRY_DRIFT_ULP = REGISTRY.gauge(
+    "bqt_carry_drift_ulp",
+    "Max f32-ULP distance of the last audit tick's carried-vs-fresh "
+    "comparison, per family.",
+    labels=("family",),
+)
+CARRY_DRIFT_ALARMS = REGISTRY.counter(
+    "bqt_carry_drift_alarms_total",
+    "Audit ticks where a family's scale-normalized drift (each leaf's "
+    "max-abs over that leaf's magnitude scale, maxed across the family's "
+    "leaves) exceeded BQT_DRIFT_TOL — each force-emits a "
+    "carry_drift_alarm event.",
+    labels=("family",),
+)
+
+# -- executable/compile ledger (obs/ledger.py) --------------------------------
+
+COMPILE_SECONDS = REGISTRY.counter(
+    "bqt_compile_seconds",
+    "Wall seconds spent compiling each engine-owned jit executable "
+    "(first launch per dispatch signature; persistent-cache hits "
+    "deserialize in ~100ms and still count here).",
+    labels=("executable",),
+)
+EXECUTABLE_BYTES = REGISTRY.gauge(
+    "bqt_executable_bytes",
+    "XLA cost_analysis bytes-accessed of the newest recorded signature "
+    "per executable (the per-dispatch memory-traffic bill).",
+    labels=("executable",),
+)
+EXECUTABLE_FLOPS = REGISTRY.gauge(
+    "bqt_executable_flops",
+    "XLA cost_analysis flops of the newest recorded signature per "
+    "executable.",
+    labels=("executable",),
+)
+
 # -- ingest buffers + registry (engine/buffer.py) ---------------------------
 
 INGEST_DEDUP_OVERWRITES = REGISTRY.counter(

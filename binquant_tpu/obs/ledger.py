@@ -1,0 +1,406 @@
+"""Executable/compile ledger: every jit entry the engine owns, accounted.
+
+The engine's cost story lives in a handful of jit executables (the wire
+step, its donated twin, the full-outputs fallback, the fused scan, the
+backtest chunk and its sweep). Until now their compile cost was visible
+only as a counter (``bqt_jit_recompiles_total``) — nothing recorded how
+long each compile took, whether the XLA persistent compilation cache
+(PR 4 session-2) actually served it warm, or what the resulting
+executable costs per dispatch. This module is that registry:
+
+* **Compile wall-time + cache outcome** — the dispatch sites wrap their
+  first-per-signature launch in :meth:`ExecutableLedger.watch`; the
+  ledger listens on ``jax.monitoring`` (``backend_compile_duration``,
+  persistent-cache ``cache_hits``/``cache_misses``) and attributes events
+  fired during the watched window (compiles run synchronously on the
+  launching thread, so a thread-local watch is attribution enough) —
+  ``warm`` means the persistent cache deserialized the executable,
+  ``cold`` a full XLA compile, ``cache_off`` no cache configured.
+* **Per-dispatch cost** — callers hand the watch a ``cost_fn`` thunk
+  (typically ``lambda: fn.lower(*abstract_args).cost_analysis()`` over
+  ``jax.ShapeDtypeStruct`` trees captured BEFORE any donation); thunks
+  run on a background worker (a re-trace, not a recompile) and fill the
+  entry's bytes/flops — ``compute_costs()`` drains synchronously for
+  tests and tools.
+* **Exports** — ``bqt_compile_seconds{executable}`` /
+  ``bqt_executable_bytes{executable}`` / ``bqt_executable_flops`` metrics,
+  one ``compile`` event per recorded compile, a once-per-boot
+  ``compile_summary`` event (total compile seconds, warm/cold split), and
+  the ``GET /debug/executables`` JSON (obs/exposition.py).
+
+Everything here is hot-path-safe: a watch over an already-recorded
+signature that triggers no compile costs two perf_counter reads and a
+thread-local store.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def abstract_args(args: tuple, kwargs: dict | None = None):
+    """(args, kwargs) with every array leaf replaced by its
+    ``jax.ShapeDtypeStruct`` — a cost thunk built from these can lower the
+    executable long after the concrete buffers were donated/deleted."""
+    import jax
+
+    def to_abstract(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        return leaf
+
+    return (
+        jax.tree_util.tree_map(to_abstract, args),
+        jax.tree_util.tree_map(to_abstract, kwargs or {}),
+    )
+
+
+def lowered_cost(fn, *args, **kwargs) -> dict:
+    """``cost_analysis`` of ``fn`` lowered at these (abstract or concrete)
+    args — a jaxpr trace + lowering, NOT an XLA compile. Missing/NaN
+    fields become None (the snapshot is served as strict JSON — a bare
+    NaN token would break every downstream parser)."""
+    ca = fn.lower(*args, **kwargs).cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+
+    def field(key):
+        v = ca.get(key)
+        if v is None:
+            return None
+        v = float(v)
+        return v if v == v else None
+
+    return {
+        "flops": field("flops"),
+        "bytes_accessed": field("bytes accessed"),
+    }
+
+
+class ExecutableLedger:
+    """Thread-safe registry of (executable, signature) compile records."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._entries: dict[tuple[str, str], dict[str, Any]] = {}
+        self._pending_cost: list[tuple[tuple[str, str], Callable[[], dict]]] = []
+        self._cost_worker: threading.Thread | None = None
+        self._listeners_installed = False
+        self._summary_emitted = False
+        self._active_watches = 0
+        # process-wide tallies incl. compiles no watch was open for
+        # (library-internal jits, helper steps)
+        self.total_backend_compile_s = 0.0
+        self.unattributed_compile_s = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- jax.monitoring attribution ----------------------------------------
+
+    def _install_listeners(self) -> None:
+        if self._listeners_installed:
+            return
+        with self._lock:
+            if self._listeners_installed:
+                return
+            try:
+                import jax.monitoring as monitoring
+
+                monitoring.register_event_duration_secs_listener(
+                    self._on_duration
+                )
+                monitoring.register_event_listener(self._on_event)
+            except Exception:  # pragma: no cover - jax baked into the image
+                log.exception("jax.monitoring unavailable; ledger degrades "
+                              "to wall-time-only records")
+            self._listeners_installed = True
+
+    def _on_duration(self, name: str, duration: float, **kw: Any) -> None:
+        if name != _COMPILE_DURATION_EVENT:
+            return
+        watch = getattr(self._tls, "watch", None)
+        with self._lock:
+            self.total_backend_compile_s += duration
+            if watch is not None:
+                watch["backend_compile_s"] += duration
+                watch["compiled"] = True
+            else:
+                self.unattributed_compile_s += duration
+
+    def _on_event(self, name: str, **kw: Any) -> None:
+        if name not in (_CACHE_HIT_EVENT, _CACHE_MISS_EVENT):
+            return
+        hit = name == _CACHE_HIT_EVENT
+        watch = getattr(self._tls, "watch", None)
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+            if watch is not None:
+                watch["cache_hits" if hit else "cache_misses"] += 1
+
+    # -- recording -----------------------------------------------------------
+
+    @contextmanager
+    def watch(
+        self,
+        executable: str,
+        signature: str,
+        expect_compile: bool = True,
+        cost_fn: Callable[[], dict] | None = None,
+        tick: int | None = None,
+    ):
+        """Time the wrapped launch and record a ledger entry when it
+        compiled (``expect_compile`` marks the caller's own new-signature
+        verdict; a monitored compile records even without it — jit cache
+        evictions the caller's signature set missed)."""
+        self._install_listeners()
+        watch = {
+            "backend_compile_s": 0.0,
+            "compiled": False,
+            "cache_hits": 0,
+            "cache_misses": 0,
+        }
+        prev = getattr(self._tls, "watch", None)
+        self._tls.watch = watch
+        with self._lock:
+            self._active_watches += 1
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            wall = time.perf_counter() - t0
+            self._tls.watch = prev
+            if expect_compile or watch["compiled"]:
+                self._record(
+                    executable, signature, wall, watch, cost_fn, tick
+                )
+            with self._lock:
+                self._active_watches -= 1
+
+    def _record(
+        self,
+        executable: str,
+        signature: str,
+        wall_s: float,
+        watch: dict,
+        cost_fn: Callable[[], dict] | None,
+        tick: int | None,
+    ) -> None:
+        from binquant_tpu.obs.events import get_event_log
+        from binquant_tpu.obs.instruments import COMPILE_SECONDS
+
+        if watch["cache_hits"] and not watch["cache_misses"]:
+            cache = "warm"
+        elif watch["cache_misses"]:
+            cache = "cold"
+        else:
+            cache = "cache_off" if watch["compiled"] else "unknown"
+        key = (executable, signature)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = {
+                    "executable": executable,
+                    "signature": signature,
+                    "compiles": 0,
+                    "compile_seconds": 0.0,
+                    "backend_compile_seconds": 0.0,
+                    "cache": cache,
+                    "cost": None,
+                    "cost_status": "none",
+                    "first_recorded_s": time.time(),
+                    "tick": tick,
+                }
+                self._entries[key] = entry
+            entry["compiles"] += 1
+            entry["compile_seconds"] += wall_s
+            entry["backend_compile_seconds"] += watch["backend_compile_s"]
+            entry["cache"] = cache
+            if cost_fn is not None and entry["cost_status"] in ("none", "error"):
+                entry["cost_status"] = "pending"
+                self._pending_cost.append((key, cost_fn))
+                start_worker = True
+            else:
+                start_worker = False
+        COMPILE_SECONDS.labels(executable=executable).inc(wall_s)
+        get_event_log().emit(
+            "compile",
+            executable=executable,
+            signature=signature,
+            seconds=round(wall_s, 3),
+            backend_compile_s=round(watch["backend_compile_s"], 3),
+            cache=cache,
+        )
+        if start_worker:
+            self._ensure_cost_worker()
+
+    # -- cost analysis (background) ------------------------------------------
+
+    def _ensure_cost_worker(self) -> None:
+        with self._lock:
+            worker = self._cost_worker
+            if worker is not None and worker.is_alive():
+                return
+            worker = threading.Thread(
+                target=self._drain_costs, name="bqt-ledger-cost", daemon=True
+            )
+            self._cost_worker = worker
+        worker.start()
+
+    def _drain_costs(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending_cost:
+                    # retire under the SAME lock _record appends under: a
+                    # thunk queued after this point sees _cost_worker dead
+                    # (None) and starts a fresh worker — without this, a
+                    # record racing an exiting-but-alive thread would
+                    # strand its thunk as cost_status='pending' forever
+                    self._cost_worker = None
+                    return
+                key, cost_fn = self._pending_cost.pop(0)
+            self._compute_one(key, cost_fn)
+
+    def _compute_one(
+        self, key: tuple[str, str], cost_fn: Callable[[], dict]
+    ) -> None:
+        from binquant_tpu.obs.instruments import (
+            EXECUTABLE_BYTES,
+            EXECUTABLE_FLOPS,
+        )
+
+        try:
+            cost = cost_fn()
+        except Exception as exc:
+            log.warning("cost analysis failed for %s: %r", key, exc)
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    entry["cost_status"] = "error"
+                    entry["cost"] = {"error": repr(exc)}
+            return
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return
+            entry["cost"] = cost
+            entry["cost_status"] = "ok"
+            executable = entry["executable"]
+        b = cost.get("bytes_accessed")
+        f = cost.get("flops")
+        if b is not None and b == b:
+            EXECUTABLE_BYTES.labels(executable=executable).set(b)
+        if f is not None and f == f:
+            EXECUTABLE_FLOPS.labels(executable=executable).set(f)
+
+    def compute_costs(self, timeout_s: float = 120.0) -> bool:
+        """Drain the queue inline AND wait out any thunk the background
+        worker already claimed, so callers (tests, tools) observe a settled
+        ledger; False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                item = self._pending_cost.pop(0) if self._pending_cost else None
+            if item is not None:
+                self._compute_one(*item)
+                continue
+            with self._lock:
+                settled = not any(
+                    e["cost_status"] == "pending"
+                    for e in self._entries.values()
+                )
+            if settled:
+                return True
+            time.sleep(0.01)  # worker mid-thunk: let it finish
+        return False
+
+    # -- exports -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``/debug/executables`` payload (JSON-safe)."""
+        with self._lock:
+            entries = [dict(e) for e in self._entries.values()]
+            totals = {
+                "executables": len(entries),
+                "compiles": sum(e["compiles"] for e in entries),
+                "compile_seconds": round(
+                    sum(e["compile_seconds"] for e in entries), 3
+                ),
+                "backend_compile_seconds_total": round(
+                    self.total_backend_compile_s, 3
+                ),
+                "unattributed_compile_seconds": round(
+                    self.unattributed_compile_s, 3
+                ),
+                "persistent_cache_hits": self.cache_hits,
+                "persistent_cache_misses": self.cache_misses,
+                "cost_pending": len(self._pending_cost),
+            }
+        entries.sort(key=lambda e: -e["compile_seconds"])
+        for e in entries:
+            e["compile_seconds"] = round(e["compile_seconds"], 3)
+            e["backend_compile_seconds"] = round(
+                e["backend_compile_seconds"], 3
+            )
+        return {"totals": totals, "executables": entries}
+
+    def emit_summary(self, reason: str = "startup") -> dict | None:
+        """One ``compile_summary`` event per boot (the satellite's
+        boot-cost visibility): total compile seconds, warm/cold split.
+        Subsequent calls are no-ops."""
+        from binquant_tpu.obs.events import get_event_log
+
+        with self._lock:
+            if self._summary_emitted:
+                return None
+            self._summary_emitted = True
+        snap = self.snapshot()
+        return get_event_log().emit(
+            "compile_summary", reason=reason, **snap["totals"]
+        )
+
+    def emit_summary_when_quiet(self, reason: str = "startup") -> dict | None:
+        """Emit the boot summary only once NO watch is in flight — the
+        background fallback pre-warm's multi-second compile is usually
+        still running when the first tick finalizes, and a summary
+        snapshotted then would permanently under-report the boot's
+        largest single compile (once-guarded, so there is no second
+        chance). Callers poll this once per tick until it fires."""
+        with self._lock:
+            if self._summary_emitted or self._active_watches > 0:
+                return None
+        return self.emit_summary(reason=reason)
+
+    @property
+    def summary_emitted(self) -> bool:
+        return self._summary_emitted
+
+    def reset(self) -> None:
+        """Test isolation: drop entries/tallies (listeners stay installed —
+        jax.monitoring offers no targeted unregister)."""
+        with self._lock:
+            self._entries.clear()
+            self._pending_cost.clear()
+            self._summary_emitted = False
+            self.total_backend_compile_s = 0.0
+            self.unattributed_compile_s = 0.0
+            self.cache_hits = 0
+            self.cache_misses = 0
+
+
+#: Process-global ledger: every engine dispatch site records here, and
+#: /debug/executables serves it.
+LEDGER = ExecutableLedger()

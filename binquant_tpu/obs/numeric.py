@@ -1,0 +1,155 @@
+"""Host side of the numeric-health observatory (ISSUE 7).
+
+The device computes the digest (``engine/step.py _numeric_digest_block``,
+riding the wire behind the static ``numeric_digest`` flag) and the
+audit-tick drift scalars (``measure_carry_drift``); this module is their
+host consumer: decode → ``bqt_numeric_*`` / ``bqt_carry_drift*`` metric
+families, the ``/healthz`` ``numeric`` section, and the force-emitted
+``numeric_anomaly`` / ``carry_drift_alarm`` events (flight-recorder
+style: event + engine snapshot, emitted unconditionally — not sampled).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.instruments import (
+    CARRY_DRIFT,
+    CARRY_DRIFT_ALARMS,
+    CARRY_DRIFT_ULP,
+    FIRED_PER_TICK,
+    NUMERIC_ABSMAX,
+    NUMERIC_ANOMALIES,
+    NUMERIC_NONFINITE,
+)
+
+log = logging.getLogger(__name__)
+
+
+class NumericHealthMonitor:
+    """Per-engine digest consumer: decode each digest-carrying tick,
+    keep the gauges + last-decoded state current, and force-emit
+    ``numeric_anomaly`` when leakage exceeds the budget.
+
+    ``event_every`` additionally files a periodic ``numeric_digest``
+    event (default: the carry-audit cadence) so offline tools
+    (``tools/health_report.py``) can render the latest digest from the
+    event log alone — anomalies are loud, health is sampled.
+    """
+
+    def __init__(self, nan_budget: int = 0, event_every: int = 256) -> None:
+        self.nan_budget = int(nan_budget)
+        self.event_every = max(int(event_every), 1)
+        self.last: dict | None = None
+        self.anomaly_ticks = 0
+        self._ticks_seen = 0
+
+    def observe(
+        self,
+        digest_vec,
+        tick_ms: int | None = None,
+        trace_id: str | None = None,
+        snapshot_fn: Callable[[], dict] | None = None,
+    ) -> dict:
+        """Decode one tick's digest block; returns the decoded dict."""
+        from binquant_tpu.engine.step import decode_numeric_digest
+
+        digest = decode_numeric_digest(digest_vec)
+        self.last = digest
+        self._ticks_seen += 1
+
+        for stage, count in digest["nan_rows"].items():
+            NUMERIC_NONFINITE.labels(stage=stage, kind="nan").set(count)
+        for stage, count in digest["inf_rows"].items():
+            NUMERIC_NONFINITE.labels(stage=stage, kind="inf").set(count)
+        # the device gate for strategy outputs is ~isfinite (NaN AND Inf
+        # in one count) — label it honestly instead of folding Inf
+        # leakage under kind="nan"
+        NUMERIC_NONFINITE.labels(stage="strategies", kind="nonfinite").set(
+            sum(digest["strategy_nonfinite"].values())
+        )
+        for series, stats in digest["series"].items():
+            if stats["absmax"] is not None:
+                NUMERIC_ABSMAX.labels(series=series).set(stats["absmax"])
+        for strategy, count in digest["fired"].items():
+            FIRED_PER_TICK.labels(strategy=strategy).observe(count)
+
+        leakage = digest["nan_total"] + digest["inf_total"]
+        anomaly = leakage > self.nan_budget
+        if anomaly:
+            self.anomaly_ticks += 1
+            NUMERIC_ANOMALIES.inc()
+            # force-emit, flight-recorder style: the event carries the
+            # decoded digest AND what the engine looked like
+            get_event_log().emit(
+                "numeric_anomaly",
+                leakage_rows=leakage,
+                budget=self.nan_budget,
+                digest=digest,
+                tick_ms=tick_ms,
+                trace_id=trace_id,
+                engine=snapshot_fn() if snapshot_fn is not None else {},
+            )
+        elif self._ticks_seen % self.event_every == 0:
+            get_event_log().emit(
+                "numeric_digest", digest=digest, tick_ms=tick_ms
+            )
+        return digest
+
+
+class DriftMeter:
+    """Audit-tick drift consumer: histogram/gauge exports, the alarm
+    event, and the last measured values for ``/healthz``."""
+
+    def __init__(self, tol: float = 0.05) -> None:
+        self.tol = float(tol)
+        self.last: dict | None = None
+        self.audits = 0
+        self.alarms = 0
+        self.skipped = 0  # audit ticks the meter could not cover
+
+    def observe(
+        self,
+        drift: dict[str, dict[str, Any]],
+        tick_ms: int | None = None,
+        trace_id: str | None = None,
+        snapshot_fn: Callable[[], dict] | None = None,
+    ) -> list[str]:
+        """Record one audit tick's per-family drift; returns the families
+        (possibly empty) whose RELATIVE drift breached the tolerance (the
+        families span price/volume-sum/correlation scales, so the alarm
+        judges the scale-free number; max_abs rides the histogram)."""
+        self.last = drift
+        self.audits += 1
+        breached: list[str] = []
+        for family, v in drift.items():
+            CARRY_DRIFT.labels(family=family).observe(v["max_abs"])
+            CARRY_DRIFT_ULP.labels(family=family).set(v["max_ulp"])
+            if v["compared"] > 0 and v.get("max_rel", 0.0) > self.tol:
+                breached.append(family)
+                CARRY_DRIFT_ALARMS.labels(family=family).inc()
+        get_event_log().emit("carry_drift", drift=drift, tick_ms=tick_ms,
+                             trace_id=trace_id)
+        if breached:
+            self.alarms += 1
+            get_event_log().emit(
+                "carry_drift_alarm",
+                families=sorted(breached),
+                tol=self.tol,
+                drift=drift,
+                tick_ms=tick_ms,
+                trace_id=trace_id,
+                engine=snapshot_fn() if snapshot_fn is not None else {},
+            )
+        return breached
+
+    def note_skipped(self) -> None:
+        """An audit tick the meter failed to measure (the pipeline's
+        crash-isolation path — metering must never take down the tick;
+        multi-slot drains ARE measured via the carry-advancing fold
+        replay). Sustained growth of the /healthz
+        ``drift_audits_unmeasured`` counter means real metering failures,
+        not expected structural skips."""
+        self.skipped += 1

@@ -40,7 +40,13 @@ full-window kernels at the init tick, since both evaluate the same
 expressions) and ``*_advance`` (one bar, O(1) bytes per symbol). Parity
 against the full-window path is pinned in tests/test_ops_parity.py
 (TestIncrementalOps); drift from f32 accumulation is bounded in production
-by the engine's periodic full-recompute audit (io/pipeline.py).
+by the engine's periodic full-recompute audit (io/pipeline.py) — and,
+since ISSUE 7, *measured* there: every audit tick compares the carried
+values against the fresh re-init per family BEFORE the resync overwrites
+them (``engine/step.py measure_carry_drift`` → ``bqt_carry_drift{family}``
+histograms + the ``BQT_DRIFT_TOL`` alarm), so accumulation residue,
+sorted-window multiset divergence, and the supertrend forgotten-prefix
+gap are production-visible numbers, not assumptions.
 
 All carries are flat pytrees of (S,)/(S, k) arrays: they ride EngineState,
 checkpoint with it, and shard over the symbol mesh by the existing

@@ -182,6 +182,7 @@ async def run_live() -> None:
     metrics_server = None
     if config.metrics_port:
         from binquant_tpu.obs.exposition import MetricsServer
+        from binquant_tpu.obs.ledger import LEDGER
 
         metrics_server = MetricsServer(
             health_fn=lambda: engine.health_snapshot(config.heartbeat_max_age_s),
@@ -190,6 +191,9 @@ async def run_live() -> None:
             # /debug/profile is side-effectful: loopback-only unless the
             # deploy explicitly opens it to the network
             profile_remote_ok=config.profile_remote_ok,
+            # /debug/executables: the engine's compile/cost ledger
+            # (read-only, served like /metrics)
+            ledger=LEDGER,
         )
         await metrics_server.start()
 

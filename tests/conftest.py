@@ -41,6 +41,14 @@ os.environ.setdefault("BQT_DONATE", "0")
 # tracing coverage opts in explicitly by installing a Tracer(sample=1.0)
 # on the engine under test (tests/test_tracing.py, tests/test_obs.py).
 os.environ.setdefault("BQT_TRACE_SAMPLE", "0")
+# Numeric-health digest + carry-drift meter default OFF for the tier-1
+# lane: the digest is a STATIC wire-layout flag (on would change every
+# engine's wire executable and break fabricated-wire fixtures), and the
+# drift meter compiles one extra jit executable per audit-carrying
+# engine. Production defaults stay ON (binquant_tpu/config.py); the
+# numeric-health coverage opts in explicitly (tests/test_numeric_health.py).
+os.environ.setdefault("BQT_NUMERIC_DIGEST", "0")
+os.environ.setdefault("BQT_DRIFT_METER", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

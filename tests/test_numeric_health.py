@@ -1,0 +1,517 @@
+"""Numeric-health observatory (ISSUE 7): wire digest, carry-drift audit
+meters, and the executable/compile ledger.
+
+Tier-1 keeps the small-shape drills: digest layout + bit-identical-when-off
+parity (the acceptance pin), an engineered NaN-injection tick through a
+real engine (digest counts + the anomaly force-emit + ledger entries), the
+unit-level drift meter (clean ≈ 0, the PR-4 supertrend forgotten-prefix
+divergence measurably nonzero), the ledger/exposition units, and the
+health-report golden. The scanned/backtest digest ride-along is
+slow-marked into ``make obs-smoke``.
+"""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from binquant_tpu.engine.buffer import NUM_FIELDS, Field
+from binquant_tpu.engine.step import (
+    DRIFT_FAMILIES,
+    NUMERIC_DIGEST_WIDTH,
+    apply_updates_carry_step,
+    apply_updates_step,
+    decode_numeric_digest,
+    default_host_inputs,
+    init_indicator_carry,
+    initial_engine_state,
+    measure_carry_drift,
+    numeric_digest_layout,
+    pad_updates,
+    tick_step_wire,
+    unpack_wire,
+    wire_length,
+)
+from binquant_tpu.obs.events import EventLog, set_event_log
+from binquant_tpu.obs.ledger import ExecutableLedger, lowered_cost
+from tests.conftest import make_ohlcv
+
+S_CAP = 16
+WINDOW = 130
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    set_event_log(log)
+    yield path
+    log.close()
+    set_event_log(None)
+
+
+def _read_events(path) -> list[dict]:
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def _bar_updates(frames: dict[int, dict], bar: int, size: int):
+    rows, tss, vals = [], [], []
+    for row, d in frames.items():
+        v = np.zeros(NUM_FIELDS, dtype=np.float32)
+        v[Field.OPEN], v[Field.HIGH] = d["open"][bar], d["high"][bar]
+        v[Field.LOW], v[Field.CLOSE] = d["low"][bar], d["close"][bar]
+        v[Field.VOLUME] = d["volume"][bar]
+        v[Field.QUOTE_VOLUME] = d["quote_asset_volume"][bar]
+        v[Field.NUM_TRADES] = 100
+        v[Field.DURATION_S] = 900
+        rows.append(row)
+        tss.append(int(d["open_time"][bar]) // 1000)
+        vals.append(v)
+    return pad_updates(
+        np.array(rows, np.int32), np.array(tss, np.int32), np.stack(vals),
+        size=size,
+    )
+
+
+def _seeded_state(n_rows=8, n_bars=WINDOW, seed=3):
+    """Engine state with ``n_bars`` clean appends on both intervals (bulk
+    buffer-only folds — no evaluation)."""
+    rng = np.random.default_rng(seed)
+    frames = {
+        i: make_ohlcv(rng, n=n_bars, start_price=30 + i, vol=0.006)
+        for i in range(n_rows)
+    }
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    for b in range(n_bars):
+        upd = _bar_updates(frames, b, S_CAP)
+        state = apply_updates_step(state, upd, upd)
+    return state, frames
+
+
+def _inputs(ts_s: int, n_rows=8):
+    tracked = np.zeros(S_CAP, dtype=bool)
+    tracked[:n_rows] = True
+    return default_host_inputs(S_CAP)._replace(
+        tracked=jnp.asarray(tracked),
+        btc_row=np.int32(0),
+        timestamp_s=np.int32(ts_s),
+        timestamp5_s=np.int32(ts_s),
+    )
+
+
+def test_digest_layout_matches_width():
+    layout = numeric_digest_layout()
+    assert len(layout) == NUMERIC_DIGEST_WIDTH
+    assert layout[0] == "features5.nan_rows"
+    # every field name unique (the decode relies on positional order)
+    assert len(set(layout)) == len(layout)
+
+
+def test_wire_bit_identical_with_digest_off_and_append_only():
+    """The acceptance pin: BQT_NUMERIC_DIGEST=0 compiles the PR-6 wire
+    bit-for-bit (same length, same bits), and the enabled digest is a
+    strict append — every pre-digest offset survives."""
+    state, frames = _seeded_state()
+    ts = int(frames[0]["open_time"][-1]) // 1000
+    upd = _bar_updates(frames, WINDOW - 1, S_CAP)
+    inputs = _inputs(ts)
+
+    _, w_default = tick_step_wire(state, upd, upd, inputs)
+    _, w_off = tick_step_wire(state, upd, upd, inputs, numeric_digest=False)
+    _, w_on = tick_step_wire(state, upd, upd, inputs, numeric_digest=True)
+    w_default, w_off, w_on = map(np.asarray, (w_default, w_off, w_on))
+
+    assert w_off.shape == (wire_length(S_CAP),)
+    assert np.array_equal(w_default.view(np.int32), w_off.view(np.int32))
+    assert w_on.shape == (wire_length(S_CAP, numeric_digest=True),)
+    assert np.array_equal(
+        w_on[: len(w_off)].view(np.int32), w_off.view(np.int32)
+    )
+
+    # decode: clean seeded data → zero leakage, sane series stats
+    _, ctx = unpack_wire(w_on, numeric_digest=True)
+    digest = decode_numeric_digest(ctx["numeric_digest"])
+    assert digest["nan_total"] == 0
+    assert digest["inf_total"] == 0
+    assert digest["series"]["close5"]["absmax"] is not None
+    assert digest["series"]["close5"]["min"] > 0
+    # the digest-off decode carries no digest key
+    _, ctx_off = unpack_wire(w_off)
+    assert "numeric_digest" not in ctx_off
+
+
+def test_nan_injection_counts_and_anomaly_force_emit(event_log):
+    """A NaN close smuggled past the sufficiency gates shows up in the
+    digest's feature-stage counts and force-emits a ``numeric_anomaly``
+    event with the engine snapshot; the ledger records the engine's wire
+    executable with nonzero cost fields."""
+    from binquant_tpu.io.replay import make_stub_engine
+    from binquant_tpu.obs.ledger import LEDGER
+
+    eng = make_stub_engine(
+        capacity=S_CAP, window=WINDOW, incremental=False, donate=False
+    )
+    eng.numeric_digest = True
+    assert eng.numeric.nan_budget == 0
+
+    rng = np.random.default_rng(11)
+    n_rows = 8
+    t0 = 1_780_272_000
+    frames = {
+        i: make_ohlcv(
+            rng, n=WINDOW, start_price=30 + i, vol=0.006,
+            interval_ms=900_000, t0=t0 * 1000,
+        )
+        for i in range(n_rows)
+    }
+    names = [f"S{i:03d}" for i in range(n_rows)]
+    for i, name in enumerate(names):
+        assert eng.registry.add(name) == i
+
+    def feed_bar(bar: int, poison_row: int | None = None):
+        # each bar feeds BOTH interval batchers (a 5m and a 15m candle at
+        # the same open) so both rings reach sufficiency
+        for i, name in enumerate(names):
+            d = frames[i]
+            close = float(d["close"][bar])
+            if i == poison_row:
+                close = float("nan")
+            base = {
+                "symbol": name,
+                "open_time": int(d["open_time"][bar]),
+                "open": float(d["open"][bar]),
+                "high": float(d["high"][bar]),
+                "low": float(d["low"][bar]),
+                "close": close,
+                "volume": float(d["volume"][bar]),
+                "quote_asset_volume": float(d["quote_asset_volume"][bar]),
+                "number_of_trades": 100,
+                "taker_buy_base_volume": 1.0,
+                "taker_buy_quote_volume": 1.0,
+            }
+            for dur_ms in (300_000, 900_000):
+                eng.ingest(
+                    dict(base, close_time=base["open_time"] + dur_ms - 1)
+                )
+
+    async def go():
+        # bulk history in one tick (deep update-only folds), then the
+        # poisoned tick
+        for b in range(WINDOW - 1):
+            feed_bar(b)
+        clean_ts = int(frames[0]["open_time"][WINDOW - 2]) // 1000
+        await eng.process_tick(now_ms=(clean_ts + 900) * 1000)
+        assert eng.numeric.last is not None
+        assert eng.numeric.last["nan_total"] == 0
+        assert eng.numeric.anomaly_ticks == 0
+
+        feed_bar(WINDOW - 1, poison_row=0)
+        bad_ts = int(frames[0]["open_time"][WINDOW - 1]) // 1000
+        await eng.process_tick(now_ms=(bad_ts + 900) * 1000)
+
+    asyncio.run(go())
+
+    digest = eng.numeric.last
+    assert digest is not None
+    # the poisoned row is sufficiency-qualified (filled >= MIN_BARS) on
+    # both intervals, so both feature stages count it
+    assert digest["nan_rows"]["features5"] >= 1
+    assert digest["nan_rows"]["features15"] >= 1
+    assert eng.numeric.anomaly_ticks == 1
+    events = _read_events(event_log)
+    anomalies = [e for e in events if e["event"] == "numeric_anomaly"]
+    assert len(anomalies) == 1
+    assert anomalies[0]["digest"]["nan_rows"]["features5"] >= 1
+    assert anomalies[0]["leakage_rows"] > 0
+    assert "queue_depth" in anomalies[0]["engine"]
+    # healthz carries the numeric section
+    numeric = eng.health_snapshot()["numeric"]
+    assert numeric["digest_enabled"] is True
+    assert numeric["anomaly_ticks"] == 1
+    assert numeric["last_digest"]["nan_rows"]["features5"] >= 1
+
+    # -- ledger satellite: the engine's wire executable is on the books
+    # with a compile record and (after a synchronous drain) nonzero cost
+    snap = LEDGER.snapshot()
+    wire_entries = [
+        e for e in snap["executables"]
+        if e["executable"] == "tick_step_wire"
+        and f"S{S_CAP}xW{WINDOW}" in e["signature"]
+        and "digest=1" in e["signature"]
+    ]
+    assert wire_entries, snap["executables"]
+    LEDGER.compute_costs()
+    snap = LEDGER.snapshot()
+    entry = next(
+        e for e in snap["executables"]
+        if e["executable"] == "tick_step_wire"
+        and f"S{S_CAP}xW{WINDOW}" in e["signature"]
+        and "digest=1" in e["signature"]
+    )
+    assert entry["compile_seconds"] > 0
+    assert entry["cost_status"] == "ok"
+    assert entry["cost"]["bytes_accessed"] > 0
+    assert entry["cost"]["flops"] > 0
+    compiles = [e for e in _read_events(event_log) if e["event"] == "compile"]
+    assert any(e["executable"] == "tick_step_wire" for e in compiles)
+
+
+def test_drift_meter_clean_stream_is_quiet():
+    """One carried advance from a fresh resync vs a fresh init: every
+    family compares rows and the relative drift stays far below the alarm
+    default (the audit meters must not cry wolf on healthy streams)."""
+    rng = np.random.default_rng(5)
+    frames = {
+        i: make_ohlcv(rng, n=WINDOW + 1, start_price=30 + i, vol=0.006)
+        for i in range(8)
+    }
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    for b in range(WINDOW):
+        upd = _bar_updates(frames, b, S_CAP)
+        state = apply_updates_step(state, upd, upd)
+    state = state._replace(
+        indicator_carry=init_indicator_carry(state.buf5, state.buf15, 0)
+    )
+    upd = _bar_updates(frames, WINDOW, S_CAP)
+    drift = measure_carry_drift(state, upd, upd, 0)
+    assert set(drift) == set(DRIFT_FAMILIES)
+    for fam in ("ewm", "sums", "moments", "abp_sorted", "lsp_sorted"):
+        assert drift[fam]["compared"] > 0, fam
+        assert drift[fam]["max_rel"] < 0.02, (fam, drift[fam])
+    # beta/corr pairs advanced in lockstep with the BTC row → clean too
+    assert drift["beta_corr"]["max_rel"] < 0.02
+
+
+def test_drift_meter_measures_supertrend_divergence():
+    """The PR-4 NOTE's divergence, now production-visible: the carried
+    supertrend recursion continues ONE scan while the full path re-anchors
+    at the sliding dropna'd-frame seed every tick — after enough
+    un-resynced advances the drift meter reads a nonzero gap."""
+    n_extra = 40
+    rng = np.random.default_rng(9)
+    frames = {
+        i: make_ohlcv(
+            rng, n=WINDOW + n_extra, start_price=30 + i, vol=0.02
+        )
+        for i in range(8)
+    }
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    for b in range(WINDOW):
+        upd = _bar_updates(frames, b, S_CAP)
+        state = apply_updates_step(state, upd, upd)
+    state = state._replace(
+        indicator_carry=init_indicator_carry(state.buf5, state.buf15, 0)
+    )
+    # advance the carry through n_extra-1 bars with NO resync, then
+    # measure on the final bar
+    for b in range(WINDOW, WINDOW + n_extra - 1):
+        upd = _bar_updates(frames, b, S_CAP)
+        state = apply_updates_carry_step(state, upd, upd, btc_row=0)
+    upd = _bar_updates(frames, WINDOW + n_extra - 1, S_CAP)
+    drift = measure_carry_drift(state, upd, upd, 0)
+    st = drift["supertrend"]
+    assert st["compared"] > 0
+    assert st["max_abs"] > 0.0
+    assert st["max_ulp"] >= 1
+
+
+def test_ledger_watch_cost_and_debug_route(event_log):
+    """Unit: a watched jit compile lands in the ledger with wall time,
+    cost fields fill on a synchronous drain, and /debug/executables
+    serves the snapshot."""
+    import jax
+
+    led = ExecutableLedger()
+    fn = jax.jit(lambda x: jnp.tanh(x) * 2.0 + 1.0)
+    x = jnp.ones((64,), jnp.float32)
+    with led.watch(
+        "unit_fn", "x[64]", expect_compile=True,
+        cost_fn=lambda: lowered_cost(fn, x),
+    ):
+        np.asarray(fn(x))
+    led.compute_costs()
+    snap = led.snapshot()
+    assert snap["totals"]["executables"] == 1
+    entry = snap["executables"][0]
+    assert entry["executable"] == "unit_fn"
+    assert entry["compiles"] == 1
+    assert entry["compile_seconds"] > 0
+    assert entry["cost_status"] == "ok"
+    assert entry["cost"]["bytes_accessed"] > 0
+    # warm path: same signature re-watched with expect_compile=False and
+    # no compile fired records nothing new
+    with led.watch("unit_fn", "x[64]", expect_compile=False):
+        np.asarray(fn(x))
+    assert led.snapshot()["totals"]["compiles"] == 1
+    # compile event carries the cache verdict
+    compile_events = [
+        e for e in _read_events(event_log) if e["event"] == "compile"
+    ]
+    assert len(compile_events) == 1
+    assert compile_events[0]["cache"] in ("warm", "cold", "cache_off")
+    # summary is once-guarded
+    assert led.emit_summary(reason="test") is not None
+    assert led.emit_summary(reason="test") is None
+
+    from binquant_tpu.obs.exposition import MetricsServer
+
+    server = MetricsServer(health_fn=lambda: {"status": "ok"}, ledger=led)
+    raw = server._route("/debug/executables")
+    body = raw.split(b"\r\n\r\n", 1)[1]
+    payload = json.loads(body)
+    assert payload["totals"]["executables"] == 1
+    assert payload["executables"][0]["executable"] == "unit_fn"
+
+
+GOLDEN_EVENTS = [
+    {
+        "event": "numeric_digest",
+        "digest": {
+            "nan_rows": {"features5": 0, "features15": 1, "indicators": 0},
+            "inf_rows": {"features5": 0, "features15": 0, "indicators": 0},
+            "strategy_nonfinite": {"activity_burst_pump": 2},
+            "fired": {"mean_reversion_fade": 3, "grid_ladder": 0},
+            "series": {
+                "close5": {"min": 1.5, "max": 120.0, "absmax": 120.0},
+                "score": {"min": None, "max": None, "absmax": None},
+            },
+            "nan_total": 3,
+            "inf_total": 0,
+        },
+    },
+    {
+        "event": "carry_drift",
+        "drift": {
+            "ewm": {
+                "max_abs": 1.5e-05, "max_rel": 2e-07, "max_ulp": 2,
+                "compared": 144,
+            },
+            "supertrend": {
+                "max_abs": 1.25, "max_rel": 0.012, "max_ulp": 131072,
+                "compared": 16,
+            },
+        },
+    },
+    {
+        "event": "compile",
+        "executable": "tick_step_wire",
+        "seconds": 7.25,
+        "cache": "cold",
+    },
+    {
+        "event": "compile_summary",
+        "compile_seconds": 7.25,
+        "executables": 1,
+        "persistent_cache_hits": 0,
+        "persistent_cache_misses": 1,
+    },
+]
+
+GOLDEN_REPORT = """\
+== numeric digest ==
+  source numeric_digest  nan_total 3  inf_total 0  anomaly_events 0
+  features15   nan_rows     1  inf_rows     0
+  features5    nan_rows     0  inf_rows     0
+  indicators   nan_rows     0  inf_rows     0
+  strategies   nonfinite     2  (activity_burst_pump)
+  fired        mean_reversion_fade=3
+  close5       min          1.5  max          120  absmax          120
+  score        min            -  max            -  absmax            -
+
+== carry drift (latest audit) ==
+  alarm_events 0
+  ewm          max_abs      1.5e-05  max_rel        2e-07  max_ulp          2  compared      144
+  supertrend   max_abs         1.25  max_rel        0.012  max_ulp     131072  compared       16
+
+== executable ledger ==
+  tick_step_wire           compiles   1  seconds    7.250  cache cold
+  boot total: 7.25s over 1 executables  (persistent cache 0 hit / 1 miss)"""
+
+
+def test_health_report_golden(tmp_path, capsys):
+    """tools/health_report.py renders a deterministic report (format
+    pinned like trace_report's waterfall golden)."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import health_report
+    finally:
+        sys.path.pop(0)
+
+    log = tmp_path / "events.jsonl"
+    log.write_text(
+        "\n".join(json.dumps(e) for e in GOLDEN_EVENTS) + "\n"
+        + "not json\n"  # torn write at rotation: skipped, not fatal
+    )
+    assert health_report.main([str(log)]) == 0
+    out = capsys.readouterr().out.rstrip("\n")
+    assert out == GOLDEN_REPORT
+
+    # --json emits the raw model
+    assert health_report.main([str(log), "--json"]) == 0
+    model = json.loads(capsys.readouterr().out)
+    assert model["digest"]["nan_total"] == 3
+    assert model["compiles"]["tick_step_wire"]["compiles"] == 1
+
+
+@pytest.mark.slow
+def test_digest_rides_scanned_and_backtest_backends(tmp_path, event_log):
+    """The digest threads through all four backends: a scanned drive and a
+    time-batched backtest drive both decode per-tick digests through the
+    shared finalize path (make obs-smoke lane)."""
+    from binquant_tpu.io.replay import (
+        generate_replay_file,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+
+    path = tmp_path / "replay.jsonl"
+    generate_replay_file(path, n_symbols=6, n_ticks=24)
+    kl = load_klines_by_tick(path)
+    seq = [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(kl[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(kl)
+    ]
+
+    # scanned (incremental) drive
+    eng = make_stub_engine(
+        capacity=8, window=220, incremental=True, donate=False,
+        scan_chunk=8, carry_audit_every=0,
+    )
+    eng.numeric_digest = True
+    asyncio.run(eng.process_ticks_scanned(iter(seq)))
+    assert eng.scan_chunks > 0
+    assert eng.numeric.last is not None
+    assert eng.numeric.last["nan_total"] == 0
+
+    # backtest (full-recompute) drive, tracer sampling on so the chunk
+    # spans (trace-parity satellite) are observable
+    from binquant_tpu.obs.tracing import Tracer
+
+    eng2 = make_stub_engine(
+        capacity=8, window=220, incremental=False, donate=False,
+        backtest_chunk=8,
+    )
+    eng2.numeric_digest = True
+    eng2.tracer = Tracer(sample=1.0, slow_ms=1e9)
+    asyncio.run(eng2.process_ticks_backtest(iter(seq)))
+    assert eng2.backtest_chunks > 0
+    assert eng2.numeric.last is not None
+    assert eng2.numeric.last["nan_total"] == 0
+    chunk_traces = [
+        t for t in eng2.tracer.entries()
+        if t["summary"].get("path") == "backtest"
+    ]
+    assert len(chunk_traces) == eng2.backtest_chunks
+    top = chunk_traces[-1]["spans"]["children"]
+    chunk_span = next(s for s in top if s["name"] == "backtest_chunk")
+    assert chunk_span["attrs"]["ticks"] >= 4
+    assert "padded" in chunk_span["attrs"]

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Render the numeric-health observatory's state from the JSONL event log.
+
+The engine emits ``numeric_digest`` (sampled) / ``numeric_anomaly``
+(forced) events carrying the decoded wire digest, ``carry_drift`` /
+``carry_drift_alarm`` events with the audit-tick drift meters, and
+``compile`` / ``compile_summary`` events from the executable ledger.
+This tool folds a log back into the "is the fast path still numerically
+honest, and what did this executable cost" view with no service in the
+loop:
+
+    python tools/health_report.py /var/log/bqt/events.jsonl
+    python tools/health_report.py events.jsonl --json
+
+Output format is golden-pinned (tests/test_numeric_health.py) — keep
+changes deliberate, like tools/trace_report.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_events(path: str | Path) -> list[dict]:
+    """All events from a JSONL log, in file order; corrupt lines (a torn
+    write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def summarize(events: list[dict]) -> dict:
+    """The report's data model: latest digest, latest drift, anomaly and
+    alarm tallies, and the per-executable compile aggregate."""
+    digest = None
+    digest_kind = None
+    drift = None
+    anomalies = 0
+    alarms = 0
+    compiles: dict[str, dict] = {}
+    summary = None
+    for ev in events:
+        kind = ev.get("event")
+        if kind in ("numeric_digest", "numeric_anomaly") and "digest" in ev:
+            digest, digest_kind = ev["digest"], kind
+            if kind == "numeric_anomaly":
+                anomalies += 1
+        elif kind in ("carry_drift", "carry_drift_alarm") and "drift" in ev:
+            drift = ev["drift"]
+            if kind == "carry_drift_alarm":
+                alarms += 1
+        elif kind == "compile":
+            entry = compiles.setdefault(
+                ev.get("executable", "?"),
+                {"compiles": 0, "seconds": 0.0, "cache": "unknown"},
+            )
+            entry["compiles"] += 1
+            entry["seconds"] += float(ev.get("seconds", 0.0) or 0.0)
+            entry["cache"] = ev.get("cache", "unknown")
+        elif kind == "compile_summary":
+            summary = ev
+    return {
+        "digest": digest,
+        "digest_kind": digest_kind,
+        "drift": drift,
+        "anomalies": anomalies,
+        "drift_alarms": alarms,
+        "compiles": compiles,
+        "compile_summary": summary,
+    }
+
+
+def render(model: dict) -> str:
+    lines: list[str] = []
+    digest = model["digest"]
+    lines.append("== numeric digest ==")
+    if digest is None:
+        lines.append("  (no digest events — BQT_NUMERIC_DIGEST off?)")
+    else:
+        lines.append(
+            f"  source {model['digest_kind']}  nan_total "
+            f"{digest.get('nan_total', 0)}  inf_total "
+            f"{digest.get('inf_total', 0)}  anomaly_events "
+            f"{model['anomalies']}"
+        )
+        for stage in sorted(digest.get("nan_rows", {})):
+            lines.append(
+                f"  {stage:<12} nan_rows {digest['nan_rows'][stage]:>5}  "
+                f"inf_rows {digest['inf_rows'][stage]:>5}"
+            )
+        bad = {
+            k: v
+            for k, v in digest.get("strategy_nonfinite", {}).items()
+            if v
+        }
+        lines.append(f"  strategies   nonfinite {sum(bad.values()):>5}"
+                     + (f"  ({', '.join(sorted(bad))})" if bad else ""))
+        fired = digest.get("fired", {})
+        hot = [f"{k}={v}" for k, v in sorted(fired.items()) if v]
+        lines.append("  fired        " + (" ".join(hot) if hot else "(none)"))
+        for series in sorted(digest.get("series", {})):
+            st = digest["series"][series]
+            lines.append(
+                f"  {series:<12} min {_fmt(st.get('min')):>12}  max "
+                f"{_fmt(st.get('max')):>12}  absmax "
+                f"{_fmt(st.get('absmax')):>12}"
+            )
+    lines.append("")
+    lines.append("== carry drift (latest audit) ==")
+    drift = model["drift"]
+    if drift is None:
+        lines.append("  (no carry_drift events — BQT_DRIFT_METER off or no "
+                     "audit tick yet)")
+    else:
+        lines.append(f"  alarm_events {model['drift_alarms']}")
+        for family in sorted(drift):
+            v = drift[family]
+            lines.append(
+                f"  {family:<12} max_abs {_fmt(v.get('max_abs')):>12}  "
+                f"max_rel {_fmt(v.get('max_rel')):>12}  "
+                f"max_ulp {_fmt(v.get('max_ulp')):>10}  "
+                f"compared {v.get('compared', 0):>8}"
+            )
+    lines.append("")
+    lines.append("== executable ledger ==")
+    if not model["compiles"]:
+        lines.append("  (no compile events)")
+    else:
+        for name in sorted(model["compiles"]):
+            e = model["compiles"][name]
+            lines.append(
+                f"  {name:<24} compiles {e['compiles']:>3}  "
+                f"seconds {e['seconds']:>8.3f}  cache {e['cache']}"
+            )
+    summary = model["compile_summary"]
+    if summary is not None:
+        lines.append(
+            f"  boot total: {_fmt(summary.get('compile_seconds'))}s over "
+            f"{summary.get('executables', 0)} executables  "
+            f"(persistent cache {summary.get('persistent_cache_hits', 0)} "
+            f"hit / {summary.get('persistent_cache_misses', 0)} miss)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw data model instead of the rendered report",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_events(args.log)
+    if not events:
+        print(f"no events in {args.log}", file=sys.stderr)
+        return 1
+    model = summarize(events)
+    if args.json:
+        print(json.dumps(model, indent=2, sort_keys=True))
+    else:
+        print(render(model))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

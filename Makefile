@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -41,6 +41,15 @@ help:
 	@echo "               rewrite chunk break) + the 64-combo vmapped grid"
 	@echo "               smoke, then a small-shape throughput + sweep"
 	@echo "               report (bench.py --backtest-throughput)"
+	@echo "  ring-smoke - circular-cursor ring lane (ISSUE 9): cursor-vs-"
+	@echo "               shift bit-equality property suite, checkpoint"
+	@echo "               v3->v4 migration + mid-phase-cursor kill-and-"
+	@echo "               restore, the slow-marked depth-2+donation drills"
+	@echo "               (incl. the >WIRE_MAX_FIRED overflow burst), then"
+	@echo "               a small-shape bench.py --ring-traffic report."
+	@echo "               The 2048x400 acceptance number is"
+	@echo "               'python bench.py --ring-traffic' (merges into"
+	@echo "               BENCH_REPLAY_CPU.json)"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run (incl."
 	@echo "               one scan chunk + one backtest chunk)"
 	@echo "  lint       - ruff check"
@@ -119,6 +128,19 @@ backtest-smoke:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu python bench.py --backtest-throughput \
 		--symbols 64 --window 160 --ticks 32 --best-of 1
+
+# The circular-ring lane (ISSUE 9): tier-1 keeps the cheap cursor parity
+# suite + checkpoint migration units + the small depth-2 donation pin;
+# this target adds the slow-marked drills (the mid-phase-cursor
+# incremental kill-and-restore, the depth-2 donated >WIRE_MAX_FIRED
+# overflow burst) plus a small-shape apply_updates traffic report. The
+# 2048x400 acceptance number is `python bench.py --ring-traffic`.
+ring-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_engine_buffer.py \
+		tests/test_checkpoint.py tests/test_pipelined_tick.py -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu python bench.py --ring-traffic \
+		--symbols 256 --window 200 --ticks 32
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

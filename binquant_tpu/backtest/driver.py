@@ -114,6 +114,7 @@ def _final_window(
     return MarketBuffer(
         times=jnp.asarray(times), values=jnp.asarray(vals),
         filled=jnp.asarray(filled),
+        cursor=jnp.zeros(filled.shape, jnp.int32),  # canonical rebuild
     )
 
 
@@ -168,7 +169,12 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
     T = len(ticks)
     tb = _pow2_bucket(T)
     W = engine.window
-    state = engine.state
+    # the host-side extension lays appends past a RIGHT-ALIGNED base: a
+    # mid-phase ring cursor (folded updates since the last full tick)
+    # canonicalizes here — one gather per chunk, amortized over T ticks
+    from binquant_tpu.engine.step import canonicalize_state
+
+    state = canonicalize_state(engine.state)
     base5_t = np.asarray(state.buf5.times)
     base5_v = np.asarray(state.buf5.values)
     base15_t = np.asarray(state.buf15.times)

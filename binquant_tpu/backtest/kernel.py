@@ -155,7 +155,13 @@ def _window_views(
     )
     times, vals = jax.lax.optimization_barrier((times, vals))
     filled = jnp.minimum(filled0[None, :] + counts, window).astype(jnp.int32)
-    return MarketBuffer(times=times, values=vals, filled=filled)
+    # gathered views are canonical right-aligned by construction
+    return MarketBuffer(
+        times=times,
+        values=vals,
+        filled=filled,
+        cursor=jnp.zeros(filled.shape, jnp.int32),
+    )
 
 
 def _precompute_one(
@@ -250,6 +256,7 @@ def _evaluate_tick(
         times=jnp.zeros((S, 1), jnp.int32),
         values=jnp.zeros((S, 1, NUM_FIELDS), jnp.float32),
         filled=pre.filled15,
+        cursor=jnp.zeros((S,), jnp.int32),
     )
     context, regime_carry2 = compute_market_context(
         thin15,
@@ -346,6 +353,9 @@ def _evaluate_tick(
             pre.pack5, pre.pack15, summary, pre.btc_beta, pre.btc_corr,
             inp.tracked, ok5, ok15, pre.fresh5, pre.fresh15,
             jnp.zeros((S,), bool),  # full path: no expected-NaN beta rows
+            # classic/full-recompute semantics: the same wire-materialized
+            # field subset the serial classic step counts (engine/step.py)
+            wire_fields_only=True,
         )
     else:
         digest = None

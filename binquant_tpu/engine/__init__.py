@@ -18,10 +18,14 @@ from binquant_tpu.engine.buffer import (  # noqa: F401
     MarketBuffer,
     SymbolRegistry,
     apply_updates,
+    apply_updates_shift,
     empty_buffer,
     field,
     fresh_mask,
+    materialize,
+    materialize_tail,
     ms_to_s,
+    ring_latest_times,
     reset_rows,
     s_to_ms,
     valid_mask,

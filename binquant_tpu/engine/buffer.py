@@ -6,18 +6,33 @@ concat → drop_duplicates(keep="last") → sort → tail(max_bars)
 whole market lives in one fixed-shape device array ``(S symbols, W bars,
 F fields)`` that is updated by a single jit'd batched operation per tick:
 
-* **Right-aligned windows**: index ``W-1`` is always the latest bar, so every
-  downstream kernel reads ``[..., -1]`` for "now" without indexing through a
-  write pointer; warm-up slots hold NaN (values) / -1 (times), which the ops
-  kernels already treat as missing.
+* **Circular write cursor** (ISSUE 9): each symbol carries a ``cursor`` —
+  the slot its NEXT append lands in. An append is a one-column scatter plus
+  a cursor bump instead of the original physical shift of the whole
+  ``(S, W, F)`` ring (~144 MB/tick at 2048×400 — the measured bandwidth
+  floor that capped the scanned replay on CPU). The k-th newest bar lives
+  at slot ``(cursor - k) mod W``; the ring invariant is that the
+  ``filled`` stored bars occupy slots ``(cursor - filled … cursor - 1)
+  mod W`` in time order, every other slot holding the empty sentinels
+  (NaN values / -1 times). ``cursor == 0`` with data packed at the right
+  edge is the **canonical** (right-aligned) layout — exactly the
+  pre-cursor format, and still a valid ring.
+* **Materialized views for window consumers**: kernels that genuinely need
+  a time-ordered window call :func:`materialize` (full canonical gather)
+  or :func:`materialize_tail` (the last K columns) ONCE per tick; the
+  incremental fast path reads only a shallow tail (engine/step.py
+  ``INCR_TAIL_WINDOW``), which is where the per-tick ring-shift bytes go.
 * **Batched scatter-update**: all candles that arrived in a tick are applied
   at once. Per symbol the update resolves exactly like the reference's
-  dedupe+sort: newer timestamp → shift-append; a timestamp already in the
-  window (latest OR mid-history) → overwrite that bar in place (the
+  dedupe+sort: newer timestamp → append at the cursor (the oldest bar is
+  overwritten once the ring is full); a timestamp already in the window
+  (latest OR mid-history) → overwrite that bar's slot in place (the
   exchange re-sent a corrected candle); an older timestamp with no
   matching bar → ignored (fixed-shape windows cannot insert mid-history —
   requires both the original delivery and the catch-up fetch to have
-  missed that bucket).
+  missed that bucket). :func:`apply_updates_shift` keeps the original
+  shift-append implementation as the bit-equality oracle for tests and
+  the ``bench.py --ring-traffic`` before/after arm.
 * **Freshness is exact-timestamp equality** with the evaluated tick, as in
   ``get_fresh_symbols`` (``market_state_store.py:49-54``).
 
@@ -83,11 +98,20 @@ def s_to_ms(ts_s: int | np.ndarray) -> np.ndarray | int:
 
 
 class MarketBuffer(NamedTuple):
-    """Pytree carried across ticks (device-resident)."""
+    """Pytree carried across ticks (device-resident).
+
+    ``cursor`` is the circular write pointer: slot of the NEXT append, per
+    symbol. ``cursor == 0`` with data packed at the right edge is the
+    canonical right-aligned layout (what :func:`materialize` returns and
+    what checkpoints store); any other cursor is a mid-phase ring whose
+    k-th newest bar sits at ``(cursor - k) mod W``. Direct ``[:, -1]``
+    reads are only valid on canonical/materialized buffers — ring readers
+    go through :func:`ring_latest_times` / :func:`materialize_tail`."""
 
     times: jnp.ndarray  # (S, W) int32 open-time seconds, -1 where empty
     values: jnp.ndarray  # (S, W, F) float32, NaN where empty
     filled: jnp.ndarray  # (S,) int32 count of valid bars (<= W)
+    cursor: jnp.ndarray  # (S,) int32 next-append slot in [0, W)
 
     @property
     def capacity(self) -> int:
@@ -99,7 +123,7 @@ class MarketBuffer(NamedTuple):
 
     @property
     def latest_times(self) -> jnp.ndarray:
-        return self.times[:, -1]
+        return ring_latest_times(self)
 
 
 def empty_buffer(num_symbols: int, window: int = 400) -> MarketBuffer:
@@ -107,21 +131,21 @@ def empty_buffer(num_symbols: int, window: int = 400) -> MarketBuffer:
         times=jnp.full((num_symbols, window), -1, dtype=jnp.int32),
         values=jnp.full((num_symbols, window, NUM_FIELDS), jnp.nan, dtype=jnp.float32),
         filled=jnp.zeros((num_symbols,), dtype=jnp.int32),
+        cursor=jnp.zeros((num_symbols,), dtype=jnp.int32),
     )
 
 
-@jax.jit
-def apply_updates(
-    buf: MarketBuffer,
-    row_idx: jnp.ndarray,  # (U,) int32 registry rows; out-of-range rows ignored
-    ts: jnp.ndarray,  # (U,) int32 open-time seconds
-    vals: jnp.ndarray,  # (U, F) float32
-) -> MarketBuffer:
-    """Apply one tick's worth of closed candles in a single fused update.
+def ring_latest_times(buf: MarketBuffer) -> jnp.ndarray:
+    """(S,) open time of each symbol's newest bar — slot ``(cursor-1) % W``
+    (== ``times[:, -1]`` on a canonical buffer; -1 where empty)."""
+    W = buf.times.shape[1]
+    idx = (buf.cursor - 1) % W
+    return jnp.take_along_axis(buf.times, idx[:, None], axis=1)[:, 0]
 
-    Duplicate rows within a batch must be pre-deduped host-side (keep last) —
-    the IngestBatcher does this; scatter order on duplicates is undefined.
-    """
+
+def _scatter_updates(buf: MarketBuffer, row_idx, ts, vals):
+    """The shared host-batch → per-symbol slot scatter + append/rewrite
+    routing both apply_updates implementations use."""
     S, W = buf.times.shape
 
     # Invalid rows map to index S (strictly out of bounds) so mode="drop"
@@ -138,24 +162,88 @@ def apply_updates(
         .at[safe_idx]
         .set(vals.astype(jnp.float32), mode="drop")
     )
-
-    last_ts = buf.times[:, -1]
     has_update = upd_ts >= 0
+    last_ts = ring_latest_times(buf)
     is_append = has_update & ((buf.filled == 0) | (upd_ts > last_ts))
+    return upd_ts, upd_vals, has_update, is_append
+
+
+@jax.jit
+def apply_updates(
+    buf: MarketBuffer,
+    row_idx: jnp.ndarray,  # (U,) int32 registry rows; out-of-range rows ignored
+    ts: jnp.ndarray,  # (U,) int32 open-time seconds
+    vals: jnp.ndarray,  # (U, F) float32
+) -> MarketBuffer:
+    """Apply one tick's worth of closed candles in a single fused update.
+
+    Circular-cursor layout: an append writes ONE column at the cursor and
+    bumps it — O(update) bytes instead of the original O(capacity)
+    shift-append (kept as :func:`apply_updates_shift`); a rewrite
+    overwrites the (unique) slot already holding that timestamp via a
+    second one-column scatter. In state-threading loops (``lax.scan``,
+    the donated live step) XLA aliases the buffer and the scatters run in
+    place — the ring's bytes/tick drop from ~144 MB to the update itself
+    at 2048×400 (``bench.py --ring-traffic``).
+
+    Duplicate rows within a batch must be pre-deduped host-side (keep last) —
+    the IngestBatcher does this; scatter order on duplicates is undefined.
+    """
+    S, W = buf.times.shape
+    upd_ts, upd_vals, has_update, is_append = _scatter_updates(
+        buf, row_idx, ts, vals
+    )
+    rows = jnp.arange(S)
+
+    # Append: one column at the cursor (index W = dropped for non-appends).
+    app_slot = jnp.where(is_append, buf.cursor, W)
+    times = buf.times.at[rows, app_slot].set(upd_ts, mode="drop")
+    values = buf.values.at[rows, app_slot].set(upd_vals, mode="drop")
+
+    # Rewrite the bar that already holds this timestamp — the latest bar
+    # (same-bucket correction) or ANY mid-history bar (an exchange
+    # re-sending a corrected candle), exactly the reference's dedupe-by-
+    # timestamp keep-last (market_state_store.py:19-32). Per-symbol times
+    # are strictly increasing in ring order, so at most one slot matches;
+    # the match scan reads only the (S, W) int32 times plane, not the
+    # (S, W, F) values. An older timestamp with NO matching bar (a bar
+    # missed entirely, delivered late) is dropped: a fixed-shape window
+    # cannot insert mid-history without a full sort.
+    slot_match = (buf.times == upd_ts[:, None]) & has_update[:, None]
+    is_rewrite = has_update & ~is_append & slot_match.any(axis=1)
+    rw_slot = jnp.where(is_rewrite, jnp.argmax(slot_match, axis=1), W)
+    values = values.at[rows, rw_slot].set(upd_vals, mode="drop")
+
+    filled = jnp.where(
+        is_append, jnp.minimum(buf.filled + 1, W), buf.filled
+    ).astype(jnp.int32)
+    cursor = jnp.where(is_append, (buf.cursor + 1) % W, buf.cursor).astype(
+        jnp.int32
+    )
+    return MarketBuffer(times=times, values=values, filled=filled, cursor=cursor)
+
+
+@jax.jit
+def apply_updates_shift(
+    buf: MarketBuffer,
+    row_idx: jnp.ndarray,
+    ts: jnp.ndarray,
+    vals: jnp.ndarray,
+) -> MarketBuffer:
+    """The ORIGINAL physical shift-append update, canonical layout only
+    (``cursor`` must be all zeros; it stays zero). Kept as the
+    bit-equality oracle for the cursor ring (tests/test_engine_buffer.py)
+    and the "before" arm of ``bench.py --ring-traffic`` — not a live
+    dispatch path."""
+    S, W = buf.times.shape
+    upd_ts, upd_vals, has_update, is_append = _scatter_updates(
+        buf, row_idx, ts, vals
+    )
 
     # Candidate A: shift-left append (oldest bar falls off the front).
     app_times = jnp.concatenate([buf.times[:, 1:], upd_ts[:, None]], axis=1)
     app_vals = jnp.concatenate([buf.values[:, 1:, :], upd_vals[:, None, :]], axis=1)
 
-    # Candidate B: rewrite the bar that already holds this timestamp —
-    # the latest bar (same-bucket correction) or ANY mid-history bar (an
-    # exchange re-sending a corrected candle), exactly the reference's
-    # dedupe-by-timestamp keep-last (market_state_store.py:19-32). Times
-    # are strictly increasing per symbol, so at most one slot matches.
-    # An older timestamp with NO matching bar (a bar missed entirely,
-    # delivered late) is dropped: a fixed-shape window cannot insert
-    # mid-history without a full sort. Rare — it requires the original
-    # delivery AND the catch-up fetch for that bucket to both have failed.
     slot_match = (buf.times == upd_ts[:, None]) & has_update[:, None]
     is_rewrite = has_update & ~is_append & slot_match.any(axis=1)
     rw_vals = jnp.where(
@@ -170,14 +258,73 @@ def apply_updates(
     filled = jnp.where(
         is_append, jnp.minimum(buf.filled + 1, W), buf.filled
     ).astype(jnp.int32)
-    return MarketBuffer(times=times, values=values, filled=filled)
+    return MarketBuffer(
+        times=times, values=values, filled=filled, cursor=buf.cursor
+    )
+
+
+def materialize(buf: MarketBuffer) -> MarketBuffer:
+    """Time-ordered right-aligned (canonical) view of a ring —
+    bit-identical to what the shift-append layout would hold, with
+    warm-up empties at the front and the newest bar at ``W-1``. Returns
+    ``cursor = 0`` (canonical IS a valid ring, so the result can keep
+    accepting appends). See :func:`materialize_tail` for why the gather
+    rides inside a ``lax.cond`` fusion barrier."""
+    return materialize_tail(buf, buf.times.shape[1])
+
+
+def materialize_tail(buf: MarketBuffer, width: int) -> MarketBuffer:
+    """Right-aligned view of each symbol's newest ``width`` bars — the
+    incremental fast path's ONE hoisted materialization per tick
+    (engine/step.py ``INCR_TAIL_WINDOW``): consumers then read
+    ``[:, -k]`` for k <= ``width`` exactly as on a full canonical buffer
+    (positions past ``filled`` stay at the -1/NaN sentinels, matching
+    canonical warm-up semantics). ``filled`` is the TRUE count and may
+    exceed ``width`` — readers use it only in comparisons (>= MIN_BARS
+    etc.), never as a window index.
+
+    The gather is wrapped in a ``lax.cond`` with an opaque always-true
+    predicate — a fusion barrier that actually survives compilation.
+    Without it XLA clones the (cheap-looking) gather into every
+    downstream consumer fusion, and ``HloCostAnalysis`` then charges the
+    whole ring operand per clone (measured 48 MB/28 MF vs 8 MB/2 MF for
+    the carry advance at 64x400 — a cost-model artifact, but one the
+    compile-time budget gates trip on); ``optimization_barrier`` does
+    not survive this XLA version's pipeline. A scatter formulation was
+    measured and rejected: model-cheap but ~20x slower at 2048x400 wall
+    time (XLA CPU scatters serialize)."""
+    S, W = buf.times.shape
+    width = min(width, W)
+
+    def gather(operand):
+        times_, values_, cursor_ = operand
+        offs = jnp.arange(width, dtype=jnp.int32) - width  # [-width, -1]
+        idx = (cursor_[:, None] + offs[None, :]) % W
+        t = jnp.take_along_axis(times_, idx, axis=1)
+        v = jnp.take_along_axis(values_, idx[:, :, None], axis=1)
+        return t, v
+
+    # data-dependent (never constant-foldable) but always-true predicate:
+    # fusion cannot cross or clone a conditional boundary, so the ring is
+    # traversed exactly once however many consumers read the view
+    pred = jnp.min(buf.cursor) >= 0
+    times, values = jax.lax.cond(
+        pred, gather, gather, (buf.times, buf.values, buf.cursor)
+    )
+    return MarketBuffer(
+        times=times,
+        values=values,
+        filled=buf.filled,
+        cursor=jnp.zeros_like(buf.cursor),
+    )
 
 
 @jax.jit
 def fresh_mask(buf: MarketBuffer, timestamp_s: jnp.ndarray) -> jnp.ndarray:
     """(S,) bool — symbols whose latest closed bar is exactly `timestamp_s`
-    (reference ``get_fresh_symbols``, ``market_state_store.py:49-54``)."""
-    return (buf.filled > 0) & (buf.times[:, -1] == timestamp_s)
+    (reference ``get_fresh_symbols``, ``market_state_store.py:49-54``).
+    Cursor-aware: valid on mid-phase rings and canonical buffers alike."""
+    return (buf.filled > 0) & (ring_latest_times(buf) == timestamp_s)
 
 
 @jax.jit
@@ -327,6 +474,9 @@ def reset_rows(buf: MarketBuffer, rows: jnp.ndarray) -> MarketBuffer:
         times=jnp.where(mask[:, None], -1, buf.times).astype(jnp.int32),
         values=jnp.where(mask[:, None, None], jnp.nan, buf.values),
         filled=jnp.where(mask, 0, buf.filled).astype(jnp.int32),
+        # a cleared row restarts canonical: the reclaiming symbol's first
+        # append lands at slot 0 of an all-empty ring
+        cursor=jnp.where(mask, 0, buf.cursor).astype(jnp.int32),
     )
 
 

@@ -26,7 +26,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from binquant_tpu.engine.buffer import Field, MarketBuffer, apply_updates, fresh_mask
+from binquant_tpu.engine.buffer import (
+    Field,
+    MarketBuffer,
+    apply_updates,
+    fresh_mask,
+    materialize,
+    materialize_tail,
+)
 from binquant_tpu.ops.incremental import (
     BetaCorrCarry,
     SupertrendCarry,
@@ -50,6 +57,7 @@ from binquant_tpu.regime.routing import allows_long_autotrade_mask
 from binquant_tpu.strategies.activity_burst_pump import (
     ABP_INIT_MIN_WINDOW,
     ABP_MIN_WINDOW,
+    TAIL as _ABP_TAIL,
     ABPCarry,
     abp_advance_one_bar,
     abp_init_from_window,
@@ -376,6 +384,7 @@ def _numeric_digest_block(
     fresh5: jnp.ndarray,
     fresh15: jnp.ndarray,
     beta_expected_nan: jnp.ndarray,
+    wire_fields_only: bool = False,
 ) -> jnp.ndarray:
     """The (NUMERIC_DIGEST_WIDTH,) f32 stats block.
 
@@ -384,7 +393,15 @@ def _numeric_digest_block(
     MIN_BARS``): warm-up NaN is by design, a NaN past the gate is leakage.
     ``beta_expected_nan`` masks the incremental path's deliberate
     dirty-row NaN decode (engine/step.py bc_dirty) out of the indicators
-    stage — those rows are *unknown*, not poisoned."""
+    stage — those rows are *unknown*, not poisoned.
+
+    ``wire_fields_only`` (static; the CLASSIC / full-recompute paths) cuts
+    the feature-stage scan to the pack fields the wire ALREADY
+    materializes (per-slot payload base feats): on the classic path the
+    full 12-field scan forced XLA to keep otherwise-fused full-window
+    intermediates alive just to count them — the measured ~13% wire-byte
+    overhead the PR 7 NOTE flagged. The incremental path's carried
+    readouts exist anyway, so it keeps the full-coverage scan (0.7%)."""
     suff5 = tracked & ok5
     suff15 = tracked & ok15
 
@@ -400,6 +417,13 @@ def _numeric_digest_block(
         ]
 
     def pack_fields(pack):
+        if wire_fields_only:
+            # exactly the per-slot payload base features (EMISSION_BASE
+            # close/volume/BB triple) — zero extra materialization
+            return (
+                pack.close, pack.volume,
+                pack.bb_upper, pack.bb_mid, pack.bb_lower,
+            )
         # every field the sufficiency gate (MIN_BARS) makes finite; quote
         # volume is excluded — feeds legitimately omit it (has_qav)
         return (
@@ -695,6 +719,76 @@ MIN_INCR_ENGINE_WINDOW = max(
     LSP_MIN_WINDOW,
     LSP_INIT_MIN_WINDOW,
 )
+
+# --- circular-ring tail materialization (ISSUE 9) ---------------------------
+# The incremental fast path never needs the full (S, W, F) window: its
+# deepest canonical column reads are the ABP advance's has_qav scan over
+# the strategy's own TAIL=128 slice, the BTC 24h-change column at -97,
+# the beta/corr leaver at -(BC_WINDOW+2) = -52, and the feature-carry
+# levers near -22 (features.MIN_INCREMENTAL_WINDOW). One hoisted
+# ``materialize_tail`` of this width per buffer per tick replaces the
+# physical ring shift — the bytes lever the scanned replay was floored by.
+INCR_TAIL_WINDOW = max(_ABP_TAIL, 98, BC_WINDOW + 2, MIN_INCR_ENGINE_WINDOW)
+
+# Wire-enabled strategies that read buffer WINDOWS the shallow tail cannot
+# cover on the incremental path (dormant kernels evaluating full-window
+# series — EWMs over the whole ring, deep resamples, the spike detector).
+# Enabling any of them keeps correctness by materializing the FULL window
+# instead of the tail (same bytes as the retired shift — never worse).
+# supertrend_swing_reversal and inverse_price_tracker are deliberately
+# absent: on the fast path the former consumes the carried st_up readout
+# and the latter is pack-only.
+#
+# MAINTENANCE CONTRACT: negative slices CLAMP, so a deep read against a
+# too-narrow tail is silently wrong, not a shape error. Any NEW
+# buffer-consuming strategy (or a deepened read in an existing one) that
+# can appear in wire_enabled on the incremental path must either stay
+# within INCR_TAIL_WINDOW columns or be added here — the ring parity and
+# A/B suites only cover the sets they drive.
+DEEP_WINDOW_STRATEGIES: frozenset[str] = frozenset(
+    {
+        "coinrule_twap_momentum_sniper",
+        "coinrule_buy_low_sell_high",
+        "coinrule_buy_the_dip",
+        "bb_extreme_reversion",
+        "range_bb_rsi_mean_reversion",
+        "range_failed_breakout_fade",
+        "relative_strength_reversal_range",
+    }
+)
+
+
+def _advance_tail_floor(params=None) -> int:
+    """Deepest ring column the carry advance/readout needs at the RESOLVED
+    params — a legal float-consistent override can still deepen the
+    ABP/LSP read windows past the defaults baked into INCR_TAIL_WINDOW
+    (their int fields are static aux, not carry-leaf-structural), and a
+    too-narrow tail would trip the advance asserts at trace time."""
+    from binquant_tpu.strategies.activity_burst_pump import _baseline_window
+    from binquant_tpu.strategies.params import resolve_params
+
+    sp = resolve_params(params)
+    return max(
+        INCR_TAIL_WINDOW,
+        _baseline_window(sp.abp) + 3,  # ABP advance's deepest column
+        3 * sp.lsp.window_hours + 1,  # LSP advance's deepest column
+    )
+
+
+def _incr_tail_width(
+    window: int,
+    wire_enabled: tuple[str, ...],
+    compute_all: bool,
+    params=None,
+) -> int:
+    """Trace-time width of the incremental path's materialized tail. The
+    full-outputs variant (``compute_all`` — fallback/bench/tests) and any
+    deep-window wire strategy read past the shallow tail, so they get the
+    whole window; values read through either width are identical, so the
+    wire stays bit-equal across variants."""
+    if compute_all or any(s in DEEP_WINDOW_STRATEGIES for s in wire_enabled):
+        return window
+    return min(window, _advance_tail_floor(params))
 
 
 def advance_indicator_carry(
@@ -1033,8 +1127,27 @@ def _tick_step_impl(
     from binquant_tpu.strategies.params import resolve_params
 
     sp = resolve_params(params)
-    buf5 = apply_updates(state.buf5, *upd5)
-    buf15 = apply_updates(state.buf15, *upd15)
+    ring5 = apply_updates(state.buf5, *upd5)
+    ring15 = apply_updates(state.buf15, *upd15)
+
+    # Circular-ring materialization (ISSUE 9): the scatter above moved
+    # O(update) bytes; time-ordered views for window consumers are gathered
+    # ONCE here. The incremental fast path reads only a shallow tail
+    # (INCR_TAIL_WINDOW) — the erased ring-shift bytes; the full path
+    # gathers the whole window (same bytes the retired shift moved) and
+    # CANONICALIZES: its returned state is right-aligned with cursor 0,
+    # so every full/audit tick also re-anchors the ring layout for free.
+    if incremental:
+        tw5 = _incr_tail_width(ring5.window, wire_enabled, compute_all, params)
+        tw15 = _incr_tail_width(
+            ring15.window, wire_enabled, compute_all, params
+        )
+        buf5 = materialize_tail(ring5, tw5)
+        buf15 = materialize_tail(ring15, tw15)
+    else:
+        ring5 = materialize(ring5)
+        ring15 = materialize(ring15)
+        buf5, buf15 = ring5, ring15
 
     # Per-interval freshness: 5m and 15m bucket opens only coincide on
     # quarter-hour boundaries, so each buffer gates on its own timestamp.
@@ -1313,9 +1426,12 @@ def _tick_step_impl(
         else skipped
     )
 
+    # the carried state keeps the RING buffers (post-scatter, mid-phase
+    # cursor) on the incremental path; the full path's ring5/ring15 were
+    # rebound to the canonical materialization above
     new_state = EngineState(
-        buf5=buf5,
-        buf15=buf15,
+        buf5=ring5,
+        buf15=ring15,
         regime_carry=regime_carry,
         mrf_last_emitted=mrf_carry,
         pt_last_signal_close=pt_carry,
@@ -1354,6 +1470,15 @@ def _tick_step_impl(
         digest = _numeric_digest_block(
             pack5, pack15, summary, btc_beta, btc_corr,
             inputs.tracked, ok5, ok15, fresh5, fresh15, beta_expected_nan,
+            # CLASSIC DEPLOYMENTS only (maintain_carry=False — the
+            # BQT_INCREMENTAL=0 steady path) count just the
+            # wire-materialized pack fields (PR 7 NOTE — the full scan
+            # kept fused intermediates alive, ~13% wire bytes). An
+            # incremental deployment's audit/fallback full-recompute
+            # ticks keep the 12-field coverage: they resync the carry,
+            # are exactly where leakage matters most, and pay the wider
+            # scan only once per BQT_CARRY_AUDIT_EVERY ticks.
+            wire_fields_only=not incremental and not maintain_carry,
         )
     else:
         digest = None
@@ -1465,6 +1590,67 @@ tick_step_wire_donated = jax.jit(
 )
 
 
+def _tick_step_wire_db_impl(
+    state: EngineState,
+    scratch: EngineState,
+    upd5,
+    upd15,
+    inputs: HostInputs,
+    cfg: ContextConfig = ContextConfig(),
+    wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
+    incremental: bool = False,
+    maintain_carry: bool = True,
+    params=None,
+    numeric_digest: bool = False,
+) -> tuple[EngineState, jnp.ndarray]:
+    """Double-buffered donated wire step (ISSUE 9): ``scratch`` is a
+    same-shape state slot whose buffers are DONATED and reused for the
+    outputs, while ``state`` (the previous tick's post state) stays live —
+    so donation composes with ``pipeline_depth >= 2``: an in-flight tick's
+    overflow fallback can still read its own post state after the next
+    dispatch has launched. The pipeline rotates two resident slots (a
+    finalized tick's state becomes the next dispatch's scratch); device
+    stream ordering guarantees computation i+1 — which reads ``state`` —
+    completes before i+2's donated writes reuse those buffers."""
+    del scratch  # consumed only via input-output buffer aliasing
+    return _tick_step_wire_impl(
+        state,
+        upd5,
+        upd15,
+        inputs,
+        cfg,
+        wire_enabled,
+        incremental=incremental,
+        maintain_carry=maintain_carry,
+        params=params,
+        numeric_digest=numeric_digest,
+    )
+
+
+# keep_unused: jit drops unused args by default, and a dropped parameter
+# cannot alias its buffers to the outputs — the whole point of the slot
+tick_step_wire_db = jax.jit(
+    _tick_step_wire_db_impl,
+    static_argnames=(
+        "cfg", "wire_enabled", "incremental", "maintain_carry",
+        "numeric_digest",
+    ),
+    donate_argnums=(1,),
+    keep_unused=True,
+)
+
+
+@jax.jit
+def canonicalize_state(state: EngineState) -> EngineState:
+    """Both ring buffers materialized to the canonical right-aligned
+    layout (cursor 0) — what checkpoints persist and what the backtest
+    driver's host-side extension building reads. Idempotent; every other
+    EngineState leaf passes through untouched."""
+    return state._replace(
+        buf5=materialize(state.buf5), buf15=materialize(state.buf15)
+    )
+
+
 def wire_length(num_symbols: int, numeric_digest: bool = False) -> int:
     """Length of one tick's packed wire at capacity ``num_symbols`` —
     scalars + fired-compaction blocks + per-slot emission payload + the
@@ -1524,8 +1710,15 @@ def _fold_and_step_wire(
         buf5 = apply_updates(state.buf5, *u5)
         buf15 = apply_updates(state.buf15, *u15)
         if incremental:
+            # the carry advance reads only the shallow canonical tail —
+            # one small gather per fold slot instead of the ring shift
+            fold_tw = _advance_tail_floor(params)
             carry, _, _ = advance_indicator_carry(
-                buf5, buf15, state.indicator_carry, inputs.btc_row, params
+                materialize_tail(buf5, min(buf5.window, fold_tw)),
+                materialize_tail(buf15, min(buf15.window, fold_tw)),
+                state.indicator_carry,
+                inputs.btc_row,
+                params,
             )
         else:
             carry = state.indicator_carry
@@ -1717,7 +1910,10 @@ def _apply_updates_carry_impl(
     buf5 = apply_updates(state.buf5, *upd5)
     buf15 = apply_updates(state.buf15, *upd15)
     carry, _, _ = advance_indicator_carry(
-        buf5, buf15, state.indicator_carry, btc_row
+        materialize_tail(buf5, min(buf5.window, INCR_TAIL_WINDOW)),
+        materialize_tail(buf15, min(buf15.window, INCR_TAIL_WINDOW)),
+        state.indicator_carry,
+        btc_row,
     )
     return state._replace(buf5=buf5, buf15=buf15, indicator_carry=carry)
 
@@ -1946,10 +2142,30 @@ def _carry_drift_impl(
         moment_std,
     )
 
-    buf5 = apply_updates(state.buf5, *upd5)
-    buf15 = apply_updates(state.buf15, *upd15)
+    # carried twin advances on the shallow tail (exactly what the
+    # incremental tick reads); the fresh twin inits from the full
+    # canonical windows (exactly what the audit's resync installs)
+    buf5 = materialize(apply_updates(state.buf5, *upd5))
+    buf15 = materialize(apply_updates(state.buf15, *upd15))
+
+    def _canonical_tail(buf: MarketBuffer, width: int) -> MarketBuffer:
+        # buf is already canonical (just materialized): its tail is a
+        # plain static slice — no second modular gather needed
+        width = min(width, buf.window)
+        return MarketBuffer(
+            times=buf.times[:, -width:],
+            values=buf.values[:, -width:],
+            filled=buf.filled,
+            cursor=buf.cursor,
+        )
+
+    drift_tw = _advance_tail_floor(params)
     carried, stale5, stale15 = advance_indicator_carry(
-        buf5, buf15, state.indicator_carry, btc_row, params
+        _canonical_tail(buf5, drift_tw),
+        _canonical_tail(buf15, drift_tw),
+        state.indicator_carry,
+        btc_row,
+        params,
     )
     fresh = init_indicator_carry(buf5, buf15, btc_row, params)
     live5 = ~stale5 & (buf5.filled > 0)

@@ -36,7 +36,32 @@ import numpy as np
 # tree order (IndicatorCarry is EngineState's last field and the new
 # sub-carries follow pack5/pack15), so both older versions migrate by the
 # same prefix-fill + first-tick carry rebuild.
-CKPT_VERSION = 3
+# v4: MarketBuffer grew the circular write ``cursor`` (ISSUE 9). Archives
+# CANONICALIZE on save — both buffers materialized right-aligned, cursor
+# leaves (identically zero after that) stripped — so the v4 leaf layout is
+# bit-compatible with v3 and every older version migrates by the same
+# prefix rules; restore re-attaches zero cursors. Persisting the mid-phase
+# cursor was rejected: a canonical archive stays readable by shape alone,
+# and the cursor-relative reads make a canonicalized restore produce the
+# bit-identical next tick anyway (tests/test_checkpoint.py pins this with
+# a mid-phase cursor at save time).
+CKPT_VERSION = 4
+
+
+def _sans_cursor(state):
+    """``state`` with each MarketBuffer replaced by its (times, values,
+    filled) triple — the v3-compatible leaf sequence (plain tuples flatten
+    positionally, exactly like the pre-cursor MarketBuffer)."""
+    return state._replace(
+        buf5=(state.buf5.times, state.buf5.values, state.buf5.filled),
+        buf15=(state.buf15.times, state.buf15.values, state.buf15.filled),
+    )
+
+
+def _archive_leaves(state) -> list:
+    import jax
+
+    return jax.tree_util.tree_leaves(_sans_cursor(state))
 
 
 def save_state(
@@ -45,10 +70,12 @@ def save_state(
     registry,
     host_carries: dict | None = None,
 ) -> None:
-    """Atomically write the engine snapshot (tmp file + rename)."""
-    import jax
+    """Atomically write the engine snapshot (tmp file + rename).
+    Ring buffers are canonicalized (cursor → 0) and the cursor leaves
+    stripped — see the v4 note above."""
+    from binquant_tpu.engine.step import canonicalize_state
 
-    leaves = jax.tree_util.tree_leaves(state)
+    leaves = _archive_leaves(canonicalize_state(state))
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     meta = {
         "version": CKPT_VERSION,
@@ -80,10 +107,17 @@ def load_state(path: str | Path, template_state, registry):
 
     with np.load(path) as data:
         meta = json.loads(bytes(data["__meta"].tobytes()).decode())
-        if meta["version"] not in (1, 2, CKPT_VERSION):
+        if meta["version"] not in (1, 2, 3, CKPT_VERSION):
             raise ValueError(f"checkpoint version {meta['version']} unsupported")
-        t_leaves, treedef = jax.tree_util.tree_flatten(template_state)
-        migrated = meta["version"] < CKPT_VERSION
+        # v3 and v4 share one leaf layout (the cursor is never archived);
+        # flatten the cursor-stripped template for counting and order
+        t_leaves, treedef = jax.tree_util.tree_flatten(
+            _sans_cursor(template_state)
+        )
+        # v1-v3 restores predate the ring cursor; the re-attached zero
+        # cursor below is exact for their canonical archives, so only the
+        # carry prefix rules mark a restore as migrated
+        migrated = meta["version"] < 3
         if meta["version"] == 1:
             # v1 predates the indicator carry, whose leaves sit at the END
             # of the EngineState flatten order (it is the last field): the
@@ -126,6 +160,19 @@ def load_state(path: str | Path, template_state, registry):
 
     state = jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(a) for a in leaves]
+    )
+    # re-attach the canonical (zero) cursors the archive strips
+    from binquant_tpu.engine.buffer import MarketBuffer
+
+    def _with_cursor(triple):
+        times, values, filled = triple
+        return MarketBuffer(
+            times=times, values=values, filled=filled,
+            cursor=jnp.zeros(filled.shape, jnp.int32),
+        )
+
+    state = state._replace(
+        buf5=_with_cursor(state.buf5), buf15=_with_cursor(state.buf15)
     )
     registry.restore(meta["registry"])
     carries = dict(meta.get("host_carries", {}))

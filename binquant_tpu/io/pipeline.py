@@ -246,6 +246,34 @@ class OpenInterestCache:
 _copy_small_carries = None
 
 
+def _unique_buffers(state):
+    """``state`` with any leaf that SHARES a device buffer with an earlier
+    leaf replaced by a fresh copy. Donating a pytree whose leaves alias
+    one buffer (identical zero-fills in a fresh state; XLA deduping two
+    identical outputs of a step into one buffer) makes the runtime raise
+    "Attempt to donate the same buffer twice" — the double-buffered
+    dispatch runs its scratch slot through here first. Pointer reads are
+    ~free; only genuinely-aliased (small) leaves pay a copy."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    seen: set[int] = set()
+    out = []
+    for leaf in leaves:
+        try:
+            ptr = leaf.unsafe_buffer_pointer()
+        except Exception:
+            ptr = None
+        if ptr is not None:
+            if ptr in seen:
+                leaf = jnp.copy(leaf)
+            else:
+                seen.add(ptr)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _snapshot_small_carries(state):
     """Pre-donation device copies of the NON-buffer EngineState leaves —
     regime carry, dedupe carries, indicator carry; all (S,)/(S, k)-scale.
@@ -287,6 +315,10 @@ class _PendingTick(NamedTuple):
     trace: Any  # TickTrace (or NULL_TRACE when sampled out) — opened at
     # dispatch, closed when this tick finalizes; its trace_id is the
     # provenance every sink payload carries
+    # double-buffered donation only: this tick's post state, recycled as
+    # the NEXT dispatch's donated scratch slot once the tick finalizes
+    # (and its fallback can no longer need the buffers). None elsewhere.
+    spare: Any = None
 
 
 def _pow2_bucket(m: int, floor: int = 4) -> int:
@@ -528,6 +560,28 @@ class SignalEngine:
         self._donate_cfg = bool(getattr(config, "donate_enabled", True))
         self.donated_ticks = 0
         self.donated_state_resets = 0
+        # double-buffered donation (ISSUE 9, pipeline_depth >= 2): free
+        # resident state slots. A finalized tick's post state parks here
+        # and a later dispatch donates it as the scratch the outputs are
+        # written into, so the in-flight ticks' own post states stay live
+        # for their fallbacks. Empty = no free slot (a fresh zeros state
+        # is allocated at the next double-buffered dispatch); a small
+        # free LIST (not one slot) so deeper pipelines' flush drains
+        # don't drop slots and re-allocate. The generation counter bumps
+        # on every cold reset so a pending tick from a FAILED lineage
+        # cannot rotate its (possibly poisoned) pre-reset state back
+        # into the pool at finalize.
+        self._spare_slots: list = []
+        self._spare_slots_max = 4
+        self._state_generation = 0
+        # a finalized tick whose post state is STILL self.state (light
+        # load: every tick finalizes before the next dispatch) cannot
+        # rotate immediately — it parks here and is promoted into the
+        # free pool by the NEXT such finalize, whose wire fetch proves
+        # the computation that read the parked buffers has completed.
+        # Without this, light-load depth>=2 would allocate + zero-fill a
+        # fresh ~2x(S,W,F) scratch on every dispatch.
+        self._deferred_spare = None
         # -- scanned replay chunks (engine/step.py tick_step_scan, ISSUE 5)
         # Multi-tick lanes (replay, catch-up, backtesting) fuse runs of
         # clean-append incremental ticks into one lax.scan dispatch of up
@@ -1711,7 +1765,8 @@ class SignalEngine:
             "inputs_build", (time.perf_counter() - t_inputs0) * 1000.0
         )
         trace.record_span("inputs_build", t_inputs0)
-        donate = self._use_donated_step()
+        mode = self._donation_mode()
+        donate = mode is not None
         with self.latency.stage("device_dispatch"), trace.span(
             "device_dispatch", incremental=use_incremental, donated=donate
         ), trace.activate():
@@ -1722,11 +1777,40 @@ class SignalEngine:
             # paths re-run the full step via the fallback closure below.
             prev_state = self.state
             small = _snapshot_small_carries(prev_state) if donate else None
+            scratch = None
+            if mode == "double":
+                # rotate a free slot in; a fresh zeros state covers boot
+                # (no tick has finalized yet) and pool misses
+                scratch = (
+                    self._spare_slots.pop() if self._spare_slots else None
+                )
+                if scratch is None or scratch is prev_state:
+                    scratch = initial_engine_state(
+                        self.capacity, window=self.window
+                    )
+                # donation rejects internally-aliased buffers (zero-fill
+                # dedup in a fresh state, XLA output dedup in a recycled
+                # one) — split them before handing the slot over
+                scratch = _unique_buffers(scratch)
+            # ONE source of truth per donation mode for the dispatched
+            # function, its ledger/recompile-counter name, and its
+            # positional args — the cost thunk below must lower exactly
+            # the signature the launch executes
+            from binquant_tpu.engine.step import tick_step_wire_db
+
+            fn_name, step_fn = {
+                "single": ("tick_step_wire_donated", tick_step_wire_donated),
+                "double": ("tick_step_wire_db", tick_step_wire_db),
+            }.get(mode, ("tick_step_wire", tick_step_wire))
+            launch_args = (
+                (prev_state, scratch, u5, u15, inputs)
+                if mode == "double"
+                else (prev_state, u5, u15, inputs)
+            )
             # recompile counter + symbols-per-tick gauge (engine/step.py's
             # shape-signature cache — a True return means the launch below
             # pays a jax trace+compile, which the executable ledger then
             # times and costs)
-            fn_name = "tick_step_wire_donated" if donate else "tick_step_wire"
             is_new_sig = observe_dispatch(
                 prev_state, u5, u15, self._wire_enabled_key(),
                 cfg=self.context_config,
@@ -1743,23 +1827,20 @@ class SignalEngine:
                 if trace.active or profiler_window_active()
                 else contextlib.nullcontext()
             )
-            step_fn = tick_step_wire_donated if donate else tick_step_wire
             ledger_sig = self._ledger_sig(u5, u15, use_incremental)
             cost_fn = None
             if is_new_sig:
                 # cost thunk over ABSTRACT avals captured before the launch
                 # can donate the state — lowering is a re-trace, not a
                 # recompile, and runs on the ledger's background worker
-                (a_state, a_u5, a_u15, a_inputs), _ = abstract_args(
-                    (prev_state, u5, u15, inputs)
-                )
+                a_pos, _ = abstract_args(launch_args)
                 cfg_, key_ = self.context_config, self._wire_enabled_key()
                 incr_, maint_ = use_incremental, self.incremental
                 dig_ = self.numeric_digest
 
-                def cost_fn(fn=step_fn):
+                def cost_fn(fn=step_fn, a_pos=a_pos):
                     return lowered_cost(
-                        fn, a_state, a_u5, a_u15, a_inputs, cfg_,
+                        fn, *a_pos, cfg_,
                         wire_enabled=key_, incremental=incr_,
                         maintain_carry=maint_, params=sp_arg,
                         numeric_digest=dig_,
@@ -1771,10 +1852,7 @@ class SignalEngine:
                     cost_fn=cost_fn, tick=self.ticks_processed,
                 ), step_ctx:
                     self.state, wire = step_fn(
-                        prev_state,
-                        u5,
-                        u15,
-                        inputs,
+                        *launch_args,
                         self.context_config,
                         # device-side wire compaction must match the host's
                         # enabled set
@@ -1787,14 +1865,18 @@ class SignalEngine:
                         numeric_digest=self.numeric_digest,
                     )
             except BaseException:
-                if donate:
+                if mode == "single":
                     # a launch that failed AFTER consuming the donated
                     # buffers leaves no usable pre-tick state — detect and
                     # reset instead of crash-looping on deleted arrays
                     self._recover_after_donated_failure(prev_state)
+                # "double": only the spare slot was consumed; prev_state
+                # (still self.state) is intact — the slot re-allocates
+                # at the next dispatch
                 raise
             if donate:
                 self.donated_ticks += 1
+            if mode == "single":
                 # the only live references to the donated buffers are gone
                 # past this point — the audit the donated path relies on:
                 # fallback/prewarm/checkpoint all read self.state (post)
@@ -1818,15 +1900,15 @@ class SignalEngine:
         # fallback wire keeps the engine's layout)
         incr_args = (use_incremental, self.incremental, self.numeric_digest)
 
-        if donate:
+        if mode == "single":
             # Donated dispatch: the pre-tick buffers no longer exist, so
             # the fallback rebuilds this tick's evaluation from the
             # POST-tick buffers (updates only feed apply_updates, already
             # applied) + the pre-tick small-carry snapshots, with EMPTY
             # update batches. ``self.state`` is read lazily at CALL time —
-            # correct because donation is only engaged at depth<=1, where
-            # a tick always finalizes before the next dispatch can donate
-            # the post state (_use_donated_step).
+            # correct because single-slot donation is only engaged at
+            # depth<=1, where a tick always finalizes before the next
+            # dispatch can donate the post state (_donation_mode).
             empty = self._empty_updates()
 
             def fallback(
@@ -1834,6 +1916,37 @@ class SignalEngine:
             ):
                 small_, inp, cfg_, key_, (incr_, maint_, dig_), emp, sp_ = _args
                 st = self.state._replace(
+                    regime_carry=small_[0],
+                    mrf_last_emitted=small_[1],
+                    pt_last_signal_close=small_[2],
+                    indicator_carry=small_[3],
+                )
+                _, full = tick_step(
+                    st, emp, emp, inp, cfg_, wire_enabled=key_,
+                    incremental=incr_, maintain_carry=maint_, params=sp_,
+                    numeric_digest=dig_,
+                )
+                return full
+
+            warm_sig = (key, "donated", empty[0].shape, incr_args)
+        elif mode == "double":
+            # Double-buffered dispatch at depth>=2: by the time this tick
+            # finalizes, LATER dispatches have replaced self.state — so
+            # the post state is captured EAGERLY (it is alive: the db step
+            # donated only the scratch slot). Same empty-updates
+            # re-evaluation from post buffers + pre-tick small carries as
+            # the single-slot scheme; same jit cache entry (tick_step on
+            # empty buckets), so one pre-warm covers both donation modes.
+            empty = self._empty_updates()
+            post_state = self.state
+
+            def fallback(
+                _args=(post_state, small, inputs, cfg, key, incr_args,
+                       empty, sp_arg)
+            ):
+                post, small_, inp, cfg_, key_, incrs, emp, sp_ = _args
+                incr_, maint_, dig_ = incrs
+                st = post._replace(
                     regime_carry=small_[0],
                     mrf_last_emitted=small_[1],
                     pt_last_signal_close=small_[2],
@@ -1922,6 +2035,14 @@ class SignalEngine:
             dispatched_at=time.perf_counter(),
             rows=self.registry.frozen_rows(),
             trace=trace,
+            # double-buffered donation: this tick's post state re-enters
+            # the slot rotation once the tick finalizes (tagged with the
+            # reset generation so a post-reset finalize discards it)
+            spare=(
+                (self.state, self._state_generation)
+                if mode == "double"
+                else None
+            ),
         )
 
     async def _finalize_tick(self, pending: _PendingTick) -> list:
@@ -1941,6 +2062,43 @@ class SignalEngine:
                 raise
             finally:
                 self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+                # double-buffered donation slot rotation: a finalized
+                # tick's post state becomes the next dispatch's scratch —
+                # UNLESS it is still the engine's current state (the tick
+                # was finalized before any newer dispatch, e.g. a
+                # flush_pending drain), which must never be donated while
+                # also being the next launch's input, or it predates a
+                # cold reset (stale generation: the buffers may belong to
+                # the failed lineage the reset just discarded)
+                if pending.spare is not None:
+                    spare_state, spare_gen = pending.spare
+
+                    def _pool(st):
+                        if len(self._spare_slots) < self._spare_slots_max:
+                            self._spare_slots.append(st)
+
+                    # promote a previously parked state first: ANY later
+                    # finalize's wire fetch proves the computation that
+                    # read the parked buffers (the dispatch right after
+                    # parking) has completed — without this, one parked
+                    # state would stay pinned for the rest of a
+                    # sustained-load run
+                    d = self._deferred_spare
+                    if (
+                        d is not None
+                        and d[1] == self._state_generation
+                        and d[0] is not self.state
+                    ):
+                        _pool(d[0])
+                        self._deferred_spare = None
+                    if spare_gen == self._state_generation:
+                        if spare_state is not self.state:
+                            _pool(spare_state)
+                        else:
+                            # light load: this tick's post state is still
+                            # the engine's current state — park it until
+                            # a later dispatch replaces self.state
+                            self._deferred_spare = (spare_state, spare_gen)
 
     async def _finalize_tick_inner(self, pending: _PendingTick, trace) -> list:
         ts5, ts15 = pending.ts5, pending.ts15
@@ -2151,31 +2309,42 @@ class SignalEngine:
             )
         return fired
 
-    def _use_donated_step(self) -> bool:
-        """Whether THIS dispatch may donate the engine state (BQT_DONATE).
+    def _donation_mode(self) -> str | None:
+        """How THIS dispatch donates the engine state (BQT_DONATE).
 
-        Safety conditions, audited against every post-dispatch reader of
-        the pre-tick state:
+        * ``None`` — copying step (donation off, or a sharded mesh, whose
+          executables keep the copying layout).
+        * ``"single"`` — ``pipeline_depth <= 1``: the classic ISSUE-4
+          scheme donating the input state itself. Safe because
+          process_tick finalizes tick i before dispatching i+1, so the
+          donated fallback's lazy ``self.state`` read at finalize still
+          sees tick i's post state.
+        * ``"double"`` — ``pipeline_depth >= 2`` (ISSUE 9): the
+          double-buffered step (``tick_step_wire_db``) donates a SECOND
+          resident slot — rotated through the ``self._spare_slots`` free
+          pool (plus the light-load ``self._deferred_spare`` parking
+          slot) — while the input state stays live, so every in-flight
+          tick's fallback keeps its own (eagerly captured) post state.
+          Host finalize of tick i overlaps the device dispatch of tick
+          i+1 with donated buffers live — the depth-2 pipelining
+          donation previously forfeited.
 
-        * ``pipeline_depth <= 1`` — process_tick finalizes tick i before
-          dispatching i+1, so a donated fallback's lazy ``self.state``
-          read at finalize still sees tick i's post state. At depth >= 2
-          the NEXT dispatch donates that state before tick i finalizes.
-        * single chip — keeps the donated executable's layout identical to
-          the warm path; the sharded engine keeps the copying step.
-
-        The crash ring's semantics change under donation: a launch that
-        fails after consuming its buffers cannot carry on with the
+        The crash ring's semantics under ``single`` donation: a launch
+        that fails after consuming its buffers cannot carry on with the
         pre-tick state — _recover_after_donated_failure resets cold
         (logged loudly, counted) instead of crash-looping on deleted
-        arrays. Host-side errors before the launch leave state intact
-        either way.
+        arrays. Under ``double`` only the spare slot is consumed; the
+        input state survives a failed launch intact, so no reset is
+        needed (the slot is simply re-allocated next dispatch). Host-side
+        errors before the launch leave state intact either way.
         """
-        return (
-            self._donate_cfg
-            and self.pipeline_depth <= 1
-            and self.mesh is None
-        )
+        if not self._donate_cfg or self.mesh is not None:
+            return None
+        return "single" if self.pipeline_depth <= 1 else "double"
+
+    def _use_donated_step(self) -> bool:
+        """Back-compat boolean view of :meth:`_donation_mode`."""
+        return self._donation_mode() is not None
 
     def _reset_state_cold(self, why: str) -> None:
         """Replace an unrecoverable engine state with a cold empty one —
@@ -2189,6 +2358,12 @@ class SignalEngine:
             self.donated_state_resets,
         )
         self.state = initial_engine_state(self.capacity, window=self.window)
+        # drop the double-buffer slots too — they may alias buffers the
+        # failed computation produced — and invalidate any spare still
+        # riding a pending tick of the failed lineage
+        self._spare_slots.clear()
+        self._deferred_spare = None
+        self._state_generation += 1
         if self.mesh is not None:
             # re-apply the symbol-axis sharding __init__ installed — an
             # unsharded replacement state would silently repin the whole
@@ -2402,9 +2577,13 @@ class SignalEngine:
         the carry sync state. A v2 restore carries the indicator state in
         the EngineState pytree (synced); a migrated v1 restore has only the
         empty template carry — the first tick runs the full recompute."""
+        from binquant_tpu.engine.buffer import ring_latest_times
+
         carry_synced = not migrated
         for key, buf in (("5m", self.state.buf5), ("15m", self.state.buf15)):
-            latest = np.asarray(buf.times[:, -1]).astype(np.int64)
+            # restored archives are canonical (cursor 0), but read through
+            # the ring-aware helper so a mid-phase state is also correct
+            latest = np.asarray(ring_latest_times(buf)).astype(np.int64)
             self._host_latest[key] = latest
             # a v2 archive written by a classic-path deployment
             # (BQT_INCREMENTAL=0 skips carry maintenance) holds a stale/

@@ -65,6 +65,7 @@ def _shard_buffer(buf: MarketBuffer, mesh: Mesh) -> MarketBuffer:
         times=jax.device_put(buf.times, s2),
         values=jax.device_put(buf.values, s3),
         filled=jax.device_put(buf.filled, s1),
+        cursor=jax.device_put(buf.cursor, s1),
     )
 
 

@@ -169,3 +169,119 @@ def test_maybe_save_cadence(replay_buckets, tmp_path):
     _drive(e, replay_buckets, buckets[1:2])
     assert mgr.maybe_save(e)
     assert mgr.path.exists()
+
+
+def test_v3_archive_migrates_to_v4(replay_buckets, tmp_path):
+    """A v3 archive (pre-ring-cursor; right-aligned buffers, no cursor
+    leaves) restores into the v4 engine: same leaf layout (v4 strips the
+    cursor on save), zero cursors re-attached, identical next tick."""
+    import jax
+
+    from binquant_tpu.io.checkpoint import _archive_leaves
+
+    buckets = sorted(replay_buckets)
+    a = make_stub_engine(capacity=CAP, window=WIN)
+    _drive(a, replay_buckets, buckets[:-1])
+
+    # craft the v3 archive by hand: v4's leaf sequence under version 3
+    # (bit-compatible by design — canonicalize-on-save + cursor strip)
+    from binquant_tpu.engine.step import canonicalize_state
+
+    leaves = _archive_leaves(canonicalize_state(a.state))
+    meta = {
+        "version": 3,
+        "n_leaves": len(leaves),
+        "registry": a.registry.to_mapping(),
+        "host_carries": a.host_carries(),
+    }
+    ckpt = tmp_path / "v3.ckpt.npz"
+    np.savez(
+        ckpt,
+        __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+
+    b = make_stub_engine(capacity=CAP, window=WIN)
+    assert CheckpointManager(ckpt).try_restore(b)
+    assert np.all(np.asarray(b.state.buf5.cursor) == 0)
+    assert np.all(np.asarray(b.state.buf15.cursor) == 0)
+    _assert_states_equal(a.state, b.state)
+    fired_a = _drive(a, replay_buckets, buckets[-1:])
+    fired_b = _drive(b, replay_buckets, buckets[-1:])
+    key = lambda s: (s.strategy, s.symbol, s.value.direction, s.value.score)
+    assert [key(s) for s in fired_a] == [key(s) for s in fired_b]
+
+
+def test_save_canonicalizes_mid_phase_cursor(tmp_path):
+    """save_state with a MID-PHASE ring cursor canonicalizes: the archive
+    holds the right-aligned view, restores with cursor 0, and reads the
+    same bars the live ring held."""
+    from binquant_tpu.engine.buffer import Field, materialize
+    from binquant_tpu.engine.step import (
+        apply_updates_step,
+        initial_engine_state,
+        pad_updates,
+    )
+    from binquant_tpu.io.checkpoint import load_state
+    from binquant_tpu.engine.buffer import SymbolRegistry
+
+    S, W = 4, 8
+    state = initial_engine_state(S, window=W)
+    for i in range(W + 3):  # wraps the ring past W → cursor mid-phase
+        upd = pad_updates(
+            np.arange(S, dtype=np.int32),
+            np.full(S, 1000 + i, np.int32),
+            np.full((S, 10), float(i), np.float32),
+            size=S,
+        )
+        state = apply_updates_step(state, upd, upd)
+    assert int(np.asarray(state.buf5.cursor)[0]) == (W + 3) % W != 0
+
+    reg = SymbolRegistry(S)
+    for i in range(S):
+        reg.add(f"S{i}USDT")
+    ckpt = tmp_path / "midphase.ckpt.npz"
+    save_state(ckpt, state, reg)
+
+    template = initial_engine_state(S, window=W)
+    restored, _ = load_state(ckpt, template, SymbolRegistry(S))
+    assert np.all(np.asarray(restored.buf5.cursor) == 0)
+    want = materialize(state.buf5)
+    np.testing.assert_array_equal(
+        np.asarray(restored.buf5.times), np.asarray(want.times)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.buf5.values[:, :, Field.CLOSE]),
+        np.asarray(want.values[:, :, Field.CLOSE]),
+    )
+
+
+@pytest.mark.slow
+def test_kill_and_restore_mid_phase_cursor_incremental(replay_buckets, tmp_path):
+    """Kill-and-restore with the cursor genuinely mid-phase: an
+    INCREMENTAL engine's ticks advance the ring without canonicalizing
+    (only full/audit ticks do), so the save must canonicalize and the
+    restored engine — reading the same values through cursor-relative
+    gathers — must produce the identical next tick."""
+    buckets = sorted(replay_buckets)
+    ckpt = tmp_path / "midphase_incr.ckpt.npz"
+
+    a = make_stub_engine(capacity=CAP, window=WIN, incremental=True)
+    _drive(a, replay_buckets, buckets[:-1])
+    # the post-cold-start incremental ticks left the ring mid-phase
+    assert int(np.asarray(a.state.buf15.cursor).max()) > 0
+    save_state(ckpt, a.state, a.registry, host_carries=a.host_carries())
+
+    b = make_stub_engine(capacity=CAP, window=WIN, incremental=True)
+    assert CheckpointManager(ckpt).try_restore(b)
+    # carry synced → the restored engine continues on the fast path
+    assert b._carry_desync_reason is None
+    fired_a = _drive(a, replay_buckets, buckets[-1:])
+    fired_b = _drive(b, replay_buckets, buckets[-1:])
+    key = lambda s: (s.strategy, s.symbol, s.value.direction, s.value.score)
+    assert [key(s) for s in fired_a] == [key(s) for s in fired_b]
+    from binquant_tpu.engine.step import canonicalize_state
+
+    _assert_states_equal(
+        canonicalize_state(a.state), canonicalize_state(b.state)
+    )

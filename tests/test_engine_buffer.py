@@ -3,6 +3,13 @@
 Reference behavior pinned here: concat → drop_duplicates(keep='last') →
 sort → tail(max_bars) per candle (market_state_store.py:19-32), exact-ts
 freshness (l.49-54).
+
+Since ISSUE 9 the live layout is a circular-cursor ring (appends are a
+one-column scatter + cursor bump); the canonical right-aligned view is
+reconstructed by ``materialize``. The original shift-append update is kept
+as ``apply_updates_shift`` and serves as the bit-equality oracle for the
+property suite at the bottom (clean appends, dedupe re-sends, mid-history
+rewrites, churn, warm-up/min_periods edges, wrap-around).
 """
 
 import numpy as np
@@ -14,10 +21,14 @@ from binquant_tpu.engine import (
     IngestBatcher,
     SymbolRegistry,
     apply_updates,
+    apply_updates_shift,
     empty_buffer,
     fresh_mask,
+    materialize,
+    materialize_tail,
     ms_to_s,
     reset_rows,
+    ring_latest_times,
 )
 
 
@@ -38,12 +49,16 @@ def test_append_and_right_alignment():
             buf, np.array([2], dtype=np.int32), np.array([ts], dtype=np.int32), mk_vals(10.0 + i)
         )
     assert int(buf.filled[2]) == 3
-    assert int(buf.times[2, -1]) == 300
-    assert int(buf.times[2, -2]) == 200
-    assert float(buf.values[2, -1, Field.CLOSE]) == 12.0
+    assert int(buf.cursor[2]) == 3  # three appends bumped the write cursor
+    assert int(ring_latest_times(buf)[2]) == 300
+    m = materialize(buf)
+    assert int(m.times[2, -1]) == 300
+    assert int(m.times[2, -2]) == 200
+    assert float(m.values[2, -1, Field.CLOSE]) == 12.0
+    assert np.all(np.asarray(m.cursor) == 0)  # canonical
     # untouched rows stay empty
     assert int(buf.filled[0]) == 0
-    assert np.all(np.asarray(buf.times[0]) == -1)
+    assert np.all(np.asarray(m.times[0]) == -1)
 
 
 def test_duplicate_timestamp_overwrites_last():
@@ -51,7 +66,8 @@ def test_duplicate_timestamp_overwrites_last():
     buf = apply_updates(buf, np.array([0], np.int32), np.array([100], np.int32), mk_vals(1.0))
     buf = apply_updates(buf, np.array([0], np.int32), np.array([100], np.int32), mk_vals(2.0))
     assert int(buf.filled[0]) == 1
-    assert float(buf.values[0, -1, Field.CLOSE]) == 2.0
+    m = materialize(buf)
+    assert float(m.values[0, -1, Field.CLOSE]) == 2.0
 
 
 def test_stale_update_ignored():
@@ -59,28 +75,33 @@ def test_stale_update_ignored():
     buf = apply_updates(buf, np.array([0], np.int32), np.array([200], np.int32), mk_vals(5.0))
     buf = apply_updates(buf, np.array([0], np.int32), np.array([100], np.int32), mk_vals(9.0))
     assert int(buf.filled[0]) == 1
-    assert float(buf.values[0, -1, Field.CLOSE]) == 5.0
-    assert int(buf.times[0, -1]) == 200
+    m = materialize(buf)
+    assert float(m.values[0, -1, Field.CLOSE]) == 5.0
+    assert int(m.times[0, -1]) == 200
 
 
 def test_mid_history_rewrite_in_place():
     """A re-sent candle whose timestamp already sits mid-window overwrites
     THAT bar (reference dedupe-by-timestamp keep-last,
-    market_state_store.py:19-32) without touching order or fill count."""
+    market_state_store.py:19-32) without touching order, fill count, or
+    the write cursor."""
     buf = empty_buffer(2, window=4)
     for i, ts in enumerate([100, 200, 300]):
         buf = apply_updates(
             buf, np.array([0], np.int32), np.array([ts], np.int32),
             mk_vals(float(i + 1)),
         )
+    cursor_before = int(buf.cursor[0])
     # correction for the MIDDLE bar (ts=200)
     buf = apply_updates(
         buf, np.array([0], np.int32), np.array([200], np.int32), mk_vals(77.0)
     )
     assert int(buf.filled[0]) == 3
-    assert [int(t) for t in buf.times[0, -3:]] == [100, 200, 300]
-    assert float(buf.values[0, -2, Field.CLOSE]) == 77.0
-    assert float(buf.values[0, -1, Field.CLOSE]) == 3.0  # latest untouched
+    assert int(buf.cursor[0]) == cursor_before  # rewrite never bumps
+    m = materialize(buf)
+    assert [int(t) for t in m.times[0, -3:]] == [100, 200, 300]
+    assert float(m.values[0, -2, Field.CLOSE]) == 77.0
+    assert float(m.values[0, -1, Field.CLOSE]) == 3.0  # latest untouched
 
 
 def test_older_absent_timestamp_still_dropped():
@@ -95,8 +116,9 @@ def test_older_absent_timestamp_still_dropped():
         buf, np.array([0], np.int32), np.array([200], np.int32), mk_vals(9.0)
     )
     assert int(buf.filled[0]) == 2
-    assert [int(t) for t in buf.times[0, -2:]] == [100, 300]
-    assert not (np.asarray(buf.values[0, :, Field.CLOSE]) == 9.0).any()
+    m = materialize(buf)
+    assert [int(t) for t in m.times[0, -2:]] == [100, 300]
+    assert not (np.asarray(m.values[0, :, Field.CLOSE]) == 9.0).any()
 
 
 def test_window_rolls_oldest_off():
@@ -106,8 +128,10 @@ def test_window_rolls_oldest_off():
             buf, np.array([0], np.int32), np.array([100 + i], np.int32), mk_vals(float(i))
         )
     assert int(buf.filled[0]) == 3
-    assert list(np.asarray(buf.times[0])) == [102, 103, 104]
-    assert list(np.asarray(buf.values[0, :, Field.CLOSE])) == [2.0, 3.0, 4.0]
+    assert int(buf.cursor[0]) == 5 % 3  # wrapped cursor
+    m = materialize(buf)
+    assert list(np.asarray(m.times[0])) == [102, 103, 104]
+    assert list(np.asarray(m.values[0, :, Field.CLOSE])) == [2.0, 3.0, 4.0]
 
 
 def test_batched_update_multiple_symbols():
@@ -130,7 +154,8 @@ def test_out_of_range_rows_dropped():
     buf = apply_updates(buf, rows, ts, vals)
     assert int(buf.filled[0]) == 0
     assert int(buf.filled[1]) == 1
-    assert float(buf.values[1, -1, Field.CLOSE]) == 3.0
+    m = materialize(buf)
+    assert float(m.values[1, -1, Field.CLOSE]) == 3.0
 
 
 def test_registry_free_list_reuse():
@@ -151,6 +176,7 @@ def test_reset_rows_clears_state():
     buf = apply_updates(buf, np.array([1], np.int32), np.array([100], np.int32), mk_vals(5.0))
     buf = reset_rows(buf, np.array([1], dtype=np.int32))
     assert int(buf.filled[1]) == 0
+    assert int(buf.cursor[1]) == 0  # cleared rows restart canonical
     assert np.all(np.asarray(buf.times[1]) == -1)
     assert np.all(np.isnan(np.asarray(buf.values[1])))
 
@@ -207,6 +233,144 @@ def test_ingest_batcher_multi_timestamp_subbatches():
         buf = apply_updates(buf, rows, ts, vals)
     ra = reg.row_of("A")
     assert int(buf.filled[ra]) == 2
-    closes = np.asarray(buf.values[ra, :, Field.CLOSE])
+    closes = np.asarray(materialize(buf).values[ra, :, Field.CLOSE])
     assert list(closes[-2:]) == [1.0, 2.0]
     assert int(buf.filled[reg.row_of("B")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Cursor ring vs shift-append: bit-equality property suite (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def _assert_same(ring, shift, ctx=""):
+    """materialize(ring) must be BIT-identical to the shift layout."""
+    m = materialize(ring)
+    assert np.array_equal(np.asarray(m.times), np.asarray(shift.times)), ctx
+    mv, sv = np.asarray(m.values), np.asarray(shift.values)
+    assert ((mv == sv) | (np.isnan(mv) & np.isnan(sv))).all(), ctx
+    assert np.array_equal(np.asarray(m.filled), np.asarray(shift.filled)), ctx
+    assert np.array_equal(
+        np.asarray(ring_latest_times(ring)), np.asarray(shift.times)[:, -1]
+    ), ctx
+    # the cursor stays in [0, W) and equals filled-mod-W for pure-append
+    # histories (no structural invariant is broken by rewrites/resets)
+    W = ring.times.shape[1]
+    cur = np.asarray(ring.cursor)
+    assert ((cur >= 0) & (cur < W)).all(), ctx
+
+
+def _batch(entries):
+    rows = np.array([r for r, _, _ in entries], np.int32)
+    ts = np.array([t for _, t, _ in entries], np.int32)
+    vals = np.zeros((len(entries), 10), np.float32)
+    for i, (_, _, c) in enumerate(entries):
+        vals[i, Field.CLOSE] = c
+        vals[i, Field.VOLUME] = 1.0 + i
+    return rows, ts, vals
+
+
+class TestCursorRingParity:
+    """Every update class the live stream produces, driven through BOTH
+    implementations from the same empty buffer."""
+
+    def _drive(self, window, batches, resets=()):
+        ring = empty_buffer(3, window=window)
+        shift = empty_buffer(3, window=window)
+        resets = dict(resets)
+        for i, entries in enumerate(batches):
+            rows, ts, vals = _batch(entries)
+            ring = apply_updates(ring, rows, ts, vals)
+            shift = apply_updates_shift(shift, rows, ts, vals)
+            if i in resets:
+                rr = np.array([resets[i]], np.int32)
+                ring = reset_rows(ring, rr)
+                shift = reset_rows(shift, rr)
+            _assert_same(ring, shift, ctx=f"batch {i}")
+        return ring, shift
+
+    def test_clean_append_run_past_wraparound(self):
+        batches = [[(0, 100 + i, float(i)), (1, 100 + i, float(-i))] for i in range(11)]
+        ring, _ = self._drive(4, batches)
+        assert int(ring.cursor[0]) == 11 % 4
+
+    def test_dedupe_resend_of_latest_bar(self):
+        batches = [
+            [(0, 100, 1.0)],
+            [(0, 200, 2.0)],
+            [(0, 200, 2.5)],  # exchange re-sent the same bucket corrected
+            [(0, 300, 3.0)],
+        ]
+        self._drive(4, batches)
+
+    def test_mid_history_rewrite_after_wrap(self):
+        batches = [[(0, 100 + i, float(i))] for i in range(6)]  # wraps W=4
+        batches.append([(0, 103, 99.0)])  # rewrite a bar now mid-ring
+        batches.append([(0, 106, 6.0)])  # appends continue cleanly
+        self._drive(4, batches)
+
+    def test_stale_absent_timestamp_dropped_after_wrap(self):
+        batches = [[(0, 100 + 2 * i, float(i))] for i in range(6)]
+        batches.append([(0, 101, 50.0)])  # never stored → dropped
+        self._drive(4, batches)
+
+    def test_churn_reset_and_reclaim(self):
+        batches = [[(1, 100 + i, float(i))] for i in range(5)]
+        batches += [[(1, 50, 9.0)]]  # the RECLAIMED row starts a new epoch
+        batches += [[(1, 51 + i, float(i))] for i in range(6)]
+        self._drive(4, batches, resets={4: 1})
+
+    def test_min_periods_warmup_edges(self):
+        """Partially-filled rings: every fill level below the window must
+        read the same warm-up sentinels through the canonical view."""
+        for n in range(1, 5):
+            batches = [[(0, 100 + i, float(i))] for i in range(n)]
+            ring, shift = self._drive(4, batches)
+            m = materialize(ring)
+            empties = np.asarray(m.times[0]) == -1
+            assert empties.sum() == 4 - n
+            assert empties[: 4 - n].all()  # warm-up NaN at the FRONT
+
+    def test_tail_view_matches_canonical_suffix(self):
+        batches = [[(0, 100 + i, float(i)), (2, 100 + i, float(i) * 2)] for i in range(9)]
+        ring, shift = self._drive(6, batches)
+        for k in (1, 2, 5):
+            tail = materialize_tail(ring, k)
+            assert np.array_equal(
+                np.asarray(tail.times), np.asarray(shift.times)[:, -k:]
+            )
+            tv = np.asarray(tail.values)
+            sv = np.asarray(shift.values)[:, -k:]
+            assert ((tv == sv) | (np.isnan(tv) & np.isnan(sv))).all()
+            # filled stays the TRUE count even when it exceeds the width
+            assert np.array_equal(np.asarray(tail.filled), np.asarray(shift.filled))
+
+    def test_randomized_stream(self):
+        rng = np.random.default_rng(1234)
+        ring = empty_buffer(4, window=5)
+        shift = empty_buffer(4, window=5)
+        last = np.zeros(4, int)
+        for step in range(120):
+            entries = []
+            for s in range(4):
+                if rng.random() < 0.6:
+                    roll = rng.random()
+                    if roll < 0.65 or last[s] == 0:
+                        last[s] += 1
+                        t = 1000 + last[s]
+                    elif roll < 0.85:
+                        t = 1000 + int(rng.integers(1, last[s] + 1))
+                    else:
+                        t = 1000 + last[s]
+                    entries.append((s, t, float(rng.random() * 100)))
+            if not entries:
+                continue
+            rows, ts, vals = _batch(entries)
+            ring = apply_updates(ring, rows, ts, vals)
+            shift = apply_updates_shift(shift, rows, ts, vals)
+            if step % 17 == 0:
+                rr = np.array([int(rng.integers(0, 4))], np.int32)
+                ring = reset_rows(ring, rr)
+                shift = reset_rows(shift, rr)
+                last[int(rr[0])] = 0
+            _assert_same(ring, shift, ctx=f"step {step}")

@@ -131,8 +131,17 @@ def test_incremental_step_matches_full_stream():
     # drift-audit resync is seamless: swap the streamed carry for a fresh
     # window re-init (what a full/audit tick produces) and the NEXT
     # incremental tick's verdicts are unchanged
-    state_resync = state_incr._replace(
-        indicator_carry=init_indicator_carry(state_incr.buf5, state_incr.buf15)
+    # init_indicator_carry reads canonical right-aligned windows — exactly
+    # what a real full/audit tick hands it (it materializes the ring
+    # first); the canonicalized state then takes the incremental tick
+    # reading the SAME values through cursor-relative gathers
+    from binquant_tpu.engine.step import canonicalize_state
+
+    state_resync = canonicalize_state(state_incr)
+    state_resync = state_resync._replace(
+        indicator_carry=init_indicator_carry(
+            state_resync.buf5, state_resync.buf15
+        )
     )
     ts += 900
     rows, tss, vals, px = _updates(rng, len(px), ts, px)
@@ -154,7 +163,7 @@ def test_incremental_step_matches_full_stream():
 def test_incremental_pack_parity_on_stream():
     """FeaturePack readout parity over a streamed buffer (NaN masks equal,
     values within f32 tolerance — ULP-scaled for the near-zero MACD)."""
-    from binquant_tpu.engine.buffer import apply_updates, empty_buffer
+    from binquant_tpu.engine.buffer import apply_updates, empty_buffer, materialize
     from binquant_tpu.strategies.features import (
         advance_feature_carry,
         compute_feature_pack,
@@ -170,7 +179,7 @@ def test_incremental_pack_parity_on_stream():
     px[0] = 68_000.0  # BTC-scale row: exercises the centered moments
     for b in range(80):
         rows, tss, vals, px = _updates(rng, S, t0 + b * 900, px)
-        buf = apply_updates(buf, rows, tss, vals)
+        buf = materialize(apply_updates(buf, rows, tss, vals))
     carry = init_feature_carry(buf)
 
     for b in range(80, 140):
@@ -178,7 +187,7 @@ def test_incremental_pack_parity_on_stream():
         if b % 5 == 0:  # a symbol missing a bar stays parity-exact
             keep = rows != 2
             rows, tss, vals = rows[keep], tss[keep], vals[keep]
-        buf = apply_updates(buf, rows, tss, vals)
+        buf = materialize(apply_updates(buf, rows, tss, vals))
         carry, stale = advance_feature_carry(buf, carry)
         assert not np.asarray(stale).any()
         got = feature_pack_from_carry(buf, carry, stale)
@@ -209,7 +218,7 @@ def test_stale_row_is_nan_masked_not_wrong():
     """Device-side defense in depth: a carry that desyncs from its row
     (reclaimed registry slot) NaN-masks that row's indicators instead of
     serving another symbol's state."""
-    from binquant_tpu.engine.buffer import apply_updates, empty_buffer
+    from binquant_tpu.engine.buffer import apply_updates, empty_buffer, materialize
     from binquant_tpu.strategies.features import (
         advance_feature_carry,
         feature_pack_from_carry,
@@ -223,7 +232,7 @@ def test_stale_row_is_nan_masked_not_wrong():
     px = 50.0 + rng.random(S)
     for b in range(40):
         rows, tss, vals, px = _updates(rng, S, t0 + b * 900, px)
-        buf = apply_updates(buf, rows, tss, vals)
+        buf = materialize(apply_updates(buf, rows, tss, vals))
     carry = init_feature_carry(buf)
     # row 1 is wiped (symbol left) and reclaimed by a NEW symbol whose
     # first bar lands at a much later timestamp — the carry still holds
@@ -239,7 +248,7 @@ def test_stale_row_is_nan_masked_not_wrong():
     vals[0, Field.HIGH] = 124.0
     vals[0, Field.LOW] = 122.0
     vals[0, Field.VOLUME] = 10.0
-    buf = apply_updates(buf, rows, tss, vals)
+    buf = materialize(apply_updates(buf, rows, tss, vals))
     carry, stale = advance_feature_carry(buf, carry)
     assert bool(np.asarray(stale)[1])
     pack = feature_pack_from_carry(buf, carry, stale)
@@ -429,7 +438,7 @@ def _stream_buffer(rng, n_rows, bars, burst_at=(), t0=1_753_000_200):
     """Stream a buffer bar-by-bar, yielding (buf, ts) after each append.
     ``burst_at`` bars get an ABP-shaped pump: 8x volume, +2% bullish close
     near the high, following two mild up-closes."""
-    from binquant_tpu.engine.buffer import apply_updates, empty_buffer
+    from binquant_tpu.engine.buffer import apply_updates, empty_buffer, materialize
 
     buf = empty_buffer(S_CAP, WINDOW)
     px = 50.0 + rng.random(n_rows) * 10
@@ -450,8 +459,8 @@ def _stream_buffer(rng, n_rows, bars, burst_at=(), t0=1_753_000_200):
         vals[:, Field.NUM_TRADES] = 150
         vals[:, Field.DURATION_S] = 900
         rows = np.arange(n_rows, dtype=np.int32)
-        buf = apply_updates(
-            buf, rows, np.full(n_rows, ts, np.int32), vals
+        buf = materialize(
+            apply_updates(buf, rows, np.full(n_rows, ts, np.int32), vals)
         )
         px = closes
         yield buf, ts
@@ -688,9 +697,14 @@ def test_checkpoint_v1_migration(tmp_path):
     for i in range(4):
         registry.add(f"S{i}USDT")
 
-    # craft a v1 archive: the non-carry leaf prefix under version 1
+    # craft a v1 archive: the non-carry leaf prefix under version 1.
+    # v1 predates the ring cursor, so the leaf sequence is the
+    # cursor-stripped canonical one (checkpoint._archive_leaves)
+    from binquant_tpu.engine.step import canonicalize_state
+    from binquant_tpu.io.checkpoint import _archive_leaves
+
     n_carry = len(jax.tree_util.tree_leaves(state.indicator_carry))
-    leaves = jax.tree_util.tree_leaves(state)
+    leaves = _archive_leaves(canonicalize_state(state))
     v1_leaves = leaves[: len(leaves) - n_carry]
     meta = {
         "version": 1,

@@ -132,3 +132,51 @@ def test_depth_zero_is_same_tick(market_path):
         return total
 
     assert asyncio.run(go())
+
+
+def test_depth2_donated_equals_serial_oracle(market_path):
+    """ISSUE 9 composition pin: depth-2 pipelining + donation. The
+    double-buffered step (``tick_step_wire_db``) donates a rotated spare
+    slot instead of the input state, so host finalize of tick i overlaps
+    the dispatch of tick i+1 with donated buffers live — previously
+    ``_use_donated_step`` hard-disabled donation past depth 1. The drive
+    must actually donate (no silent fallback), never reset cold, and emit
+    exactly the depth-0 serial oracle's signal set."""
+    serial: list[tuple] = []
+    run_replay(market_path, capacity=CAP, window=WIN, collect=serial,
+               pipeline_depth=0, donate=False)
+    db: list[tuple] = []
+    stats = run_replay(market_path, capacity=CAP, window=WIN, collect=db,
+                       pipeline_depth=2, donate=True)
+    assert stats["donated_ticks"] > 0, "depth-2 drive never donated"
+    assert stats["donated_state_resets"] == 0
+    assert serial, "scenario must fire at least one signal"
+    assert set(serial) == set(db)
+
+
+@pytest.mark.slow
+def test_depth2_donated_overflow_burst(tmp_path):
+    """The depth-2 donated drive through a >WIRE_MAX_FIRED crash tick: the
+    overflow fallback re-evaluates from the tick's EAGERLY-captured post
+    state (later dispatches have already replaced self.state by finalize
+    time) + the pre-tick small-carry snapshots. Emitted set must equal the
+    depth-0 serial oracle's, signal for signal."""
+    from binquant_tpu.engine.step import WIRE_MAX_FIRED
+    from binquant_tpu.io.replay import generate_burst_replay
+
+    n_symbols = 160
+    assert n_symbols > WIRE_MAX_FIRED
+    path = tmp_path / "burst_depth2.jsonl"
+    generate_burst_replay(path, n_symbols=n_symbols, n_ticks=108)
+
+    serial: list[tuple] = []
+    run_replay(path, capacity=256, window=200, collect=serial,
+               pipeline_depth=0, donate=False)
+    db: list[tuple] = []
+    stats = run_replay(path, capacity=256, window=200, collect=db,
+                       pipeline_depth=2, donate=True)
+    assert stats["overflow_ticks"] >= 1, "burst never overflowed the wire"
+    assert stats["donated_ticks"] > 0
+    assert stats["donated_state_resets"] == 0
+    assert serial
+    assert set(serial) == set(db)

@@ -221,7 +221,10 @@ def load_buffer(market, registry_rows=None):
             np.stack(vals),
         )
     ts = int(next(iter(market.values()))["open_time"].iloc[-1]) // 1000
-    return buf, rows, ts
+    from binquant_tpu.engine import materialize
+
+    # kernels below consume right-aligned windows; canonicalize the ring
+    return materialize(buf), rows, ts
 
 
 def run_kernel(buf, rows, ts, carry=None, cfg=ContextConfig()):

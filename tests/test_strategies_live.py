@@ -63,7 +63,10 @@ def fill_buffer(frames: dict[int, pd.DataFrame], window=WINDOW, cap=S_CAP):
         buf = apply_updates(
             buf, np.array(idx, np.int32), np.array(tss, np.int32), np.stack(vals)
         )
-    return buf
+    from binquant_tpu.engine import materialize
+
+    # strategy kernels consume right-aligned windows; canonicalize the ring
+    return materialize(buf)
 
 
 def random_frames(rng, n_rows=10, n=WINDOW, vol=0.02):

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios
 
 help:
 	@echo "binquant_tpu targets:"
@@ -50,6 +50,19 @@ help:
 	@echo "               The 2048x400 acceptance number is"
 	@echo "               'python bench.py --ring-traffic' (merges into"
 	@echo "               BENCH_REPLAY_CPU.json)"
+	@echo "  scenarios  - scenario engine + chaos lane (ISSUE 10): the"
+	@echo "               pytest drills (tier-1 flash_crash 3-way drive,"
+	@echo "               ws/sink chaos drill, /healthz ws probe, jitter,"
+	@echo "               bad-frame meter, churn routing; slow adds"
+	@echo "               restore-under-fault mid-rewrite-storm + the"
+	@echo "               flaky-sink signal-set pin), then the FULL corpus"
+	@echo "               via main.py --scenario all (9 families incl. the"
+	@echo "               160-symbol >WIRE_MAX_FIRED fire burst, each"
+	@echo "               driven serial + scanned + full-oracle with exact"
+	@echo "               signal-set equality, pinned sets, and every"
+	@echo "               graceful-degradation invariant), rendered by"
+	@echo "               tools/scenario_report.py. Repin deliberately"
+	@echo "               with BQT_SCENARIO_REPIN=1"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run (incl."
 	@echo "               one scan chunk + one backtest chunk)"
 	@echo "  lint       - ruff check"
@@ -141,6 +154,19 @@ ring-smoke:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu python bench.py --ring-traffic \
 		--symbols 256 --window 200 --ticks 32
+
+# The scenario + chaos lane (ISSUE 10): tier-1 keeps the cheap drills
+# (the flash_crash 3-way drive + the chaos/probe/jitter/meter units);
+# this target adds the slow-marked fault drills and then runs the FULL
+# corpus — every family serial + scanned + full-oracle with pinned
+# signal sets — emitting scenario_run events the report renders.
+scenarios:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios.py -q \
+		-p no:cacheprovider
+	rm -f /tmp/bqt_scenario_events.jsonl
+	BQT_EVENT_LOG=/tmp/bqt_scenario_events.jsonl JAX_PLATFORMS=cpu \
+	python main.py --scenario all
+	python tools/scenario_report.py /tmp/bqt_scenario_events.jsonl
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -475,6 +475,10 @@ class SignalEngine:
         self.overflow_ticks = 0
         # optional CheckpointManager; consume_loop snapshots through it
         self.checkpoint = None
+        # injectable ws reconnect-health tracker for health_snapshot's
+        # ``ws`` section (None = the io.websocket module singleton the
+        # live connectors feed); tests script their own WsHealth here
+        self.ws_health = None
         # per-stage latency histograms (SURVEY §5: the p99<50ms budget is
         # measured in production, not guessed)
         self.latency = LatencyTracker()
@@ -552,6 +556,11 @@ class SignalEngine:
         # exact counters surfaced by health_snapshot / tests
         self.incremental_ticks = 0
         self.full_recompute_ticks = 0
+        # per-reason tally mirroring bqt_full_recompute_total{reason} at
+        # engine scope — the scenario lane asserts a drill's scripted
+        # routing (rewrite storms -> "rewrite", listing waves -> "churn")
+        # without reading the process-global registry
+        self.full_recompute_reasons: dict[str, int] = {}
         # -- donated live buffers (engine/step.py tick_step_wire_donated)
         # BQT_DONATE: the wire step updates the ring buffers IN PLACE
         # (erases the functional scatter's allocate+copy — ~0.23 GB/tick of
@@ -1071,6 +1080,12 @@ class SignalEngine:
             batches5 = self.batcher5.drain()
             batches15 = self.batcher15.drain()
             churn = self.registry.version != version0
+            if churn:
+                # same rule as the serial drain: the new row's carry needs
+                # a full-recompute re-anchor, and the requeued per-tick
+                # dispatch below won't see the version change (the rows
+                # were claimed by THIS drain)
+                self._mark_carry_desynced("churn")
             clean = self._note_applied(batches5, batches15, commit=False)
             planned = 0 if plan is None else len(plan["ticks"])
             seq = self.ticks_processed + planned
@@ -1480,8 +1495,20 @@ class SignalEngine:
             # backlog at dispatch: how many deduped candles this tick drains
             QUEUE_DEPTH.labels(queue="batcher5").set(len(self.batcher5))
             QUEUE_DEPTH.labels(queue="batcher15").set(len(self.batcher15))
+            registry_version0 = self.registry.version
             batches5 = self.batcher5.drain()
             batches15 = self.batcher15.drain()
+            if self.registry.version != registry_version0:
+                # a NEW symbol claimed a row in this drain (listing wave /
+                # reclaimed churn row): its carried indicator state was
+                # initialized on whatever window the LAST full recompute
+                # saw — an empty ring or a prior occupant's history — so
+                # advancing it incrementally would diverge from a fresh
+                # compute. Route one full recompute to re-anchor every
+                # row's carry (at cold start the earlier cold_start reason
+                # wins; the scanned drive breaks its chunk on the same
+                # version change, keeping both drives' routing identical).
+                self._mark_carry_desynced("churn")
             # incremental-path eligibility: every update this tick must be
             # a clean strictly-newer append, judged against the host-side
             # latest-ts mirror (a mid-history rewrite is invisible to the
@@ -1551,6 +1578,9 @@ class SignalEngine:
                 else:
                     self.full_recompute_ticks += 1
                     FULL_RECOMPUTE.labels(reason=reason).inc()
+                    self.full_recompute_reasons[reason] = (
+                        self.full_recompute_reasons.get(reason, 0) + 1
+                    )
             path = "incremental" if use_incremental else "full"
             sp_route.set(path=path, full_recompute_reason=reason)
             # root attr: the ring summary / healthz "carry path taken"
@@ -2701,8 +2731,20 @@ class SignalEngine:
             status = "degraded" if self._hb_consecutive_failures else "ok"
         else:
             status = "stale"
+        # websocket ingest health: reconnects in the rolling window plus
+        # the clients currently sitting in backoff. A reconnect STORM is
+        # alive-but-impaired — the probe degrades (stays HTTP 200 per the
+        # PR 1 contract; only stale is 503) so orchestrators see the
+        # outage without restart-looping an engine that would only rejoin
+        # the thundering herd.
+        from binquant_tpu.io.websocket import WS_HEALTH
+
+        ws = (self.ws_health or WS_HEALTH).snapshot()
+        if status == "ok" and ws["storming"]:
+            status = "degraded"
         return {
             "status": status,
+            "ws": ws,
             "heartbeat_age_s": heartbeat_age,
             "heartbeat_max_age_s": max_age_s,
             "heartbeat_write_failures": self.heartbeat_write_failures,

@@ -74,6 +74,9 @@ def make_stub_engine(
     carry_audit_every: int | None = None,
     scan_chunk: int | None = None,
     backtest_chunk: int | None = None,
+    session=None,
+    telegram_transport=None,
+    trace_sample: float | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -82,7 +85,14 @@ def make_stub_engine(
     and either dispatch variant explicitly; ``carry_audit_every`` /
     ``scan_chunk`` override the drift-audit cadence and the fused-scan
     chunk size (BQT_CARRY_AUDIT_EVERY / BQT_SCAN_CHUNK) for drills that
-    need resync boundaries or chunk breaks at test scale."""
+    need resync boundaries or chunk breaks at test scale.
+
+    Chaos seams (binquant_tpu/sim/chaos.py): ``session`` replaces the
+    default StubSession behind BinbotApi (a FlakySession injects 5xx/
+    timeout storms), ``telegram_transport`` is awaited before each send is
+    recorded (raise to script delivery failures), and ``trace_sample``
+    overrides BQT_TRACE_SAMPLE so the scenario lane's crash-ring
+    invariant actually traces every tick."""
     import os
 
     os.environ.setdefault("ENV", "CI")
@@ -111,11 +121,20 @@ def make_stub_engine(
         config.__dict__["scan_chunk"] = int(scan_chunk)
     if backtest_chunk is not None:
         config.__dict__["backtest_chunk"] = int(backtest_chunk)
-    binbot_api = BinbotApi("http://stub", session=StubSession(breadth=breadth))
+    if trace_sample is not None:
+        config.__dict__["trace_sample"] = float(trace_sample)
+    binbot_api = BinbotApi(
+        "http://stub",
+        session=session if session is not None else StubSession(breadth=breadth),
+    )
 
     sent: list[str] = []
 
     async def capture_transport(chat_id: str, text: str) -> None:
+        if telegram_transport is not None:
+            # injected fault transport first: a scripted failure must keep
+            # the message OUT of the recorded-sent list (it wasn't sent)
+            await telegram_transport(chat_id, text)
         sent.append(text)
 
     telegram = TelegramConsumer(
@@ -154,7 +173,14 @@ def make_stub_engine(
 
 def load_klines_by_tick(path: str | Path) -> dict[int, list[dict]]:
     """Group a JSONL kline file by 15m bucket (one engine tick each).
-    Transparently reads gzip fixtures (checked-in market files)."""
+    Transparently reads gzip fixtures (checked-in market files).
+
+    A line may carry an optional ``_deliver_bucket`` transport key: the
+    candle is handed to the engine at THAT tick instead of its own
+    open-time bucket — how scenario streams script delivery faults the
+    plain format cannot express (a rewrite storm re-sending an old candle
+    ticks later; an exchange outage whose bars all arrive in one catch-up
+    drain). The key is popped here; the engine never sees it."""
     import gzip
 
     opener = gzip.open if str(path).endswith(".gz") else open
@@ -165,9 +191,44 @@ def load_klines_by_tick(path: str | Path) -> dict[int, list[dict]]:
             if not line:
                 continue
             k = json.loads(line)
-            bucket = int(k["open_time"]) // 1000 // 900
+            deliver = k.pop("_deliver_bucket", None)
+            bucket = (
+                int(deliver)
+                if deliver is not None
+                else int(k["open_time"]) // 1000 // 900
+            )
             klines_by_tick.setdefault(bucket, []).append(k)
     return klines_by_tick
+
+
+def tick_seq(path: str | Path) -> list[tuple[int, list[dict]]]:
+    """A kline file's delivery-ordered tick sequence: one engine tick per
+    15m delivery bucket, ``now_ms`` just after the bucket's bars close —
+    THE one copy of the bucket→tick convention every drive (run_replay,
+    the scenario runner, the scan drills) shares."""
+    klines_by_tick = load_klines_by_tick(path)
+    return [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(klines_by_tick)
+    ]
+
+
+def signal_tuples(fired) -> list[tuple]:
+    """Fired signals → the ``(tick_ms, strategy, symbol, direction,
+    autotrade)`` comparison tuples every equality harness shares."""
+    return [
+        (
+            s.tick_ms,
+            s.strategy,
+            s.symbol,
+            str(s.value.direction),
+            bool(s.value.autotrade),
+        )
+        for s in fired
+    ]
 
 
 def run_replay(
@@ -225,7 +286,7 @@ def run_replay(
     # dormant strategies can be exercised in A/B runs)
     engine.at_consumer.market_domination_reversal = market_domination_reversal
     engine.at_consumer.current_market_dominance_is_losers = dominance_is_losers
-    klines_by_tick = load_klines_by_tick(path)
+    seq = tick_seq(path)
 
     fired_total = 0
     t_start = time.perf_counter()
@@ -235,23 +296,13 @@ def run_replay(
         nonlocal fired_total
         fired_total += len(fired)
         if collect is not None:
-            for s in fired:
-                collect.append(
-                    (
-                        s.tick_ms,
-                        s.strategy,
-                        s.symbol,
-                        str(s.value.direction),
-                        bool(s.value.autotrade),
-                    )
-                )
+            collect.extend(signal_tuples(fired))
 
     async def drive() -> None:
-        for bucket in sorted(klines_by_tick):
-            for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
+        for tick_ms, klines in seq:
+            for k in klines:
                 engine.ingest(k)
             # the tick fires just after the bucket's bars CLOSE
-            tick_ms = (bucket + 1) * 900 * 1000
             t0 = time.perf_counter()
             fired = await engine.process_tick(now_ms=tick_ms)
             latencies.append((time.perf_counter() - t0) * 1000)
@@ -259,13 +310,6 @@ def run_replay(
         record(await engine.flush_pending())
 
     async def drive_scanned() -> None:
-        seq = [
-            (
-                (bucket + 1) * 900 * 1000,
-                sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]),
-            )
-            for bucket in sorted(klines_by_tick)
-        ]
         record(await engine.process_ticks_scanned(seq))
         record(await engine.flush_pending())
 
@@ -333,12 +377,10 @@ def run_replay_oracle(
     adp_latest, adp_prev, adp_diff, adp_diff_prev, _ = breadth_scalars(mb)
 
     policy = GridOnlyPolicy.disabled("not_evaluated")
-    klines_by_tick = load_klines_by_tick(path)
     out: list[tuple] = []
-    for bucket in sorted(klines_by_tick):
-        for k in sorted(klines_by_tick[bucket], key=lambda k: k["open_time"]):
+    for tick_ms, klines in tick_seq(path):
+        for k in klines:
             evaluator.ingest(k)
-        tick_ms = (bucket + 1) * 900 * 1000
         for strategy, sym, direction, autotrade in evaluator.evaluate(
             tick_ms,
             grid_policy_allows=policy.allow_grid_ladder,
@@ -430,29 +472,38 @@ def run_replay_ab(
     }
 
 
+def kline_record(
+    symbol: str, ts_s: int, interval_s: int, o, h, low, c, volume,
+    trades: float = 300.0,
+) -> dict:
+    """One ExtendedKline-shaped dict — the single field contract every
+    replay generator shares (close_time = open+interval-1ms, taker splits,
+    6-dp rounding). The scenario engine (binquant_tpu/sim) builds streams
+    from these dicts so stream-level faults (rewrite storms, outage
+    redelivery) can be scripted before serialization."""
+    return {
+        "symbol": symbol,
+        "open_time": ts_s * 1000,
+        "close_time": (ts_s + interval_s) * 1000 - 1,
+        "open": round(float(o), 6),
+        "high": round(float(h), 6),
+        "low": round(float(low), 6),
+        "close": round(float(c), 6),
+        "volume": round(float(volume), 3),
+        "quote_asset_volume": round(float(volume * c), 3),
+        "number_of_trades": trades,
+        "taker_buy_base_volume": round(float(volume / 2), 3),
+        "taker_buy_quote_volume": round(float(volume * c / 2), 3),
+    }
+
+
 def _kline_json(
     symbol: str, ts_s: int, interval_s: int, o, h, low, c, volume,
     trades: float = 300.0,
 ) -> str:
-    """One ExtendedKline JSONL line — the single writer every replay
-    generator shares, so all fixtures exercise the same ingest-parser
-    field contract (close_time = open+interval-1ms, taker splits, 6-dp
-    rounding)."""
+    """One ExtendedKline JSONL line (see :func:`kline_record`)."""
     return json.dumps(
-        {
-            "symbol": symbol,
-            "open_time": ts_s * 1000,
-            "close_time": (ts_s + interval_s) * 1000 - 1,
-            "open": round(float(o), 6),
-            "high": round(float(h), 6),
-            "low": round(float(low), 6),
-            "close": round(float(c), 6),
-            "volume": round(float(volume), 3),
-            "quote_asset_volume": round(float(volume * c), 3),
-            "number_of_trades": trades,
-            "taker_buy_base_volume": round(float(volume / 2), 3),
-            "taker_buy_quote_volume": round(float(volume * c / 2), 3),
-        }
+        kline_record(symbol, ts_s, interval_s, o, h, low, c, volume, trades)
     ) + "\n"
 
 

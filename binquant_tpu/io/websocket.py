@@ -19,12 +19,20 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import random
+import time
+from collections import deque
 from collections.abc import Callable
 from typing import Any
 
 from binquant_tpu.exceptions import WebSocketError
 from binquant_tpu.obs.events import get_event_log
-from binquant_tpu.obs.instruments import WS_FRAMES, WS_RECONNECTS
+from binquant_tpu.obs.instruments import (
+    WS_FRAMES,
+    WS_PARSE_ERRORS,
+    WS_RECONNECTS,
+)
 from binquant_tpu.schemas import SymbolModel
 
 BINANCE_WS_BASE = "wss://stream.binance.com:9443/ws"
@@ -32,6 +40,111 @@ MAX_MARKETS_PER_CLIENT = 400  # Binance (klines_connector.py:24)
 MAX_TOPICS_PER_CONNECTION = 300  # KuCoin (websocket_factory.py:30)
 
 FIAT_PREFIXES = ("USDT", "USDC", "BUSD", "EUR", "TRY", "DAI")
+
+# Reconnect backoff defaults shared by both exchange connectors. The ±25%
+# per-client jitter exists because the N chunked clients of one exchange
+# share one deterministic exponential schedule: an exchange-wide outage
+# would otherwise end in a synchronized resubscribe thundering herd.
+RECONNECT_INITIAL_BACKOFF_S = 1.0
+RECONNECT_MAX_BACKOFF_S = 30.0
+RECONNECT_JITTER = 0.25
+
+
+def reconnect_delay(
+    backoff: float, rng: random.Random, jitter: float = RECONNECT_JITTER
+) -> float:
+    """``backoff`` spread by ±``jitter`` fraction via the client's own rng
+    (seeded per client in tests via ``reconnect_seed``)."""
+    if jitter <= 0:
+        return backoff
+    return backoff * (1.0 + jitter * (2.0 * rng.random() - 1.0))
+
+
+class _BadFrameMeter:
+    """Counts ws parse failures (``bqt_ws_parse_errors_total``) and emits a
+    rate-limited ``ws_bad_frame`` event — a poisoned-feed chaos run is
+    observable without letting a frame-per-ms garbage storm turn the event
+    log into a firehose. Suppressed emissions are tallied and reported on
+    the next admitted event."""
+
+    def __init__(self, every_s: float = 30.0) -> None:
+        self.every_s = float(every_s)
+        self._last: dict[str, float] = {}
+        self._suppressed: dict[str, int] = {}
+
+    def note(self, exchange: str, error: str, raw_len: int) -> None:
+        WS_PARSE_ERRORS.labels(exchange=exchange).inc()
+        now = time.monotonic()
+        if now - self._last.get(exchange, float("-inf")) < self.every_s:
+            self._suppressed[exchange] = self._suppressed.get(exchange, 0) + 1
+            return
+        self._last[exchange] = now
+        get_event_log().emit(
+            "ws_bad_frame",
+            exchange=exchange,
+            error=str(error)[:200],
+            raw_len=int(raw_len),
+            suppressed_since_last=self._suppressed.pop(exchange, 0),
+        )
+
+
+BAD_FRAMES = _BadFrameMeter()
+
+
+class WsHealth:
+    """Rolling reconnect-storm tracker surfaced as the ``ws`` section of
+    ``/healthz`` (``SignalEngine.health_snapshot``). Connectors report
+    drops and recoveries; a reconnect rate past ``degrade_reconnects``
+    inside the window marks the probe ``degraded`` — which by the PR 1
+    probe contract stays HTTP 200 (alive but impaired; only ``stale`` is
+    503), so orchestrators see the storm without killing live engines."""
+
+    def __init__(
+        self, window_s: float = 300.0, degrade_reconnects: int = 6
+    ) -> None:
+        self.window_s = float(window_s)
+        self.degrade_reconnects = int(degrade_reconnects)
+        self._reconnects: deque[float] = deque(maxlen=4096)
+        self._backoff: dict[str, float] = {}  # "exchange/client" -> seconds
+
+    def note_reconnect(
+        self, exchange: str, client: int, backoff_s: float,
+        now: float | None = None,
+    ) -> None:
+        self._reconnects.append(
+            time.monotonic() if now is None else float(now)
+        )
+        self._backoff[f"{exchange}/{client}"] = float(backoff_s)
+
+    def note_connected(self, exchange: str, client: int) -> None:
+        self._backoff.pop(f"{exchange}/{client}", None)
+
+    def reset(self) -> None:
+        self._reconnects.clear()
+        self._backoff.clear()
+
+    def snapshot(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else float(now)
+        recent = sum(1 for t in self._reconnects if now - t <= self.window_s)
+        return {
+            "reconnects_recent": recent,
+            "window_s": self.window_s,
+            "degrade_reconnects": self.degrade_reconnects,
+            "clients_backing_off": len(self._backoff),
+            "max_backoff_s": max(self._backoff.values(), default=0.0),
+            "storming": recent >= self.degrade_reconnects,
+        }
+
+
+# Process singleton the connectors feed and health_snapshot reads.
+# Env-configured directly (not Config: this module is imported by tests
+# and tools that never construct the validated config singleton).
+WS_HEALTH = WsHealth(
+    window_s=float(os.environ.get("BQT_WS_DEGRADE_WINDOW", "300") or "300"),
+    degrade_reconnects=int(
+        os.environ.get("BQT_WS_DEGRADE_RECONNECTS", "6") or "6"
+    ),
+)
 
 
 def filter_fiat_symbols(symbols: list[SymbolModel]) -> list[SymbolModel]:
@@ -71,6 +184,7 @@ def parse_binance_kline_frame(raw: str | bytes) -> dict | None:
     try:
         res = json.loads(raw)
     except Exception as e:
+        BAD_FRAMES.note("binance", str(e), len(str(raw)))
         logging.error("Failed to decode ws message: %s; len=%s", e, len(str(raw)))
         return None
     if res.get("e") != "kline":
@@ -79,20 +193,28 @@ def parse_binance_kline_frame(raw: str | bytes) -> dict | None:
     k = res.get("k", {})
     if not k.get("s") or not k.get("x"):  # closed candles only
         return None
-    return {
-        "symbol": k["s"],
-        "open_time": int(k["t"]),
-        "close_time": int(k["T"]),
-        "open": float(k["o"]),
-        "high": float(k["h"]),
-        "low": float(k["l"]),
-        "close": float(k["c"]),
-        "volume": float(k["v"]),
-        "quote_asset_volume": float(k.get("q", 0.0)),
-        "number_of_trades": float(k.get("n", 0.0)),
-        "taker_buy_base_volume": float(k.get("V", 0.0)),
-        "taker_buy_quote_volume": float(k.get("Q", 0.0)),
-    }
+    try:
+        return {
+            "symbol": k["s"],
+            "open_time": int(k["t"]),
+            "close_time": int(k["T"]),
+            "open": float(k["o"]),
+            "high": float(k["h"]),
+            "low": float(k["l"]),
+            "close": float(k["c"]),
+            "volume": float(k["v"]),
+            "quote_asset_volume": float(k.get("q", 0.0)),
+            "number_of_trades": float(k.get("n", 0.0)),
+            "taker_buy_base_volume": float(k.get("V", 0.0)),
+            "taker_buy_quote_volume": float(k.get("Q", 0.0)),
+        }
+    except (TypeError, ValueError, KeyError) as e:
+        # valid JSON, malformed fields: a SHAPE parse failure. Must not
+        # escape — it would tear down the whole multi-market connection
+        # as a phantom reconnect instead of counting as a bad frame.
+        BAD_FRAMES.note("binance", f"bad kline fields: {e}", len(str(raw)))
+        logging.error("Malformed kline frame fields: %s", e)
+        return None
 
 
 class KlinesConnector:
@@ -111,6 +233,11 @@ class KlinesConnector:
         intervals: tuple[str, ...] = ("5m", "15m"),
         connect: Callable[..., Any] | None = None,
         max_markets_per_client: int = MAX_MARKETS_PER_CLIENT,
+        reconnect_jitter: float = RECONNECT_JITTER,
+        reconnect_seed: int | None = None,
+        initial_backoff_s: float = RECONNECT_INITIAL_BACKOFF_S,
+        max_backoff_s: float = RECONNECT_MAX_BACKOFF_S,
+        health: WsHealth | None = None,
     ) -> None:
         self.queue = queue
         self.symbols = filter_fiat_symbols(symbols)
@@ -122,6 +249,19 @@ class KlinesConnector:
             connect = websockets.connect
         self._connect = connect
         self._tasks: list[asyncio.Task] = []
+        self._reconnect_jitter = reconnect_jitter
+        self._reconnect_seed = reconnect_seed
+        self._initial_backoff_s = initial_backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._health = health or WS_HEALTH
+
+    def _client_rng(self, idx: int) -> random.Random:
+        """Per-client jitter rng — seeded + offset when a test pins
+        ``reconnect_seed`` (distinct per client either way, so a shared
+        outage cannot resynchronize the fleet)."""
+        if self._reconnect_seed is None:
+            return random.Random()
+        return random.Random(self._reconnect_seed + idx)
 
     def _chunks(self) -> list[list[str]]:
         """Chunk SYMBOLS so each client stays under the stream cap with
@@ -141,8 +281,9 @@ class KlinesConnector:
 
     async def _run_client(self, idx: int, markets: list[str]) -> None:
         """One connection: subscribe, pump frames, reconnect on close
-        (klines_connector.py:53-69)."""
-        backoff = 1.0
+        (klines_connector.py:53-69) with per-client jittered backoff."""
+        backoff = self._initial_backoff_s
+        rng = self._client_rng(idx)
         while True:
             try:
                 async with self._connect(BINANCE_WS_BASE) as ws:
@@ -154,7 +295,8 @@ class KlinesConnector:
                     logging.info(
                         "Subscribed client %d to %d markets", idx, len(markets)
                     )
-                    backoff = 1.0
+                    backoff = self._initial_backoff_s
+                    self._health.note_connected("binance", idx)
                     async for raw in ws:
                         WS_FRAMES.labels(exchange="binance").inc()
                         kline = parse_binance_kline_frame(raw)
@@ -164,6 +306,7 @@ class KlinesConnector:
                 raise
             except Exception as e:
                 WS_RECONNECTS.labels(exchange="binance").inc()
+                self._health.note_reconnect("binance", idx, backoff)
                 get_event_log().emit(
                     "ws_reconnect",
                     exchange="binance",
@@ -177,8 +320,10 @@ class KlinesConnector:
                     e,
                     backoff,
                 )
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 30.0)
+                await asyncio.sleep(
+                    reconnect_delay(backoff, rng, self._reconnect_jitter)
+                )
+                backoff = min(backoff * 2, self._max_backoff_s)
 
     async def start_stream(self) -> None:
         chunks = self._chunks()
@@ -224,6 +369,7 @@ def parse_kucoin_candle_message(
     try:
         msg = json.loads(raw)
     except Exception as e:
+        BAD_FRAMES.note("kucoin", str(e), len(str(raw)))
         logging.error("Failed to decode kucoin ws message: %s", e)
         return None
     if msg.get("type") != "message":
@@ -241,15 +387,22 @@ def parse_kucoin_candle_message(
     interval_s = _KUCOIN_INTERVAL_S.get(interval)
     if interval_s is None:
         return None
-    t = int(float(candles[0])) * 1000
-    if str(market_type).lower() == "futures":
-        o, h, low, c = (float(candles[i]) for i in (1, 2, 3, 4))
-        volume = float(candles[5]) if len(candles) > 5 else 0.0
-        turnover = 0.0
-    else:
-        o, c, h, low = (float(candles[i]) for i in (1, 2, 3, 4))
-        volume = float(candles[5]) if len(candles) > 5 else 0.0
-        turnover = float(candles[6]) if len(candles) > 6 else 0.0
+    try:
+        t = int(float(candles[0])) * 1000
+        if str(market_type).lower() == "futures":
+            o, h, low, c = (float(candles[i]) for i in (1, 2, 3, 4))
+            volume = float(candles[5]) if len(candles) > 5 else 0.0
+            turnover = 0.0
+        else:
+            o, c, h, low = (float(candles[i]) for i in (1, 2, 3, 4))
+            volume = float(candles[5]) if len(candles) > 5 else 0.0
+            turnover = float(candles[6]) if len(candles) > 6 else 0.0
+    except (TypeError, ValueError, IndexError) as e:
+        # shape parse failure (see the Binance twin above): count it,
+        # never let it tear down a 300-topic connection
+        BAD_FRAMES.note("kucoin", f"bad candle fields: {e}", len(str(raw)))
+        logging.error("Malformed kucoin candle fields: %s", e)
+        return None
     return (
         symbol,
         interval,
@@ -292,6 +445,11 @@ class KucoinKlinesConnector:
         connect: Callable[..., Any] | None = None,
         token_fetch: Callable[[], tuple[str, str, float]] | None = None,
         max_topics_per_connection: int = MAX_TOPICS_PER_CONNECTION,
+        reconnect_jitter: float = RECONNECT_JITTER,
+        reconnect_seed: int | None = None,
+        initial_backoff_s: float = RECONNECT_INITIAL_BACKOFF_S,
+        max_backoff_s: float = RECONNECT_MAX_BACKOFF_S,
+        health: WsHealth | None = None,
     ) -> None:
         self.queue = queue
         self.market_type = market_type
@@ -311,6 +469,13 @@ class KucoinKlinesConnector:
         self._tasks: list[asyncio.Task] = []
         # (symbol, interval) -> last in-progress candle dict
         self._last_candle: dict[tuple[str, str], dict] = {}
+        self._reconnect_jitter = reconnect_jitter
+        self._reconnect_seed = reconnect_seed
+        self._initial_backoff_s = initial_backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._health = health or WS_HEALTH
+
+    _client_rng = KlinesConnector._client_rng
 
     def _default_token_fetch(self) -> tuple[str, str, float]:
         """(ws_endpoint, token, ping_interval_s) via the public bullet."""
@@ -353,7 +518,8 @@ class KucoinKlinesConnector:
         self._last_candle[key] = candle
 
     async def _run_client(self, idx: int, topics: list[str]) -> None:
-        backoff = 1.0
+        backoff = self._initial_backoff_s
+        rng = self._client_rng(idx)
         while True:
             try:
                 # the bullet handshake is a blocking HTTP POST; keep it off
@@ -392,7 +558,8 @@ class KucoinKlinesConnector:
                         idx,
                         len(topics),
                     )
-                    backoff = 1.0
+                    backoff = self._initial_backoff_s
+                    self._health.note_connected("kucoin", idx)
 
                     async def ping_loop() -> None:
                         n = 0
@@ -429,6 +596,7 @@ class KucoinKlinesConnector:
                             tuple(sym_iv.rsplit("_", 1)), None
                         )
                 WS_RECONNECTS.labels(exchange="kucoin").inc()
+                self._health.note_reconnect("kucoin", idx, backoff)
                 get_event_log().emit(
                     "ws_reconnect",
                     exchange="kucoin",
@@ -442,8 +610,10 @@ class KucoinKlinesConnector:
                     e,
                     backoff,
                 )
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 30.0)
+                await asyncio.sleep(
+                    reconnect_delay(backoff, rng, self._reconnect_jitter)
+                )
+                backoff = min(backoff * 2, self._max_backoff_s)
 
     async def start_stream(self) -> None:
         chunks = self._chunks()

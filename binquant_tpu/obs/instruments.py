@@ -228,6 +228,13 @@ WS_RECONNECTS = REGISTRY.counter(
     "Websocket client drops that entered the reconnect-backoff loop.",
     labels=("exchange",),
 )
+WS_PARSE_ERRORS = REGISTRY.counter(
+    "bqt_ws_parse_errors_total",
+    "Websocket frames that failed JSON/shape parsing, per exchange — a "
+    "poisoned feed shows here (plus rate-limited ws_bad_frame events), "
+    "not just in the error log.",
+    labels=("exchange",),
+)
 
 # -- emission sinks (io/emission.py, io/telegram.py, io/autotrade.py) -------
 

@@ -1,0 +1,23 @@
+"""Scenario engine + chaos lane (ISSUE 10).
+
+``scenarios`` — composable adversarial market generator layered on
+``io/market_sim.py``'s GARCH base stream, emitting the exact kline-stream
+format ``run_replay`` consumes (plus the optional ``_deliver_bucket``
+transport key for delivery-scripted faults).
+
+``chaos`` — fault injection at the transport/sink boundary: a scripted
+websocket connection factory and flaky wrappers for the binbot session and
+Telegram transport.
+
+``runner`` — drives every scenario scanned AND serial through the full
+engine with signal-set equality, pinned-corpus comparison, and the
+graceful-degradation invariants checked after each run (``make
+scenarios``).
+"""
+
+from binquant_tpu.sim.scenarios import (  # noqa: F401
+    SCENARIOS,
+    Scenario,
+    ScenarioSpec,
+    write_scenario_file,
+)

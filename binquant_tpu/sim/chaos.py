@@ -1,0 +1,334 @@
+"""Transport/sink fault injection for the chaos lane.
+
+Exploits the seams the io layer already exposes instead of monkeypatching:
+the websocket connectors take an injectable ``connect`` factory
+(:class:`FaultyConnectFactory` scripts disconnect storms, malformed and
+partial frames, refused/delayed reconnects), ``BinbotApi`` takes an
+injectable session (:class:`FlakySession` injects 5xx and timeout storms
+around the replay stub), and ``TelegramConsumer`` takes an injectable
+transport (:func:`flaky_transport`).
+
+:func:`ws_chaos_drill` is the end-to-end drill `make scenarios` runs: a
+real ``KlinesConnector`` + ``SignalEngine.consume_loop`` stack under a
+scripted disconnect storm, garbage frames, AND flaky sinks — asserting
+the engine keeps ticking, the heartbeat stays live, and ZERO closed
+candles are lost across the reconnects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any
+
+import numpy as np
+
+
+class ScriptedWs:
+    """One scripted websocket session: an async context manager + async
+    frame iterator driven by an event list:
+
+    * ``("frame", payload)`` — yield one raw frame;
+    * ``("drop", msg)``      — raise (the connector reconnects);
+    * ``("sleep", seconds)`` — stall the stream;
+    * ``("idle",)``          — stay connected, delivering nothing.
+    """
+
+    def __init__(self, events: list[tuple]) -> None:
+        self._events = list(events)
+        self.sent: list[str] = []
+
+    async def __aenter__(self) -> "ScriptedWs":
+        return self
+
+    async def __aexit__(self, *exc) -> bool:
+        return False
+
+    async def send(self, payload: str) -> None:
+        self.sent.append(payload)
+
+    def __aiter__(self) -> "ScriptedWs":
+        return self
+
+    async def __anext__(self) -> str:
+        while self._events:
+            kind, *args = self._events[0]
+            if kind == "frame":
+                self._events.pop(0)
+                return args[0]
+            if kind == "sleep":
+                self._events.pop(0)
+                await asyncio.sleep(args[0])
+                continue
+            if kind == "drop":
+                self._events.pop(0)
+                raise ConnectionError(args[0] if args else "scripted drop")
+            if kind == "idle":
+                await asyncio.sleep(3600.0)
+            else:  # unknown event: skip rather than wedge the drill
+                self._events.pop(0)
+        raise StopAsyncIteration
+
+
+class RefusedConnect:
+    """A connect attempt that fails at the handshake — the delayed-
+    reconnect case (exchange still down when the client retries)."""
+
+    def __init__(self, msg: str = "scripted connection refused") -> None:
+        self.msg = msg
+
+    async def __aenter__(self):
+        raise ConnectionError(self.msg)
+
+    async def __aexit__(self, *exc) -> bool:
+        return False
+
+
+class FaultyConnectFactory:
+    """Injectable ``connect`` for the connectors: each call hands out the
+    next scripted session; exhausted scripts idle connected so the drill
+    ends with a healthy stream."""
+
+    def __init__(self, sessions: list[Any]) -> None:
+        self._sessions = list(sessions)
+        self.connects = 0
+
+    def __call__(self, url: str, **_kw):
+        self.connects += 1
+        if self._sessions:
+            return self._sessions.pop(0)
+        return ScriptedWs([("idle",)])
+
+
+def binance_frame(k: dict) -> str:
+    """One closed-candle Binance kline frame for an ExtendedKline dict —
+    the inverse of ``parse_binance_kline_frame``'s field mapping."""
+    return json.dumps(
+        {
+            "e": "kline",
+            "k": {
+                "s": k["symbol"],
+                "t": k["open_time"],
+                "T": k["close_time"],
+                "x": True,
+                "o": str(k["open"]),
+                "h": str(k["high"]),
+                "l": str(k["low"]),
+                "c": str(k["close"]),
+                "v": str(k["volume"]),
+                "q": str(k.get("quote_asset_volume", 0.0)),
+                "n": k.get("number_of_trades", 0.0),
+                "V": str(k.get("taker_buy_base_volume", 0.0)),
+                "Q": str(k.get("taker_buy_quote_volume", 0.0)),
+            },
+        }
+    )
+
+
+GARBAGE_FRAMES = (
+    "{not json at all",
+    '{"e": "kline", "k": ',  # torn mid-frame
+    "\x00\x01\x02binary noise",
+)
+
+
+class FlakySession:
+    """Wraps the replay ``StubSession`` (or any session) with a scripted
+    per-request fault plan: ``"ok"`` passes through, ``"5xx"`` returns a
+    503 error body, ``"timeout"`` raises. The plan is consumed one entry
+    per request; exhausted → ok. ``failures`` counts injected faults."""
+
+    def __init__(self, inner: Any, plan: list[str] | tuple = ()) -> None:
+        self.inner = inner
+        self.plan = list(plan)
+        self.failures = 0
+
+    def _mode(self) -> str:
+        return self.plan.pop(0) if self.plan else "ok"
+
+    def request(self, method: str, url: str, **kwargs):
+        mode = self._mode()
+        if mode == "timeout":
+            self.failures += 1
+            raise TimeoutError(f"scripted timeout: {method} {url}")
+        if mode == "5xx":
+            self.failures += 1
+            resp = self.inner.request(method, url, **kwargs)
+            resp.status_code = 503
+            return resp
+        return self.inner.request(method, url, **kwargs)
+
+    def get(self, url, params=None):
+        return self.request("GET", url, params=params)
+
+
+def flaky_transport(plan: list[str] | tuple = ()):
+    """An async Telegram transport failing per plan entry (``"error"`` /
+    ``"ok"``; exhausted → ok). ``transport.calls`` tallies attempts and
+    injected failures."""
+    plan_list = list(plan)
+    calls = {"attempts": 0, "failed": 0}
+
+    async def transport(chat_id: str, text: str) -> None:
+        calls["attempts"] += 1
+        mode = plan_list.pop(0) if plan_list else "ok"
+        if mode == "error":
+            calls["failed"] += 1
+            raise RuntimeError("scripted telegram transport failure")
+
+    transport.calls = calls  # type: ignore[attr-defined]
+    return transport
+
+
+# -- the end-to-end chaos drill ----------------------------------------------
+
+
+def ws_chaos_drill(
+    n_symbols: int = 8,
+    n_ticks: int = 6,
+    timeout_s: float = 30.0,
+) -> dict:
+    """Disconnect storm + garbage frames + sink 5xx storm through the REAL
+    ingest stack: a ``KlinesConnector`` (scripted factory, fast jittered
+    backoff) feeding ``SignalEngine.consume_loop`` whose binbot session
+    and Telegram transport are flaky. Returns the facts the scenario lane
+    asserts: the engine ticked, the heartbeat stayed live, reconnects
+    were observed (and surfaced via the ws health tracker), and every
+    closed candle in the script landed in the device buffers exactly
+    once (``lost_candles == 0``)."""
+    from binquant_tpu.io.replay import StubSession, make_stub_engine
+    from binquant_tpu.io.websocket import KlinesConnector, WsHealth
+    from binquant_tpu.schemas import SymbolModel
+    from binquant_tpu.sim.scenarios import (
+        ScenarioSpec,
+        base_market,
+        emit_stream,
+        symbol_names,
+    )
+
+    spec = ScenarioSpec(
+        name="chaos", description="", n_symbols=n_symbols, n_ticks=n_ticks
+    )
+    closes, vols, _rng = base_market(spec)
+    klines = emit_stream(spec, closes, vols)
+    frames = [binance_frame(k) for k in klines]
+    cut = len(frames) // 3
+
+    # session 1: a third of the stream, then a hard drop mid-feed;
+    # session 2: the exchange refuses the reconnect (delayed recovery);
+    # session 3: garbage + torn frames mixed into the rest, then idle.
+    sessions = [
+        ScriptedWs([("frame", f) for f in frames[:cut]] + [("drop", "storm")]),
+        RefusedConnect(),
+        ScriptedWs(
+            [("frame", GARBAGE_FRAMES[0]), ("frame", GARBAGE_FRAMES[1])]
+            + [("frame", f) for f in frames[cut:]]
+            + [("frame", GARBAGE_FRAMES[2]), ("idle",)]
+        ),
+    ]
+    factory = FaultyConnectFactory(sessions)
+    health = WsHealth(window_s=60.0, degrade_reconnects=2)
+
+    flaky_session = FlakySession(
+        StubSession(),
+        # a FULL sink outage: every backend call during the drill eats a
+        # timeout or a 503 (the drill ticks on a wall clock, so only a
+        # handful of calls — e.g. the per-bucket breadth refresh — happen;
+        # all of them must fail and the engine must not care)
+        plan=["timeout", "5xx"] * 50,
+    )
+    telegram = flaky_transport(plan=["error", "ok"] * 20)
+    engine = make_stub_engine(
+        capacity=32,
+        window=120,
+        session=flaky_session,
+        telegram_transport=telegram,
+    )
+    engine.ws_health = health
+
+    symbols = [
+        SymbolModel(id=name, base_asset=name[:-4], quote_asset="USDT")
+        for name in symbol_names(n_symbols)
+    ]
+    queue: asyncio.Queue = asyncio.Queue()
+    connector = KlinesConnector(
+        queue,
+        symbols,
+        connect=factory,
+        reconnect_seed=7,
+        initial_backoff_s=0.02,
+        max_backoff_s=0.1,
+        health=health,
+    )
+
+    expected15 = n_ticks
+    expected5 = n_ticks * 3
+
+    async def drill() -> dict:
+        await connector.start_stream()
+        consume = asyncio.create_task(
+            engine.consume_loop(queue, tick_interval_s=0.05)
+        )
+        deadline = time.monotonic() + timeout_s
+
+        def all_landed() -> bool:
+            rows = [engine.registry.row_of(s.id) for s in symbols]
+            if any(r is None for r in rows):
+                return False
+            f15 = np.asarray(engine.state.buf15.filled)
+            f5 = np.asarray(engine.state.buf5.filled)
+            return all(
+                f15[r] >= expected15 and f5[r] >= expected5 for r in rows
+            )
+
+        landed = False
+        while time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+            if engine.ticks_processed > 0 and all_landed():
+                landed = True
+                break
+        # a couple more intervals so the post-storm engine provably keeps
+        # ticking with the stream idle-connected
+        ticks_at_land = engine.ticks_processed
+        await asyncio.sleep(0.2)
+        consume.cancel()
+        await asyncio.gather(consume, return_exceptions=True)
+        await connector.stop()
+
+        lost = 0
+        for s_idx, name in enumerate(symbol_names(n_symbols)):
+            row = engine.registry.row_of(name)
+            if row is None:
+                lost += expected15 + expected5
+                continue
+            lost += max(
+                0, expected15 - int(np.asarray(engine.state.buf15.filled)[row])
+            )
+            lost += max(
+                0, expected5 - int(np.asarray(engine.state.buf5.filled)[row])
+            )
+        return {
+            "landed": landed,
+            "lost_candles": lost,
+            "ticks": engine.ticks_processed,
+            "ticks_after_storm": engine.ticks_processed - ticks_at_land,
+            "reconnect_connects": factory.connects,
+            "ws": health.snapshot(),
+            "sink_faults": flaky_session.failures,
+            "telegram": dict(telegram.calls),
+            "health": engine.health_snapshot(),
+            "heartbeat_live": engine.health_snapshot()["heartbeat_age_s"]
+            is not None,
+        }
+
+    facts = asyncio.run(drill())
+    facts["ok"] = bool(
+        facts["landed"]
+        and facts["lost_candles"] == 0
+        and facts["ticks"] > 0
+        and facts["reconnect_connects"] >= 3
+        and facts["sink_faults"] > 0
+        and facts["heartbeat_live"]
+    )
+    return facts

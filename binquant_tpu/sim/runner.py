@@ -1,0 +1,287 @@
+"""Scenario-corpus runner: every scenario driven scanned AND serial
+through the full engine, with the graceful-degradation invariants checked
+after each run.
+
+Per scenario, three drives over the identical generated stream:
+
+1. serial incremental (the live per-tick path),
+2. scanned incremental (``process_ticks_scanned`` fused chunks),
+3. serial full-recompute (``BQT_INCREMENTAL=0`` — the carried-path's
+   in-engine oracle).
+
+Checks: exact signal-set equality across all three; recompute-routing
+reasons equal to the scenario's script (and identical between the serial
+and scanned drives); zero crash-ring entries (errored traces, donated
+state resets); per-bar emission dedupe holds; heartbeat live; overflow
+expectations (a fire burst must overflow AND re-drive; everything else
+must not); pinned-signal-set equality against the checked-in corpus
+(``tests/fixtures/scenario_signals.json`` — regenerate deliberately with
+``repin=True`` / ``BQT_SCENARIO_REPIN=1``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from pathlib import Path
+
+from binquant_tpu.io.replay import signal_tuples, tick_seq
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.sim.scenarios import SCENARIOS, Scenario, write_scenario_file
+
+PINNED_FIXTURE = (
+    Path(__file__).resolve().parents[2]
+    / "tests"
+    / "fixtures"
+    / "scenario_signals.json"
+)
+
+
+def drive_scenario(
+    scenario: Scenario, path, *, scanned: bool, incremental: bool
+):
+    """One drive of a generated scenario stream; returns (signal tuples,
+    engine) — the engine is kept for invariant introspection."""
+    from binquant_tpu.io.replay import make_stub_engine
+    from binquant_tpu.io.websocket import WsHealth
+
+    spec = scenario.spec
+    engine = make_stub_engine(
+        capacity=spec.capacity,
+        window=spec.window,
+        breadth=spec.breadth,
+        incremental=incremental,
+        scan_chunk=spec.scan_chunk,
+        enabled_strategies=set(spec.enabled_strategies),
+        trace_sample=1.0,  # every tick traced: the crash-ring invariant
+    )
+    # isolated ws tracker: the module singleton may carry another drill's
+    # reconnect storm, which would flip this run's health to degraded
+    engine.ws_health = WsHealth()
+    seq = tick_seq(path)
+    out: list = []
+
+    async def go() -> None:
+        if scanned:
+            out.extend(await engine.process_ticks_scanned(seq))
+        else:
+            for now_ms, klines in seq:
+                for k in klines:
+                    engine.ingest(k)
+                out.extend(await engine.process_tick(now_ms=now_ms))
+        out.extend(await engine.flush_pending())
+
+    asyncio.run(go())
+    return signal_tuples(out), engine
+
+
+def _crash_ring_entries(engine) -> int:
+    """Errored entries in the engine's completed-trace ring plus cold
+    state resets — the 'something went down mid-run' tally that must be
+    zero after every scenario."""
+    errored = sum(
+        1
+        for e in engine.tracer.entries()
+        if e["summary"].get("status") != "ok"
+    )
+    return errored + engine.donated_state_resets
+
+
+def _dedupe_holds(signals: list[tuple]) -> bool:
+    """Per-bar emission dedupe: at most one emission per (strategy,
+    symbol) per producing tick, and no duplicated tuples at all."""
+    keys = [(t, strat, sym) for t, strat, sym, *_ in signals]
+    return len(keys) == len(set(keys)) and len(signals) == len(set(signals))
+
+
+def run_scenario(
+    name: str, workdir: str | Path, pinned: dict | None = None
+) -> dict:
+    """Generate + drive one scenario; returns the verdict dict (also
+    emitted as a ``scenario_run`` event for tools/scenario_report.py)."""
+    scenario = SCENARIOS[name]
+    spec = scenario.spec
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    path = workdir / f"{name}.jsonl"
+    lines = write_scenario_file(scenario, path)
+
+    serial, eng_s = drive_scenario(scenario, path, scanned=False, incremental=True)
+    scanned, eng_c = drive_scenario(scenario, path, scanned=True, incremental=True)
+    full, eng_f = drive_scenario(scenario, path, scanned=False, incremental=False)
+
+    signal_set = sorted(set(serial))
+    checks: dict[str, bool] = {}
+    checks["serial_eq_scanned"] = set(serial) == set(scanned)
+    checks["carried_eq_full_oracle"] = set(serial) == set(full)
+    checks["scan_fused"] = eng_c.scanned_ticks > 0
+    routing = dict(eng_s.full_recompute_reasons)
+    checks["routing_matches_script"] = (
+        set(routing) == set(spec.expect_routing)
+        and eng_c.full_recompute_reasons == routing
+        and all(routing.get(r, 0) >= n for r, n in spec.routing_min)
+    )
+    checks["zero_crash_ring_entries"] = (
+        sum(_crash_ring_entries(e) for e in (eng_s, eng_c, eng_f)) == 0
+    )
+    checks["dedupe_holds"] = all(
+        _dedupe_holds(sigs) for sigs in (serial, scanned, full)
+    )
+    checks["heartbeat_live"] = all(
+        e.health_snapshot()["status"] == "ok" for e in (eng_s, eng_c, eng_f)
+    )
+    if spec.expect_overflow:
+        checks["overflow_script"] = (
+            eng_s.overflow_ticks >= 1 and eng_c.scan_overflow_reruns >= 1
+        )
+    else:
+        checks["overflow_script"] = (
+            eng_s.overflow_ticks == 0 and eng_c.scan_overflow_reruns == 0
+        )
+    checks["min_signals"] = len(signal_set) >= spec.min_signals
+    checks["min_telegram"] = (
+        len(eng_s._telegram_sent) >= spec.min_telegram  # type: ignore[attr-defined]
+    )
+    checks["numeric_clean"] = all(
+        e.numeric.anomaly_ticks == 0 and e.drift.alarms == 0
+        for e in (eng_s, eng_c, eng_f)
+    )
+    if pinned is not None and name in pinned:
+        checks["pinned_signal_set"] = (
+            [list(t) for t in signal_set] == pinned[name]["signals"]
+        )
+
+    verdict = {
+        "scenario": name,
+        "ok": all(checks.values()),
+        "signals": len(signal_set),
+        "telegram": len(eng_s._telegram_sent),  # type: ignore[attr-defined]
+        "ticks": eng_s.ticks_processed,
+        "lines": lines,
+        "scan_chunks": eng_c.scan_chunks,
+        "scanned_ticks": eng_c.scanned_ticks,
+        "overflow_ticks": eng_s.overflow_ticks,
+        "scan_overflow_reruns": eng_c.scan_overflow_reruns,
+        "routing": routing,
+        "checks": checks,
+    }
+    get_event_log().emit("scenario_run", **verdict)
+    verdict["signal_set"] = signal_set  # not in the event: corpus pinning
+    return verdict
+
+
+def load_pinned(path: str | Path = PINNED_FIXTURE) -> dict | None:
+    path = Path(path)
+    if not path.exists():
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def run_corpus(
+    names: list[str] | None = None,
+    workdir: str | Path = "/tmp/bqt_scenarios",
+    include_slow: bool = True,
+    repin: bool = False,
+    pinned_path: str | Path = PINNED_FIXTURE,
+    chaos: bool = True,
+) -> list[dict]:
+    """Run the scenario corpus (+ the ws/sink chaos drill) and compare —
+    or with ``repin`` rewrite — the pinned signal sets."""
+    repin = repin or os.environ.get("BQT_SCENARIO_REPIN") == "1"
+    pinned = None if repin else load_pinned(pinned_path)
+    if names is None:
+        names = [
+            n
+            for n, sc in SCENARIOS.items()
+            if include_slow or not sc.spec.slow
+        ]
+    verdicts = [run_scenario(n, workdir, pinned=pinned) for n in names]
+    if repin:
+        # never pin a broken run: a scenario whose invariants failed
+        # (drive inequality, routing mismatch, crash-ring entries) must
+        # not have its signal set enshrined as the golden corpus
+        corpus = {
+            v["scenario"]: {
+                "signals": [list(t) for t in v["signal_set"]],
+                "count": v["signals"],
+            }
+            for v in verdicts
+            if v["ok"]
+        }
+        skipped = [v["scenario"] for v in verdicts if not v["ok"]]
+        if skipped:
+            print(f"repin SKIPPED failing scenarios: {', '.join(skipped)}")
+        existing = load_pinned(pinned_path) or {}
+        existing.update(corpus)
+        Path(pinned_path).parent.mkdir(parents=True, exist_ok=True)
+        with open(pinned_path, "w") as f:
+            json.dump(existing, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if chaos:
+        from binquant_tpu.sim.chaos import ws_chaos_drill
+
+        facts = ws_chaos_drill()
+        event = {
+            "scenario": "chaos_drill",
+            "ok": facts["ok"],
+            "signals": 0,
+            "ticks": facts["ticks"],
+            "routing": {},
+            "checks": {
+                "stream_landed": facts["landed"],
+                "zero_lost_candles": facts["lost_candles"] == 0,
+                "engine_ticking": facts["ticks"] > 0,
+                "reconnect_storm_ran": facts["reconnect_connects"] >= 3,
+                "sink_faults_injected": facts["sink_faults"] > 0,
+                "heartbeat_live": facts["heartbeat_live"],
+            },
+            "ws": facts["ws"],
+        }
+        get_event_log().emit("scenario_run", **event)
+        verdicts.append(event)
+    return verdicts
+
+
+def render_verdict(event: dict) -> str:
+    """One scenario_run event → the deterministic report line(s)
+    tools/scenario_report.py prints (golden-pinned — keep format changes
+    deliberate)."""
+    checks = event.get("checks") or {}
+    failed = sorted(k for k, v in checks.items() if not v)
+    status = "PASS" if event.get("ok") else "FAIL"
+    routing = ",".join(
+        f"{k}={v}" for k, v in sorted((event.get("routing") or {}).items())
+    )
+    line = (
+        f"{event.get('scenario', '?'):<20} {status}"
+        f"  signals {event.get('signals', 0):>4}"
+        f"  ticks {event.get('ticks', 0):>4}"
+        f"  scan_chunks {event.get('scan_chunks', 0):>3}"
+        f"  overflow {event.get('overflow_ticks', 0):>2}"
+        f"  routing {routing or '-'}"
+    )
+    if failed:
+        line += f"\n  failed: {', '.join(failed)}"
+    return line
+
+
+def main_cli(arg: str) -> int:
+    """``main.py --scenario`` entry: a scenario name, ``all``, or
+    ``list``. Prints one verdict line per run; non-zero when any failed."""
+    if arg == "list":
+        for name, sc in sorted(SCENARIOS.items()):
+            print(f"{name:<20} {'[slow] ' if sc.spec.slow else ''}"
+                  f"{sc.spec.description}")
+        return 0
+    if arg == "all":
+        verdicts = run_corpus()
+    elif arg in SCENARIOS:
+        verdicts = [run_scenario(arg, "/tmp/bqt_scenarios", pinned=load_pinned())]
+    else:
+        print(f"unknown scenario {arg!r}; try --scenario list")
+        return 2
+    for v in verdicts:
+        print(render_verdict(v))
+    return 0 if all(v["ok"] for v in verdicts) else 1

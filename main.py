@@ -230,6 +230,15 @@ def main() -> int:
         "identical to the serial drive",
     )
     parser.add_argument(
+        "--scenario",
+        help="run the adversarial scenario engine (ISSUE 10): a scenario "
+        "name from binquant_tpu/sim, 'all' for the whole corpus + the "
+        "ws/sink chaos drill, or 'list'. Each scenario is driven scanned "
+        "AND serial with signal-set equality and the graceful-degradation "
+        "invariants asserted; verdicts also land in the event log "
+        "(BQT_EVENT_LOG) for tools/scenario_report.py",
+    )
+    parser.add_argument(
         "--backtest",
         action="store_true",
         help="drive the replay through the time-batched backtest backend "
@@ -239,6 +248,16 @@ def main() -> int:
     )
     args = parser.parse_args()
 
+    if args.scenario:
+        if args.replay or args.scanned or args.backtest or args.backend != "tpu":
+            parser.error(
+                "--scenario runs the sim corpus on its own drives (serial "
+                "+ scanned + full-oracle); combining it with --replay/"
+                "--backend/--scanned/--backtest would be silently ignored"
+            )
+        from binquant_tpu.sim.runner import main_cli
+
+        return main_cli(args.scenario)
     if args.backend != "tpu" and not args.replay:
         parser.error("--backend reference/ab requires --replay")
     if args.scanned and not args.replay:

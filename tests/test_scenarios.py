@@ -1,0 +1,474 @@
+"""Scenario engine + chaos lane drills (ISSUE 10).
+
+Tier-1 keeps the cheap drills: one full scenario (flash_crash) driven
+serial + scanned + full-oracle with every graceful-degradation invariant
+checked, the ws/sink chaos drill, the /healthz ws-section probe
+semantics, the reconnect-jitter unit, the bad-frame meter, and the
+listing-churn routing unit. The slow lane (``make scenarios``) adds
+restore-under-fault mid-rewrite-storm and the flaky-sink signal-set pin,
+plus the full corpus (incl. the 160-symbol fire burst) via
+``main.py --scenario all``.
+"""
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import scenario_report  # noqa: E402
+
+from binquant_tpu.sim.runner import (
+    drive_scenario,
+    load_pinned,
+    render_verdict,
+    run_scenario,
+    tick_seq,
+)
+from binquant_tpu.sim.scenarios import SCENARIOS, write_scenario_file
+
+
+# -- the tier-1 scenario drill ------------------------------------------------
+
+
+def test_flash_crash_scenario_tier1(tmp_path):
+    """ISSUE 10 acceptance (tier-1 half): one corpus scenario driven
+    scanned AND serial with exact signal-set equality, the in-engine
+    full-recompute oracle agreeing, scripted routing matching, and every
+    graceful-degradation invariant (zero crash-ring entries, dedupe,
+    heartbeat, no stray overflow) green — pinned against the checked-in
+    corpus when the fixture exists."""
+    verdict = run_scenario("flash_crash", tmp_path, pinned=load_pinned())
+    assert verdict["ok"], verdict["checks"]
+    assert verdict["signals"] >= 1
+    assert verdict["scan_chunks"] >= 2  # the scanned drive actually fused
+    assert verdict["routing"] == {"cold_start": 1}
+
+
+def test_scenario_corpus_has_eight_families():
+    """The acceptance floor: >= 8 scenario families in the corpus, each
+    with a non-empty stream in the exact replay format."""
+    assert len(SCENARIOS) >= 8
+    # every family must declare its degradation script
+    for name, sc in SCENARIOS.items():
+        assert sc.spec.expect_routing, name
+
+
+def test_scenario_streams_are_replay_format(tmp_path):
+    """Every (fast) scenario emits loadable dual-interval streams; the
+    delivery-scripted ones carry _deliver_bucket keys that
+    load_klines_by_tick strips before the engine sees a candle."""
+    for name, sc in SCENARIOS.items():
+        if sc.spec.slow:
+            continue
+        path = tmp_path / f"{name}.jsonl"
+        lines = write_scenario_file(sc, path)
+        assert lines > 0
+        seq = tick_seq(path)
+        assert len(seq) > 0
+        for _, klines in seq[:3]:
+            for k in klines:
+                assert "_deliver_bucket" not in k
+                assert {"symbol", "open_time", "close_time", "close"} <= set(k)
+
+
+def test_rewrite_storm_delivery_scripting(tmp_path):
+    """The rewrite storm's corrected candles are grouped at their
+    DELIVERY tick, not their open-time bucket — the fault the plain
+    format cannot express."""
+    sc = SCENARIOS["rewrite_storm"]
+    path = tmp_path / "storm.jsonl"
+    write_scenario_file(sc, path)
+    raw = [json.loads(line) for line in open(path)]
+    tagged = [k for k in raw if "_deliver_bucket" in k]
+    assert tagged, "storm produced no re-deliveries"
+    for k in tagged:
+        assert k["_deliver_bucket"] > k["open_time"] // 1000 // 900
+    seq = tick_seq(path)
+    by_bucket = {now // 900_000 - 1: klines for now, klines in seq}
+    k = tagged[0]
+    assert any(
+        j["symbol"] == k["symbol"] and j["open_time"] == k["open_time"]
+        for j in by_bucket[k["_deliver_bucket"]]
+    )
+
+
+# -- chaos lane ---------------------------------------------------------------
+
+
+def test_ws_chaos_drill():
+    """ISSUE 10 acceptance (chaos half): a scripted ws disconnect storm
+    (drop mid-feed, refused reconnect, garbage + torn frames) plus a full
+    sink 5xx/timeout storm through the REAL connector + consume_loop
+    stack — the engine keeps ticking, the heartbeat stays live, the
+    reconnects surface in the ws health tracker, and ZERO closed candles
+    are lost."""
+    from binquant_tpu.obs.instruments import WS_PARSE_ERRORS
+    from binquant_tpu.sim.chaos import ws_chaos_drill
+
+    parse_errors0 = WS_PARSE_ERRORS.labels(exchange="binance").value
+    facts = ws_chaos_drill()
+    assert facts["ok"], facts
+    assert facts["lost_candles"] == 0
+    assert facts["ticks"] > 0
+    assert facts["reconnect_connects"] >= 3  # storm + refusal + recovery
+    assert facts["sink_faults"] >= 1
+    assert facts["ws"]["reconnects_recent"] >= 2
+    # the garbage frames were counted, not just logged
+    assert WS_PARSE_ERRORS.labels(exchange="binance").value >= parse_errors0 + 3
+    # a reconnect storm degrades the probe but does NOT fail it
+    assert facts["health"]["status"] == "degraded"
+
+
+def test_healthz_ws_probe_semantics():
+    """Satellite: /healthz grows a ws section; a reconnect storm marks
+    the engine degraded (HTTP 200 — the PR 1 probe contract), while only
+    a stale heartbeat is 503."""
+    from binquant_tpu.io.replay import make_stub_engine
+    from binquant_tpu.io.websocket import WsHealth
+    from binquant_tpu.obs.exposition import MetricsServer
+
+    engine = make_stub_engine(capacity=8, window=120, incremental=False)
+    health = WsHealth(window_s=300.0, degrade_reconnects=3)
+    engine.ws_health = health
+
+    server = MetricsServer(health_fn=lambda: engine.health_snapshot(1500.0))
+
+    # never heartbeaten: stale -> 503
+    reply = server._route("/healthz").decode()
+    assert "503" in reply.splitlines()[0]
+
+    engine.touch_heartbeat()
+    reply = server._route("/healthz").decode()
+    head, _, body = reply.partition("\r\n\r\n")
+    assert "200" in head.splitlines()[0]
+    payload = json.loads(body)
+    assert payload["status"] == "ok"
+    assert payload["ws"]["reconnects_recent"] == 0
+    assert payload["ws"]["storming"] is False
+
+    # a reconnect storm: degraded, still HTTP 200, backoff surfaced
+    for i in range(4):
+        health.note_reconnect("binance", 0, backoff_s=2.0 * (i + 1))
+    reply = server._route("/healthz").decode()
+    head, _, body = reply.partition("\r\n\r\n")
+    assert "200" in head.splitlines()[0]
+    payload = json.loads(body)
+    assert payload["status"] == "degraded"
+    assert payload["ws"]["storming"] is True
+    assert payload["ws"]["reconnects_recent"] == 4
+    assert payload["ws"]["max_backoff_s"] == 8.0
+
+    # recovery: the window ages the storm out
+    health.note_connected("binance", 0)
+    snap = health.snapshot(now=1e9)
+    assert snap["storming"] is False and snap["clients_backing_off"] == 0
+
+
+def test_reconnect_jitter_breaks_thundering_herd():
+    """Satellite: the N chunked clients share one exponential schedule;
+    the per-client seeded jitter must spread their resubscribes by ±25%
+    and give DIFFERENT clients different delays."""
+    import random
+
+    from binquant_tpu.io.websocket import (
+        KlinesConnector,
+        reconnect_delay,
+    )
+    from binquant_tpu.schemas import SymbolModel
+
+    rng = random.Random(7)
+    delays = [reconnect_delay(8.0, rng, 0.25) for _ in range(200)]
+    assert all(6.0 <= d <= 10.0 for d in delays)
+    assert max(delays) - min(delays) > 1.0  # actually spread, not pinned
+    # jitter 0 keeps the deterministic schedule (opt-out)
+    assert reconnect_delay(8.0, rng, 0.0) == 8.0
+
+    connector = KlinesConnector(
+        asyncio.Queue(),
+        [SymbolModel(id="BTCUSDT")],
+        connect=lambda *_a, **_k: None,  # websockets lib absent in CI
+        reconnect_seed=11,
+    )
+    r0, r1 = connector._client_rng(0), connector._client_rng(1)
+    assert [r0.random() for _ in range(3)] != [r1.random() for _ in range(3)]
+    # seeded: reproducible per client
+    assert connector._client_rng(0).random() == connector._client_rng(0).random()
+
+
+def test_bad_frame_meter_counts_and_rate_limits(tmp_path):
+    """Satellite: ws parse failures increment
+    bqt_ws_parse_errors_total{exchange} and emit a rate-limited
+    ws_bad_frame event carrying the suppressed tally."""
+    import binquant_tpu.io.websocket as ws
+    from binquant_tpu.obs.events import EventLog, set_event_log
+    from binquant_tpu.obs.instruments import WS_PARSE_ERRORS
+
+    log_path = tmp_path / "events.jsonl"
+    set_event_log(EventLog(log_path))
+    old_meter = ws.BAD_FRAMES
+    ws.BAD_FRAMES = ws._BadFrameMeter(every_s=3600.0)
+    try:
+        before = WS_PARSE_ERRORS.labels(exchange="binance").value
+        for _ in range(5):
+            assert ws.parse_binance_kline_frame("{torn") is None
+        assert ws.parse_kucoin_candle_message("\x00garbage", "spot") is None
+        # SHAPE failures (valid JSON, malformed fields) count too — and
+        # must return None instead of raising into the reconnect loop
+        shape_bad = (
+            '{"e":"kline","k":{"s":"BTCUSDT","x":true,"t":"oops"}}'
+        )
+        assert ws.parse_binance_kline_frame(shape_bad) is None
+        kucoin_bad = json.dumps(
+            {
+                "type": "message",
+                "topic": "/market/candles:BTC-USDT_5min",
+                "data": {"candles": ["abc", "1", "2", "3", "4"]},
+            }
+        )
+        assert ws.parse_kucoin_candle_message(kucoin_bad, "spot") is None
+        assert WS_PARSE_ERRORS.labels(exchange="binance").value == before + 6
+        events = [
+            json.loads(line) for line in open(log_path) if line.strip()
+        ]
+        bad = [e for e in events if e["event"] == "ws_bad_frame"]
+        # one admitted per exchange inside the window; the rest tallied
+        assert [e["exchange"] for e in bad] == ["binance", "kucoin"]
+        assert bad[0]["suppressed_since_last"] == 0
+        # the NEXT admitted event (fresh meter) reports the suppressed 4
+        ws.BAD_FRAMES = ws._BadFrameMeter(every_s=0.0)
+        ws.BAD_FRAMES._suppressed["binance"] = 4
+        ws.parse_binance_kline_frame("{torn")
+        events = [
+            json.loads(line) for line in open(log_path) if line.strip()
+        ]
+        assert events[-1]["suppressed_since_last"] == 4
+    finally:
+        ws.BAD_FRAMES = old_meter
+        set_event_log(None)
+
+
+def test_listing_churn_routes_full_recompute():
+    """Satellite (routing rule): a NEW symbol claiming a registry row
+    mid-stream routes that tick to the full recompute with
+    reason=churn — its carry was initialized on a window the symbol was
+    not part of."""
+    from binquant_tpu.io.replay import make_stub_engine
+    from binquant_tpu.sim.scenarios import T0, kline_record
+
+    engine = make_stub_engine(capacity=32, window=120, incremental=True)
+
+    def bars(symbol, tick, px):
+        ts15 = T0 + tick * 900
+        out = [kline_record(symbol, ts15, 900, px, px * 1.001, px * 0.999, px, 100.0)]
+        for j in range(3):
+            out.append(
+                kline_record(symbol, ts15 + j * 300, 300, px, px * 1.001, px * 0.999, px, 30.0)
+            )
+        return out
+
+    async def go():
+        for tick in range(4):
+            symbols = ["BTCUSDT", "S001USDT"]
+            if tick >= 2:
+                symbols.append("S002USDT")  # lists mid-stream
+            for s_i, sym in enumerate(symbols):
+                for k in bars(sym, tick, 10.0 + s_i):
+                    engine.ingest(k)
+            await engine.process_tick(now_ms=(T0 // 900 + tick + 1) * 900_000)
+        await engine.flush_pending()
+
+    asyncio.run(go())
+    assert engine.full_recompute_reasons == {"cold_start": 1, "churn": 1}
+    assert engine.incremental_ticks == 2
+
+
+# -- report golden ------------------------------------------------------------
+
+
+def test_scenario_report_golden():
+    """tools/scenario_report.py renders a deterministic verdict table
+    (pinned — keep format changes deliberate)."""
+    events = [
+        {
+            "event": "scenario_run",
+            "scenario": "flash_crash",
+            "ok": True,
+            "signals": 12,
+            "ticks": 112,
+            "scan_chunks": 4,
+            "overflow_ticks": 0,
+            "routing": {"cold_start": 1},
+            "checks": {"serial_eq_scanned": True},
+        },
+        {
+            "event": "scenario_run",
+            "scenario": "rewrite_storm",
+            "ok": False,
+            "signals": 3,
+            "ticks": 112,
+            "scan_chunks": 2,
+            "overflow_ticks": 0,
+            "routing": {"cold_start": 1, "rewrite": 8},
+            "checks": {"serial_eq_scanned": False, "dedupe_holds": True},
+        },
+    ]
+    expected = (
+        "flash_crash          PASS  signals   12  ticks  112"
+        "  scan_chunks   4  overflow  0  routing cold_start=1\n"
+        "rewrite_storm        FAIL  signals    3  ticks  112"
+        "  scan_chunks   2  overflow  0  routing cold_start=1,rewrite=8\n"
+        "  failed: serial_eq_scanned\n"
+        "1/2 scenarios passed"
+    )
+    assert scenario_report.render_report(events) == expected
+
+
+def test_scenario_report_cli(tmp_path, capsys):
+    log = tmp_path / "events.jsonl"
+    log.write_text(
+        json.dumps(
+            {
+                "event": "scenario_run",
+                "scenario": "x",
+                "ok": True,
+                "signals": 0,
+                "ticks": 1,
+                "routing": {},
+                "checks": {},
+            }
+        )
+        + "\n"
+        + "{torn line\n"
+    )
+    assert scenario_report.main([str(log)]) == 0
+    assert "1/1 scenarios passed" in capsys.readouterr().out
+
+
+# -- slow lane (make scenarios) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_restore_under_fault_rewrite_storm(tmp_path):
+    """Satellite: kill-and-restore mid-scenario DURING a rewrite storm
+    (checkpoint v4; the 5m ring cursor has wrapped at save time) — the
+    resumed drive's remaining signal set must equal the uninterrupted
+    oracle's."""
+    from binquant_tpu.io.checkpoint import load_state, save_state
+    from binquant_tpu.io.replay import make_stub_engine
+    from binquant_tpu.sim.runner import signal_tuples
+
+    sc = SCENARIOS["rewrite_storm"]
+    spec = sc.spec
+    path = tmp_path / "storm.jsonl"
+    write_scenario_file(sc, path)
+    seq = tick_seq(path)
+    # split between the storm's two pulses: the last processed tick is
+    # INCREMENTAL (a full storm tick would have canonicalized the ring,
+    # zeroing the cursor), and the resumed drive faces pulse 2 at once
+    split = spec.n_ticks - 6
+
+    def fresh_engine():
+        return make_stub_engine(
+            capacity=spec.capacity,
+            window=spec.window,
+            incremental=True,
+            scan_chunk=spec.scan_chunk,
+            enabled_strategies=set(spec.enabled_strategies),
+        )
+
+    async def drive(engine, ticks):
+        out = []
+        for now_ms, klines in ticks:
+            for k in klines:
+                engine.ingest(k)
+            out.extend(await engine.process_tick(now_ms=now_ms))
+        out.extend(await engine.flush_pending())
+        return out
+
+    # the uninterrupted oracle
+    oracle = fresh_engine()
+    oracle_signals = signal_tuples(asyncio.run(drive(oracle, seq)))
+    assert oracle.full_recompute_reasons.get("rewrite", 0) >= 6
+
+    # drive to the split, snapshot, and "crash"
+    victim = fresh_engine()
+    asyncio.run(drive(victim, seq[:split]))
+    cursor5 = np.asarray(victim.state.buf5.cursor)
+    assert cursor5.max() > 0  # mid-phase ring: the cursor has wrapped
+    ckpt = tmp_path / "mid_storm.ckpt.npz"
+    save_state(ckpt, victim.state, victim.registry, victim.host_carries())
+
+    # restore into a cold engine and drive the remainder
+    resumed = fresh_engine()
+    state, carries = load_state(ckpt, resumed.state, resumed.registry)
+    resumed.state = state
+    resumed.restore_host_carries(carries)
+    resumed.note_state_restored(
+        migrated=bool(carries.get("_carry_rebuilt", False))
+    )
+    resumed_signals = signal_tuples(asyncio.run(drive(resumed, seq[split:])))
+
+    split_ms = seq[split][0]
+    oracle_tail = {t for t in oracle_signals if t[0] >= split_ms}
+    assert set(resumed_signals) == oracle_tail, {
+        "only_resumed": sorted(set(resumed_signals) - oracle_tail)[:5],
+        "only_oracle": sorted(oracle_tail - set(resumed_signals))[:5],
+    }
+    # the resumed drive kept hitting the storm's rewrite route
+    assert resumed.full_recompute_reasons.get("rewrite", 0) >= 1
+    # non-vacuous: signals actually exist on both sides of the split
+    assert oracle_tail and len(oracle_signals) > len(oracle_tail)
+
+
+@pytest.mark.slow
+def test_flaky_sinks_keep_signal_set(tmp_path):
+    """Chaos satellite: a full Telegram-transport failure storm plus a
+    binbot 5xx/timeout storm must not change the emitted signal set —
+    sink faults are isolated from the trade path."""
+    from binquant_tpu.io.replay import StubSession, make_stub_engine
+    from binquant_tpu.sim.chaos import FlakySession, flaky_transport
+    from binquant_tpu.sim.runner import signal_tuples
+
+    sc = SCENARIOS["flash_crash"]
+    spec = sc.spec
+    path = tmp_path / "crash.jsonl"
+    write_scenario_file(sc, path)
+    seq = tick_seq(path)
+
+    async def drive(engine):
+        out = []
+        for now_ms, klines in seq:
+            for k in klines:
+                engine.ingest(k)
+            out.extend(await engine.process_tick(now_ms=now_ms))
+        out.extend(await engine.flush_pending())
+        return out
+
+    kwargs = dict(
+        capacity=spec.capacity,
+        window=spec.window,
+        incremental=True,
+        enabled_strategies=set(spec.enabled_strategies),
+    )
+    clean = make_stub_engine(**kwargs)
+    clean_signals = signal_tuples(asyncio.run(drive(clean)))
+    assert clean_signals
+
+    telegram = flaky_transport(plan=["error"] * 1000)
+    flaky = make_stub_engine(
+        session=FlakySession(StubSession(), plan=["5xx", "timeout"] * 500),
+        telegram_transport=telegram,
+        **kwargs,
+    )
+    flaky_signals = signal_tuples(asyncio.run(drive(flaky)))
+
+    assert set(flaky_signals) == set(clean_signals)
+    assert flaky.ticks_processed == clean.ticks_processed
+    # the storm actually hit: every telegram attempt failed, nothing
+    # recorded as sent, and the engine did not care
+    assert telegram.calls["failed"] == telegram.calls["attempts"] > 0
+    assert flaky._telegram_sent == []

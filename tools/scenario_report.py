@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Render scenario-lane verdicts from the JSONL event log.
+
+The scenario runner (``binquant_tpu/sim/runner.py``, driven by
+``main.py --scenario`` / ``make scenarios``) emits one ``scenario_run``
+event per corpus entry — signal counts, routing tallies, and every
+graceful-degradation invariant's pass/fail. This tool turns an event log
+back into the per-scenario verdict table without any service in the loop
+(golden-pinned like trace_report — keep format changes deliberate):
+
+    python tools/scenario_report.py /tmp/bqt_scenario_events.jsonl
+    python tools/scenario_report.py events.jsonl --scenario rewrite_storm
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# runnable as a plain script (`python tools/scenario_report.py`): the
+# repo root is the tool dir's parent, not necessarily on sys.path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from binquant_tpu.sim.runner import render_verdict  # noqa: E402
+
+
+def load_scenario_events(path: str | Path) -> list[dict]:
+    """All ``scenario_run`` events, in file order; corrupt lines (a torn
+    write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") == "scenario_run":
+                out.append(record)
+    return out
+
+
+def render_report(events: list[dict]) -> str:
+    lines = [render_verdict(e) for e in events]
+    passed = sum(1 for e in events if e.get("ok"))
+    lines.append(f"{passed}/{len(events)} scenarios passed")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument(
+        "--scenario", help="render only this scenario's verdict"
+    )
+    args = parser.parse_args(argv)
+
+    events = load_scenario_events(args.log)
+    if args.scenario:
+        events = [e for e in events if e.get("scenario") == args.scenario]
+    if not events:
+        print(f"no scenario_run events in {args.log}", file=sys.stderr)
+        return 1
+    print(render_report(events))
+    return 0 if all(e.get("ok") for e in events) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

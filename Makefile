@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -63,8 +63,23 @@ help:
 	@echo "               graceful-degradation invariant), rendered by"
 	@echo "               tools/scenario_report.py. Repin deliberately"
 	@echo "               with BQT_SCENARIO_REPIN=1"
+	@echo "  latency-smoke- latency observatory lane (ISSUE 11): the"
+	@echo "               pytest drills (freshness histograms on a fake"
+	@echo "               clock, SLO-breach force-emit, chunk occupancy"
+	@echo "               summing to wall, serial==scanned phase taxonomy,"
+	@echo "               chunk-span waterfall + timeline goldens), then a"
+	@echo "               scanned replay with freshness + host-phase knobs"
+	@echo "               on and an aggressive BQT_FRESHNESS_SLO_MS,"
+	@echo "               rendered by tools/latency_report.py and exported"
+	@echo "               as Chrome-trace JSON (tools/timeline_export.py,"
+	@echo "               open in chrome://tracing or ui.perfetto.dev)."
+	@echo "               The 2048x400 host_phase acceptance numbers merge"
+	@echo "               into BENCH_REPLAY_CPU.json via"
+	@echo "               'python bench.py --replay-throughput'"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run (incl."
-	@echo "               one scan chunk + one backtest chunk)"
+	@echo "               one scan chunk + one backtest chunk; emits"
+	@echo "               structured dryrun_phase timing records with"
+	@echo "               per-executable compile seconds)"
 	@echo "  lint       - ruff check"
 	@echo "offline kernel profiling: tools/profile_stages.py captures"
 	@echo "per-stage jax.profiler traces (see README.md section Observability)"
@@ -167,6 +182,27 @@ scenarios:
 	BQT_EVENT_LOG=/tmp/bqt_scenario_events.jsonl JAX_PLATFORMS=cpu \
 	python main.py --scenario all
 	python tools/scenario_report.py /tmp/bqt_scenario_events.jsonl
+
+# The latency observatory lane (ISSUE 11): tier-1 keeps all the drills
+# (they are cheap — shapes shared with the tracing/scan lanes); this
+# target then replays a small stream through the SCANNED drive with the
+# observatory pinned on and an aggressive SLO so breaches force-emit,
+# renders the freshness summary table + host-phase/occupancy split, and
+# exports the chunk-phase timeline for Perfetto. The production-shape
+# host_phase section is `python bench.py --replay-throughput` (merges
+# into BENCH_REPLAY_CPU.json).
+latency-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_latency.py -q \
+		-p no:cacheprovider
+	python -c "from binquant_tpu.io.replay import generate_burst_replay; generate_burst_replay('/tmp/replay_latency.jsonl', n_symbols=8, n_ticks=108)"
+	rm -f /tmp/bqt_latency_events.jsonl
+	BQT_FRESHNESS=1 BQT_HOST_PHASE=1 BQT_FRESHNESS_SLO_MS=250 \
+	BQT_INCREMENTAL=1 BQT_TRACE_SAMPLE=1 \
+	BQT_EVENT_LOG=/tmp/bqt_latency_events.jsonl JAX_PLATFORMS=cpu \
+	python main.py --replay /tmp/replay_latency.jsonl --scanned
+	python tools/latency_report.py /tmp/bqt_latency_events.jsonl
+	python tools/timeline_export.py /tmp/bqt_latency_events.jsonl \
+		--out /tmp/bqt_timeline.json
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -684,8 +684,14 @@ def run_replay_throughput(
     os.environ.setdefault("BQT_DONATE", "1")
 
     def drive_arm(scanned: bool) -> dict:
+        from binquant_tpu.obs.latency import PhaseAccountant
+
         engine, make_updates, now, px = _seed_engine(num_symbols, window, 0)
         engine.scan_chunk = scan_chunk
+        # host-phase dwell accounting (ISSUE 11): pinned ON regardless of
+        # the ambient env so the record always carries the breakdown;
+        # reset after warmup so compiles don't pollute the steady state
+        engine.host_phase = PhaseAccountant(enabled=True)
         px_box = [px]
 
         def feed(i: int) -> int:
@@ -720,6 +726,7 @@ def run_replay_throughput(
                     )
                 )
                 await engine.flush_pending()
+                engine.host_phase.reset()
                 t0 = time.perf_counter()
                 signals += len(
                     await engine.process_ticks_scanned(
@@ -732,6 +739,7 @@ def run_replay_throughput(
                 now_ms = feed(i)
                 signals += len(await engine.process_tick(now_ms=now_ms))
             signals += len(await engine.flush_pending())
+            engine.host_phase.reset()
             t0 = time.perf_counter()
             for i in range(ticks):
                 now_ms = feed(warmup + i)
@@ -741,6 +749,7 @@ def run_replay_throughput(
 
         wall = asyncio.run(run_arm())
         return {
+            "host_phase": engine.host_phase.snapshot(),
             "wall_s": round(wall, 3),
             "ticks": ticks,
             "ticks_per_sec": round(ticks / wall, 2),
@@ -761,6 +770,46 @@ def run_replay_throughput(
         if serial["ticks_per_sec"]
         else None
     )
+
+    # host-phase breakdown (ISSUE 11): the tracked regression surface for
+    # ROADMAP item 3 — "the scanned drive's UNOVERLAPPED host work exceeds
+    # the dispatch overhead it amortizes" becomes machine-readable numbers
+    # instead of a one-off floor analysis
+    def _per_tick(arm: dict, drive: str) -> dict:
+        phases = arm.get("host_phase", {}).get("phase_ms", {}).get(drive, {})
+        return {p: round(v["total_ms"] / ticks, 3) for p, v in phases.items()}
+
+    serial_phase = _per_tick(serial, "serial")
+    scanned_phase = _per_tick(scanned, "scanned")
+    host_keys = ("plan", "stack", "decode", "emit")
+    scanned_host = round(sum(scanned_phase.get(k, 0.0) for k in host_keys), 3)
+    serial_dispatch = round(serial_phase.get("dispatch", 0.0), 3)
+    host_phase_section = {
+        "serial_ms_per_tick": serial_phase,
+        "scanned_ms_per_tick": scanned_phase,
+        "scanned_unoverlapped_host_ms_per_tick": scanned_host,
+        "serial_dispatch_overhead_ms_per_tick": serial_dispatch,
+        "scanned_host_exceeds_serial_dispatch": scanned_host > serial_dispatch,
+        "serial_occupancy": serial.get("host_phase", {})
+        .get("occupancy", {})
+        .get("serial"),
+        "scanned_occupancy": scanned.get("host_phase", {})
+        .get("occupancy", {})
+        .get("scanned"),
+        "note": (
+            "per-tick host-phase dwell over the measured window "
+            "(steady state, compiles reset after warmup); phases: "
+            "plan/stack/dispatch/device_wait/decode/emit per "
+            "obs/latency.py. scanned_unoverlapped_host = plan+stack+"
+            "decode+emit — the work the host-overlap pipeline (ROADMAP "
+            "item 3) must hide behind the device dispatch. The serial "
+            "occupancy's large dead_gap is the ASYNC device compute "
+            "overlapping host boundaries (verified: synchronous CPU "
+            "dispatch moves it into the dispatch bracket), so serial "
+            "host cost is the bracketed host_ms, not wall - device."
+        ),
+    }
+
     return {
         "symbols": num_symbols,
         "window": window,
@@ -769,6 +818,7 @@ def run_replay_throughput(
         "serial": serial,
         "scanned": scanned,
         "scanned_vs_serial_x": speedup,
+        "host_phase": host_phase_section,
         "measurement": (
             "production SignalEngine over one synthetic stream per arm "
             "(identical seeds): serial = per-tick process_tick at depth 0 "
@@ -821,12 +871,16 @@ def run_backtest_throughput(
     P × candles/sec — the hyperparameter-search workload's true rate."""
 
     def drive_arm(backtest: bool) -> dict:
+        from binquant_tpu.obs.latency import PhaseAccountant
+
         best = None
         for _rep in range(max(best_of, 1)):
             engine, make_updates, now, px = _seed_engine(
                 num_symbols, window, 0, incremental=False
             )
             engine.backtest_chunk = backtest_chunk
+            # host-phase dwell pinned ON (ISSUE 11), reset after warmup
+            engine.host_phase = PhaseAccountant(enabled=True)
             px_box = [px]
 
             def feed(i: int, engine=engine, make_updates=make_updates,
@@ -859,6 +913,7 @@ def run_backtest_throughput(
                         )
                     )
                     await engine.flush_pending()
+                    engine.host_phase.reset()
                     t0 = time.perf_counter()
                     signals += len(
                         await engine.process_ticks_backtest(
@@ -871,6 +926,7 @@ def run_backtest_throughput(
                     now_ms = feed(i)
                     signals += len(await engine.process_tick(now_ms=now_ms))
                 signals += len(await engine.flush_pending())
+                engine.host_phase.reset()
                 t0 = time.perf_counter()
                 for i in range(ticks):
                     now_ms = feed(warmup + i)
@@ -880,6 +936,7 @@ def run_backtest_throughput(
 
             wall = asyncio.run(run_arm())
             arm = {
+                "host_phase": engine.host_phase.snapshot(),
                 "wall_s": round(wall, 3),
                 "ticks": ticks,
                 "ticks_per_sec": round(ticks / wall, 2),
@@ -1859,6 +1916,16 @@ def main() -> int | None:
             and args.window >= 400
             and ticks >= 256
         ):
+            # carry the previously-merged ring_traffic section over — a
+            # replay rerun must not erase the --ring-traffic acceptance
+            # numbers that were merged into the same record
+            try:
+                with open("BENCH_REPLAY_CPU.json") as f:
+                    prior = json.load(f).get("detail", {}).get("ring_traffic")
+            except (OSError, ValueError):
+                prior = None
+            if prior is not None:
+                record["detail"]["ring_traffic"] = prior
             with open("BENCH_REPLAY_CPU.json", "w") as f:
                 json.dump(record, f, indent=1)
         return

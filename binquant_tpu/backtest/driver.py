@@ -140,10 +140,12 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
     the post-chunk state, and finalize tick-by-tick through the standard
     decode path. Overflow ⇒ serial re-drive from the plan-start snapshot.
 
-    Trace-span parity with the scanned drive (ISSUE 7 satellite): one
-    ``backtest_chunk`` span per chunk (ticks/padded/overflow_rerun attrs,
-    ``path=backtest`` root attr), so ``tools/trace_report.py`` renders
-    backtest drives exactly like scanned ones."""
+    Trace-span parity with the scanned drive (ISSUE 7 satellite, grown by
+    ISSUE 11): one ``backtest_chunk`` span per chunk with
+    stack/dispatch/device_wait children plus synthetic plan/finalize root
+    spans (ticks/padded/overflow_rerun attrs, ``path=backtest`` root
+    attr), so ``tools/trace_report.py`` renders backtest drives exactly
+    like scanned ones — phase waterfalls, not one opaque bar."""
     from binquant_tpu.io.pipeline import (
         _PendingTick,
         _pow2_bucket,
@@ -169,97 +171,151 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
     T = len(ticks)
     tb = _pow2_bucket(T)
     W = engine.window
-    # the host-side extension lays appends past a RIGHT-ALIGNED base: a
-    # mid-phase ring cursor (folded updates since the last full tick)
-    # canonicalizes here — one gather per chunk, amortized over T ticks
-    from binquant_tpu.engine.step import canonicalize_state
-
-    state = canonicalize_state(engine.state)
-    base5_t = np.asarray(state.buf5.times)
-    base5_v = np.asarray(state.buf5.values)
-    base15_t = np.asarray(state.buf15.times)
-    base15_v = np.asarray(state.buf15.values)
-    ext5_t, ext5_v, counts5 = _build_extension(
-        base5_t, base5_v, [p.batches5 for p in ticks], W
-    )
-    ext15_t, ext15_v, counts15 = _build_extension(
-        base15_t, base15_v, [p.batches15 for p in ticks], W
-    )
-    filled0 = (np.asarray(state.buf5.filled), np.asarray(state.buf15.filled))
-    inputs_seq, active, momentum_seq = _stack_inputs(engine, ticks, tb)
-    policy_prev = (
-        np.bool_(engine._last_regime is not None),
-        np.int32(-1 if engine._last_regime is None else engine._last_regime),
-    )
-    key = engine._wire_enabled_key()
-    chunk_args = (
-        (ext5_t, ext5_v),
-        (ext15_t, ext15_v),
-        _pad_counts(counts5, tb),
-        _pad_counts(counts15, tb),
-        filled0,
-        (state.regime_carry, state.mrf_last_emitted,
-         state.pt_last_signal_close),
-        inputs_seq,
-        active,
-        momentum_seq,
-        policy_prev,
-    )
-    chunk_kwargs = dict(
-        wire_enabled=key,
-        window=W,
-        params=None if params is None else dynamic_params(params),
-        numeric_digest=engine.numeric_digest,
-    )
-    ledger_sig = (
-        f"S{engine.capacity}xW{W} T{tb} ext5[{ext5_t.shape[1] - W}]"
-        f" ext15[{ext15_t.shape[1] - W}]"
-        f" digest={int(engine.numeric_digest)}"
-    )
-
-    def cost_fn(args=chunk_args, kwargs=chunk_kwargs, cfg=engine.context_config):
-        # abstract-ify lazily: this thunk is only consumed when the watch
-        # actually observed a compile — the steady-state chunk loop must
-        # not pay a per-chunk tree_map over the extended buffers
-        a_args, a_kwargs = abstract_args(args, kwargs)
-        return lowered_cost(backtest_chunk, *a_args, cfg, **a_kwargs)
 
     engine._tick_seq += 1
     trace = engine.tracer.begin_tick(
         engine._tick_seq, tick_ms=ticks[-1].now_ms
     )
     trace.set_attr(path="backtest")
+    # chunk-phase dwell (ISSUE 11): same taxonomy and bracketing as the
+    # scanned flush — accumulated planning dwell, then live stack/
+    # dispatch/device_wait brackets, closed by the finalize loop
+    engine.host_phase.begin_chunk("backtest")
+    plan_ms = float(plan.get("plan_ms", 0.0))
+    engine.host_phase.record("backtest", "plan", plan_ms)
     t_chunk0 = time.perf_counter()
+    if plan_ms:
+        trace.record_span(
+            "plan", t_chunk0 - plan_ms / 1000.0, t_chunk0,
+            accumulated=True, ticks=T,
+        )
     try:
         with engine.latency.stage("backtest_chunk"), trace.span(
             "backtest_chunk", ticks=T, padded=tb,
         ), trace.activate():
-            # newness is detected by the ledger's compile monitoring (the
-            # kernel's jit cache keys on shapes the drive doesn't mirror
-            # host-side the way observe_dispatch does for the tick steps)
-            with LEDGER.watch(
-                "backtest_chunk", ledger_sig, expect_compile=False,
-                cost_fn=cost_fn, tick=engine.ticks_processed,
+            with trace.span("stack"), engine.host_phase.phase(
+                "backtest", "stack"
             ):
-                carries, _policy, wires_dev, _fired, _counts = backtest_chunk(
-                    *chunk_args, engine.context_config, **chunk_kwargs
+                # the host-side extension lays appends past a
+                # RIGHT-ALIGNED base: a mid-phase ring cursor (folded
+                # updates since the last full tick) canonicalizes here —
+                # one gather per chunk, amortized over T ticks
+                from binquant_tpu.engine.step import canonicalize_state
+
+                state = canonicalize_state(engine.state)
+                base5_t = np.asarray(state.buf5.times)
+                base5_v = np.asarray(state.buf5.values)
+                base15_t = np.asarray(state.buf15.times)
+                base15_v = np.asarray(state.buf15.values)
+                ext5_t, ext5_v, counts5 = _build_extension(
+                    base5_t, base5_v, [p.batches5 for p in ticks], W
                 )
-            wires = np.asarray(wires_dev)
+                ext15_t, ext15_v, counts15 = _build_extension(
+                    base15_t, base15_v, [p.batches15 for p in ticks], W
+                )
+                filled0 = (
+                    np.asarray(state.buf5.filled),
+                    np.asarray(state.buf15.filled),
+                )
+                inputs_seq, active, momentum_seq = _stack_inputs(
+                    engine, ticks, tb
+                )
+                policy_prev = (
+                    np.bool_(engine._last_regime is not None),
+                    np.int32(
+                        -1 if engine._last_regime is None
+                        else engine._last_regime
+                    ),
+                )
+                key = engine._wire_enabled_key()
+                chunk_args = (
+                    (ext5_t, ext5_v),
+                    (ext15_t, ext15_v),
+                    _pad_counts(counts5, tb),
+                    _pad_counts(counts15, tb),
+                    filled0,
+                    (state.regime_carry, state.mrf_last_emitted,
+                     state.pt_last_signal_close),
+                    inputs_seq,
+                    active,
+                    momentum_seq,
+                    policy_prev,
+                )
+                chunk_kwargs = dict(
+                    wire_enabled=key,
+                    window=W,
+                    params=None if params is None else dynamic_params(params),
+                    numeric_digest=engine.numeric_digest,
+                )
+                ledger_sig = (
+                    f"S{engine.capacity}xW{W} T{tb}"
+                    f" ext5[{ext5_t.shape[1] - W}]"
+                    f" ext15[{ext15_t.shape[1] - W}]"
+                    f" digest={int(engine.numeric_digest)}"
+                )
+
+                def cost_fn(
+                    args=chunk_args, kwargs=chunk_kwargs,
+                    cfg=engine.context_config,
+                ):
+                    # abstract-ify lazily: this thunk is only consumed
+                    # when the watch actually observed a compile — the
+                    # steady-state chunk loop must not pay a per-chunk
+                    # tree_map over the extended buffers
+                    a_args, a_kwargs = abstract_args(args, kwargs)
+                    return lowered_cost(
+                        backtest_chunk, *a_args, cfg, **a_kwargs
+                    )
+
+            t_launch0 = time.perf_counter()
+            with trace.span("dispatch"), engine.host_phase.phase(
+                "backtest", "dispatch"
+            ):
+                # newness is detected by the ledger's compile monitoring
+                # (the kernel's jit cache keys on shapes the drive doesn't
+                # mirror host-side the way observe_dispatch does for the
+                # tick steps)
+                with LEDGER.watch(
+                    "backtest_chunk", ledger_sig, expect_compile=False,
+                    cost_fn=cost_fn, tick=engine.ticks_processed,
+                ):
+                    carries, _policy, wires_dev, _fired, _counts = (
+                        backtest_chunk(
+                            *chunk_args, engine.context_config,
+                            **chunk_kwargs
+                        )
+                    )
+            with trace.span("device_wait"), engine.host_phase.phase(
+                "backtest", "device_wait"
+            ):
+                wires = np.asarray(wires_dev)
     except BaseException as exc:
         trace.mark_error(exc)
         engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
         raise
+    # chunk-level dispatch→wire-fetch freshness, measured from the LAUNCH
+    # (stack packing excluded — comparable with the serial drive's stamp;
+    # per-tick finalizes below read an already-landed host array)
+    engine.freshness.observe_stage(
+        "dispatch_to_fetch", (time.perf_counter() - t_launch0) * 1000.0
+    )
     if np.any(wires[:T, WIRE_FIRED_COUNT_OFF] > WIRE_MAX_FIRED):
         # a tick's fired set overflowed the wire's compaction slots: drop
         # the chunk's outputs (engine.state never advanced) and re-drive
         # serially through the audited per-tick overflow fallback
         trace.set_attr(overflow_rerun=True)
         engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
+        # close the discarded chunk's occupancy accounting (the host
+        # really spent this wall; an open chunk must not linger)
+        engine.host_phase.note_chunk(
+            "backtest",
+            plan_ms + (time.perf_counter() - t_chunk0) * 1000.0,
+            T,
+        )
         engine.backtest_overflow_reruns += 1
         BACKTEST_OVERFLOW_RERUNS.inc()
         fired_all.extend(await engine._redrive_serial(plan))
         return fired_all
-    engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
 
     regime_carry, mrf_carry, pt_carry = carries
     engine.state = EngineState(
@@ -276,27 +332,41 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
     BACKTEST_CHUNKS.inc()
 
     per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
-    for i, p in enumerate(ticks):
-        engine.market_breadth = p.breadth
-        pending = _PendingTick(
-            wire=wires[i],
-            fallback=_scan_fallback_unavailable,
-            ts_ms=p.now_ms,
-            ts5=p.ts5,
-            ts15=p.ts15,
-            bucket15=p.bucket15,
-            dispatched_at=t_chunk0,
-            rows=p.rows,
-            trace=NULL_TRACE,
+    t_fin0 = time.perf_counter()
+    try:
+        for i, p in enumerate(ticks):
+            engine.market_breadth = p.breadth
+            pending = _PendingTick(
+                wire=wires[i],
+                fallback=_scan_fallback_unavailable,
+                ts_ms=p.now_ms,
+                ts5=p.ts5,
+                ts15=p.ts15,
+                bucket15=p.bucket15,
+                dispatched_at=t_chunk0,
+                rows=p.rows,
+                trace=NULL_TRACE,
+                drive="backtest",
+                ingest_mono=p.ingest_mono,
+            )
+            fired_all.extend(await engine._finalize_tick(pending))
+            engine.latency.record("tick_total", per_tick_ms)
+            engine.ticks_processed += 1
+            engine._last_tick_wall_s = time.time()
+            TICKS.inc()
+            get_event_log().tick = engine.ticks_processed
+            engine.backtest_ticks += 1
+            BACKTEST_TICKS.inc()
+    finally:
+        # chunk trace closes AFTER its finalizes (waterfall shows the
+        # decode/emit half; an errored finalize still flight-records)
+        trace.record_span("finalize", t_fin0, ticks=T)
+        engine.tracer.complete(trace, snapshot_fn=engine._flight_snapshot)
+        engine.host_phase.note_chunk(
+            "backtest",
+            plan_ms + (time.perf_counter() - t_chunk0) * 1000.0,
+            T,
         )
-        fired_all.extend(await engine._finalize_tick(pending))
-        engine.latency.record("tick_total", per_tick_ms)
-        engine.ticks_processed += 1
-        engine._last_tick_wall_s = time.time()
-        TICKS.inc()
-        get_event_log().tick = engine.ticks_processed
-        engine.backtest_ticks += 1
-        BACKTEST_TICKS.inc()
     engine.touch_heartbeat()
     return fired_all
 
@@ -356,12 +426,14 @@ async def drive_ticks_backtest(engine, ticks, params=None, chunk=None) -> list:
         fired_all.extend(await engine.flush_pending())
         plan: dict | None = None
         for now_ms, feed in ticks:
+            t_plan0 = time.perf_counter()
             if callable(feed):
                 feed()
             else:
                 for k in feed:
                     engine.ingest(k)
             version0 = engine.registry.version
+            ingest_mono = engine._oldest_pending_mono()
             batches5 = engine.batcher5.drain()
             batches15 = engine.batcher15.drain()
             churn = engine.registry.version != version0
@@ -384,9 +456,11 @@ async def drive_ticks_backtest(engine, ticks, params=None, chunk=None) -> list:
             await engine._refresh_market_breadth(bucket15)
             plan["ticks"].append(
                 engine._plan_scan_tick(
-                    now_ms, batches5, batches15, momentum_ok
+                    now_ms, batches5, batches15, momentum_ok,
+                    ingest_mono=ingest_mono,
                 )
             )
+            plan["plan_ms"] += (time.perf_counter() - t_plan0) * 1000.0
             if len(plan["ticks"]) >= chunk:
                 fired_all.extend(
                     await _flush_backtest_plan(engine, plan, params)

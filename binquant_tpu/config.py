@@ -291,6 +291,35 @@ class Config:
         return int(self._get("BQT_TRACE_RING", "256") or "256")
 
     @cached_property
+    def freshness_enabled(self) -> bool:
+        """Candle-close→sink-ack freshness stamps (obs/latency.py): every
+        tick carries its evaluated candle-close time and ingest-arrival
+        monotonic stamp, and finalize exports bqt_freshness_ms{stage} +
+        per-sink delivery histograms and stamps freshness_ms into the
+        analytics payload / signal event. BQT_FRESHNESS=0 disables (the
+        tier-1 test lane's default — the BQT_TRACE_SAMPLE pattern) and
+        keeps the no-observatory payloads byte-identical."""
+        return self._get("BQT_FRESHNESS", "1") != "0"
+
+    @cached_property
+    def freshness_slo_ms(self) -> float:
+        """Freshness SLO: a signal whose worst close→sink-ack exceeds this
+        many ms force-emits a freshness_slo_breach event (host-phase
+        breakdown + engine snapshot) and counts in
+        bqt_freshness_slo_breaches_total. 0 (default) disables the breach
+        check; stamps still record while BQT_FRESHNESS is on."""
+        return float(self._get("BQT_FRESHNESS_SLO_MS", "0") or "0")
+
+    @cached_property
+    def host_phase_enabled(self) -> bool:
+        """Host-phase dwell accounting (obs/latency.py): the shared
+        plan/stack/dispatch/device_wait/decode/emit taxonomy recorded per
+        drive into bqt_host_phase_ms{drive,phase} plus per-chunk
+        device-vs-host-vs-dead-gap occupancy. BQT_HOST_PHASE=0 disables
+        (the tier-1 test lane's default)."""
+        return self._get("BQT_HOST_PHASE", "1") != "0"
+
+    @cached_property
     def profile_dir(self) -> str:
         """Output directory for on-demand jax.profiler capture windows
         (/debug/profile?seconds=N and SIGUSR2)."""

@@ -48,6 +48,7 @@ never sees strings.
 
 from __future__ import annotations
 
+import time
 from enum import IntEnum
 from typing import NamedTuple
 
@@ -500,6 +501,12 @@ class IngestBatcher:
         self.registry = registry
         self._pending: dict[tuple[str, int], np.ndarray] = {}
         self._prebuilt: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        # perf_counter stamp of the OLDEST candle waiting since the last
+        # drain — the latency observatory's ingest-arrival anchor for the
+        # tick that will drain it (ingest→dispatch freshness). Reset by
+        # drain(); a requeue (serial re-drives) restamps, so re-driven
+        # ticks measure their own queue dwell, not the original one's.
+        self.first_pending_mono: float | None = None
 
     def __len__(self) -> int:
         return len(self._pending) + sum(len(r) for r, _, _ in self._prebuilt)
@@ -512,6 +519,8 @@ class IngestBatcher:
         benchmark driver, skipping per-candle dict parsing. Rows must
         already be registry rows; the batch is applied before any
         per-candle pending entries on the next drain."""
+        if self.first_pending_mono is None:
+            self.first_pending_mono = time.perf_counter()
         self._prebuilt.append(
             (
                 np.asarray(row_idx, dtype=np.int32),
@@ -529,6 +538,8 @@ class IngestBatcher:
         symbol = str(get("symbol", "")).strip().upper()
         if not symbol:
             return  # malformed kline; never claim a registry row for ""
+        if self.first_pending_mono is None:
+            self.first_pending_mono = time.perf_counter()
         open_time_ms = int(get("open_time", 0))
         close_time_ms = int(get("close_time", 0)) or open_time_ms
         row = np.array(
@@ -582,4 +593,5 @@ class IngestBatcher:
         # Clear only after every registry.add() has succeeded, so a full
         # registry raises without losing the whole tick's candles.
         self._pending.clear()
+        self.first_pending_mono = None
         return batches

@@ -109,6 +109,11 @@ class FiredSignal:
         # from it — back to the engine tick's span tree in the event log
         self.trace_id: str | None = None
         self.tick_seq: int | None = None
+        # candle-close→emission staleness in ms, stamped by _finalize_tick
+        # when the latency observatory is on (BQT_FRESHNESS); also mirrored
+        # into the analytics payload / metadata so downstream consumers
+        # can measure freshness without scraping Prometheus
+        self.freshness_ms: float | None = None
 
 
 def _cast_diag(kind: str, v: float):

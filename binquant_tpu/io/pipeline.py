@@ -73,6 +73,7 @@ from binquant_tpu.obs.instruments import (
     SIGNALS,
     TICKS,
 )
+from binquant_tpu.obs.latency import FreshnessTracker, PhaseAccountant
 from binquant_tpu.obs.ledger import LEDGER, abstract_args, lowered_cost
 from binquant_tpu.obs.numeric import DriftMeter, NumericHealthMonitor
 from binquant_tpu.obs.tracing import (
@@ -319,6 +320,12 @@ class _PendingTick(NamedTuple):
     # the NEXT dispatch's donated scratch slot once the tick finalizes
     # (and its fallback can no longer need the buffers). None elsewhere.
     spare: Any = None
+    # which drive dispatched this tick ("serial" / "scanned" / "backtest")
+    # — finalize attributes its decode/emit host-phase dwell to it
+    drive: str = "serial"
+    # perf_counter of the OLDEST pending candle this tick drained (None
+    # when unknown) — the ingest→dispatch freshness anchor
+    ingest_mono: Any = None
 
 
 def _pow2_bucket(m: int, floor: int = 4) -> int:
@@ -367,6 +374,9 @@ class _ScanTickPlan(NamedTuple):
     is_futures: bool
     dominance_is_losers: bool
     market_domination_reversal: bool
+    # ingest-arrival perf_counter of this tick's oldest drained candle
+    # (freshness stamp; None when the batchers were already empty)
+    ingest_mono: Any = None
 
 
 class SignalEngine:
@@ -488,6 +498,16 @@ class SignalEngine:
             sample=float(getattr(config, "trace_sample", 1.0)),
             slow_ms=float(getattr(config, "trace_slow_ms", 50.0)),
             ring=int(getattr(config, "trace_ring", 256)),
+        )
+        # latency observatory (ISSUE 11): candle-close→sink-ack freshness
+        # stamps + the shared host-phase dwell taxonomy (obs/latency.py).
+        # Host-only instruments — the device wire is untouched either way.
+        self.freshness = FreshnessTracker(
+            enabled=bool(getattr(config, "freshness_enabled", True)),
+            slo_ms=float(getattr(config, "freshness_slo_ms", 0.0) or 0.0),
+        )
+        self.host_phase = PhaseAccountant(
+            enabled=bool(getattr(config, "host_phase_enabled", True))
         )
         # tick_seq source for traces: advances on every dispatch ATTEMPT
         # (ticks_processed only counts successes — deriving the seq from
@@ -977,6 +997,10 @@ class SignalEngine:
         (each stamped with ``tick_ms`` of the tick that produced it).
         """
         t_tick0 = time.perf_counter()
+        # serial occupancy accounting: one "chunk" per call (this call's
+        # phase brackets — finalize halves of evicted ticks + the new
+        # dispatch — diffed against its wall clock)
+        self.host_phase.begin_chunk("serial")
         fired: list = []
         # Finalize BEFORE dispatching: at depth 1 this consumes tick i-1's
         # (already-landed) wire first, so the host carries feeding tick i
@@ -989,7 +1013,9 @@ class SignalEngine:
         self._pending.append(pending)
         if self.pipeline_depth == 0:
             fired.extend(await self._finalize_tick(self._pending.popleft()))
-        self.latency.record("tick_total", (time.perf_counter() - t_tick0) * 1000.0)
+        tick_wall_ms = (time.perf_counter() - t_tick0) * 1000.0
+        self.latency.record("tick_total", tick_wall_ms)
+        self.host_phase.note_chunk("serial", tick_wall_ms, 1)
         self.latency.maybe_log()
         self.ticks_processed += 1
         self._last_tick_wall_s = time.time()
@@ -1071,12 +1097,14 @@ class SignalEngine:
         fired_all.extend(await self.flush_pending())
         plan: dict | None = None
         for now_ms, feed in ticks:
+            t_plan0 = time.perf_counter()
             if callable(feed):
                 feed()
             else:
                 for k in feed:
                     self.ingest(k)
             version0 = self.registry.version
+            ingest_mono = self._oldest_pending_mono()
             batches5 = self.batcher5.drain()
             batches15 = self.batcher15.drain()
             churn = self.registry.version != version0
@@ -1120,8 +1148,15 @@ class SignalEngine:
             bucket15 = (now_ms // 1000) // FIFTEEN_MIN_S
             await self._refresh_market_breadth(bucket15)
             plan["ticks"].append(
-                self._plan_scan_tick(now_ms, batches5, batches15, momentum_ok)
+                self._plan_scan_tick(
+                    now_ms, batches5, batches15, momentum_ok,
+                    ingest_mono=ingest_mono,
+                )
             )
+            # per-tick planning dwell (feed, drain, eligibility judgments,
+            # the plan snapshot) accumulates on the plan and lands as the
+            # chunk's "plan" phase at flush
+            plan["plan_ms"] += (time.perf_counter() - t_plan0) * 1000.0
             if len(plan["ticks"]) >= self.scan_chunk:
                 fired_all.extend(await self._flush_scan_plan(plan))
                 plan = None
@@ -1152,6 +1187,8 @@ class SignalEngine:
             "host_latest": {
                 key: arr.copy() for key, arr in self._host_latest.items()
             },
+            # accumulated per-tick planning dwell (host-phase "plan")
+            "plan_ms": 0.0,
         }
 
     def _requeue_batches(self, batches5: list, batches15: list) -> None:
@@ -1169,8 +1206,22 @@ class SignalEngine:
         momentum = read_breadth_momentum(self.market_breadth)
         return momentum is not None and momentum.leaning != "flat"
 
+    def _oldest_pending_mono(self) -> float | None:
+        """perf_counter of the oldest candle waiting in either batcher —
+        read BEFORE draining (drain resets the stamps)."""
+        stamps = [
+            m
+            for m in (
+                self.batcher5.first_pending_mono,
+                self.batcher15.first_pending_mono,
+            )
+            if m is not None
+        ]
+        return min(stamps) if stamps else None
+
     def _plan_scan_tick(
-        self, now_ms: int, batches5: list, batches15: list, momentum_ok: bool
+        self, now_ms: int, batches5: list, batches15: list, momentum_ok: bool,
+        ingest_mono: float | None = None,
     ) -> _ScanTickPlan:
         ts_s = now_ms // 1000
         bucket15 = ts_s // FIFTEEN_MIN_S
@@ -1210,6 +1261,7 @@ class SignalEngine:
             market_domination_reversal=bool(
                 self.at_consumer.market_domination_reversal
             ),
+            ingest_mono=ingest_mono,
         )
 
     async def _redrive_serial(self, plan: dict) -> list:
@@ -1253,93 +1305,131 @@ class SignalEngine:
         tb = bucket(T)
         S = self.capacity
 
-        r5 = np.full((tb, depth, u5_rows), -1, np.int32)
-        t5 = np.full((tb, depth, u5_rows), -1, np.int32)
-        v5 = np.zeros((tb, depth, u5_rows, NUM_FIELDS), np.float32)
-        r15 = np.full((tb, depth, u15_rows), -1, np.int32)
-        t15 = np.full((tb, depth, u15_rows), -1, np.int32)
-        v15 = np.zeros((tb, depth, u15_rows, NUM_FIELDS), np.float32)
-        for i, p in enumerate(ticks):
-            # serial pairing preserved: the tick's own slots sit at the
-            # TAIL (front-padded with exact-no-op empties), so its last
-            # slot is always the evaluated one — _fold_updates semantics
-            off = depth - n_slots[i]
-            for d, b in enumerate(p.batches5):
-                r5[i, off + d], t5[i, off + d], v5[i, off + d] = pad_updates(
-                    *b, size=u5_rows
-                )
-            for d, b in enumerate(p.batches15):
-                r15[i, off + d], t15[i, off + d], v15[i, off + d] = (
-                    pad_updates(*b, size=u15_rows)
-                )
-
-        inputs_seq, active, momentum_seq = self._stack_plan_inputs(ticks, tb)
-        policy_prev = (
-            np.bool_(self._last_regime is not None),
-            np.int32(-1 if self._last_regime is None else self._last_regime),
-        )
-
         key = self._wire_enabled_key()
         self._tick_seq += 1
         trace = self.tracer.begin_tick(self._tick_seq, tick_ms=ticks[-1].now_ms)
         trace.set_attr(path="scanned")
+        # chunk-phase dwell (ISSUE 11): the accumulated per-tick planning
+        # dwell lands as the chunk's "plan" phase (a synthetic span laid
+        # just before the chunk — planning really happened interleaved
+        # with the caller's feed loop), then stack/dispatch/device_wait
+        # are live brackets, and the finalize loop closes the accounting.
+        self.host_phase.begin_chunk("scanned")
+        plan_ms = float(plan.get("plan_ms", 0.0))
+        self.host_phase.record("scanned", "plan", plan_ms)
         t_chunk0 = time.perf_counter()
+        if plan_ms:
+            trace.record_span(
+                "plan", t_chunk0 - plan_ms / 1000.0, t_chunk0,
+                accumulated=True, ticks=T,
+            )
         try:
             with self.latency.stage("scan_chunk"), trace.span(
                 "scan_chunk", ticks=T, padded=tb, depth=depth,
             ), trace.activate():
-                is_new_sig = observe_dispatch(
-                    self.state, (r5, t5, v5), (r15, t15, v15), key,
-                    cfg=self.context_config, fn="tick_step_scan",
-                    incremental=True, maintain_carry=True,
-                    numeric_digest=self.numeric_digest,
-                )
-                scan_sig = (
-                    f"{self._ledger_sig((r5,), (r15,), True)}"
-                    f" T{tb}xD{depth}"
-                )
-                cost_fn = None
-                if is_new_sig:
-                    a_args, _ = abstract_args(
-                        (
-                            self.state, (r5, t5, v5), (r15, t15, v15),
-                            inputs_seq, active, momentum_seq, policy_prev,
-                        )
-                    )
-                    cfg_, dig_ = self.context_config, self.numeric_digest
-
-                    def cost_fn(args=a_args):
-                        return lowered_cost(
-                            tick_step_scan, *args, cfg_,
-                            wire_enabled=key, incremental=True,
-                            maintain_carry=True, numeric_digest=dig_,
-                        )
-
-                # NOT donated: self.state stays alive as the pre-chunk
-                # anchor the overflow re-run below rewinds to
-                with LEDGER.watch(
-                    "tick_step_scan", scan_sig, expect_compile=is_new_sig,
-                    cost_fn=cost_fn, tick=self.ticks_processed,
+                with trace.span("stack"), self.host_phase.phase(
+                    "scanned", "stack"
                 ):
-                    new_state, wires_dev, _counts = tick_step_scan(
-                        self.state,
-                        (r5, t5, v5),
-                        (r15, t15, v15),
-                        inputs_seq,
-                        active,
-                        momentum_seq,
-                        policy_prev,
-                        self.context_config,
-                        wire_enabled=key,
-                        incremental=True,
-                        maintain_carry=True,
+                    r5 = np.full((tb, depth, u5_rows), -1, np.int32)
+                    t5 = np.full((tb, depth, u5_rows), -1, np.int32)
+                    v5 = np.zeros(
+                        (tb, depth, u5_rows, NUM_FIELDS), np.float32
+                    )
+                    r15 = np.full((tb, depth, u15_rows), -1, np.int32)
+                    t15 = np.full((tb, depth, u15_rows), -1, np.int32)
+                    v15 = np.zeros(
+                        (tb, depth, u15_rows, NUM_FIELDS), np.float32
+                    )
+                    for i, p in enumerate(ticks):
+                        # serial pairing preserved: the tick's own slots
+                        # sit at the TAIL (front-padded with exact-no-op
+                        # empties), so its last slot is always the
+                        # evaluated one — _fold_updates semantics
+                        off = depth - n_slots[i]
+                        for d, b in enumerate(p.batches5):
+                            r5[i, off + d], t5[i, off + d], v5[i, off + d] = (
+                                pad_updates(*b, size=u5_rows)
+                            )
+                        for d, b in enumerate(p.batches15):
+                            r15[i, off + d], t15[i, off + d], v15[i, off + d] = (
+                                pad_updates(*b, size=u15_rows)
+                            )
+                    inputs_seq, active, momentum_seq = (
+                        self._stack_plan_inputs(ticks, tb)
+                    )
+                    policy_prev = (
+                        np.bool_(self._last_regime is not None),
+                        np.int32(
+                            -1 if self._last_regime is None
+                            else self._last_regime
+                        ),
+                    )
+                t_launch0 = time.perf_counter()
+                with trace.span("dispatch"), self.host_phase.phase(
+                    "scanned", "dispatch"
+                ):
+                    is_new_sig = observe_dispatch(
+                        self.state, (r5, t5, v5), (r15, t15, v15), key,
+                        cfg=self.context_config, fn="tick_step_scan",
+                        incremental=True, maintain_carry=True,
                         numeric_digest=self.numeric_digest,
                     )
-                wires = np.asarray(wires_dev)
+                    scan_sig = (
+                        f"{self._ledger_sig((r5,), (r15,), True)}"
+                        f" T{tb}xD{depth}"
+                    )
+                    cost_fn = None
+                    if is_new_sig:
+                        a_args, _ = abstract_args(
+                            (
+                                self.state, (r5, t5, v5), (r15, t15, v15),
+                                inputs_seq, active, momentum_seq, policy_prev,
+                            )
+                        )
+                        cfg_, dig_ = self.context_config, self.numeric_digest
+
+                        def cost_fn(args=a_args):
+                            return lowered_cost(
+                                tick_step_scan, *args, cfg_,
+                                wire_enabled=key, incremental=True,
+                                maintain_carry=True, numeric_digest=dig_,
+                            )
+
+                    # NOT donated: self.state stays alive as the pre-chunk
+                    # anchor the overflow re-run below rewinds to
+                    with LEDGER.watch(
+                        "tick_step_scan", scan_sig, expect_compile=is_new_sig,
+                        cost_fn=cost_fn, tick=self.ticks_processed,
+                    ):
+                        new_state, wires_dev, _counts = tick_step_scan(
+                            self.state,
+                            (r5, t5, v5),
+                            (r15, t15, v15),
+                            inputs_seq,
+                            active,
+                            momentum_seq,
+                            policy_prev,
+                            self.context_config,
+                            wire_enabled=key,
+                            incremental=True,
+                            maintain_carry=True,
+                            numeric_digest=self.numeric_digest,
+                        )
+                with trace.span("device_wait"), self.host_phase.phase(
+                    "scanned", "device_wait"
+                ):
+                    wires = np.asarray(wires_dev)
         except BaseException as exc:
             trace.mark_error(exc)
             self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
             raise
+        # chunk-level dispatch→wire-fetch freshness, measured from the
+        # LAUNCH (stack packing excluded — comparable with the serial
+        # drive's stamp; the per-tick finalize fetches below read an
+        # already-landed host array)
+        self.freshness.observe_stage(
+            "dispatch_to_fetch", (time.perf_counter() - t_launch0) * 1000.0
+        )
         counts = wires[:T, WIRE_FIRED_COUNT_OFF]
         if np.any(counts > WIRE_MAX_FIRED):
             # a tick's fired set overflowed the wire's compaction slots:
@@ -1348,6 +1438,14 @@ class SignalEngine:
             # audited overflow fallback, so the emitted set stays exact
             trace.set_attr(overflow_rerun=True)
             self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+            # close the chunk's occupancy accounting: the host really
+            # spent this wall even though the outputs are discarded (and
+            # an open chunk must not linger into the serial re-drive)
+            self.host_phase.note_chunk(
+                "scanned",
+                plan_ms + (time.perf_counter() - t_chunk0) * 1000.0,
+                T,
+            )
             self.scan_overflow_reruns += 1
             SCAN_OVERFLOW_RERUNS.inc()
             fired_all.extend(await self._redrive_serial(plan))
@@ -1355,32 +1453,46 @@ class SignalEngine:
         self.state = new_state
         self.scan_chunks += 1
         SCAN_CHUNKS.inc()
-        self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
 
         per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
-        for i, p in enumerate(ticks):
-            # finalize reads the breadth this tick's dispatch saw
-            self.market_breadth = p.breadth
-            pending = _PendingTick(
-                wire=wires[i],
-                fallback=_scan_fallback_unavailable,
-                ts_ms=p.now_ms,
-                ts5=p.ts5,
-                ts15=p.ts15,
-                bucket15=p.bucket15,
-                dispatched_at=t_chunk0,
-                rows=p.rows,
-                trace=NULL_TRACE,
+        t_fin0 = time.perf_counter()
+        try:
+            for i, p in enumerate(ticks):
+                # finalize reads the breadth this tick's dispatch saw
+                self.market_breadth = p.breadth
+                pending = _PendingTick(
+                    wire=wires[i],
+                    fallback=_scan_fallback_unavailable,
+                    ts_ms=p.now_ms,
+                    ts5=p.ts5,
+                    ts15=p.ts15,
+                    bucket15=p.bucket15,
+                    dispatched_at=t_chunk0,
+                    rows=p.rows,
+                    trace=NULL_TRACE,
+                    drive="scanned",
+                    ingest_mono=p.ingest_mono,
+                )
+                fired_all.extend(await self._finalize_tick(pending))
+                self.latency.record("tick_total", per_tick_ms)
+                self.ticks_processed += 1
+                self._last_tick_wall_s = time.time()
+                TICKS.inc()
+                get_event_log().tick = self.ticks_processed
+                self.incremental_ticks += 1
+                self.scanned_ticks += 1
+                SCANNED_TICKS.inc()
+        finally:
+            # the chunk trace closes AFTER its finalizes so the waterfall
+            # shows the back-to-back decode/emit half, not just the
+            # dispatch — and an errored finalize still flight-records
+            trace.record_span("finalize", t_fin0, ticks=T)
+            self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
+            self.host_phase.note_chunk(
+                "scanned",
+                plan_ms + (time.perf_counter() - t_chunk0) * 1000.0,
+                T,
             )
-            fired_all.extend(await self._finalize_tick(pending))
-            self.latency.record("tick_total", per_tick_ms)
-            self.ticks_processed += 1
-            self._last_tick_wall_s = time.time()
-            TICKS.inc()
-            get_event_log().tick = self.ticks_processed
-            self.incremental_ticks += 1
-            self.scanned_ticks += 1
-            SCANNED_TICKS.inc()
         self.touch_heartbeat()
         return fired_all
 
@@ -1478,9 +1590,11 @@ class SignalEngine:
             self.tracer.complete(trace, snapshot_fn=self._flight_snapshot)
             raise
 
+
     async def _dispatch_tick_inner(self, ts_ms: int, trace) -> _PendingTick:
         import jax.numpy as jnp
 
+        t_plan0 = time.perf_counter()
         ts_s = ts_ms // 1000
         # Evaluate against the bar that just CLOSED: its open time is one
         # full interval behind the current wall-clock bucket.
@@ -1495,6 +1609,9 @@ class SignalEngine:
             # backlog at dispatch: how many deduped candles this tick drains
             QUEUE_DEPTH.labels(queue="batcher5").set(len(self.batcher5))
             QUEUE_DEPTH.labels(queue="batcher15").set(len(self.batcher15))
+            # ingest-arrival anchor: the oldest candle THIS tick drains
+            # (read before drain — drain resets the batcher stamps)
+            ingest_mono = self._oldest_pending_mono()
             registry_version0 = self.registry.version
             batches5 = self.batcher5.drain()
             batches15 = self.batcher15.drain()
@@ -1585,6 +1702,12 @@ class SignalEngine:
             sp_route.set(path=path, full_recompute_reason=reason)
             # root attr: the ring summary / healthz "carry path taken"
             trace.set_attr(path=path if reason is None else f"{path}:{reason}")
+        # serial dispatch-half dwell: plan covers breadth refresh, drain,
+        # and routing; stack covers the audit/fold/input build below
+        self.host_phase.record(
+            "serial", "plan", (time.perf_counter() - t_plan0) * 1000.0
+        )
+        t_stack0 = time.perf_counter()
 
         # explicit params override (backtest drives) — None stays the
         # baked-constant live graph. Resolved before the drift meter so an
@@ -1795,6 +1918,10 @@ class SignalEngine:
             "inputs_build", (time.perf_counter() - t_inputs0) * 1000.0
         )
         trace.record_span("inputs_build", t_inputs0)
+        self.host_phase.record(
+            "serial", "stack", (time.perf_counter() - t_stack0) * 1000.0
+        )
+        t_dispatch0 = time.perf_counter()
         mode = self._donation_mode()
         donate = mode is not None
         with self.latency.stage("device_dispatch"), trace.span(
@@ -2055,6 +2182,11 @@ class SignalEngine:
 
             threading.Thread(target=_warm, daemon=True).start()
 
+        # dispatch-phase dwell: the jit launch plus the fallback-closure/
+        # pre-warm setup riding the same half (everything past inputs)
+        self.host_phase.record(
+            "serial", "dispatch", (time.perf_counter() - t_dispatch0) * 1000.0
+        )
         return _PendingTick(
             wire=wire,
             fallback=fallback,
@@ -2065,6 +2197,8 @@ class SignalEngine:
             dispatched_at=time.perf_counter(),
             rows=self.registry.frozen_rows(),
             trace=trace,
+            drive="serial",
+            ingest_mono=ingest_mono,
             # double-buffered donation: this tick's post state re-enters
             # the slot rotation once the tick finalizes (tagged with the
             # reset generation so a post-reset finalize discards it)
@@ -2132,12 +2266,45 @@ class SignalEngine:
 
     async def _finalize_tick_inner(self, pending: _PendingTick, trace) -> list:
         ts5, ts15 = pending.ts5, pending.ts15
+        drive = getattr(pending, "drive", "serial") or "serial"
         # ONE device fetch per tick: the packed wire (context scalars +
         # compacted fired entries). Everything host-side below reads it.
+        t_fetch0 = time.perf_counter()
         with self.latency.stage("wire_fetch"), trace.span("wire_fetch") as sp_wire:
             unpacked = unpack_wire(
                 pending.wire, numeric_digest=self.numeric_digest
             )
+        t_fetch_end = time.perf_counter()
+        if drive == "serial":
+            # the serial drive's one blocking device interaction; on the
+            # batch drives the per-tick wire is an ALREADY-LANDED numpy
+            # row — parsing it is decode work (the chunk's np.asarray
+            # bracket captured the real device wait), so t_decode0 below
+            # reaches back to cover this unpack
+            self.host_phase.record(
+                drive, "device_wait", (t_fetch_end - t_fetch0) * 1000.0
+            )
+        if self.freshness.enabled:
+            # logical close→dispatch (this tick's clock vs the newest
+            # evaluated bar's close — exact live, deterministic in replay)
+            close_ms = max(ts5 + FIVE_MIN_S, ts15 + FIFTEEN_MIN_S) * 1000
+            self.freshness.observe_stage(
+                "close_to_dispatch", pending.ts_ms - close_ms
+            )
+            ingest_mono = getattr(pending, "ingest_mono", None)
+            if ingest_mono is not None:
+                self.freshness.observe_stage(
+                    "ingest_to_dispatch",
+                    max((pending.dispatched_at - ingest_mono) * 1000.0, 0.0),
+                )
+            if drive == "serial":
+                # batch drives observe this once per chunk at flush (their
+                # per-tick wire is an already-landed host array)
+                self.freshness.observe_stage(
+                    "dispatch_to_fetch",
+                    (t_fetch_end - pending.dispatched_at) * 1000.0,
+                )
+        t_decode0 = t_fetch_end if drive == "serial" else t_fetch0
         fired_w, ctx_scalars = unpacked
         sp_wire.set(overflow=bool(fired_w.overflow))
         # resync pressure: beta/corr rows reading null until the next full
@@ -2273,20 +2440,58 @@ class SignalEngine:
                 signal.message += (
                     f"\n- Trace: {trace.trace_id}/{trace.tick_seq}"
                 )
+        # decode half done (wire → deduped, provenance-stamped signals);
+        # the emit half below is sink dispatch only
+        t_emit_phase0 = time.perf_counter()
+        self.host_phase.record(
+            drive, "decode", (t_emit_phase0 - t_decode0) * 1000.0
+        )
+
+        def _sig_lag_ms(signal) -> int:
+            return pending.ts_ms - self._bar_close_ms(
+                signal.strategy, ts5, ts15
+            )
+
         with trace.span("emission", signals=len(fired)):
             for signal in fired:
+                # per-signal freshness, stamped BEFORE the analytics POST
+                # so the payload itself carries its staleness (additive
+                # field, absent while BQT_FRESHNESS=0 — satellite: no
+                # Prometheus scrape needed downstream)
+                sink_acks: dict[str, float] | None = None
+                if self.freshness.enabled:
+                    lag0 = _sig_lag_ms(signal)
+                    signal.freshness_ms = round(
+                        lag0
+                        + (time.perf_counter() - pending.dispatched_at)
+                        * 1000.0,
+                        3,
+                    )
+                    signal.analytics["freshness_ms"] = signal.freshness_ms
+                    signal.value.metadata["freshness_ms"] = signal.freshness_ms
+                    sink_acks = {}
+
+                    def _ack(sink: str, lag0=lag0, acks=sink_acks) -> None:
+                        acks[sink] = lag0 + (
+                            time.perf_counter() - pending.dispatched_at
+                        ) * 1000.0
+                else:
+                    def _ack(sink: str) -> None:
+                        pass
                 with trace.span(
                     "sink.analytics",
                     strategy=signal.strategy,
                     symbol=signal.symbol,
                 ):
                     dispatch_signal_record(self.binbot_api, signal.analytics)
+                _ack("analytics")
                 with trace.span(
                     "sink.telegram",
                     strategy=signal.strategy,
                     symbol=signal.symbol,
                 ):
                     self.telegram_consumer.dispatch_signal(signal.message)
+                _ack("telegram")
                 try:
                     with trace.span(
                         "sink.autotrade",
@@ -2296,11 +2501,35 @@ class SignalEngine:
                         await self.at_consumer.process_autotrade_restrictions(
                             signal.value
                         )
+                    # ack only on success: a swallowed sink failure must
+                    # not record a delivery latency for a sink that never
+                    # delivered (the error is visible in the span status
+                    # and bqt_sink_emissions_total)
+                    _ack("autotrade")
                 except Exception:
                     logging.exception(
                         "autotrade processing crashed for %s/%s; continuing",
                         signal.strategy,
                         signal.symbol,
+                    )
+                if self.freshness.enabled:
+                    # close→sink-ack + per-sink delivery + the SLO check
+                    # (breach force-emits with the chunk's phase split)
+                    self.freshness.observe_signal(
+                        strategy=signal.strategy,
+                        symbol=signal.symbol,
+                        close_to_emit_ms=signal.freshness_ms,
+                        sink_ack_ms=sink_acks,
+                        tick_ms=pending.ts_ms,
+                        trace_id=signal.trace_id,
+                        # the PRODUCING chunk's split-so-far (its
+                        # occupancy closes after this finalize); fall
+                        # back to the last closed chunk outside one
+                        phases=(
+                            self.host_phase.open_split(drive)
+                            or self.host_phase.last_chunk
+                        ),
+                        snapshot_fn=self._flight_snapshot,
                     )
         self.latency.record("emission", (time.perf_counter() - t_emit0) * 1000.0)
         self.signals_emitted += len(fired)
@@ -2318,6 +2547,13 @@ class SignalEngine:
             # to the tick that evicted it
             signal.tick_ms = pending.ts_ms
             SIGNALS.labels(strategy=signal.strategy).inc()
+            # freshness_ms rides the signal event only when stamped (the
+            # no-observatory record stays byte-identical)
+            extra = (
+                {"freshness_ms": signal.freshness_ms}
+                if signal.freshness_ms is not None
+                else {}
+            )
             get_event_log().emit(
                 "signal",
                 strategy=signal.strategy,
@@ -2327,16 +2563,14 @@ class SignalEngine:
                 tick_ms=pending.ts_ms,
                 trace_id=signal.trace_id,
                 tick_seq=signal.tick_seq,
-            )
-            bar_close_ms = (
-                (ts5 + FIVE_MIN_S) * 1000
-                if signal.strategy in FIVE_MIN_STRATEGIES
-                else (ts15 + FIFTEEN_MIN_S) * 1000
+                **extra,
             )
             self.latency.record(
-                "candle_to_emit",
-                (pending.ts_ms - bar_close_ms) + emit_lag_ms,
+                "candle_to_emit", _sig_lag_ms(signal) + emit_lag_ms
             )
+        self.host_phase.record(
+            drive, "emit", (time.perf_counter() - t_emit_phase0) * 1000.0
+        )
         return fired
 
     def _donation_mode(self) -> str | None:
@@ -2511,6 +2745,16 @@ class SignalEngine:
                 else self.enabled_strategies
             )
         )
+
+    def _bar_close_ms(self, strategy: str, ts5: int, ts15: int) -> int:
+        """Close time (ms) of the bar a strategy evaluated this tick — the
+        freshness anchor every close→* stamp measures against."""
+        bar_ts = (
+            ts5 + FIVE_MIN_S
+            if strategy in FIVE_MIN_STRATEGIES
+            else ts15 + FIFTEEN_MIN_S
+        )
+        return bar_ts * 1000
 
     def _already_emitted(
         self, strategy: str, symbol: str | None, ts5: int, ts15: int
@@ -2707,6 +2951,10 @@ class SignalEngine:
             "carry_desync_reason": self._carry_desync_reason,
             "numeric_anomaly_ticks": self.numeric.anomaly_ticks,
             "drift_alarms": self.drift.alarms,
+            # latency observatory: the newest chunk's occupancy split and
+            # the freshness-SLO tally (attribute reads only)
+            "freshness_slo_breaches": self.freshness.breaches,
+            "host_phase_last_chunk": self.host_phase.last_chunk,
         }
 
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
@@ -2793,6 +3041,12 @@ class SignalEngine:
             # the latest completed tick's trace summary (total ms, slowest
             # stage, carry path) — None while tracing is sampled off
             "last_tick_trace": self.tracer.last_tick_trace(),
+            # latency observatory (ISSUE 11): freshness stamps/SLO tally +
+            # per-drive host-phase dwell and chunk occupancy
+            "latency": {
+                "freshness": self.freshness.snapshot(),
+                "host_phase": self.host_phase.snapshot(),
+            },
         }
 
     # -- loops (main.py:37-57) ------------------------------------------------

@@ -77,6 +77,9 @@ def make_stub_engine(
     session=None,
     telegram_transport=None,
     trace_sample: float | None = None,
+    freshness: bool | None = None,
+    host_phase: bool | None = None,
+    freshness_slo_ms: float | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -123,6 +126,15 @@ def make_stub_engine(
         config.__dict__["backtest_chunk"] = int(backtest_chunk)
     if trace_sample is not None:
         config.__dict__["trace_sample"] = float(trace_sample)
+    # latency observatory (ISSUE 11): BQT_FRESHNESS / BQT_HOST_PHASE /
+    # BQT_FRESHNESS_SLO_MS overrides, so the latency lane can pin the
+    # observatory on while the tier-1 conftest keeps it off
+    if freshness is not None:
+        config.__dict__["freshness_enabled"] = bool(freshness)
+    if host_phase is not None:
+        config.__dict__["host_phase_enabled"] = bool(host_phase)
+    if freshness_slo_ms is not None:
+        config.__dict__["freshness_slo_ms"] = float(freshness_slo_ms)
     binbot_api = BinbotApi(
         "http://stub",
         session=session if session is not None else StubSession(breadth=breadth),
@@ -247,6 +259,9 @@ def run_replay(
     scanned: bool = False,
     carry_audit_every: int | None = None,
     scan_chunk: int | None = None,
+    freshness: bool | None = None,
+    host_phase: bool | None = None,
+    freshness_slo_ms: float | None = None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -280,6 +295,9 @@ def run_replay(
         donate=donate,
         carry_audit_every=carry_audit_every,
         scan_chunk=scan_chunk,
+        freshness=freshness,
+        host_phase=host_phase,
+        freshness_slo_ms=freshness_slo_ms,
     )
     # scripted dominance state (reference: attrs on the evaluator/consumer,
     # NEUTRAL/False in production — scriptable here so the dominance-gated
@@ -316,7 +334,20 @@ def run_replay(
     asyncio.run(drive_scanned() if scanned else drive())
     wall = time.perf_counter() - t_start
     overflow = engine.latency.stats().get("overflow_fallback", {})
+    # latency observatory (ISSUE 11): the run's freshness + host-phase
+    # summary rides the stats AND the event log, so make latency-smoke's
+    # report tool can render it after the process exits
+    latency_summary = None
+    if engine.freshness.enabled or engine.host_phase.enabled:
+        latency_summary = {
+            "freshness": engine.freshness.snapshot(),
+            "host_phase": engine.host_phase.snapshot(),
+        }
+        from binquant_tpu.obs.events import get_event_log
+
+        get_event_log().emit("latency_summary", **latency_summary)
     return {
+        **({"latency": latency_summary} if latency_summary else {}),
         "ticks": engine.ticks_processed,
         # fused-scan accounting (scanned=True lanes; 0 on the serial drive)
         "scanned_ticks": engine.scanned_ticks,

@@ -56,6 +56,52 @@ SLOW_TICKS = REGISTRY.counter(
     labels=("stage",),
 )
 
+# -- latency observatory (obs/latency.py, ISSUE 11) ---------------------------
+
+FRESHNESS = REGISTRY.histogram(
+    "bqt_freshness_ms",
+    "End-to-end signal freshness per stage: close_to_dispatch / "
+    "ingest_to_dispatch / dispatch_to_fetch / close_to_emit / "
+    "close_to_sink_ack. close_to_* stages are logical (measured against "
+    "the tick's own clock — exact live, deterministic in replay); the "
+    "rest are real monotonic deltas.",
+    labels=("stage",),
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             2500.0, 10000.0, 60000.0),
+)
+SINK_DELIVERY = REGISTRY.histogram(
+    "bqt_sink_delivery_ms",
+    "Per-sink delivery latency: candle close to the sink call returning "
+    "(telegram measures the paced-queue enqueue ack, not wire delivery).",
+    labels=("sink",),
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             2500.0, 10000.0, 60000.0),
+)
+FRESHNESS_SLO_BREACHES = REGISTRY.counter(
+    "bqt_freshness_slo_breaches_total",
+    "Signals whose worst close→sink-ack exceeded BQT_FRESHNESS_SLO_MS "
+    "(each force-emits a freshness_slo_breach event with the producing "
+    "chunk's host-phase breakdown).",
+)
+HOST_PHASE = REGISTRY.histogram(
+    "bqt_host_phase_ms",
+    "Host-phase dwell per drive (serial / scanned / backtest) and phase "
+    "(plan / stack / dispatch / device_wait / decode / emit) — one "
+    "observation per tick on the serial drive, per chunk-level bracket "
+    "on the batch drives.",
+    labels=("drive", "phase"),
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+             1000.0, 5000.0),
+)
+CHUNK_OCCUPANCY = REGISTRY.gauge(
+    "bqt_chunk_occupancy_ratio",
+    "The newest chunk's wall-clock split per drive: device_wait (blocking "
+    "wire fetch — a lower bound on device busy), host (named host "
+    "phases), dead_gap (unattributed residual), each as a fraction of "
+    "chunk wall.",
+    labels=("drive", "component"),
+)
+
 # -- event log (obs/events.py) ----------------------------------------------
 
 EVENTLOG_DROPPED = REGISTRY.counter(

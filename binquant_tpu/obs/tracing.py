@@ -281,6 +281,10 @@ class TickTrace:
                 "name": span.name,
                 "span_id": span.span_id,
                 "ms": round(span.duration_ms, 3),
+                # offset from the root's start, ms — the timeline
+                # exporter's placement anchor (tools/timeline_export.py);
+                # synthetic record_span entries can sit before the root
+                "t0": round((span.start - self.root.start) * 1000.0, 3),
                 "status": span.status,
             }
             if span.attrs:

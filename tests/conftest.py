@@ -49,6 +49,15 @@ os.environ.setdefault("BQT_TRACE_SAMPLE", "0")
 # numeric-health coverage opts in explicitly (tests/test_numeric_health.py).
 os.environ.setdefault("BQT_NUMERIC_DIGEST", "0")
 os.environ.setdefault("BQT_DRIFT_METER", "0")
+# Latency observatory (ISSUE 11) defaults OFF for the tier-1 lane, the
+# same pattern as BQT_TRACE_SAMPLE/BQT_NUMERIC_DIGEST: dozens of stub
+# engines must not each pay the freshness/phase bookkeeping, and several
+# fixtures pin the pre-observatory analytics/signal-event field sets
+# (freshness_ms is additive and only stamped while BQT_FRESHNESS=1).
+# Production defaults stay ON (binquant_tpu/config.py); the latency
+# coverage opts in explicitly (tests/test_latency.py).
+os.environ.setdefault("BQT_FRESHNESS", "0")
+os.environ.setdefault("BQT_HOST_PHASE", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

@@ -1,0 +1,535 @@
+"""Latency observatory (ISSUE 11): freshness-histogram correctness on a
+fake clock, SLO-breach force-emit, chunk occupancy summing to chunk wall,
+the shared serial/scanned phase taxonomy, chunk-span waterfalls, and the
+timeline-export golden."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from binquant_tpu.obs.events import EventLog, set_event_log
+from binquant_tpu.obs.latency import (
+    PHASES,
+    FreshnessTracker,
+    PhaseAccountant,
+)
+from binquant_tpu.obs.registry import REGISTRY
+from binquant_tpu.obs.tracing import Tracer
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import latency_report  # noqa: E402
+import timeline_export  # noqa: E402
+import trace_report  # noqa: E402
+
+# serial shapes shared with tests/test_obs.py / test_tracing.py (compile
+# cache hit); scanned shapes shared with tests/test_scan_replay.py
+CAP, WIN = 16, 130
+SCAN_CAP, SCAN_WIN = 32, 120
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    set_event_log(log)
+    try:
+        yield path
+    finally:
+        log.close()
+        set_event_log(None)
+
+
+def _read_events(path) -> list[dict]:
+    if not Path(path).exists():  # nothing emitted yet (lazy file sink)
+        return []
+    return [json.loads(ln) for ln in Path(path).read_text().splitlines()]
+
+
+def _hist_child(name: str, **labels):
+    fam = REGISTRY.get(name)
+    assert fam is not None, name
+    return fam.labels(**labels)
+
+
+def _counter_value(name: str) -> float:
+    fam = REGISTRY.get(name)
+    return 0.0 if fam is None else fam._solo().value
+
+
+# ---------------------------------------------------------------------------
+# unit: freshness tracker on a fake clock
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_histograms_fake_clock(event_log):
+    """Hand-fed stamps land in the right stage/sink children with exact
+    sums and counts — the histogram math checked against a fake clock's
+    known values (no wall time involved)."""
+    tracker = FreshnessTracker(enabled=True, slo_ms=0.0)
+    stage = _hist_child("bqt_freshness_ms", stage="close_to_emit")
+    ack_stage = _hist_child("bqt_freshness_ms", stage="close_to_sink_ack")
+    sink = _hist_child("bqt_sink_delivery_ms", sink="telegram")
+    sum0, count0 = stage.sum, stage.count
+    ack_sum0 = ack_stage.sum
+    sink_count0 = sink.count
+
+    worst = tracker.observe_signal(
+        "abp", "BTCUSDT", 40.0,
+        sink_ack_ms={"telegram": 55.0, "analytics": 45.0},
+    )
+    assert worst == 55.0  # close->sink-ack = the worst sink
+    worst = tracker.observe_signal(
+        "abp", "ETHUSDT", 10.0, sink_ack_ms={"telegram": 5.0}
+    )
+    assert worst == 10.0  # never below close->emit itself
+
+    assert stage.count == count0 + 2
+    assert stage.sum == pytest.approx(sum0 + 50.0)
+    assert ack_stage.sum == pytest.approx(ack_sum0 + 65.0)
+    assert sink.count == sink_count0 + 2
+    assert tracker.snapshot()["signals"] == 2
+    assert tracker.snapshot()["last_ms"]["close_to_emit"] == 10.0
+    # no SLO configured: nothing breached, nothing emitted
+    assert tracker.breaches == 0
+    assert all(
+        e["event"] != "freshness_slo_breach" for e in _read_events(event_log)
+    )
+
+    # disabled tracker is a no-op (the tier-1 default)
+    off = FreshnessTracker(enabled=False, slo_ms=1.0)
+    assert off.observe_signal("abp", "X", 1e9) is None
+    assert off.signals == 0 and stage.count == count0 + 2
+
+
+def test_freshness_slo_breach_force_emits(event_log):
+    """A signal whose worst sink ack crosses the SLO force-emits a
+    freshness_slo_breach with the phase breakdown + engine snapshot."""
+    tracker = FreshnessTracker(enabled=True, slo_ms=100.0)
+    before = _counter_value("bqt_freshness_slo_breaches_total")
+    tracker.observe_signal(
+        "lsp", "BTCUSDT", 80.0,
+        sink_ack_ms={"autotrade": 150.0},
+        tick_ms=123000,
+        trace_id="cafe",
+        phases={"drive": "scanned", "wall_ms": 200.0},
+        snapshot_fn=lambda: {"queue_depth": 3},
+    )
+    tracker.observe_signal("lsp", "ETHUSDT", 20.0)  # under SLO: no event
+    assert tracker.breaches == 1
+    assert _counter_value("bqt_freshness_slo_breaches_total") == before + 1
+    (breach,) = [
+        e for e in _read_events(event_log)
+        if e["event"] == "freshness_slo_breach"
+    ]
+    assert breach["close_to_sink_ack_ms"] == 150.0
+    assert breach["slo_ms"] == 100.0
+    assert breach["sink_ack_ms"] == {"autotrade": 150.0}
+    assert breach["host_phases"]["drive"] == "scanned"
+    assert breach["engine"] == {"queue_depth": 3}
+    assert breach["trace_id"] == "cafe"
+
+
+# ---------------------------------------------------------------------------
+# unit: phase accountant occupancy identity
+# ---------------------------------------------------------------------------
+
+
+def test_occupancy_sums_to_chunk_wall_exactly():
+    acc = PhaseAccountant(enabled=True)
+    acc.begin_chunk("scanned")
+    acc.record("scanned", "plan", 10.0)
+    acc.record("scanned", "stack", 5.0)
+    acc.record("scanned", "dispatch", 40.0)
+    acc.record("scanned", "device_wait", 30.0)
+    acc.record("scanned", "decode", 8.0)
+    # mid-chunk readers (an SLO breach during finalize) see the OPEN
+    # chunk's split-so-far, not the previous chunk's
+    mid = acc.open_split("scanned")
+    assert mid["drive"] == "scanned" and mid["dispatch"] == 40.0
+    acc.record("scanned", "emit", 2.0)
+    occ = acc.note_chunk("scanned", 100.0, 16)
+    assert acc.open_split("scanned") is None  # chunk closed
+    assert occ["device_wait_ms"] == 30.0
+    assert occ["host_ms"] == 65.0
+    assert occ["dead_gap_ms"] == 5.0
+    # the identity the acceptance criterion pins: wall == device + host +
+    # dead gap, and the attribution percentage reads off the same split
+    assert (
+        occ["device_wait_ms"] + occ["host_ms"] + occ["dead_gap_ms"]
+        == occ["wall_ms"]
+    )
+    assert occ["attributed_pct"] == 95.0
+    snap = acc.snapshot()
+    assert snap["occupancy"]["scanned"]["ticks"] == 16
+    assert set(snap["phase_ms"]["scanned"]) == set(PHASES)
+    # a second chunk diffs against its own marks, not the totals
+    acc.begin_chunk("scanned")
+    acc.record("scanned", "plan", 1.0)
+    occ2 = acc.note_chunk("scanned", 2.0, 4)
+    assert occ2["host_ms"] == 1.0 and occ2["dead_gap_ms"] == 1.0
+    # disabled accountant records nothing and notes nothing
+    off = PhaseAccountant(enabled=False)
+    off.begin_chunk("serial")
+    off.record("serial", "plan", 1.0)
+    assert off.note_chunk("serial", 1.0, 1) is None
+    assert off.open_split("serial") is None
+    assert off.snapshot()["phase_ms"] == {}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: replayed burst with the observatory on
+# ---------------------------------------------------------------------------
+
+
+def _drive_serial(engine, path) -> list:
+    from binquant_tpu.io.replay import load_klines_by_tick
+
+    by_tick = load_klines_by_tick(path)
+
+    async def go() -> list:
+        fired = []
+        for bucket in sorted(by_tick):
+            for k in sorted(by_tick[bucket], key=lambda k: k["open_time"]):
+                engine.ingest(k)
+            fired.extend(
+                await engine.process_tick(now_ms=(bucket + 1) * 900 * 1000)
+            )
+        fired.extend(await engine.flush_pending())
+        return fired
+
+    return asyncio.run(go())
+
+
+def test_replay_freshness_end_to_end(tmp_path, event_log):
+    """Every emitted signal carries a finite close→emit stamp into the
+    analytics payload, metadata, and the signal event; the engine's
+    freshness snapshot counts them; /healthz exposes the section."""
+    from binquant_tpu.io.replay import generate_burst_replay, make_stub_engine
+
+    path = tmp_path / "burst.jsonl"
+    generate_burst_replay(path, n_symbols=8, n_ticks=108)
+    engine = make_stub_engine(
+        capacity=CAP, window=WIN, pipeline_depth=0,
+        freshness=True, host_phase=True,
+    )
+    fired = _drive_serial(engine, path)
+    assert fired, "burst fixture must fire signals"
+    for signal in fired:
+        assert signal.freshness_ms is not None
+        assert signal.freshness_ms == signal.analytics["freshness_ms"]
+        assert signal.freshness_ms == signal.value.metadata["freshness_ms"]
+        # the evaluated bar closed before the tick dispatched: staleness
+        # is bounded below by the logical close→tick gap (>= 0 here)
+        assert signal.freshness_ms >= 0
+    signal_events = [
+        e for e in _read_events(event_log) if e["event"] == "signal"
+    ]
+    assert signal_events and all(
+        e.get("freshness_ms") is not None for e in signal_events
+    )
+    fresh = engine.freshness.snapshot()
+    assert fresh["signals"] == len(fired)
+    assert fresh["slo_breaches"] == 0
+    # every stage observed at least once on the serial drive
+    for stage in (
+        "close_to_dispatch", "ingest_to_dispatch", "dispatch_to_fetch",
+        "close_to_emit", "close_to_sink_ack",
+    ):
+        assert stage in fresh["last_ms"], stage
+    health = engine.health_snapshot()
+    assert health["latency"]["freshness"]["signals"] == len(fired)
+    assert health["latency"]["host_phase"]["enabled"] is True
+
+
+def test_replay_slo_breach_forced(tmp_path, event_log):
+    """slo_ms below any real end-to-end latency: every signal breaches,
+    each force-emitting with an engine snapshot attached."""
+    from binquant_tpu.io.replay import generate_burst_replay, make_stub_engine
+
+    path = tmp_path / "burst.jsonl"
+    generate_burst_replay(path, n_symbols=8, n_ticks=108)
+    engine = make_stub_engine(
+        capacity=CAP, window=WIN, pipeline_depth=0,
+        freshness=True, host_phase=True, freshness_slo_ms=1e-6,
+    )
+    fired = _drive_serial(engine, path)
+    assert fired
+    breaches = [
+        e for e in _read_events(event_log)
+        if e["event"] == "freshness_slo_breach"
+    ]
+    assert len(breaches) == len(fired) == engine.freshness.breaches
+    for b in breaches:
+        assert b["close_to_sink_ack_ms"] >= b["close_to_emit_ms"] >= 0
+        assert set(b["sink_ack_ms"]) == {"analytics", "telegram", "autotrade"}
+        assert "ticks_processed" in b["engine"]
+        # the PRODUCING chunk's split-so-far rides the breach (its tick's
+        # serial chunk is still open while finalize emits)
+        assert b["host_phases"]["drive"] == "serial"
+    assert (
+        engine._flight_snapshot()["freshness_slo_breaches"] == len(fired)
+    )
+
+
+def test_scanned_vs_serial_phase_taxonomy_and_occupancy(tmp_path, event_log):
+    """One scanned drive (whose cold-start tick re-enters the serial
+    path) reports BOTH drives under the SAME phase taxonomy, and each
+    chunk's occupancy split sums to its wall clock with ≥90% attributed
+    to named phases."""
+    from binquant_tpu.io.replay import generate_replay_file, run_replay
+
+    path = tmp_path / "scan.jsonl"
+    generate_replay_file(path, n_symbols=8, n_ticks=24)
+    stats = run_replay(
+        path, capacity=SCAN_CAP, window=SCAN_WIN, scanned=True,
+        incremental=True, scan_chunk=8,
+        freshness=True, host_phase=True,
+    )
+    assert stats["scan_chunks"] >= 1
+    host_phase = stats["latency"]["host_phase"]
+    phase_ms = host_phase["phase_ms"]
+    assert set(phase_ms) == {"serial", "scanned"}
+    # the acceptance pin: both drives report the identical taxonomy
+    assert set(phase_ms["serial"]) == set(phase_ms["scanned"]) == set(PHASES)
+    for drive, occ in host_phase["occupancy"].items():
+        total = (
+            occ["device_wait_ms"] + occ["host_ms"] + occ["dead_gap_ms"]
+        )
+        assert total == pytest.approx(occ["wall_ms"], abs=0.01), drive
+        assert occ["attributed_pct"] >= 90.0, (drive, occ)
+    assert host_phase["occupancy"]["scanned"]["ticks"] == stats[
+        "scanned_ticks"
+    ]
+    # the chunk-level dispatch→wire-fetch freshness stamp landed
+    assert "dispatch_to_fetch" in stats["latency"]["freshness"]["last_ms"]
+    # the run's summary event rode the log for offline reporting
+    summaries = [
+        e for e in _read_events(event_log) if e["event"] == "latency_summary"
+    ]
+    assert summaries and summaries[-1]["host_phase"]["occupancy"]
+
+
+def test_chunk_trace_carries_phase_children(tmp_path, event_log):
+    """The scanned chunk's trace is a phase waterfall (stack / dispatch /
+    device_wait children + plan/finalize root spans), not one opaque
+    bar — and trace_report renders it."""
+    from binquant_tpu.io.replay import (
+        generate_replay_file,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+
+    path = tmp_path / "scan.jsonl"
+    generate_replay_file(path, n_symbols=8, n_ticks=24)
+    engine = make_stub_engine(
+        capacity=SCAN_CAP, window=SCAN_WIN, incremental=True,
+        scan_chunk=8, freshness=True, host_phase=True,
+    )
+    engine.tracer = Tracer(sample=1.0, slow_ms=1e9, ring=64)
+    by_tick = load_klines_by_tick(path)
+    seq = [
+        (
+            (bucket + 1) * 900 * 1000,
+            sorted(by_tick[bucket], key=lambda k: k["open_time"]),
+        )
+        for bucket in sorted(by_tick)
+    ]
+    asyncio.run(engine.process_ticks_scanned(seq))
+    asyncio.run(engine.flush_pending())
+    chunk_traces = [
+        e
+        for e in _read_events(event_log)
+        if e["event"] == "trace" and e.get("path") == "scanned"
+    ]
+    assert chunk_traces, "at least one scan chunk must trace"
+    tree = chunk_traces[0]["spans"]
+    top = {c["name"]: c for c in tree["children"]}
+    assert {"plan", "scan_chunk", "finalize"} <= set(top)
+    kids = {c["name"] for c in top["scan_chunk"]["children"]}
+    assert {"stack", "dispatch", "device_wait"} <= kids
+    assert top["plan"]["attrs"]["accumulated"] is True
+    assert top["finalize"]["attrs"]["ticks"] == top["scan_chunk"]["attrs"][
+        "ticks"
+    ]
+    # spans carry the timeline exporter's placement offsets
+    assert "t0" in top["scan_chunk"]
+    assert trace_report.main([str(event_log), "--slowest", "2"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# goldens: chunk waterfall + timeline export
+# ---------------------------------------------------------------------------
+
+_CHUNK_EVENT = {
+    "event": "trace",
+    "trace_id": "00c0ffee00c0ffee",
+    "tick_seq": 7,
+    "busy_ms": 100.0,
+    "wall_ms": 130.0,
+    "status": "ok",
+    "path": "scanned",
+    "ts": 1700000000.13,
+    "spans": {
+        "name": "tick",
+        "span_id": "aaaaaaaa",
+        "ms": 130.0,
+        "t0": 0.0,
+        "status": "ok",
+        "children": [
+            {
+                "name": "plan",
+                "span_id": "bbbbbbbb",
+                "ms": 8.0,
+                "t0": -8.0,
+                "status": "ok",
+                "attrs": {"accumulated": True, "ticks": 16},
+            },
+            {
+                "name": "scan_chunk",
+                "span_id": "cccccccc",
+                "ms": 90.0,
+                "t0": 0.0,
+                "status": "ok",
+                "attrs": {"ticks": 16, "padded": 16, "depth": 1},
+                "children": [
+                    {
+                        "name": "stack",
+                        "span_id": "dddddddd",
+                        "ms": 5.0,
+                        "t0": 0.0,
+                        "status": "ok",
+                    },
+                    {
+                        "name": "dispatch",
+                        "span_id": "eeeeeeee",
+                        "ms": 60.0,
+                        "t0": 5.0,
+                        "status": "ok",
+                    },
+                    {
+                        "name": "device_wait",
+                        "span_id": "ffffffff",
+                        "ms": 25.0,
+                        "t0": 65.0,
+                        "status": "ok",
+                    },
+                ],
+            },
+            {
+                "name": "finalize",
+                "span_id": "99999999",
+                "ms": 2.0,
+                "t0": 90.0,
+                "status": "ok",
+                "attrs": {"ticks": 16},
+            },
+        ],
+    },
+}
+
+_CHUNK_RENDERED = """\
+trace 00c0ffee00c0ffee  tick 7  status ok  busy 100.0ms  wall 130.0ms  path scanned
+  plan                         8.000ms   8.0%  accumulated=True ticks=16
+  scan_chunk                  90.000ms  90.0%  ticks=16 padded=16 depth=1
+    stack                        5.000ms   5.0%
+    dispatch                    60.000ms  60.0%
+    device_wait                 25.000ms  25.0%
+  finalize                     2.000ms   2.0%  ticks=16"""
+
+
+def test_trace_report_chunk_waterfall_golden():
+    assert trace_report.render_trace(_CHUNK_EVENT) == _CHUNK_RENDERED
+
+
+def test_timeline_export_golden(tmp_path):
+    doc = timeline_export.export([_CHUNK_EVENT])
+    events = doc["traceEvents"]
+    # lane metadata first: one process + two named lanes
+    assert [e["name"] for e in events[:3]] == [
+        "process_name", "thread_name", "thread_name",
+    ]
+    slices = {e["name"]: e for e in events[3:]}
+    root_start_us = 1700000000.13 * 1e6 - 130.0 * 1000.0
+    assert slices["tick 7"]["tid"] == timeline_export.TID_HOST
+    assert slices["tick 7"]["ts"] == pytest.approx(root_start_us, abs=0.2)
+    assert slices["tick 7"]["dur"] == pytest.approx(130000.0)
+    # host lane: plan/stack/finalize; device lane: dispatch/device_wait
+    for name in ("plan", "scan_chunk", "stack", "finalize"):
+        assert slices[name]["tid"] == timeline_export.TID_HOST, name
+    for name in ("dispatch", "device_wait"):
+        assert slices[name]["tid"] == timeline_export.TID_DEVICE, name
+    # t0 placement: device_wait starts 65ms after the root
+    assert slices["device_wait"]["ts"] == pytest.approx(
+        root_start_us + 65000.0, abs=0.2
+    )
+    assert slices["device_wait"]["dur"] == pytest.approx(25000.0)
+    # the accumulated plan span sits BEFORE the chunk anchor
+    assert slices["plan"]["ts"] == pytest.approx(
+        root_start_us - 8000.0, abs=0.2
+    )
+    assert slices["scan_chunk"]["args"]["trace_id"] == "00c0ffee00c0ffee"
+
+    # CLI round trip: file in, chrome-trace json out
+    log = tmp_path / "ev.jsonl"
+    log.write_text(
+        json.dumps({"event": "signal"}) + "\n" + json.dumps(_CHUNK_EVENT)
+        + "\n"
+    )
+    out = tmp_path / "timeline.json"
+    assert timeline_export.main([str(log), "--out", str(out)]) == 0
+    parsed = json.loads(out.read_text())
+    assert parsed["displayTimeUnit"] == "ms"
+    assert len(parsed["traceEvents"]) == len(events)
+    # filters + empty-log failure mode
+    assert timeline_export.main([str(log), "--tick", "7"]) == 0
+    assert timeline_export.main([str(log), "--tick", "99"]) == 1
+
+
+def test_latency_report_renders_summary(tmp_path, capsys):
+    log = tmp_path / "ev.jsonl"
+    records = [
+        {
+            "event": "latency_summary",
+            "freshness": {
+                "signals": 3,
+                "slo_ms": 250.0,
+                "slo_breaches": 1,
+                "last_ms": {"close_to_emit": 12.5},
+            },
+            "host_phase": {
+                "phase_ms": {
+                    "scanned": {"plan": {"total_ms": 10.0, "count": 2}}
+                },
+                "occupancy": {
+                    "scanned": {
+                        "wall_ms": 100.0, "device_wait_ms": 40.0,
+                        "host_ms": 55.0, "dead_gap_ms": 5.0,
+                        "attributed_pct": 95.0, "chunks": 2, "ticks": 16,
+                    }
+                },
+            },
+        },
+        {"event": "signal", "strategy": "abp", "freshness_ms": 12.5},
+        {"event": "signal", "strategy": "abp", "freshness_ms": 20.0},
+        {
+            "event": "freshness_slo_breach",
+            "strategy": "abp",
+            "symbol": "BTCUSDT",
+            "close_to_sink_ack_ms": 300.0,
+            "slo_ms": 250.0,
+            "tick_ms": 1,
+        },
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    assert latency_report.main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "freshness" in out
+    assert "occupancy" in out
+    assert "dead_gap=5.0ms" in out
+    assert "SLO breaches (1)" in out
+    assert "abp" in out

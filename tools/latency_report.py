@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Render a replay's latency observatory from its JSONL event log.
+
+``run_replay`` emits one ``latency_summary`` event at end of run (the
+engine's freshness + host-phase snapshots); every emitted ``signal``
+event carries its ``freshness_ms`` stamp and every SLO violation a
+``freshness_slo_breach`` event. This tool turns those back into the
+"where do the milliseconds go / how stale are signals" tables without
+any service in the loop:
+
+    python tools/latency_report.py /tmp/bqt_latency_events.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_events(path: str | Path) -> list[dict]:
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _percentiles(values: list[float]) -> tuple[float, float, float]:
+    ordered = sorted(values)
+
+    def at(q: float) -> float:
+        if not ordered:
+            return float("nan")
+        idx = min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)
+        return ordered[idx]
+
+    return at(0.5), at(0.99), ordered[-1]
+
+
+def render(events: list[dict]) -> str:
+    lines: list[str] = []
+    summaries = [e for e in events if e.get("event") == "latency_summary"]
+    breaches = [e for e in events if e.get("event") == "freshness_slo_breach"]
+    signals = [
+        e
+        for e in events
+        if e.get("event") == "signal" and e.get("freshness_ms") is not None
+    ]
+
+    if summaries:
+        summary = summaries[-1]
+        freshness = summary.get("freshness") or {}
+        lines.append("freshness")
+        lines.append(
+            f"  signals {freshness.get('signals', 0)}"
+            f"  slo_ms {freshness.get('slo_ms', 0)}"
+            f"  breaches {freshness.get('slo_breaches', 0)}"
+        )
+        for stage, ms in sorted((freshness.get("last_ms") or {}).items()):
+            lines.append(f"  last {stage:<20} {ms:>10.3f}ms")
+        host_phase = summary.get("host_phase") or {}
+        phase_ms = host_phase.get("phase_ms") or {}
+        if phase_ms:
+            lines.append("")
+            lines.append("host phases (total ms per drive)")
+            for drive in sorted(phase_ms):
+                row = phase_ms[drive]
+                cells = "  ".join(
+                    f"{p}={row[p]['total_ms']:.1f}"
+                    for p in sorted(row)
+                )
+                lines.append(f"  {drive:<9} {cells}")
+        occupancy = host_phase.get("occupancy") or {}
+        if occupancy:
+            lines.append("")
+            lines.append(
+                "occupancy (chunk wall = device_wait + host + dead_gap)"
+            )
+            for drive in sorted(occupancy):
+                occ = occupancy[drive]
+                lines.append(
+                    f"  {drive:<9} wall={occ['wall_ms']:.1f}ms"
+                    f" device_wait={occ['device_wait_ms']:.1f}ms"
+                    f" host={occ['host_ms']:.1f}ms"
+                    f" dead_gap={occ['dead_gap_ms']:.1f}ms"
+                    f" attributed={occ.get('attributed_pct')}%"
+                    f" chunks={occ['chunks']} ticks={occ['ticks']}"
+                )
+    else:
+        lines.append("no latency_summary event (observatory knobs off?)")
+
+    if signals:
+        by_strategy: dict[str, list[float]] = {}
+        for s in signals:
+            by_strategy.setdefault(s["strategy"], []).append(
+                float(s["freshness_ms"])
+            )
+        lines.append("")
+        lines.append("per-signal close->emit freshness (ms)")
+        for strategy in sorted(by_strategy):
+            p50, p99, worst = _percentiles(by_strategy[strategy])
+            lines.append(
+                f"  {strategy:<28} n={len(by_strategy[strategy]):<4}"
+                f" p50={p50:.1f} p99={p99:.1f} max={worst:.1f}"
+            )
+
+    if breaches:
+        lines.append("")
+        lines.append(f"SLO breaches ({len(breaches)})")
+        for b in breaches[:10]:
+            lines.append(
+                f"  {b.get('strategy')}/{b.get('symbol')}"
+                f" close_to_sink_ack={b.get('close_to_sink_ack_ms')}ms"
+                f" slo={b.get('slo_ms')}ms tick_ms={b.get('tick_ms')}"
+            )
+        if len(breaches) > 10:
+            lines.append(f"  ... {len(breaches) - 10} more")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    args = parser.parse_args(argv)
+    events = load_events(args.log)
+    if not events:
+        print(f"no events in {args.log}", file=sys.stderr)
+        return 1
+    print(render(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Render the trace ring's JSONL events as Chrome-trace/Perfetto JSON.
+
+The engine emits one ``trace`` event per sampled tick or chunk with the
+span tree inlined (``binquant_tpu/obs/tracing.py``; each node carries a
+``t0`` offset from the root's start). This tool lays those spans out on
+two lanes — **host** (planning, stacking, decode, emission) and
+**device** (dispatch launch, blocking wire fetch/device wait) — in the
+Chrome trace-event format, loadable in ``chrome://tracing`` or
+https://ui.perfetto.dev:
+
+    python tools/timeline_export.py events.jsonl --out timeline.json
+    python tools/timeline_export.py events.jsonl --tick 42
+    python tools/timeline_export.py events.jsonl --trace cc73e595f7047dee
+
+Placement: each trace is anchored at its record's wall-clock ``ts``
+minus the root's wall duration (the completion event is written when the
+tick finalizes); spans place at root-start + ``t0``. Device-lane spans
+bracket *host-observed* device interaction — the launch call and the
+blocking fetch — so on an asynchronously-dispatching backend the device
+lane is a lower bound on device busy time. Traces from logs predating
+``t0`` fall back to sequential sibling layout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+PID = 1
+TID_HOST = 1
+TID_DEVICE = 2
+
+#: span names laid on the device lane: the jit launch and every blocking
+#: wait on device results (the rest of the tree is host work)
+DEVICE_SPANS = {
+    "device_dispatch",
+    "device_wait",
+    "wire_fetch",
+    "dispatch",
+    "overflow_fallback",
+}
+
+
+def load_trace_events(path: str | Path) -> list[dict]:
+    """All ``trace`` events from a JSONL event log, in file order.
+    Corrupt lines (a torn write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") == "trace" and "spans" in record:
+                out.append(record)
+    return out
+
+
+def trace_to_events(event: dict) -> list[dict]:
+    """One trace event → its Chrome trace-event slices (``ph: "X"``)."""
+    wall_ms = float(event.get("wall_ms") or 0.0)
+    root_start_us = float(event.get("ts", 0.0)) * 1e6 - wall_ms * 1000.0
+    out: list[dict] = []
+
+    def walk(node: dict, fallback_t0: float) -> None:
+        t0 = node.get("t0")
+        if t0 is None:
+            t0 = fallback_t0
+        ms = float(node.get("ms") or 0.0)
+        device = node["name"] in DEVICE_SPANS
+        args = dict(node.get("attrs") or {})
+        if node.get("status", "ok") != "ok":
+            args["status"] = node["status"]
+        slice_name = (
+            f"tick {event.get('tick_seq')}"
+            if node["name"] == "tick"
+            else node["name"]
+        )
+        out.append(
+            {
+                "name": slice_name,
+                "cat": "device" if device else "host",
+                "ph": "X",
+                "ts": round(root_start_us + float(t0) * 1000.0, 1),
+                "dur": round(ms * 1000.0, 1),
+                "pid": PID,
+                "tid": TID_DEVICE if device else TID_HOST,
+                "args": {**args, "trace_id": event.get("trace_id")},
+            }
+        )
+        # sequential sibling layout for pre-t0 logs: children start where
+        # the previous sibling ended
+        cursor = float(t0)
+        for child in node.get("children", ()):
+            walk(child, cursor)
+            cursor += float(child.get("ms") or 0.0)
+
+    walk(event["spans"], 0.0)
+    return out
+
+
+def export(events: list[dict]) -> dict:
+    """The full Chrome-trace document: lane metadata + every slice."""
+    trace_events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": PID,
+         "args": {"name": "binquant_tpu"}},
+        {"name": "thread_name", "ph": "M", "pid": PID, "tid": TID_HOST,
+         "args": {"name": "host"}},
+        {"name": "thread_name", "ph": "M", "pid": PID, "tid": TID_DEVICE,
+         "args": {"name": "device"}},
+    ]
+    for event in events:
+        trace_events.extend(trace_to_events(event))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument(
+        "--out", default="-",
+        help="output path for the Chrome-trace JSON (default: stdout)",
+    )
+    parser.add_argument("--trace", help="export only this trace_id")
+    parser.add_argument(
+        "--tick", type=int, help="export only this tick_seq"
+    )
+    args = parser.parse_args(argv)
+
+    events = load_trace_events(args.log)
+    if args.trace:
+        events = [e for e in events if e["trace_id"] == args.trace]
+    if args.tick is not None:
+        events = [e for e in events if e.get("tick_seq") == args.tick]
+    if not events:
+        print(
+            f"no matching trace events in {args.log} (tracing sampled off?)",
+            file=sys.stderr,
+        )
+        return 1
+
+    doc = json.dumps(export(events), indent=1)
+    if args.out in ("-", ""):
+        print(doc)
+    else:
+        Path(args.out).write_text(doc + "\n", encoding="utf-8")
+        print(
+            f"wrote {len(events)} trace(s) to {args.out} — open in "
+            "chrome://tracing or https://ui.perfetto.dev",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -76,10 +76,25 @@ help:
 	@echo "               The 2048x400 host_phase acceptance numbers merge"
 	@echo "               into BENCH_REPLAY_CPU.json via"
 	@echo "               'python bench.py --replay-throughput'"
-	@echo "  dryrun     - 8-device virtual-mesh multichip dry run (incl."
-	@echo "               one scan chunk + one backtest chunk; emits"
-	@echo "               structured dryrun_phase timing records with"
-	@echo "               per-executable compile seconds)"
+	@echo "  outcome-smoke- signal-outcome observatory lane (ISSUE 12):"
+	@echo "               the pytest drills (maturation-gather math, cap/"
+	@echo "               eviction, the serial==scanned==backtest matured-"
+	@echo "               set parity pin, checkpoint round-trip of the"
+	@echo "               open-signal registry, report goldens, sweep"
+	@echo "               economic scoring), then a scanned replay of the"
+	@echo "               mid-stream-fire fixture with outcomes on,"
+	@echo "               rendered by tools/outcome_report.py. The 2048x400"
+	@echo "               acceptance number (<5% wire-step bytes) is"
+	@echo "               'python bench.py --outcome-cost' (writes"
+	@echo "               BENCH_OUTCOMES_CPU.json)"
+	@echo "  dryrun     - 8-device virtual-mesh multichip dry run; gated"
+	@echo "               to ONE shard-compatible executable by default"
+	@echo "               (BQT_DRYRUN_PHASES=tick_step — the three-"
+	@echo "               executable compile bill was the rc=124;"
+	@echo "               BQT_DRYRUN_PHASES=all restores scan_chunk +"
+	@echo "               backtest_chunk); emits structured dryrun_phase"
+	@echo "               timing records with per-executable compile"
+	@echo "               seconds"
 	@echo "  lint       - ruff check"
 	@echo "offline kernel profiling: tools/profile_stages.py captures"
 	@echo "per-stage jax.profiler traces (see README.md section Observability)"
@@ -203,6 +218,22 @@ latency-smoke:
 	python tools/latency_report.py /tmp/bqt_latency_events.jsonl
 	python tools/timeline_export.py /tmp/bqt_latency_events.jsonl \
 		--out /tmp/bqt_timeline.json
+
+# The signal-outcome lane (ISSUE 12): the pytest drills (incl. the slow
+# sweep-scoring opt-in), then a scanned replay of the mid-stream-fire
+# fixture with the observatory pinned on, rendered as the per-strategy
+# scoreboard. The 2048x400 acceptance cost number is
+# `python bench.py --outcome-cost` (writes BENCH_OUTCOMES_CPU.json).
+outcome-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_outcomes.py -q \
+		-p no:cacheprovider
+	python -c "from binquant_tpu.io.replay import generate_outcome_replay; generate_outcome_replay('/tmp/replay_outcomes.jsonl', n_symbols=8, n_ticks=128)"
+	rm -f /tmp/bqt_outcome_events.jsonl
+	BQT_OUTCOMES=1 BQT_OUTCOME_HORIZONS=1,4,16 BQT_INCREMENTAL=1 \
+	BQT_SCAN_CHUNK=32 BQT_TRACE_SAMPLE=1 \
+	BQT_EVENT_LOG=/tmp/bqt_outcome_events.jsonl JAX_PLATFORMS=cpu \
+	python main.py --replay /tmp/replay_outcomes.jsonl --scanned
+	python tools/outcome_report.py /tmp/bqt_outcome_events.jsonl
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -486,6 +486,9 @@ def run_backtest(
     context_config=None,
     params: StrategyParams | None = None,
     chunk: int | None = None,
+    outcomes: bool | None = None,
+    outcome_horizons: tuple[int, ...] | None = None,
+    collect_outcomes: list | None = None,
 ) -> dict:
     """Backtest a JSONL kline stream through the time-batched backend.
 
@@ -510,6 +513,8 @@ def run_backtest(
         context_config=context_config,
         incremental=False,
         donate=False,
+        outcomes=outcomes,
+        outcome_horizons=outcome_horizons,
     )
     engine.at_consumer.market_domination_reversal = market_domination_reversal
     engine.at_consumer.current_market_dominance_is_losers = dominance_is_losers
@@ -549,7 +554,14 @@ def run_backtest(
     t_start = time.perf_counter()
     asyncio.run(drive())
     wall = time.perf_counter() - t_start
+    if engine.outcomes.enabled and collect_outcomes is not None:
+        collect_outcomes.extend(sorted(engine.outcomes.matured_set()))
     return {
+        **(
+            {"outcomes": engine.outcomes.scoreboard()}
+            if engine.outcomes.enabled
+            else {}
+        ),
         "ticks": engine.ticks_processed,
         "backtest_ticks": engine.backtest_ticks,
         "backtest_chunks": engine.backtest_chunks,
@@ -560,6 +572,214 @@ def run_backtest(
         "wall_s": round(wall, 3),
         "candles_per_sec": round(candles / wall, 1) if wall > 0 else None,
     }
+
+
+class _SweepOutcomeScorer:
+    """Economic scoring bed for :func:`run_param_sweep` (ISSUE 12).
+
+    The sweep shares ONE price stream across every combo, so a fired
+    signal's outcome depends only on its (symbol row, entry bar) pair and
+    the horizon — not on which combo fired it. The scorer therefore
+    matures each UNIQUE (row, entry_ts) pair once through the SAME jit'd
+    gather the live tracker uses (``obs.outcomes.outcome_gather``, fed
+    the sweep's committed host rings) and attributes the raw result to
+    every (combo, strategy, direction) reference, signed per direction —
+    P combos cost one gather, not P.
+
+    Maturation runs at each chunk flush against the post-commit rings:
+    the gather is timestamp-bounded, so gathering later than the due tick
+    changes nothing as long as the ring still holds the window (clipped
+    windows are detected via the row's oldest retained bar and counted as
+    truncated, exactly like the live tracker).
+    """
+
+    def __init__(self, P: int, horizons: tuple[int, ...]) -> None:
+        from binquant_tpu.obs.outcomes import _Agg
+
+        self.P = int(P)
+        self.horizons = tuple(
+            sorted({int(h) for h in (horizons or ()) if int(h) > 0})
+        )
+        self._agg_cls = _Agg
+        self._pair_ids: dict[tuple[int, int], int] = {}
+        self._pairs: list[dict] = []  # {row, entry_ts, pending}
+        self._refs: list[list[tuple[int, int, int]]] = []  # (p, si, sign)
+        # per combo: (si, horizon) -> the SAME scoreboard cell the live
+        # tracker keeps (obs.outcomes._Agg) — one fold, one rounding
+        self.agg: list[dict[tuple[int, int], object]] = [
+            {} for _ in range(self.P)
+        ]
+        self.matured_pairs = 0
+        self.truncated = 0
+        # fired slots beyond the wire's compaction width on a burst tick
+        # (the live drives re-drive such ticks serially; the sweep has no
+        # serial path, so the tail is DROPPED from scoring — counted
+        # here, never silently)
+        self.overflow_dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        """No positive horizons = scoring off (the bench's throughput
+        arms and a `--horizons 0` opt-out both land here cleanly)."""
+        return bool(self.horizons)
+
+    def register_chunk(self, slots, nfired, tick_ts5: list[int]) -> None:
+        """One flushed chunk's fired compactions: ``slots`` (P, T, 3, K)
+        rows (strategy_idx, row, direction), ``nfired`` (P, T)."""
+        from binquant_tpu.obs.outcomes import direction_sign
+
+        if not self.enabled:
+            return
+        slots = np.asarray(slots)
+        nfired = np.asarray(nfired)
+        K = slots.shape[-1]
+        for t, ts5 in enumerate(tick_ts5):
+            for p in range(self.P):
+                n = int(nfired[p, t])
+                k = min(n, K)
+                if n > K:
+                    # >WIRE_MAX_FIRED burst: the compaction kept the
+                    # first K pairs — score those, count the dropped tail
+                    self.overflow_dropped += n - K
+                if k <= 0:
+                    continue
+                si = slots[p, t, 0, :k].astype(np.int64)
+                row = slots[p, t, 1, :k].astype(np.int64)
+                dirn = slots[p, t, 2, :k].astype(np.int64)
+                ok = row >= 0
+                for s, r, d in zip(si[ok], row[ok], dirn[ok]):
+                    key = (int(r), int(ts5))
+                    pid = self._pair_ids.get(key)
+                    if pid is None:
+                        pid = len(self._pairs)
+                        self._pair_ids[key] = pid
+                        self._pairs.append(
+                            {
+                                "row": int(r),
+                                "entry_ts": int(ts5),
+                                "pending": list(self.horizons),
+                            }
+                        )
+                        self._refs.append([])
+                    self._refs[pid].append(
+                        (p, int(s), direction_sign(int(d)))
+                    )
+
+    def mature(self, times5, vals5, now_ts5: int) -> None:
+        """Mature every due (pair, horizon) against the committed rings."""
+        from binquant_tpu.obs.outcomes import (
+            FIVE_MIN_S,
+            _pow2,
+            outcome_gather,
+            signed_outcome,
+        )
+
+        if not self.enabled:
+            return
+        due: list[tuple[int, int]] = []
+        for pid, pair in enumerate(self._pairs):
+            for h in pair["pending"]:
+                if pair["entry_ts"] + h * FIVE_MIN_S <= now_ts5:
+                    due.append((pid, h))
+        if not due:
+            return
+        K = _pow2(len(due))
+        rows = np.full(K, -1, np.int32)
+        entry = np.zeros(K, np.int32)
+        horizon = np.zeros(K, np.int32)
+        for i, (pid, h) in enumerate(due):
+            rows[i] = self._pairs[pid]["row"]
+            entry[i] = self._pairs[pid]["entry_ts"]
+            horizon[i] = entry[i] + h * FIVE_MIN_S
+        floats, ints = outcome_gather(times5, vals5, rows, entry, horizon)
+        for i, (pid, h) in enumerate(due):
+            pair = self._pairs[pid]
+            pair["pending"].remove(h)
+            self.matured_pairs += 1
+            clipped = int(ints[1, i]) > pair["entry_ts"]
+            # unusable raw gather (empty window / NaN entry) counts as
+            # truncated too — the live tracker's exact accounting
+            # (OutcomeTracker.on_tick: ``outcome is None or clipped``);
+            # raw usability is direction-independent, so judge it once
+            # per pair, not per combo reference
+            usable = signed_outcome(
+                1, float(floats[0, i]), float(floats[1, i]),
+                float(floats[2, i]), float(floats[3, i]),
+            )
+            if clipped or usable is None:
+                self.truncated += 1
+                continue
+            for p, si, sign in self._refs[pid]:
+                fwd, mae, mfe = signed_outcome(
+                    sign, float(floats[0, i]), float(floats[1, i]),
+                    float(floats[2, i]), float(floats[3, i]),
+                )
+                cell = self.agg[p].get((si, h))
+                if cell is None:
+                    cell = self.agg[p][(si, h)] = self._agg_cls()
+                cell.add(fwd, mae, mfe)
+
+    def result(self, score_horizon: int | None = None) -> dict:
+        """The sweep result's ``outcomes`` section: per-combo per-strategy
+        scoreboards (the live tracker's exact cell shape — one fold, one
+        rounding) plus one scalar score row per combo at the scoring
+        horizon (the largest horizon that matured anything, unless
+        pinned) — the ROADMAP-4 economic proxy the ranking reads."""
+        if not self.enabled:
+            return {"enabled": False}
+        matured_h = {
+            h for by in self.agg for (_, h) in by
+        }
+        if score_horizon is None:
+            score_horizon = max(matured_h) if matured_h else max(self.horizons)
+        per_combo = []
+        combo_score = []
+        for p in range(self.P):
+            by_strategy: dict[str, dict[str, dict]] = {}
+            for (si, h), cell in sorted(self.agg[p].items()):
+                by_strategy.setdefault(STRATEGY_ORDER[si], {})[str(h)] = (
+                    cell.as_dict()
+                )
+            per_combo.append(by_strategy)
+            n = hits = 0
+            sum_fwd = sum_mae = 0.0
+            for (si, h), cell in self.agg[p].items():
+                if h != score_horizon:
+                    continue
+                n += cell.n
+                hits += cell.hits
+                sum_fwd += cell.sum_fwd
+                sum_mae += cell.sum_mae
+            combo_score.append(
+                {
+                    "n": n,
+                    "hit_rate": round(hits / n, 4) if n else None,
+                    "avg_fwd": round(sum_fwd / n, 6) if n else None,
+                    "sum_fwd": round(sum_fwd, 6),
+                    "avg_mae": round(sum_mae / n, 6) if n else None,
+                }
+            )
+        ranking = sorted(
+            range(self.P),
+            key=lambda p: (-combo_score[p]["sum_fwd"], p),
+        )
+        unmatured = sum(len(pair["pending"]) for pair in self._pairs)
+        return {
+            "enabled": True,
+            "horizons": list(self.horizons),
+            "score_horizon": int(score_horizon),
+            "per_combo": per_combo,
+            "combo_score": combo_score,
+            "ranking_by_return": [int(p) for p in ranking],
+            "matured_pairs": self.matured_pairs,
+            "truncated": self.truncated,
+            "unmatured_pair_horizons": int(unmatured),
+            # burst-tick slots the wire compaction could not carry — a
+            # nonzero value means the ranking was computed on a capped
+            # subset of those ticks' signals (re-run with fewer symbols
+            # or a narrower enabled set for full fidelity)
+            "overflow_dropped_slots": self.overflow_dropped,
+        }
 
 
 def _apply_host_updates(times, vals, filled, batches, window):
@@ -594,6 +814,8 @@ def run_param_sweep(
     context_config=None,
     chunk: int | None = None,
     base_params: StrategyParams | None = None,
+    horizons: tuple[int, ...] | None = (1, 4, 16, 96),
+    score_horizon: int | None = None,
 ) -> dict:
     """Score a strategy-parameter grid over a kline stream: ONE vmapped
     dispatch per chunk evaluates every combo (``backtest_chunk_sweep``).
@@ -604,8 +826,16 @@ def run_param_sweep(
     Non-append ticks (rewrites) flush the chunk, apply host-side, and keep
     sweeping — there is no serial path here (nothing to emit; the sweep
     SCORES, it does not emit signals). Returns per-combo per-strategy
-    trigger/autotrade counts plus the combo labels for
-    ``tools/sweep_report.py``."""
+    trigger/autotrade counts PLUS the economic proxies ROADMAP item 4
+    asked for (ISSUE 12): each combo's fired signals mature through the
+    same outcome kernel the live tracker uses (forward return / MAE /
+    MFE / hit-rate at ``horizons`` 5m bars, deduped across combos via the
+    shared price stream), and ``outcomes.ranking_by_return`` ranks combos
+    by total signed forward return at ``score_horizon`` instead of raw
+    fire counts. ``tools/sweep_report.py`` renders both. ``horizons``
+    with no positive entries (or None) disables scoring entirely — the
+    kernel then skips the fired-slot slice and the sweep measures the
+    pre-scoring throughput graph (the bench arms pass None)."""
     from binquant_tpu.io.pipeline import FIFTEEN_MIN_S
     from binquant_tpu.io.replay import load_klines_by_tick, make_stub_engine
     from binquant_tpu.regime.context import initial_regime_carry
@@ -655,6 +885,7 @@ def run_param_sweep(
     evaluated = 0
     dispatches = 0
     candles = 0
+    scorer = _SweepOutcomeScorer(P, horizons)
 
     klines_by_tick = load_klines_by_tick(path)
     seq = [
@@ -685,7 +916,7 @@ def run_param_sweep(
             times15, vals15, [b15 for _, _, b15 in plan], W
         )
         inputs_seq, active, momentum_seq = _stack_inputs(engine, ticks, tb)
-        carriesP, policyP, _fired, tc, ac = backtest_chunk_sweep(
+        (carriesP, policyP, _fired, tc, ac, fired_slots) = backtest_chunk_sweep(
             (ext5_t, ext5_v),
             (ext15_t, ext15_v),
             _pad_counts(counts5, tb),
@@ -700,11 +931,23 @@ def run_param_sweep(
             wire_enabled=key,
             window=W,
             params=dynamic_params(grid),
+            # scoring off (no positive horizons — the bench's throughput
+            # arms) restores the pre-scoring graph: the fired-slot slice
+            # is never computed and the wire tail stays DCE'd
+            with_fired_slots=scorer.enabled,
         )
         trig_totals += np.asarray(tc)[:, :T].sum(axis=1)
         at_totals += np.asarray(ac)[:, :T].sum(axis=1)
         evaluated += T
         dispatches += 1
+        # outcome scoring (ISSUE 12): register every combo's fired slots
+        # against their entry anchors BEFORE committing the rings...
+        if scorer.enabled:
+            scorer.register_chunk(
+                np.asarray(fired_slots)[:, :T],
+                np.asarray(_fired)[:, :T],
+                [p.ts5 for p in ticks],
+            )
         # commit the post-chunk rings
         buf5 = _final_window(ext5_t, ext5_v, counts5[-1], filled5, W)
         buf15 = _final_window(ext15_t, ext15_v, counts15[-1], filled15, W)
@@ -712,6 +955,9 @@ def run_param_sweep(
         filled5 = np.asarray(buf5.filled).astype(np.int64)
         times15, vals15 = np.asarray(buf15.times), np.asarray(buf15.values)
         filled15 = np.asarray(buf15.filled).astype(np.int64)
+        # ...then mature everything due through the chunk's last evaluated
+        # bar against the committed 5m ring (timestamp-bounded gather)
+        scorer.mature(times5, vals5, ticks[-1].ts5)
         plan.clear()
 
     t_start = time.perf_counter()
@@ -747,6 +993,7 @@ def run_param_sweep(
     order = np.argsort(-trig_totals.sum(axis=1), kind="stable")
     return {
         "P": P,
+        "outcomes": scorer.result(score_horizon=score_horizon),
         "combos": combos,
         "axes": {k: [float(v) for v in vs] for k, vs in axes.items()},
         "strategies": list(STRATEGY_ORDER),

@@ -58,6 +58,7 @@ from binquant_tpu.engine.step import (
     MIN_BARS,
     STRATEGY_ORDER,
     WIRE_FIRED_COUNT_OFF,
+    WIRE_MAX_FIRED,
     HostInputs,
     _btc_change_96,
     _btc_momentum_pair,
@@ -490,7 +491,10 @@ backtest_chunk = partial(
 )(_backtest_chunk_impl)
 
 
-@partial(jax.jit, static_argnames=("cfg", "wire_enabled", "window"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "wire_enabled", "window", "with_fired_slots"),
+)
 def backtest_chunk_sweep(
     ext5,
     ext15,
@@ -506,6 +510,7 @@ def backtest_chunk_sweep(
     wire_enabled: tuple[str, ...] = tuple(sorted(LIVE_STRATEGIES)),
     window: int = 400,
     params=None,  # DynamicParams with (P,) float leaves on swept axes
+    with_fired_slots: bool = True,
 ):
     """One dispatch scoring P strategy-parameter combos over the chunk.
 
@@ -513,21 +518,38 @@ def backtest_chunk_sweep(
     carries; buffers, packs, symbol features and every other
     params-independent intermediate carries no batch dim and is computed
     ONCE. Returns ``(carries', policy', fired_count (P, T), trig_counts
-    (P, T, N), autotrade_counts (P, T, N))`` — wires are deliberately NOT
-    returned (P × T × L would dominate memory; XLA dead-code-eliminates
-    the per-combo payload gathers this way).
+    (P, T, N), autotrade_counts (P, T, N), fired_slots (P, T, 3, K))`` —
+    the full wires are deliberately NOT returned (P × T × L would
+    dominate memory; XLA dead-code-eliminates the per-combo emission
+    payload and calibration gathers). ``fired_slots`` is the wire's
+    compacted fired block sliced down to the three rows the outcome
+    scorer joins on — (strategy_idx, row, direction), K =
+    ``WIRE_MAX_FIRED`` slots, invalid slots -1 — so economic scoring
+    (ISSUE 12) costs 3K floats per (combo, tick), not a wire.
+    ``with_fired_slots=False`` (static — the scoring-off throughput
+    arms) returns None there and restores the pre-scoring graph: nothing
+    of the wire beyond the fired count survives DCE.
     """
     dyn_leaves, treedef = jax.tree_util.tree_flatten(params)
     axes = [0 if getattr(v, "ndim", 0) >= 1 else None for v in dyn_leaves]
+    K = WIRE_MAX_FIRED
+    off = WIRE_FIRED_COUNT_OFF
 
     def run_one(carries_one, policy_one, *leaves):
         p = jax.tree_util.tree_unflatten(treedef, leaves)
-        carries2, policy2, _wires, fired, (tc, ac) = _backtest_chunk_impl(
+        carries2, policy2, wires, fired, (tc, ac) = _backtest_chunk_impl(
             ext5, ext15, counts5, counts15, filled0, carries_one,
             inputs_seq, active, momentum_ok, policy_one,
             cfg, wire_enabled, window, p,
         )
-        return carries2, policy2, fired, tc, ac
+        if not with_fired_slots:
+            return carries2, policy2, fired, tc, ac, None
+        blocks = wires[:, off + 1 : off + 1 + 6 * K].reshape(
+            wires.shape[0], 6, K
+        )
+        # rows 0/1/3 of the fired block: strategy_idx, row, direction
+        slots = blocks[:, jnp.asarray((0, 1, 3)), :]
+        return carries2, policy2, fired, tc, ac, slots
 
     return jax.vmap(run_one, in_axes=(0, 0, *axes))(
         carries, policy_prev, *dyn_leaves

@@ -320,6 +320,43 @@ class Config:
         return self._get("BQT_HOST_PHASE", "1") != "0"
 
     @cached_property
+    def outcomes_enabled(self) -> bool:
+        """Signal-outcome observatory (obs/outcomes.py): every emitted
+        signal registers in the open-signal registry and matures
+        device-side at the BQT_OUTCOME_HORIZONS bars of the 5m series
+        (forward return / MAE / MFE / hit-rate per strategy, signal_outcome
+        events joinable to signal events by trace_id/tick_seq).
+        BQT_OUTCOMES=0 disables (the tier-1 test lane's default — the
+        BQT_TRACE_SAMPLE pattern); payloads and the device wire are
+        untouched either way."""
+        return self._get("BQT_OUTCOMES", "1") != "0"
+
+    @cached_property
+    def outcome_horizons(self) -> tuple[int, ...]:
+        """Maturation horizons in 5m bars (comma-separated). Unparsable
+        tokens are dropped, not fatal; an all-invalid value falls back to
+        the default rather than booting a horizon-less tracker. Setting
+        it to non-positive values (e.g. "0") disables maturation — the
+        tracker treats no positive horizons as off."""
+        raw = self._get("BQT_OUTCOME_HORIZONS", "1,4,16,96")
+        horizons = []
+        for token in raw.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            try:
+                horizons.append(int(token))
+            except ValueError:
+                continue
+        return tuple(horizons) or (1, 4, 16, 96)
+
+    @cached_property
+    def outcome_cap(self) -> int:
+        """Open-signal registry bound: registering past it evicts the
+        oldest open signal (bqt_signal_outcome_evictions_total)."""
+        return int(self._get("BQT_OUTCOME_CAP", "1024") or "1024")
+
+    @cached_property
     def profile_dir(self) -> str:
         """Output directory for on-demand jax.profiler capture windows
         (/debug/profile?seconds=N and SIGUSR2)."""

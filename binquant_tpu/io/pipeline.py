@@ -76,6 +76,7 @@ from binquant_tpu.obs.instruments import (
 from binquant_tpu.obs.latency import FreshnessTracker, PhaseAccountant
 from binquant_tpu.obs.ledger import LEDGER, abstract_args, lowered_cost
 from binquant_tpu.obs.numeric import DriftMeter, NumericHealthMonitor
+from binquant_tpu.obs.outcomes import OutcomeTracker
 from binquant_tpu.obs.tracing import (
     NULL_TRACE,
     Tracer,
@@ -508,6 +509,17 @@ class SignalEngine:
         )
         self.host_phase = PhaseAccountant(
             enabled=bool(getattr(config, "host_phase_enabled", True))
+        )
+        # signal-outcome observatory (ISSUE 12): every emitted signal
+        # registers here and matures device-side at fixed 5m-bar horizons
+        # (obs/outcomes.py). Host-side registry + one small jit'd gather
+        # per maturation tick — the device wire is untouched either way.
+        self.outcomes = OutcomeTracker(
+            enabled=bool(getattr(config, "outcomes_enabled", True)),
+            horizons=tuple(
+                getattr(config, "outcome_horizons", None) or (1, 4, 16, 96)
+            ),
+            cap=int(getattr(config, "outcome_cap", 1024) or 1024),
         )
         # tick_seq source for traces: advances on every dispatch ATTEMPT
         # (ticks_processed only counts successes — deriving the seq from
@@ -2568,6 +2580,26 @@ class SignalEngine:
             self.latency.record(
                 "candle_to_emit", _sig_lag_ms(signal) + emit_lag_ms
             )
+        # signal-outcome observatory (ISSUE 12): the emitted (post-dedupe)
+        # set enters the open registry anchored on this tick's evaluated
+        # 5m bar, then everything due matures against the live ring in ONE
+        # jit'd gather. The gather is timestamp-bounded, so reading the
+        # engine's CURRENT state — post-chunk on the batch drives, a tick
+        # ahead on a pipelined live loop — yields the identical matured
+        # set every drive pins (obs/outcomes.py module docstring).
+        if self.outcomes.enabled:
+            for signal in fired:
+                self.outcomes.register(
+                    strategy=signal.strategy,
+                    symbol=signal.symbol,
+                    row=signal.row,
+                    entry_ts5=ts5,
+                    direction=signal.value.direction,
+                    trace_id=signal.trace_id,
+                    tick_seq=signal.tick_seq,
+                    tick_ms=pending.ts_ms,
+                )
+            self.outcomes.on_tick(ts5, self.state.buf5)
         self.host_phase.record(
             drive, "emit", (time.perf_counter() - t_emit_phase0) * 1000.0
         )
@@ -2843,6 +2875,11 @@ class SignalEngine:
                 for (strategy, symbol), ts in self._last_emitted.items()
             ],
             "notifier_last_transition": self.notifier.last_transition_sent,
+            # open-signal outcome registry (ISSUE 12): signals emitted but
+            # not yet matured at every horizon — a restart mid-horizon
+            # must mature the same signal_outcome set an uninterrupted
+            # run would (tests/test_outcomes.py pins the round trip)
+            "outcomes_open": self.outcomes.snapshot_open(),
         }
 
     def note_state_restored(self, migrated: bool = False) -> None:
@@ -2888,6 +2925,7 @@ class SignalEngine:
         self.notifier.last_transition_sent = (
             None if notifier_last is None else int(notifier_last)
         )
+        self.outcomes.restore_open(carries.get("outcomes_open"))
 
     _HB_WARN_EVERY_S = 60.0
 
@@ -2955,6 +2993,9 @@ class SignalEngine:
             # the freshness-SLO tally (attribute reads only)
             "freshness_slo_breaches": self.freshness.breaches,
             "host_phase_last_chunk": self.host_phase.last_chunk,
+            # signal-outcome observatory: registry pressure at the breach
+            "outcomes_open": len(self.outcomes._open),
+            "outcome_evictions": self.outcomes.evictions,
         }
 
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
@@ -3047,6 +3088,9 @@ class SignalEngine:
                 "freshness": self.freshness.snapshot(),
                 "host_phase": self.host_phase.snapshot(),
             },
+            # signal-outcome observatory (ISSUE 12): the per-strategy
+            # hit-rate/excursion scoreboard + open-registry pressure
+            "outcomes": self.outcomes.scoreboard(),
         }
 
     # -- loops (main.py:37-57) ------------------------------------------------

@@ -80,6 +80,9 @@ def make_stub_engine(
     freshness: bool | None = None,
     host_phase: bool | None = None,
     freshness_slo_ms: float | None = None,
+    outcomes: bool | None = None,
+    outcome_horizons: tuple[int, ...] | None = None,
+    outcome_cap: int | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -135,6 +138,17 @@ def make_stub_engine(
         config.__dict__["host_phase_enabled"] = bool(host_phase)
     if freshness_slo_ms is not None:
         config.__dict__["freshness_slo_ms"] = float(freshness_slo_ms)
+    # signal-outcome observatory (ISSUE 12): BQT_OUTCOMES /
+    # BQT_OUTCOME_HORIZONS / BQT_OUTCOME_CAP overrides so the outcome
+    # lane pins the observatory on while the tier-1 conftest keeps it off
+    if outcomes is not None:
+        config.__dict__["outcomes_enabled"] = bool(outcomes)
+    if outcome_horizons is not None:
+        config.__dict__["outcome_horizons"] = tuple(
+            int(h) for h in outcome_horizons
+        )
+    if outcome_cap is not None:
+        config.__dict__["outcome_cap"] = int(outcome_cap)
     binbot_api = BinbotApi(
         "http://stub",
         session=session if session is not None else StubSession(breadth=breadth),
@@ -262,6 +276,9 @@ def run_replay(
     freshness: bool | None = None,
     host_phase: bool | None = None,
     freshness_slo_ms: float | None = None,
+    outcomes: bool | None = None,
+    outcome_horizons: tuple[int, ...] | None = None,
+    collect_outcomes: list | None = None,
 ) -> dict:
     """Replay a JSONL kline file; returns run statistics.
 
@@ -298,6 +315,8 @@ def run_replay(
         freshness=freshness,
         host_phase=host_phase,
         freshness_slo_ms=freshness_slo_ms,
+        outcomes=outcomes,
+        outcome_horizons=outcome_horizons,
     )
     # scripted dominance state (reference: attrs on the evaluator/consumer,
     # NEUTRAL/False in production — scriptable here so the dominance-gated
@@ -346,8 +365,20 @@ def run_replay(
         from binquant_tpu.obs.events import get_event_log
 
         get_event_log().emit("latency_summary", **latency_summary)
+    # signal-outcome observatory (ISSUE 12): matured comparison tuples +
+    # the per-strategy scoreboard ride the stats so the parity harness and
+    # outcome_report can consume a run without scraping Prometheus
+    outcome_summary = None
+    if engine.outcomes.enabled:
+        if collect_outcomes is not None:
+            collect_outcomes.extend(sorted(engine.outcomes.matured_set()))
+        outcome_summary = engine.outcomes.scoreboard()
+        from binquant_tpu.obs.events import get_event_log as _gel
+
+        _gel().emit("outcome_summary", **outcome_summary)
     return {
         **({"latency": latency_summary} if latency_summary else {}),
+        **({"outcomes": outcome_summary} if outcome_summary else {}),
         "ticks": engine.ticks_processed,
         # fused-scan accounting (scanned=True lanes; 0 on the serial drive)
         "scanned_ticks": engine.scanned_ticks,
@@ -784,6 +815,73 @@ def generate_dormant_extended_replay(
             # the fade's sub-bars are strictly monotone red by construction
             # (each 15m fade bar splits into three falling sub-bars above)
     return None
+
+
+def generate_outcome_replay(
+    path: str | Path,
+    n_symbols: int = 8,
+    n_ticks: int = 128,
+    fire_ticks: tuple[int, int] = (104, 110),
+    seed: int = 11,
+) -> None:
+    """MID-stream MeanReversionFade hammers with scripted aftermaths — the
+    signal-outcome lane's fixture (ISSUE 12). Unlike the other generators
+    (whose crafted setups land on the LAST tick, leaving nothing to
+    mature), this stream fires early enough that every 5m-bar horizon up
+    to ``3 * (n_ticks - fire_ticks[1] - 1)`` completes before EOF, with
+    deliberately opposite aftermaths:
+
+    * S005 — steady bleed, green hammer at ``fire_ticks[0]``, then a
+      +0.35%/tick RECOVERY: positive forward returns, small MAE;
+    * S006 — the same recipe at ``fire_ticks[1]``, then the bleed simply
+      CONTINUES at −0.4%/tick: negative forward returns, deep MAE, tiny
+      MFE.
+
+    The rest of the universe random-walks gently (BTC row 0 flat-ish).
+    """
+    rng = np.random.default_rng(seed)
+    t0 = 1_780_272_000
+    assert t0 % 900 == 0
+    assert n_symbols >= 7 and n_ticks > max(fire_ticks) + 2
+    px = 20 + rng.random(n_symbols) * 100
+
+    with open(path, "w") as f:
+        for tick in range(n_ticks):
+            ts15 = t0 + tick * 900
+            rets = rng.normal(0, 0.003, n_symbols)
+            for s, fire in zip((5, 6), fire_ticks):
+                if tick < fire:
+                    rets[s] = -0.006  # bleed: RSI pins low pre-hammer
+                elif tick > fire:
+                    rets[s] = 0.0035 if s == 5 else -0.004
+            new_px = px * (1 + rets)
+            for i in range(n_symbols):
+                symbol = "BTCUSDT" if i == 0 else f"S{i:03d}USDT"
+                o, c = px[i], new_px[i]
+                vol15 = abs(rng.normal(1000, 200))
+                h, low = max(o, c) * 1.002, min(o, c) * 0.998
+                if i in (5, 6) and tick == fire_ticks[0 if i == 5 else 1]:
+                    # the green-hammer recipe (generate_replay_file): deep
+                    # gap below the 20-bar lower band, green close, 3x vol
+                    o = px[i] * 0.955
+                    c = o * 1.003
+                    h, low = c * 1.001, o * 0.997
+                    new_px[i] = c
+                    vol15 *= 3.0
+                f.write(_kline_json(symbol, ts15, 900, o, h, low, c, vol15))
+                sub_o = o
+                for j in range(3):
+                    sub_c = o + (c - o) * (j + 1) / 3
+                    sh = max(sub_o, sub_c) * 1.001
+                    sl = min(sub_o, sub_c) * 0.999
+                    f.write(
+                        _kline_json(
+                            symbol, ts15 + j * 300, 300,
+                            sub_o, sh, sl, sub_c, vol15 / 3,
+                        )
+                    )
+                    sub_o = sub_c
+            px = new_px
 
 
 def generate_replay_file(

@@ -1,0 +1,468 @@
+"""Signal-outcome observatory: device-side forward-return attribution.
+
+Four observability layers can say *when* and *how healthy* a signal was
+emitted (metrics, traces, numeric health, latency); this one says whether
+it was any *good*. Every emitted signal registers here (strategy, symbol
+row, the evaluated 5m bar as the entry anchor, trace_id/tick_seq as the
+join key back to the ``signal`` event) and matures at fixed horizons —
+bars of the 5m series (:data:`DEFAULT_HORIZONS`) — via ONE jit'd batched
+gather over the open rows against the live ring per maturation tick: no
+per-signal Python loops, no extra history copies on the host.
+
+The gather is **timestamp-bounded**, not recency-bounded: a (slot,
+horizon) pair reads exactly the ring bars with ``entry_ts < t <=
+entry_ts + horizon*300`` plus the entry bar itself, so WHEN maturation
+runs is irrelevant to WHAT it computes — the serial drive maturing
+per-tick and the scanned/backtest drives maturing through a
+post-chunk ring (their finalize loop runs after the chunk commits, so
+the ring already holds newer bars) produce the identical matured set.
+The one retention requirement: the ring must still HOLD the pair's
+window when maturation reaches it — ``W >= 3 * chunk_ticks +
+max(horizons)`` 5m bars (three 5m bars land per 15m tick). A clipped
+window is detected via the ring's oldest retained bar and the outcome
+is marked ``truncated`` (excluded from metrics, counted) instead of
+silently computing on partial history.
+
+Outcome sign convention (direction-relative return space, so LONG and
+SHORT share one scale):
+
+* ``fwd_ret``  — signed forward return at the horizon close
+  (``direction * (fwd_close / entry_close - 1)``); a hit is
+  ``fwd_ret > 0``.
+* ``mae`` — max adverse excursion, always ``<= 0``: the worst
+  signed-return drawdown within the horizon (LONG: the lowest low;
+  SHORT: the highest high).
+* ``mfe`` — max favorable excursion, always ``>= 0``: the best
+  signed-return run-up within the horizon.
+
+The open registry is bounded (``cap`` slots; registering past it evicts
+the OLDEST open signal and counts ``bqt_signal_outcome_evictions_total``)
+and survives checkpoint save/restore through the engine's host-carries
+JSON (:meth:`OutcomeTracker.snapshot_open` / :meth:`restore_open`) — a
+restart mid-horizon matures the same ``signal_outcome`` set as an
+uninterrupted run (tests/test_outcomes.py pins this).
+
+Knob: ``BQT_OUTCOMES`` — default ON in production, pinned 0 in the
+tier-1 conftest and in bench throughput arms (the BQT_TRACE_SAMPLE lane
+split). ``BQT_OUTCOME_HORIZONS`` / ``BQT_OUTCOME_CAP`` size the bed.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.instruments import (
+    OUTCOME_EVICTIONS,
+    OUTCOME_MATURED,
+    OUTCOME_OPEN,
+    OUTCOME_TRUNCATED,
+    SIGNAL_FWD_RETURN,
+    SIGNAL_HIT_RATE,
+    SIGNAL_MAE,
+    SIGNAL_MFE,
+)
+
+#: Maturation horizons in 5m bars: next bar, ~20 min, ~80 min, ~8 h.
+DEFAULT_HORIZONS: tuple[int, ...] = (1, 4, 16, 96)
+
+FIVE_MIN_S = 300
+
+
+def _outcome_gather_impl(times, values, rows, entry_ts, horizon_ts):
+    """The one device pass per maturation tick.
+
+    ``times`` (S, W) / ``values`` (S, W, F) are the LIVE 5m ring arrays —
+    raw ring order, any cursor phase: every reduction below is a
+    timestamp-masked scan, so bar order in memory is irrelevant (the same
+    property the circular-cursor rings rely on). ``rows`` (K,) selects
+    the open slots' symbol rows (padding slots are -1), ``entry_ts`` /
+    ``horizon_ts`` (K,) bound each pair's window in bar-open seconds.
+
+    Returns ``(f32 (4, K), i32 (2, K))``: entry close (the last bar at or
+    before the entry anchor), horizon close (the last bar inside the
+    window), window min-low and max-high; then bars-found and the row's
+    oldest retained bar ts (the host's truncation judge — returned as
+    exact int32, f32 would quantize ~1.7e9-second stamps to ±128 s).
+    """
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.buffer import Field
+
+    S = times.shape[0]
+    safe = jnp.clip(rows, 0, S - 1)
+    t = times[safe]  # (K, W)
+    v = values[safe]  # (K, W, F)
+    live = (t >= 0) & (rows[:, None] >= 0)
+    close = v[:, :, Field.CLOSE]
+    high = v[:, :, Field.HIGH]
+    low = v[:, :, Field.LOW]
+    in_win = live & (t > entry_ts[:, None]) & (t <= horizon_ts[:, None])
+    at_entry = live & (t <= entry_ts[:, None])
+
+    def last_close(sel):
+        has = jnp.any(sel, axis=1)
+        idx = jnp.argmax(jnp.where(sel, t, jnp.int32(-(2**31))), axis=1)
+        c = jnp.take_along_axis(close, idx[:, None], axis=1)[:, 0]
+        return jnp.where(has, c, jnp.nan)
+
+    any_win = jnp.any(in_win, axis=1)
+    min_low = jnp.min(jnp.where(in_win, low, jnp.inf), axis=1)
+    max_high = jnp.max(jnp.where(in_win, high, -jnp.inf), axis=1)
+    floats = jnp.stack(
+        [
+            last_close(at_entry),
+            last_close(in_win),
+            jnp.where(any_win, min_low, jnp.nan).astype(jnp.float32),
+            jnp.where(any_win, max_high, jnp.nan).astype(jnp.float32),
+        ]
+    )
+    oldest = jnp.min(
+        jnp.where(live, t, jnp.int32(2**31 - 1)), axis=1
+    )
+    ints = jnp.stack(
+        [jnp.sum(in_win, axis=1).astype(jnp.int32), oldest]
+    )
+    return floats, ints
+
+
+# jit'd lazily so importing this module never drags jax in (the obs
+# package idiom — instruments/events stay importable in jax-free tools)
+_outcome_gather_jit = None
+
+
+def outcome_gather(times, values, rows, entry_ts, horizon_ts):
+    """Host entry for the maturation kernel: pad-free numpy in, numpy out
+    (callers pad ``rows`` to a power-of-two bucket themselves — the pad
+    policy bounds the executable count and lives with the caller)."""
+    global _outcome_gather_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _outcome_gather_jit is None:
+        _outcome_gather_jit = jax.jit(_outcome_gather_impl)
+    floats, ints = _outcome_gather_jit(
+        times,
+        values,
+        jnp.asarray(np.asarray(rows, np.int32)),
+        jnp.asarray(np.asarray(entry_ts, np.int32)),
+        jnp.asarray(np.asarray(horizon_ts, np.int32)),
+    )
+    return np.asarray(floats), np.asarray(ints)
+
+
+def signed_outcome(
+    direction: int,
+    entry_close: float,
+    fwd_close: float,
+    min_low: float,
+    max_high: float,
+) -> tuple[float, float, float] | None:
+    """(fwd_ret, mae, mfe) in direction-relative return space, or None
+    when the raw gather was unusable (no entry bar / empty window /
+    non-positive entry). One copy of the sign convention — the live
+    tracker and the sweep scorer both fold raw gathers through here."""
+    if not (
+        entry_close == entry_close
+        and fwd_close == fwd_close
+        and min_low == min_low
+        and max_high == max_high
+        and entry_close > 0
+    ):
+        return None
+    fwd_raw = fwd_close / entry_close - 1.0
+    lo = min_low / entry_close - 1.0
+    hi = max_high / entry_close - 1.0
+    if direction >= 0:
+        return fwd_raw, min(0.0, lo), max(0.0, hi)
+    return -fwd_raw, min(0.0, -hi), max(0.0, -lo)
+
+
+def direction_sign(direction: Any) -> int:
+    """'SHORT'/Direction.SHORT/1 → -1; everything else (LONG, grid) +1."""
+    s = str(direction)
+    if s in ("SHORT", "1", "Direction.SHORT"):
+        return -1
+    return 1
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    """The ONE pad-bucket policy for the maturation gather's pair axis
+    (the live tracker and the sweep scorer both pad through here — the
+    bucket policy directly controls the gather's executable count; the
+    scan lanes' io.pipeline._pow2_bucket is a separate policy for a
+    separate executable family)."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class _Agg:
+    """Per-(strategy, horizon) scoreboard cell."""
+
+    __slots__ = ("n", "hits", "sum_fwd", "sum_mae", "sum_mfe", "worst_mae")
+
+    def __init__(self) -> None:
+        self.n = 0
+        self.hits = 0
+        self.sum_fwd = 0.0
+        self.sum_mae = 0.0
+        self.sum_mfe = 0.0
+        self.worst_mae = 0.0
+
+    def add(self, fwd: float, mae: float, mfe: float) -> None:
+        self.n += 1
+        self.hits += 1 if fwd > 0 else 0
+        self.sum_fwd += fwd
+        self.sum_mae += mae
+        self.sum_mfe += mfe
+        self.worst_mae = min(self.worst_mae, mae)
+
+    def as_dict(self) -> dict:
+        n = self.n
+        return {
+            "n": n,
+            "hits": self.hits,
+            "hit_rate": round(self.hits / n, 4) if n else None,
+            "avg_fwd": round(self.sum_fwd / n, 6) if n else None,
+            "avg_mae": round(self.sum_mae / n, 6) if n else None,
+            "avg_mfe": round(self.sum_mfe / n, 6) if n else None,
+            "worst_mae": round(self.worst_mae, 6) if n else None,
+        }
+
+
+class OutcomeTracker:
+    """Open-signal registry + maturation driver for one engine."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        horizons: tuple[int, ...] = DEFAULT_HORIZONS,
+        cap: int = 1024,
+    ) -> None:
+        self.horizons = tuple(
+            sorted({int(h) for h in (horizons or ()) if int(h) > 0})
+        )
+        # no positive horizons = the observatory is off (an operator's
+        # BQT_OUTCOME_HORIZONS=0 is a disable, not a boot crash)
+        self.enabled = bool(enabled) and bool(self.horizons)
+        self.cap = max(int(cap), 1)
+        # open slots in registration order (eviction pops the head); each
+        # slot is one emitted signal with its not-yet-matured horizons
+        self._open: deque[dict] = deque()
+        self.registered = 0
+        self.evictions = 0
+        self.matured = 0  # (signal, horizon) pairs matured
+        self.truncated = 0  # matured pairs whose ring window was clipped
+        self._agg: dict[tuple[str, int], _Agg] = {}
+        # matured comparison tuples (strategy, symbol, entry_ts, horizon,
+        # fwd, mae, mfe, bars) — the parity/test surface, ring-bounded so
+        # a long-lived live engine cannot grow it without bound
+        self.recent: deque[tuple] = deque(maxlen=8192)
+
+    # -- registration --------------------------------------------------------
+
+    def register(
+        self,
+        strategy: str,
+        symbol: str,
+        row: int,
+        entry_ts5: int,
+        direction: Any,
+        trace_id: str | None = None,
+        tick_seq: int | None = None,
+        tick_ms: int | None = None,
+    ) -> None:
+        """One emitted signal enters the open registry. ``entry_ts5`` is
+        the evaluated 5m bar's OPEN time (seconds) — its close is the
+        entry anchor, gathered from the ring at maturation so every drive
+        anchors on the identical bar, not on a per-strategy payload
+        field."""
+        if not self.enabled:
+            return
+        if len(self._open) >= self.cap:
+            self._open.popleft()
+            self.evictions += 1
+            OUTCOME_EVICTIONS.inc()
+        self._open.append(
+            {
+                "strategy": strategy,
+                "symbol": symbol,
+                "row": int(row),
+                "entry_ts": int(entry_ts5),
+                "dir": direction_sign(direction),
+                "trace_id": trace_id,
+                "tick_seq": tick_seq,
+                "tick_ms": tick_ms,
+                "pending": list(self.horizons),
+            }
+        )
+        self.registered += 1
+        OUTCOME_OPEN.set(len(self._open))
+
+    # -- maturation ----------------------------------------------------------
+
+    def due_pairs(self, now_ts5: int) -> list[tuple[dict, int]]:
+        """(slot, horizon) pairs whose horizon bar has closed by the tick
+        evaluating the 5m bar that opens at ``now_ts5``."""
+        out: list[tuple[dict, int]] = []
+        for slot in self._open:
+            for h in slot["pending"]:
+                if slot["entry_ts"] + h * FIVE_MIN_S <= now_ts5:
+                    out.append((slot, h))
+        return out
+
+    def on_tick(self, now_ts5: int, buf5) -> list[tuple]:
+        """Mature everything due at this tick against the live 5m ring.
+        Returns the newly matured comparison tuples (also appended to
+        ``self.recent`` and emitted as ``signal_outcome`` events)."""
+        if not self.enabled or not self._open:
+            return []
+        pairs = self.due_pairs(int(now_ts5))
+        if not pairs:
+            return []
+        K = _pow2(len(pairs))
+        rows = np.full(K, -1, np.int32)
+        entry = np.zeros(K, np.int32)
+        horizon = np.zeros(K, np.int32)
+        for i, (slot, h) in enumerate(pairs):
+            rows[i] = slot["row"]
+            entry[i] = slot["entry_ts"]
+            horizon[i] = slot["entry_ts"] + h * FIVE_MIN_S
+        floats, ints = outcome_gather(
+            buf5.times, buf5.values, rows, entry, horizon
+        )
+        matured: list[tuple] = []
+        touched: set[tuple[str, int]] = set()
+        for i, (slot, h) in enumerate(pairs):
+            slot["pending"].remove(h)
+            # plain Python floats: the values land in JSON events and the
+            # checkpoint blob — numpy scalars would serialize per-platform
+            outcome = signed_outcome(
+                slot["dir"], float(floats[0, i]), float(floats[1, i]),
+                float(floats[2, i]), float(floats[3, i]),
+            )
+            # the ring must still hold the pair's whole window: its oldest
+            # retained bar at or before the entry anchor (the entry bar
+            # itself doubles as the boundary witness)
+            clipped = int(ints[1, i]) > slot["entry_ts"]
+            self.matured += 1
+            event: dict[str, Any] = {
+                "strategy": slot["strategy"],
+                "symbol": slot["symbol"],
+                "horizon": h,
+                "entry_ts": slot["entry_ts"],
+                "bars": int(ints[0, i]),
+                "tick_ms": slot["tick_ms"],
+                "trace_id": slot["trace_id"],
+                "tick_seq": slot["tick_seq"],
+                "direction": "SHORT" if slot["dir"] < 0 else "LONG",
+            }
+            if outcome is None or clipped:
+                self.truncated += 1
+                OUTCOME_TRUNCATED.inc()
+                event["truncated"] = True
+                get_event_log().emit("signal_outcome", **event)
+                continue
+            fwd, mae, mfe = outcome
+            key = (slot["strategy"], h)
+            self._agg.setdefault(key, _Agg()).add(fwd, mae, mfe)
+            touched.add(key)
+            hl = str(h)
+            SIGNAL_FWD_RETURN.labels(
+                strategy=slot["strategy"], horizon=hl
+            ).observe(fwd)
+            SIGNAL_MAE.labels(strategy=slot["strategy"], horizon=hl).observe(
+                mae
+            )
+            SIGNAL_MFE.labels(strategy=slot["strategy"], horizon=hl).observe(
+                mfe
+            )
+            OUTCOME_MATURED.labels(
+                strategy=slot["strategy"], horizon=hl
+            ).inc()
+            event.update(
+                fwd_ret=round(fwd, 6), mae=round(mae, 6), mfe=round(mfe, 6)
+            )
+            get_event_log().emit("signal_outcome", **event)
+            tup = (
+                slot["strategy"],
+                slot["symbol"],
+                slot["entry_ts"],
+                h,
+                round(fwd, 6),
+                round(mae, 6),
+                round(mfe, 6),
+                int(ints[0, i]),
+            )
+            self.recent.append(tup)
+            matured.append(tup)
+        for strategy, h in touched:
+            agg = self._agg[(strategy, h)]
+            SIGNAL_HIT_RATE.labels(strategy=strategy, horizon=str(h)).set(
+                agg.hits / agg.n
+            )
+        # drop fully-matured slots (registration order preserved)
+        self._open = deque(s for s in self._open if s["pending"])
+        OUTCOME_OPEN.set(len(self._open))
+        return matured
+
+    # -- introspection / persistence -----------------------------------------
+
+    def scoreboard(self) -> dict:
+        """/healthz ``outcomes`` section + report surface."""
+        per_strategy: dict[str, dict[str, dict]] = {}
+        for (strategy, h), agg in sorted(self._agg.items()):
+            per_strategy.setdefault(strategy, {})[str(h)] = agg.as_dict()
+        return {
+            "enabled": self.enabled,
+            "horizons": list(self.horizons),
+            "cap": self.cap,
+            "open": len(self._open),
+            "registered": self.registered,
+            "matured": self.matured,
+            "truncated": self.truncated,
+            "evictions": self.evictions,
+            "per_strategy": per_strategy,
+        }
+
+    def snapshot_open(self) -> list[dict]:
+        """JSON-safe open-registry snapshot for the checkpoint's
+        host-carries blob (aggregates are observability state and restart
+        fresh; the OPEN signals are correctness state — a restart
+        mid-horizon must mature the same set an uninterrupted run would)."""
+        return [dict(slot, pending=list(slot["pending"])) for slot in self._open]
+
+    def restore_open(self, slots: list[dict] | None) -> None:
+        # a disabled tracker must not adopt an outcomes-on checkpoint's
+        # open registry: register/on_tick would never mature or clear the
+        # slots, leaving phantom registry pressure in every snapshot
+        if not slots or not self.enabled:
+            return
+        for slot in slots:
+            self._open.append(
+                {
+                    "strategy": str(slot["strategy"]),
+                    "symbol": str(slot["symbol"]),
+                    "row": int(slot["row"]),
+                    "entry_ts": int(slot["entry_ts"]),
+                    "dir": int(slot.get("dir", 1)),
+                    "trace_id": slot.get("trace_id"),
+                    "tick_seq": slot.get("tick_seq"),
+                    "tick_ms": slot.get("tick_ms"),
+                    "pending": [int(h) for h in slot["pending"]],
+                }
+            )
+        while len(self._open) > self.cap:
+            self._open.popleft()
+            self.evictions += 1
+            OUTCOME_EVICTIONS.inc()
+        OUTCOME_OPEN.set(len(self._open))
+
+    def matured_set(self) -> set[tuple]:
+        """The matured comparison tuples (parity harness surface)."""
+        return set(self.recent)

@@ -55,6 +55,15 @@ def drive_scenario(
         scan_chunk=spec.scan_chunk,
         enabled_strategies=set(spec.enabled_strategies),
         trace_sample=1.0,  # every tick traced: the crash-ring invariant
+        # signal-outcome observatory (ISSUE 12): pinned ON with short
+        # horizons so the scripted streams' aftermaths show up as
+        # per-family MAE/MFE columns in the verdict — and the matured set
+        # becomes one more cross-drive equality invariant. Horizons stay
+        # small because the corpus events land just past MIN_BARS near
+        # EOF (longer horizons would never mature) and because the
+        # retention bound (W >= 3*chunk + h) must hold at spec shapes.
+        outcomes=True,
+        outcome_horizons=(1, 4),
     )
     # isolated ws tracker: the module singleton may carry another drill's
     # reconnect storm, which would flip this run's health to degraded
@@ -151,6 +160,17 @@ def run_scenario(
         checks["pinned_signal_set"] = (
             [list(t) for t in signal_set] == pinned[name]["signals"]
         )
+    # signal-outcome parity (ISSUE 12): the matured (strategy, symbol,
+    # entry, horizon, fwd/mae/mfe) sets must agree across all three
+    # drives — outcomes derive from the (pinned-equal) signal sets plus
+    # the shared stream, so a mismatch means the maturation gather read
+    # different history (a retention-bound violation or a drive bug)
+    checks["outcome_parity"] = (
+        eng_s.outcomes.matured_set()
+        == eng_c.outcomes.matured_set()
+        == eng_f.outcomes.matured_set()
+    )
+    outcomes = _outcome_columns(eng_s)
 
     verdict = {
         "scenario": name,
@@ -164,11 +184,44 @@ def run_scenario(
         "overflow_ticks": eng_s.overflow_ticks,
         "scan_overflow_reruns": eng_c.scan_overflow_reruns,
         "routing": routing,
+        "outcomes": outcomes,
         "checks": checks,
     }
     get_event_log().emit("scenario_run", **verdict)
     verdict["signal_set"] = signal_set  # not in the event: corpus pinning
     return verdict
+
+
+def _outcome_columns(engine) -> dict:
+    """Per-scenario outcome summary for the verdict/report: matured-pair
+    count plus hit-rate and average MAE/MFE folded over every strategy at
+    the LARGEST matured horizon (the scripted aftermath's signature —
+    flash-crash entries show deep MAE, pump-frenzy entries fat MFE)."""
+    board = engine.outcomes.scoreboard()
+    best_h = None
+    for by_h in board["per_strategy"].values():
+        for h in by_h:
+            best_h = max(best_h or 0, int(h))
+    if best_h is None:
+        return {"matured": 0}
+    n = hits = 0
+    sum_mae = sum_mfe = 0.0
+    for by_h in board["per_strategy"].values():
+        cell = by_h.get(str(best_h))
+        if not cell or not cell["n"]:
+            continue
+        n += cell["n"]
+        hits += cell["hits"]
+        sum_mae += cell["avg_mae"] * cell["n"]
+        sum_mfe += cell["avg_mfe"] * cell["n"]
+    return {
+        "matured": board["matured"],
+        "horizon": best_h,
+        "n": n,
+        "hit_rate": round(hits / n, 3) if n else None,
+        "avg_mae": round(sum_mae / n, 5) if n else None,
+        "avg_mfe": round(sum_mfe / n, 5) if n else None,
+    }
 
 
 def load_pinned(path: str | Path = PINNED_FIXTURE) -> dict | None:
@@ -262,6 +315,17 @@ def render_verdict(event: dict) -> str:
         f"  overflow {event.get('overflow_ticks', 0):>2}"
         f"  routing {routing or '-'}"
     )
+    # per-family outcome columns (ISSUE 12) — appended only when the run
+    # matured anything, so pre-observatory events render byte-identically
+    outcomes = event.get("outcomes") or {}
+    if outcomes.get("matured") and outcomes.get("n"):
+        line += (
+            f"  outcomes h{outcomes['horizon']}"
+            f" n {outcomes['n']}"
+            f" hit {outcomes['hit_rate']:.3f}"
+            f" mae {outcomes['avg_mae']:+.5f}"
+            f" mfe {outcomes['avg_mfe']:+.5f}"
+        )
     if failed:
         line += f"\n  failed: {', '.join(failed)}"
     return line

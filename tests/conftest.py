@@ -58,6 +58,13 @@ os.environ.setdefault("BQT_DRIFT_METER", "0")
 # coverage opts in explicitly (tests/test_latency.py).
 os.environ.setdefault("BQT_FRESHNESS", "0")
 os.environ.setdefault("BQT_HOST_PHASE", "0")
+# Signal-outcome observatory (ISSUE 12) defaults OFF for the tier-1 lane,
+# the same knob pattern: dozens of stub engines must not each pay the
+# open-registry bookkeeping + a maturation-kernel compile, and several
+# fixtures pin pre-observatory /healthz and host-carries shapes only
+# additively. Production default stays ON (binquant_tpu/config.py); the
+# outcome coverage opts in explicitly (tests/test_outcomes.py).
+os.environ.setdefault("BQT_OUTCOMES", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

@@ -1,0 +1,421 @@
+"""Signal-outcome observatory (ISSUE 12).
+
+Covers: the maturation gather's math (LONG/SHORT sign convention, missing
+bars, padding slots), registry bounds (cap + eviction), the three-drive
+matured-set parity pin (serial / scanned / backtest — the acceptance
+criterion), checkpoint round-trip of the open-signal registry (kill
+mid-horizon, restore, resumed drive matures the oracle's set),
+``signal_outcome`` event joinability to ``signal`` events, the /healthz
+scoreboard section, the sweep's economic scoring, and the
+tools/outcome_report.py golden.
+
+Engine shapes are shared across the module (capacity 8, window 160) so
+the jit cache amortizes; the stream is ``generate_outcome_replay`` —
+MID-stream MeanReversionFade hammers with scripted aftermaths, the one
+generator whose signals actually mature before EOF.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import outcome_report  # noqa: E402
+
+from binquant_tpu.engine.buffer import NUM_FIELDS, Field  # noqa: E402
+from binquant_tpu.obs.outcomes import (  # noqa: E402
+    OutcomeTracker,
+    direction_sign,
+    outcome_gather,
+    signed_outcome,
+)
+
+CAP, WIN = 8, 160
+HORIZONS = (1, 4, 16)
+ENABLED = {"mean_reversion_fade"}
+FIRE_TICKS = (104, 110)
+N_TICKS = 128
+
+
+# -- fixtures -----------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stream(tmp_path_factory):
+    from binquant_tpu.io.replay import generate_outcome_replay
+
+    path = tmp_path_factory.mktemp("outcomes") / "stream.jsonl"
+    generate_outcome_replay(
+        path, n_symbols=CAP, n_ticks=N_TICKS, fire_ticks=FIRE_TICKS
+    )
+    return path
+
+
+@pytest.fixture(scope="module")
+def oracle(stream):
+    """The uninterrupted serial drive: signals + matured outcome set."""
+    from binquant_tpu.io.replay import run_replay
+
+    signals: list = []
+    outcomes: list = []
+    stats = run_replay(
+        stream,
+        capacity=CAP,
+        window=WIN,
+        enabled_strategies=ENABLED,
+        incremental=True,
+        donate=False,
+        collect=signals,
+        outcomes=True,
+        outcome_horizons=HORIZONS,
+        collect_outcomes=outcomes,
+    )
+    return {"signals": signals, "outcomes": outcomes, "stats": stats}
+
+
+# -- kernel + tracker units ---------------------------------------------------
+
+
+def _ring(closes, t0=300_000, step=300, n_rows=2):
+    W = len(closes)
+    times = np.full((n_rows, W), -1, np.int32)
+    vals = np.full((n_rows, W, NUM_FIELDS), np.nan, np.float32)
+    for k, c in enumerate(closes):
+        times[0, k] = t0 + k * step
+        vals[0, k, Field.CLOSE] = c
+        vals[0, k, Field.HIGH] = c * 1.01
+        vals[0, k, Field.LOW] = c * 0.99
+    return times, vals, t0
+
+
+def test_gather_kernel_math():
+    closes = [100, 101, 102, 103, 104, 105, 104, 103, 102, 101]
+    times, vals, t0 = _ring(closes)
+    entry_ts = t0 + 2 * 300
+    rows = np.array([0, 0, -1, -1], np.int32)
+    entry = np.array([entry_ts, entry_ts, 0, 0], np.int32)
+    horizon = np.array([entry_ts + 300, entry_ts + 4 * 300, 0, 0], np.int32)
+    floats, ints = outcome_gather(times, vals, rows, entry, horizon)
+    assert floats[0, 0] == closes[2]  # entry close (the anchored bar)
+    assert floats[1, 0] == closes[3]  # h=1 forward close
+    assert floats[1, 1] == closes[6]  # h=4 forward close
+    assert ints[0, 1] == 4  # bars inside (entry, entry+4]
+    assert np.isclose(floats[3, 1], max(closes[3:7]) * 1.01)  # window high
+    assert np.isclose(floats[2, 1], min(closes[3:7]) * 0.99)  # window low
+    # padding slots stay NaN / empty
+    assert np.isnan(floats[0, 2]) and ints[0, 2] == 0
+    # oldest retained bar is exact int32 (truncation judge)
+    assert ints[1, 0] == t0
+
+
+def test_gather_missing_horizon_bar_uses_last_available():
+    """A gap at the exact horizon bar falls back to the latest bar inside
+    the window (deterministic across drives — the contract the parity pin
+    relies on)."""
+    closes = [100, 101, 102, 103, 104, 105]
+    times, vals, t0 = _ring(closes)
+    times[0, 4] = -1  # kill the bar at entry+2
+    rows = np.array([0] * 8, np.int32)
+    entry = np.full(8, t0, np.int32)
+    horizon = np.full(8, t0 + 4 * 300, np.int32)
+    floats, _ = outcome_gather(times, vals, rows, entry, horizon)
+    # bars 1,2,3 live; 4 killed → forward close is bar 3's
+    assert floats[1, 0] == closes[3]
+
+
+def test_signed_outcome_convention():
+    # LONG: fwd follows price, mae from the low, mfe from the high
+    fwd, mae, mfe = signed_outcome(1, 100.0, 103.0, 99.0, 104.0)
+    assert fwd == pytest.approx(0.03)
+    assert mae == pytest.approx(-0.01)
+    assert mfe == pytest.approx(0.04)
+    # SHORT mirrors: adverse is the high, favorable the low
+    fwd, mae, mfe = signed_outcome(-1, 100.0, 103.0, 99.0, 104.0)
+    assert fwd == pytest.approx(-0.03)
+    assert mae == pytest.approx(-0.04)
+    assert mfe == pytest.approx(0.01)
+    # mae <= 0 <= mfe always
+    assert signed_outcome(1, 100.0, 101.0, 100.5, 102.0)[1] == 0.0
+    # unusable raw gathers → None
+    assert signed_outcome(1, float("nan"), 1.0, 1.0, 1.0) is None
+    assert signed_outcome(1, 0.0, 1.0, 1.0, 1.0) is None
+    assert direction_sign("SHORT") == -1
+    assert direction_sign("LONG") == 1
+    assert direction_sign("grid") == 1
+
+
+def test_tracker_cap_eviction_and_restore():
+    tr = OutcomeTracker(enabled=True, horizons=(1, 4), cap=2)
+    for i, sym in enumerate(("A", "B", "C")):
+        tr.register("s", sym, 0, 300_000, "LONG", tick_ms=i)
+    assert tr.evictions == 1
+    assert [s["symbol"] for s in tr._open] == ["B", "C"]
+    # snapshot → restore round-trips the open registry (JSON-safe)
+    blob = json.loads(json.dumps(tr.snapshot_open()))
+    tr2 = OutcomeTracker(enabled=True, horizons=(1, 4), cap=4)
+    tr2.restore_open(blob)
+    assert [s["symbol"] for s in tr2._open] == ["B", "C"]
+    assert tr2._open[0]["pending"] == [1, 4]
+
+
+def test_tracker_matures_and_scoreboard():
+    closes = [100, 101, 102, 103, 104, 105, 104, 103, 102, 101]
+    times, vals, t0 = _ring(closes)
+
+    class Buf:
+        pass
+
+    buf = Buf()
+    buf.times, buf.values = times, vals
+    tr = OutcomeTracker(enabled=True, horizons=(1, 4), cap=8)
+    tr.register("s", "LONGY", 0, t0 + 2 * 300, "LONG", tick_ms=1)
+    tr.register("s", "SHORTY", 0, t0 + 2 * 300, "SHORT", tick_ms=2)
+    # nothing due yet at the entry tick
+    assert tr.on_tick(t0 + 2 * 300, buf) == []
+    matured = tr.on_tick(t0 + 6 * 300, buf)
+    assert len(matured) == 4 and not tr._open
+    board = tr.scoreboard()
+    assert board["matured"] == 4 and board["truncated"] == 0
+    cell = board["per_strategy"]["s"]["4"]
+    assert cell["n"] == 2 and cell["hit_rate"] == 0.5
+    # LONG and SHORT of the same move cancel in signed-return space
+    assert cell["avg_fwd"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_tracker_truncation_detected():
+    """A window whose entry bar was evicted from the ring must mature as
+    truncated, not silently compute on partial history."""
+    closes = [100, 101, 102, 103]
+    times, vals, t0 = _ring(closes)
+
+    class Buf:
+        pass
+
+    buf = Buf()
+    buf.times, buf.values = times, vals
+    tr = OutcomeTracker(enabled=True, horizons=(1,), cap=8)
+    # entry anchored BEFORE the ring's oldest retained bar
+    tr.register("s", "OLD", 0, t0 - 300, "LONG", tick_ms=1)
+    tr.on_tick(t0 + 3 * 300, buf)
+    assert tr.truncated == 1 and tr.matured == 1
+    assert not tr.matured_set()  # truncated pairs stay off the scoreboard
+
+
+# -- the acceptance pin: three drives, one matured set ------------------------
+
+
+def test_three_drive_outcome_parity(stream, oracle):
+    """Serial, scanned, and backtest drives report the IDENTICAL matured
+    outcome set on a replayed stream (ISSUE 12 acceptance)."""
+    from binquant_tpu.backtest.driver import run_backtest
+    from binquant_tpu.io.replay import run_replay
+
+    assert oracle["stats"]["signals"] >= 2
+    assert len(oracle["outcomes"]) >= len(HORIZONS) * 2
+
+    scanned: list = []
+    s2 = run_replay(
+        stream,
+        capacity=CAP,
+        window=WIN,
+        enabled_strategies=ENABLED,
+        incremental=True,
+        donate=False,
+        scanned=True,
+        scan_chunk=16,
+        outcomes=True,
+        outcome_horizons=HORIZONS,
+        collect_outcomes=scanned,
+    )
+    assert s2["scanned_ticks"] > 0  # the fused path actually engaged
+    assert scanned == oracle["outcomes"]
+
+    backtest: list = []
+    s3 = run_backtest(
+        stream,
+        capacity=CAP,
+        window=WIN,
+        enabled_strategies=ENABLED,
+        outcomes=True,
+        outcome_horizons=HORIZONS,
+        collect_outcomes=backtest,
+    )
+    assert s3["backtest_ticks"] > 0
+    assert backtest == oracle["outcomes"]
+    # the scripted aftermaths are distinctive: the recovery symbol's h=16
+    # return beats the continued-bleed symbol's
+    by_sym = {}
+    for strategy, sym, _entry, h, fwd, _mae, _mfe, _bars in oracle["outcomes"]:
+        if h == 16:
+            by_sym.setdefault(sym, []).append(fwd)
+    if {"S005USDT", "S006USDT"} <= set(by_sym):
+        assert max(by_sym["S005USDT"]) > max(by_sym["S006USDT"])
+
+
+# -- checkpoint round-trip of the open-signal registry ------------------------
+
+
+def _drive_serial(engine, seq) -> None:
+    async def go():
+        for now_ms, klines in seq:
+            for k in klines:
+                engine.ingest(k)
+            await engine.process_tick(now_ms=now_ms)
+        await engine.flush_pending()
+
+    asyncio.run(go())
+
+
+def test_checkpoint_roundtrip_mid_horizon(stream, oracle, tmp_path):
+    """Kill mid-horizon, restore, and the resumed drive matures the same
+    signal_outcome set as the uninterrupted oracle (ISSUE 12 satellite)."""
+    from binquant_tpu.io.checkpoint import CheckpointManager, save_state
+    from binquant_tpu.io.replay import make_stub_engine, tick_seq
+
+    seq = tick_seq(stream)
+    # cut AFTER the first fire with horizons still pending, BEFORE the
+    # second fire — the open registry must carry both facts across
+    cut = FIRE_TICKS[0] + 3
+    assert cut < FIRE_TICKS[1]
+
+    kw = dict(
+        capacity=CAP,
+        window=WIN,
+        enabled_strategies=ENABLED,
+        incremental=True,
+        donate=False,
+        outcomes=True,
+        outcome_horizons=HORIZONS,
+    )
+    a = make_stub_engine(**kw)
+    _drive_serial(a, seq[:cut])
+    assert a.outcomes._open, "cut must land mid-horizon (open slots)"
+    ckpt = tmp_path / "engine.ckpt.npz"
+    save_state(ckpt, a.state, a.registry, host_carries=a.host_carries())
+
+    b = make_stub_engine(**kw)
+    assert CheckpointManager(ckpt).try_restore(b)
+    assert [s["symbol"] for s in b.outcomes.snapshot_open()] == [
+        s["symbol"] for s in a.outcomes.snapshot_open()
+    ]
+    _drive_serial(b, seq[cut:])
+
+    combined = sorted(a.outcomes.matured_set() | b.outcomes.matured_set())
+    assert combined == oracle["outcomes"]
+    assert b.outcomes.matured_set(), "post-restore drive matured something"
+
+
+# -- events / healthz / report surfaces ---------------------------------------
+
+
+def test_events_healthz_and_report(stream, oracle, tmp_path):
+    """signal_outcome events join signal events by trace_id/tick_seq, the
+    /healthz snapshot carries the scoreboard, and outcome_report renders
+    the captured log."""
+    from binquant_tpu.io.replay import make_stub_engine, tick_seq
+    from binquant_tpu.obs.events import EventLog, set_event_log
+
+    log_path = tmp_path / "events.jsonl"
+    set_event_log(EventLog(log_path))
+    try:
+        engine = make_stub_engine(
+            capacity=CAP,
+            window=WIN,
+            enabled_strategies=ENABLED,
+            incremental=True,
+            donate=False,
+            outcomes=True,
+            outcome_horizons=HORIZONS,
+            trace_sample=1.0,
+        )
+        _drive_serial(engine, tick_seq(stream))
+        board = engine.health_snapshot()["outcomes"]
+        assert board["enabled"] and board["matured"] == len(oracle["outcomes"])
+        assert "mean_reversion_fade" in board["per_strategy"]
+        snap = engine._flight_snapshot()
+        assert "outcomes_open" in snap and "outcome_evictions" in snap
+    finally:
+        set_event_log(None)
+
+    events = [
+        json.loads(line)
+        for line in log_path.read_text().splitlines()
+        if line.strip()
+    ]
+    signals = {
+        (e["trace_id"], e["tick_seq"])
+        for e in events
+        if e.get("event") == "signal"
+    }
+    outcomes = [e for e in events if e.get("event") == "signal_outcome"]
+    assert len(outcomes) == len(oracle["outcomes"])
+    for e in outcomes:
+        assert e["trace_id"] is not None
+        assert (e["trace_id"], e["tick_seq"]) in signals  # the join key
+        assert {"strategy", "symbol", "horizon", "fwd_ret", "mae", "mfe"} <= (
+            set(e)
+        )
+
+    # the scoreboard CLI renders the same log (exit 0, table present)
+    assert outcome_report.main([str(log_path)]) == 0
+
+
+def test_outcome_report_golden(capsys):
+    """tools/outcome_report.py renders a deterministic scoreboard table
+    (pinned — keep format changes deliberate)."""
+    events = [
+        {"event": "signal_outcome", "strategy": "mean_reversion_fade",
+         "horizon": 4, "fwd_ret": 0.012, "mae": -0.004, "mfe": 0.02},
+        {"event": "signal_outcome", "strategy": "mean_reversion_fade",
+         "horizon": 4, "fwd_ret": -0.008, "mae": -0.016, "mfe": 0.002},
+        {"event": "signal_outcome", "strategy": "activity_burst_pump",
+         "horizon": 1, "fwd_ret": 0.004, "mae": 0.0, "mfe": 0.006},
+        {"event": "signal_outcome", "strategy": "activity_burst_pump",
+         "horizon": 1, "truncated": True},
+    ]
+    expected = (
+        "signal-outcome scoreboard: 3 matured pairs (1 truncated)\n"
+        "strategy                        h     n   hit% "
+        "  avg_fwd   avg_mae   avg_mfe  worst_mae\n"
+        "activity_burst_pump             1     1 100.0% "
+        "  +0.0040   +0.0000   +0.0060    +0.0000\n"
+        "mean_reversion_fade             4     2  50.0% "
+        "  +0.0020   -0.0100   +0.0110    -0.0160"
+    )
+    assert outcome_report.render_report(events) == expected
+
+
+# -- sweep economic scoring ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sweep_scores_outcomes(stream):
+    """run_param_sweep scores combos on forward returns / hit-rate / MAE
+    (the ROADMAP-4 economic proxy), not just trigger counts."""
+    from binquant_tpu.backtest import run_param_sweep
+
+    res = run_param_sweep(
+        stream,
+        axes={"mrf.rsi_long_max": [15.0, 35.0]},
+        capacity=CAP,
+        window=WIN,
+        chunk=32,
+        horizons=HORIZONS,
+    )
+    out = res["outcomes"]
+    assert out["matured_pairs"] > 0
+    assert out["horizons"] == sorted(HORIZONS)
+    assert len(out["per_combo"]) == res["P"]
+    assert len(out["ranking_by_return"]) == res["P"]
+    assert sorted(out["ranking_by_return"]) == list(range(res["P"]))
+    scored = [c for c in out["combo_score"] if c["n"]]
+    assert scored, "at least one combo matured outcomes"
+    for c in scored:
+        assert c["hit_rate"] is not None and c["avg_mae"] <= 0.0

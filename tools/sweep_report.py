@@ -1,15 +1,19 @@
-"""Strategy-parameter sweep report (ISSUE 6 satellite).
+"""Strategy-parameter sweep report (ISSUE 6 satellite, economic scoring
+columns since ISSUE 12).
 
 Runs ``binquant_tpu.backtest.run_param_sweep`` over a kline stream and
-prints a per-combo table of signal fire counts — the human surface of the
-vmapped grid backend. One dispatch per chunk scores EVERY combo.
+prints a per-combo table of signal fire counts PLUS the outcome columns
+(hit-rate / avg signed forward return / avg MAE at the scoring horizon) —
+the ROADMAP-4 economic proxies. One dispatch per chunk scores EVERY combo;
+outcomes mature through the same kernel the live tracker uses.
 
 Usage::
 
     python tools/sweep_report.py STREAM.jsonl \
         --axis pt.rsi_oversold=20,30,40 \
         --axis mrf.rsi_long_max=15,25,35 \
-        [--capacity 64] [--window 200] [--chunk 32] [--top 10] [--json OUT]
+        [--capacity 64] [--window 200] [--chunk 32] [--top 10] [--json OUT] \
+        [--horizons 1,4,16] [--rank-by return|fires]
 
     python tools/sweep_report.py --demo   # synthesize a stream + default grid
 
@@ -59,6 +63,15 @@ def main() -> int:
     )
     parser.add_argument("--json", help="also dump the full result as JSON")
     parser.add_argument(
+        "--horizons", default="1,4,16,96",
+        help="outcome maturation horizons in 5m bars (comma-separated)",
+    )
+    parser.add_argument(
+        "--rank-by", choices=("return", "fires"), default="return",
+        help="rank combos by total signed forward return at the scoring "
+        "horizon (the economic proxy) or by raw fire counts",
+    )
+    parser.add_argument(
         "--list-axes", action="store_true",
         help="print the sweepable axis names and exit",
     )
@@ -79,11 +92,13 @@ def main() -> int:
     if args.demo:
         import tempfile
 
-        from binquant_tpu.io.replay import generate_replay_file
+        from binquant_tpu.io.replay import generate_outcome_replay
 
         td = tempfile.mkdtemp(prefix="bqt_sweep_")
         args.stream = f"{td}/demo.jsonl"
-        generate_replay_file(args.stream, n_symbols=24, n_ticks=112)
+        # mid-stream fires (unlike generate_replay_file's last-tick
+        # setups) so the demo's outcome columns actually mature
+        generate_outcome_replay(args.stream, n_symbols=24, n_ticks=128)
         args.capacity, args.window = 32, 160
         axes = axes or {
             "pt.rsi_oversold": [15.0, 30.0, 45.0, 60.0],
@@ -95,12 +110,16 @@ def main() -> int:
 
     from binquant_tpu.backtest import run_param_sweep
 
+    horizons = tuple(
+        int(v) for v in str(args.horizons).split(",") if v.strip()
+    )
     res = run_param_sweep(
         args.stream,
         axes=axes,
         capacity=args.capacity,
         window=args.window,
         chunk=args.chunk,
+        horizons=horizons or (1, 4, 16, 96),
     )
 
     strategies = res["strategies"]
@@ -109,22 +128,55 @@ def main() -> int:
         if any(res["trig_counts"][p][i] for p in range(res["P"]))
     ]
     axis_names = list(axes)
+    outcomes = res.get("outcomes") or {}
+    scored = bool(outcomes.get("enabled"))
     print(
         f"sweep: P={res['P']} combos x {res['evaluated_ticks']} ticks "
         f"({res['candles']} candles) in {res['dispatches']} dispatches, "
         f"{res['wall_s']}s "
         f"({res['combo_candles_per_sec']} combo-candles/s)"
     )
+    if scored:
+        H = outcomes["score_horizon"]
+        print(
+            f"outcomes: {outcomes['matured_pairs']} matured pairs "
+            f"({outcomes['truncated']} truncated, "
+            f"{outcomes['unmatured_pair_horizons']} unmatured horizons), "
+            f"scored at h={H} bars of 5m; ranked by {args.rank_by}"
+        )
+    else:
+        print("outcomes: scoring disabled (no positive horizons); "
+              "ranked by fires")
+    ranking = (
+        outcomes["ranking_by_return"]
+        if scored and args.rank_by == "return"
+        else res["ranking"]
+    )
+
+    def _fmt(v, pct=False):
+        if v is None:
+            return "-"
+        return f"{v * 100:.1f}%" if pct else f"{v:+.4f}"
+
+    score_cols = [f"hit@{H}", f"fwd@{H}", f"mae@{H}"] if scored else []
     header = (
-        ["#", "total"]
+        ["#", "total", *score_cols]
         + [strategies[i] for i in live_cols]
         + axis_names
     )
     rows = []
-    for rank, p in enumerate(res["ranking"][: args.top]):
+    for rank, p in enumerate(ranking[: args.top]):
         combo = res["combos"][p]
+        score_cells = []
+        if scored:
+            score = outcomes["combo_score"][p]
+            score_cells = [
+                _fmt(score["hit_rate"], pct=True),
+                _fmt(score["avg_fwd"]),
+                _fmt(score["avg_mae"]),
+            ]
         rows.append(
-            [str(rank + 1), str(res["total_fired"][p])]
+            [str(rank + 1), str(res["total_fired"][p]), *score_cells]
             + [str(res["trig_counts"][p][i]) for i in live_cols]
             + [f"{combo[name]:g}" for name in axis_names]
         )

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -87,6 +87,18 @@ help:
 	@echo "               acceptance number (<5% wire-step bytes) is"
 	@echo "               'python bench.py --outcome-cost' (writes"
 	@echo "               BENCH_OUTCOMES_CPU.json)"
+	@echo "  delivery-smoke- durable delivery plane lane (ISSUE 13): the"
+	@echo "               pytest drills (WAL put/ack/compaction + torn-line"
+	@echo "               tolerance, breaker state machine, plane retry/"
+	@echo "               shed/deferral semantics, WAL replay across a hard"
+	@echo "               kill, bounded binbot client, golden report; slow"
+	@echo "               adds the full chaos drill), then the standalone"
+	@echo "               kill/restore drill with the event log on —"
+	@echo "               scripted autotrade 5xx/timeout storm, breaker"
+	@echo "               open>half_open>closed cycle, analytics queue-"
+	@echo "               saturation burst, ZERO autotrade loss and ZERO"
+	@echo "               duplicates past the (trace_id, tick_seq) dedupe"
+	@echo "               key — rendered by tools/delivery_report.py"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run; gated"
 	@echo "               to ONE shard-compatible executable by default"
 	@echo "               (BQT_DRYRUN_PHASES=tick_step — the three-"
@@ -234,6 +246,23 @@ outcome-smoke:
 	BQT_EVENT_LOG=/tmp/bqt_outcome_events.jsonl JAX_PLATFORMS=cpu \
 	python main.py --replay /tmp/replay_outcomes.jsonl --scanned
 	python tools/outcome_report.py /tmp/bqt_outcome_events.jsonl
+
+# The durable-delivery lane (ISSUE 13): tier-1 keeps the cheap units;
+# this target adds the slow chaos drill (kill mid-storm with unacked WAL
+# entries, restore, at-least-once equality) and then re-runs the drill
+# standalone with the event log on so the report renders the breaker/
+# shed/replay story. The /healthz `delivery` section and the
+# bqt_delivery_* families are live in any BQT_DELIVERY=1 run.
+delivery-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_delivery.py -q \
+		-p no:cacheprovider
+	rm -f /tmp/bqt_delivery_events.jsonl
+	BQT_EVENT_LOG=/tmp/bqt_delivery_events.jsonl JAX_PLATFORMS=cpu \
+	python -c "from binquant_tpu.sim.chaos import delivery_chaos_drill; \
+	facts = delivery_chaos_drill(); \
+	print({k: v for k, v in facts.items() if k != 'checks'}); \
+	assert facts['ok'], facts['checks']"
+	python tools/delivery_report.py /tmp/bqt_delivery_events.jsonl
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -61,6 +61,7 @@ def _seed_engine(num_symbols: int, window: int, depth: int,
         capacity=num_symbols, window=window, pipeline_depth=depth,
         incremental=incremental,
         donate=False if incremental is False else None,
+        delivery=False,
     )
     names = ["BTCUSDT"] + [f"S{i:04d}USDT" for i in range(1, num_symbols)]
     rows_all = engine.registry.rows_for(names)

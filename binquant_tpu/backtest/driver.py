@@ -515,6 +515,9 @@ def run_backtest(
         donate=False,
         outcomes=outcomes,
         outcome_horizons=outcome_horizons,
+        # inline sinks: the backtest lane pins sink-visible effects
+        # synchronously; the delivery plane has its own lane
+        delivery=False,
     )
     engine.at_consumer.market_domination_reversal = market_domination_reversal
     engine.at_consumer.current_market_dominance_is_losers = dominance_is_losers
@@ -851,6 +854,7 @@ def run_param_sweep(
         context_config=context_config,
         incremental=False,
         donate=False,
+        delivery=False,
     )
     key = engine._wire_enabled_key()
     _check_supported(key, window)

@@ -382,3 +382,95 @@ class Config:
     @cached_property
     def checkpoint_every_ticks(self) -> int:
         return int(self._get("BQT_CHECKPOINT_EVERY_TICKS", "60"))
+
+    # -- durable delivery plane (io/delivery.py, ISSUE 13) -------------------
+
+    @cached_property
+    def delivery_enabled(self) -> bool:
+        """Durable signal delivery plane: finalize enqueues into per-sink
+        bounded outbox queues (workers own retries/backoff/breakers;
+        autotrade is WAL-durable at-least-once across a process kill).
+        BQT_DELIVERY=0 restores the inline fire-and-forget sink dispatch
+        (the tier-1 test lane's default — the BQT_TRACE_SAMPLE pattern)."""
+        return self._get("BQT_DELIVERY", "1") != "0"
+
+    @cached_property
+    def delivery_wal_path(self) -> str:
+        """Append-only JSONL write-ahead log backing the at-least-once
+        sink class; empty disables durability (the plane still bounds and
+        meters, but a kill loses unacked entries). The /tmp default (the
+        heartbeat/checkpoint convention) survives a process kill but NOT
+        a host reboot on tmpfs-backed /tmp, and is per-host shared —
+        production deployments should point this at persistent,
+        per-instance storage."""
+        return self._get("BQT_DELIVERY_WAL", "/tmp/binquant_tpu.wal.jsonl")
+
+    @cached_property
+    def delivery_queue_max(self) -> int:
+        """Per-sink outbox bound. A full lossy queue sheds (counted); a
+        full at-least-once queue defers to the WAL — bounded memory
+        either way."""
+        return int(self._get("BQT_DELIVERY_QUEUE", "512") or "512")
+
+    @cached_property
+    def delivery_attempt_timeout_s(self) -> float:
+        """Deadline per delivery attempt: a sink call past this is a
+        failure (counted, retried/shed per policy) — no sink can wedge
+        its worker."""
+        return float(self._get("BQT_DELIVERY_TIMEOUT", "5") or "5")
+
+    @cached_property
+    def delivery_retry_max(self) -> int:
+        """Attempt budget per LOSSY-class entry (telegram/analytics);
+        exhausted → shed with reason=retries_exhausted. The at-least-once
+        class retries without bound (the WAL holds the entry)."""
+        return int(self._get("BQT_DELIVERY_RETRY_MAX", "3") or "3")
+
+    @cached_property
+    def delivery_backoff_s(self) -> float:
+        """Initial retry backoff (exponential, ±jittered — the websocket
+        reconnect_delay idiom)."""
+        return float(self._get("BQT_DELIVERY_BACKOFF", "0.25") or "0.25")
+
+    @cached_property
+    def delivery_backoff_max_s(self) -> float:
+        return float(self._get("BQT_DELIVERY_BACKOFF_MAX", "30") or "30")
+
+    @cached_property
+    def delivery_breaker_threshold(self) -> int:
+        """Consecutive failures that OPEN a sink's circuit breaker (open
+        sheds lossy entries immediately and parks at-least-once entries
+        on the WAL until the half-open probe succeeds)."""
+        return int(self._get("BQT_DELIVERY_BREAKER_FAILS", "5") or "5")
+
+    @cached_property
+    def delivery_breaker_cooldown_s(self) -> float:
+        """Open-state dwell before the breaker admits ONE half-open
+        probe."""
+        return float(self._get("BQT_DELIVERY_BREAKER_COOLDOWN", "30") or "30")
+
+    @cached_property
+    def wal_compact_every(self) -> int:
+        """Ack records between WAL compactions (atomic rewrite keeping
+        only unacked puts); 0 disables auto-compaction."""
+        return int(self._get("BQT_WAL_COMPACT_EVERY", "256") or "256")
+
+    # -- binbot REST bounds (io/binbot.py satellite) -------------------------
+
+    @cached_property
+    def binbot_timeout_s(self) -> float:
+        """Per-request deadline for every binbot REST call (the client
+        default; pre-plane POSTs had whatever httpx defaulted to)."""
+        return float(self._get("BQT_BINBOT_TIMEOUT", "10") or "10")
+
+    @cached_property
+    def binbot_retry_max(self) -> int:
+        """In-client retries per binbot call after a transport error or
+        5xx, jitter-backed; exhaustion surfaces as a counted
+        bqt_binbot_retries_total{outcome=exhausted} + event, then the
+        error propagates (fire-and-forget callers still swallow it)."""
+        return int(self._get("BQT_BINBOT_RETRIES", "2") or "2")
+
+    @cached_property
+    def binbot_retry_backoff_s(self) -> float:
+        return float(self._get("BQT_BINBOT_RETRY_BACKOFF", "0.2") or "0.2")

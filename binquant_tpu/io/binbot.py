@@ -11,10 +11,12 @@ the same at this class.
 from __future__ import annotations
 
 import logging
+import random
+import time
 from typing import Any
 
 from binquant_tpu.exceptions import BinbotError
-from binquant_tpu.obs.instruments import BINBOT_REQUESTS
+from binquant_tpu.obs.instruments import BINBOT_REQUESTS, BINBOT_RETRIES
 from binquant_tpu.schemas import (
     AutotradeSettingsSchema,
     MarketBreadthSeries,
@@ -28,13 +30,32 @@ class BinbotApi:
     (consumers/klines_provider.py, consumers/autotrade_consumer.py,
     shared/autotrade.py)."""
 
-    def __init__(self, base_url: str, session: Any | None = None) -> None:
+    def __init__(
+        self,
+        base_url: str,
+        session: Any | None = None,
+        timeout_s: float = 10.0,
+        retry_max: int = 0,
+        retry_backoff_s: float = 0.2,
+        rng: random.Random | None = None,
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         if session is None:
             import httpx
 
-            session = httpx.Client(timeout=10)
+            session = httpx.Client(timeout=timeout_s)
         self.session = session
+        # bounded REST calls (ISSUE 13 satellite): every request carries a
+        # deadline (the client timeout above) and up to ``retry_max``
+        # in-client retries after a transport error or 5xx, with jittered
+        # exponential backoff. Exhaustion is COUNTED (metric + event) and
+        # the error then propagates as before — never a silent hang, and
+        # no crash-ring entry on the emission path (the span records the
+        # error without flagging the trace).
+        self.timeout_s = float(timeout_s)
+        self.retry_max = max(int(retry_max), 0)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._rng = rng or random.Random()
 
     # -- plumbing -----------------------------------------------------------
 
@@ -61,21 +82,78 @@ class BinbotApi:
             return payload
 
     def _request_inner(self, method: str, path: str, **kwargs) -> Any:
+        """One bounded round trip: transport errors and 5xx responses are
+        retried up to ``retry_max`` times with jittered exponential
+        backoff (4xx and backend-error bodies are NOT — they are
+        deterministic rejections, not weather). Exhaustion counts in
+        bqt_binbot_retries_total{outcome=exhausted} and emits a
+        binbot_retry_exhausted event before the final error propagates."""
         url = f"{self.base_url}{path}"
-        try:
-            resp = self.session.request(method, url, **kwargs)
-        except Exception:
-            BINBOT_REQUESTS.labels(method=method, outcome="transport_error").inc()
-            raise
-        if resp.status_code >= 400:
-            BINBOT_REQUESTS.labels(method=method, outcome="http_error").inc()
-            raise BinbotError(f"{method} {path} -> {resp.status_code}: {resp.text}")
-        payload = resp.json()
-        if isinstance(payload, dict) and payload.get("error") == 1:
-            BINBOT_REQUESTS.labels(method=method, outcome="backend_error").inc()
-            raise BinbotError(str(payload.get("message", "unknown binbot error")))
-        BINBOT_REQUESTS.labels(method=method, outcome="ok").inc()
-        return payload
+        attempts = self.retry_max + 1
+        backoff = self.retry_backoff_s
+        for attempt in range(attempts):
+            retryable: str | None = None
+            try:
+                resp = self.session.request(method, url, **kwargs)
+            except Exception:
+                BINBOT_REQUESTS.labels(
+                    method=method, outcome="transport_error"
+                ).inc()
+                retryable = "transport_error"
+                if attempt + 1 >= attempts:
+                    if self.retry_max:
+                        self._note_exhausted(method, path, retryable)
+                    raise
+            else:
+                if resp.status_code >= 500:
+                    BINBOT_REQUESTS.labels(
+                        method=method, outcome="http_error"
+                    ).inc()
+                    retryable = f"http_{resp.status_code}"
+                    if attempt + 1 >= attempts:
+                        if self.retry_max:
+                            self._note_exhausted(method, path, retryable)
+                        raise BinbotError(
+                            f"{method} {path} -> {resp.status_code}: {resp.text}"
+                        )
+                elif resp.status_code >= 400:
+                    BINBOT_REQUESTS.labels(
+                        method=method, outcome="http_error"
+                    ).inc()
+                    raise BinbotError(
+                        f"{method} {path} -> {resp.status_code}: {resp.text}"
+                    )
+                else:
+                    payload = resp.json()
+                    if isinstance(payload, dict) and payload.get("error") == 1:
+                        BINBOT_REQUESTS.labels(
+                            method=method, outcome="backend_error"
+                        ).inc()
+                        raise BinbotError(
+                            str(payload.get("message", "unknown binbot error"))
+                        )
+                    BINBOT_REQUESTS.labels(method=method, outcome="ok").inc()
+                    return payload
+            # jittered backoff before the retry (websocket reconnect_delay
+            # idiom — a fleet of clients must not re-storm the backend)
+            from binquant_tpu.io.websocket import reconnect_delay
+
+            BINBOT_RETRIES.labels(outcome="retry").inc()
+            time.sleep(reconnect_delay(backoff, self._rng))
+            backoff *= 2.0
+        raise BinbotError(f"{method} {path}: retry loop exited")  # unreachable
+
+    def _note_exhausted(self, method: str, path: str, reason: str) -> None:
+        from binquant_tpu.obs.events import get_event_log
+
+        BINBOT_RETRIES.labels(outcome="exhausted").inc()
+        get_event_log().emit(
+            "binbot_retry_exhausted",
+            method=method,
+            path=path,
+            reason=reason,
+            retries=self.retry_max,
+        )
 
     def _get(self, path: str, **kwargs) -> Any:
         return self._request("GET", path, **kwargs)

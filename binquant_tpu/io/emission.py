@@ -503,7 +503,9 @@ def _analytics_record(
 
 def dispatch_signal_record(binbot_api, record: dict[str, Any]) -> None:
     """Fire-and-forget analytics POST — failures never break the trade path
-    (context_evaluator.py:329-333)."""
+    (context_evaluator.py:329-333). The INLINE emission path's shape; the
+    delivery plane (io/delivery.py) instead calls ``AnalyticsSink.deliver``
+    so failures raise into its retry/breaker machinery."""
     from binquant_tpu.obs.instruments import SINK_EMISSIONS
 
     try:
@@ -515,3 +517,151 @@ def dispatch_signal_record(binbot_api, record: dict[str, Any]) -> None:
             "dispatch_signal_record failed for %s; trade path continues.",
             record.get("symbol"),
         )
+
+
+# ---------------------------------------------------------------------------
+# Sink-consumer interface (ISSUE 13): the delivery plane's view of a sink.
+#
+# ROADMAP item 2's refactor lever: finalize's emit half no longer knows the
+# three sinks by name — each is a SignalSink the DeliveryPlane owns a
+# worker for. ``deliver`` RAISES on failure (the plane owns retries,
+# backoff, and the circuit breaker; the old inline path's per-sink
+# swallowing lives in pipeline._finalize_tick_inner for the plane-off
+# configuration). ``encode``/``to_wal``/``from_wal`` split the payload
+# contract: encode materializes the sink-native payload once at enqueue
+# (the FiredSignal itself never rides a queue), to_wal/from_wal round-trip
+# it through the JSONL write-ahead log for the at-least-once class.
+# ---------------------------------------------------------------------------
+
+
+class SignalSink:
+    """One delivery target behind the plane. Subclasses set ``name`` and
+    ``policy`` ("at_least_once": WAL-durable, never dropped; "lossy":
+    bounded retries, shed-with-a-counter under pressure)."""
+
+    name = "sink"
+    policy = "lossy"
+
+    def encode(self, signal: FiredSignal) -> Any:
+        """FiredSignal → the sink-native payload enqueued on the plane."""
+        raise NotImplementedError
+
+    def to_wal(self, payload: Any) -> Any:
+        """Payload → a JSON-serializable WAL record body."""
+        return payload
+
+    def stamp(self, payload: Any, entry_id: str) -> None:
+        """Attach the plane's delivery identity to the payload itself so
+        it travels to the consumer on every (re)delivery. Only meaningful
+        for at-least-once sinks: when trace sampling skipped a tick, the
+        trace_id/tick_seq provenance stamps are absent from the payload
+        and this is the downstream dedupe key for a post-kill replay."""
+
+    def from_wal(self, data: Any) -> Any:
+        """WAL record body → the payload ``deliver`` accepts (restart
+        replay)."""
+        return data
+
+    async def deliver(self, payload: Any) -> None:
+        """One delivery attempt; MUST raise on failure."""
+        raise NotImplementedError
+
+
+class AnalyticsSink(SignalSink):
+    """POST /signals analytics record (lossy: the trade path must stay
+    fresh; a shed analytics record is a counted, bounded loss)."""
+
+    name = "analytics"
+    policy = "lossy"
+
+    def __init__(self, binbot_api) -> None:
+        self.binbot_api = binbot_api
+
+    def encode(self, signal: FiredSignal) -> dict[str, Any]:
+        return signal.analytics
+
+    async def deliver(self, payload: dict[str, Any]) -> None:
+        import asyncio
+
+        from binquant_tpu.obs.instruments import SINK_EMISSIONS
+
+        try:
+            # the binbot client is sync httpx — keep its round trip off
+            # the event loop (the worker awaits, the loop stays free)
+            await asyncio.to_thread(
+                self.binbot_api.dispatch_create_signal, payload
+            )
+        except Exception:
+            SINK_EMISSIONS.labels(sink="analytics", outcome="error").inc()
+            raise
+        SINK_EMISSIONS.labels(sink="analytics", outcome="ok").inc()
+
+
+class TelegramSink(SignalSink):
+    """Telegram alert (lossy: an alert that missed its moment is noise;
+    the cooldown ledger's duplicate suppression still applies and counts
+    as a successful no-op delivery)."""
+
+    name = "telegram"
+    policy = "lossy"
+
+    def __init__(self, consumer) -> None:
+        self.consumer = consumer
+
+    def encode(self, signal: FiredSignal) -> str:
+        return signal.message
+
+    async def deliver(self, payload: str) -> None:
+        await self.consumer.deliver_signal(payload)
+
+
+class AutotradeSink(SignalSink):
+    """Autotrade admission (at_least_once: a lost trade signal is lost
+    money — unacked WAL entries replay on restart; downstream dedupes on
+    the trace_id/tick_seq key every redelivery carries)."""
+
+    name = "autotrade"
+    policy = "at_least_once"
+
+    def __init__(self, at_consumer) -> None:
+        self.at_consumer = at_consumer
+
+    def encode(self, signal: FiredSignal) -> SignalsConsumer:
+        return signal.value
+
+    def to_wal(self, payload: SignalsConsumer) -> dict[str, Any]:
+        return payload.model_dump(mode="json")
+
+    def from_wal(self, data: Any) -> SignalsConsumer:
+        return SignalsConsumer.model_validate(data)
+
+    def stamp(self, payload: SignalsConsumer, entry_id: str) -> None:
+        # the WAL round trip (model_dump/model_validate) preserves
+        # metadata, so a post-kill replay redelivers the same id
+        payload.metadata.setdefault("delivery_id", entry_id)
+
+    async def deliver(self, payload: SignalsConsumer) -> None:
+        import asyncio
+
+        # The consumer is async-in-name-only: every await bottoms out in
+        # sync binbot REST (plus its blocking retry backoff), which would
+        # wedge the shared event loop — and the plane's per-attempt
+        # deadline cannot preempt blocked sync code. A worker thread with
+        # its own loop keeps the tick path responsive; a deadline cancel
+        # abandons the thread's result and the redelivery dedupes
+        # downstream (at_least_once).
+        await asyncio.to_thread(
+            asyncio.run,
+            self.at_consumer.process_autotrade_restrictions(payload),
+        )
+
+
+def make_signal_sinks(
+    binbot_api, telegram_consumer, at_consumer
+) -> list[SignalSink]:
+    """The production sink set, in the inline path's dispatch order."""
+    return [
+        AnalyticsSink(binbot_api),
+        TelegramSink(telegram_consumer),
+        AutotradeSink(at_consumer),
+    ]

@@ -339,6 +339,15 @@ def _pow2_bucket(m: int, floor: int = 4) -> int:
     return size
 
 
+def _knob(config, name: str, default):
+    """Config attribute with a default for stub configs that lack it.
+    Unlike ``getattr(...) or default``, a present-but-zero value passes
+    through — 0 is a documented setting for several delivery knobs
+    (e.g. BQT_WAL_COMPACT_EVERY=0 disables auto-compaction)."""
+    val = getattr(config, name, None)
+    return default if val is None else val
+
+
 def _scan_fallback_unavailable():
     """Fallback slot of a scanned tick's fabricated _PendingTick. Never
     reachable: the chunked drive re-drives overflowed chunks serially
@@ -521,6 +530,39 @@ class SignalEngine:
             ),
             cap=int(getattr(config, "outcome_cap", 1024) or 1024),
         )
+        # durable signal delivery plane (ISSUE 13): finalize enqueues and
+        # returns; per-sink workers own retries/backoff/breakers, and the
+        # autotrade class is WAL-durable at-least-once across a process
+        # kill. BQT_DELIVERY=0 (the tier-1 lane's default) keeps the
+        # pre-plane inline sink dispatch byte-identical.
+        self.delivery = None
+        if bool(getattr(config, "delivery_enabled", False)):
+            from binquant_tpu.io.delivery import DeliveryPlane
+            from binquant_tpu.io.emission import make_signal_sinks
+
+            self.delivery = DeliveryPlane(
+                sinks=make_signal_sinks(
+                    binbot_api, telegram_consumer, at_consumer
+                ),
+                wal_path=getattr(config, "delivery_wal_path", "") or None,
+                queue_max=int(_knob(config, "delivery_queue_max", 512)),
+                attempt_timeout_s=float(
+                    _knob(config, "delivery_attempt_timeout_s", 5.0)
+                ),
+                retry_max=int(_knob(config, "delivery_retry_max", 3)),
+                backoff_s=float(_knob(config, "delivery_backoff_s", 0.25)),
+                backoff_max_s=float(
+                    _knob(config, "delivery_backoff_max_s", 30.0)
+                ),
+                breaker_threshold=int(
+                    _knob(config, "delivery_breaker_threshold", 5)
+                ),
+                breaker_cooldown_s=float(
+                    _knob(config, "delivery_breaker_cooldown_s", 30.0)
+                ),
+                wal_compact_every=int(_knob(config, "wal_compact_every", 256)),
+                freshness=self.freshness,
+            )
         # tick_seq source for traces: advances on every dispatch ATTEMPT
         # (ticks_processed only counts successes — deriving the seq from
         # it would hand a failed tick's number to the retry, and tick_seq
@@ -1049,6 +1091,15 @@ class SignalEngine:
         if task is not None and not task.done():
             await task
         return fired
+
+    async def aclose_delivery(self, drain_s: float = 5.0) -> None:
+        """Gracefully retire the delivery plane (replay end / shutdown):
+        best-effort drain, stop the workers, compact the WAL. Entries a
+        down sink never acked stay durable for the next boot — this NEVER
+        rides the tick path (flush_pending deliberately does not drain
+        the plane; a sink outage must not stall the tick thread)."""
+        if self.delivery is not None and self.delivery.started:
+            await self.delivery.aclose(drain_s=drain_s)
 
     async def emit_ready(self) -> list:
         """Fired-tick fast path: land and emit the oldest in-flight tick
@@ -2471,6 +2522,7 @@ class SignalEngine:
                 # field, absent while BQT_FRESHNESS=0 — satellite: no
                 # Prometheus scrape needed downstream)
                 sink_acks: dict[str, float] | None = None
+                lag0: float | None = None
                 if self.freshness.enabled:
                     lag0 = _sig_lag_ms(signal)
                     signal.freshness_ms = round(
@@ -2490,6 +2542,42 @@ class SignalEngine:
                 else:
                     def _ack(sink: str) -> None:
                         pass
+                if self.delivery is not None:
+                    # delivery plane (ISSUE 13): finalize ENQUEUES and
+                    # returns — a WAL append per at-least-once sink plus
+                    # bounded-queue puts; the sink round trips, retries,
+                    # and breaker waits all happen on the plane's workers,
+                    # so the tick thread's emit dwell stays bounded no
+                    # matter how the sinks behave. bqt_sink_delivery_ms
+                    # is observed by the worker at ACK (close→acked-
+                    # through-the-queue); the SLO check here judges
+                    # close→emit (the plane accepted the signal).
+                    with trace.span(
+                        "delivery.enqueue",
+                        strategy=signal.strategy,
+                        symbol=signal.symbol,
+                    ):
+                        self.delivery.enqueue_fired(
+                            signal,
+                            tick_ms=pending.ts_ms,
+                            lag0_ms=lag0,
+                            dispatched_at=pending.dispatched_at,
+                        )
+                    if self.freshness.enabled:
+                        self.freshness.observe_signal(
+                            strategy=signal.strategy,
+                            symbol=signal.symbol,
+                            close_to_emit_ms=signal.freshness_ms,
+                            sink_ack_ms=None,
+                            tick_ms=pending.ts_ms,
+                            trace_id=signal.trace_id,
+                            phases=(
+                                self.host_phase.open_split(drive)
+                                or self.host_phase.last_chunk
+                            ),
+                            snapshot_fn=self._flight_snapshot,
+                        )
+                    continue
                 with trace.span(
                     "sink.analytics",
                     strategy=signal.strategy,
@@ -2996,6 +3084,20 @@ class SignalEngine:
             # signal-outcome observatory: registry pressure at the breach
             "outcomes_open": len(self.outcomes._open),
             "outcome_evictions": self.outcomes.evictions,
+            # delivery plane: per-sink queue depth + breaker state at the
+            # breach (attribute reads only; None while the plane is off)
+            "delivery": (
+                {
+                    name: {
+                        "queue": lane.queue.qsize(),
+                        "breaker": lane.breaker.state,
+                        "deferred": lane.deferred,
+                    }
+                    for name, lane in self.delivery._lanes.items()
+                }
+                if self.delivery is not None
+                else None
+            ),
         }
 
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
@@ -3091,6 +3193,16 @@ class SignalEngine:
             # signal-outcome observatory (ISSUE 12): the per-strategy
             # hit-rate/excursion scoreboard + open-registry pressure
             "outcomes": self.outcomes.scoreboard(),
+            # durable delivery plane (ISSUE 13): per-sink outbox queues,
+            # breaker states, shed/ack counters, and WAL occupancy. A
+            # plane under pressure (open breakers, WAL backlog) reads
+            # DEGRADED here but keeps the probe at HTTP 200 — the PR-1
+            # contract: only a stale heartbeat is worth a restart loop.
+            "delivery": (
+                self.delivery.snapshot()
+                if self.delivery is not None
+                else {"enabled": False}
+            ),
         }
 
     # -- loops (main.py:37-57) ------------------------------------------------
@@ -3106,6 +3218,10 @@ class SignalEngine:
         best-effort so its signals aren't dropped between the SIGTERM and
         the restart.
         """
+        # start the delivery plane UP FRONT: unacked WAL entries from the
+        # previous process replay at boot, not at the first new signal
+        if self.delivery is not None:
+            self.delivery.start()
         try:
             await self._consume_loop_body(queue, tick_interval_s)
         finally:
@@ -3120,6 +3236,16 @@ class SignalEngine:
                     logging.warning("shutdown flush interrupted mid-emission")
                 except Exception:
                     logging.exception("shutdown flush failed")
+            # retire the delivery plane last: best-effort drain of the
+            # outbox queues, then stop the workers. Anything a down sink
+            # never acked stays in the WAL and replays at the next boot —
+            # the at-least-once contract across the SIGTERM.
+            try:
+                await self.aclose_delivery(drain_s=2.0)
+            except asyncio.CancelledError:
+                logging.warning("shutdown delivery drain interrupted")
+            except Exception:
+                logging.exception("shutdown delivery close failed")
 
     async def _consume_loop_body(
         self, queue: asyncio.Queue, tick_interval_s: float

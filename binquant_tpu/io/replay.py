@@ -83,6 +83,9 @@ def make_stub_engine(
     outcomes: bool | None = None,
     outcome_horizons: tuple[int, ...] | None = None,
     outcome_cap: int | None = None,
+    delivery: bool | None = None,
+    delivery_wal: str | None = None,
+    delivery_overrides: dict | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -149,6 +152,37 @@ def make_stub_engine(
         )
     if outcome_cap is not None:
         config.__dict__["outcome_cap"] = int(outcome_cap)
+    # durable delivery plane (ISSUE 13): BQT_DELIVERY / BQT_DELIVERY_WAL
+    # overrides so the delivery lane pins the plane on (with a tmp WAL and
+    # drill-scale queue/backoff/breaker knobs via ``delivery_overrides``,
+    # config attr name -> value) while the tier-1 conftest keeps it off
+    if delivery is not None:
+        config.__dict__["delivery_enabled"] = bool(delivery)
+    if delivery_wal is not None:
+        config.__dict__["delivery_wal_path"] = str(delivery_wal)
+    elif getattr(config, "delivery_enabled", False):
+        # never share the LIVE deployment's WAL: a stub run's unacked
+        # leftovers must not replay into the next production boot (and
+        # vice versa) — stub engines get a fresh throwaway outbox
+        import atexit
+        import contextlib
+        import tempfile
+
+        fd, wal_tmp = tempfile.mkstemp(
+            prefix="bqt_stub_", suffix=".wal.jsonl"
+        )
+        os.close(fd)
+
+        def _discard_stub_wal(path=wal_tmp):
+            with contextlib.suppress(OSError):
+                os.unlink(path)
+
+        # throwaway means throwaway: drills/tests mint one per stub
+        # engine and nothing else ever unlinks it
+        atexit.register(_discard_stub_wal)
+        config.__dict__["delivery_wal_path"] = wal_tmp
+    for key, value in (delivery_overrides or {}).items():
+        config.__dict__[key] = value
     binbot_api = BinbotApi(
         "http://stub",
         session=session if session is not None else StubSession(breadth=breadth),
@@ -345,10 +379,14 @@ def run_replay(
             latencies.append((time.perf_counter() - t0) * 1000)
             record(fired)
         record(await engine.flush_pending())
+        # retire the delivery plane (when on) before the loop closes:
+        # best-effort drain so the stubbed sinks see every signal
+        await engine.aclose_delivery()
 
     async def drive_scanned() -> None:
         record(await engine.process_ticks_scanned(seq))
         record(await engine.flush_pending())
+        await engine.aclose_delivery()
 
     asyncio.run(drive_scanned() if scanned else drive())
     wall = time.perf_counter() - t_start

@@ -216,6 +216,12 @@ class CooldownLedger:
     def release(self, key: tuple[str, ...]) -> None:
         self._inflight.discard(key)
 
+    def forget(self, key: tuple[str, ...]) -> None:
+        """Drop a key's cooldown stamp — a FAILED send must not suppress
+        the delivery plane's retry of the same message as a duplicate
+        (admit records the stamp at admission, not at send success)."""
+        self._sent_at.pop(key, None)
+
 
 # ---------------------------------------------------------------------------
 # Transport + consumer
@@ -317,6 +323,38 @@ class TelegramConsumer:
             SINK_EMISSIONS.labels(sink="telegram", outcome="error").inc()
             log.error("Error sending telegram signal: %s", exc)
             log.error("Original message: %s", message)
+
+    async def deliver_signal(self, message: str) -> bool:
+        """Delivery-plane entry point (io/delivery.py TelegramSink): the
+        same admission control as ``dispatch_signal``, but awaited and
+        RAISING on transport failure so the plane's retry/backoff and
+        circuit breaker own the error instead of a swallowed log line.
+        Returns False when disabled, empty, or suppressed as a duplicate
+        (all successful no-op deliveries)."""
+        if not self.is_enabled or self._transport is None:
+            return False
+        condensed = _condense(message)
+        if not condensed:
+            return False
+        key = parse_fingerprint(condensed).key()
+        if not self._ledger.admit(key, self._signal_dedupe_seconds):
+            SINK_EMISSIONS.labels(sink="telegram", outcome="suppressed").inc()
+            return False
+        try:
+            await self.send_msg(condensed)
+            return True
+        except BaseException as exc:
+            if isinstance(exc, Exception):
+                SINK_EMISSIONS.labels(sink="telegram", outcome="error").inc()
+            # a failed send — or one cancelled by the plane's per-attempt
+            # deadline (CancelledError is a BaseException) — must not hold
+            # the cooldown window against the retry of the very same
+            # message, else the retry is suppressed as a duplicate and
+            # acked without ever sending
+            self._ledger.forget(key)
+            raise
+        finally:
+            self._ledger.release(key)
 
     def dispatch_signal(self, message: str) -> asyncio.Task | None:
         """Fire-and-forget entry point used by the emission path.

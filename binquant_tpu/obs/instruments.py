@@ -350,6 +350,58 @@ AUTOTRADE_REFUSALS = REGISTRY.counter(
     labels=("gate",),
 )
 
+# -- durable delivery plane (io/delivery.py, ISSUE 13) ------------------------
+
+DELIVERY_ENQUEUED = REGISTRY.counter(
+    "bqt_delivery_enqueued_total",
+    "Signals accepted by the delivery plane per sink (finalize enqueues "
+    "and returns; the WAL put for at-least-once sinks precedes this).",
+    labels=("sink",),
+)
+DELIVERY_ACKED = REGISTRY.counter(
+    "bqt_delivery_acked_total",
+    "Deliveries the sink confirmed, per sink (at-least-once sinks also "
+    "write the WAL ack record here).",
+    labels=("sink",),
+)
+DELIVERY_RETRIES = REGISTRY.counter(
+    "bqt_delivery_retries_total",
+    "Failed delivery attempts per sink (each schedules a jittered "
+    "exponential-backoff retry, or a shed once a lossy sink's attempt "
+    "budget is spent).",
+    labels=("sink",),
+)
+DELIVERY_SHED = REGISTRY.counter(
+    "bqt_delivery_shed_total",
+    "Lossy-class signals dropped by the plane, by reason (queue_full / "
+    "breaker_open / retries_exhausted / encode_error). At-least-once "
+    "sinks never appear here except queue_full with durability disabled.",
+    labels=("sink", "reason"),
+)
+DELIVERY_BREAKER = REGISTRY.counter(
+    "bqt_delivery_breaker_transitions_total",
+    "Circuit-breaker state transitions per sink (open / half_open / "
+    "closed); each also emits a delivery_breaker event.",
+    labels=("sink", "state"),
+)
+DELIVERY_QUEUE = REGISTRY.gauge(
+    "bqt_delivery_queue_depth",
+    "Outbox queue occupancy per sink (bounded by BQT_DELIVERY_QUEUE).",
+    labels=("sink",),
+)
+DELIVERY_WAL_UNACKED = REGISTRY.gauge(
+    "bqt_delivery_wal_unacked",
+    "Write-ahead-log puts without an ack yet, per at-least-once sink — "
+    "sustained growth means the sink is down and the outbox is absorbing.",
+    labels=("sink",),
+)
+DELIVERY_WAL_REPLAYED = REGISTRY.counter(
+    "bqt_delivery_wal_replayed_total",
+    "Unacked WAL entries re-enqueued at boot (the previous process was "
+    "killed between accept and sink ack) — the at-least-once replay path.",
+    labels=("sink",),
+)
+
 # -- binbot REST client (io/binbot.py) --------------------------------------
 
 BINBOT_REQUESTS = REGISTRY.counter(
@@ -357,6 +409,14 @@ BINBOT_REQUESTS = REGISTRY.counter(
     "Binbot backend REST calls by method and outcome "
     "(ok / http_error / backend_error / transport_error).",
     labels=("method", "outcome"),
+)
+BINBOT_RETRIES = REGISTRY.counter(
+    "bqt_binbot_retries_total",
+    "Binbot REST retry outcomes: retry (a capped, jittered in-client "
+    "retry ran after a transport error / 5xx) and exhausted (the retry "
+    "budget was spent; the error surfaced to the caller and a "
+    "binbot_retry_exhausted event recorded it).",
+    labels=("outcome",),
 )
 
 # -- checkpointing (io/checkpoint.py) ---------------------------------------

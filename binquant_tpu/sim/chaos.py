@@ -244,6 +244,9 @@ def ws_chaos_drill(
         window=120,
         session=flaky_session,
         telegram_transport=telegram,
+        # this drill pins the INLINE sink path's isolation; the delivery
+        # plane's storm/kill/restore drill is delivery_chaos_drill below
+        delivery=False,
     )
     engine.ws_health = health
 
@@ -331,4 +334,324 @@ def ws_chaos_drill(
         and facts["sink_faults"] > 0
         and facts["heartbeat_live"]
     )
+    return facts
+
+
+# -- the delivery-plane chaos drill (ISSUE 13) --------------------------------
+
+
+class FlakySink:
+    """Wraps a :class:`~binquant_tpu.io.emission.SignalSink` with a
+    scripted per-ATTEMPT fault plan — the delivery plane's chaos seam.
+    Plan entries: ``"ok"`` records the payload in ``delivered``;
+    ``"5xx"``/``"timeout"``/anything else raises (exhausted → ok).
+    ``latency_s`` stalls every attempt first, so a drill can prove the
+    tick thread never waits on the sink."""
+
+    def __init__(
+        self, inner: Any, plan: list[str] | tuple = (), latency_s: float = 0.0
+    ) -> None:
+        self.inner = inner
+        self.name = inner.name
+        self.policy = inner.policy
+        self.plan = list(plan)
+        self.latency_s = float(latency_s)
+        self.failures = 0
+        self.delivered: list[Any] = []
+
+    def encode(self, signal):
+        return self.inner.encode(signal)
+
+    def to_wal(self, payload):
+        return self.inner.to_wal(payload)
+
+    def from_wal(self, data):
+        return self.inner.from_wal(data)
+
+    async def deliver(self, payload) -> None:
+        if self.latency_s:
+            await asyncio.sleep(self.latency_s)
+        mode = self.plan.pop(0) if self.plan else "ok"
+        if mode != "ok":
+            self.failures += 1
+            raise ConnectionError(f"scripted sink fault: {mode}")
+        self.delivered.append(payload)
+
+
+def _autotrade_key(payload) -> tuple:
+    """Content identity of a delivered autotrade payload — stable across
+    independent drives (trace ids differ per process; price/direction/
+    symbol/strategy pin the producing bar on a deterministic stream)."""
+    return (
+        str(payload.algorithm_name),
+        str(payload.symbol),
+        str(payload.direction),
+        round(float(payload.current_price), 8),
+    )
+
+
+def _burst_signal(i: int):
+    """A synthetic FiredSignal for the queue-saturation burst."""
+    from binquant_tpu.io.emission import FiredSignal
+    from binquant_tpu.schemas import SignalsConsumer
+
+    value = SignalsConsumer(
+        autotrade=False,
+        current_price=1.0 + i,
+        direction="LONG",
+        algorithm_name="burst",
+        symbol=f"BURST{i:03d}USDT",
+    )
+    return FiredSignal(
+        "burst", value.symbol, i, value, f"burst {i}", {"symbol": value.symbol}
+    )
+
+
+def delivery_chaos_drill(workdir: str | None = None) -> dict:
+    """The ISSUE-13 acceptance drill: a scripted autotrade 5xx/timeout
+    storm, scripted breaker open→half_open→open→half_open→closed cycle,
+    an analytics queue-saturation burst, and a process kill mid-storm
+    (workers cancelled hard, WAL left unacked and uncompacted) followed
+    by a checkpoint restore — asserting
+
+    * ZERO autotrade-signal loss and ZERO duplicates past the delivery
+      dedupe key: victim+resumed delivered set == the uninterrupted
+      oracle's, each key exactly once;
+    * the WAL replay actually carried entries across the kill;
+    * the breaker walked the scripted transition sequence;
+    * lossy queue saturation shed with reason=queue_full (counted, not
+      silent);
+    * finalize's ``emit`` host-phase dwell stayed bounded while the sink
+      burned orders of magnitude more wall time (the tick thread never
+      blocks on a sink).
+    """
+    import tempfile
+    from pathlib import Path
+
+    from binquant_tpu.io.checkpoint import load_state, save_state
+    from binquant_tpu.io.replay import make_stub_engine, tick_seq
+    from binquant_tpu.sim.scenarios import (
+        Scenario,
+        ScenarioSpec,
+        _bleed_then_hammer,
+        base_market,
+        emit_stream,
+        write_scenario_file,
+    )
+
+    workdir = Path(workdir or tempfile.mkdtemp(prefix="bqt_delivery_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    # the drill's own two-pulse stream (not a registered corpus family):
+    # THREE capitulation hammers before the kill point — the first walks
+    # the scripted breaker cycle to a delivery, the other two sit unacked
+    # in the WAL when the kill lands — and two more after it
+    spec = ScenarioSpec(
+        name="delivery_storm",
+        description="two signal pulses bracketing a mid-storm kill",
+    )
+
+    def _build(sp: ScenarioSpec) -> list[dict]:
+        closes, vols, _rng = base_market(sp)
+        shapes: dict = {}
+        _bleed_then_hammer(
+            closes, vols, shapes, (2, 5, 8), sp.n_ticks - 36, sp.n_ticks - 10
+        )
+        _bleed_then_hammer(
+            closes, vols, shapes, (3, 6), sp.n_ticks - 27, sp.n_ticks - 1
+        )
+        return emit_stream(sp, closes, vols, shapes)
+
+    stream = workdir / "delivery_storm.jsonl"
+    write_scenario_file(Scenario(spec=spec, build=_build), stream)
+    seq = tick_seq(stream)
+    # split between the storm's two signal pulses (the restore-under-
+    # fault geometry): signals exist on BOTH sides of the kill
+    split = spec.n_ticks - 6
+
+    knobs = dict(
+        delivery_queue_max=64,
+        delivery_attempt_timeout_s=2.0,
+        delivery_retry_max=2,
+        delivery_backoff_s=0.01,
+        delivery_backoff_max_s=0.05,
+        delivery_breaker_threshold=2,
+        delivery_breaker_cooldown_s=0.05,
+        wal_compact_every=0,  # the kill must find an uncompacted WAL
+    )
+
+    def build(wal: Path):
+        return make_stub_engine(
+            capacity=spec.capacity,
+            window=spec.window,
+            incremental=True,
+            scan_chunk=spec.scan_chunk,
+            enabled_strategies=set(spec.enabled_strategies),
+            host_phase=True,
+            delivery=True,
+            delivery_wal=str(wal),
+            delivery_overrides=dict(knobs),
+        )
+
+    async def drive(engine, ticks) -> None:
+        engine.delivery.start()
+        for now_ms, klines in ticks:
+            for k in klines:
+                engine.ingest(k)
+            await engine.process_tick(now_ms=now_ms)
+        await engine.flush_pending()
+
+    # -- the uninterrupted oracle (healthy recorder sinks) -------------------
+    oracle = build(workdir / "oracle.wal.jsonl")
+    at_oracle = FlakySink(oracle.delivery.lane("autotrade").sink)
+    oracle.delivery.lane("autotrade").sink = at_oracle
+
+    async def run_oracle() -> None:
+        await drive(oracle, seq)
+        await oracle.delivery.aclose(drain_s=10.0)
+
+    asyncio.run(run_oracle())
+    oracle_keys = {_autotrade_key(p) for p in at_oracle.delivered}
+
+    # -- the victim: storm + breaker script + burst, then a hard kill --------
+    wal_path = workdir / "victim.wal.jsonl"
+    victim = build(wal_path)
+    # breaker choreography (threshold 2): two failures OPEN, the first
+    # half-open probe FAILS (re-open), the second probe succeeds (CLOSE);
+    # then the storm resumes failing everything until the kill
+    at_victim = FlakySink(
+        victim.delivery.lane("autotrade").sink,
+        plan=["5xx", "timeout", "5xx", "ok"] + ["5xx"] * 10_000,
+        latency_s=0.002,
+    )
+    victim.delivery.lane("autotrade").sink = at_victim
+    # analytics saturation target: a slow sink behind a 64-slot queue
+    an_victim = FlakySink(
+        victim.delivery.lane("analytics").sink, latency_s=0.5
+    )
+    victim.delivery.lane("analytics").sink = an_victim
+
+    async def run_victim() -> dict:
+        await drive(victim, seq[:split])
+        # queue-saturation burst: 80 synthetic lossy records against the
+        # 64-slot analytics queue while its worker crawls — the overflow
+        # must shed with an explicit counter, never block or grow
+        # (analytics lane only: a burst into the autotrade lane would be
+        # WAL-durable by design and pollute the oracle-equality check)
+        from binquant_tpu.io.delivery import Envelope
+
+        an_lane = victim.delivery.lane("analytics")
+        for i in range(80):
+            sig = _burst_signal(i)
+            victim.delivery.enqueue(
+                Envelope(
+                    entry_id=f"burst/{i}",
+                    sink="analytics",
+                    payload=an_lane.sink.encode(sig),
+                    ts_ms=0,
+                )
+            )
+        # give the breaker script room to complete its scripted cycle
+        deadline = time.monotonic() + 8.0
+        breaker = victim.delivery.breaker("autotrade")
+        while time.monotonic() < deadline:
+            if len(breaker.transitions) >= 5:
+                break
+            await asyncio.sleep(0.01)
+        # HARD KILL: cancel the workers mid-flight — no drain, no ack
+        # flush, no WAL compaction (what SIGKILL leaves behind)
+        for lane in victim.delivery._lanes.values():
+            if lane.worker is not None:
+                lane.worker.cancel()
+        await asyncio.gather(
+            *(
+                lane.worker
+                for lane in victim.delivery._lanes.values()
+                if lane.worker is not None
+            ),
+            return_exceptions=True,
+        )
+        victim.delivery.closed = True
+        victim.delivery.wal.close()
+        return {
+            "breaker_transitions": list(breaker.transitions),
+            "analytics_shed": dict(
+                victim.delivery.lane("analytics").shed
+            ),
+            "emit_ms": (
+                victim.host_phase.totals.get("serial", {})
+                .get("emit", [0.0, 0])[0]
+            ),
+            "sink_wall_ms": 1000.0
+            * (
+                0.5 * (len(an_victim.delivered) + an_victim.failures)
+                + 0.002 * (len(at_victim.delivered) + at_victim.failures)
+            ),
+        }
+
+    victim_facts = asyncio.run(run_victim())
+    victim_keys = {_autotrade_key(p) for p in at_victim.delivered}
+    from binquant_tpu.io.delivery import DeliveryWal
+
+    wal_probe = DeliveryWal(wal_path, fsync=False, compact_every=0)
+    unacked_at_kill = len(wal_probe.unacked())
+    wal_probe.close()
+    ckpt = workdir / "victim.ckpt.npz"
+    save_state(ckpt, victim.state, victim.registry, victim.host_carries())
+
+    # -- restore: same WAL, healthy sink; replay then the stream tail --------
+    resumed = build(wal_path)
+    at_resumed = FlakySink(resumed.delivery.lane("autotrade").sink)
+    resumed.delivery.lane("autotrade").sink = at_resumed
+    state, carries = load_state(ckpt, resumed.state, resumed.registry)
+    resumed.state = state
+    resumed.restore_host_carries(carries)
+    resumed.note_state_restored(
+        migrated=bool(carries.get("_carry_rebuilt", False))
+    )
+
+    async def run_resumed() -> None:
+        await drive(resumed, seq[split:])
+        await resumed.delivery.aclose(drain_s=10.0)
+
+    asyncio.run(run_resumed())
+    resumed_keys = {_autotrade_key(p) for p in at_resumed.delivered}
+
+    delivered = [
+        _autotrade_key(p)
+        for p in (*at_victim.delivered, *at_resumed.delivered)
+    ]
+    facts = {
+        "oracle_autotrade": len(oracle_keys),
+        "delivered_autotrade": len(set(delivered)),
+        "lost_autotrade": len(oracle_keys - set(delivered)),
+        "duplicate_keys": len(delivered) - len(set(delivered)),
+        "extra_keys": len(set(delivered) - oracle_keys),
+        "victim_delivered": len(victim_keys),
+        "resumed_delivered": len(resumed_keys),
+        "unacked_at_kill": unacked_at_kill,
+        "wal_replayed": resumed.delivery.wal_replayed,
+        "breaker_transitions": victim_facts["breaker_transitions"],
+        "analytics_shed": victim_facts["analytics_shed"],
+        "emit_ms": round(victim_facts["emit_ms"], 3),
+        "sink_wall_ms": round(victim_facts["sink_wall_ms"], 1),
+    }
+    checks = {
+        "zero_autotrade_loss": facts["lost_autotrade"] == 0
+        and facts["extra_keys"] == 0
+        and len(oracle_keys) > 0,
+        "zero_duplicates_past_key": facts["duplicate_keys"] == 0,
+        "signals_on_both_sides": len(victim_keys) > 0
+        and len(resumed_keys - victim_keys) > 0,
+        "kill_left_unacked_wal": unacked_at_kill > 0,
+        "wal_replay_ran": facts["wal_replayed"] > 0,
+        "breaker_cycle_scripted": facts["breaker_transitions"][:5]
+        == ["open", "half_open", "open", "half_open", "closed"],
+        "queue_saturation_shed": facts["analytics_shed"].get("queue_full", 0)
+        > 0,
+        # the tick thread enqueues; the sinks burn wall time elsewhere
+        "emit_dwell_bounded": facts["emit_ms"]
+        < max(0.1 * facts["sink_wall_ms"], 250.0),
+    }
+    facts["checks"] = checks
+    facts["ok"] = all(checks.values())
     return facts

@@ -64,6 +64,10 @@ def drive_scenario(
         # retention bound (W >= 3*chunk + h) must hold at spec shapes.
         outcomes=True,
         outcome_horizons=(1, 4),
+        # the corpus pins INLINE sink semantics (telegram-sent counts read
+        # synchronously after the drive); the delivery plane has its own
+        # drill (delivery_chaos_drill) with the at-least-once invariants
+        delivery=False,
     )
     # isolated ws tracker: the module singleton may carry another drill's
     # reconnect storm, which would flip this run's health to degraded
@@ -294,6 +298,37 @@ def run_corpus(
         }
         get_event_log().emit("scenario_run", **event)
         verdicts.append(event)
+        # ISSUE 13: the delivery-plane drill — sink 5xx/timeout storm,
+        # scripted breaker cycle, queue-saturation burst, and a process
+        # kill/restore with zero autotrade loss and zero duplicates past
+        # the delivery dedupe key
+        from binquant_tpu.sim.chaos import delivery_chaos_drill
+
+        dfacts = delivery_chaos_drill()
+        devent = {
+            "scenario": "delivery_drill",
+            "ok": dfacts["ok"],
+            "signals": dfacts["delivered_autotrade"],
+            "ticks": 0,
+            "routing": {},
+            "checks": dfacts["checks"],
+            "delivery": {
+                k: dfacts[k]
+                for k in (
+                    "oracle_autotrade",
+                    "delivered_autotrade",
+                    "lost_autotrade",
+                    "duplicate_keys",
+                    "unacked_at_kill",
+                    "wal_replayed",
+                    "breaker_transitions",
+                    "analytics_shed",
+                    "emit_ms",
+                )
+            },
+        }
+        get_event_log().emit("scenario_run", **devent)
+        verdicts.append(devent)
     return verdicts
 
 

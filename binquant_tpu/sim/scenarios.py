@@ -579,6 +579,54 @@ def _fire_burst(spec: ScenarioSpec) -> list[dict]:
     return emit_stream(spec, closes, vols, shapes)
 
 
+def btc_withhold(
+    klines: list[dict], ticks, recover_tick: int
+) -> None:
+    """bc_dirty pressure (ROADMAP 5a): withhold ONLY the BTC row's
+    candles during ``ticks`` and deliver them in one catch-up drain at
+    ``recover_tick`` — every other symbol keeps appending, so each
+    withheld 15m bucket is an ASYMMETRIC advance vs the BTC row and the
+    beta/corr carry marks every advancing row dirty (engine/step.py
+    ``bc_dirty``). Dirty rows decode btc_beta/corr as NaN → the analytics
+    payload serializes null (the NaN-decode invariant) until a full
+    recompute re-anchors them. The late BTC bars are still strictly-newer
+    appends for their row, so routing stays clean (no rewrite reroute) —
+    the pressure is purely on the carry's pairing, which is the point."""
+    gap = set(ticks)
+    for k in klines:
+        if k["symbol"] == "BTCUSDT" and _tick_of(k) in gap:
+            k["_deliver_bucket"] = _bucket0() + recover_tick
+
+
+@_scenario(
+    ScenarioSpec(
+        name="bc_dirty_pressure",
+        description="asymmetric BTC-row appends: BTC's candles are "
+        "withheld for six mid-stream buckets (every other symbol keeps "
+        "appending — the beta/corr carry marks advancing rows dirty and "
+        "decodes their BTC posture as NaN/null) and arrive in one "
+        "catch-up drain; a capitulation hammer fires INSIDE the dirty "
+        "window so emitted analytics carry the null-not-zero invariant",
+        min_signals=1,
+    )
+)
+def _bc_dirty_pressure(spec: ScenarioSpec) -> list[dict]:
+    closes, vols, _rng = base_market(spec)
+    shapes: dict = {}
+    # the hammer lands mid-window WHILE the carry is dirty: its emitted
+    # analytics record must serialize btc_beta/btc_corr as null
+    _bleed_then_hammer(
+        closes, vols, shapes, (4, 9), spec.n_ticks - 31, spec.n_ticks - 5
+    )
+    klines = emit_stream(spec, closes, vols, shapes)
+    btc_withhold(
+        klines,
+        ticks=range(spec.n_ticks - 9, spec.n_ticks - 3),
+        recover_tick=spec.n_ticks - 3,
+    )
+    return klines
+
+
 def write_scenario_file(scenario: Scenario | str, path: str | Path) -> int:
     """Generate one scenario's kline stream to ``path`` (JSONL, with any
     ``_deliver_bucket`` transport keys); returns the line count."""

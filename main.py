@@ -40,7 +40,15 @@ async def run_live() -> None:
         from binquant_tpu.obs.events import EventLog, set_event_log
 
         set_event_log(EventLog(config.event_log))
-    binbot_api = BinbotApi(config.binbot_api_url)
+    # bounded REST calls (ISSUE 13 satellite): per-request deadline plus
+    # capped, jittered in-client retries; exhaustion is counted
+    # (bqt_binbot_retries_total) instead of hanging or crash-ringing
+    binbot_api = BinbotApi(
+        config.binbot_api_url,
+        timeout_s=config.binbot_timeout_s,
+        retry_max=config.binbot_retry_max,
+        retry_backoff_s=config.binbot_retry_backoff_s,
+    )
 
     autotrade_settings = binbot_api.get_autotrade_settings()
     test_settings = binbot_api.get_test_autotrade_settings()

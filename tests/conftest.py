@@ -65,6 +65,14 @@ os.environ.setdefault("BQT_HOST_PHASE", "0")
 # additively. Production default stays ON (binquant_tpu/config.py); the
 # outcome coverage opts in explicitly (tests/test_outcomes.py).
 os.environ.setdefault("BQT_OUTCOMES", "0")
+# Durable delivery plane (ISSUE 13) defaults OFF for the tier-1 lane, the
+# same knob pattern: dozens of stub engines must not each spin per-sink
+# worker tasks + a WAL file, and many fixtures pin the inline sink
+# dispatch order / SINK_EMISSIONS outcomes the plane intentionally
+# reshapes (enqueue-now, deliver-on-a-worker). Production default stays
+# ON (binquant_tpu/config.py); delivery coverage opts in explicitly
+# (tests/test_delivery.py via make_stub_engine(delivery=True)).
+os.environ.setdefault("BQT_DELIVERY", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

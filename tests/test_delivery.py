@@ -1,0 +1,663 @@
+"""Durable delivery plane drills (ISSUE 13).
+
+Tier-1 keeps the cheap units — WAL put/ack/compaction + torn-line
+tolerance, the breaker state machine on a scripted clock, plane
+delivery/retry/shed/deferral semantics with fake sinks, the WAL replay
+across a hard kill, the bounded binbot client, the Telegram plane-path
+admission, the engine's enqueue-and-return integration, and the
+golden-pinned delivery report. The slow lane (``make delivery-smoke`` /
+``make scenarios``) adds the full chaos drill: sink 5xx/timeout storm,
+scripted breaker cycle, queue-saturation burst, and the process
+kill/restore with zero autotrade loss and zero duplicates past the
+dedupe key.
+"""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from binquant_tpu.io.delivery import (
+    AT_LEAST_ONCE,
+    LOSSY,
+    CircuitBreaker,
+    DeliveryPlane,
+    DeliveryWal,
+    Envelope,
+    entry_id_of,
+)
+
+
+class FakeSink:
+    """Scriptable SignalSink: fail the first ``fail_times`` attempts."""
+
+    def __init__(
+        self,
+        name="analytics",
+        policy=LOSSY,
+        fail_times=0,
+        latency_s=0.0,
+    ):
+        self.name = name
+        self.policy = policy
+        self.fail_times = fail_times
+        self.latency_s = latency_s
+        self.attempts = 0
+        self.delivered = []
+
+    def encode(self, signal):
+        # JSON-serializable payload (the WAL round-trips it verbatim)
+        return {
+            "strategy": signal.strategy,
+            "symbol": signal.symbol,
+            "seq": getattr(signal, "tick_seq", 0),
+        }
+
+    def to_wal(self, payload):
+        return payload
+
+    def from_wal(self, data):
+        return data
+
+    async def deliver(self, payload):
+        self.attempts += 1
+        if self.latency_s:
+            await asyncio.sleep(self.latency_s)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("scripted sink failure")
+        self.delivered.append(payload)
+
+
+def make_plane(sinks, tmp_path=None, **kw):
+    kw.setdefault("queue_max", 8)
+    kw.setdefault("attempt_timeout_s", 1.0)
+    kw.setdefault("retry_max", 3)
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.005)
+    kw.setdefault("breaker_threshold", 3)
+    kw.setdefault("breaker_cooldown_s", 0.02)
+    kw.setdefault("wal_fsync", False)
+    if tmp_path is not None:
+        kw.setdefault("wal_path", tmp_path / "outbox.wal.jsonl")
+    return DeliveryPlane(sinks=sinks, **kw)
+
+
+def fake_signal(i=0, strategy="mrf", symbol=None):
+    return SimpleNamespace(
+        strategy=strategy,
+        symbol=symbol or f"S{i:03d}USDT",
+        trace_id=f"trace{i}",
+        tick_seq=i,
+    )
+
+
+# -- WAL ----------------------------------------------------------------------
+
+
+def test_wal_put_ack_compact_roundtrip(tmp_path):
+    wal = DeliveryWal(tmp_path / "w.jsonl", fsync=False, compact_every=0)
+    wal.append_put("a/1", "autotrade", {"x": 1}, ts_ms=10)
+    wal.append_put("a/2", "autotrade", {"x": 2}, ts_ms=20)
+    wal.append_put("a/3", "autotrade", {"x": 3}, ts_ms=30)
+    wal.append_ack("a/2", "autotrade")
+    pending = wal.unacked()
+    assert [r["id"] for r in pending] == ["a/1", "a/3"]
+    assert pending[0]["payload"] == {"x": 1}
+    # compaction keeps only unacked puts, atomically
+    assert wal.compact() == 2
+    lines = (tmp_path / "w.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+    assert {json.loads(ln)["id"] for ln in lines} == {"a/1", "a/3"}
+    # the handle survives compaction: appends keep working
+    wal.append_ack("a/1", "autotrade")
+    assert [r["id"] for r in wal.unacked()] == ["a/3"]
+    wal.close()
+
+
+def test_wal_tolerates_torn_trailing_line(tmp_path):
+    path = tmp_path / "w.jsonl"
+    wal = DeliveryWal(path, fsync=False)
+    wal.append_put("a/1", "autotrade", {"x": 1})
+    wal.close()
+    with open(path, "a") as f:
+        f.write('{"op": "put", "id": "a/2", "si')  # killed mid-write
+    wal2 = DeliveryWal(path, fsync=False)
+    assert [r["id"] for r in wal2.unacked()] == ["a/1"]
+    wal2.close()
+
+
+def test_wal_unacked_count_seeds_from_boot_backlog(tmp_path):
+    path = tmp_path / "w.jsonl"
+    wal = DeliveryWal(path, fsync=False)
+    wal.append_put("a/1", "autotrade", 1)
+    wal.append_put("a/2", "autotrade", 2)
+    wal.append_put("t/1", "telegram", 3)
+    wal.append_ack("a/1", "autotrade")
+    assert wal.unacked_count() == 2
+    assert wal.unacked_count("autotrade") == 1
+    wal.close()
+    # a fresh process still sees the previous boot's backlog — the
+    # per-process puts/acks counters can't express replayed entries
+    wal2 = DeliveryWal(path, fsync=False)
+    assert wal2.unacked_count() == 2
+    assert wal2.unacked_count("telegram") == 1
+    wal2.append_ack("a/2", "autotrade")
+    assert wal2.unacked_count("autotrade") == 0
+    wal2.close()
+
+
+def test_wal_auto_compacts_on_ack_cadence(tmp_path):
+    wal = DeliveryWal(tmp_path / "w.jsonl", fsync=False, compact_every=2)
+    for i in range(4):
+        wal.append_put(f"a/{i}", "autotrade", i)
+        wal.append_ack(f"a/{i}", "autotrade")
+    assert wal.compactions == 2
+    assert wal.unacked() == []
+    wal.close()
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_state_machine_scripted_clock():
+    clock = SimpleNamespace(now=0.0)
+    br = CircuitBreaker(
+        "autotrade", threshold=2, cooldown_s=10.0, clock=lambda: clock.now
+    )
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # one failure is weather
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # cooldown not elapsed
+    clock.now = 11.0
+    assert br.allow()  # ONE half-open probe admitted
+    assert br.state == "half_open"
+    assert not br.allow()  # no second probe while one is in flight
+    br.record_failure()  # probe failed -> re-open
+    assert br.state == "open"
+    clock.now = 22.0
+    assert br.allow()
+    br.record_success()  # probe succeeded -> closed
+    assert br.state == "closed" and br.consecutive == 0
+    assert br.transitions == ["open", "half_open", "open", "half_open", "closed"]
+
+
+# -- plane semantics ----------------------------------------------------------
+
+
+def test_plane_delivers_and_acks_through_wal(tmp_path):
+    at = FakeSink("autotrade", policy=AT_LEAST_ONCE)
+    an = FakeSink("analytics", policy=LOSSY)
+    plane = make_plane([at, an], tmp_path)
+
+    async def go():
+        plane.start()
+        for i in range(3):
+            plane.enqueue_fired(fake_signal(i), tick_ms=1000 + i)
+        assert await plane.drain(timeout_s=5.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    assert len(at.delivered) == 3 and len(an.delivered) == 3
+    assert plane.lane("autotrade").acked == 3
+    # every durable entry acked -> compaction at close leaves nothing
+    assert (tmp_path / "outbox.wal.jsonl").read_text() == ""
+    # identity: the dedupe key is trace/seq/strategy/symbol
+    assert entry_id_of("trace0", 0, "mrf", "S000USDT") == (
+        "trace0/0/mrf/S000USDT"
+    )
+
+
+def test_plane_retries_then_delivers(tmp_path):
+    at = FakeSink("autotrade", policy=AT_LEAST_ONCE, fail_times=2)
+    plane = make_plane([at], tmp_path, breaker_threshold=10)
+
+    async def go():
+        plane.start()
+        plane.enqueue_fired(fake_signal(0))
+        assert await plane.drain(timeout_s=5.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    assert at.attempts == 3 and len(at.delivered) == 1
+    assert plane.lane("autotrade").retries == 2
+
+
+def test_lossy_sheds_on_retries_exhausted_and_queue_full(tmp_path):
+    an = FakeSink("analytics", policy=LOSSY, fail_times=99)
+    plane = make_plane(
+        [an], tmp_path, retry_max=2, queue_max=1, breaker_threshold=100
+    )
+
+    async def go():
+        plane.start()
+        plane.enqueue_fired(fake_signal(0))
+        await plane.drain(timeout_s=5.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    lane = plane.lane("analytics")
+    assert lane.shed.get("retries_exhausted") == 1
+    assert an.attempts == 2 and an.delivered == []
+
+    # queue_full shed: a lossy queue of 1 with no worker running
+    an2 = FakeSink("analytics", policy=LOSSY)
+    plane2 = make_plane([an2], tmp_path, queue_max=1)
+    for i in range(3):
+        plane2.enqueue(
+            Envelope(entry_id=f"b/{i}", sink="analytics", payload=i)
+        )
+    assert plane2.lane("analytics").shed.get("queue_full") == 2
+
+
+def test_at_least_once_defers_to_wal_on_queue_full(tmp_path):
+    at = FakeSink("autotrade", policy=AT_LEAST_ONCE, latency_s=0.01)
+    plane = make_plane([at], tmp_path, queue_max=2)
+
+    async def go():
+        plane.start()
+        # burst past the bound BEFORE the worker can drain: the overflow
+        # parks WAL-only and the worker sweeps it back in
+        for i in range(8):
+            plane.enqueue_fired(fake_signal(i))
+        assert plane.lane("autotrade").deferred > 0
+        assert await plane.drain(timeout_s=10.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    # nothing lost: all 8 delivered exactly once
+    assert len(at.delivered) == 8
+    assert plane.lane("autotrade").deferred == 0
+
+
+def test_breaker_open_sheds_lossy_and_parks_durable(tmp_path):
+    at = FakeSink("autotrade", policy=AT_LEAST_ONCE, fail_times=4)
+    an = FakeSink("analytics", policy=LOSSY, fail_times=2)
+    plane = make_plane(
+        [at, an],
+        tmp_path,
+        retry_max=1,  # lossy: one attempt, then shed
+        breaker_threshold=2,
+        breaker_cooldown_s=0.02,
+    )
+
+    async def go():
+        plane.start()
+        for i in range(3):
+            plane.enqueue_fired(fake_signal(i))
+        assert await plane.drain(timeout_s=10.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    an_lane = plane.lane("analytics")
+    # analytics: 2 scripted failures open the breaker (threshold 2, one
+    # attempt each under retry_max=1), the third entry sheds without an
+    # attempt OR the half-open probe delivers it — either way nothing
+    # hangs and the loss is counted or delivered
+    assert an_lane.breaker.transitions[0] == "open"
+    assert (
+        sum(an_lane.shed.values()) + len(an.delivered) == 3
+    )
+    # autotrade: the storm (4 failures) trips the breaker, the half-open
+    # probes eventually succeed, and EVERY entry lands
+    assert len(at.delivered) == 3
+    assert "open" in plane.lane("autotrade").breaker.transitions
+    assert plane.lane("autotrade").breaker.state == "closed"
+
+
+def test_wal_replay_after_hard_kill(tmp_path):
+    """Satellite (unit half): kill with unacked WAL entries, restart a
+    fresh plane on the same WAL, and the replay delivers everything
+    exactly once."""
+    wal = tmp_path / "kill.wal.jsonl"
+    at = FakeSink("autotrade", policy=AT_LEAST_ONCE, fail_times=10_000)
+    plane = make_plane([at], wal_path=wal, breaker_threshold=2)
+
+    async def storm():
+        plane.start()
+        for i in range(3):
+            plane.enqueue_fired(fake_signal(i))
+        await asyncio.sleep(0.05)  # attempts burn, nothing acks
+        # HARD KILL: no drain, no ack, no compaction
+        for lane in plane._lanes.values():
+            lane.worker.cancel()
+        await asyncio.gather(
+            *(lane.worker for lane in plane._lanes.values()),
+            return_exceptions=True,
+        )
+        plane.closed = True
+        plane.wal.close()
+
+    asyncio.run(storm())
+    probe = DeliveryWal(wal, fsync=False)
+    assert len(probe.unacked()) == 3
+    probe.close()
+
+    at2 = FakeSink("autotrade", policy=AT_LEAST_ONCE)
+    plane2 = make_plane([at2], wal_path=wal)
+
+    async def recover():
+        plane2.start()
+        assert await plane2.drain(timeout_s=5.0)
+        await plane2.aclose()
+
+    asyncio.run(recover())
+    assert plane2.wal_replayed == 3
+    assert len(at2.delivered) == 3
+    # acked on replay -> the WAL is clean for the next boot
+    probe = DeliveryWal(wal, fsync=False)
+    assert probe.unacked() == []
+    probe.close()
+
+
+# -- engine integration -------------------------------------------------------
+
+
+def test_engine_enqueues_and_healthz_reports(tmp_path):
+    """The pipeline half without a device tick: an engine with the plane
+    on fans a FiredSignal out through enqueue_fired, the sinks (stub
+    telegram/analytics/autotrade) ack on the workers, and /healthz grows
+    the ``delivery`` section."""
+    from binquant_tpu.io.emission import FiredSignal
+    from binquant_tpu.io.replay import make_stub_engine
+    from binquant_tpu.schemas import SignalsConsumer
+
+    engine = make_stub_engine(
+        capacity=16,
+        window=120,
+        delivery=True,
+        delivery_wal=str(tmp_path / "engine.wal.jsonl"),
+        delivery_overrides={"delivery_backoff_s": 0.001},
+    )
+    assert engine.delivery is not None
+    value = SignalsConsumer(
+        autotrade=False,
+        current_price=42.0,
+        direction="LONG",
+        algorithm_name="mrf",
+        symbol="TESTUSDT",
+    )
+    signal = FiredSignal(
+        "mrf",
+        "TESTUSDT",
+        0,
+        value,
+        "- Action: LONG ENTRY\n- msg",
+        {"symbol": "TESTUSDT", "algorithm_name": "mrf"},
+    )
+
+    async def go():
+        engine.delivery.start()
+        engine.delivery.enqueue_fired(signal, tick_ms=1234)
+        assert await engine.delivery.drain(timeout_s=5.0)
+        snap = engine.health_snapshot()["delivery"]
+        assert snap["enabled"] and snap["started"]
+        assert snap["sinks"]["autotrade"]["policy"] == "at_least_once"
+        assert snap["sinks"]["autotrade"]["acked"] == 1
+        assert snap["sinks"]["analytics"]["acked"] == 1
+        assert snap["wal"]["puts"] == 1 and snap["wal"]["acks"] == 1
+        await engine.aclose_delivery()
+
+    asyncio.run(go())
+    # the telegram sink actually sent through the stub transport
+    assert len(engine._telegram_sent) == 1
+    # plane off -> the section reads disabled (tier-1 default shape)
+    off = make_stub_engine(capacity=16, window=120, delivery=False)
+    assert off.health_snapshot()["delivery"] == {"enabled": False}
+
+
+def test_telegram_deliver_signal_raises_and_releases_cooldown():
+    from binquant_tpu.io.telegram import TelegramConsumer
+
+    calls = {"n": 0}
+
+    async def transport(chat_id, text):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("scripted transport failure")
+
+    consumer = TelegramConsumer(token="", chat_id="c", transport=transport)
+    consumer._min_send_interval_seconds = 0.0
+    msg = "- Action: LONG ENTRY\n- Strategy: long\n#SYMUSDT"
+
+    async def go():
+        with pytest.raises(RuntimeError):
+            await consumer.deliver_signal(msg)
+        # the failed send forgot its cooldown stamp: the plane's retry of
+        # the SAME message is admitted, not suppressed as a duplicate
+        assert await consumer.deliver_signal(msg) is True
+        # a genuine duplicate afterwards IS suppressed
+        assert await consumer.deliver_signal(msg) is False
+
+    asyncio.run(go())
+    assert calls["n"] == 2
+
+
+def test_telegram_deliver_signal_cancelled_forgets_cooldown():
+    from binquant_tpu.io.telegram import TelegramConsumer
+
+    calls = {"n": 0}
+
+    async def transport(chat_id, text):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            await asyncio.sleep(60)  # hang past the plane's deadline
+
+    consumer = TelegramConsumer(token="", chat_id="c", transport=transport)
+    consumer._min_send_interval_seconds = 0.0
+    msg = "- Action: LONG ENTRY\n- Strategy: long\n#SYMUSDT"
+
+    async def go():
+        # the plane's per-attempt deadline cancels the hung send
+        # (CancelledError, a BaseException — not an Exception)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(consumer.deliver_signal(msg), timeout=0.05)
+        # the cancelled send forgot its cooldown stamp: the plane's retry
+        # is admitted and actually sends — NOT suppressed as a duplicate
+        # and acked without ever reaching the wire
+        assert await consumer.deliver_signal(msg) is True
+
+    asyncio.run(go())
+    assert calls["n"] == 2
+
+
+def test_autotrade_delivery_id_stamp_survives_wal_roundtrip():
+    from binquant_tpu.io.emission import AutotradeSink
+    from binquant_tpu.schemas import SignalsConsumer
+
+    sink = AutotradeSink(at_consumer=None)
+    # an untraced tick's payload has no trace_id/tick_seq metadata — the
+    # stamp is the downstream dedupe key for a post-kill replay
+    value = SignalsConsumer(symbol="TESTUSDT", algorithm_name="mrf")
+    sink.stamp(value, "t1978200/0/mrf/TESTUSDT")
+    rehydrated = sink.from_wal(sink.to_wal(value))
+    assert rehydrated.metadata["delivery_id"] == "t1978200/0/mrf/TESTUSDT"
+    # stamping is idempotent: a traced payload keeps its original id
+    sink.stamp(rehydrated, "other/1/mrf/TESTUSDT")
+    assert rehydrated.metadata["delivery_id"] == "t1978200/0/mrf/TESTUSDT"
+
+
+def test_worker_error_requeues_durable_envelope(tmp_path):
+    """A non-sink exception escaping _deliver (a worker bug, a failing
+    WAL ack write) must not drop an at-least-once envelope in-process."""
+    sink = FakeSink(name="autotrade", policy=AT_LEAST_ONCE)
+    plane = make_plane([sink], tmp_path)
+    orig = plane._deliver
+    calls = {"n": 0}
+
+    async def flaky_deliver(lane, env):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("scripted worker bug")
+        await orig(lane, env)
+
+    plane._deliver = flaky_deliver
+
+    async def go():
+        plane.start()
+        plane.enqueue_fired(fake_signal(1))
+        assert await plane.drain(timeout_s=5.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    assert [p["symbol"] for p in sink.delivered] == ["S001USDT"]
+
+
+def test_worker_error_sheds_lossy_envelope_counted(tmp_path):
+    sink = FakeSink(name="analytics", policy=LOSSY)
+    plane = make_plane([sink])
+
+    async def broken_deliver(lane, env):
+        raise RuntimeError("scripted worker bug")
+
+    plane._deliver = broken_deliver
+
+    async def go():
+        plane.start()
+        plane.enqueue_fired(fake_signal(2))
+        assert await plane.drain(timeout_s=5.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    assert plane.lane("analytics").shed.get("worker_error") == 1
+
+
+# -- bounded binbot REST (satellite) ------------------------------------------
+
+
+def test_binbot_retries_then_succeeds_and_exhausts():
+    import random
+
+    from binquant_tpu.exceptions import BinbotError
+    from binquant_tpu.io.binbot import BinbotApi
+    from binquant_tpu.io.replay import StubSession
+    from binquant_tpu.sim.chaos import FlakySession
+
+    session = FlakySession(StubSession(), plan=["5xx", "ok"])
+    api = BinbotApi(
+        "http://stub",
+        session=session,
+        retry_max=1,
+        retry_backoff_s=0.001,
+        rng=random.Random(7),
+    )
+    # first attempt eats the scripted 503, the in-client retry succeeds
+    assert api.dispatch_create_signal({"x": 1}) is not None
+    assert session.failures == 1
+
+    session2 = FlakySession(StubSession(), plan=["timeout"] * 10)
+    api2 = BinbotApi(
+        "http://stub",
+        session=session2,
+        retry_max=2,
+        retry_backoff_s=0.001,
+        rng=random.Random(7),
+    )
+    with pytest.raises(TimeoutError):
+        api2.dispatch_create_signal({"x": 1})
+    assert session2.failures == 3  # 1 attempt + 2 retries, then it raised
+
+    # 4xx is a deterministic rejection: never retried
+    class Flat4xx:
+        def __init__(self):
+            self.calls = 0
+
+        def request(self, method, url, **kw):
+            self.calls += 1
+            return StubSession._Resp({"data": {}}, status_code=404)
+
+    s404 = Flat4xx()
+    api3 = BinbotApi("http://stub", session=s404, retry_max=3)
+    with pytest.raises(BinbotError):
+        api3.dispatch_create_signal({"x": 1})
+    assert s404.calls == 1
+
+
+# -- report golden ------------------------------------------------------------
+
+
+def test_delivery_report_golden(tmp_path):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import delivery_report
+
+    events = [
+        {"event": "delivery_breaker", "sink": "autotrade", "state": "open",
+         "consecutive_failures": 2},
+        {"event": "delivery_shed", "sink": "analytics",
+         "reason": "queue_full"},
+        {"event": "delivery_breaker", "sink": "autotrade",
+         "state": "half_open", "consecutive_failures": 2},
+        {"event": "delivery_breaker", "sink": "autotrade", "state": "closed",
+         "consecutive_failures": 0},
+        {"event": "delivery_wal_replay", "entries": 3},
+        {"event": "delivery_ack", "sink": "autotrade", "id": "t1/0/mrf/A",
+         "attempts": 3, "replayed": False},
+        {"event": "delivery_ack", "sink": "autotrade", "id": "t2/0/mrf/B",
+         "attempts": 1, "replayed": True},
+        {"event": "delivery_summary", "sinks": {
+            "autotrade": {"policy": "at_least_once", "enqueued": 2,
+                          "acked": 2, "retries": 2, "shed": {},
+                          "wal_replayed": 1, "breaker": "closed",
+                          "breaker_transitions": ["open", "half_open",
+                                                  "closed"]},
+            "analytics": {"policy": "lossy", "enqueued": 5, "acked": 4,
+                          "retries": 0, "shed": {"queue_full": 1},
+                          "wal_replayed": 0, "breaker": "closed",
+                          "breaker_transitions": []},
+        }},
+    ]
+    log = tmp_path / "events.jsonl"
+    with open(log, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    expected = "\n".join([
+        "breaker  autotrade    -> open       after 2 consecutive failures",
+        "shed     analytics    reason=queue_full",
+        "breaker  autotrade    -> half_open  after 2 consecutive failures",
+        "breaker  autotrade    -> closed     after 0 consecutive failures",
+        "replay   WAL -> 3 unacked entries re-enqueued at boot",
+        "acked    autotrade    2 deliveries, 2.00 attempts/ack"
+        " (1 via WAL replay)",
+        "",
+        "sink         policy           enq   ack retry  shed replay  breaker",
+        "analytics    lossy              5     4     0     1      0  closed",
+        "               shed[queue_full] = 1",
+        "autotrade    at_least_once      2     2     2     0      1"
+        "  closed (open>half_open>closed)",
+    ])
+    assert delivery_report.render_report(
+        delivery_report.load_delivery_events(log)
+    ) == expected
+
+
+# -- the chaos drill (slow lane: make delivery-smoke / make scenarios) --------
+
+
+@pytest.mark.slow
+def test_delivery_chaos_drill_kill_restore(tmp_path):
+    """ISSUE 13 acceptance + restore-under-delivery-fault satellite: a
+    scripted autotrade 5xx/timeout storm with a scripted breaker cycle
+    and an analytics queue-saturation burst, killed mid-storm with
+    unacked WAL entries, restored, and driven to the end — the delivered
+    autotrade set equals the uninterrupted oracle's with zero duplicates
+    past the dedupe key, the WAL replay carried the kill's backlog, and
+    the finalize emit dwell stayed bounded."""
+    from binquant_tpu.sim.chaos import delivery_chaos_drill
+
+    facts = delivery_chaos_drill(workdir=str(tmp_path))
+    assert facts["ok"], facts
+    assert facts["lost_autotrade"] == 0
+    assert facts["duplicate_keys"] == 0
+    assert facts["unacked_at_kill"] > 0
+    assert facts["wal_replayed"] > 0
+    assert facts["breaker_transitions"][:5] == [
+        "open", "half_open", "open", "half_open", "closed",
+    ]
+    assert facts["analytics_shed"].get("queue_full", 0) > 0

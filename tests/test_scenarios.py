@@ -348,6 +348,59 @@ def test_scenario_report_cli(tmp_path, capsys):
     assert "1/1 scenarios passed" in capsys.readouterr().out
 
 
+def test_bc_dirty_pressure_nan_decode(tmp_path):
+    """Satellite (ROADMAP 5a): the bc_dirty pressure family withholds
+    ONLY the BTC row's candles for six mid-stream buckets — every other
+    row's 15m append is asymmetric, the beta/corr carry marks them dirty,
+    and a capitulation hammer fires INSIDE the window. The invariant: a
+    dirty row's BTC posture is UNKNOWN, so the emitted analytics record
+    serializes btc_beta/btc_corr as null (NaN-decode) — never 0.0, which
+    is a legitimate measured value. Routing stays clean (the late BTC
+    bars are strictly-newer appends), pinned by the corpus run's
+    serial==scanned==oracle equality."""
+    sc = SCENARIOS["bc_dirty_pressure"]
+    spec = sc.spec
+    path = tmp_path / "bcd.jsonl"
+    write_scenario_file(sc, path)
+    # the stream actually scripts the asymmetry: BTC candles re-routed
+    raw = [json.loads(line) for line in open(path)]
+    tagged = [k for k in raw if "_deliver_bucket" in k]
+    assert tagged and all(k["symbol"] == "BTCUSDT" for k in tagged)
+
+    from binquant_tpu.io.replay import make_stub_engine
+
+    engine = make_stub_engine(
+        capacity=spec.capacity,
+        window=spec.window,
+        incremental=True,
+        scan_chunk=spec.scan_chunk,
+        enabled_strategies=set(spec.enabled_strategies),
+    )
+    seq = tick_seq(path)
+    out = []
+
+    async def go():
+        for now_ms, klines in seq:
+            for k in klines:
+                engine.ingest(k)
+            out.extend(await engine.process_tick(now_ms=now_ms))
+        out.extend(await engine.flush_pending())
+
+    asyncio.run(go())
+    # signals fired while the carry was dirty: null BTC posture, not 0.0
+    assert len(out) >= 1
+    for signal in out:
+        indicators = signal.analytics["indicators"]
+        assert indicators["btc_beta"] is None
+        assert indicators["btc_corr"] is None
+    # the resync-pressure gauge saw the dirty rows
+    from binquant_tpu.obs.instruments import BC_DIRTY_ROWS
+
+    assert BC_DIRTY_ROWS.value > 0
+    # no rewrite/churn reroute: the late BTC bars are clean appends
+    assert set(engine.full_recompute_reasons) == {"cold_start"}
+
+
 # -- slow lane (make scenarios) ----------------------------------------------
 
 

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Render delivery-plane activity from the JSONL event log.
+
+The durable delivery plane (``binquant_tpu/io/delivery.py``) emits
+``delivery_*`` events as it works: ``delivery_breaker`` on every circuit
+transition, ``delivery_shed`` per counted loss, ``delivery_ack`` per
+confirmed delivery, ``delivery_wal_replay`` when a boot re-enqueues
+unacked entries, and one ``delivery_summary`` scoreboard when a plane
+retires. This tool turns an event log back into the per-sink delivery
+story without any service in the loop (golden-pinned like
+scenario_report — keep format changes deliberate):
+
+    python tools/delivery_report.py /tmp/bqt_delivery_events.jsonl
+    python tools/delivery_report.py events.jsonl --sink autotrade
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+DELIVERY_EVENTS = (
+    "delivery_breaker",
+    "delivery_shed",
+    "delivery_ack",
+    "delivery_wal_replay",
+    "delivery_summary",
+    "binbot_retry_exhausted",
+)
+
+
+def load_delivery_events(path: str | Path) -> list[dict]:
+    """All delivery-plane events, in file order; corrupt lines (a torn
+    write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") in DELIVERY_EVENTS:
+                out.append(record)
+    return out
+
+
+def render_summary(summary: dict) -> list[str]:
+    """One ``delivery_summary`` scoreboard → the per-sink table."""
+    lines = [
+        f"{'sink':<12} {'policy':<14} {'enq':>5} {'ack':>5} "
+        f"{'retry':>5} {'shed':>5} {'replay':>6}  breaker"
+    ]
+    for name in sorted(summary.get("sinks", {})):
+        cell = summary["sinks"][name]
+        shed_total = sum((cell.get("shed") or {}).values())
+        transitions = cell.get("breaker_transitions") or []
+        breaker = cell.get("breaker", "closed")
+        if transitions:
+            breaker += " (" + ">".join(transitions) + ")"
+        lines.append(
+            f"{name:<12} {cell.get('policy', '?'):<14}"
+            f" {cell.get('enqueued', 0):>5} {cell.get('acked', 0):>5}"
+            f" {cell.get('retries', 0):>5} {shed_total:>5}"
+            f" {cell.get('wal_replayed', 0):>6}  {breaker}"
+        )
+        for reason in sorted(cell.get("shed") or {}):
+            lines.append(
+                f"{'':<12}   shed[{reason}] = {cell['shed'][reason]}"
+            )
+    return lines
+
+
+def render_report(events: list[dict], sink: str | None = None) -> str:
+    """The deterministic report: breaker/shed/replay timeline, ack
+    tallies, and the final per-sink summary table."""
+    lines: list[str] = []
+    acks: dict[str, int] = {}
+    ack_attempts: dict[str, int] = {}
+    replays: dict[str, int] = {}
+    last_summary: dict | None = None
+    exhausted = 0
+    for e in events:
+        if sink and e.get("sink") not in (None, sink):
+            continue
+        kind = e.get("event")
+        if kind == "delivery_breaker":
+            lines.append(
+                f"breaker  {e.get('sink', '?'):<12} -> {e.get('state', '?'):<10}"
+                f" after {e.get('consecutive_failures', 0)} consecutive"
+                " failures"
+            )
+        elif kind == "delivery_shed":
+            lines.append(
+                f"shed     {e.get('sink', '?'):<12} reason={e.get('reason', '?')}"
+            )
+        elif kind == "delivery_ack":
+            name = e.get("sink", "?")
+            acks[name] = acks.get(name, 0) + 1
+            ack_attempts[name] = ack_attempts.get(name, 0) + int(
+                e.get("attempts", 1) or 1
+            )
+            if e.get("replayed"):
+                replays[name] = replays.get(name, 0) + 1
+        elif kind == "delivery_wal_replay":
+            lines.append(
+                f"replay   WAL -> {e.get('entries', 0)} unacked"
+                " entries re-enqueued at boot"
+            )
+        elif kind == "binbot_retry_exhausted":
+            exhausted += 1
+        elif kind == "delivery_summary":
+            last_summary = e
+    for name in sorted(acks):
+        mean = ack_attempts[name] / acks[name]
+        extra = (
+            f" ({replays[name]} via WAL replay)" if replays.get(name) else ""
+        )
+        lines.append(
+            f"acked    {name:<12} {acks[name]} deliveries,"
+            f" {mean:.2f} attempts/ack{extra}"
+        )
+    if exhausted:
+        lines.append(f"binbot   {exhausted} retry-budget exhaustions")
+    if last_summary is not None:
+        lines.append("")
+        lines.extend(render_summary(last_summary))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument("--sink", help="render only this sink's activity")
+    args = parser.parse_args(argv)
+
+    events = load_delivery_events(args.log)
+    if not events:
+        print(f"no delivery events in {args.log}", file=sys.stderr)
+        return 1
+    print(render_report(events, sink=args.sink))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

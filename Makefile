@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -99,6 +99,23 @@ help:
 	@echo "               saturation burst, ZERO autotrade loss and ZERO"
 	@echo "               duplicates past the (trace_id, tick_seq) dedupe"
 	@echo "               key — rendered by tools/delivery_report.py"
+	@echo "  fanout-smoke- subscription fan-out plane lane (ISSUE 14):"
+	@echo "               the pytest drills (bitset pack/unpack props,"
+	@echo "               device-match-vs-Python-oracle equality, churn"
+	@echo "               plane correctness + incremental-resync kinds,"
+	@echo "               replayed-burst recipient parity across all four"
+	@echo "               drives, WS/SSE hub shed + cursor resume over"
+	@echo "               real sockets, report golden; slow adds the"
+	@echo "               1M-subscription single-dispatch smoke + the"
+	@echo "               churn-storm chaos drill), then the standalone"
+	@echo "               drill with the event log on — churn storm mid-"
+	@echo "               stream, stalled consumer shedding counted, the"
+	@echo "               autotrade group untouched, reconnect-with-"
+	@echo "               cursor replaying the gap — rendered by"
+	@echo "               tools/fanout_report.py. The 1M-population"
+	@echo "               kernel number is 'python bench.py"
+	@echo "               --fanout-throughput' (writes"
+	@echo "               BENCH_FANOUT_CPU.json)"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run; gated"
 	@echo "               to ONE shard-compatible executable by default"
 	@echo "               (BQT_DRYRUN_PHASES=tick_step — the three-"
@@ -263,6 +280,24 @@ delivery-smoke:
 	print({k: v for k, v in facts.items() if k != 'checks'}); \
 	assert facts['ok'], facts['checks']"
 	python tools/delivery_report.py /tmp/bqt_delivery_events.jsonl
+
+# The subscription fan-out lane (ISSUE 14): tier-1 keeps the cheap
+# drills (pack/unpack props, oracle equality, churn correctness, the
+# four-drive recipient parity, hub sockets, report golden); this target
+# adds the slow 1M-subscription single-dispatch smoke + the chaos drill,
+# then re-runs the drill standalone with the event log on so the report
+# renders the churn/shed/resume story. The 1M-population acceptance
+# bench is `python bench.py --fanout-throughput` (BENCH_FANOUT_CPU.json).
+fanout-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fanout.py -q \
+		-p no:cacheprovider
+	rm -f /tmp/bqt_fanout_events.jsonl
+	BQT_EVENT_LOG=/tmp/bqt_fanout_events.jsonl JAX_PLATFORMS=cpu \
+	python -c "from binquant_tpu.sim.chaos import fanout_chaos_drill; \
+	facts = fanout_chaos_drill(); \
+	print({k: v for k, v in facts.items() if k != 'checks'}); \
+	assert facts['ok'], facts['checks']"
+	python tools/fanout_report.py /tmp/bqt_fanout_events.jsonl --top 5
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

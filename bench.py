@@ -621,6 +621,233 @@ def run_outcome_cost(
     }
 
 
+def run_fanout_throughput(
+    n_subs: int = 1_000_000,
+    fired: int = 8,
+    iters: int = 20,
+    oracle_sample: int = 20_000,
+    replay_symbols: int = 16,
+    replay_ticks: int = 60,
+    replay_subs: int = 10_000,
+) -> dict:
+    """Subscription fan-out match-kernel throughput (ISSUE 14).
+
+    Arm 1 (headline): bulk-load ``n_subs`` subscriptions (mixed symbol/
+    strategy/regime criteria + per-user strength floors) into the packed
+    bitset planes, push them to the device once, then measure the ONE
+    jit'd dispatch that joins ``fired`` fired slots against the whole
+    population — (subscriptions x fired-signals)/s, with the pure-Python
+    oracle extrapolated from a sample as the what-it-replaces baseline
+    (the ROADMAP's "a million subscriptions costs one extra kernel, not
+    a Python loop").
+
+    Arm 2 (integration overhead): an identical replayed burst through
+    the serial drive with the plane ON (``replay_subs`` subscribers) vs
+    BQT_FANOUT=0 — median tick wall both ways (the plane must not tax
+    unfired ticks) plus the measured match cost per FIRED tick (sync
+    check + pad + dispatch + packed-words D2H), compile excluded by
+    pre-warming the fired-count buckets."""
+    from binquant_tpu.engine.step import STRATEGY_ORDER
+    from binquant_tpu.enums import MarketRegimeCode
+    from binquant_tpu.fanout.kernel import DevicePlanes, popcount_words
+    from binquant_tpu.fanout.registry import (
+        INVALID_REGIME_ROW,
+        Subscription,
+        SubscriptionRegistry,
+    )
+
+    # -- arm 1: the 1M-subscription single-dispatch join --------------------
+    sym_rows = {f"S{j:03d}USDT": j for j in range(64)}
+    symbols = list(sym_rows)
+    n_regimes = len(MarketRegimeCode)
+
+    def make_sub(i: int) -> Subscription:
+        return Subscription(
+            f"u{i}",
+            symbols=(
+                frozenset({symbols[i % len(symbols)]})
+                if i % 4 == 0
+                else None
+            ),
+            strategies=frozenset({STRATEGY_ORDER[i % len(STRATEGY_ORDER)]}),
+            regimes=(
+                frozenset({i % n_regimes}) if i % 8 == 0 else None
+            ),
+            min_strength=(i % 100) / 100.0,
+        )
+
+    t0 = time.perf_counter()
+    subs = [make_sub(i) for i in range(n_subs)]
+    build_s = time.perf_counter() - t0
+    reg = SubscriptionRegistry(symbol_capacity=64, capacity=n_subs)
+    t0 = time.perf_counter()
+    reg.bulk_load(subs, row_of=sym_rows.get)
+    bulk_load_s = time.perf_counter() - t0
+    dev = DevicePlanes(reg)
+    t0 = time.perf_counter()
+    assert dev.sync() == "full"
+    sync_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(14)
+    rows = rng.integers(0, 64, size=fired).astype(np.int32)
+    strats = rng.integers(0, len(STRATEGY_ORDER), size=fired).astype(
+        np.int32
+    )
+    scores = np.float32(rng.normal(0, 0.6, size=fired))
+    for _ in range(2):  # compile + steady-state warmup
+        dev.match(rows, strats, scores, 0)
+    dispatch_s: list[float] = []
+    recipients = 0
+    for it in range(iters):
+        sc = np.float32(rng.normal(0, 0.6, size=fired))
+        t0 = time.perf_counter()
+        words = dev.match(rows, strats, sc, it % n_regimes)
+        dispatch_s.append(time.perf_counter() - t0)  # np.asarray = D2H sync
+        recipients = popcount_words(words)
+    best_s = min(dispatch_s)
+
+    # the Python oracle, extrapolated from a sample population (running
+    # it at 1M would take minutes — which is the point)
+    sample_reg = SubscriptionRegistry(
+        symbol_capacity=64, capacity=oracle_sample
+    )
+    sample_reg.bulk_load(
+        [make_sub(i) for i in range(oracle_sample)], row_of=sym_rows.get
+    )
+    entries = [
+        (STRATEGY_ORDER[si], symbols[ri], float(sc))
+        for si, ri, sc in zip(strats, rows, scores)
+    ]
+    t0 = time.perf_counter()
+    sample_reg.match_oracle(entries, 0)
+    oracle_sample_s = time.perf_counter() - t0
+    oracle_s_est = oracle_sample_s * (n_subs / oracle_sample)
+
+    # -- arm 2: per-tick overhead vs BQT_FANOUT=0 over one replay -----------
+    import tempfile
+
+    from binquant_tpu.fanout.kernel import bucket as _bucket
+    from binquant_tpu.io.replay import (
+        generate_replay_file,
+        load_klines_by_tick,
+        make_stub_engine,
+    )
+
+    stream = tempfile.mktemp(prefix="bqt_fanout_bench_", suffix=".jsonl")
+    generate_replay_file(
+        stream, n_symbols=replay_symbols, n_ticks=replay_ticks
+    )
+    by_tick = load_klines_by_tick(stream)
+    seq = [
+        (
+            (b + 1) * 900 * 1000,
+            sorted(by_tick[b], key=lambda k: k["open_time"]),
+        )
+        for b in sorted(by_tick)
+    ]
+
+    def drive(engine) -> list[float]:
+        ticks: list[float] = []
+
+        async def go():
+            for now_ms, klines in seq:
+                for k in klines:
+                    engine.ingest(k)
+                t0 = time.perf_counter()
+                await engine.process_tick(now_ms=now_ms)
+                ticks.append((time.perf_counter() - t0) * 1000)
+            await engine.flush_pending()
+            await engine.aclose_fanout()
+
+        asyncio.run(go())
+        return ticks[5:]  # drop engine-compile warmup ticks
+
+    # delivery pinned off like every bench lane (inline sinks): the arm
+    # quotes the PLANE's overhead, and an un-aclosed delivery plane's
+    # workers would wedge asyncio.run teardown. A throwaway engine pays
+    # the engine-executable compiles first so BOTH timed drives run on a
+    # warm jit cache (the compile bill would otherwise land entirely on
+    # whichever arm drives first and swamp the comparison).
+    drive(
+        make_stub_engine(
+            capacity=replay_symbols, window=120, fanout=False,
+            delivery=False,
+        )
+    )
+    off = make_stub_engine(
+        capacity=replay_symbols, window=120, fanout=False, delivery=False
+    )
+    ticks_off = drive(off)
+
+    on = make_stub_engine(
+        capacity=replay_symbols, window=120, fanout=True, delivery=False
+    )
+    on.fanout.bulk_load(
+        [make_sub(i) for i in range(replay_subs)]
+    )
+    # pre-warm the fired-count pad buckets so arm-2 timings exclude the
+    # match kernel's compile (it retraces per power-of-two bucket only)
+    on.fanout.sync_device()
+    for k in (1, _bucket(4) + 1, _bucket(8) + 1):
+        on.fanout._device.match(
+            np.zeros(k, np.int32),
+            np.zeros(k, np.int32),
+            np.zeros(k, np.float32),
+            INVALID_REGIME_ROW,
+        )
+    match_acc = {"s": 0.0, "n": 0}
+    orig_match = on.fanout.match
+
+    def timed_match(fired_signals, ctx_scalars):
+        t0 = time.perf_counter()
+        words = orig_match(fired_signals, ctx_scalars)
+        match_acc["s"] += time.perf_counter() - t0
+        match_acc["n"] += 1
+        return words
+
+    on.fanout.match = timed_match
+    ticks_on = drive(on)
+
+    med_off = float(np.median(ticks_off))
+    med_on = float(np.median(ticks_on))
+    return {
+        "subscriptions": n_subs,
+        "fired_slots": fired,
+        "plane_words": reg.words,
+        "build_population_s": round(build_s, 3),
+        "bulk_load_s": round(bulk_load_s, 3),
+        "device_full_sync_s": round(sync_s, 3),
+        "match_dispatch_ms_best": round(best_s * 1000, 3),
+        "match_dispatch_ms_mean": round(
+            float(np.mean(dispatch_s)) * 1000, 3
+        ),
+        "sub_signal_matches_per_s": round(n_subs * fired / best_s),
+        "last_match_recipients": recipients,
+        "python_oracle_s_at_1m_est": round(oracle_s_est, 3),
+        "python_oracle_sampled": oracle_sample,
+        "speedup_vs_python_oracle_x": round(oracle_s_est / best_s, 1),
+        "replay_overhead": {
+            "symbols": replay_symbols,
+            "ticks": len(seq),
+            "subscribers": replay_subs,
+            "tick_median_ms_fanout_off": round(med_off, 3),
+            "tick_median_ms_fanout_on": round(med_on, 3),
+            "overhead_median_ms_per_tick": round(med_on - med_off, 3),
+            "fired_ticks_matched": match_acc["n"],
+            "match_ms_per_fired_tick": (
+                round(match_acc["s"] / match_acc["n"] * 1000, 3)
+                if match_acc["n"]
+                else None
+            ),
+        },
+        "note": (
+            "CPU-model numbers — rerun on silicon when the tunnel "
+            "returns."
+        ),
+        "measurement_epoch": MEASUREMENT_EPOCH,
+    }
+
+
 def run_ring_traffic(
     num_symbols: int = 2048, window: int = 400, ticks: int = 64
 ) -> dict:
@@ -1761,6 +1988,11 @@ def main() -> int | None:
     # (BENCH_OUTCOMES_CPU.json). Set BQT_OUTCOMES=1 to measure a
     # tracker-on drive explicitly.
     os.environ.setdefault("BQT_OUTCOMES", "0")
+    # Subscription fan-out plane likewise pinned OFF in throughput arms
+    # (the benches quote the plane-free hot path; its own cost is the
+    # dedicated --fanout-throughput arm, BENCH_FANOUT_CPU.json). Set
+    # BQT_FANOUT=1 to measure a plane-on drive explicitly.
+    os.environ.setdefault("BQT_FANOUT", "0")
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny shapes")
     parser.add_argument(
@@ -1816,6 +2048,22 @@ def main() -> int | None:
         help="signal-outcome maturation gather vs the wire step "
         "(ISSUE 12 acceptance: <5%% extra bytes at 2048x400); writes "
         "BENCH_OUTCOMES_CPU.json at the acceptance shape",
+    )
+    parser.add_argument(
+        "--fanout-throughput",
+        action="store_true",
+        help="subscription match-kernel throughput (ISSUE 14): ONE "
+        "dispatch joining --fanout-subs subscriptions against a fired "
+        "tick, vs the extrapolated Python oracle, plus per-tick replay "
+        "overhead vs BQT_FANOUT=0; writes BENCH_FANOUT_CPU.json at "
+        ">=1M subscriptions on the CPU model",
+    )
+    parser.add_argument(
+        "--fanout-subs",
+        type=int,
+        default=1_000_000,
+        help="population size for --fanout-throughput (smaller = "
+        "print-only smoke)",
     )
     parser.add_argument(
         "--backtest-throughput",
@@ -1925,6 +2173,26 @@ def main() -> int | None:
         print(json.dumps(record))
         if jax.default_backend() == "cpu" and record_shape:
             with open("BENCH_BACKTEST_CPU.json", "w") as f:
+                json.dump(record, f, indent=1)
+        return
+
+    if args.fanout_throughput:
+        import jax
+
+        n_subs = 10_000 if args.smoke else args.fanout_subs
+        r = run_fanout_throughput(n_subs=n_subs)
+        record = {
+            "metric": "fanout_match_sub_signals_per_s",
+            "value": r["sub_signal_matches_per_s"],
+            "unit": "sub*signal/s",
+            # the what-it-replaces ratio: one device dispatch vs the
+            # pure-Python subscription loop at the same population
+            "vs_baseline": r["speedup_vs_python_oracle_x"],
+            "detail": r,
+        }
+        print(json.dumps(record))
+        if jax.default_backend() == "cpu" and n_subs >= 1_000_000:
+            with open("BENCH_FANOUT_CPU.json", "w") as f:
                 json.dump(record, f, indent=1)
         return
 

@@ -516,8 +516,10 @@ def run_backtest(
         outcomes=outcomes,
         outcome_horizons=outcome_horizons,
         # inline sinks: the backtest lane pins sink-visible effects
-        # synchronously; the delivery plane has its own lane
+        # synchronously; the delivery + fan-out planes have their own
+        # lanes
         delivery=False,
+        fanout=False,
     )
     engine.at_consumer.market_domination_reversal = market_domination_reversal
     engine.at_consumer.current_market_dominance_is_losers = dominance_is_losers
@@ -855,6 +857,7 @@ def run_param_sweep(
         incremental=False,
         donate=False,
         delivery=False,
+        fanout=False,
     )
     key = engine._wire_enabled_key()
     _check_supported(key, window)

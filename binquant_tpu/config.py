@@ -455,6 +455,63 @@ class Config:
         only unacked puts); 0 disables auto-compaction."""
         return int(self._get("BQT_WAL_COMPACT_EVERY", "256") or "256")
 
+    # -- subscription fan-out plane (binquant_tpu/fanout, ISSUE 14) ----------
+
+    @cached_property
+    def fanout_enabled(self) -> bool:
+        """Subscription fan-out plane: compile user subscriptions into
+        device bitset planes, join every fired tick's deduped signal set
+        against them in ONE extra kernel dispatch, and broadcast matched
+        frames over the WS/SSE hub (behind the delivery plane when it is
+        on). BQT_FANOUT=0 keeps the three-sink path byte-identical (the
+        tier-1 test lane's default — the BQT_TRACE_SAMPLE pattern)."""
+        return self._get("BQT_FANOUT", "1") != "0"
+
+    @cached_property
+    def fanout_capacity(self) -> int:
+        """Initial user-slot capacity of the subscription planes (rounded
+        up to a multiple of 32). Growing past it doubles the planes — the
+        match kernel's one legitimate retrace; size generously for a
+        churn-heavy deployment."""
+        return int(self._get("BQT_FANOUT_CAPACITY", "1024") or "1024")
+
+    @cached_property
+    def fanout_port(self) -> int:
+        """Port for the WS/SSE broadcast hub (/ws + /sse); 0 disables
+        serving (matching + outbox still run so a later hub can replay)."""
+        return int(self._get("BQT_FANOUT_PORT", "0") or 0)
+
+    @cached_property
+    def fanout_host(self) -> str:
+        """Bind address for the broadcast hub. The hub authenticates
+        NOTHING — the user id in the URL is the only credential — so the
+        0.0.0.0 default assumes a private network / an auth-injecting
+        reverse proxy in front (the MetricsServer trust model); bind
+        127.0.0.1 to keep it loopback-only."""
+        return self._get("BQT_FANOUT_HOST", "0.0.0.0") or "0.0.0.0"
+
+    @cached_property
+    def fanout_conn_queue(self) -> int:
+        """Per-connection bounded frame queue; a full queue sheds with
+        bqt_fanout_shed_total{reason=slow_consumer} and marks the
+        connection gapped (reconnect-with-cursor replays the gap)."""
+        return int(self._get("BQT_FANOUT_CONN_QUEUE", "256") or "256")
+
+    @cached_property
+    def fanout_outbox_path(self) -> str:
+        """Broadcast-frame outbox (JSONL, size-bounded): what a
+        reconnecting client's cursor replays from. Empty disables replay.
+        The /tmp default shares the delivery-WAL caveats (per-host,
+        tmpfs-lossy across reboots)."""
+        return self._get("BQT_FANOUT_OUTBOX", "/tmp/binquant_tpu.fanout.jsonl")
+
+    @cached_property
+    def fanout_outbox_cap(self) -> int:
+        """Outbox retention: past 2x this many frames the file rewrites
+        keeping the newest cap (a cursor older than retention replays
+        only the retained tail — the shed is visible as a seq gap)."""
+        return int(self._get("BQT_FANOUT_OUTBOX_CAP", "4096") or "4096")
+
     # -- binbot REST bounds (io/binbot.py satellite) -------------------------
 
     @cached_property

@@ -1,0 +1,31 @@
+"""Subscription fan-out plane (ISSUE 14): registry + bitset compiler,
+the one-dispatch device match kernel, and the WebSocket/SSE broadcast
+tier behind the durable delivery boundary. See README §Fan-out plane."""
+
+from binquant_tpu.fanout.hub import BroadcastOutbox, FanoutHub
+from binquant_tpu.fanout.kernel import (
+    DevicePlanes,
+    pack_words_np,
+    popcount_words,
+    unpack_slots,
+    unpack_words_np,
+)
+from binquant_tpu.fanout.plane import FanoutPlane, FanoutSink
+from binquant_tpu.fanout.registry import (
+    Subscription,
+    SubscriptionRegistry,
+)
+
+__all__ = [
+    "BroadcastOutbox",
+    "DevicePlanes",
+    "FanoutHub",
+    "FanoutPlane",
+    "FanoutSink",
+    "Subscription",
+    "SubscriptionRegistry",
+    "pack_words_np",
+    "popcount_words",
+    "unpack_slots",
+    "unpack_words_np",
+]

@@ -1,0 +1,355 @@
+"""The fan-out plane: match-at-finalize + the broadcast consumer group.
+
+:class:`FanoutPlane` is what the engine owns (``SignalEngine.fanout``,
+``BQT_FANOUT``): the subscription registry, its device-resident plane
+copy, the per-tick match dispatch, the frame outbox, and (when served)
+the WS/SSE hub. The tick thread's whole cost is one extra device kernel
+on fired ticks plus an outbox append per frame — broadcast itself rides
+the PR-13 delivery plane as a lossy consumer group (:class:`FanoutSink`)
+when the plane is on, or a direct bounded-queue offer when it is not.
+
+Cross-backend determinism: the match input is the DEDUPED, provenance-
+stamped fired set every backend produces through the one shared finalize,
+and frame sequence numbers advance in emission order — so serial,
+scanned, backtest, and donated drives publish identical (seq, frame,
+recipient-set) streams (pinned by tests/test_fanout.py against the
+pure-Python :meth:`SubscriptionRegistry.match_oracle`).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+import numpy as np
+
+from binquant_tpu.enums import MarketRegimeCode
+from binquant_tpu.fanout.hub import BroadcastOutbox, FanoutHub
+from binquant_tpu.fanout.kernel import DevicePlanes, popcount_words
+from binquant_tpu.fanout.registry import (
+    INVALID_REGIME_ROW,
+    _STRAT_IDX,
+    Subscription,
+    SubscriptionRegistry,
+)
+from binquant_tpu.io.emission import SignalSink
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.instruments import (
+    FANOUT_MATCH_DISPATCHES,
+    FANOUT_PUBLISHED,
+    FANOUT_RECIPIENTS,
+    FANOUT_RECOMPILES,
+    FANOUT_SHED,
+    FANOUT_SUBSCRIPTIONS,
+)
+
+log = logging.getLogger(__name__)
+
+
+class FanoutPlane:
+    """Subscription fan-out over the engine's fired wire slots.
+
+    ``engine_registry`` is the engine's
+    :class:`~binquant_tpu.engine.buffer.SymbolRegistry` (symbol-name
+    subscriptions resolve to its rows and re-resolve on its ``version``);
+    ``outbox_path`` enables cursor-replayable broadcast durability;
+    :meth:`serve` binds the WS/SSE hub when a deployment wants the
+    broadcast tier up.
+    """
+
+    def __init__(
+        self,
+        engine_registry,
+        capacity: int = 1024,
+        outbox_path: str | None = None,
+        outbox_cap: int = 4096,
+        conn_queue_max: int = 256,
+    ) -> None:
+        self.engine_registry = engine_registry
+        self.subscriptions = SubscriptionRegistry(
+            symbol_capacity=engine_registry.capacity, capacity=capacity
+        )
+        self._device = DevicePlanes(self.subscriptions)
+        self.outbox = (
+            BroadcastOutbox(outbox_path, cap=outbox_cap)
+            if outbox_path
+            else None
+        )
+        # per-slot minimum frame seq: slots are RECYCLED on unsubscribe,
+        # and outbox frames / in-flight delivery-worker frames encode
+        # recipients as slot bits — a new claimant must never receive (or
+        # cursor-replay) frames addressed to the slot's previous owner.
+        # Cursor replay therefore only covers frames published since the
+        # user's CURRENT subscription was created (which also makes a
+        # retained outbox from a previous process — whose slot bits are
+        # meaningless against this boot's fresh registry — unreplayable).
+        self._slot_min_seq: dict[int, int] = {}
+        self.hub = FanoutHub(
+            slot_of=self.subscriptions.slot_of,
+            outbox=self.outbox,
+            conn_queue_max=conn_queue_max,
+            min_seq_of=lambda slot: self._slot_min_seq.get(slot, 0),
+        )
+        self._served = False
+        # behind-the-delivery-plane handoff (FanoutSink attached): the
+        # finalize path skips the direct broadcast and lets the worker
+        # deliver — horizontal scaling seam (ROADMAP item 2)
+        self.sink_attached = False
+        # monotonically increasing frame sequence — the reconnect cursor;
+        # deterministic across drives (advances in emission order). A
+        # reopened persistent outbox seeds it PAST the retained tail so
+        # post-restart frames never collide with logged seqs (a collision
+        # would hide them from every cursor replay)
+        self.seq = (
+            self.outbox.last_seq() + 1 if self.outbox is not None else 0
+        )
+        self.match_dispatches = 0
+        self.published = 0
+        self.matched_recipients = 0
+        self.recompiles = {"full": 0, "incremental": 0}
+
+    # -- subscription churn (delegates stamping metrics) ---------------------
+
+    def subscribe(self, sub: Subscription) -> int:
+        fresh = sub.user_id not in self.subscriptions
+        slot = self.subscriptions.add(sub, row_of=self.engine_registry.row_of)
+        if fresh:
+            self._slot_min_seq[slot] = self.seq
+        self._note_churn("subscribe", sub.user_id, slot)
+        return slot
+
+    def update(self, sub: Subscription) -> int:
+        fresh = sub.user_id not in self.subscriptions
+        slot = self.subscriptions.update(
+            sub, row_of=self.engine_registry.row_of
+        )
+        if fresh:  # update of an unknown user claims a slot like subscribe
+            self._slot_min_seq[slot] = self.seq
+        self._note_churn("update", sub.user_id, slot)
+        return slot
+
+    def unsubscribe(self, user_id: str) -> int | None:
+        slot = self.subscriptions.remove(user_id)
+        if slot is not None:
+            # the freed slot may be reclaimed by another user: any still-
+            # open connection bound to it must close NOW or it would
+            # receive the next claimant's frames (cross-user misdelivery)
+            self.hub.close_user(user_id)
+            self._note_churn("unsubscribe", user_id, slot)
+        return slot
+
+    def bulk_load(self, subs) -> int:
+        subs = list(subs)
+        n = self.subscriptions.bulk_load(
+            subs, row_of=self.engine_registry.row_of
+        )
+        for sub in subs:
+            slot = self.subscriptions.slot_of(sub.user_id)
+            if slot is not None:
+                self._slot_min_seq[slot] = self.seq
+        FANOUT_SUBSCRIPTIONS.set(len(self.subscriptions))
+        return n
+
+    def _note_churn(self, op: str, user_id: str, slot: int) -> None:
+        FANOUT_SUBSCRIPTIONS.set(len(self.subscriptions))
+        get_event_log().emit("fanout_churn", op=op, user=user_id, slot=slot)
+
+    # -- device sync ---------------------------------------------------------
+
+    def sync_device(self) -> str | None:
+        """Bring the device planes current (symbol-row refresh first);
+        returns the recompile kind performed, if any."""
+        self.subscriptions.refresh_rows(
+            self.engine_registry.row_of, self.engine_registry.version
+        )
+        kind = self._device.sync()
+        if kind is not None:
+            self.recompiles[kind] = self.recompiles.get(kind, 0) + 1
+            FANOUT_RECOMPILES.labels(kind=kind).inc()
+        return kind
+
+    # -- the per-tick join ---------------------------------------------------
+
+    @staticmethod
+    def regime_row(ctx_scalars: dict) -> int:
+        regime = int(ctx_scalars.get("market_regime", -1))
+        valid = bool(ctx_scalars.get("valid", False))
+        if valid and 0 <= regime < len(MarketRegimeCode):
+            return regime
+        return INVALID_REGIME_ROW
+
+    def match(self, fired: list, ctx_scalars: dict) -> np.ndarray:
+        """One dispatch joining the deduped fired signals against the
+        subscription planes → ``(len(fired), U32)`` packed recipient
+        words. Fired symbols resolve by NAME against the registry the
+        planes were just synced to — NOT the signal's dispatch-time row,
+        which listing churn may have re-homed between dispatch and
+        finalize; a symbol that no longer resolves gathers the planes'
+        always-empty no-row bucket (wildcard subscribers still match)."""
+        self.sync_device()
+        cap = self.subscriptions.symbol_capacity
+        row_of = self.engine_registry.row_of
+
+        def current_row(symbol: str) -> int:
+            r = row_of(symbol)
+            return r if r is not None and 0 <= r < cap else cap
+
+        rows = np.asarray([current_row(s.symbol) for s in fired], np.int32)
+        strats = np.asarray(
+            [_STRAT_IDX[s.strategy] for s in fired], np.int32
+        )
+        scores = np.asarray(
+            [float(s.value.score or 0.0) for s in fired], np.float32
+        )
+        words = self._device.match(
+            rows, strats, scores, self.regime_row(ctx_scalars)
+        )
+        self.match_dispatches += 1
+        FANOUT_MATCH_DISPATCHES.inc()
+        return words
+
+    def on_fired(
+        self,
+        fired: list,
+        ctx_scalars: dict,
+        tick_ms: int | None = None,
+    ) -> dict:
+        """The finalize hook: match, mint frames (seq + provenance),
+        append the outbox, stamp each signal's ``fanout_frame`` for the
+        delivery consumer group (or broadcast directly when the plane is
+        not behind the delivery tier). Returns span stats."""
+        if not fired:
+            return {"signals": 0, "recipients": 0}
+        words = self.match(fired, ctx_scalars)
+        t_pub = time.perf_counter()
+        total = 0
+        for signal, wrow in zip(fired, words):
+            n = popcount_words(wrow)
+            total += n
+            frame = {
+                "seq": self.seq,
+                "trace_id": signal.trace_id,
+                "tick_seq": signal.tick_seq,
+                "tick_ms": tick_ms,
+                "strategy": signal.strategy,
+                "symbol": signal.symbol,
+                "direction": str(signal.value.direction),
+                "score": float(signal.value.score or 0.0),
+                "autotrade": bool(signal.value.autotrade),
+                "recipients": n,
+            }
+            self.seq += 1
+            self.published += 1
+            FANOUT_PUBLISHED.inc()
+            if self.outbox is not None:
+                # lossy-tier contract: a broadcast-durability I/O failure
+                # (ENOSPC, dead handle) must never abort finalize — the
+                # frame still broadcasts live; only its cursor replay is
+                # lost, counted never silent
+                try:
+                    self.outbox.append(frame, wrow)
+                except Exception:
+                    FANOUT_SHED.labels(reason="outbox_error").inc()
+                    get_event_log().emit(
+                        "fanout_shed",
+                        reason="outbox_error",
+                        seq=frame["seq"],
+                        count=1,
+                    )
+                    log.warning(
+                        "fanout outbox append failed (seq=%d)",
+                        frame["seq"],
+                        exc_info=True,
+                    )
+            get_event_log().emit(
+                "fanout_publish",
+                seq=frame["seq"],
+                strategy=frame["strategy"],
+                symbol=frame["symbol"],
+                recipients=n,
+                trace_id=frame["trace_id"],
+                tick_seq=frame["tick_seq"],
+            )
+            signal.fanout_frame = (frame, wrow, t_pub)
+            if not self.sink_attached:
+                # no delivery plane behind us: bounded-queue offers on the
+                # tick thread (O(connections); the served-at-scale shape
+                # runs behind the delivery worker instead)
+                self.hub.broadcast(frame, wrow, t_pub=t_pub)
+        self.matched_recipients += total
+        FANOUT_RECIPIENTS.inc(total)
+        return {"signals": len(fired), "recipients": total}
+
+    # -- serving -------------------------------------------------------------
+
+    async def serve(self, port: int, host: str = "0.0.0.0") -> int:
+        self.hub.host = host
+        self.hub.port = int(port)
+        bound = await self.hub.start()
+        self._served = True
+        return bound
+
+    async def aclose(self) -> None:
+        if self._served:
+            await self.hub.stop()
+            self._served = False
+        self.emit_summary()
+        if self.outbox is not None:
+            self.outbox.close()
+
+    def emit_summary(self) -> None:
+        hub = self.hub.snapshot()
+        top = sorted(
+            self.hub.totals_by_user.items(), key=lambda kv: (-kv[1], kv[0])
+        )[:10]
+        get_event_log().emit(
+            "fanout_summary",
+            users=len(self.subscriptions),
+            published=self.published,
+            matched_recipients=self.matched_recipients,
+            match_dispatches=self.match_dispatches,
+            recompiles=dict(self.recompiles),
+            frames_sent=hub["frames_sent"],
+            shed=hub["shed"],
+            resumed=hub["resumed"],
+            top_users=[{"user": u, "delivered": n} for u, n in top],
+        )
+
+    def snapshot(self) -> dict:
+        """/healthz ``fanout`` section — attribute reads only."""
+        return {
+            "enabled": True,
+            "subscriptions": self.subscriptions.snapshot(),
+            "published": self.published,
+            "matched_recipients": self.matched_recipients,
+            "match_dispatches": self.match_dispatches,
+            "recompiles": dict(self.recompiles),
+            "behind_delivery": self.sink_attached,
+            "hub": self.hub.snapshot(),
+        }
+
+
+class FanoutSink(SignalSink):
+    """The broadcast tier as a PR-13 consumer group: lossy class — under
+    pressure the trade path stays fresh and broadcast loss is counted
+    (per-connection sheds), never blocking. ``deliver`` hands the matched
+    frame to the hub off the tick thread; a signal the match addressed to
+    nobody delivers as a no-op (still acked — the frame is already in
+    the outbox for cursor replay)."""
+
+    name = "fanout"
+    policy = "lossy"
+
+    def __init__(self, plane: FanoutPlane) -> None:
+        self.plane = plane
+        plane.sink_attached = True
+
+    def encode(self, signal) -> Any:
+        return getattr(signal, "fanout_frame", None)
+
+    async def deliver(self, payload: Any) -> None:
+        if payload is None:
+            return
+        frame, words, t_pub = payload
+        self.plane.hub.broadcast(frame, words, t_pub=t_pub)

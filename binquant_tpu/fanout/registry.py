@@ -1,0 +1,493 @@
+"""Subscription registry + packed-bitset plane compiler (ISSUE 14).
+
+The fan-out plane's data model: a :class:`Subscription` is one user's
+standing filter — symbols × strategies × regimes × a minimum signal
+strength — and the :class:`SubscriptionRegistry` compiles the whole user
+population into dense uint32 bitset planes the device match kernel
+(:mod:`binquant_tpu.fanout.kernel`) joins against a tick's fired slots in
+ONE dispatch:
+
+* ``sym_plane``    — ``(S, U32)``: bit ``u`` of word column set when user
+  ``u`` subscribed to the symbol occupying engine row ``s`` explicitly;
+* ``strat_plane``  — ``(N_strategies, U32)``: per-strategy user bits, row
+  order = ``engine.step.STRATEGY_ORDER``;
+* ``regime_plane`` — ``(len(MarketRegimeCode) + 1, U32)``: per-regime user
+  bits; the extra trailing row is the *invalid-context* bucket (a tick
+  whose market context has not stabilized matches only regime-wildcard
+  subscribers);
+* ``any_masks``    — ``(3, U32)``: the wildcard words (symbols=None /
+  strategies=None / regimes=None — "all"), OR-ed into the corresponding
+  plane gather at match time so a wildcard never pays a per-row fill;
+* ``floors``       — ``(U,)`` f32 per-slot minimum strength (matched
+  against ``|score|``; unoccupied slots carry ``+inf``).
+
+``U32 = capacity // 32`` and ``U = capacity``; user slots pack LSB-first
+into words (slot ``u`` → word ``u >> 5``, bit ``u & 31``), the exact
+layout ``np.packbits(..., bitorder="little")`` produces, so the host
+decodes device words with one ``np.unpackbits`` call.
+
+Churn (add / update / remove) flips ONE bit column host-side and marks
+the touched word dirty; the device copy resynchronizes lazily at the next
+match via a jit'd column scatter (``kind="incremental"`` in
+``bqt_fanout_recompiles_total``) — the tick step is never retraced, and
+the match kernel itself only retraces when the slot capacity doubles
+(``kind="full"``). Symbol subscriptions are stored by NAME and re-resolve
+against the engine's :class:`~binquant_tpu.engine.buffer.SymbolRegistry`
+whenever its ``version`` moves (listing churn re-homes rows).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+import numpy as np
+
+from binquant_tpu.engine.step import STRATEGY_ORDER
+from binquant_tpu.enums import MarketRegimeCode
+
+# index into regime_plane for a tick without a valid market context
+REGIME_ROWS = len(MarketRegimeCode) + 1
+INVALID_REGIME_ROW = len(MarketRegimeCode)
+
+_STRAT_IDX: dict[str, int] = {s: i for i, s in enumerate(STRATEGY_ORDER)}
+
+# any_masks rows
+ANY_SYM, ANY_STRAT, ANY_REGIME = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class Subscription:
+    """One user's standing signal filter. ``None`` criteria mean "all"."""
+
+    user_id: str
+    symbols: frozenset[str] | None = None
+    strategies: frozenset[str] | None = None
+    regimes: frozenset[int] | None = None
+    min_strength: float = 0.0
+
+    def __post_init__(self) -> None:
+        # the floor is quantized to f32 AT THE MODEL BOUNDARY: the device
+        # planes store f32, and an unquantized f64 here would let oracle
+        # and kernel disagree on scores inside the rounding gap (e.g.
+        # floor 0.1: f32(0.1)=0.100000001 matches a score of 0.099999999
+        # on device but not in f64)
+        object.__setattr__(
+            self, "min_strength", float(np.float32(self.min_strength))
+        )
+        if self.strategies is not None:
+            unknown = set(self.strategies) - set(STRATEGY_ORDER)
+            if unknown:
+                raise ValueError(
+                    f"unknown strategies {sorted(unknown)}; valid: "
+                    f"{list(STRATEGY_ORDER)}"
+                )
+        if self.regimes is not None:
+            bad = [r for r in self.regimes if not 0 <= int(r) < len(MarketRegimeCode)]
+            if bad:
+                raise ValueError(
+                    f"regime codes {bad} outside MarketRegimeCode range"
+                )
+
+    def matches(
+        self, strategy: str, symbol: str, score: float,
+        regime: int | None,
+    ) -> bool:
+        """The Python-oracle predicate the device kernel must agree with
+        bit-for-bit. ``regime=None`` is the invalid-context tick."""
+        if self.strategies is not None and strategy not in self.strategies:
+            return False
+        if self.symbols is not None and symbol not in self.symbols:
+            return False
+        if self.regimes is not None and (
+            regime is None or int(regime) not in {int(r) for r in self.regimes}
+        ):
+            return False
+        # compare in f32, exactly as the kernel does (score is cast f32
+        # on the way to the device; min_strength is f32-quantized above)
+        return bool(
+            np.abs(np.float32(score)) >= np.float32(self.min_strength)
+        )
+
+
+@dataclass
+class _SlotRecord:
+    sub: Subscription
+    slot: int
+    # engine rows the symbol set resolved to at the last row refresh
+    rows: list[int] = field(default_factory=list)
+
+
+def _norm_symbols(symbols: Iterable[str] | None) -> frozenset[str] | None:
+    if symbols is None:
+        return None
+    return frozenset(s.strip().upper() for s in symbols)
+
+
+class SubscriptionRegistry:
+    """Host-authoritative subscription store + bitset plane compiler.
+
+    ``capacity`` is the user-slot bound (rounded up to a multiple of 32);
+    adding past it doubles the planes (a deliberate, counted match-kernel
+    retrace — the only one). Every mutation updates the numpy planes in
+    place and marks the touched word column dirty; the device sync policy
+    lives in :class:`binquant_tpu.fanout.plane.FanoutPlane`.
+    """
+
+    def __init__(self, symbol_capacity: int, capacity: int = 1024) -> None:
+        self.symbol_capacity = int(symbol_capacity)
+        cap = max(int(capacity), 32)
+        self.capacity = (cap + 31) & ~31
+        self._records: dict[str, _SlotRecord] = {}
+        # user_ids with EXPLICIT symbol criteria — the only records a
+        # symbol-row refresh must re-resolve (keeps listing churn
+        # O(explicit subs), not O(population))
+        self._explicit: set[str] = set()
+        self._slot_user: dict[int, str] = {}
+        self._free: list[int] = []
+        self._next_slot = 0
+        # bumped on every mutation that changed any plane bit; the plane
+        # uses it to invalidate cached device copies
+        self.version = 0
+        # capacity generation: bumped on growth (device copy must be
+        # rebuilt from scratch and the match kernel retraces)
+        self.capacity_generation = 0
+        self.dirty_words: set[int] = set()
+        self._alloc_planes()
+        # engine-registry version the symbol rows were resolved against
+        self._rows_version: int | None = None
+
+    # -- plane storage -------------------------------------------------------
+
+    def _alloc_planes(self) -> None:
+        u32 = self.capacity // 32
+        # one trailing always-zero row: the "no such symbol" bucket a
+        # match can gather when a fired symbol no longer resolves to an
+        # engine row (delisted between dispatch and finalize) — explicit
+        # subscribers get nothing, wildcards still match via any_masks
+        self.sym_plane = np.zeros((self.symbol_capacity + 1, u32), np.uint32)
+        self.strat_plane = np.zeros((len(STRATEGY_ORDER), u32), np.uint32)
+        self.regime_plane = np.zeros((REGIME_ROWS, u32), np.uint32)
+        self.any_masks = np.zeros((3, u32), np.uint32)
+        self.floors = np.full(self.capacity, np.inf, np.float32)
+
+    @property
+    def words(self) -> int:
+        return self.capacity // 32
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def __contains__(self, user_id: str) -> bool:
+        return user_id in self._records
+
+    def get(self, user_id: str) -> Subscription | None:
+        rec = self._records.get(user_id)
+        return rec.sub if rec is not None else None
+
+    def slot_of(self, user_id: str) -> int | None:
+        rec = self._records.get(user_id)
+        return rec.slot if rec is not None else None
+
+    def user_of(self, slot: int) -> str | None:
+        return self._slot_user.get(int(slot))
+
+    def users_of_slots(self, slots: Iterable[int]) -> list[str]:
+        return [
+            u for u in (self._slot_user.get(int(s)) for s in slots)
+            if u is not None
+        ]
+
+    # -- churn ---------------------------------------------------------------
+
+    def _claim_slot(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._next_slot >= self.capacity:
+            self._grow()
+        slot = self._next_slot
+        self._next_slot += 1
+        return slot
+
+    def _grow(self) -> None:
+        """Double the slot capacity: realloc planes, replay every bit.
+        Counted by the plane as a FULL device recompile (and the match
+        kernel's one legitimate retrace)."""
+        self.capacity *= 2
+        old = list(self._records.values())
+        self._alloc_planes()
+        for rec in old:
+            self._set_bits(rec, on=True)
+        self.capacity_generation += 1
+        self.dirty_words.clear()  # full resync supersedes column sync
+
+    def _set_bits(self, rec: _SlotRecord, on: bool) -> None:
+        sub, slot = rec.sub, rec.slot
+        w, bit = slot >> 5, np.uint32(1 << (slot & 31))
+        planes_bits: list[tuple[np.ndarray, int]] = []
+        if sub.symbols is None:
+            planes_bits.append((self.any_masks, ANY_SYM))
+        else:
+            for row in rec.rows:
+                planes_bits.append((self.sym_plane, row))
+        if sub.strategies is None:
+            planes_bits.append((self.any_masks, ANY_STRAT))
+        else:
+            for name in sub.strategies:
+                planes_bits.append((self.strat_plane, _STRAT_IDX[name]))
+        if sub.regimes is None:
+            planes_bits.append((self.any_masks, ANY_REGIME))
+        else:
+            for code in sub.regimes:
+                planes_bits.append((self.regime_plane, int(code)))
+        if on:
+            for plane, r in planes_bits:
+                plane[r, w] |= bit
+            self.floors[slot] = np.float32(sub.min_strength)
+        else:
+            inv = np.uint32(~bit)
+            for plane, r in planes_bits:
+                plane[r, w] &= inv
+            self.floors[slot] = np.inf
+        self.dirty_words.add(w)
+        self.version += 1
+
+    def _resolve_rows(
+        self, symbols: frozenset[str] | None, row_of: Callable[[str], int | None]
+    ) -> list[int]:
+        if symbols is None:
+            return []
+        rows = (row_of(s) for s in symbols)
+        return sorted(
+            r for r in rows if r is not None and 0 <= r < self.symbol_capacity
+        )
+
+    def add(
+        self,
+        sub: Subscription,
+        row_of: Callable[[str], int | None] | None = None,
+    ) -> int:
+        """Insert (or replace — churn ``update`` is remove+add on the SAME
+        slot) one subscription; returns the user's slot. ``row_of``
+        resolves symbol names to engine rows (None = unresolved yet; the
+        plane re-resolves on its registry-version check)."""
+        sub = Subscription(
+            user_id=sub.user_id,
+            symbols=_norm_symbols(sub.symbols),
+            strategies=sub.strategies,
+            regimes=sub.regimes,
+            min_strength=sub.min_strength,
+        )
+        existing = self._records.get(sub.user_id)
+        if existing is not None:
+            self._set_bits(existing, on=False)
+            slot = existing.slot
+        else:
+            slot = self._claim_slot()
+        rec = _SlotRecord(sub=sub, slot=slot)
+        if row_of is not None:
+            rec.rows = self._resolve_rows(sub.symbols, row_of)
+        self._records[sub.user_id] = rec
+        if sub.symbols is not None:
+            self._explicit.add(sub.user_id)
+        else:
+            self._explicit.discard(sub.user_id)
+        self._slot_user[slot] = sub.user_id
+        self._set_bits(rec, on=True)
+        return slot
+
+    def update(
+        self,
+        sub: Subscription,
+        row_of: Callable[[str], int | None] | None = None,
+    ) -> int:
+        """Alias of :meth:`add` for churn-intent readability (slot kept)."""
+        return self.add(sub, row_of=row_of)
+
+    def remove(self, user_id: str) -> int | None:
+        rec = self._records.pop(user_id, None)
+        if rec is None:
+            return None
+        self._explicit.discard(user_id)
+        self._set_bits(rec, on=False)
+        del self._slot_user[rec.slot]
+        self._free.append(rec.slot)
+        return rec.slot
+
+    def bulk_load(
+        self,
+        subs: Iterable[Subscription],
+        row_of: Callable[[str], int | None] | None = None,
+    ) -> int:
+        """Vectorized initial load (the 1M-subscription path): one grouped
+        ``np.bitwise_or.at`` pass per plane instead of per-user bit flips.
+        Produces planes IDENTICAL to sequential :meth:`add` calls (pinned
+        by tests). Returns the number of users loaded."""
+        subs = list(subs)
+        # validate BEFORE any mutation: a duplicate found mid-loop would
+        # otherwise leave earlier records registered without plane bits
+        # (a silent device-vs-oracle divergence no later sync repairs)
+        seen: set[str] = set()
+        for raw in subs:
+            if raw.user_id in self._records or raw.user_id in seen:
+                raise ValueError(
+                    f"bulk_load of existing user {raw.user_id!r}; use "
+                    "update() for churn"
+                )
+            seen.add(raw.user_id)
+        need = self._next_slot + len(subs) - len(self._free)
+        while need > self.capacity:
+            self._grow()
+        sym_i: list[int] = []
+        sym_w: list[int] = []
+        sym_b: list[int] = []
+        strat_i: list[int] = []
+        strat_w: list[int] = []
+        strat_b: list[int] = []
+        reg_i: list[int] = []
+        reg_w: list[int] = []
+        reg_b: list[int] = []
+        any_i: list[int] = []
+        any_w: list[int] = []
+        any_b: list[int] = []
+        slots = np.empty(len(subs), np.int64)
+        floors = np.empty(len(subs), np.float32)
+        for k, raw in enumerate(subs):
+            sub = Subscription(
+                user_id=raw.user_id,
+                symbols=_norm_symbols(raw.symbols),
+                strategies=raw.strategies,
+                regimes=raw.regimes,
+                min_strength=raw.min_strength,
+            )
+            slot = self._claim_slot()
+            rec = _SlotRecord(sub=sub, slot=slot)
+            if row_of is not None:
+                rec.rows = self._resolve_rows(sub.symbols, row_of)
+            self._records[sub.user_id] = rec
+            if sub.symbols is not None:
+                self._explicit.add(sub.user_id)
+            self._slot_user[slot] = sub.user_id
+            slots[k] = slot
+            floors[k] = sub.min_strength
+            w, b = slot >> 5, slot & 31
+            if sub.symbols is None:
+                any_i.append(ANY_SYM); any_w.append(w); any_b.append(b)
+            else:
+                for row in rec.rows:
+                    sym_i.append(row); sym_w.append(w); sym_b.append(b)
+            if sub.strategies is None:
+                any_i.append(ANY_STRAT); any_w.append(w); any_b.append(b)
+            else:
+                for name in sub.strategies:
+                    strat_i.append(_STRAT_IDX[name])
+                    strat_w.append(w); strat_b.append(b)
+            if sub.regimes is None:
+                any_i.append(ANY_REGIME); any_w.append(w); any_b.append(b)
+            else:
+                for code in sub.regimes:
+                    reg_i.append(int(code)); reg_w.append(w); reg_b.append(b)
+        one = np.uint32(1)
+        for plane, ii, ww, bb in (
+            (self.sym_plane, sym_i, sym_w, sym_b),
+            (self.strat_plane, strat_i, strat_w, strat_b),
+            (self.regime_plane, reg_i, reg_w, reg_b),
+            (self.any_masks, any_i, any_w, any_b),
+        ):
+            if ii:
+                np.bitwise_or.at(
+                    plane,
+                    (np.asarray(ii, np.int64), np.asarray(ww, np.int64)),
+                    one << np.asarray(bb, np.uint32),
+                )
+        self.floors[slots] = floors
+        self.dirty_words.update(int(w) for w in np.unique(slots >> 5))
+        self.version += 1
+        return len(subs)
+
+    # -- symbol-row refresh --------------------------------------------------
+
+    def refresh_rows(
+        self, row_of: Callable[[str], int | None], registry_version: int
+    ) -> bool:
+        """Re-resolve every explicit symbol subscription against the
+        engine registry when its ``version`` moved (listing churn re-homes
+        rows). Rebuilds ``sym_plane`` from scratch — symbol churn is rare
+        and row reuse makes per-row patching unsound (a freed row's old
+        bits must vanish). Returns True when anything was rebuilt."""
+        if self._rows_version == registry_version:
+            return False
+        self._rows_version = registry_version
+        if not self._explicit:
+            # wildcard-only population: sym_plane is all zero and stays
+            # so — recording the version is enough; forcing a full device
+            # re-push here would re-upload megabytes of unchanged planes
+            # on every engine listing-churn version bump
+            return False
+        self.sym_plane.fill(0)
+        # only EXPLICIT symbol subscriptions re-resolve (the _explicit
+        # index keeps listing churn O(explicit subs), not O(population));
+        # bits land in one grouped scatter instead of per-record writes
+        rr: list[int] = []
+        ww: list[int] = []
+        bb: list[int] = []
+        for uid in self._explicit:
+            rec = self._records[uid]
+            rec.rows = self._resolve_rows(rec.sub.symbols, row_of)
+            if rec.rows:
+                w, b = rec.slot >> 5, rec.slot & 31
+                rr.extend(rec.rows)
+                ww.extend([w] * len(rec.rows))
+                bb.extend([b] * len(rec.rows))
+        if rr:
+            np.bitwise_or.at(
+                self.sym_plane,
+                (np.asarray(rr, np.int64), np.asarray(ww, np.int64)),
+                np.uint32(1) << np.asarray(bb, np.uint32),
+            )
+        # every word column of sym_plane may have changed: force a full
+        # device resync rather than enumerating all words as dirty
+        self.capacity_generation += 1
+        self.dirty_words.clear()
+        self.version += 1
+        return True
+
+    # -- oracle --------------------------------------------------------------
+
+    def match_oracle(
+        self,
+        entries: list[tuple[str, str, float]],
+        regime: int | None,
+        unresolved: frozenset[str] = frozenset(),
+    ) -> list[set[str]]:
+        """Per-entry recipient user-id sets for ``(strategy, symbol,
+        score)`` fired entries — the pure-Python reference the device
+        kernel's packed output must equal exactly. ``unresolved`` names
+        fired symbols with NO current engine row (delisted between
+        dispatch and finalize): the kernel gathers the empty no-row
+        bucket for those, so explicit-symbol subscribers do not match —
+        only wildcards do — and the oracle must agree."""
+        out: list[set[str]] = []
+        for strategy, symbol, score in entries:
+            sym = symbol.strip().upper()
+            out.append(
+                {
+                    rec.sub.user_id
+                    for rec in self._records.values()
+                    if rec.sub.matches(strategy, sym, score, regime)
+                    and not (
+                        rec.sub.symbols is not None and sym in unresolved
+                    )
+                }
+            )
+        return out
+
+    def snapshot(self) -> dict:
+        """Attribute-read stats for /healthz and the flight recorder."""
+        return {
+            "users": len(self._records),
+            "capacity": self.capacity,
+            "words": self.words,
+            "version": self.version,
+            "dirty_words": len(self.dirty_words),
+        }

@@ -487,11 +487,32 @@ class DeliveryPlane:
             if lane.worker is not None:
                 lane.worker.cancel()
         for lane in self._lanes.values():
-            if lane.worker is not None:
-                try:
-                    await lane.worker
-                except (asyncio.CancelledError, Exception):
-                    pass
+            worker = lane.worker
+            if worker is None:
+                continue
+            # Python 3.10's wait_for can SWALLOW a cancellation that lands
+            # while the inner deliver is already done (bpo-42130): the
+            # worker resumes as if the attempt succeeded and parks back on
+            # queue.get never having observed the cancel — a bare `await
+            # worker` here then deadlocks the closing task (seen live as a
+            # replay-drive hang whenever drain timed out with a worker
+            # mid-attempt). Re-cancel on a short timeout until the task
+            # actually exits; the loop-top `closed` check in _worker makes
+            # the recheck (or the second cancel) land immediately.
+            for _ in range(25):
+                done, _pending = await asyncio.wait({worker}, timeout=0.2)
+                if done:
+                    try:
+                        await worker
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                    break
+                worker.cancel()
+            else:
+                log.warning(
+                    "delivery worker %s ignored shutdown; abandoned",
+                    lane.sink.name,
+                )
         self.emit_summary()
         if self.wal is not None:
             try:
@@ -625,7 +646,10 @@ class DeliveryPlane:
         return moved > 0
 
     async def _worker(self, lane: _SinkLane) -> None:
-        while True:
+        # loop-top closed check: a worker whose shutdown cancel was
+        # swallowed by 3.10's wait_for (see aclose) exits here instead of
+        # parking on an empty queue forever
+        while not self.closed:
             try:
                 env = lane.queue.get_nowait()
             except asyncio.QueueEmpty:

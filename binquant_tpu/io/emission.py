@@ -114,6 +114,11 @@ class FiredSignal:
         # into the analytics payload / metadata so downstream consumers
         # can measure freshness without scraping Prometheus
         self.freshness_ms: float | None = None
+        # fan-out plane (ISSUE 14): (frame dict, packed recipient words,
+        # publish perf_counter) stamped by FanoutPlane.on_fired at
+        # finalize — what the delivery plane's fanout consumer group
+        # encodes; None while BQT_FANOUT=0 or before the match ran
+        self.fanout_frame: tuple | None = None
 
 
 def _cast_diag(kind: str, v: float):

@@ -530,6 +530,25 @@ class SignalEngine:
             ),
             cap=int(getattr(config, "outcome_cap", 1024) or 1024),
         )
+        # subscription fan-out plane (ISSUE 14): compile user
+        # subscriptions into device bitset planes and join every fired
+        # tick's deduped signal set against them in ONE extra dispatch;
+        # matched frames ride the outbox + the WS/SSE hub. BQT_FANOUT=0
+        # (the tier-1 lane's default) keeps the three-sink path
+        # byte-identical — no plane, no kernel, no outbox.
+        self.fanout = None
+        if bool(getattr(config, "fanout_enabled", False)):
+            from binquant_tpu.fanout.plane import FanoutPlane
+
+            self.fanout = FanoutPlane(
+                self.registry,
+                capacity=int(_knob(config, "fanout_capacity", 1024)),
+                outbox_path=(
+                    getattr(config, "fanout_outbox_path", "") or None
+                ),
+                outbox_cap=int(_knob(config, "fanout_outbox_cap", 4096)),
+                conn_queue_max=int(_knob(config, "fanout_conn_queue", 256)),
+            )
         # durable signal delivery plane (ISSUE 13): finalize enqueues and
         # returns; per-sink workers own retries/backoff/breakers, and the
         # autotrade class is WAL-durable at-least-once across a process
@@ -540,10 +559,18 @@ class SignalEngine:
             from binquant_tpu.io.delivery import DeliveryPlane
             from binquant_tpu.io.emission import make_signal_sinks
 
+            sinks = make_signal_sinks(
+                binbot_api, telegram_consumer, at_consumer
+            )
+            if self.fanout is not None:
+                # the broadcast tier as a fourth, lossy consumer group
+                # (ROADMAP item 2's horizontal-scaling seam): the hub
+                # handoff runs on a delivery worker, not the tick thread
+                from binquant_tpu.fanout.plane import FanoutSink
+
+                sinks.append(FanoutSink(self.fanout))
             self.delivery = DeliveryPlane(
-                sinks=make_signal_sinks(
-                    binbot_api, telegram_consumer, at_consumer
-                ),
+                sinks=sinks,
                 wal_path=getattr(config, "delivery_wal_path", "") or None,
                 queue_max=int(_knob(config, "delivery_queue_max", 512)),
                 attempt_timeout_s=float(
@@ -1100,6 +1127,12 @@ class SignalEngine:
         the plane; a sink outage must not stall the tick thread)."""
         if self.delivery is not None and self.delivery.started:
             await self.delivery.aclose(drain_s=drain_s)
+
+    async def aclose_fanout(self) -> None:
+        """Retire the fan-out plane: stop the hub (if served), emit the
+        fanout_summary scoreboard, close the outbox."""
+        if self.fanout is not None:
+            await self.fanout.aclose()
 
     async def emit_ready(self) -> list:
         """Fired-tick fast path: land and emit the oldest in-flight tick
@@ -2503,6 +2536,20 @@ class SignalEngine:
                 signal.message += (
                     f"\n- Trace: {trace.trace_id}/{trace.tick_seq}"
                 )
+        # subscription fan-out (ISSUE 14): join the deduped, provenance-
+        # stamped fired set against the compiled subscription planes in
+        # ONE extra kernel dispatch and mint broadcast frames. Runs at the
+        # shared finalize, so every backend (serial/donated/scanned/
+        # backtest) produces the identical recipient sets. When the
+        # delivery plane is on the hub handoff happens on its fanout
+        # worker (signal.fanout_frame, enqueued below); otherwise the
+        # plane offers to connections directly (bounded, non-blocking).
+        if self.fanout is not None and fired:
+            with trace.span("fanout_match") as sp_fanout:
+                fanout_stats = self.fanout.on_fired(
+                    fired, ctx_scalars, tick_ms=pending.ts_ms
+                )
+                sp_fanout.set(**fanout_stats)
         # decode half done (wire → deduped, provenance-stamped signals);
         # the emit half below is sink dispatch only
         t_emit_phase0 = time.perf_counter()
@@ -3098,6 +3145,17 @@ class SignalEngine:
                 if self.delivery is not None
                 else None
             ),
+            # fan-out plane pressure at the breach (attribute reads only)
+            "fanout": (
+                {
+                    "users": len(self.fanout.subscriptions),
+                    "published": self.fanout.published,
+                    "connections": self.fanout.hub.connections,
+                    "shed": self.fanout.hub.shed,
+                }
+                if self.fanout is not None
+                else None
+            ),
         }
 
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
@@ -3203,6 +3261,14 @@ class SignalEngine:
                 if self.delivery is not None
                 else {"enabled": False}
             ),
+            # subscription fan-out plane (ISSUE 14): compiled-population
+            # size, match/publish counters, recompile kinds, and the hub's
+            # per-connection scoreboard (attribute reads only)
+            "fanout": (
+                self.fanout.snapshot()
+                if self.fanout is not None
+                else {"enabled": False}
+            ),
         }
 
     # -- loops (main.py:37-57) ------------------------------------------------
@@ -3246,6 +3312,14 @@ class SignalEngine:
                 logging.warning("shutdown delivery drain interrupted")
             except Exception:
                 logging.exception("shutdown delivery close failed")
+            # the fan-out hub retires after the delivery drain (its lane's
+            # last in-flight frames should reach connections first)
+            try:
+                await self.aclose_fanout()
+            except asyncio.CancelledError:
+                logging.warning("shutdown fanout close interrupted")
+            except Exception:
+                logging.exception("shutdown fanout close failed")
 
     async def _consume_loop_body(
         self, queue: asyncio.Queue, tick_interval_s: float

@@ -86,6 +86,8 @@ def make_stub_engine(
     delivery: bool | None = None,
     delivery_wal: str | None = None,
     delivery_overrides: dict | None = None,
+    fanout: bool | None = None,
+    fanout_overrides: dict | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -182,6 +184,33 @@ def make_stub_engine(
         atexit.register(_discard_stub_wal)
         config.__dict__["delivery_wal_path"] = wal_tmp
     for key, value in (delivery_overrides or {}).items():
+        config.__dict__[key] = value
+    # subscription fan-out plane (ISSUE 14): BQT_FANOUT override, plus
+    # the same throwaway-outbox rule as the delivery WAL — a stub run's
+    # broadcast frames must never replay into (or pollute the retention
+    # of) the live deployment's cursor outbox
+    if fanout is not None:
+        config.__dict__["fanout_enabled"] = bool(fanout)
+    if getattr(config, "fanout_enabled", False) and "fanout_outbox_path" not in (
+        fanout_overrides or {}
+    ):
+        import atexit
+        import contextlib
+        import tempfile
+
+        fd, outbox_tmp = tempfile.mkstemp(
+            prefix="bqt_stub_", suffix=".fanout.jsonl"
+        )
+        os.close(fd)
+
+        def _discard_stub_outbox(path=outbox_tmp):
+            for p in (path, path + ".1"):  # live file + rotated generation
+                with contextlib.suppress(OSError):
+                    os.unlink(p)
+
+        atexit.register(_discard_stub_outbox)
+        config.__dict__["fanout_outbox_path"] = outbox_tmp
+    for key, value in (fanout_overrides or {}).items():
         config.__dict__[key] = value
     binbot_api = BinbotApi(
         "http://stub",
@@ -382,11 +411,15 @@ def run_replay(
         # retire the delivery plane (when on) before the loop closes:
         # best-effort drain so the stubbed sinks see every signal
         await engine.aclose_delivery()
+        # ... and the fan-out plane (when on): emits the fanout_summary
+        # scoreboard tools/fanout_report.py renders
+        await engine.aclose_fanout()
 
     async def drive_scanned() -> None:
         record(await engine.process_ticks_scanned(seq))
         record(await engine.flush_pending())
         await engine.aclose_delivery()
+        await engine.aclose_fanout()
 
     asyncio.run(drive_scanned() if scanned else drive())
     wall = time.perf_counter() - t_start

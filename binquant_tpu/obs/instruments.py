@@ -426,3 +426,57 @@ CHECKPOINT_SAVES = REGISTRY.counter(
     "Engine-state snapshot attempts by outcome (ok / error).",
     labels=("outcome",),
 )
+
+# -- subscription fan-out plane (binquant_tpu/fanout, ISSUE 14) -------------
+
+FANOUT_SUBSCRIPTIONS = REGISTRY.gauge(
+    "bqt_fanout_subscriptions",
+    "Live subscriptions compiled into the device bitset planes "
+    "(user x symbols/strategies/regimes/min-strength).",
+)
+FANOUT_RECOMPILES = REGISTRY.counter(
+    "bqt_fanout_recompiles_total",
+    "Device plane resyncs by kind: incremental (dirty word columns "
+    "scattered in one jit'd update after churn) vs full (first use, "
+    "capacity growth, or a symbol-row refresh after registry churn — "
+    "the only case that retraces the match kernel; the tick step never "
+    "retraces either way).",
+    labels=("kind",),
+)
+FANOUT_MATCH_DISPATCHES = REGISTRY.counter(
+    "bqt_fanout_match_dispatches_total",
+    "Per-tick subscription match kernel launches (one per fired tick, "
+    "joining every deduped fired slot in a single dispatch).",
+)
+FANOUT_RECIPIENTS = REGISTRY.counter(
+    "bqt_fanout_matched_recipients_total",
+    "Total (signal, subscriber) matches the kernel produced.",
+)
+FANOUT_PUBLISHED = REGISTRY.counter(
+    "bqt_fanout_published_total",
+    "Signal frames entering the broadcast tier (outbox-appended; "
+    "delivered to connections by the hub or the delivery worker).",
+)
+FANOUT_FRAMES = REGISTRY.counter(
+    "bqt_fanout_frames_total",
+    "Frames written to subscriber connections, per transport.",
+    labels=("transport",),
+)
+FANOUT_CONNECTIONS = REGISTRY.gauge(
+    "bqt_fanout_connections",
+    "Open hub connections per transport (ws / sse).",
+    labels=("transport",),
+)
+FANOUT_SHED = REGISTRY.counter(
+    "bqt_fanout_shed_total",
+    "Broadcast frames dropped by reason (slow_consumer: a connection's "
+    "bounded queue was full; resume_overflow: a reconnect gap exceeded "
+    "the queue) — counted, never silent; the client recovers by "
+    "reconnecting with its cursor.",
+    labels=("reason",),
+)
+FANOUT_RESUME_REPLAYED = REGISTRY.counter(
+    "bqt_fanout_resume_replayed_total",
+    "Frames replayed from the broadcast outbox to reconnecting clients "
+    "presenting a cursor.",
+)

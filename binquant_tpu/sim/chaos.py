@@ -246,7 +246,9 @@ def ws_chaos_drill(
         telegram_transport=telegram,
         # this drill pins the INLINE sink path's isolation; the delivery
         # plane's storm/kill/restore drill is delivery_chaos_drill below
+        # and the fan-out plane's churn/stall drill is fanout_chaos_drill
         delivery=False,
+        fanout=False,
     )
     engine.ws_health = health
 
@@ -490,6 +492,10 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
             delivery=True,
             delivery_wal=str(wal),
             delivery_overrides=dict(knobs),
+            # this drill pins the pre-fanout three-sink delivery story
+            # (lane names, healthz shapes); fanout_chaos_drill owns the
+            # four-lane composition
+            fanout=False,
         )
 
     async def drive(engine, ticks) -> None:
@@ -651,6 +657,367 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
         # the tick thread enqueues; the sinks burn wall time elsewhere
         "emit_dwell_bounded": facts["emit_ms"]
         < max(0.1 * facts["sink_wall_ms"], 250.0),
+    }
+    facts["checks"] = checks
+    facts["ok"] = all(checks.values())
+    return facts
+
+
+def fanout_chaos_drill(workdir: str | None = None) -> dict:
+    """The ISSUE-14 acceptance drill: a subscriber churn storm riding the
+    whole stream (adds/updates/removes between every tick, growing the
+    slot planes mid-storm) while signal pulses broadcast to a healthy
+    WebSocket watcher AND a stalled consumer whose 2-slot queue can never
+    drain — asserting
+
+    * device recipient sets equal the Python oracle on EVERY fired tick
+      of the churn storm (the compiled planes track churn exactly);
+    * the plane resynced incrementally through churn, with full
+      recompiles only at first use / capacity growth;
+    * zero tick-thread stall: every tick processed, the finalize emit
+      dwell stays bounded while the stalled consumer wedges, and the
+      healthy watcher still receives every frame addressed to it;
+    * sheds are COUNTED, never silent (hub.shed == the stalled
+      connection's drops, and the shed reason is slow_consumer);
+    * the autotrade consumer group is unaffected: delivered set == the
+      fanout-off oracle run's, zero loss, zero duplicates;
+    * a reconnect presenting a cursor replays the stalled consumer's
+      whole gap from the broadcast outbox.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from binquant_tpu.fanout.hub import _Connection, ws_read_frame
+    from binquant_tpu.fanout.registry import Subscription
+    from binquant_tpu.io.replay import make_stub_engine, tick_seq
+    from binquant_tpu.sim.scenarios import (
+        Scenario,
+        ScenarioSpec,
+        _bleed_then_hammer,
+        base_market,
+        emit_stream,
+        symbol_names,
+        write_scenario_file,
+    )
+
+    workdir = Path(workdir or tempfile.mkdtemp(prefix="bqt_fanout_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    spec = ScenarioSpec(
+        name="fanout_storm",
+        description="three hammer pulses under a subscription churn storm",
+    )
+
+    def _build(sp: ScenarioSpec) -> list[dict]:
+        closes, vols, _rng = base_market(sp)
+        shapes: dict = {}
+        # three pulses, all past MIN_BARS(=100) where strategies arm:
+        # t-10 / t-4 / t-1, so the t-7 flash mob's plane growth lands
+        # BETWEEN live matches and the t-4 -> t-1 gap is churn-only (the
+        # incremental column-scatter resync the drill must exercise)
+        _bleed_then_hammer(
+            closes, vols, shapes, (2, 5, 8), sp.n_ticks - 40, sp.n_ticks - 10
+        )
+        _bleed_then_hammer(
+            closes, vols, shapes, (3, 6), sp.n_ticks - 31, sp.n_ticks - 4
+        )
+        _bleed_then_hammer(
+            closes, vols, shapes, (4, 7), sp.n_ticks - 24, sp.n_ticks - 1
+        )
+        return emit_stream(sp, closes, vols, shapes)
+
+    stream = workdir / "fanout_storm.jsonl"
+    write_scenario_file(Scenario(spec=spec, build=_build), stream)
+    seq = tick_seq(stream)
+    symbols = symbol_names(spec.n_symbols)
+
+    def build(fanout: bool, wal: Path):
+        return make_stub_engine(
+            capacity=spec.capacity,
+            window=spec.window,
+            incremental=True,
+            scan_chunk=spec.scan_chunk,
+            enabled_strategies=set(spec.enabled_strategies),
+            host_phase=True,
+            delivery=True,
+            delivery_wal=str(wal),
+            delivery_overrides={"delivery_backoff_s": 0.005},
+            fanout=fanout,
+            fanout_overrides=(
+                # small slot capacity so the churn storm forces plane
+                # growth (the match kernel's one legitimate retrace);
+                # roomy outbox so the stalled user's whole gap replays
+                {"fanout_capacity": 64, "fanout_outbox_cap": 4096}
+                if fanout
+                else {}
+            ),
+        )
+
+    async def drive(engine, churn=None) -> list:
+        engine.delivery.start()
+        ticks = []
+        for i, (now_ms, klines) in enumerate(seq):
+            if churn is not None:
+                churn(i)
+            for k in klines:
+                engine.ingest(k)
+            t0 = time.perf_counter()
+            await engine.process_tick(now_ms=now_ms)
+            ticks.append((time.perf_counter() - t0) * 1000)
+        await engine.flush_pending()
+        return ticks
+
+    # -- the fanout-off oracle: what autotrade must deliver regardless ------
+    oracle = build(False, workdir / "oracle.wal.jsonl")
+    at_oracle = FlakySink(oracle.delivery.lane("autotrade").sink)
+    oracle.delivery.lane("autotrade").sink = at_oracle
+
+    async def run_oracle() -> None:
+        await drive(oracle)
+        await oracle.delivery.aclose(drain_s=10.0)
+
+    asyncio.run(run_oracle())
+    oracle_keys = {_autotrade_key(p) for p in at_oracle.delivered}
+
+    # -- the subject: churn storm + stalled consumer + healthy watcher ------
+    subject = build(True, workdir / "subject.wal.jsonl")
+    at_subject = FlakySink(subject.delivery.lane("autotrade").sink)
+    subject.delivery.lane("autotrade").sink = at_subject
+    plane = subject.fanout
+    rng = np.random.default_rng(spec.seed)
+    strategies = list(spec.enabled_strategies)
+
+    # standing population: the watcher and the sloth subscribe to all
+    plane.subscribe(Subscription("watcher"))
+    plane.subscribe(Subscription("sloth"))
+    churn_pool: list[str] = []
+    churn_ops = {"subscribe": 0, "update": 0, "unsubscribe": 0}
+    next_id = 0
+
+    def _random_sub(uid: str) -> Subscription:
+        return Subscription(
+            uid,
+            symbols=(
+                None
+                if rng.random() < 0.5
+                else frozenset(
+                    str(s)
+                    for s in rng.choice(
+                        symbols, size=int(rng.integers(1, 4)), replace=False
+                    )
+                )
+            ),
+            strategies=(
+                None
+                if rng.random() < 0.5
+                else frozenset(
+                    str(s)
+                    for s in rng.choice(
+                        strategies,
+                        size=int(rng.integers(1, 3)),
+                        replace=False,
+                    )
+                )
+            ),
+            min_strength=float(np.float32(rng.random() * 0.5)),
+        )
+
+    def churn(tick: int) -> None:
+        nonlocal next_id
+        # a flash mob BETWEEN the signal pulses: 300 signups in one tick
+        # force a slot-capacity growth bracketed by two live matches, so
+        # the storm exercises the grow -> full-device-resync path (the
+        # match kernel's one legitimate retrace) mid-stream
+        adds = 6 + (300 if tick == spec.n_ticks - 7 else 0)
+        for _ in range(adds):
+            uid = f"churn{next_id:05d}"
+            next_id += 1
+            plane.subscribe(_random_sub(uid))
+            churn_pool.append(uid)
+            churn_ops["subscribe"] += 1
+        for _ in range(2):
+            if churn_pool:
+                plane.update(_random_sub(str(rng.choice(churn_pool))))
+                churn_ops["update"] += 1
+        for _ in range(2):
+            if churn_pool:
+                uid = str(rng.choice(churn_pool))
+                churn_pool.remove(uid)
+                plane.unsubscribe(uid)
+                churn_ops["unsubscribe"] += 1
+
+    # per-fired-tick oracle equality spy over the churning population
+    mismatches: list = []
+    matched_ticks = {"n": 0}
+    orig_on_fired = plane.on_fired
+
+    def spy(fired, ctx_scalars, tick_ms=None):
+        from binquant_tpu.enums import MarketRegimeCode
+        from binquant_tpu.fanout.kernel import unpack_words_np
+
+        stats = orig_on_fired(fired, ctx_scalars, tick_ms=tick_ms)
+        regime = int(ctx_scalars.get("market_regime", -1))
+        valid = bool(ctx_scalars.get("valid", False))
+        want = plane.subscriptions.match_oracle(
+            [
+                (s.strategy, s.symbol, float(s.value.score or 0.0))
+                for s in fired
+            ],
+            regime if valid and 0 <= regime < len(MarketRegimeCode) else None,
+        )
+        matched_ticks["n"] += 1
+        for s, w in zip(fired, want):
+            _frame, words, _t = s.fanout_frame
+            got = set(
+                plane.subscriptions.users_of_slots(
+                    np.flatnonzero(unpack_words_np(words))
+                )
+            )
+            if got != w:
+                mismatches.append((tick_ms, s.strategy, s.symbol))
+        return stats
+
+    plane.on_fired = spy
+
+    watcher_frames: list[dict] = []
+    facts: dict = {}
+
+    async def run_subject() -> None:
+        port = await plane.serve(0, host="127.0.0.1")
+        # healthy watcher over a real WS socket
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"GET /ws?user=watcher HTTP/1.1\r\nHost: x\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Key: dGhlIHNhbXBsZQ==\r\n\r\n"
+        )
+        await writer.drain()
+        await reader.readline()
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+
+        async def watch() -> None:
+            try:
+                while True:
+                    opcode, payload = await ws_read_frame(reader)
+                    if opcode == 0x1:
+                        watcher_frames.append(json.loads(payload))
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+        watch_task = asyncio.ensure_future(watch())
+        # the stalled consumer: a registered connection whose writer task
+        # never drains its 2-slot queue (the bounded-queue chaos seam — a
+        # live socket's kernel buffer would mask the wedge)
+        sloth = _Connection(
+            "sloth", plane.subscriptions.slot_of("sloth"), "ws", queue_max=2
+        )
+        plane.hub._conns.add(sloth)
+
+        tick_ms_list = await drive(subject, churn=churn)
+        facts["drained"] = await subject.delivery.drain(timeout_s=15.0)
+        # let the watcher catch the tail
+        deadline = time.monotonic() + 5.0
+        while (
+            time.monotonic() < deadline
+            and len(watcher_frames) < plane.published
+        ):
+            await asyncio.sleep(0.02)
+        plane.hub._conns.discard(sloth)
+
+        # reconnect-with-cursor: the sloth's gap replays from the outbox
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        w2.write(
+            b"GET /ws?user=sloth&cursor=-1 HTTP/1.1\r\nHost: x\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Key: dGhlIHNhbXBsZQ==\r\n\r\n"
+        )
+        await w2.drain()
+        await r2.readline()
+        while (await r2.readline()) not in (b"\r\n", b""):
+            pass
+        sloth_slot = plane.subscriptions.slot_of("sloth")
+        addressed = [
+            f["seq"]
+            for f, words in plane.outbox.entries()
+            if (
+                sloth_slot >> 5 < len(words)
+                and (int(words[sloth_slot >> 5]) >> (sloth_slot & 31)) & 1
+            )
+        ]
+        replayed = []
+        try:
+            while len(replayed) < len(addressed):
+                opcode, payload = await asyncio.wait_for(
+                    ws_read_frame(r2), timeout=5.0
+                )
+                if opcode == 0x1:
+                    replayed.append(json.loads(payload)["seq"])
+        except (TimeoutError, asyncio.TimeoutError):
+            pass
+        writer.close()
+        w2.close()
+        watch_task.cancel()
+        await subject.delivery.aclose(drain_s=5.0)
+        await subject.aclose_fanout()
+        facts["tick_p99_ms"] = float(np.percentile(tick_ms_list, 99))
+        facts["sloth_addressed"] = len(addressed)
+        facts["sloth_replayed"] = len(replayed)
+        facts["sloth_gap_replayed"] = replayed == addressed
+        facts["sloth_dropped"] = sloth.dropped
+        facts["sloth_gapped"] = sloth.gapped
+
+    asyncio.run(run_subject())
+    subject_keys = {_autotrade_key(p) for p in at_subject.delivered}
+    delivered = [_autotrade_key(p) for p in at_subject.delivered]
+    watcher_seqs = sorted(f["seq"] for f in watcher_frames)
+    emit_ms = (
+        subject.host_phase.totals.get("serial", {}).get("emit", [0.0, 0])[0]
+    )
+    facts.update(
+        {
+            "ticks": subject.ticks_processed,
+            "published": plane.published,
+            "matched_ticks": matched_ticks["n"],
+            "oracle_mismatches": mismatches[:5],
+            "churn_ops": dict(churn_ops),
+            "subscriptions_live": len(plane.subscriptions),
+            "slot_capacity": plane.subscriptions.capacity,
+            "recompiles": dict(plane.recompiles),
+            "hub_shed": plane.hub.shed,
+            "watcher_frames": len(watcher_frames),
+            "oracle_autotrade": len(oracle_keys),
+            "delivered_autotrade": len(subject_keys),
+            "duplicate_keys": len(delivered) - len(subject_keys),
+            "emit_ms": round(emit_ms, 3),
+        }
+    )
+    checks = {
+        "delivery_drained": bool(facts.get("drained")),
+        # churn storm correctness: the compiled planes tracked every op
+        "oracle_equal_through_churn": not mismatches
+        and matched_ticks["n"] >= 2,
+        "churn_storm_ran": churn_ops["subscribe"] > 300
+        and churn_ops["unsubscribe"] > 50,
+        "plane_grew_mid_storm": plane.subscriptions.capacity > 64
+        and plane.recompiles.get("full", 0) >= 2,
+        "incremental_resyncs": plane.recompiles.get("incremental", 0) > 0,
+        # zero tick-thread stall: every tick processed while the sloth
+        # wedged, and finalize's emit dwell stayed an enqueue
+        "all_ticks_processed": subject.ticks_processed == len(seq),
+        "emit_dwell_bounded": emit_ms < 250.0,
+        # sheds counted, never silent
+        "sheds_counted": facts["sloth_dropped"] > 0
+        and plane.hub.shed == facts["sloth_dropped"],
+        # the healthy consumer missed nothing
+        "watcher_complete": watcher_seqs == list(range(plane.published))
+        and plane.published > 0,
+        # the trade path is a different consumer group entirely
+        "autotrade_unaffected": subject_keys == oracle_keys
+        and len(oracle_keys) > 0
+        and facts["duplicate_keys"] == 0,
+        # reconnect-with-cursor replays the whole gap from the outbox
+        "cursor_replayed_gap": facts["sloth_gap_replayed"]
+        and facts["sloth_addressed"] > 0,
     }
     facts["checks"] = checks
     facts["ok"] = all(checks.values())

@@ -68,6 +68,10 @@ def drive_scenario(
         # synchronously after the drive); the delivery plane has its own
         # drill (delivery_chaos_drill) with the at-least-once invariants
         delivery=False,
+        # likewise the fan-out plane: the corpus pins pre-fanout event
+        # logs and signal sets; the plane's own drill is
+        # fanout_chaos_drill (churn storm + stalled consumers)
+        fanout=False,
     )
     # isolated ws tracker: the module singleton may carry another drill's
     # reconnect storm, which would flip this run's health to degraded
@@ -329,6 +333,41 @@ def run_corpus(
         }
         get_event_log().emit("scenario_run", **devent)
         verdicts.append(devent)
+        # ISSUE 14: the fan-out plane drill — subscriber churn storm +
+        # stalled broadcast consumer, with device-vs-oracle recipient
+        # equality through the churn, counted sheds, an unaffected
+        # autotrade consumer group, and a cursor replay of the gap
+        from binquant_tpu.sim.chaos import fanout_chaos_drill
+
+        ffacts = fanout_chaos_drill()
+        fevent = {
+            "scenario": "fanout_drill",
+            "ok": ffacts["ok"],
+            "signals": ffacts["published"],
+            "ticks": ffacts["ticks"],
+            "routing": {},
+            "checks": ffacts["checks"],
+            "fanout": {
+                k: ffacts[k]
+                for k in (
+                    "published",
+                    "matched_ticks",
+                    "churn_ops",
+                    "subscriptions_live",
+                    "slot_capacity",
+                    "recompiles",
+                    "hub_shed",
+                    "watcher_frames",
+                    "sloth_dropped",
+                    "sloth_replayed",
+                    "oracle_autotrade",
+                    "delivered_autotrade",
+                    "emit_ms",
+                )
+            },
+        }
+        get_event_log().emit("scenario_run", **fevent)
+        verdicts.append(fevent)
     return verdicts
 
 

@@ -205,6 +205,17 @@ async def run_live() -> None:
         )
         await metrics_server.start()
 
+    # Subscription fan-out broadcast tier (ISSUE 14): serve the WS/SSE
+    # hub when BQT_FANOUT_PORT is set (requires BQT_FANOUT=1, the
+    # default). Subscribers connect to /ws?user=<id> or /sse?user=<id>
+    # (+ an optional cursor) and receive exactly the frames the device
+    # match kernel addressed to them; see README §Fan-out plane.
+    if engine.fanout is not None and config.fanout_port:
+        port = await engine.fanout.serve(
+            config.fanout_port, host=config.fanout_host
+        )
+        logging.info("fanout hub serving ws/sse on port %d", port)
+
     logging.info("binquant_tpu started: %d symbols tracked", len(all_symbols))
     # OI refresh rides a background task (bounded-concurrency REST sweeps
     # amortized across the bucket); the tick path only reads its cache

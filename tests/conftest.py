@@ -73,6 +73,13 @@ os.environ.setdefault("BQT_OUTCOMES", "0")
 # ON (binquant_tpu/config.py); delivery coverage opts in explicitly
 # (tests/test_delivery.py via make_stub_engine(delivery=True)).
 os.environ.setdefault("BQT_DELIVERY", "0")
+# Subscription fan-out plane (ISSUE 14) defaults OFF for the tier-1 lane,
+# the same knob pattern: the match kernel is a separate jit cache entry
+# dozens of stub engines must not each compile, and several fixtures pin
+# the pre-fanout sink dispatch / healthz shapes only additively.
+# Production default stays ON (binquant_tpu/config.py); fanout coverage
+# opts in explicitly (tests/test_fanout.py via make_stub_engine(fanout=True)).
+os.environ.setdefault("BQT_FANOUT", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

@@ -13,6 +13,8 @@ dedupe key.
 """
 
 import asyncio
+import contextlib
+import time
 import json
 from types import SimpleNamespace
 
@@ -523,6 +525,51 @@ def test_worker_error_sheds_lossy_envelope_counted(tmp_path):
 
     asyncio.run(go())
     assert plane.lane("analytics").shed.get("worker_error") == 1
+
+
+def test_aclose_unsticks_worker_that_swallowed_its_cancel():
+    """Python 3.10's wait_for can swallow a cancellation that lands while
+    the inner deliver attempt is already done (bpo-42130): the worker then
+    parks back on queue.get having never observed the cancel, and an
+    aclose that bare-awaits the task deadlocks. aclose must re-cancel
+    until the worker actually exits (repro'd live: the replay drive hung
+    whenever drain timed out with a worker mid-attempt)."""
+    sink = FakeSink(name="telegram", policy=LOSSY)
+    plane = make_plane([sink])
+
+    async def go():
+        plane.start()
+        lane = plane.lane("telegram")
+        real = lane.worker
+        real.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await real
+
+        # a worker that eats its first shutdown cancel exactly like the
+        # 3.10 wait_for swallow, then parks on the queue again
+        async def stubborn():
+            try:
+                await asyncio.sleep(3600)
+            except asyncio.CancelledError:
+                pass  # the swallow
+            # parks on the (empty) queue like the real worker would;
+            # only aclose's re-cancel can unstick it
+            await lane.queue.get()
+
+        lane.worker = asyncio.get_running_loop().create_task(stubborn())
+        await asyncio.sleep(0)
+        t0 = time.monotonic()
+        # the 10s harness timeout is NOT the pass condition: a deadlocked
+        # aclose absorbs the harness cancel in its old broad except and
+        # "returns" only at the timeout — the re-cancel loop must finish
+        # far quicker than that
+        await asyncio.wait_for(plane.aclose(drain_s=0.05), timeout=10.0)
+        assert time.monotonic() - t0 < 5.0, (
+            "aclose deadlocked on the swallowed-cancel worker"
+        )
+
+    asyncio.run(go())
+    assert plane.closed
 
 
 # -- bounded binbot REST (satellite) ------------------------------------------

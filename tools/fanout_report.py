@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Render fan-out plane activity from the JSONL event log.
+
+The subscription fan-out plane (``binquant_tpu/fanout``) narrates its
+life as events: ``fanout_churn`` per registry mutation,
+``fanout_publish`` per matched frame entering the broadcast tier,
+``fanout_shed`` per counted drop (slow consumer / resume overflow),
+``fanout_resume`` per reconnect-with-cursor replay, ``fanout_conn_close``
+with one connection's delivery scoreboard, and one ``fanout_summary``
+when the plane retires. This tool turns an event log back into the
+broadcast story — churn volume, per-signal fan-out, per-connection
+delivery lag, shed counts, and the top-N hottest subscriptions — without
+any service in the loop (golden-pinned like delivery_report — keep
+format changes deliberate):
+
+    python tools/fanout_report.py /tmp/bqt_fanout_events.jsonl
+    python tools/fanout_report.py events.jsonl --top 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+FANOUT_EVENTS = (
+    "fanout_churn",
+    "fanout_publish",
+    "fanout_shed",
+    "fanout_resume",
+    "fanout_conn_close",
+    "fanout_summary",
+)
+
+
+def load_fanout_events(path: str | Path) -> list[dict]:
+    """All fan-out plane events, in file order; corrupt lines (a torn
+    write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") in FANOUT_EVENTS:
+                out.append(record)
+    return out
+
+
+def render_report(events: list[dict], top: int = 10) -> str:
+    """The deterministic report: churn tally, per-signal publish volume,
+    shed counts by reason, resume timeline, the per-connection delivery
+    scoreboard (with lag), and the plane's final summary."""
+    lines: list[str] = []
+    churn: dict[str, int] = {}
+    published: dict[tuple[str, str], list[int]] = {}
+    shed: dict[str, int] = {}
+    conns: list[dict] = []
+    last_summary: dict | None = None
+    for e in events:
+        kind = e.get("event")
+        if kind == "fanout_churn":
+            op = e.get("op", "?")
+            churn[op] = churn.get(op, 0) + 1
+        elif kind == "fanout_publish":
+            key = (e.get("strategy", "?"), e.get("symbol", "?"))
+            cell = published.setdefault(key, [0, 0])
+            cell[0] += 1
+            cell[1] += int(e.get("recipients", 0) or 0)
+        elif kind == "fanout_shed":
+            # aggregated sheds (close_pending, resume_overflow) carry a
+            # count field; per-frame sheds (slow_consumer) count as 1
+            reason = e.get("reason", "?")
+            shed[reason] = shed.get(reason, 0) + int(e.get("count", 1) or 1)
+        elif kind == "fanout_resume":
+            lines.append(
+                f"resume   {e.get('user', '?'):<12} ({e.get('transport', '?')})"
+                f" cursor={e.get('cursor', '?')}"
+                f" replayed={e.get('replayed', 0)}"
+            )
+        elif kind == "fanout_conn_close":
+            conns.append(e)
+        elif kind == "fanout_summary":
+            last_summary = e
+    if churn:
+        net = (
+            churn.get("subscribe", 0) - churn.get("unsubscribe", 0)
+        )
+        lines.insert(
+            0,
+            "churn    "
+            + " ".join(f"{op}={churn[op]}" for op in sorted(churn))
+            + f" (net {net:+d})",
+        )
+    for (strategy, symbol) in sorted(published):
+        frames, recipients = published[(strategy, symbol)]
+        lines.append(
+            f"publish  {strategy}/{symbol}"
+            f"  {frames} frame{'s' if frames != 1 else ''},"
+            f" {recipients} recipients"
+        )
+    for reason in sorted(shed):
+        lines.append(f"shed     {reason} = {shed[reason]}")
+    if conns:
+        lines.append("")
+        lines.append(
+            f"{'connection':<12} {'tport':<5} {'sent':>5} {'drop':>5}"
+            f" {'replay':>6} {'gap':>3} {'lag_mean':>9} {'lag_max':>8}"
+        )
+        for c in sorted(
+            conns, key=lambda c: (c.get("user", ""), c.get("transport", ""))
+        ):
+            mean = c.get("lag_ms_mean")
+            lines.append(
+                f"{c.get('user', '?'):<12} {c.get('transport', '?'):<5}"
+                f" {c.get('delivered', 0):>5} {c.get('dropped', 0):>5}"
+                f" {c.get('replayed', 0):>6}"
+                f" {'yes' if c.get('gapped') else 'no':>3}"
+                f" {(f'{mean:.1f}ms' if mean is not None else '-'):>9}"
+                f" {c.get('lag_ms_max', 0):>6.1f}ms"
+            )
+    if last_summary is not None:
+        s = last_summary
+        lines.append("")
+        recompiles = s.get("recompiles") or {}
+        lines.append(
+            f"summary  users={s.get('users', 0)}"
+            f" published={s.get('published', 0)}"
+            f" recipients={s.get('matched_recipients', 0)}"
+            f" dispatches={s.get('match_dispatches', 0)}"
+            f" recompiles="
+            + "/".join(
+                f"{k}:{recompiles[k]}" for k in sorted(recompiles)
+            )
+        )
+        lines.append(
+            f"hub      frames_sent={s.get('frames_sent', 0)}"
+            f" shed={s.get('shed', 0)} resumed={s.get('resumed', 0)}"
+        )
+        top_users = (s.get("top_users") or [])[:top]
+        if top_users:
+            lines.append(f"hottest  top {len(top_users)} subscriptions:")
+            for row in top_users:
+                lines.append(
+                    f"  {row.get('user', '?'):<20}"
+                    f" {row.get('delivered', 0):>6} delivered"
+                )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument(
+        "--top", type=int, default=10,
+        help="hottest-subscription rows rendered from the summary",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_fanout_events(args.log)
+    if not events:
+        print(f"no fanout events in {args.log}", file=sys.stderr)
+        return 1
+    print(render_report(events, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke ingest-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -76,6 +76,18 @@ help:
 	@echo "               The 2048x400 host_phase acceptance numbers merge"
 	@echo "               into BENCH_REPLAY_CPU.json via"
 	@echo "               'python bench.py --replay-throughput'"
+	@echo "  ingest-smoke - ingest-health observatory lane (ISSUE 15): the"
+	@echo "               pytest drills (digest layout/decode, wire"
+	@echo "               bit-identity with the digest off, the serial=="
+	@echo "               donated==scanned==backtest digest equality pin,"
+	@echo "               host monitor classification + /debug/symbols +"
+	@echo "               SLO trip/clear, the slow churn+rewrite stream"
+	@echo "               drill, report goldens), then a scripted per-"
+	@echo "               symbol feed-outage replay through main.py"
+	@echo "               --replay with the staleness SLO burning and"
+	@echo "               clearing, rendered by tools/ingest_report.py."
+	@echo "               The 2048x400 acceptance number (<5% wire-step"
+	@echo "               bytes) is the bench --device ingest_digest arm."
 	@echo "  outcome-smoke- signal-outcome observatory lane (ISSUE 12):"
 	@echo "               the pytest drills (maturation-gather math, cap/"
 	@echo "               eviction, the serial==scanned==backtest matured-"
@@ -136,6 +148,16 @@ bench:
 
 smoke:
 	python bench.py --smoke
+
+ingest-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ingest_health.py -q \
+		-p no:cacheprovider
+	python -c "from binquant_tpu.sim.scenarios import write_scenario_file; write_scenario_file('feed_outage', '/tmp/replay_ingest.jsonl')"
+	rm -f /tmp/bqt_ingest_events.jsonl
+	BQT_INGEST_DIGEST=1 BQT_INGEST_STALE_BUDGET=0 \
+	BQT_EVENT_LOG=/tmp/bqt_ingest_events.jsonl JAX_PLATFORMS=cpu \
+	python main.py --replay /tmp/replay_ingest.jsonl
+	python tools/ingest_report.py /tmp/bqt_ingest_events.jsonl
 
 obs-smoke:
 	python -m pytest tests/test_obs.py tests/test_tracing.py -q -m "not slow" \

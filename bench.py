@@ -122,6 +122,36 @@ MEASUREMENT_EPOCH = {
 }
 
 
+def _git_sha() -> str:
+    """Short git SHA of the measured tree (ISSUE 15 satellite: every bench
+    record orders deterministically in BENCH_TRAJECTORY.json)."""
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:
+        return "unknown"
+
+
+def _stamped(record: dict) -> dict:
+    """Uniform record stamp: wall-clock measurement time + git SHA (for
+    deterministic ordering by tools/bench_trajectory.py) and the
+    MEASUREMENT_EPOCH methodology note on the detail section. Mutates and
+    returns ``record`` so print- and file-writers share one stamped dict."""
+    record.setdefault("measured_at_epoch_s", int(time.time()))
+    record.setdefault("git_sha", _git_sha())
+    detail = record.get("detail")
+    if isinstance(detail, dict):
+        detail.setdefault("measurement_epoch", MEASUREMENT_EPOCH)
+    return record
+
+
 def device_cost_breakdown(
     num_symbols: int = 2048,
     window: int = 400,
@@ -399,6 +429,15 @@ def device_cost_breakdown(
     # classic overhead is a tracked number instead of a NOTE.
     cost_digest = _cost_of(incremental=True, numeric_digest=True)
     cost_digest_classic = _cost_of(maintain_carry=False, numeric_digest=True)
+    # ingest-health digest (ISSUE 15): same acceptance framing — the
+    # ingest block's wire-step byte overhead must stay <5% over the
+    # digest-off step on BOTH paths, and the production stack carries
+    # numeric + ingest together, so that combination is recorded too
+    cost_ingest = _cost_of(incremental=True, ingest_digest=True)
+    cost_ingest_classic = _cost_of(maintain_carry=False, ingest_digest=True)
+    cost_obs_stack = _cost_of(
+        incremental=True, numeric_digest=True, ingest_digest=True
+    )
 
     def _ratio(full, incr):
         if not full or not incr or incr != incr or full != full:
@@ -494,6 +533,31 @@ def device_cost_breakdown(
                 "bytes_overhead_pct": _overhead_pct(
                     cost_digest_classic.get("bytes_accessed"),
                     cost.get("bytes_accessed"),
+                ),
+            },
+        },
+        # ISSUE 15 acceptance: the ingest digest's wire-step byte overhead
+        # (<5%), same NaN handling/rounding rules as the numeric arm above
+        "ingest_digest": {
+            **cost_ingest,
+            "bytes_overhead_pct": _overhead_pct(
+                cost_ingest.get("bytes_accessed"),
+                cost_incr.get("bytes_accessed"),
+            ),
+            "classic": {
+                **cost_ingest_classic,
+                "bytes_overhead_pct": _overhead_pct(
+                    cost_ingest_classic.get("bytes_accessed"),
+                    cost.get("bytes_accessed"),
+                ),
+            },
+            # the deployed observability stack (numeric + ingest digests
+            # both on) vs the digest-free incremental wire
+            "with_numeric_stack": {
+                **cost_obs_stack,
+                "bytes_overhead_pct": _overhead_pct(
+                    cost_obs_stack.get("bytes_accessed"),
+                    cost_incr.get("bytes_accessed"),
                 ),
             },
         },
@@ -1982,6 +2046,10 @@ def main() -> int | None:
     # measure a digest-on drive explicitly.
     os.environ.setdefault("BQT_NUMERIC_DIGEST", "0")
     os.environ.setdefault("BQT_DRIFT_METER", "0")
+    # Ingest digest likewise: throughput arms quote the digest-off wire;
+    # its own overhead is the device record's ingest_digest arm. Set
+    # BQT_INGEST_DIGEST=1 to measure a digest-on drive explicitly.
+    os.environ.setdefault("BQT_INGEST_DIGEST", "0")
     # Signal-outcome observatory likewise pinned OFF in throughput arms:
     # the benches quote the observatory-free hot path, and the outcome
     # bed's own cost is the dedicated --outcome-cost arm
@@ -2170,7 +2238,7 @@ def main() -> int | None:
             "vs_baseline": r["backtest_vs_serial_x"],
             "detail": r,
         }
-        print(json.dumps(record))
+        print(json.dumps(_stamped(record)))
         if jax.default_backend() == "cpu" and record_shape:
             with open("BENCH_BACKTEST_CPU.json", "w") as f:
                 json.dump(record, f, indent=1)
@@ -2190,7 +2258,7 @@ def main() -> int | None:
             "vs_baseline": r["speedup_vs_python_oracle_x"],
             "detail": r,
         }
-        print(json.dumps(record))
+        print(json.dumps(_stamped(record)))
         if jax.default_backend() == "cpu" and n_subs >= 1_000_000:
             with open("BENCH_FANOUT_CPU.json", "w") as f:
                 json.dump(record, f, indent=1)
@@ -2213,7 +2281,7 @@ def main() -> int | None:
             ),
             "detail": r,
         }
-        print(json.dumps(record))
+        print(json.dumps(_stamped(record)))
         if (
             jax.default_backend() == "cpu"
             and args.symbols >= 2048
@@ -2242,7 +2310,7 @@ def main() -> int | None:
             ),
             "detail": r,
         }
-        print(json.dumps(record))
+        print(json.dumps(_stamped(record)))
         if (
             jax.default_backend() == "cpu"
             and args.symbols >= 2048
@@ -2304,7 +2372,7 @@ def main() -> int | None:
             ),
             "detail": r,
         }
-        print(json.dumps(record))
+        print(json.dumps(_stamped(record)))
         # only the acceptance shape overwrites the checked-in record —
         # smoke-shape runs (make replay-smoke) print only
         if (
@@ -2333,14 +2401,14 @@ def main() -> int | None:
             (p for p in sweep["points"] if p["symbols"] == 2048), sweep["points"][0]
         )
         print(
-            json.dumps(
+            json.dumps(_stamped(
                 {
                     "metric": "device_step_ms_at_2048",
                     "value": ref_point["step_ms"],
                     "unit": "ms",
                     "vs_baseline": round(50.0 / ref_point["step_ms"], 3),
-                    "detail": {**sweep, "measurement_epoch": MEASUREMENT_EPOCH},
-                }
+                    "detail": dict(sweep),
+                })
             )
         )
         return
@@ -2348,14 +2416,14 @@ def main() -> int | None:
     if args.device:
         d = device_cost_breakdown(args.symbols, args.window, per_strategy=True)
         print(
-            json.dumps(
+            json.dumps(_stamped(
                 {
                     "metric": "device_step_ms",
                     "value": d["step_ms"],
                     "unit": "ms",
                     "vs_baseline": round(50.0 / d["step_ms"], 3),
-                    "detail": {**d, "measurement_epoch": MEASUREMENT_EPOCH},
-                }
+                    "detail": dict(d),
+                })
             )
         )
         return
@@ -2364,7 +2432,7 @@ def main() -> int | None:
         stats = run_config1()
         value = round(stats["p99_ms"], 3)
         print(
-            json.dumps(
+            json.dumps(_stamped(
                 {
                     "metric": "legacy_single_symbol_tick_p99_ms",
                     "value": value,
@@ -2379,7 +2447,7 @@ def main() -> int | None:
                             "pandas oracle (the reference-shaped path)"
                         ),
                     },
-                }
+                })
             )
         )
         return
@@ -2388,7 +2456,7 @@ def main() -> int | None:
         stats = run_config2()
         value = round(stats["pass_ms"], 3)
         print(
-            json.dumps(
+            json.dumps(_stamped(
                 {
                     "metric": "indicator_batch_pass_ms",
                     "value": value,
@@ -2402,7 +2470,7 @@ def main() -> int | None:
                             "D2H sync, amortized over 50 passes"
                         ),
                     },
-                }
+                })
             )
         )
         return
@@ -2413,7 +2481,7 @@ def main() -> int | None:
         )
         value = round(stats["p99_ms"], 3)
         print(
-            json.dumps(
+            json.dumps(_stamped(
                 {
                     "metric": "context_scoring_4tf_p99_ms",
                     "value": value,
@@ -2438,7 +2506,7 @@ def main() -> int | None:
                             stats["scoring_evals_per_sec"]
                         ),
                     },
-                }
+                })
             )
         )
         return
@@ -2451,7 +2519,7 @@ def main() -> int | None:
     )
     value = round(stats["p99_ms"], 3)
     print(
-        json.dumps(
+        json.dumps(_stamped(
             {
                 "metric": "tick_p99_ms",
                 "value": value,
@@ -2514,7 +2582,7 @@ def main() -> int | None:
                     "device": device,
                     "measurement_epoch": MEASUREMENT_EPOCH,
                 },
-            }
+            })
         )
     )
 

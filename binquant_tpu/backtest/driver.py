@@ -246,12 +246,14 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
                     window=W,
                     params=None if params is None else dynamic_params(params),
                     numeric_digest=engine.numeric_digest,
+                    ingest_digest=engine.ingest_digest,
                 )
                 ledger_sig = (
                     f"S{engine.capacity}xW{W} T{tb}"
                     f" ext5[{ext5_t.shape[1] - W}]"
                     f" ext15[{ext15_t.shape[1] - W}]"
                     f" digest={int(engine.numeric_digest)}"
+                    + (" ingest=1" if engine.ingest_digest else "")
                 )
 
                 def cost_fn(

@@ -241,6 +241,7 @@ def _evaluate_tick(
     wire_enabled: tuple[str, ...],
     sp,
     numeric_digest: bool = False,
+    ingest_block=None,
 ):
     """The gated half of the full tick from precomputed features: market
     context (same ``compute_market_context``, symbol features injected),
@@ -366,6 +367,11 @@ def _evaluate_tick(
         jnp.asarray(0.0, dtype=jnp.float32),  # full path: no dirty bc rows
         wire_enabled,
         digest=digest,
+        # ingest-health block (ISSUE 15): assembled OUTSIDE the scan from
+        # the per-tick window views + cumulative extension counts
+        # (_chunk_ingest_stats/_chunk_ingest_counts) and threaded in as a
+        # scan input — packed last, exactly like the serial step
+        ingest=ingest_block,
     )
     enabled_mask = jnp.asarray(
         [s in wire_enabled for s in STRATEGY_ORDER], dtype=bool
@@ -377,6 +383,89 @@ def _evaluate_tick(
         summary.autotrade & summary.trigger & enabled_mask[:, None], axis=1
     ).astype(jnp.int32)
     return (regime_carry2, mrf_carry2, pt_carry2), wire, trig_counts, at_counts
+
+
+def _chunk_ingest_counts(
+    ext_times: jnp.ndarray,  # (S, W+L) extension times
+    counts: jnp.ndarray,  # (T, S) cumulative bars applied through tick t
+    window: int,
+    interval_s: int,
+) -> jnp.ndarray:
+    """(T, 4) per-tick ``(appends, rewrites, gaps, drops)`` for the ingest
+    digest. The drive only batches clean strictly-newer appends (anything
+    else breaks the chunk back to the serial path), so rewrites/drops are
+    identically zero here — matching what the serial classifier reads on
+    the same stream. Gap bars are judged position-locally (a laid bar more
+    than one whole bucket past its ring predecessor), exactly the serial
+    rule, via one cumulative-sum pass over the extension columns."""
+    S = ext_times.shape[0]
+    laid = ext_times[:, window:]  # (S, L) — k-th laid bar per symbol
+    prev = ext_times[:, window - 1 : -1]  # its ring predecessor
+    gapflag = (laid >= 0) & (prev >= 0) & ((laid - prev) > interval_s)
+    gcum = jnp.concatenate(
+        [
+            jnp.zeros((S, 1), jnp.int32),
+            jnp.cumsum(gapflag.astype(jnp.int32), axis=1),
+        ],
+        axis=1,
+    )  # (S, L+1): gap bars among the first k laid
+    prev_counts = jnp.concatenate(
+        [jnp.zeros((1, S), counts.dtype), counts[:-1]], axis=0
+    )
+    appends_t = jnp.sum(counts - prev_counts, axis=1).astype(jnp.float32)
+    g_hi = jnp.take_along_axis(gcum, counts.T.astype(jnp.int32), axis=1)
+    g_lo = jnp.take_along_axis(gcum, prev_counts.T.astype(jnp.int32), axis=1)
+    gaps_t = jnp.sum(g_hi - g_lo, axis=0).astype(jnp.float32)
+    zeros = jnp.zeros_like(appends_t)
+    return jnp.stack([appends_t, zeros, gaps_t, zeros], axis=1)
+
+
+def _chunk_ingest_blocks(
+    views5: MarketBuffer,  # (T, ...) stacked window views
+    views15: MarketBuffer,
+    ext5,
+    ext15,
+    counts5: jnp.ndarray,
+    counts15: jnp.ndarray,
+    inputs_seq: HostInputs,
+    window: int,
+) -> jnp.ndarray:
+    """(T, INGEST_DIGEST_WIDTH) stacked ingest blocks — the same shared
+    ``_ingest_interval_stats`` reductions the serial step runs, vmapped
+    over the tick axis (exact integer ops → bit-identical blocks)."""
+    from binquant_tpu.engine.step import (
+        FIFTEEN_MIN_S,
+        FIVE_MIN_S,
+        _ingest_interval_stats,
+    )
+
+    def stats(views, eval_ts_seq, interval_s):
+        def one(latest, filled, tracked, eval_ts):
+            return jnp.stack(
+                _ingest_interval_stats(
+                    latest, filled, tracked, eval_ts, interval_s
+                )
+            )
+
+        # canonical views: each tick's newest bar sits in the last column
+        return jax.vmap(one)(
+            views.times[:, :, -1],
+            views.filled,
+            inputs_seq.tracked,
+            eval_ts_seq,
+        )
+
+    tracked_ct = jnp.sum(inputs_seq.tracked, axis=1).astype(jnp.float32)
+    return jnp.concatenate(
+        [
+            tracked_ct[:, None],
+            stats(views5, inputs_seq.timestamp5_s, FIVE_MIN_S),
+            _chunk_ingest_counts(ext5[0], counts5, window, FIVE_MIN_S),
+            stats(views15, inputs_seq.timestamp_s, FIFTEEN_MIN_S),
+            _chunk_ingest_counts(ext15[0], counts15, window, FIFTEEN_MIN_S),
+        ],
+        axis=1,
+    )
 
 
 def _backtest_chunk_impl(
@@ -395,6 +484,7 @@ def _backtest_chunk_impl(
     window: int = 400,
     params=None,
     numeric_digest: bool = False,
+    ingest_digest: bool = False,
 ):
     """T full-recompute ticks in one dispatch over the extended buffers.
 
@@ -412,7 +502,9 @@ def _backtest_chunk_impl(
         "buffer-consuming dormant kernels run via the serial drives"
     )
     S = ext5[0].shape[0]
-    L = wire_length(S, numeric_digest=numeric_digest)
+    L = wire_length(
+        S, numeric_digest=numeric_digest, ingest_digest=ingest_digest
+    )
     n_strat = len(STRATEGY_ORDER)
     range_code = jnp.int32(int(MarketRegimeCode.RANGE))
     trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
@@ -434,9 +526,18 @@ def _backtest_chunk_impl(
         zeros = jnp.zeros((T, S), jnp.float32)
         abp_pre = (jnp.zeros((T, S), bool), zeros, {})
 
+    ing_seq = (
+        _chunk_ingest_blocks(
+            views5, views15, ext5, ext15, counts5, counts15,
+            inputs_seq, window,
+        )
+        if ingest_digest
+        else None
+    )
+
     def body(carry, xs):
         regime_c, mrf_c, pt_c, prev_valid, prev_regime = carry
-        pre_t, abp_t, inp, act, mok = xs
+        pre_t, abp_t, inp, act, mok, ing_t = xs
         allow = (
             mok
             & prev_valid
@@ -449,6 +550,7 @@ def _backtest_chunk_impl(
             (rc2, mc2, pc2), wire, tc, ac = _evaluate_tick(
                 pre_t, abp_t, inp, rc, mc, pc, cfg, wire_enabled, sp,
                 numeric_digest,
+                ingest_block=ing_t,
             )
             return rc2, mc2, pc2, wire, tc, ac
 
@@ -473,7 +575,7 @@ def _backtest_chunk_impl(
         jax.lax.scan(
             body,
             (regime_c, mrf_c, pt_c, policy_prev[0], policy_prev[1]),
-            (pre, abp_pre, inputs_seq, active, momentum_ok),
+            (pre, abp_pre, inputs_seq, active, momentum_ok, ing_seq),
         )
     )
     return (
@@ -487,7 +589,9 @@ def _backtest_chunk_impl(
 
 backtest_chunk = partial(
     jax.jit,
-    static_argnames=("cfg", "wire_enabled", "window", "numeric_digest"),
+    static_argnames=(
+        "cfg", "wire_enabled", "window", "numeric_digest", "ingest_digest",
+    ),
 )(_backtest_chunk_impl)
 
 

@@ -226,6 +226,28 @@ class Config:
         return int(self._get("BQT_NUMERIC_NAN_BUDGET", "0") or "0")
 
     @cached_property
+    def ingest_digest(self) -> bool:
+        """Ingest-health observatory (ISSUE 15): the device-side ingest
+        digest riding the wire (per-interval staleness buckets, coverage
+        funnel, append/rewrite/gap/drop routing counts) PLUS the host-side
+        per-symbol watermark/counter monitor, bqt_ingest_* families, the
+        /healthz ``ingest`` section and GET /debug/symbols.
+        BQT_INGEST_DIGEST=0 disables the whole observatory and compiles
+        the pre-ingest wire bit-identically (the tier-1 test lane's
+        default)."""
+        return self._get("BQT_INGEST_DIGEST", "1") != "0"
+
+    @cached_property
+    def ingest_stale_budget(self) -> int:
+        """Staleness SLO: tracked rows allowed to be at least one whole
+        bucket behind (the digest's 1x staleness buckets, both intervals
+        summed) per tick before the tick counts as an ingest anomaly
+        (bqt_ingest_anomaly_ticks_total + a force-emitted ingest_anomaly
+        event; recovery emits ingest_recovered). Default 0 — any stale
+        row burns the budget."""
+        return int(self._get("BQT_INGEST_STALE_BUDGET", "0") or "0")
+
+    @cached_property
     def drift_meter(self) -> bool:
         """Measure per-family carried-vs-fresh indicator drift on every
         audit tick BEFORE the resync overwrites the carry (exported as

@@ -144,9 +144,28 @@ def ring_latest_times(buf: MarketBuffer) -> jnp.ndarray:
     return jnp.take_along_axis(buf.times, idx[:, None], axis=1)[:, 0]
 
 
-def _scatter_updates(buf: MarketBuffer, row_idx, ts, vals):
-    """The shared host-batch → per-symbol slot scatter + append/rewrite
-    routing both apply_updates implementations use."""
+class UpdateRouting(NamedTuple):
+    """One update batch's routing verdicts against the PRE-update ring —
+    the single copy of the append/rewrite/drop decision rules shared by
+    both ``apply_updates`` implementations AND the ingest digest's batch
+    classifier (``engine/step.py _ingest_batch_counts``), so the decoded
+    digest can never drift from what the scatter actually did."""
+
+    upd_ts: jnp.ndarray  # (S,) int32 per-row update ts, -1 = no update
+    safe_idx: jnp.ndarray  # (U,) scatter index (S = dropped)
+    has_update: jnp.ndarray  # (S,) bool
+    is_append: jnp.ndarray  # (S,) strictly-newer bar (or first bar)
+    last_ts: jnp.ndarray  # (S,) pre-update newest bar ts
+    slot_match: jnp.ndarray  # (S, W) the bar already holding upd_ts
+    is_rewrite: jnp.ndarray  # (S,) non-append with a matching bar
+    # non-append, no matching bar: a stale mid-history insert, discarded
+
+
+def route_updates(buf: MarketBuffer, row_idx, ts) -> UpdateRouting:
+    """Classify one update batch against the pre-update ring — see
+    :class:`UpdateRouting`. The rewrite match scan reads only the (S, W)
+    int32 times plane; per-symbol times are strictly increasing in ring
+    order, so at most one slot matches."""
     S, W = buf.times.shape
 
     # Invalid rows map to index S (strictly out of bounds) so mode="drop"
@@ -158,15 +177,28 @@ def _scatter_updates(buf: MarketBuffer, row_idx, ts, vals):
 
     # Scatter the batch into per-symbol slots: -1 means "no update this tick".
     upd_ts = jnp.full((S,), -1, dtype=jnp.int32).at[safe_idx].set(ts, mode="drop")
-    upd_vals = (
-        jnp.zeros((S, NUM_FIELDS), dtype=jnp.float32)
-        .at[safe_idx]
-        .set(vals.astype(jnp.float32), mode="drop")
-    )
     has_update = upd_ts >= 0
     last_ts = ring_latest_times(buf)
     is_append = has_update & ((buf.filled == 0) | (upd_ts > last_ts))
-    return upd_ts, upd_vals, has_update, is_append
+    slot_match = (buf.times == upd_ts[:, None]) & has_update[:, None]
+    is_rewrite = has_update & ~is_append & slot_match.any(axis=1)
+    return UpdateRouting(
+        upd_ts, safe_idx, has_update, is_append, last_ts,
+        slot_match, is_rewrite,
+    )
+
+
+def _scatter_updates(buf: MarketBuffer, row_idx, ts, vals):
+    """The shared host-batch → per-symbol slot scatter + routing both
+    apply_updates implementations use: (routing, upd_vals (S, F))."""
+    S = buf.times.shape[0]
+    routing = route_updates(buf, row_idx, ts)
+    upd_vals = (
+        jnp.zeros((S, NUM_FIELDS), dtype=jnp.float32)
+        .at[routing.safe_idx]
+        .set(vals.astype(jnp.float32), mode="drop")
+    )
+    return routing, upd_vals
 
 
 @jax.jit
@@ -191,36 +223,33 @@ def apply_updates(
     the IngestBatcher does this; scatter order on duplicates is undefined.
     """
     S, W = buf.times.shape
-    upd_ts, upd_vals, has_update, is_append = _scatter_updates(
-        buf, row_idx, ts, vals
-    )
+    r, upd_vals = _scatter_updates(buf, row_idx, ts, vals)
     rows = jnp.arange(S)
 
     # Append: one column at the cursor (index W = dropped for non-appends).
-    app_slot = jnp.where(is_append, buf.cursor, W)
-    times = buf.times.at[rows, app_slot].set(upd_ts, mode="drop")
+    app_slot = jnp.where(r.is_append, buf.cursor, W)
+    times = buf.times.at[rows, app_slot].set(r.upd_ts, mode="drop")
     values = buf.values.at[rows, app_slot].set(upd_vals, mode="drop")
 
     # Rewrite the bar that already holds this timestamp — the latest bar
     # (same-bucket correction) or ANY mid-history bar (an exchange
     # re-sending a corrected candle), exactly the reference's dedupe-by-
-    # timestamp keep-last (market_state_store.py:19-32). Per-symbol times
-    # are strictly increasing in ring order, so at most one slot matches;
-    # the match scan reads only the (S, W) int32 times plane, not the
-    # (S, W, F) values. An older timestamp with NO matching bar (a bar
-    # missed entirely, delivered late) is dropped: a fixed-shape window
-    # cannot insert mid-history without a full sort.
-    slot_match = (buf.times == upd_ts[:, None]) & has_update[:, None]
-    is_rewrite = has_update & ~is_append & slot_match.any(axis=1)
-    rw_slot = jnp.where(is_rewrite, jnp.argmax(slot_match, axis=1), W)
+    # timestamp keep-last (market_state_store.py:19-32). An older
+    # timestamp with NO matching bar (a bar missed entirely, delivered
+    # late) is dropped: a fixed-shape window cannot insert mid-history
+    # without a full sort. The match/verdicts come from route_updates —
+    # the one copy of these rules.
+    rw_slot = jnp.where(
+        r.is_rewrite, jnp.argmax(r.slot_match, axis=1), W
+    )
     values = values.at[rows, rw_slot].set(upd_vals, mode="drop")
 
     filled = jnp.where(
-        is_append, jnp.minimum(buf.filled + 1, W), buf.filled
+        r.is_append, jnp.minimum(buf.filled + 1, W), buf.filled
     ).astype(jnp.int32)
-    cursor = jnp.where(is_append, (buf.cursor + 1) % W, buf.cursor).astype(
-        jnp.int32
-    )
+    cursor = jnp.where(
+        r.is_append, (buf.cursor + 1) % W, buf.cursor
+    ).astype(jnp.int32)
     return MarketBuffer(times=times, values=values, filled=filled, cursor=cursor)
 
 
@@ -237,27 +266,23 @@ def apply_updates_shift(
     and the "before" arm of ``bench.py --ring-traffic`` — not a live
     dispatch path."""
     S, W = buf.times.shape
-    upd_ts, upd_vals, has_update, is_append = _scatter_updates(
-        buf, row_idx, ts, vals
-    )
+    r, upd_vals = _scatter_updates(buf, row_idx, ts, vals)
 
     # Candidate A: shift-left append (oldest bar falls off the front).
-    app_times = jnp.concatenate([buf.times[:, 1:], upd_ts[:, None]], axis=1)
+    app_times = jnp.concatenate([buf.times[:, 1:], r.upd_ts[:, None]], axis=1)
     app_vals = jnp.concatenate([buf.values[:, 1:, :], upd_vals[:, None, :]], axis=1)
 
-    slot_match = (buf.times == upd_ts[:, None]) & has_update[:, None]
-    is_rewrite = has_update & ~is_append & slot_match.any(axis=1)
     rw_vals = jnp.where(
-        (is_rewrite[:, None] & slot_match)[..., None],
+        (r.is_rewrite[:, None] & r.slot_match)[..., None],
         upd_vals[:, None, :],
         buf.values,
     )
 
-    sel_a = is_append[:, None]
+    sel_a = r.is_append[:, None]
     times = jnp.where(sel_a, app_times, buf.times)
     values = jnp.where(sel_a[..., None], app_vals, rw_vals)
     filled = jnp.where(
-        is_append, jnp.minimum(buf.filled + 1, W), buf.filled
+        r.is_append, jnp.minimum(buf.filled + 1, W), buf.filled
     ).astype(jnp.int32)
     return MarketBuffer(
         times=times, values=values, filled=filled, cursor=buf.cursor

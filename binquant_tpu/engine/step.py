@@ -33,6 +33,7 @@ from binquant_tpu.engine.buffer import (
     fresh_mask,
     materialize,
     materialize_tail,
+    ring_latest_times,
 )
 from binquant_tpu.ops.incremental import (
     BetaCorrCarry,
@@ -509,6 +510,164 @@ def decode_numeric_digest(block) -> dict:
     }
 
 
+# --- ingest-health digest (ISSUE 15) ----------------------------------------
+# A second fused device-computed stats block appended to the wire STRICTLY
+# AFTER the numeric digest when the static ``ingest_digest`` flag is on
+# (BQT_INGEST_DIGEST): per-interval staleness buckets (tracked rows whose
+# last bar's age exceeds 1x/3x/10x the bar interval), coverage counts
+# (tracked vs filled>=MIN_BARS vs fresh-and-sufficient), and the tick's
+# append/rewrite/gap/drop routing counts summed over EVERY update sub-batch
+# the tick applied (fold slots included — the serial drive accumulates fold
+# counts through the counted fold steps below, the scanned drive inside
+# ``_fold_and_step_wire``, and the backtest kernel from its cumulative
+# extension counts). Disabled (the default argument) the traced graph is
+# unchanged, so the wire compiles bit-identically to the pre-ingest layout.
+FIVE_MIN_S = 300
+FIFTEEN_MIN_S = 900
+INGEST_INTERVALS: tuple[str, ...] = ("5m", "15m")
+INGEST_STAT_FIELDS: tuple[str, ...] = (
+    "stale_1x", "stale_3x", "stale_10x", "max_age_s",
+    "covered", "min_bars", "fresh",
+)
+INGEST_COUNT_FIELDS: tuple[str, ...] = (
+    "appends", "rewrites", "gap_appends", "dropped",
+)
+INGEST_DIGEST_WIDTH = 1 + len(INGEST_INTERVALS) * (
+    len(INGEST_STAT_FIELDS) + len(INGEST_COUNT_FIELDS)
+)
+
+
+def ingest_digest_layout() -> list[str]:
+    """Field names of the ingest block, in wire order (decode + docs)."""
+    names = ["tracked"]
+    for interval in INGEST_INTERVALS:
+        names += [f"{interval}.{f}" for f in INGEST_STAT_FIELDS]
+        names += [f"{interval}.{f}" for f in INGEST_COUNT_FIELDS]
+    return names
+
+
+def _ingest_interval_stats(
+    latest_ts: jnp.ndarray,  # (S,) int32 newest bar open time, -1 empty
+    filled: jnp.ndarray,  # (S,) int32
+    tracked: jnp.ndarray,  # (S,) bool
+    eval_ts: jnp.ndarray,  # scalar int32 — the evaluated bucket's open time
+    interval_s: int,
+) -> list[jnp.ndarray]:
+    """The 7 per-interval staleness/coverage scalars, POST-update.
+
+    Staleness buckets are cumulative thresholds over ``age = eval_ts -
+    latest_ts`` among tracked rows that hold any data: ``stale_1x`` means
+    the row missed at least one whole bucket (a fresh row has age 0, a row
+    one bar behind exactly ``interval`` — neither counts). ``max_age_s``
+    is the stalest such row's age (NaN when no tracked row holds data).
+    Every operation is an exact integer reduction cast to f32, so all four
+    backends produce bit-identical blocks on the same stream."""
+    covered = tracked & (filled > 0)
+    age = jnp.where(covered, eval_ts - latest_ts, 0).astype(jnp.int32)
+    any_covered = jnp.any(covered)
+    max_age = jnp.max(jnp.where(covered, age, 0)).astype(jnp.float32)
+    min_bars = tracked & (filled >= MIN_BARS)
+    fresh = min_bars & (latest_ts == eval_ts)
+    return [
+        jnp.sum(covered & (age > 1 * interval_s)).astype(jnp.float32),
+        jnp.sum(covered & (age > 3 * interval_s)).astype(jnp.float32),
+        jnp.sum(covered & (age > 10 * interval_s)).astype(jnp.float32),
+        jnp.where(any_covered, max_age, jnp.float32(jnp.nan)),
+        jnp.sum(covered).astype(jnp.float32),
+        jnp.sum(min_bars).astype(jnp.float32),
+        jnp.sum(fresh).astype(jnp.float32),
+    ]
+
+
+def _ingest_batch_counts(
+    buf: MarketBuffer,
+    row_idx: jnp.ndarray,
+    ts: jnp.ndarray,
+    interval_s: int,
+) -> jnp.ndarray:
+    """(4,) f32 ``(appends, rewrites, gap_appends, dropped)`` — one update
+    sub-batch classified against the PRE-update ring through the SAME
+    ``route_updates`` the apply scatters resolve (one copy of the rules —
+    the digest cannot drift from the actual routing). A gap append is a
+    new bar that skipped at least one whole bucket past the row's
+    previous newest bar (clean next-bucket appends advance by exactly
+    ``interval``); dropped updates are stale mid-history inserts
+    ``apply_updates`` discards."""
+    from binquant_tpu.engine.buffer import route_updates
+
+    r = route_updates(buf, row_idx, ts)
+    dropped = r.has_update & ~r.is_append & ~r.is_rewrite
+    gap = (
+        r.is_append & (buf.filled > 0) & (r.upd_ts - r.last_ts > interval_s)
+    )
+    return jnp.stack(
+        [
+            jnp.sum(r.is_append).astype(jnp.float32),
+            jnp.sum(r.is_rewrite).astype(jnp.float32),
+            jnp.sum(gap).astype(jnp.float32),
+            jnp.sum(dropped).astype(jnp.float32),
+        ]
+    )
+
+
+def _ingest_digest_block(
+    tracked: jnp.ndarray,
+    stats5: list,
+    stats15: list,
+    counts5: jnp.ndarray,
+    counts15: jnp.ndarray,
+) -> jnp.ndarray:
+    """Assemble the (INGEST_DIGEST_WIDTH,) f32 block in layout order —
+    ONE copy shared by the serial/scanned steps and the backtest kernel
+    so the backends cannot drift."""
+    return jnp.concatenate(
+        [
+            jnp.stack([jnp.sum(tracked).astype(jnp.float32)] + stats5),
+            counts5,
+            jnp.stack(stats15),
+            counts15,
+        ]
+    )
+
+
+def decode_ingest_digest(block) -> dict:
+    """Host-side decode of one tick's ingest block → nested dict (the
+    ``bqt_ingest_*`` gauges, /healthz ``ingest`` section, ``ingest_*``
+    events). ``max_age_s`` decodes NaN → None (no tracked data)."""
+    import numpy as np
+
+    vec = np.asarray(block, dtype=np.float64)
+    assert vec.shape == (INGEST_DIGEST_WIDTH,), vec.shape
+    out: dict = {"tracked": int(vec[0])}
+    i = 1
+    for interval in INGEST_INTERVALS:
+        sect: dict = {}
+        for f in INGEST_STAT_FIELDS:
+            v = vec[i]
+            if f == "max_age_s":
+                sect[f] = None if v != v else float(v)
+            else:
+                sect[f] = int(v)
+            i += 1
+        for f in INGEST_COUNT_FIELDS:
+            sect[f] = int(vec[i])
+            i += 1
+        out[interval] = sect
+    out["stale_total"] = out["5m"]["stale_1x"] + out["15m"]["stale_1x"]
+    return out
+
+
+def _ingest_pair_counts(state, upd5, upd15) -> jnp.ndarray:
+    """(8,) f32 — both intervals' batch counts concatenated (the fold
+    accumulator's unit; traced inside whichever step consumes it)."""
+    return jnp.concatenate(
+        [
+            _ingest_batch_counts(state.buf5, upd5[0], upd5[1], FIVE_MIN_S),
+            _ingest_batch_counts(state.buf15, upd15[0], upd15[1], FIFTEEN_MIN_S),
+        ]
+    )
+
+
 class WireFired(NamedTuple):
     """Host-side (numpy) compacted fired entries; first ``n`` rows valid."""
 
@@ -526,7 +685,9 @@ class WireFired(NamedTuple):
     payload: object = None
 
 
-def unpack_wire(wire, numeric_digest: bool = False) -> tuple[WireFired, dict]:
+def unpack_wire(
+    wire, numeric_digest: bool = False, ingest_digest: bool = False
+) -> tuple[WireFired, dict]:
     """Split one fetched wire array into fired entries + context scalars.
 
     The scalar dict mirrors the reference's per-tick context consumption
@@ -535,10 +696,16 @@ def unpack_wire(wire, numeric_digest: bool = False) -> tuple[WireFired, dict]:
     a tunneled device). ``numeric_digest=True`` (the engine knows — the
     flag is static per executable) strips the trailing
     ``NUMERIC_DIGEST_WIDTH`` health block into ``ctx["numeric_digest"]``
-    first, so the calib-block inference below sees the pre-digest layout."""
+    first, so the calib-block inference below sees the pre-digest layout;
+    ``ingest_digest=True`` strips the ``INGEST_DIGEST_WIDTH`` ingest block
+    (packed strictly LAST) into ``ctx["ingest_digest"]`` before that."""
     import numpy as np
 
     w = np.asarray(wire)
+    ingest = None
+    if ingest_digest:
+        ingest = w[-INGEST_DIGEST_WIDTH:]
+        w = w[:-INGEST_DIGEST_WIDTH]
     digest = None
     if numeric_digest:
         digest = w[-NUMERIC_DIGEST_WIDTH:]
@@ -580,6 +747,8 @@ def unpack_wire(wire, numeric_digest: bool = False) -> tuple[WireFired, dict]:
             ctx["calib_atr_pct"] = calib[2]
     if digest is not None:
         ctx["numeric_digest"] = digest
+    if ingest is not None:
+        ctx["ingest_digest"] = ingest
     fired = WireFired(
         n=n,
         overflow=n > K,
@@ -924,6 +1093,7 @@ def pack_wire(
     bc_dirty_rows: jnp.ndarray,
     wire_enabled: tuple[str, ...],
     digest: jnp.ndarray | None = None,
+    ingest: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Pack one tick's evaluation into the single wire array: context
     scalars + device-side fired compaction + per-slot emission payload +
@@ -934,7 +1104,8 @@ def pack_wire(
     effect, exactly as the inline block did. ``digest`` (trace-time
     optional — None compiles the pre-digest wire unchanged) appends the
     (NUMERIC_DIGEST_WIDTH,) numeric-health block strictly at the END so
-    every pre-digest offset survives."""
+    every pre-digest offset survives; ``ingest`` likewise appends the
+    (INGEST_DIGEST_WIDTH,) ingest-health block strictly after it."""
     S = summary.trigger.shape[1]
     scalar_values = {
         "valid": context.valid,
@@ -1066,6 +1237,8 @@ def pack_wire(
     ]
     if digest is not None:
         parts.append(digest.astype(jnp.float32))
+    if ingest is not None:
+        parts.append(ingest.astype(jnp.float32))
     return jnp.concatenate(parts)
 
 
@@ -1081,6 +1254,8 @@ def _tick_step_impl(
     maintain_carry: bool = True,
     params=None,
     numeric_digest: bool = False,
+    ingest_digest: bool = False,
+    ingest_fold_counts=None,
 ) -> tuple[EngineState, TickOutputs]:
     """One tick: apply candle updates, rebuild context, evaluate everything.
 
@@ -1123,10 +1298,28 @@ def _tick_step_impl(
     ``numeric_digest`` (static) appends the device-computed numeric-health
     block to the wire (``_numeric_digest_block``); False compiles a graph
     bit-identical to the pre-digest step.
+
+    ``ingest_digest`` (static) appends the ingest-health block
+    (``_ingest_digest_block``) after the numeric one; False likewise
+    leaves the traced graph untouched. ``ingest_fold_counts`` (dynamic,
+    (8,) f32 or None) carries the append/rewrite/gap/drop counts of the
+    fold sub-batches the caller applied BEFORE this evaluated batch
+    (``_fold_updates``' counted steps / the scan body's fold slots) so
+    the digest reports the whole tick's drain, not just its final slot.
     """
     from binquant_tpu.strategies.params import resolve_params
 
     sp = resolve_params(params)
+    if ingest_digest:
+        # classify the evaluated batch against the PRE-update rings (the
+        # same routing _scatter_updates resolves below)
+        icnt5 = _ingest_batch_counts(state.buf5, upd5[0], upd5[1], FIVE_MIN_S)
+        icnt15 = _ingest_batch_counts(
+            state.buf15, upd15[0], upd15[1], FIFTEEN_MIN_S
+        )
+        if ingest_fold_counts is not None:
+            icnt5 = icnt5 + ingest_fold_counts[:4]
+            icnt15 = icnt15 + ingest_fold_counts[4:]
     ring5 = apply_updates(state.buf5, *upd5)
     ring15 = apply_updates(state.buf15, *upd15)
 
@@ -1482,10 +1675,27 @@ def _tick_step_impl(
         )
     else:
         digest = None
+    if ingest_digest:
+        ingest_block = _ingest_digest_block(
+            inputs.tracked,
+            _ingest_interval_stats(
+                ring_latest_times(ring5), ring5.filled, inputs.tracked,
+                inputs.timestamp5_s, FIVE_MIN_S,
+            ),
+            _ingest_interval_stats(
+                ring_latest_times(ring15), ring15.filled, inputs.tracked,
+                inputs.timestamp_s, FIFTEEN_MIN_S,
+            ),
+            icnt5,
+            icnt15,
+        )
+    else:
+        ingest_block = None
     wire = pack_wire(
         context, strategies, summary, pack5, pack15,
         btc_beta, btc_corr, btc_change_96, bc_dirty_rows, wire_enabled,
         digest=digest,
+        ingest=ingest_block,
     )
 
     outputs = TickOutputs(
@@ -1510,7 +1720,7 @@ tick_step = partial(
     jax.jit,
     static_argnames=(
         "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
 )(_tick_step_impl)
 
@@ -1526,6 +1736,8 @@ def _tick_step_wire_impl(
     maintain_carry: bool = True,
     params=None,
     numeric_digest: bool = False,
+    ingest_digest: bool = False,
+    ingest_fold_counts=None,
 ) -> tuple[EngineState, jnp.ndarray]:
     """The live engine's step: identical evaluation, but only the wire
     leaves the computation. The full ``TickOutputs`` pytree is ~400 output
@@ -1551,6 +1763,8 @@ def _tick_step_wire_impl(
         maintain_carry=maintain_carry,
         params=params,
         numeric_digest=numeric_digest,
+        ingest_digest=ingest_digest,
+        ingest_fold_counts=ingest_fold_counts,
     )
     return new_state, outputs.wire
 
@@ -1559,7 +1773,7 @@ tick_step_wire = partial(
     jax.jit,
     static_argnames=(
         "cfg", "wire_enabled", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
 )(_tick_step_wire_impl)
 
@@ -1575,7 +1789,7 @@ tick_step_donated = jax.jit(
     _tick_step_impl,
     static_argnames=(
         "cfg", "wire_enabled", "compute_all", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
     donate_argnums=(0,),
 )
@@ -1584,7 +1798,7 @@ tick_step_wire_donated = jax.jit(
     _tick_step_wire_impl,
     static_argnames=(
         "cfg", "wire_enabled", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
     donate_argnums=(0,),
 )
@@ -1602,6 +1816,8 @@ def _tick_step_wire_db_impl(
     maintain_carry: bool = True,
     params=None,
     numeric_digest: bool = False,
+    ingest_digest: bool = False,
+    ingest_fold_counts=None,
 ) -> tuple[EngineState, jnp.ndarray]:
     """Double-buffered donated wire step (ISSUE 9): ``scratch`` is a
     same-shape state slot whose buffers are DONATED and reused for the
@@ -1624,6 +1840,8 @@ def _tick_step_wire_db_impl(
         maintain_carry=maintain_carry,
         params=params,
         numeric_digest=numeric_digest,
+        ingest_digest=ingest_digest,
+        ingest_fold_counts=ingest_fold_counts,
     )
 
 
@@ -1633,7 +1851,7 @@ tick_step_wire_db = jax.jit(
     _tick_step_wire_db_impl,
     static_argnames=(
         "cfg", "wire_enabled", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
     donate_argnums=(1,),
     keep_unused=True,
@@ -1651,12 +1869,16 @@ def canonicalize_state(state: EngineState) -> EngineState:
     )
 
 
-def wire_length(num_symbols: int, numeric_digest: bool = False) -> int:
+def wire_length(
+    num_symbols: int,
+    numeric_digest: bool = False,
+    ingest_digest: bool = False,
+) -> int:
     """Length of one tick's packed wire at capacity ``num_symbols`` —
     scalars + fired-compaction blocks + per-slot emission payload + the
     (3, S) calibration block (+ the numeric-health digest when that
-    static flag is on). The scan step needs it statically to shape its
-    inactive-tick zero wire."""
+    static flag is on, + the ingest-health digest after it). The scan
+    step needs it statically to shape its inactive-tick zero wire."""
     na, nb = len(WIRE_SCALARS_A), len(WIRE_SCALARS_B)
     return (
         na + nb + 4 + 1
@@ -1664,6 +1886,7 @@ def wire_length(num_symbols: int, numeric_digest: bool = False) -> int:
         + WIRE_MAX_FIRED * EMISSION_SLOT_WIDTH
         + 3 * num_symbols
         + (NUMERIC_DIGEST_WIDTH if numeric_digest else 0)
+        + (INGEST_DIGEST_WIDTH if ingest_digest else 0)
     )
 
 
@@ -1693,6 +1916,7 @@ def _fold_and_step_wire(
     maintain_carry: bool,
     params=None,
     numeric_digest: bool = False,
+    ingest_digest: bool = False,
 ) -> tuple[EngineState, jnp.ndarray]:
     """One replayed tick inside the scan: fold all but the final update
     sub-batch slot (mirroring ``SignalEngine._fold_updates`` — on the
@@ -1701,12 +1925,21 @@ def _fold_and_step_wire(
     (rows (N, U), ts (N, U), vals (N, U, F)) with a STATIC slot depth N;
     empty slots (all rows -1) are exact no-ops on buffers and carries
     (``carry_advance_masks``: an unchanged latest ts neither advances nor
-    stales a row), which is what makes depth padding sound."""
+    stales a row), which is what makes depth padding sound. With
+    ``ingest_digest`` on, each fold slot's append/rewrite/gap/drop counts
+    accumulate (empty padding slots count zero) so the evaluated wire's
+    ingest block covers the whole tick's drain — exactly what the serial
+    drive accumulates through its counted fold steps."""
     n = upd5_slots[0].shape[0]
     assert n == upd15_slots[0].shape[0]
+    fold_counts = (
+        jnp.zeros((8,), dtype=jnp.float32) if ingest_digest else None
+    )
     for d in range(n - 1):
         u5 = tuple(x[d] for x in upd5_slots)
         u15 = tuple(x[d] for x in upd15_slots)
+        if ingest_digest:
+            fold_counts = fold_counts + _ingest_pair_counts(state, u5, u15)
         buf5 = apply_updates(state.buf5, *u5)
         buf15 = apply_updates(state.buf15, *u15)
         if incremental:
@@ -1736,6 +1969,8 @@ def _fold_and_step_wire(
         maintain_carry=maintain_carry,
         params=params,
         numeric_digest=numeric_digest,
+        ingest_digest=ingest_digest,
+        ingest_fold_counts=fold_counts,
     )
 
 
@@ -1753,6 +1988,7 @@ def _tick_step_scan_impl(
     maintain_carry: bool = True,
     params=None,
     numeric_digest: bool = False,
+    ingest_digest: bool = False,
 ) -> tuple[EngineState, jnp.ndarray, jnp.ndarray]:
     """T replayed ticks fused into ONE dispatch (ISSUE 5 tentpole).
 
@@ -1791,7 +2027,9 @@ def _tick_step_scan_impl(
     from binquant_tpu.enums import MarketRegimeCode
 
     S = state.buf15.capacity
-    L = wire_length(S, numeric_digest=numeric_digest)
+    L = wire_length(
+        S, numeric_digest=numeric_digest, ingest_digest=ingest_digest
+    )
     range_code = jnp.int32(int(MarketRegimeCode.RANGE))
     trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
 
@@ -1809,6 +2047,7 @@ def _tick_step_scan_impl(
             return _fold_and_step_wire(
                 operand, u5_slots, u15_slots, inp, cfg, wire_enabled,
                 incremental, maintain_carry, params, numeric_digest,
+                ingest_digest,
             )
 
         def idle(operand):
@@ -1831,7 +2070,7 @@ tick_step_scan = partial(
     jax.jit,
     static_argnames=(
         "cfg", "wire_enabled", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
 )(_tick_step_scan_impl)
 
@@ -1843,7 +2082,7 @@ tick_step_scan_donated = jax.jit(
     _tick_step_scan_impl,
     static_argnames=(
         "cfg", "wire_enabled", "incremental", "maintain_carry",
-        "numeric_digest",
+        "numeric_digest", "ingest_digest",
     ),
     donate_argnums=(0,),
 )
@@ -1941,6 +2180,94 @@ def apply_updates_carry_step(
     )
 
 
+# -- counted fold steps (ISSUE 15) -------------------------------------------
+# Twins of the three fold steps above that ALSO classify the sub-batch
+# against the pre-fold ring and accumulate (8,) f32 ingest counts
+# (appends/rewrites/gaps/drops per interval) inside the SAME dispatch. The
+# pipeline selects them when the ingest digest is on, threading the
+# accumulated counts into the evaluated tick's wire step so the digest
+# reports the whole drain — identical to the scan body's in-trace folds.
+
+
+@jax.jit
+def apply_updates_step_counted(
+    state: EngineState,
+    upd5,
+    upd15,
+    counts: jnp.ndarray,
+) -> tuple[EngineState, jnp.ndarray]:
+    counts = counts + _ingest_pair_counts(state, upd5, upd15)
+    return (
+        state._replace(
+            buf5=apply_updates(state.buf5, *upd5),
+            buf15=apply_updates(state.buf15, *upd15),
+        ),
+        counts,
+    )
+
+
+@jax.jit
+def _apply_updates_carry_counted_impl(
+    state: EngineState,
+    upd5,
+    upd15,
+    btc_row: jnp.ndarray,
+    counts: jnp.ndarray,
+) -> tuple[EngineState, jnp.ndarray]:
+    counts = counts + _ingest_pair_counts(state, upd5, upd15)
+    return _apply_updates_carry_impl(state, upd5, upd15, btc_row), counts
+
+
+def apply_updates_carry_step_counted(
+    state: EngineState,
+    upd5,
+    upd15,
+    btc_row=None,
+    counts=None,
+) -> tuple[EngineState, jnp.ndarray]:
+    if counts is None:
+        counts = jnp.zeros((8,), dtype=jnp.float32)
+    return _apply_updates_carry_counted_impl(
+        state,
+        upd5,
+        upd15,
+        jnp.asarray(-1 if btc_row is None else btc_row, jnp.int32),
+        counts,
+    )
+
+
+@jax.jit
+def apply_updates_scan_counted(
+    state: EngineState,
+    upd5_seq,
+    upd15_seq,
+    counts: jnp.ndarray,
+) -> tuple[EngineState, jnp.ndarray]:
+    """Counted twin of :func:`apply_updates_scan` — deep update-only folds
+    (backfill chunks, restore gap catch-up) keep their one-dispatch-per-
+    chunk cost while the ingest counts still cover every folded bar."""
+
+    def body(carry, xs):
+        st, c = carry
+        u5, u15 = xs
+        c = c + _ingest_pair_counts(st, u5, u15)
+        return (
+            (
+                st._replace(
+                    buf5=apply_updates(st.buf5, *u5),
+                    buf15=apply_updates(st.buf15, *u15),
+                ),
+                c,
+            ),
+            None,
+        )
+
+    (new_state, counts), _ = jax.lax.scan(
+        body, (state, counts), (upd5_seq, upd15_seq)
+    )
+    return new_state, counts
+
+
 def pad_updates(
     rows, ts, vals, size: int | None = None
 ):
@@ -1983,7 +2310,8 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
                      fn: str = "tick_step_wire",
                      incremental: bool = False,
                      maintain_carry: bool = True,
-                     numeric_digest: bool = False) -> bool:
+                     numeric_digest: bool = False,
+                     ingest_digest: bool = False) -> bool:
     """Record per-dispatch telemetry; True when this signature is new
     (i.e. the launch below it will trace+compile)."""
     import numpy as np
@@ -2009,6 +2337,7 @@ def observe_dispatch(state, upd5, upd15, wire_enabled, cfg=None,
         tuple(wire_enabled),
         cfg,
         bool(numeric_digest),
+        bool(ingest_digest),
     )
     if signature in _DISPATCH_SIGNATURES:
         return False

@@ -36,8 +36,11 @@ from binquant_tpu.engine.step import (
     WIRE_FIRED_COUNT_OFF,
     WIRE_MAX_FIRED,
     apply_updates_carry_step,
+    apply_updates_carry_step_counted,
     apply_updates_scan,
+    apply_updates_scan_counted,
     apply_updates_step,
+    apply_updates_step_counted,
     default_host_inputs,
     initial_engine_state,
     measure_carry_drift,
@@ -73,6 +76,7 @@ from binquant_tpu.obs.instruments import (
     SIGNALS,
     TICKS,
 )
+from binquant_tpu.obs.ingest import IngestHealthMonitor
 from binquant_tpu.obs.latency import FreshnessTracker, PhaseAccountant
 from binquant_tpu.obs.ledger import LEDGER, abstract_args, lowered_cost
 from binquant_tpu.obs.numeric import DriftMeter, NumericHealthMonitor
@@ -731,6 +735,26 @@ class SignalEngine:
             nan_budget=int(getattr(config, "numeric_nan_budget", 0) or 0),
             event_every=self.carry_audit_every or 256,
         )
+        # -- ingest-health observatory (ISSUE 15)
+        # Device-side ingest digest riding the wire after the numeric block
+        # (BQT_INGEST_DIGEST; a STATIC flag — off compiles the pre-ingest
+        # wire bit-identically) + the host-side per-symbol watermark/
+        # counter monitor feeding bqt_ingest_* and GET /debug/symbols.
+        # Staleness past BQT_INGEST_STALE_BUDGET force-emits
+        # ingest_anomaly events and degrades the /healthz status.
+        self.ingest_digest = bool(getattr(config, "ingest_digest", True))
+        self.ingest_monitor = IngestHealthMonitor(
+            self.registry,
+            enabled=self.ingest_digest,
+            stale_budget=int(getattr(config, "ingest_stale_budget", 0) or 0),
+            event_every=self.carry_audit_every or 256,
+        )
+        # device-side (8,) accumulator of the current tick's fold-slot
+        # ingest counts (counted fold steps) — consumed (and reset) by the
+        # next evaluated dispatch; a cached zeros array keeps the dispatch
+        # signature stable on fold-free ticks
+        self._ingest_fold_counts = None
+        self._ingest_zero_counts = None
         # Carry-drift audit meters (BQT_DRIFT_METER): every audit tick
         # measures per-family carried-vs-fresh drift BEFORE the resync
         # overwrites the carry — the audit becomes a measured correctness
@@ -764,6 +788,15 @@ class SignalEngine:
                 duration_s,
                 kline.get("symbol"),
             )
+            return
+        if self.ingest_monitor.enabled:
+            # arrival watermark + per-exchange feed lag (the ws parsers
+            # stamp "exchange"; replay/fixture streams default binance)
+            self.ingest_monitor.note_arrival(
+                str(kline.get("symbol", "")).strip().upper(),
+                int(kline["close_time"]),
+                exchange=str(kline.get("exchange", "binance")),
+            )
 
     # -- startup history backfill ---------------------------------------------
 
@@ -792,6 +825,7 @@ class SignalEngine:
         the caller verified every sub-batch is a strictly-newer append.
         ``btc_row`` keeps the beta/corr positional pairing advancing
         through the folds (engine/step.py advance_indicator_carry)."""
+        count = self.ingest_digest
         if advance_carry:
             fold = lambda st, a, b: apply_updates_carry_step(
                 st, a, b, btc_row=btc_row
@@ -809,15 +843,66 @@ class SignalEngine:
             self._scan_fold_prefix(batches5, batches15, n)
         else:
             for i in range(n - 1):
-                self.state = fold(
-                    self.state,
-                    upd5[i] if i < len(upd5) else empty,
-                    upd15[i] if i < len(upd15) else empty,
-                )
+                a = upd5[i] if i < len(upd5) else empty
+                b = upd15[i] if i < len(upd15) else empty
+                if count:
+                    # counted twins: classify each fold slot against the
+                    # pre-fold ring inside the SAME dispatch, so the next
+                    # evaluated tick's ingest digest covers the whole
+                    # drain (engine/step.py counted fold steps)
+                    if advance_carry:
+                        self.state, self._ingest_fold_counts = (
+                            apply_updates_carry_step_counted(
+                                self.state, a, b, btc_row=btc_row,
+                                counts=self._ingest_fold_acc(),
+                            )
+                        )
+                    else:
+                        self.state, self._ingest_fold_counts = (
+                            apply_updates_step_counted(
+                                self.state, a, b, self._ingest_fold_acc()
+                            )
+                        )
+                else:
+                    self.state = fold(self.state, a, b)
         return (
             upd5[n - 1] if n - 1 < len(upd5) else empty,
             upd15[n - 1] if n - 1 < len(upd15) else empty,
         )
+
+    def _ingest_fold_acc(self):
+        """The running (8,) fold-count accumulator (device array; a cached
+        zeros template when no fold has counted yet this tick)."""
+        if self._ingest_fold_counts is not None:
+            return self._ingest_fold_counts
+        if self._ingest_zero_counts is None:
+            import jax.numpy as jnp
+
+            self._ingest_zero_counts = jnp.zeros((8,), dtype=jnp.float32)
+        return self._ingest_zero_counts
+
+    def _take_ingest_fold_counts(self):
+        """Consume the accumulated fold counts for the tick being
+        dispatched (None while the digest is off — the traced step ignores
+        the argument entirely, keeping the pre-ingest graph)."""
+        if not self.ingest_digest:
+            return None
+        counts = self._ingest_fold_counts
+        self._ingest_fold_counts = None
+        return counts if counts is not None else self._ingest_fold_acc()
+
+    def _begin_plan_ingest_state(self):
+        """Plan-start hook for the chunked drives: snapshot the monitor
+        (the rewind anchor) and DISCARD any pending fold-count
+        accumulator. Counts from update-only drains (backfill, restore
+        catch-up) ride the next SERIAL evaluated tick's digest; a chunk
+        that batches the immediately-following tick computes its own
+        counts from its own update views, so a stale accumulator would
+        otherwise leak into whichever unrelated serial tick dispatches
+        after the chunk. The host monitor counted those bars either way —
+        the digest is per-tick telemetry, not the ledger."""
+        self._ingest_fold_counts = None
+        return self.ingest_monitor.snapshot_state()
 
     # update-only folds shorter than this keep the per-sub-batch dispatch
     # loop (a fresh scan compile isn't worth a handful of launches)
@@ -857,9 +942,17 @@ class SignalEngine:
                     r15[i - start], t15[i - start], v15[i - start] = (
                         rows, ts, vals,
                     )
-            self.state = apply_updates_scan(
-                self.state, (r5, t5, v5), (r15, t15, v15)
-            )
+            if self.ingest_digest:
+                self.state, self._ingest_fold_counts = (
+                    apply_updates_scan_counted(
+                        self.state, (r5, t5, v5), (r15, t15, v15),
+                        self._ingest_fold_acc(),
+                    )
+                )
+            else:
+                self.state = apply_updates_scan(
+                    self.state, (r5, t5, v5), (r15, t15, v15)
+                )
 
     def _note_applied(
         self, batches5: list, batches15: list, commit: bool = True
@@ -874,6 +967,7 @@ class SignalEngine:
         whether a tick joins a chunk (committed then) or re-enters the
         serial path (which judges and commits itself)."""
         clean = True
+        feed_monitor = commit and self.ingest_monitor.enabled
         for key, batches in (("5m", batches5), ("15m", batches15)):
             latest = self._host_latest[key]
             if not commit:
@@ -887,6 +981,13 @@ class SignalEngine:
                 rows, ts64 = rows[ok], ts64[ok]
                 if np.any(ts64 <= latest[rows]):
                     clean = False
+                if feed_monitor:
+                    # per-symbol watermarks/counters, classified against
+                    # the pre-apply mirror (the same routing the device
+                    # resolves); peeks (commit=False) never feed
+                    self.ingest_monitor.note_applied_batch(
+                        key, rows, ts64, latest[rows]
+                    )
                 np.maximum.at(latest, rows, ts64)
         return clean
 
@@ -901,12 +1002,23 @@ class SignalEngine:
             self._note_applied(batches5, batches15)
             self._mark_carry_desynced("backfill")
         u5, u15 = self._fold_updates(batches5, batches15)
-        self.state = apply_updates_step(self.state, u5, u15)
+        if self.ingest_digest:
+            # the final slot is update-only here too (no evaluation):
+            # count it into the accumulator the next evaluated tick drains
+            self.state, self._ingest_fold_counts = apply_updates_step_counted(
+                self.state, u5, u15, self._ingest_fold_acc()
+            )
+        else:
+            self.state = apply_updates_step(self.state, u5, u15)
 
     def _mark_carry_desynced(self, reason: str) -> None:
         """Record that the carried indicator state no longer matches the
         windows; the next tick dispatches the full recompute (which
         resyncs). First reason wins until a full tick clears it."""
+        if reason == "churn":
+            # every drive marks churn at its drain (serial, scanned and
+            # backtest planners alike) — one hook covers all three
+            self.ingest_monitor.note_churn()
         if self._carry_desync_reason is None:
             self._carry_desync_reason = reason
 
@@ -1283,6 +1395,10 @@ class SignalEngine:
             "host_latest": {
                 key: arr.copy() for key, arr in self._host_latest.items()
             },
+            # ingest-monitor rewind anchor: an overflow re-drive replays
+            # the plan's ticks through _note_applied a second time — the
+            # per-symbol counters must stay exactly-once (obs/ingest.py)
+            "ingest_monitor": self._begin_plan_ingest_state(),
             # accumulated per-tick planning dwell (host-phase "plan")
             "plan_ms": 0.0,
         }
@@ -1370,6 +1486,7 @@ class SignalEngine:
         self._host_latest = {
             key: arr.copy() for key, arr in plan["host_latest"].items()
         }
+        self.ingest_monitor.restore_state(plan.get("ingest_monitor"))
         fired: list = []
         for p in plan["ticks"]:
             self._requeue_batches(p.batches5, p.batches15)
@@ -1469,6 +1586,7 @@ class SignalEngine:
                         cfg=self.context_config, fn="tick_step_scan",
                         incremental=True, maintain_carry=True,
                         numeric_digest=self.numeric_digest,
+                        ingest_digest=self.ingest_digest,
                     )
                     scan_sig = (
                         f"{self._ledger_sig((r5,), (r15,), True)}"
@@ -1483,12 +1601,14 @@ class SignalEngine:
                             )
                         )
                         cfg_, dig_ = self.context_config, self.numeric_digest
+                        ing_ = self.ingest_digest
 
                         def cost_fn(args=a_args):
                             return lowered_cost(
                                 tick_step_scan, *args, cfg_,
                                 wire_enabled=key, incremental=True,
                                 maintain_carry=True, numeric_digest=dig_,
+                                ingest_digest=ing_,
                             )
 
                     # NOT donated: self.state stays alive as the pre-chunk
@@ -1510,6 +1630,7 @@ class SignalEngine:
                             incremental=True,
                             maintain_carry=True,
                             numeric_digest=self.numeric_digest,
+                            ingest_digest=self.ingest_digest,
                         )
                 with trace.span("device_wait"), self.host_phase.phase(
                     "scanned", "device_wait"
@@ -2064,6 +2185,10 @@ class SignalEngine:
             # shape-signature cache — a True return means the launch below
             # pays a jax trace+compile, which the executable ledger then
             # times and costs)
+            # ingest digest: the tick's accumulated fold counts ride the
+            # dispatch as ONE stable (8,) dynamic arg (zeros template on
+            # fold-free ticks; None compiles the pre-ingest graph)
+            ing_counts = self._take_ingest_fold_counts()
             is_new_sig = observe_dispatch(
                 prev_state, u5, u15, self._wire_enabled_key(),
                 cfg=self.context_config,
@@ -2071,6 +2196,7 @@ class SignalEngine:
                 incremental=use_incremental,
                 maintain_carry=self.incremental,
                 numeric_digest=self.numeric_digest,
+                ingest_digest=self.ingest_digest,
             )
             # StepTraceAnnotation groups this tick's XLA work in profiler
             # captures; skipped entirely on untraced ticks outside a
@@ -2089,14 +2215,21 @@ class SignalEngine:
                 a_pos, _ = abstract_args(launch_args)
                 cfg_, key_ = self.context_config, self._wire_enabled_key()
                 incr_, maint_ = use_incremental, self.incremental
-                dig_ = self.numeric_digest
+                dig_, ing_ = self.numeric_digest, self.ingest_digest
+                a_ing = (
+                    abstract_args((ing_counts,))[0][0]
+                    if ing_counts is not None
+                    else None
+                )
 
-                def cost_fn(fn=step_fn, a_pos=a_pos):
+                def cost_fn(fn=step_fn, a_pos=a_pos, a_ing=a_ing):
                     return lowered_cost(
                         fn, *a_pos, cfg_,
                         wire_enabled=key_, incremental=incr_,
                         maintain_carry=maint_, params=sp_arg,
                         numeric_digest=dig_,
+                        ingest_digest=ing_,
+                        ingest_fold_counts=a_ing,
                     )
 
             try:
@@ -2116,6 +2249,8 @@ class SignalEngine:
                         maintain_carry=self.incremental,
                         params=sp_arg,
                         numeric_digest=self.numeric_digest,
+                        ingest_digest=self.ingest_digest,
+                        ingest_fold_counts=ing_counts,
                     )
             except BaseException:
                 if mode == "single":
@@ -2151,7 +2286,10 @@ class SignalEngine:
         # and an overflow tick's emitted set must match the stream the
         # incremental path certified (numeric_digest rides along so the
         # fallback wire keeps the engine's layout)
-        incr_args = (use_incremental, self.incremental, self.numeric_digest)
+        incr_args = (
+            use_incremental, self.incremental, self.numeric_digest,
+            self.ingest_digest,
+        )
 
         if mode == "single":
             # Donated dispatch: the pre-tick buffers no longer exist, so
@@ -2167,7 +2305,7 @@ class SignalEngine:
             def fallback(
                 _args=(small, inputs, cfg, key, incr_args, empty, sp_arg)
             ):
-                small_, inp, cfg_, key_, (incr_, maint_, dig_), emp, sp_ = _args
+                small_, inp, cfg_, key_, (incr_, maint_, dig_, ing_), emp, sp_ = _args
                 st = self.state._replace(
                     regime_carry=small_[0],
                     mrf_last_emitted=small_[1],
@@ -2177,7 +2315,7 @@ class SignalEngine:
                 _, full = tick_step(
                     st, emp, emp, inp, cfg_, wire_enabled=key_,
                     incremental=incr_, maintain_carry=maint_, params=sp_,
-                    numeric_digest=dig_,
+                    numeric_digest=dig_, ingest_digest=ing_,
                 )
                 return full
 
@@ -2198,7 +2336,7 @@ class SignalEngine:
                        empty, sp_arg)
             ):
                 post, small_, inp, cfg_, key_, incrs, emp, sp_ = _args
-                incr_, maint_, dig_ = incrs
+                incr_, maint_, dig_, ing_ = incrs
                 st = post._replace(
                     regime_carry=small_[0],
                     mrf_last_emitted=small_[1],
@@ -2208,7 +2346,7 @@ class SignalEngine:
                 _, full = tick_step(
                     st, emp, emp, inp, cfg_, wire_enabled=key_,
                     incremental=incr_, maintain_carry=maint_, params=sp_,
-                    numeric_digest=dig_,
+                    numeric_digest=dig_, ingest_digest=ing_,
                 )
                 return full
 
@@ -2224,11 +2362,11 @@ class SignalEngine:
                        sp_arg)
             ):
                 st, upd5, upd15, inp, cfg_, key_, incrs, sp_ = _args
-                incr_, maint_, dig_ = incrs
+                incr_, maint_, dig_, ing_ = incrs
                 _, full = tick_step(
                     st, upd5, upd15, inp, cfg_, wire_enabled=key_,
                     incremental=incr_, maintain_carry=maint_, params=sp_,
-                    numeric_digest=dig_,
+                    numeric_digest=dig_, ingest_digest=ing_,
                 )
                 return full
 
@@ -2264,7 +2402,7 @@ class SignalEngine:
                            "fallback"):
                 try:
                     st, upd5, upd15, inp, cfg_, key_, incrs = args
-                    incr_, maint_, dig_ = incrs
+                    incr_, maint_, dig_, ing_ = incrs
                     # the ledger watch runs on THIS thread — compile events
                     # attribute to the fallback entry, not the tick's
                     with LEDGER.watch("tick_step", sig_, expect_compile=True):
@@ -2272,6 +2410,7 @@ class SignalEngine:
                             st, upd5, upd15, inp, cfg_, wire_enabled=key_,
                             incremental=incr_, maintain_carry=maint_,
                             params=sp_, numeric_digest=dig_,
+                            ingest_digest=ing_,
                         )
                 except Exception:
                     logging.exception("fallback pre-warm failed (non-fatal)")
@@ -2368,7 +2507,8 @@ class SignalEngine:
         t_fetch0 = time.perf_counter()
         with self.latency.stage("wire_fetch"), trace.span("wire_fetch") as sp_wire:
             unpacked = unpack_wire(
-                pending.wire, numeric_digest=self.numeric_digest
+                pending.wire, numeric_digest=self.numeric_digest,
+                ingest_digest=self.ingest_digest,
             )
         t_fetch_end = time.perf_counter()
         if drive == "serial":
@@ -2420,6 +2560,22 @@ class SignalEngine:
                 )
                 sp_num.set(
                     nan_rows=digest["nan_total"], inf_rows=digest["inf_total"]
+                )
+        # ingest-health digest (trailing block on every backend's wire):
+        # staleness/coverage gauges + the SLO burn/recovery state machine
+        # (obs/ingest.py force-emits ingest_anomaly / ingest_recovered)
+        if "ingest_digest" in ctx_scalars:
+            with trace.span("ingest_digest") as sp_ing:
+                idig = self.ingest_monitor.observe_digest(
+                    ctx_scalars["ingest_digest"],
+                    tick_ms=pending.ts_ms,
+                    trace_id=trace.trace_id,
+                    snapshot_fn=self._flight_snapshot,
+                )
+                sp_ing.set(
+                    stale_rows=idig["stale_total"],
+                    fresh5=idig["5m"]["fresh"],
+                    fresh15=idig["15m"]["fresh"],
                 )
         # The full TickOutputs exists only if a degenerate path needs it:
         # compaction overflow (>WIRE_MAX_FIRED fired pairs) or a wire
@@ -2900,6 +3056,7 @@ class SignalEngine:
             f" u15[{int(np.asarray(u15[0]).shape[-1])}]"
             f" incr={int(bool(incremental))}"
             f" digest={int(self.numeric_digest)}"
+            + (" ingest=1" if self.ingest_digest else "")
         )
 
     def _wire_enabled_key(self) -> tuple[str, ...]:
@@ -3124,6 +3281,9 @@ class SignalEngine:
             "carry_desync_reason": self._carry_desync_reason,
             "numeric_anomaly_ticks": self.numeric.anomaly_ticks,
             "drift_alarms": self.drift.alarms,
+            # ingest-health observatory: staleness-burn state at the breach
+            "ingest_anomaly_ticks": self.ingest_monitor.anomaly_ticks,
+            "ingest_burning": self.ingest_monitor.burning,
             # latency observatory: the newest chunk's occupancy split and
             # the freshness-SLO tally (attribute reads only)
             "freshness_slo_breaches": self.freshness.breaches,
@@ -3191,6 +3351,12 @@ class SignalEngine:
         ws = (self.ws_health or WS_HEALTH).snapshot()
         if status == "ok" and ws["storming"]:
             status = "degraded"
+        # ingest staleness burning past BQT_INGEST_STALE_BUDGET is
+        # alive-but-impaired, same contract as a ws storm: the payload
+        # (and the ingest section below) says why, the probe stays 200
+        ingest = self.ingest_monitor.snapshot()
+        if status == "ok" and ingest["status"] == "degraded":
+            status = "degraded"
         return {
             "status": status,
             "ws": ws,
@@ -3236,6 +3402,11 @@ class SignalEngine:
                 "drift_audits_unmeasured": self.drift.skipped,
                 "last_drift": self.drift.last,
             },
+            # ingest-health observatory (ISSUE 15): the last decoded
+            # ingest digest, SLO burn state, per-exchange feed lag and the
+            # host monitor's churn/arrival tallies; per-symbol detail is
+            # the paginated GET /debug/symbols route
+            "ingest": ingest,
             # event-log drops (write failures / emit-after-close) — zero
             # in a healthy deployment
             "eventlog_dropped": get_event_log().dropped,

@@ -37,8 +37,28 @@ class StubSession:
     def __init__(self, breadth: dict | None = None) -> None:
         self.requests: list[tuple[str, str, Any]] = []
         # scripted market-breadth payload (None = the empty default, which
-        # leaves breadth-gated strategies dormant)
+        # leaves breadth-gated strategies dormant). A dict carrying a
+        # "schedule" key scripts a PER-REQUEST breadth stream instead: the
+        # list is consumed one entry per market-breadth call (the engine
+        # refreshes once per 15m bucket, so entry k feeds bucket k), the
+        # last entry repeats, and the sentinel "error" returns HTTP 500 —
+        # a stalled upstream whose engine keeps its previous series. This
+        # is how the breadth-fault scenario family (ISSUE 15 / ROADMAP
+        # 5a) drives stalls and NaN holes mid-run.
         self.breadth = breadth
+        self._breadth_calls = 0
+
+    def _breadth_payload(self):
+        breadth = self.breadth
+        if isinstance(breadth, dict) and "schedule" in breadth:
+            schedule = breadth["schedule"]
+            idx = min(self._breadth_calls, len(schedule) - 1)
+            self._breadth_calls += 1
+            entry = schedule[idx] if schedule else None
+            if entry == "error":
+                return self._Resp({"error": "breadth upstream down"}, 500)
+            return self._Resp({"data": entry or {}})
+        return self._Resp({"data": breadth or {}})
 
     def request(self, method: str, url: str, **kwargs):
         self.requests.append((method, url, kwargs.get("json")))
@@ -55,7 +75,7 @@ class StubSession:
                 {"message": "ok", "error": 0, "data": {"pair": "X"}}
             )
         if "market-breadth" in url:
-            return self._Resp({"data": self.breadth or {}})
+            return self._breadth_payload()
         return self._Resp({"data": {}})
 
     def get(self, url, params=None):
@@ -88,6 +108,8 @@ def make_stub_engine(
     delivery_overrides: dict | None = None,
     fanout: bool | None = None,
     fanout_overrides: dict | None = None,
+    ingest_digest: bool | None = None,
+    ingest_stale_budget: int | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -134,6 +156,13 @@ def make_stub_engine(
         config.__dict__["backtest_chunk"] = int(backtest_chunk)
     if trace_sample is not None:
         config.__dict__["trace_sample"] = float(trace_sample)
+    # ingest-health observatory (ISSUE 15): BQT_INGEST_DIGEST /
+    # BQT_INGEST_STALE_BUDGET overrides so the ingest lane pins the
+    # observatory on while the tier-1 conftest keeps it off
+    if ingest_digest is not None:
+        config.__dict__["ingest_digest"] = bool(ingest_digest)
+    if ingest_stale_budget is not None:
+        config.__dict__["ingest_stale_budget"] = int(ingest_stale_budget)
     # latency observatory (ISSUE 11): BQT_FRESHNESS / BQT_HOST_PHASE /
     # BQT_FRESHNESS_SLO_MS overrides, so the latency lane can pin the
     # observatory on while the tier-1 conftest keeps it off

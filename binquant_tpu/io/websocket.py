@@ -207,6 +207,9 @@ def parse_binance_kline_frame(raw: str | bytes) -> dict | None:
             "number_of_trades": float(k.get("n", 0.0)),
             "taker_buy_base_volume": float(k.get("V", 0.0)),
             "taker_buy_quote_volume": float(k.get("Q", 0.0)),
+            # source tag for the ingest monitor's per-exchange feed-lag
+            # watermarks (additive — the batcher ignores unknown keys)
+            "exchange": "binance",
         }
     except (TypeError, ValueError, KeyError) as e:
         # valid JSON, malformed fields: a SHAPE parse failure. Must not
@@ -419,6 +422,8 @@ def parse_kucoin_candle_message(
             "number_of_trades": 0.0,
             "taker_buy_base_volume": 0.0,
             "taker_buy_quote_volume": 0.0,
+            # source tag for the ingest monitor's per-exchange feed lag
+            "exchange": "kucoin",
         },
     )
 

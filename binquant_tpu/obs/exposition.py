@@ -25,7 +25,11 @@ client) that serves:
   (:data:`binquant_tpu.obs.ledger.LEDGER` by default): every jit entry
   the engine owns with compile wall-time, warm-vs-cold persistent-cache
   outcome, and per-dispatch ``cost_analysis`` bytes/flops. Read-only —
-  served to any peer like ``/metrics``.
+  served to any peer like ``/metrics``;
+* ``GET /debug/symbols?offset=&limit=&prefix=&min_score=`` — the ingest
+  monitor's paginated worst-first per-symbol stream-health scoreboard
+  (health score, staleness ages, gap/rewrite/out-of-order/churn counts,
+  watermarks). Read-only — served to any peer like ``/metrics``.
 
 Started from ``main.py`` when ``BQT_METRICS_PORT`` is set; ``port=0``
 binds an ephemeral port (tests), reported by :meth:`MetricsServer.start`.
@@ -113,6 +117,7 @@ class MetricsServer:
         profiler=None,
         profile_remote_ok: bool = False,
         ledger=None,
+        ingest=None,
     ) -> None:
         self.registry = registry if registry is not None else REGISTRY
         self.health_fn = health_fn
@@ -123,6 +128,9 @@ class MetricsServer:
         if ledger is None:
             from binquant_tpu.obs.ledger import LEDGER as ledger
         self.ledger = ledger
+        # the engine's IngestHealthMonitor (GET /debug/symbols); None
+        # keeps the route answering with a JSON not-configured no-op
+        self.ingest = ingest
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -156,6 +164,8 @@ class MetricsServer:
         path, _, query = target.partition("?")
         if path == "/debug/profile":
             return self._route_profile(query, peer)
+        if path == "/debug/symbols":
+            return self._route_symbols(query)
         if path == "/debug/executables":
             # read-only like /metrics; snapshot() is attribute reads under
             # a lock, safe inline on the event loop
@@ -191,6 +201,49 @@ class MetricsServer:
                 json.dumps(payload),
             )
         return self._respond(404, "Not Found", "text/plain", "not found\n")
+
+    def _route_symbols(self, query: str) -> bytes:
+        """``/debug/symbols?offset=&limit=&prefix=&min_score=`` — the
+        ingest monitor's worst-first per-symbol stream-health scoreboard
+        (ISSUE 15). Read-only, served to any peer like ``/metrics``;
+        strict 400 on malformed numeric args so a typo'd probe reads as a
+        typo, not as page one."""
+        from urllib.parse import parse_qs
+
+        if self.ingest is None or not getattr(self.ingest, "enabled", False):
+            return self._respond(
+                200, "OK", "application/json",
+                json.dumps({"enabled": False, "symbols": []}),
+            )
+        qs = parse_qs(query)
+        try:
+            offset = int(qs.get("offset", ["0"])[0])
+            limit = int(qs.get("limit", ["50"])[0])
+            raw_min = qs.get("min_score", [None])[0]
+            min_score = None if raw_min is None else float(raw_min)
+        except ValueError:
+            return self._respond(
+                400, "Bad Request", "application/json",
+                json.dumps({"error": "offset/limit must be integers, "
+                            "min_score a float"}),
+            )
+        prefix = qs.get("prefix", [None])[0]
+        try:
+            payload = self.ingest.symbols_report(
+                offset=offset, limit=limit, prefix=prefix,
+                min_score=min_score,
+            )
+            payload["enabled"] = True
+        except Exception:
+            log.exception("ingest symbols_report crashed")
+            # a broken scoreboard must not read as success to probes
+            return self._respond(
+                500, "Internal Server Error", "application/json",
+                json.dumps({"error": "symbols_report_failed"}),
+            )
+        return self._respond(
+            200, "OK", "application/json", json.dumps(payload)
+        )
 
     @staticmethod
     def _is_loopback(peer: tuple | None) -> bool:

@@ -480,3 +480,70 @@ FANOUT_RESUME_REPLAYED = REGISTRY.counter(
     "Frames replayed from the broadcast outbox to reconnecting clients "
     "presenting a cursor.",
 )
+
+# -- ingest-health observatory (ISSUE 15) -------------------------------------
+
+INGEST_TRACKED = REGISTRY.gauge(
+    "bqt_ingest_tracked_rows",
+    "Tracked registry rows on the last ingest-digest tick (the universe "
+    "the staleness/coverage counts below are judged over).",
+)
+INGEST_STALE = REGISTRY.gauge(
+    "bqt_ingest_stale_rows",
+    "Tracked rows with data whose newest bar's age exceeds the bucket "
+    "threshold (1x / 3x / 10x the bar interval; cumulative thresholds — "
+    "a row counted under 10x also counts under 1x), per interval, on the "
+    "last digest tick. Sustained non-zero means per-symbol feed death.",
+    labels=("interval", "bucket"),
+)
+INGEST_COVERAGE = REGISTRY.gauge(
+    "bqt_ingest_coverage_rows",
+    "Coverage funnel per interval on the last digest tick: covered "
+    "(tracked rows holding any data) -> min_bars (filled >= MIN_BARS, "
+    "strategy-sufficient) -> fresh (sufficient AND holding the evaluated "
+    "bucket's bar).",
+    labels=("interval", "stage"),
+)
+INGEST_MAX_AGE = REGISTRY.gauge(
+    "bqt_ingest_max_age_seconds",
+    "Age of the stalest tracked row's newest bar per interval on the "
+    "last digest tick (0 when every covered row is fresh).",
+    labels=("interval",),
+)
+INGEST_APPLIED = REGISTRY.counter(
+    "bqt_ingest_applied_total",
+    "Update-batch routing decoded from the per-tick ingest digest, per "
+    "interval and kind (append / rewrite / gap_append / dropped) — "
+    "device-classified with apply_updates' exact rules, summed over "
+    "every sub-batch each finalized tick applied.",
+    labels=("interval", "kind"),
+)
+INGEST_FEED_LAG = REGISTRY.histogram(
+    "bqt_ingest_feed_lag_ms",
+    "Exchange feed lag per candle at ingest: host wall-clock arrival "
+    "minus the candle's close_time, per exchange. Replay lanes carry "
+    "historical close times, so their readings saturate the top bucket "
+    "by design.",
+    labels=("exchange",),
+    buckets=(50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 15000.0,
+             60000.0, 300000.0),
+)
+INGEST_ANOMALIES = REGISTRY.counter(
+    "bqt_ingest_anomaly_ticks_total",
+    "Digest ticks whose 1x-stale row total exceeded "
+    "BQT_INGEST_STALE_BUDGET (each force-emits an ingest_anomaly event "
+    "with the decoded digest, the worst symbols, and an engine snapshot).",
+)
+INGEST_CHURN = REGISTRY.counter(
+    "bqt_ingest_churn_total",
+    "Symbol churn observed by the ingest monitor: a known symbol's "
+    "registry row moved (listing churn re-homing) or the engine marked "
+    "a churn carry-desync.",
+)
+INGEST_OOO = REGISTRY.counter(
+    "bqt_ingest_out_of_order_total",
+    "Host-classified non-append deliveries per interval (a candle at or "
+    "behind the row's latest applied bar: same-bar rewrites and "
+    "mid-history corrections/drops).",
+    labels=("interval",),
+)

@@ -55,6 +55,13 @@ def drive_scenario(
         scan_chunk=spec.scan_chunk,
         enabled_strategies=set(spec.enabled_strategies),
         trace_sample=1.0,  # every tick traced: the crash-ring invariant
+        # ingest-health observatory (ISSUE 15): scenarios that script
+        # feed faults pin the digest + monitor ON (zero budget — any
+        # stale row burns) so the staleness SLO state machine and the
+        # cross-drive digest equality become scripted invariants;
+        # everything else keeps the lane's env default
+        ingest_digest=True if spec.ingest else None,
+        ingest_stale_budget=0 if spec.ingest else None,
         # signal-outcome observatory (ISSUE 12): pinned ON with short
         # horizons so the scripted streams' aftermaths show up as
         # per-family MAE/MFE columns in the verdict — and the matured set
@@ -76,6 +83,10 @@ def drive_scenario(
     # isolated ws tracker: the module singleton may carry another drill's
     # reconnect storm, which would flip this run's health to degraded
     engine.ws_health = WsHealth()
+    if spec.ingest:
+        # capture every tick's raw digest vector: the runner pins
+        # bit-identical digest streams across the three drives
+        engine.ingest_monitor.record_history = True
     seq = tick_seq(path)
     out: list = []
 
@@ -179,6 +190,47 @@ def run_scenario(
         == eng_f.outcomes.matured_set()
     )
     outcomes = _outcome_columns(eng_s)
+    ingest = None
+    if spec.ingest:
+        import numpy as np
+
+        # bit-identical per-tick ingest digests across the three drives
+        ds, dc, df = (
+            np.stack(e.ingest_monitor.digests)
+            for e in (eng_s, eng_c, eng_f)
+        )
+        checks["ingest_digest_parity"] = bool(
+            ds.shape == dc.shape == df.shape
+            and np.array_equal(ds, dc, equal_nan=True)
+            and np.array_equal(ds, df, equal_nan=True)
+        )
+        if spec.expect_ingest_anomaly:
+            # the staleness alarm must TRIP during the scripted fault and
+            # CLEAR after catch-up — in every drive, with /healthz
+            # degraded while burning resolved back to ok at EOF
+            checks["ingest_alarm_trips_and_clears"] = all(
+                e.ingest_monitor.anomaly_ticks > 0
+                and e.ingest_monitor.recoveries >= 1
+                and not e.ingest_monitor.burning
+                and e.health_snapshot()["ingest"]["status"] == "ok"
+                for e in (eng_s, eng_c, eng_f)
+            )
+        else:
+            checks["ingest_quiet"] = all(
+                e.ingest_monitor.anomaly_ticks == 0
+                for e in (eng_s, eng_c, eng_f)
+            )
+        mon = eng_s.ingest_monitor
+        ingest = {
+            "anomaly_ticks": mon.anomaly_ticks,
+            "recoveries": mon.recoveries,
+            "peak_stale_rows": int(
+                max(
+                    (d["stale_total"] for d in map(_decode_digest, ds)),
+                    default=0,
+                )
+            ),
+        }
 
     verdict = {
         "scenario": name,
@@ -193,11 +245,18 @@ def run_scenario(
         "scan_overflow_reruns": eng_c.scan_overflow_reruns,
         "routing": routing,
         "outcomes": outcomes,
+        "ingest": ingest,
         "checks": checks,
     }
     get_event_log().emit("scenario_run", **verdict)
     verdict["signal_set"] = signal_set  # not in the event: corpus pinning
     return verdict
+
+
+def _decode_digest(vec):
+    from binquant_tpu.engine.step import decode_ingest_digest
+
+    return decode_ingest_digest(vec)
 
 
 def _outcome_columns(engine) -> dict:
@@ -399,6 +458,16 @@ def render_verdict(event: dict) -> str:
             f" hit {outcomes['hit_rate']:.3f}"
             f" mae {outcomes['avg_mae']:+.5f}"
             f" mfe {outcomes['avg_mfe']:+.5f}"
+        )
+    # ingest columns (ISSUE 15) — appended only when a scenario drove
+    # with the observatory on, so pre-observatory events render
+    # byte-identically
+    ingest = event.get("ingest") or {}
+    if ingest.get("anomaly_ticks") is not None:
+        line += (
+            f"  ingest anomalies {ingest['anomaly_ticks']}"
+            f" recovered {ingest['recoveries']}"
+            f" peak_stale {ingest['peak_stale_rows']}"
         )
     if failed:
         line += f"\n  failed: {', '.join(failed)}"

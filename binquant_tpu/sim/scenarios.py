@@ -83,6 +83,13 @@ class ScenarioSpec:
     expect_overflow: bool = False
     min_signals: int = 0
     min_telegram: int = 0  # regime-notifier digests (btc_regime_flip)
+    # drive with the ingest-health observatory pinned ON (digest riding
+    # every backend's wire + the host monitor; ISSUE 15) — per-tick digest
+    # equality across the three drives becomes one more invariant
+    ingest: bool = False
+    # staleness SLO script: the bqt_ingest stale alarm must TRIP during
+    # the scripted fault and CLEAR after catch-up (requires ingest=True)
+    expect_ingest_anomaly: bool = False
     # heavy shapes excluded from the tier-1 drill (make scenarios runs all)
     slow: bool = False
 
@@ -625,6 +632,126 @@ def _bc_dirty_pressure(spec: ScenarioSpec) -> list[dict]:
         recover_tick=spec.n_ticks - 3,
     )
     return klines
+
+
+def feed_outage(
+    klines: list[dict], symbol_idx, ticks, recover_tick: int, n_symbols: int
+) -> None:
+    """Per-symbol feed death (ISSUE 15): ONLY the listed symbols' candles
+    are withheld during ``ticks`` and delivered in one catch-up drain at
+    ``recover_tick`` — every other symbol keeps appending, so the engine
+    keeps ticking while the dead rows' staleness buckets grow (the
+    dominant production failure mode the ingest observatory exists for;
+    contrast :func:`outage`, which silences whole buckets so no tick ever
+    observes the gap). The late bars are strictly-newer appends for their
+    rows, so routing stays clean and the scanned drive stays fused."""
+    names = symbol_names(n_symbols)
+    dead = {names[i] for i in symbol_idx}
+    gap = set(ticks)
+    for k in klines:
+        if k["symbol"] in dead and _tick_of(k) in gap:
+            k["_deliver_bucket"] = _bucket0() + recover_tick
+
+
+@_scenario(
+    ScenarioSpec(
+        name="feed_outage",
+        description="per-symbol feed death: three symbols' streams go "
+        "silent for seven mid-stream buckets while the rest keep "
+        "appending — the ingest staleness alarm must trip while they are "
+        "dark (bqt_ingest_stale_rows + ingest_anomaly + degraded "
+        "/healthz ingest section) and clear after the one-drain catch-up",
+        ingest=True,
+        expect_ingest_anomaly=True,
+        min_signals=1,
+    )
+)
+def _feed_outage(spec: ScenarioSpec) -> list[dict]:
+    closes, vols, _rng = base_market(spec)
+    shapes: dict = {}
+    # the hammer symbol keeps a LIVE feed; the dead rows are elsewhere
+    _bleed_then_hammer(
+        closes, vols, shapes, (5,), spec.n_ticks - 27, spec.n_ticks - 1
+    )
+    klines = emit_stream(spec, closes, vols, shapes)
+    feed_outage(
+        klines,
+        symbol_idx=(3, 7, 11),
+        ticks=range(spec.n_ticks - 12, spec.n_ticks - 5),
+        recover_tick=spec.n_ticks - 5,
+        n_symbols=spec.n_symbols,
+    )
+    return klines
+
+
+def _breadth_stall_schedule(n_ticks: int) -> dict:
+    """Scripted per-bucket market-breadth stream (ROADMAP 5a breadth
+    faults): healthy rising readings, then NaN-holed entries (the live
+    API nulls individual points), then the stream VANISHES entirely
+    (empty payloads — breadth-gated routing loses its inputs mid-run),
+    then a recovered rising series. One schedule entry per 15m bucket
+    (StubSession consumes them per market-breadth call)."""
+    healthy = {
+        "timestamp": [1, 2, 3, 4],
+        "market_breadth": [0.30, 0.34, 0.38, 0.42],
+        "market_breadth_ma": [0.30, 0.36],
+    }
+    holed = {
+        "timestamp": [1, 2, 3, 4],
+        "market_breadth": [0.30, None, None, 0.38],
+        "market_breadth_ma": [None, None],
+    }
+    schedule: list = []
+    for t in range(n_ticks):
+        if t < n_ticks - 32:
+            schedule.append(healthy)
+        elif t < n_ticks - 24:
+            schedule.append(holed)  # NaN holes mid-series
+        elif t < n_ticks - 16:
+            schedule.append(None)  # stream vanished (empty payload)
+        else:
+            schedule.append(healthy)  # recovered
+    return {"schedule": schedule}
+
+
+# one tick-count constant shared by the spec AND its breadth schedule —
+# the schedule's fault windows are phased against n_ticks, so the two
+# must never drift apart
+_BREADTH_STALL_TICKS = 112
+
+
+@_scenario(
+    ScenarioSpec(
+        name="breadth_stall",
+        description="breadth-series fault family (ROADMAP 5a): the "
+        "scripted breadth stream degrades mid-run — NaN-holed entries, "
+        "then an empty (vanished) series, then recovery — while pumps "
+        "and a capitulation hammer fire; the breadth-gated paths must "
+        "degrade gracefully and all three drives stay signal-identical",
+        n_ticks=_BREADTH_STALL_TICKS,
+        breadth=_breadth_stall_schedule(_BREADTH_STALL_TICKS),
+        min_signals=1,
+    )
+)
+def _breadth_stall(spec: ScenarioSpec) -> list[dict]:
+    closes, vols, _rng = base_market(spec)
+    shapes: dict = {}
+    # one hammer INSIDE the vanished-breadth window (MRF is not
+    # breadth-gated — signals must keep flowing while breadth is dark)
+    # and one after recovery
+    _bleed_then_hammer(
+        closes, vols, shapes, (4,), spec.n_ticks - 46, spec.n_ticks - 20
+    )
+    _bleed_then_hammer(
+        closes, vols, shapes, (9,), spec.n_ticks - 27, spec.n_ticks - 1
+    )
+    # BTC momentum up + a 15m pump during the healthy tail (LSP's long
+    # route re-engages once breadth recovers)
+    last = spec.n_ticks - 1
+    closes[last, 0] = closes[last - 1, 0] * 1.005
+    closes[last, 3] = closes[last - 1, 3] * 1.03
+    vols[last, 3] *= 8.0
+    return emit_stream(spec, closes, vols, shapes)
 
 
 def write_scenario_file(scenario: Scenario | str, path: str | Path) -> int:

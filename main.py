@@ -202,6 +202,9 @@ async def run_live() -> None:
             # /debug/executables: the engine's compile/cost ledger
             # (read-only, served like /metrics)
             ledger=LEDGER,
+            # /debug/symbols: the ingest monitor's worst-first per-symbol
+            # stream-health scoreboard (read-only, served like /metrics)
+            ingest=engine.ingest_monitor,
         )
         await metrics_server.start()
 

@@ -49,6 +49,14 @@ os.environ.setdefault("BQT_TRACE_SAMPLE", "0")
 # numeric-health coverage opts in explicitly (tests/test_numeric_health.py).
 os.environ.setdefault("BQT_NUMERIC_DIGEST", "0")
 os.environ.setdefault("BQT_DRIFT_METER", "0")
+# Ingest-health observatory (ISSUE 15) likewise defaults OFF for the
+# tier-1 lane: the ingest digest is a STATIC wire-layout flag (on would
+# change every engine's wire executable and break fabricated-wire
+# fixtures) and the host monitor adds per-candle/per-batch bookkeeping
+# dozens of stub engines must not each pay. Production default stays ON
+# (binquant_tpu/config.py); ingest coverage opts in explicitly
+# (tests/test_ingest_health.py via make_stub_engine(ingest_digest=True)).
+os.environ.setdefault("BQT_INGEST_DIGEST", "0")
 # Latency observatory (ISSUE 11) defaults OFF for the tier-1 lane, the
 # same pattern as BQT_TRACE_SAMPLE/BQT_NUMERIC_DIGEST: dozens of stub
 # engines must not each pay the freshness/phase bookkeeping, and several

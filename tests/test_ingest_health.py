@@ -1,0 +1,690 @@
+"""Ingest-health observatory (ISSUE 15): wire ingest digest, host-side
+per-symbol monitor, staleness SLO, /debug/symbols, and the report tools.
+
+Tier-1 keeps the small-shape drills: digest layout + bit-identical-when-off
+parity (the acceptance pin), device-side batch classification
+(append/rewrite/gap/drop), the staleness/coverage reductions, the
+cross-backend digest equality pin on a clean stream
+(serial == donated == scanned == backtest == classic — the acceptance
+criterion), the host monitor units (classification, health score,
+pagination, snapshot/rewind, SLO trip/clear), the /debug/symbols route,
+and the report goldens. The churn+rewrite stream drill is slow-marked
+into ``make ingest-smoke``.
+"""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from binquant_tpu.engine.buffer import NUM_FIELDS, Field, SymbolRegistry
+from binquant_tpu.engine.step import (
+    INGEST_DIGEST_WIDTH,
+    _ingest_batch_counts,
+    _ingest_interval_stats,
+    apply_updates_step,
+    decode_ingest_digest,
+    default_host_inputs,
+    ingest_digest_layout,
+    initial_engine_state,
+    pad_updates,
+    tick_step_wire,
+    unpack_wire,
+    wire_length,
+)
+from binquant_tpu.obs.events import EventLog, set_event_log
+from binquant_tpu.obs.ingest import IngestHealthMonitor
+from tests.conftest import make_ohlcv
+
+S_CAP = 16
+WINDOW = 130
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    set_event_log(log)
+    yield path
+    log.close()
+    set_event_log(None)
+
+
+def _read_events(path) -> list[dict]:
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def _bar_updates(frames: dict[int, dict], bar: int, size: int):
+    rows, tss, vals = [], [], []
+    for row, d in frames.items():
+        v = np.zeros(NUM_FIELDS, dtype=np.float32)
+        v[Field.OPEN], v[Field.HIGH] = d["open"][bar], d["high"][bar]
+        v[Field.LOW], v[Field.CLOSE] = d["low"][bar], d["close"][bar]
+        v[Field.VOLUME] = d["volume"][bar]
+        v[Field.QUOTE_VOLUME] = d["quote_asset_volume"][bar]
+        v[Field.NUM_TRADES] = 100
+        v[Field.DURATION_S] = 900
+        rows.append(row)
+        tss.append(int(d["open_time"][bar]) // 1000)
+        vals.append(v)
+    return pad_updates(
+        np.array(rows, np.int32), np.array(tss, np.int32), np.stack(vals),
+        size=size,
+    )
+
+
+def _seeded_state(n_rows=8, n_bars=WINDOW, seed=3):
+    rng = np.random.default_rng(seed)
+    frames = {
+        i: make_ohlcv(rng, n=n_bars, start_price=30 + i, vol=0.006)
+        for i in range(n_rows)
+    }
+    state = initial_engine_state(S_CAP, window=WINDOW)
+    for b in range(n_bars):
+        upd = _bar_updates(frames, b, S_CAP)
+        state = apply_updates_step(state, upd, upd)
+    return state, frames
+
+
+def _inputs(ts_s: int, n_rows=8):
+    tracked = np.zeros(S_CAP, dtype=bool)
+    tracked[:n_rows] = True
+    return default_host_inputs(S_CAP)._replace(
+        tracked=jnp.asarray(tracked),
+        btc_row=np.int32(0),
+        timestamp_s=np.int32(ts_s),
+        timestamp5_s=np.int32(ts_s),
+    )
+
+
+def test_ingest_layout_matches_width():
+    layout = ingest_digest_layout()
+    assert len(layout) == INGEST_DIGEST_WIDTH
+    assert layout[0] == "tracked"
+    assert layout[1] == "5m.stale_1x"
+    assert len(set(layout)) == len(layout)
+
+
+def test_wire_bit_identical_with_ingest_off_and_append_only():
+    """The acceptance pin: BQT_INGEST_DIGEST=0 compiles the pre-ingest
+    wire bit-for-bit, and the enabled block is a strict append after the
+    (optional) numeric digest — every earlier offset survives."""
+    state, frames = _seeded_state()
+    ts = int(frames[0]["open_time"][-1]) // 1000
+    upd = _bar_updates(frames, WINDOW - 1, S_CAP)
+    inputs = _inputs(ts)
+
+    _, w_default = tick_step_wire(state, upd, upd, inputs)
+    _, w_off = tick_step_wire(state, upd, upd, inputs, ingest_digest=False)
+    _, w_on = tick_step_wire(state, upd, upd, inputs, ingest_digest=True)
+    w_default, w_off, w_on = map(np.asarray, (w_default, w_off, w_on))
+
+    assert w_off.shape == (wire_length(S_CAP),)
+    assert np.array_equal(w_default.view(np.int32), w_off.view(np.int32))
+    assert w_on.shape == (wire_length(S_CAP, ingest_digest=True),)
+    assert np.array_equal(
+        w_on[: len(w_off)].view(np.int32), w_off.view(np.int32)
+    )
+    # both digests stack: numeric first, ingest strictly last
+    _, w_both = tick_step_wire(
+        state, upd, upd, inputs, numeric_digest=True, ingest_digest=True
+    )
+    w_both = np.asarray(w_both)
+    assert w_both.shape == (
+        wire_length(S_CAP, numeric_digest=True, ingest_digest=True),
+    )
+    _, ctx_both = unpack_wire(w_both, numeric_digest=True, ingest_digest=True)
+    assert "numeric_digest" in ctx_both and "ingest_digest" in ctx_both
+
+    # decode: the evaluated batch RE-SENDS each row's already-seeded last
+    # bar (same ts as the ring's latest) — exactly a same-bar correction,
+    # so the digest classifies all 8 as rewrites, zero appends
+    _, ctx = unpack_wire(w_on, ingest_digest=True)
+    digest = decode_ingest_digest(ctx["ingest_digest"])
+    assert digest["tracked"] == 8
+    for interval in ("5m", "15m"):
+        sect = digest[interval]
+        assert sect["appends"] == 0
+        assert sect["rewrites"] == 8
+        assert sect["gap_appends"] == sect["dropped"] == 0
+        assert sect["covered"] == 8
+        assert sect["min_bars"] == 8  # WINDOW=130 seeded bars >= MIN_BARS
+        assert sect["fresh"] == 8
+        assert sect["stale_1x"] == 0
+        assert sect["max_age_s"] == 0.0
+    assert digest["stale_total"] == 0
+    _, ctx_off = unpack_wire(w_off)
+    assert "ingest_digest" not in ctx_off
+
+
+def test_batch_counts_classify_like_apply_updates():
+    """Device classification unit: append / gap append / rewrite (latest
+    AND mid-history) / dropped (stale insert with no matching bar), judged
+    against the pre-update ring exactly as apply_updates routes them."""
+    state, frames = _seeded_state(n_rows=4)
+    buf = state.buf15
+    last_ts = int(frames[0]["open_time"][-1]) // 1000
+
+    rows = np.array([0, 1, 2, 3], np.int32)
+    ts = np.array(
+        [
+            last_ts + 900,  # clean next-bucket append
+            last_ts + 3 * 900,  # append skipping two buckets: gap
+            last_ts,  # re-send of the latest bar: rewrite
+            last_ts - 900 + 450,  # off-grid old ts, no matching bar: drop
+        ],
+        np.int32,
+    )
+    counts = np.asarray(
+        _ingest_batch_counts(buf, jnp.asarray(rows), jnp.asarray(ts), 900)
+    )
+    assert counts.tolist() == [2.0, 1.0, 1.0, 1.0]
+
+    # mid-history rewrite (an old bar that IS in the window) counts as a
+    # rewrite, not a drop; out-of-range rows are ignored entirely
+    rows2 = np.array([0, 5_000], np.int32)
+    ts2 = np.array([last_ts - 10 * 900, last_ts], np.int32)
+    counts2 = np.asarray(
+        _ingest_batch_counts(buf, jnp.asarray(rows2), jnp.asarray(ts2), 900)
+    )
+    assert counts2.tolist() == [0.0, 1.0, 0.0, 0.0]
+
+    # an empty (all-padding) batch is an exact zero
+    empty = pad_updates(
+        np.zeros(0, np.int32), np.zeros(0, np.int32),
+        np.zeros((0, NUM_FIELDS), np.float32), size=4,
+    )
+    counts3 = np.asarray(
+        _ingest_batch_counts(buf, jnp.asarray(empty[0]), jnp.asarray(empty[1]), 900)
+    )
+    assert counts3.tolist() == [0.0, 0.0, 0.0, 0.0]
+
+
+def test_interval_stats_staleness_buckets():
+    """Staleness/coverage reductions: cumulative 1x/3x/10x thresholds over
+    tracked rows with data, max age, and the coverage funnel."""
+    latest = jnp.asarray(
+        np.array([1000, 1000 - 900, 1000 - 2 * 900, 1000 - 4 * 900,
+                  1000 - 11 * 900, -1, 1000, 1000], np.int32)
+    )
+    filled = jnp.asarray(
+        np.array([120, 120, 120, 120, 120, 0, 50, 120], np.int32)
+    )
+    tracked = jnp.asarray(
+        np.array([1, 1, 1, 1, 1, 1, 1, 0], bool)
+    )
+    stats = [
+        float(v)
+        for v in _ingest_interval_stats(latest, filled, tracked, 1000, 900)
+    ]
+    stale_1x, stale_3x, stale_10x, max_age, covered, min_bars, fresh = stats
+    # ages: 0, 900 (exactly one bucket: NOT stale), 1800, 3600, 9900
+    assert stale_1x == 3  # 1800, 3600, 9900 > 900
+    assert stale_3x == 2  # 3600, 9900 > 2700
+    assert stale_10x == 1  # 9900 > 9000
+    assert max_age == 9900.0
+    assert covered == 6  # tracked with data (row 7 untracked, row 5 empty)
+    assert min_bars == 5  # row 6 has only 50 bars
+    assert fresh == 1  # only row 0 is sufficient AND at the eval bucket
+    # no tracked data at all → max_age decodes NaN
+    none_stats = _ingest_interval_stats(
+        latest, filled, jnp.zeros((8,), bool), 1000, 900
+    )
+    assert np.isnan(float(none_stats[3]))
+
+
+def _drive(mode, path, **kw):
+    from binquant_tpu.io.replay import make_stub_engine, tick_seq
+
+    seq = tick_seq(path)
+    eng = make_stub_engine(
+        capacity=16, window=112, ingest_digest=True, scan_chunk=8,
+        backtest_chunk=8, **kw,
+    )
+    eng.ingest_monitor.record_history = True
+
+    async def go():
+        out = []
+        if mode == "scanned":
+            out.extend(await eng.process_ticks_scanned(seq))
+        elif mode == "backtest":
+            out.extend(await eng.process_ticks_backtest(seq))
+        else:
+            for now_ms, klines in seq:
+                for k in klines:
+                    eng.ingest(k)
+                out.extend(await eng.process_tick(now_ms=now_ms))
+        out.extend(await eng.flush_pending())
+        return out
+
+    signals = asyncio.run(go())
+    return eng, signals
+
+
+def test_cross_backend_ingest_digest_equality(tmp_path):
+    """The acceptance criterion: all four backends (serial, donated,
+    scanned, backtest — plus the classic serial path) emit bit-identical
+    per-tick ingest digests on a clean stream, fold slots included (every
+    15m tick drains three 5m sub-batches here)."""
+    from binquant_tpu.io.replay import generate_replay_file
+
+    path = tmp_path / "clean.jsonl"
+    generate_replay_file(path, n_symbols=10, n_ticks=20, seed=5)
+
+    engines = {
+        "serial": _drive("serial", path, incremental=True)[0],
+        "donated": _drive("serial", path, incremental=True, donate=True)[0],
+        "scanned": _drive("scanned", path, incremental=True)[0],
+        "backtest": _drive("backtest", path, incremental=False)[0],
+        "classic": _drive("serial", path, incremental=False)[0],
+    }
+    mats = {
+        k: np.stack(e.ingest_monitor.digests) for k, e in engines.items()
+    }
+    base = mats["serial"]
+    assert base.shape == (20, INGEST_DIGEST_WIDTH)
+    for name, mat in mats.items():
+        assert mat.shape == base.shape, name
+        assert np.array_equal(
+            mat.view(np.int32), base.view(np.int32)
+        ), f"{name} digest diverged from serial"
+    # the batch drives really batched (the equality is cross-executable)
+    assert engines["scanned"].scan_chunks > 0
+    assert engines["backtest"].backtest_chunks > 0
+    assert engines["donated"].donated_ticks > 0
+    # fold slots counted: each 15m tick applies three 5m bars per symbol
+    last = decode_ingest_digest(base[-1])
+    assert last["5m"]["appends"] == 30
+    assert last["15m"]["appends"] == 10
+    # a clean stream never burns the staleness budget
+    assert all(
+        e.ingest_monitor.anomaly_ticks == 0 for e in engines.values()
+    )
+
+
+def _mk_monitor(n=4, budget=0):
+    reg = SymbolRegistry(8)
+    for i in range(n):
+        reg.add(f"S{i:03d}USDT")
+    return IngestHealthMonitor(reg, enabled=True, stale_budget=budget), reg
+
+
+def test_monitor_classification_score_and_pagination():
+    mon, reg = _mk_monitor()
+    t0 = 900_000
+    # establish bars on every row
+    rows = np.arange(4, dtype=np.int64)
+    mon.note_applied_batch(
+        "15m", rows, np.full(4, t0, np.int64), np.full(4, -1, np.int64)
+    )
+    # row 1 gaps (skips 2 buckets), row 2 rewrites, row 3 out-of-order
+    mon.note_applied_batch(
+        "15m",
+        np.array([0, 1, 2, 3], np.int64),
+        np.array([t0 + 900, t0 + 3 * 900, t0, t0 - 900], np.int64),
+        np.array([t0, t0, t0, t0], np.int64),
+    )
+    assert mon.appends[0] == 2 and mon.gaps[0] == 0
+    assert mon.gaps[1] == 1
+    assert mon.rewrites[2] == 1
+    assert mon.out_of_order[3] == 1
+    # arrival watermark + feed lag
+    mon.note_arrival("S000USDT", close_ms=5_000, exchange="kucoin",
+                     now_ms=6_500.0)
+    assert mon.feed_lag_last_ms["kucoin"] == 1_500.0
+    assert mon.arrivals == 1
+
+    # worst-first: the stale rows rank below the fresh frontier row
+    report = mon.symbols_report(limit=10)
+    assert report["total"] == 4
+    scores = [s["score"] for s in report["symbols"]]
+    assert scores == sorted(scores)
+    worst = report["symbols"][0]
+    assert worst["symbol"] in ("S002USDT", "S003USDT")
+    # frontier is row 1's t0+3*900; row 0 at t0+900 is 2 buckets behind
+    by_name = {s["symbol"]: s for s in report["symbols"]}
+    assert by_name["S000USDT"]["age_s"]["15m"] == 2 * 900
+    # pagination + prefix filter
+    page = mon.symbols_report(offset=1, limit=2)
+    assert [s["symbol"] for s in page["symbols"]] == [
+        s["symbol"] for s in report["symbols"][1:3]
+    ]
+    only = mon.symbols_report(prefix="S001")
+    assert [s["symbol"] for s in only["symbols"]] == ["S001USDT"]
+    # min_score keeps the unhealthy tail only
+    tail = mon.symbols_report(min_score=0.5)
+    assert all(s["score"] <= 0.5 for s in tail["symbols"])
+
+    # snapshot/rewind: an overflow re-drive must not double-count
+    snap = mon.snapshot_state()
+    before = int(mon.appends[1])
+    mon.note_applied_batch(
+        "15m", np.array([1], np.int64),
+        np.array([t0 + 4 * 900], np.int64), np.array([t0 + 3 * 900], np.int64),
+    )
+    assert mon.appends[1] == before + 1
+    mon.restore_state(snap)
+    assert mon.appends[1] == before
+
+
+def test_monitor_churn_rehoming_resets_row_stats():
+    mon, reg = _mk_monitor(n=2)
+    mon.note_applied_batch(
+        "15m", np.array([0, 1], np.int64),
+        np.full(2, 900_000, np.int64), np.full(2, -1, np.int64),
+    )
+    assert mon.appends[1] == 1
+    # symbol leaves, a newcomer claims its row
+    reg.remove("S001USDT")
+    reg.add("NEWUSDT")
+    mon.note_applied_batch(
+        "15m", np.array([1], np.int64),
+        np.array([900_900], np.int64), np.array([-1], np.int64),
+    )
+    assert mon.names[1] == "NEWUSDT"
+    assert mon.churn[1] == 1
+    assert mon.churn_total == 1
+    # the departed symbol's history did not leak onto the newcomer
+    assert mon.appends[1] == 1
+
+
+def _digest_vec(stale5=0, stale15=0, tracked=8, fresh=8):
+    layout = ingest_digest_layout()
+    vec = np.zeros(len(layout), np.float32)
+    vals = {
+        "tracked": tracked,
+        "5m.stale_1x": stale5, "15m.stale_1x": stale15,
+        "5m.covered": tracked, "15m.covered": tracked,
+        "5m.min_bars": tracked, "15m.min_bars": tracked,
+        "5m.fresh": fresh, "15m.fresh": fresh,
+        "5m.appends": tracked, "15m.appends": tracked,
+    }
+    for key, v in vals.items():
+        vec[layout.index(key)] = v
+    return vec
+
+
+def test_slo_trip_and_clear_events(event_log):
+    """The staleness state machine: burn entry force-emits ingest_anomaly
+    (with worst symbols + engine snapshot), every burning tick counts,
+    recovery emits ingest_recovered, healthy digests sample at the
+    cadence."""
+    mon, _ = _mk_monitor(budget=1)
+    mon.event_every = 4
+    snap = {"marker": True}
+    for _ in range(2):  # healthy: under budget
+        d = mon.observe_digest(_digest_vec(stale5=1), tick_ms=1,
+                               snapshot_fn=lambda: snap)
+        assert d["stale_total"] == 1
+    assert mon.anomaly_ticks == 0 and not mon.burning
+    for i in range(5):  # burning: 2 + 1 > budget
+        mon.observe_digest(_digest_vec(stale5=2, stale15=1), tick_ms=2 + i,
+                           snapshot_fn=lambda: snap)
+    assert mon.burning and mon.anomaly_ticks == 5
+    mon.observe_digest(_digest_vec(), tick_ms=10)  # recovered
+    assert not mon.burning and mon.recoveries == 1
+
+    events = _read_events(event_log)
+    kinds = [e["event"] for e in events]
+    anomalies = [e for e in events if e["event"] == "ingest_anomaly"]
+    # entry + one cadence re-emit (tick 4 of the burn), not one per tick
+    assert len(anomalies) == 2
+    assert anomalies[0]["stale_rows"] == 3
+    assert anomalies[0]["budget"] == 1
+    assert anomalies[0]["engine"] == {"marker": True}
+    assert "worst_symbols" in anomalies[0]
+    assert kinds[-1] == "ingest_recovered"
+    assert events[-1]["burn_ticks"] == 5
+
+
+def test_debug_symbols_route(event_log):
+    from binquant_tpu.obs.exposition import MetricsServer
+
+    mon, _ = _mk_monitor()
+    mon.note_applied_batch(
+        "15m", np.arange(4, dtype=np.int64),
+        np.full(4, 900_000, np.int64), np.full(4, -1, np.int64),
+    )
+    server = MetricsServer(health_fn=lambda: {"status": "ok"}, ingest=mon)
+
+    def get(target):
+        raw = server._route(target)
+        head, body = raw.split(b"\r\n\r\n", 1)
+        return head.decode().split()[1], json.loads(body)
+
+    status, payload = get("/debug/symbols?limit=2")
+    assert status == "200"
+    assert payload["enabled"] is True
+    assert payload["total"] == 4
+    assert len(payload["symbols"]) == 2
+    status, payload = get("/debug/symbols?offset=3&limit=10")
+    assert len(payload["symbols"]) == 1
+    status, payload = get("/debug/symbols?limit=junk")
+    assert status == "400"
+    # unconfigured/disabled: a JSON no-op, never a 500
+    bare = MetricsServer(health_fn=lambda: {"status": "ok"})
+    raw = bare._route("/debug/symbols")
+    body = json.loads(raw.split(b"\r\n\r\n", 1)[1])
+    assert body == {"enabled": False, "symbols": []}
+    # a crashing scoreboard must not read as success to probes
+    mon.symbols_report = lambda **kw: (_ for _ in ()).throw(RuntimeError())
+    status, payload = get("/debug/symbols")
+    assert status == "500"
+    assert payload == {"error": "symbols_report_failed"}
+
+
+GOLDEN_EVENTS = [
+    {
+        "event": "ingest_digest",
+        "digest": {
+            "tracked": 8,
+            "5m": {
+                "stale_1x": 0, "stale_3x": 0, "stale_10x": 0,
+                "max_age_s": 0.0, "covered": 8, "min_bars": 8, "fresh": 8,
+                "appends": 24, "rewrites": 0, "gap_appends": 0, "dropped": 0,
+            },
+            "15m": {
+                "stale_1x": 0, "stale_3x": 0, "stale_10x": 0,
+                "max_age_s": 0.0, "covered": 8, "min_bars": 8, "fresh": 8,
+                "appends": 8, "rewrites": 0, "gap_appends": 0, "dropped": 0,
+            },
+            "stale_total": 0,
+        },
+    },
+    {
+        "event": "ingest_anomaly",
+        "tick_ms": 1780372800000,
+        "stale_rows": 4,
+        "budget": 0,
+        "digest": {
+            "tracked": 8,
+            "5m": {
+                "stale_1x": 2, "stale_3x": 1, "stale_10x": 0,
+                "max_age_s": 3600.0, "covered": 8, "min_bars": 8, "fresh": 6,
+                "appends": 18, "rewrites": 0, "gap_appends": 0, "dropped": 0,
+            },
+            "15m": {
+                "stale_1x": 2, "stale_3x": 0, "stale_10x": 0,
+                "max_age_s": 1800.0, "covered": 8, "min_bars": 8, "fresh": 6,
+                "appends": 6, "rewrites": 0, "gap_appends": 0, "dropped": 0,
+            },
+            "stale_total": 4,
+        },
+        "worst_symbols": [
+            {
+                "symbol": "S003USDT", "row": 3, "score": 0.3333,
+                "age_s": {"5m": 3600, "15m": 1800},
+                "gaps": 0, "out_of_order": 0, "churn": 0,
+            },
+        ],
+    },
+    {
+        "event": "ingest_recovered",
+        "tick_ms": 1780374600000,
+        "burn_ticks": 2,
+        "digest": {
+            "tracked": 8,
+            "5m": {
+                "stale_1x": 0, "stale_3x": 0, "stale_10x": 0,
+                "max_age_s": 0.0, "covered": 8, "min_bars": 8, "fresh": 8,
+                "appends": 36, "rewrites": 0, "gap_appends": 2, "dropped": 0,
+            },
+            "15m": {
+                "stale_1x": 0, "stale_3x": 0, "stale_10x": 0,
+                "max_age_s": 0.0, "covered": 8, "min_bars": 8, "fresh": 8,
+                "appends": 12, "rewrites": 0, "gap_appends": 2, "dropped": 0,
+            },
+            "stale_total": 0,
+        },
+    },
+]
+
+GOLDEN_REPORT = """\
+== ingest digest (latest) ==
+  source ingest_recovered  tracked 8  stale_total 0
+  5m   stale 1x/3x/10x 0/0/0  max_age      0s  covered    8  min_bars    8  fresh    8
+       appends    36  rewrites    0  gap_appends    2  dropped    0
+  15m  stale 1x/3x/10x 0/0/0  max_age      0s  covered    8  min_bars    8  fresh    8
+       appends    12  rewrites    0  gap_appends    2  dropped    0
+
+== staleness SLO timeline ==
+  BURN  tick_ms   1780372800000  stale_rows    4  budget 0
+  CLEAR tick_ms   1780374600000  after 2 burning tick(s)
+
+== worst symbols (latest anomaly) ==
+  S003USDT     score  0.3333  age5   3600s  age15   1800s  gaps   0  ooo   0  churn  0"""
+
+
+def test_ingest_report_golden(tmp_path, capsys):
+    """tools/ingest_report.py renders a deterministic report (format
+    pinned like health_report's golden)."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import ingest_report
+    finally:
+        sys.path.pop(0)
+
+    log = tmp_path / "events.jsonl"
+    log.write_text(
+        "\n".join(json.dumps(e) for e in GOLDEN_EVENTS) + "\n"
+        + "not json\n"
+    )
+    assert ingest_report.main([str(log)]) == 0
+    out = capsys.readouterr().out.rstrip("\n")
+    assert out == GOLDEN_REPORT
+
+    assert ingest_report.main([str(log), "--json"]) == 0
+    model = json.loads(capsys.readouterr().out)
+    assert model["digest"]["stale_total"] == 0
+    assert model["anomalies"][0]["stale_rows"] == 4
+    assert model["worst_symbols"][0]["symbol"] == "S003USDT"
+
+
+def test_health_report_ingest_section(tmp_path, capsys):
+    """tools/health_report.py gains an ingest section — rendered only when
+    ingest events exist, so pre-observatory logs render byte-identically."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import health_report
+    finally:
+        sys.path.pop(0)
+
+    log = tmp_path / "events.jsonl"
+    log.write_text("\n".join(json.dumps(e) for e in GOLDEN_EVENTS) + "\n")
+    assert health_report.main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "== ingest health (latest digest) ==" in out
+    assert "anomaly_events 1  recoveries 1" in out
+
+    # a log with no ingest events renders no ingest section
+    log2 = tmp_path / "plain.jsonl"
+    log2.write_text(json.dumps({"event": "compile", "executable": "x",
+                                "seconds": 1.0, "cache": "cold"}) + "\n")
+    assert health_report.main([str(log2)]) == 0
+    assert "ingest health" not in capsys.readouterr().out
+
+
+def test_healthz_ingest_section_and_degraded_status(tmp_path):
+    """/healthz grows an ingest section; a burning staleness SLO degrades
+    the status (alive-but-impaired — stays probe-passing per the PR-1
+    contract, which only 503s on stale heartbeats)."""
+    from binquant_tpu.io.replay import make_stub_engine
+
+    eng = make_stub_engine(capacity=8, window=112, ingest_digest=True)
+    eng.touch_heartbeat()
+    snap = eng.health_snapshot()
+    assert snap["ingest"]["enabled"] is True
+    assert snap["ingest"]["status"] == "ok"
+    assert snap["status"] == "ok"
+    eng.ingest_monitor.burning = True
+    snap = eng.health_snapshot()
+    assert snap["ingest"]["status"] == "degraded"
+    assert snap["status"] == "degraded"
+    # observatory off: section reports off, wires nothing
+    eng2 = make_stub_engine(capacity=8, window=112, ingest_digest=False)
+    eng2.touch_heartbeat()
+    snap2 = eng2.health_snapshot()
+    assert snap2["ingest"]["enabled"] is False
+    assert snap2["ingest"]["status"] == "off"
+    assert snap2["status"] == "ok"
+
+
+@pytest.mark.slow
+def test_churn_rewrite_stream_drill(tmp_path):
+    """Slow lane (make ingest-smoke): a stream carrying a rewrite storm
+    AND a listing wave, driven serial + scanned with the digest on —
+    per-tick digests stay bit-identical (the storm ticks re-enter the
+    serial path in both drives), the digest counts the rewrites, and the
+    monitor sees the churn + out-of-order deliveries."""
+    from binquant_tpu.io.replay import signal_tuples
+    from binquant_tpu.sim.scenarios import (
+        SCENARIOS,
+        ScenarioSpec,
+        base_market,
+        emit_stream,
+        listing_churn,
+        rewrite_storm,
+    )
+
+    spec = ScenarioSpec(name="_drill", description="", n_symbols=10,
+                        n_ticks=40, capacity=16, window=112, scan_chunk=8)
+    closes, vols, _ = base_market(spec)
+    klines = emit_stream(spec, closes, vols)
+    rewrite_storm(klines, [spec.n_ticks - 6, spec.n_ticks - 4], per_tick=2)
+    # the listing lands on a chunk boundary: a churn break that strands a
+    # TOO-SHORT plan (< _SCAN_MIN_TICKS) re-drives it serially AFTER the
+    # churn drain already claimed the newcomer's row, so those re-driven
+    # ticks read `tracked` one registry claim early — a pre-existing
+    # fidelity wrinkle with zero signal impact (an empty row can't fire)
+    # that the digest's tracked count would surface as a spurious diff
+    listing_churn(
+        klines, listings={8: 25}, delistings={}, n_symbols=spec.n_symbols
+    )
+    path = tmp_path / "churny.jsonl"
+    with open(path, "w") as f:
+        for k in klines:
+            f.write(json.dumps(k) + "\n")
+
+    eng_s, sig_s = _drive("serial", path, incremental=True)
+    eng_c, sig_c = _drive("scanned", path, incremental=True)
+    ds = np.stack(eng_s.ingest_monitor.digests)
+    dc = np.stack(eng_c.ingest_monitor.digests)
+    assert ds.shape == dc.shape
+    assert np.array_equal(ds.view(np.int32), dc.view(np.int32))
+    assert set(signal_tuples(sig_s)) == set(signal_tuples(sig_c))
+    # the storm's corrected re-sends decode as rewrites in the digest
+    decoded = [decode_ingest_digest(v) for v in ds]
+    assert sum(d["15m"]["rewrites"] for d in decoded) >= 4
+    # and as out-of-order deliveries + churn on the host monitor
+    assert eng_s.ingest_monitor.out_of_order.sum() >= 4
+    assert eng_s.ingest_monitor.churn_total >= 1
+    assert eng_c.ingest_monitor.churn_total == eng_s.ingest_monitor.churn_total
+    assert "feed_outage" in SCENARIOS and "breadth_stall" in SCENARIOS

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Merge every checked-in BENCH_*.json into one ordered trajectory.
+
+Each bench record is a point-in-time measurement of one lane; reading the
+perf story across fourteen PRs means opening a dozen files. This tool
+flattens every numeric scalar in every ``BENCH_*.json`` (and, with
+``--include-multichip``, ``MULTICHIP_*.json``) into dotted metric paths
+and merges them into ``BENCH_TRAJECTORY.json``::
+
+    {
+      "generated_epoch_s": ...,
+      "git_sha": ...,
+      "sources": ["BENCH_r01.json", ...],
+      "metrics": {
+        "tick_p99_ms": [
+          {"epoch": 1721..., "value": 38.2, "source": "BENCH_r01.json",
+           "git_sha": "..."},
+          ...
+        ],
+        "detail.device.step_ms": [...],
+        ...
+      }
+    }
+
+Ordering: each record's ``measured_at_epoch_s`` stamp (bench.py stamps
+every writer since ISSUE 15); records predating the stamp fall back to
+the file's mtime, so the series still orders deterministically — rerun
+the bench to upgrade a file to a real stamp. Boolean leaves are skipped;
+numeric leaves inside lists use ``[i]`` path segments only for short
+lists (<= 8) to keep sweep points addressable without exploding the
+metric space.
+
+    python tools/bench_trajectory.py            # repo root, writes the file
+    python tools/bench_trajectory.py --dir X --dry-run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+MAX_LIST = 8  # longer lists are measurement arrays, not named points
+
+
+def _git_sha() -> str:
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:
+        return "unknown"
+
+
+def flatten(value, prefix: str = "") -> list[tuple[str, float]]:
+    out: list[tuple[str, float]] = []
+    if isinstance(value, bool):
+        return out
+    if isinstance(value, (int, float)):
+        v = float(value)
+        if v == v:  # NaN leaves carry no trajectory information
+            out.append((prefix, v))
+        return out
+    if isinstance(value, dict):
+        for k in sorted(value):
+            # methodology stamps are provenance, not measurements — a
+            # constant `measurement_epoch.epoch = 2` series per record
+            # would only pollute the metric namespace
+            if k in ("measurement_epoch", "measured_at_epoch_s"):
+                continue
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.extend(flatten(value[k], key))
+        return out
+    if isinstance(value, list) and len(value) <= MAX_LIST:
+        for i, item in enumerate(value):
+            out.extend(flatten(item, f"{prefix}[{i}]"))
+    return out
+
+
+def record_epoch(record: dict, path: Path) -> int:
+    """The stamp bench.py writes since ISSUE 15; mtime for older files."""
+    stamp = record.get("measured_at_epoch_s")
+    if isinstance(stamp, (int, float)) and stamp > 0:
+        return int(stamp)
+    return int(path.stat().st_mtime)
+
+
+def build_trajectory(
+    bench_dir: Path, include_multichip: bool = False
+) -> dict:
+    patterns = ["BENCH_*.json"]
+    if include_multichip:
+        patterns.append("MULTICHIP_*.json")
+    files = sorted(
+        p
+        for pattern in patterns
+        for p in bench_dir.glob(pattern)
+        if p.name != "BENCH_TRAJECTORY.json"
+    )
+    metrics: dict[str, list[dict]] = {}
+    sources: list[str] = []
+    for path in files:
+        try:
+            record = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            print(f"skipping unreadable {path.name}: {e}", file=sys.stderr)
+            continue
+        if not isinstance(record, dict):
+            continue
+        sources.append(path.name)
+        epoch = record_epoch(record, path)
+        sha = record.get("git_sha", "unknown")
+        # the headline metric keeps its declared name; everything else
+        # flattens under its structural path
+        headline = record.get("metric")
+        for key, value in flatten(record):
+            name = (
+                str(headline)
+                if key == "value" and headline
+                else key
+            )
+            metrics.setdefault(name, []).append(
+                {
+                    "epoch": epoch,
+                    "value": value,
+                    "source": path.name,
+                    "git_sha": sha,
+                }
+            )
+    for series in metrics.values():
+        series.sort(key=lambda p: (p["epoch"], p["source"]))
+    return {
+        "generated_epoch_s": int(time.time()),
+        "git_sha": _git_sha(),
+        "sources": sources,
+        "metrics": metrics,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--dir", default=".", help="directory holding BENCH_*.json"
+    )
+    parser.add_argument(
+        "--include-multichip", action="store_true",
+        help="also fold MULTICHIP_*.json dryrun records in",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="print the merged trajectory instead of writing the file",
+    )
+    parser.add_argument(
+        "--metric", help="print just one metric's ordered series and exit"
+    )
+    args = parser.parse_args(argv)
+
+    bench_dir = Path(args.dir)
+    trajectory = build_trajectory(
+        bench_dir, include_multichip=args.include_multichip
+    )
+    if not trajectory["sources"]:
+        print(f"no BENCH_*.json under {bench_dir}", file=sys.stderr)
+        return 1
+    if args.metric:
+        series = trajectory["metrics"].get(args.metric)
+        if series is None:
+            close = [
+                m for m in sorted(trajectory["metrics"]) if args.metric in m
+            ]
+            print(
+                f"unknown metric {args.metric!r}"
+                + (f"; close: {', '.join(close[:10])}" if close else ""),
+                file=sys.stderr,
+            )
+            return 2
+        print(json.dumps({args.metric: series}, indent=1))
+        return 0
+    if args.dry_run:
+        print(json.dumps(trajectory, indent=1))
+        return 0
+    out = bench_dir / "BENCH_TRAJECTORY.json"
+    with open(out, "w") as f:
+        json.dump(trajectory, f, indent=1, sort_keys=True)
+        f.write("\n")
+    n_points = sum(len(s) for s in trajectory["metrics"].values())
+    print(
+        f"wrote {out} — {len(trajectory['metrics'])} metrics, "
+        f"{n_points} points from {len(trajectory['sources'])} records"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

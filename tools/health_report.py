@@ -58,12 +58,24 @@ def summarize(events: list[dict]) -> dict:
     alarms = 0
     compiles: dict[str, dict] = {}
     summary = None
+    ingest = None
+    ingest_kind = None
+    ingest_anomalies = 0
+    ingest_recoveries = 0
     for ev in events:
         kind = ev.get("event")
         if kind in ("numeric_digest", "numeric_anomaly") and "digest" in ev:
             digest, digest_kind = ev["digest"], kind
             if kind == "numeric_anomaly":
                 anomalies += 1
+        elif kind in (
+            "ingest_digest", "ingest_anomaly", "ingest_recovered"
+        ) and "digest" in ev:
+            ingest, ingest_kind = ev["digest"], kind
+            if kind == "ingest_anomaly":
+                ingest_anomalies += 1
+            elif kind == "ingest_recovered":
+                ingest_recoveries += 1
         elif kind in ("carry_drift", "carry_drift_alarm") and "drift" in ev:
             drift = ev["drift"]
             if kind == "carry_drift_alarm":
@@ -86,6 +98,10 @@ def summarize(events: list[dict]) -> dict:
         "drift_alarms": alarms,
         "compiles": compiles,
         "compile_summary": summary,
+        "ingest": ingest,
+        "ingest_kind": ingest_kind,
+        "ingest_anomalies": ingest_anomalies,
+        "ingest_recoveries": ingest_recoveries,
     }
 
 
@@ -139,6 +155,29 @@ def render(model: dict) -> str:
                 f"max_rel {_fmt(v.get('max_rel')):>12}  "
                 f"max_ulp {_fmt(v.get('max_ulp')):>10}  "
                 f"compared {v.get('compared', 0):>8}"
+            )
+    # ingest section (ISSUE 15) — rendered only when ingest events exist,
+    # so pre-observatory logs render byte-identically
+    if model.get("ingest") is not None:
+        lines.append("")
+        lines.append("== ingest health (latest digest) ==")
+        ing = model["ingest"]
+        lines.append(
+            f"  source {model['ingest_kind']}  tracked "
+            f"{ing.get('tracked', 0)}  stale_total "
+            f"{ing.get('stale_total', 0)}  anomaly_events "
+            f"{model['ingest_anomalies']}  recoveries "
+            f"{model['ingest_recoveries']}"
+        )
+        for interval in ("5m", "15m"):
+            sect = ing.get(interval) or {}
+            lines.append(
+                f"  {interval:<4} stale 1x/3x/10x "
+                f"{sect.get('stale_1x', 0)}/{sect.get('stale_3x', 0)}/"
+                f"{sect.get('stale_10x', 0)}  max_age "
+                f"{_fmt(sect.get('max_age_s'))}s  covered "
+                f"{sect.get('covered', 0)}  min_bars "
+                f"{sect.get('min_bars', 0)}  fresh {sect.get('fresh', 0)}"
             )
     lines.append("")
     lines.append("== executable ledger ==")

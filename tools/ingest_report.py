@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Render the ingest-health observatory's state from the JSONL event log.
+
+The engine emits ``ingest_digest`` (sampled) / ``ingest_anomaly``
+(forced on staleness-SLO burn, carrying the worst symbols and an engine
+snapshot) / ``ingest_recovered`` events. This tool folds a log back into
+the "which symbols are stale, gapped, rewritten or lagging their
+exchange" view with no service in the loop:
+
+    python tools/ingest_report.py /var/log/bqt/events.jsonl
+    python tools/ingest_report.py events.jsonl --json
+
+Output format is golden-pinned (tests/test_ingest_health.py) — keep
+changes deliberate, like tools/health_report.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_events(path: str | Path) -> list[dict]:
+    """All events from a JSONL log, in file order; corrupt lines (a torn
+    write at rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def summarize(events: list[dict]) -> dict:
+    """The report's data model: the latest digest, the anomaly/recovery
+    timeline, and the worst-symbol table from the newest anomaly."""
+    digest = None
+    digest_kind = None
+    anomalies: list[dict] = []
+    recoveries: list[dict] = []
+    worst: list[dict] = []
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "ingest_digest" and "digest" in ev:
+            digest, digest_kind = ev["digest"], kind
+        elif kind == "ingest_anomaly" and "digest" in ev:
+            digest, digest_kind = ev["digest"], kind
+            anomalies.append(
+                {
+                    "tick_ms": ev.get("tick_ms"),
+                    "stale_rows": ev.get("stale_rows"),
+                    "budget": ev.get("budget"),
+                }
+            )
+            worst = ev.get("worst_symbols") or worst
+        elif kind == "ingest_recovered" and "digest" in ev:
+            digest, digest_kind = ev["digest"], kind
+            recoveries.append(
+                {
+                    "tick_ms": ev.get("tick_ms"),
+                    "burn_ticks": ev.get("burn_ticks"),
+                }
+            )
+    return {
+        "digest": digest,
+        "digest_kind": digest_kind,
+        "anomalies": anomalies,
+        "recoveries": recoveries,
+        "worst_symbols": worst,
+    }
+
+
+def render(model: dict) -> str:
+    lines: list[str] = []
+    digest = model["digest"]
+    lines.append("== ingest digest (latest) ==")
+    if digest is None:
+        lines.append("  (no ingest events — BQT_INGEST_DIGEST off?)")
+    else:
+        lines.append(
+            f"  source {model['digest_kind']}  tracked "
+            f"{digest.get('tracked', 0)}  stale_total "
+            f"{digest.get('stale_total', 0)}"
+        )
+        for interval in ("5m", "15m"):
+            sect = digest.get(interval) or {}
+            lines.append(
+                f"  {interval:<4} stale 1x/3x/10x "
+                f"{sect.get('stale_1x', 0)}/{sect.get('stale_3x', 0)}/"
+                f"{sect.get('stale_10x', 0)}"
+                f"  max_age {_fmt(sect.get('max_age_s')):>6}s"
+                f"  covered {sect.get('covered', 0):>4}"
+                f"  min_bars {sect.get('min_bars', 0):>4}"
+                f"  fresh {sect.get('fresh', 0):>4}"
+            )
+            lines.append(
+                f"       appends {sect.get('appends', 0):>5}"
+                f"  rewrites {sect.get('rewrites', 0):>4}"
+                f"  gap_appends {sect.get('gap_appends', 0):>4}"
+                f"  dropped {sect.get('dropped', 0):>4}"
+            )
+    lines.append("")
+    lines.append("== staleness SLO timeline ==")
+    if not model["anomalies"] and not model["recoveries"]:
+        lines.append("  (no anomalies — budget never burned)")
+    else:
+        for a in model["anomalies"]:
+            lines.append(
+                f"  BURN  tick_ms {_fmt(a['tick_ms']):>15}  stale_rows "
+                f"{a['stale_rows']:>4}  budget {a['budget']}"
+            )
+        for r in model["recoveries"]:
+            lines.append(
+                f"  CLEAR tick_ms {_fmt(r['tick_ms']):>15}  after "
+                f"{r['burn_ticks']} burning tick(s)"
+            )
+    lines.append("")
+    lines.append("== worst symbols (latest anomaly) ==")
+    if not model["worst_symbols"]:
+        lines.append("  (none recorded)")
+    else:
+        for s in model["worst_symbols"]:
+            age = s.get("age_s") or {}
+            lines.append(
+                f"  {s.get('symbol', '?'):<12} score "
+                f"{_fmt(s.get('score')):>7}  age5 "
+                f"{_fmt(age.get('5m')):>6}s  age15 "
+                f"{_fmt(age.get('15m')):>6}s  gaps {s.get('gaps', 0):>3}"
+                f"  ooo {s.get('out_of_order', 0):>3}"
+                f"  churn {s.get('churn', 0):>2}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw data model instead of the rendered report",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_events(args.log)
+    if not events:
+        print(f"no events in {args.log}", file=sys.stderr)
+        return 1
+    model = summarize(events)
+    if args.json:
+        print(json.dumps(model, indent=2, sort_keys=True))
+    else:
+        print(render(model))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,11 @@ help:
 	@echo "               digest ride-along), a digest+drift replay with a"
 	@echo "               NaN-poisoned candle (numeric_anomaly force-emit,"
 	@echo "               audit-tick carry_drift events), and the event log"
-	@echo "               rendered by tools/health_report.py"
+	@echo "               rendered by tools/health_report.py (which since"
+	@echo "               ISSUE 16 grows a delivery/SLO section when those"
+	@echo "               events exist); the ISSUE-16 SLO registry / GET"
+	@echo "               /debug/slo / report-golden units run via"
+	@echo "               tests/test_slo.py"
 	@echo "  incr-smoke - fast CPU smoke of the incremental indicator path"
 	@echo "               (step parity + pipeline gating, tier-1 lane)"
 	@echo "  strat-smoke- CPU smoke of the ISSUE-4 strategy-stage carries +"
@@ -110,7 +114,12 @@ help:
 	@echo "               open>half_open>closed cycle, analytics queue-"
 	@echo "               saturation burst, ZERO autotrade loss and ZERO"
 	@echo "               duplicates past the (trace_id, tick_seq) dedupe"
-	@echo "               key — rendered by tools/delivery_report.py"
+	@echo "               key — rendered by tools/delivery_report.py;"
+	@echo "               since ISSUE 16 the drill also asserts the SLO"
+	@echo "               burn>recover sequence + a sane slo_verdict()"
+	@echo "               (no false green while a breaker is open), the"
+	@echo "               lane runs tests/test_slo.py, and the burn"
+	@echo "               history renders via tools/slo_report.py"
 	@echo "  fanout-smoke- subscription fan-out plane lane (ISSUE 14):"
 	@echo "               the pytest drills (bitset pack/unpack props,"
 	@echo "               device-match-vs-Python-oracle equality, churn"
@@ -163,6 +172,8 @@ obs-smoke:
 	python -m pytest tests/test_obs.py tests/test_tracing.py -q -m "not slow" \
 		-k "obs_smoke or healthz or provenance or flight"
 	JAX_PLATFORMS=cpu python -m pytest tests/test_numeric_health.py -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q \
 		-p no:cacheprovider
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay_health.jsonl', n_symbols=8, n_ticks=110)"
 	python -c "import json; lines=open('/tmp/replay_health.jsonl').read().splitlines(); k=json.loads(lines[-1]); k['close']=float('nan'); lines[-1]=json.dumps(k); open('/tmp/replay_health.jsonl','w').write('\n'.join(lines)+'\n')"
@@ -293,7 +304,7 @@ outcome-smoke:
 # shed/replay story. The /healthz `delivery` section and the
 # bqt_delivery_* families are live in any BQT_DELIVERY=1 run.
 delivery-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_delivery.py -q \
+	JAX_PLATFORMS=cpu python -m pytest tests/test_delivery.py tests/test_slo.py -q \
 		-p no:cacheprovider
 	rm -f /tmp/bqt_delivery_events.jsonl
 	BQT_EVENT_LOG=/tmp/bqt_delivery_events.jsonl JAX_PLATFORMS=cpu \
@@ -302,6 +313,7 @@ delivery-smoke:
 	print({k: v for k, v in facts.items() if k != 'checks'}); \
 	assert facts['ok'], facts['checks']"
 	python tools/delivery_report.py /tmp/bqt_delivery_events.jsonl
+	python tools/slo_report.py /tmp/bqt_delivery_events.jsonl
 
 # The subscription fan-out lane (ISSUE 14): tier-1 keeps the cheap
 # drills (pack/unpack props, oracle equality, churn correctness, the

@@ -912,6 +912,107 @@ def run_fanout_throughput(
     }
 
 
+def run_fanout_connection_sweep(
+    counts: tuple = (10_000, 50_000, 100_000),
+    frames: int = 64,
+    match_density: float = 0.2,
+    slow_fraction: float = 0.01,
+    conn_queue_max: int = 8,
+) -> dict:
+    """Connection-scale sweep over the broadcast tier (ISSUE 16,
+    ROADMAP 2c): how the HUB itself scales from 10k to 100k concurrent
+    consumers, independent of the match kernel (arm 1 covers that).
+
+    Simulated consumers: real ``_Connection`` objects registered on a
+    real ``FanoutHub`` driven through the production ``broadcast()``
+    path (packed-word bit test + bounded offer + queue-depth sampling
+    per connection), but drained inline instead of through sockets — at
+    100k connections the sweep measures the fan-out loop and the
+    backpressure contract, not the kernel's TCP stack. A ``slow_fraction``
+    of consumers never drains: their bounded queues fill and overflow
+    frames shed through the counted slow-consumer path, so each rung
+    reports a real shed rate. Match→write latency is the ISSUE-16
+    definition — ``t_pub`` stamped at frame mint through drain-side
+    ``note_delivered`` — quoted at p50/p99 per rung."""
+    from binquant_tpu.fanout.hub import FanoutHub, _Connection
+
+    rng = np.random.default_rng(16)
+    sweep: list[dict] = []
+    for n_conns in counts:
+        hub = FanoutHub(slot_of=lambda u: None, conn_queue_max=conn_queue_max)
+        conns = [
+            _Connection(f"u{i}", i, "ws", conn_queue_max)
+            for i in range(n_conns)
+        ]
+        hub._conns.update(conns)
+        n_slow = max(int(n_conns * slow_fraction), 1)
+        fast = conns[n_slow:]  # the first n_slow never drain
+
+        n_words = (n_conns + 31) >> 5
+        addressed = 0
+        bcast_s: list[float] = []
+        lags_ms: list[float] = []
+        for seq in range(frames):
+            mask = rng.random(n_conns) < match_density
+            addressed += int(mask.sum())
+            packed = np.packbits(mask, bitorder="little")
+            packed = np.pad(packed, (0, (-len(packed)) % 4))
+            words = packed.view(np.uint32)[:n_words]
+            frame = {"seq": seq, "strategy": "bench", "symbol": "SWEEP"}
+            t_pub = time.perf_counter()
+            hub.broadcast(frame, words, t_pub)
+            bcast_s.append(time.perf_counter() - t_pub)
+            # responsive consumers drain between ticks; the slow cohort's
+            # queues keep filling until the shed path takes over
+            for conn in fast:
+                while True:
+                    try:
+                        s, _, tp = conn.queue.get_nowait()
+                    except asyncio.QueueFull:  # pragma: no cover
+                        break
+                    except asyncio.QueueEmpty:
+                        break
+                    conn.note_delivered(tp, s)
+                    if tp is not None:
+                        lags_ms.append(
+                            (time.perf_counter() - tp) * 1000.0
+                        )
+        delivered = sum(c.delivered for c in conns)
+        lags = np.asarray(lags_ms) if lags_ms else np.asarray([0.0])
+        sweep.append(
+            {
+                "connections": n_conns,
+                "slow_consumers": n_slow,
+                "addressed": addressed,
+                "delivered": delivered,
+                "shed": hub.shed,
+                "shed_rate_pct": round(
+                    100.0 * hub.shed / addressed, 3
+                )
+                if addressed
+                else 0.0,
+                "cursor_lag_records": hub.cursor_lag(),
+                "broadcast_ms_per_frame": round(
+                    float(np.mean(bcast_s)) * 1000, 3
+                ),
+                "frames_per_s": round(frames / sum(bcast_s)),
+                "match_write_p50_ms": round(
+                    float(np.percentile(lags, 50)), 3
+                ),
+                "match_write_p99_ms": round(
+                    float(np.percentile(lags, 99)), 3
+                ),
+            }
+        )
+    return {
+        "frames": frames,
+        "match_density": match_density,
+        "slow_fraction": slow_fraction,
+        "conn_queue_max": conn_queue_max,
+        "sweep": sweep,
+    }
+
+
 def run_ring_traffic(
     num_symbols: int = 2048, window: int = 400, ticks: int = 64
 ) -> dict:
@@ -2123,8 +2224,10 @@ def main() -> int | None:
         help="subscription match-kernel throughput (ISSUE 14): ONE "
         "dispatch joining --fanout-subs subscriptions against a fired "
         "tick, vs the extrapolated Python oracle, plus per-tick replay "
-        "overhead vs BQT_FANOUT=0; writes BENCH_FANOUT_CPU.json at "
-        ">=1M subscriptions on the CPU model",
+        "overhead vs BQT_FANOUT=0, plus the ISSUE-16 connection-scale "
+        "sweep (10k->100k simulated consumers: shed rate + match->write "
+        "p99 through the hub broadcast path); writes BENCH_FANOUT_CPU.json "
+        "at >=1M subscriptions on the CPU model",
     )
     parser.add_argument(
         "--fanout-subs",
@@ -2249,6 +2352,13 @@ def main() -> int | None:
 
         n_subs = 10_000 if args.smoke else args.fanout_subs
         r = run_fanout_throughput(n_subs=n_subs)
+        # connection-scale arm (ISSUE 16): the hub's broadcast tier from
+        # 10k to 100k simulated consumers — shed rate + match->write p99
+        r["connection_sweep"] = run_fanout_connection_sweep(
+            counts=(1_000, 2_000) if args.smoke
+            else (10_000, 50_000, 100_000),
+            frames=8 if args.smoke else 64,
+        )
         record = {
             "metric": "fanout_match_sub_signals_per_s",
             "value": r["sub_signal_matches_per_s"],

@@ -534,6 +534,47 @@ class Config:
         only the retained tail — the shed is visible as a seq gap)."""
         return int(self._get("BQT_FANOUT_OUTBOX_CAP", "4096") or "4096")
 
+    # -- unified SLO / delivery observatory plane (obs/slo.py, ISSUE 16) -----
+
+    @cached_property
+    def slo_enabled(self) -> bool:
+        """Unified SLO registry + verdict plane (obs/slo.py): freshness,
+        staleness, and per-sink delivery SLOs behind one burn/recover
+        event model, served at GET /debug/slo and folded by
+        slo_verdict(). BQT_SLO=0 disables registration and judging (the
+        per-plane breach events keep firing — the tier-1 default, per
+        the BQT_TRACE_SAMPLE pattern)."""
+        return self._get("BQT_SLO", "1") != "0"
+
+    @cached_property
+    def slo_window(self) -> int:
+        """Rolling per-sink sample window the delivery SLO's p99 is
+        computed over (obs/delivery_health.py)."""
+        return int(self._get("BQT_SLO_WINDOW", "512") or "512")
+
+    @cached_property
+    def slo_event_every(self) -> int:
+        """Burning observations between re-emitted slo_burn events (the
+        entry observation always emits; a sustained outage must not
+        flood one event per failing observation)."""
+        return int(self._get("BQT_SLO_EVENT_EVERY", "256") or "256")
+
+    @cached_property
+    def delivery_health_enabled(self) -> bool:
+        """Delivery-plane health collector: per-sink close→final-ack lag
+        histograms (bqt_delivery_lag_ms{sink}) + per-attempt sink spans
+        joined to the tick's trace_id. BQT_DELIVERY_HEALTH=0 keeps the
+        ack path allocation-free (the tier-1 default)."""
+        return self._get("BQT_DELIVERY_HEALTH", "1") != "0"
+
+    @cached_property
+    def delivery_slo_ms(self) -> float:
+        """p99 close→sink-ack budget per sink (ms); a sink whose rolling
+        p99 exceeds it burns its delivery.<sink> SLO. 0 disables the
+        delivery SLO (lag histograms still record when the health
+        collector is on)."""
+        return float(self._get("BQT_DELIVERY_SLO_MS", "0") or "0")
+
     # -- binbot REST bounds (io/binbot.py satellite) -------------------------
 
     @cached_property

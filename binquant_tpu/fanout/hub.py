@@ -44,10 +44,13 @@ import numpy as np
 
 from binquant_tpu.obs.events import get_event_log
 from binquant_tpu.obs.instruments import (
+    DELIVERY_CURSOR_LAG,
+    FANOUT_CONN_QUEUE_DEPTH,
     FANOUT_CONNECTIONS,
     FANOUT_FRAMES,
     FANOUT_RESUME_REPLAYED,
     FANOUT_SHED,
+    FANOUT_WRITE_LATENCY,
 )
 
 log = logging.getLogger(__name__)
@@ -258,6 +261,10 @@ class _Connection:
         self.gapped = False
         self.lag_ms_sum = 0.0
         self.lag_ms_max = 0.0
+        # highest frame seq WRITTEN to this peer's socket — the hub's
+        # cursor-lag watermark compares it against the outbox head to
+        # report how far the laggiest consumer trails (ISSUE 16)
+        self.last_seq = -1
         self.closed = asyncio.Event()
         # set by FanoutHub._close_conn: the close bookkeeping (per-user
         # totals fold + conn_close event) must run exactly once whether
@@ -273,12 +280,18 @@ class _Connection:
             self.gapped = True
             return False
 
-    def note_delivered(self, t_pub: float | None) -> None:
+    def note_delivered(self, t_pub: float | None, seq: int = -1) -> None:
         self.delivered += 1
+        if seq > self.last_seq:
+            self.last_seq = seq
         if t_pub is not None:
+            # subscriber match→socket-write latency: t_pub is stamped by
+            # FanoutPlane.on_fired at frame mint, so this spans bitset
+            # match + queue dwell + the actual transport write
             lag = (time.perf_counter() - t_pub) * 1000.0
             self.lag_ms_sum += lag
             self.lag_ms_max = max(self.lag_ms_max, lag)
+            FANOUT_WRITE_LATENCY.labels(transport=self.transport).observe(lag)
 
     def stats(self) -> dict:
         return {
@@ -294,6 +307,8 @@ class _Connection:
                 else None
             ),
             "lag_ms_max": round(self.lag_ms_max, 3),
+            "last_seq": self.last_seq,
+            "queue_depth": self.queue.qsize(),
         }
 
 
@@ -326,6 +341,11 @@ class FanoutHub:
         self.frames_sent = 0
         self.shed = 0
         self.resumed = 0
+        # highest frame seq broadcast so far — the head the fan-out
+        # consumer-group cursor lag is measured against (a memory-held
+        # mirror; outbox.last_seq() is a full-file scan, unfit for
+        # snapshot-rate reads)
+        self.head_seq = -1
         # accumulated per-user delivery totals incl. closed connections —
         # the report tool's "hottest subscriptions" feed
         self.totals_by_user: dict[str, int] = {}
@@ -368,6 +388,24 @@ class FanoutHub:
             self._conns.discard(conn)
         return len(victims)
 
+    def cursor_lag(self) -> int:
+        """Records-behind-head for the hub's LAGGIEST open connection —
+        the fan-out plane's entry in the per-consumer-group cursor-lag
+        watermark (the delivery lanes are the other three groups). A
+        connection that has written frames trails by ``head_seq -
+        last_seq``; one that hasn't yet trails by its queued backlog.
+        Refreshes the gauge on read, the watermark pattern (labelled
+        fanout_hub — the delivery plane's "fanout" lane is the
+        worker-side group; this one is the socket-side consumers)."""
+        lag = 0
+        for conn in self._conns:
+            if conn.last_seq >= 0 and self.head_seq >= 0:
+                lag = max(lag, self.head_seq - conn.last_seq)
+            else:
+                lag = max(lag, conn.queue.qsize())
+        DELIVERY_CURSOR_LAG.labels(group="fanout_hub").set(lag)
+        return lag
+
     def snapshot(self) -> dict:
         return {
             "port": self.port if self._server is not None else None,
@@ -375,6 +413,8 @@ class FanoutHub:
             "frames_sent": self.frames_sent,
             "shed": self.shed,
             "resumed": self.resumed,
+            "head_seq": self.head_seq,
+            "cursor_lag": self.cursor_lag(),
             "outbox": (
                 {
                     "path": str(self.outbox.path),
@@ -398,6 +438,8 @@ class FanoutHub:
             return
         data = json.dumps(frame, separators=(",", ":"))
         seq = int(frame.get("seq", 0))
+        if seq > self.head_seq:
+            self.head_seq = seq
         for conn in list(self._conns):
             w = conn.slot >> 5
             if w >= len(words) or not (
@@ -408,6 +450,9 @@ class FanoutHub:
                 # an in-flight frame addressed to this slot's PREVIOUS
                 # owner (delivery-worker handoff raced an unsubscribe)
                 continue
+            # queue-depth distribution sampled at offer time — the shape
+            # of this histogram is the early-warning for shed storms
+            FANOUT_CONN_QUEUE_DEPTH.observe(conn.queue.qsize())
             if not conn.offer((seq, data, t_pub)):
                 self.shed += 1
                 FANOUT_SHED.labels(reason="slow_consumer").inc()
@@ -611,7 +656,7 @@ class FanoutHub:
                     return
                 seq, data, t_pub = getter.result()
                 await write_frame(seq, data)
-                conn.note_delivered(t_pub)
+                conn.note_delivered(t_pub, seq)
                 self.frames_sent += 1
                 FANOUT_FRAMES.labels(transport=conn.transport).inc()
         finally:

@@ -108,6 +108,9 @@ class FanoutPlane:
         self.published = 0
         self.matched_recipients = 0
         self.recompiles = {"full": 0, "incremental": 0}
+        # outbox append failures (lossy-tier: counted, never aborting
+        # finalize) — the SLO plane's recipient-set invariant reads this
+        self.outbox_errors = 0
 
     # -- subscription churn (delegates stamping metrics) ---------------------
 
@@ -250,6 +253,7 @@ class FanoutPlane:
                 try:
                     self.outbox.append(frame, wrow)
                 except Exception:
+                    self.outbox_errors += 1
                     FANOUT_SHED.labels(reason="outbox_error").inc()
                     get_event_log().emit(
                         "fanout_shed",
@@ -326,7 +330,23 @@ class FanoutPlane:
             "match_dispatches": self.match_dispatches,
             "recompiles": dict(self.recompiles),
             "behind_delivery": self.sink_attached,
+            "outbox_errors": self.outbox_errors,
             "hub": self.hub.snapshot(),
+        }
+
+    def recipient_set_invariant(self) -> dict:
+        """The PR 14 recipient-set integrity contract as an SLO-plane
+        probe: every published frame made it into the cursor-replay log
+        (no outbox_error sheds), and no slot's min-seq floor ran ahead of
+        the frame counter (a floor past ``seq`` would silently suppress
+        live frames for that slot's owner)."""
+        floors_ok = all(
+            floor <= self.seq for floor in self._slot_min_seq.values()
+        )
+        return {
+            "ok": self.outbox_errors == 0 and floors_ok,
+            "outbox_errors": self.outbox_errors,
+            "slot_floors_ok": floors_ok,
         }
 
 

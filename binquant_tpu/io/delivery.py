@@ -65,7 +65,10 @@ from binquant_tpu.obs.events import get_event_log
 from binquant_tpu.obs.instruments import (
     DELIVERY_ACKED,
     DELIVERY_BREAKER,
+    DELIVERY_BREAKER_STATE,
+    DELIVERY_CURSOR_LAG,
     DELIVERY_ENQUEUED,
+    DELIVERY_OLDEST_AGE,
     DELIVERY_QUEUE,
     DELIVERY_RETRIES,
     DELIVERY_SHED,
@@ -125,11 +128,22 @@ class DeliveryWal:
         self._unacked_keys: set[tuple[str, str]] = {
             key for key in puts if key not in acked
         }
+        # per-unacked-key put wall clock (epoch ms) for the oldest-record
+        # -age watermark; pre-observatory records without a `wall` field
+        # fall back to boot time — a conservative LOWER bound on age
+        boot_wall_ms = time.time() * 1000.0
+        self._put_wall_ms: dict[tuple[str, str], float] = {
+            key: float(puts[key].get("wall") or boot_wall_ms)
+            for key in self._unacked_keys
+        }
         self._f = open(self.path, "a", encoding="utf-8")
         self._acks_since_compact = 0
         self.puts = 0
         self.acks = 0
         self.compactions = 0
+        # acks for keys not currently unacked — the zero-duplicate
+        # invariant's meter (a worker acking the same entry twice)
+        self.dup_acks = 0
 
     def _append(self, record: dict) -> None:
         self._f.write(json.dumps(record, separators=(",", ":")) + "\n")
@@ -141,27 +155,59 @@ class DeliveryWal:
                 pass
 
     def append_put(
-        self, entry_id: str, sink: str, payload: Any, ts_ms: int | None = None
+        self,
+        entry_id: str,
+        sink: str,
+        payload: Any,
+        ts_ms: int | None = None,
+        lag0_ms: float | None = None,
+        trace_id: str | None = None,
     ) -> None:
+        """``lag0_ms``/``trace_id`` are the ISSUE-16 provenance stamps
+        riding the existing put record (additive fields — a pre-16 WAL
+        replays fine): the candle-close lag at enqueue and the
+        originating tick's trace id, plus the put's own wall clock so a
+        replayed ack can report the true cross-process close→ack lag."""
         self.puts += 1
-        self._unacked_keys.add((entry_id, sink))
-        self._append(
-            {
-                "op": "put",
-                "id": entry_id,
-                "sink": sink,
-                "ts_ms": ts_ms,
-                "payload": payload,
-            }
-        )
+        key = (entry_id, sink)
+        self._unacked_keys.add(key)
+        wall = time.time() * 1000.0
+        self._put_wall_ms[key] = wall
+        record = {
+            "op": "put",
+            "id": entry_id,
+            "sink": sink,
+            "ts_ms": ts_ms,
+            "payload": payload,
+            "wall": round(wall, 3),
+        }
+        if lag0_ms is not None:
+            record["lag0"] = round(float(lag0_ms), 3)
+        if trace_id is not None:
+            record["trace"] = trace_id
+        self._append(record)
 
     def append_ack(self, entry_id: str, sink: str) -> None:
         self.acks += 1
-        self._unacked_keys.discard((entry_id, sink))
+        key = (entry_id, sink)
+        if key not in self._unacked_keys:
+            self.dup_acks += 1
+        self._unacked_keys.discard(key)
+        self._put_wall_ms.pop(key, None)
         self._append({"op": "ack", "id": entry_id, "sink": sink})
         self._acks_since_compact += 1
         if self.compact_every and self._acks_since_compact >= self.compact_every:
             self.compact()
+
+    def oldest_put_wall_ms(self, sink: str) -> float | None:
+        """Put wall clock of the sink's oldest unacked record (the
+        oldest-record-age watermark's anchor); None when fully acked."""
+        walls = [
+            wall
+            for (_, s), wall in self._put_wall_ms.items()
+            if s == sink
+        ]
+        return min(walls) if walls else None
 
     def unacked_count(self, sink: str | None = None) -> int:
         """Live unacked-entry count (boot backlog included) — what the
@@ -250,12 +296,19 @@ class CircuitBreaker:
         self._opened_at: float | None = None
         self.transitions: list[str] = []
 
+    #: gauge encoding for bqt_delivery_breaker_state (level companion to
+    #: the transitions counter; alert on >0, not on edges)
+    STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
     def _transition(self, state: str) -> None:
         if state == self.state:
             return
         self.state = state
         self.transitions.append(state)
         DELIVERY_BREAKER.labels(sink=self.sink, state=state).inc()
+        DELIVERY_BREAKER_STATE.labels(sink=self.sink).set(
+            self.STATE_CODES.get(state, 0)
+        )
         get_event_log().emit(
             "delivery_breaker",
             sink=self.sink,
@@ -314,9 +367,15 @@ class Envelope:
     replayed: bool = False  # came back off the WAL (restart / deferral)
     # freshness anchors (live enqueues only): candle-close lag at dispatch
     # plus the dispatch perf_counter — the ack computes close→acked from
-    # them. Replayed entries have no meaningful anchors and skip the stamp.
+    # them. Replayed entries restore lag0_ms + put_wall_ms off the WAL
+    # record instead (wall-clock delta since the put — the true
+    # cross-process lag), leaving dispatched_at None.
     lag0_ms: float | None = None
     dispatched_at: float | None = None
+    # ISSUE-16 provenance: the originating tick's trace id (sink spans +
+    # WAL record) and the WAL put's wall clock (replayed-lag anchor)
+    trace_id: str | None = None
+    put_wall_ms: float | None = None
 
 
 @dataclass
@@ -353,6 +412,7 @@ class DeliveryPlane:
         wal_compact_every: int = 256,
         rng: random.Random | None = None,
         freshness: Any | None = None,
+        health: Any | None = None,
     ) -> None:
         self.queue_max = max(int(queue_max), 1)
         self.attempt_timeout_s = float(attempt_timeout_s)
@@ -361,6 +421,9 @@ class DeliveryPlane:
         self.backoff_max_s = float(backoff_max_s)
         self._rng = rng or random.Random()
         self.freshness = freshness
+        # obs/delivery_health.py collector (ISSUE 16): the ack-side
+        # close→ack lag consumer + per-attempt sink-span gate
+        self.health = health
         self.wal: DeliveryWal | None = (
             DeliveryWal(
                 wal_path, fsync=wal_fsync, compact_every=wal_compact_every
@@ -419,6 +482,12 @@ class DeliveryPlane:
             payload=payload,
             ts_ms=rec.get("ts_ms"),
             replayed=True,
+            # ISSUE-16 anchors riding the put record: lag-at-enqueue +
+            # the put's wall clock let the ack report the TRUE
+            # cross-process close→ack lag; absent on pre-16 records
+            lag0_ms=rec.get("lag0"),
+            put_wall_ms=rec.get("wall"),
+            trace_id=rec.get("trace"),
         )
 
     def _replay_wal(self) -> None:
@@ -539,6 +608,18 @@ class DeliveryPlane:
         into the tick thread."""
         if not self.started:
             self.start()
+        # delivery-health fallback anchors: with freshness OFF no caller
+        # stamps lag0/dispatched_at, so the lag histogram would stay
+        # empty — anchor at the enqueue instead (lag measures
+        # enqueue→ack; documented in README §Delivery observatory)
+        if (
+            lag0_ms is None
+            and self.health is not None
+            and getattr(self.health, "enabled", False)
+        ):
+            lag0_ms = 0.0
+        if dispatched_at is None and lag0_ms is not None:
+            dispatched_at = time.perf_counter()
         for lane in self._lanes.values():
             try:
                 payload = lane.sink.encode(signal)
@@ -579,6 +660,7 @@ class DeliveryPlane:
                 ts_ms=tick_ms,
                 lag0_ms=lag0_ms,
                 dispatched_at=dispatched_at,
+                trace_id=getattr(signal, "trace_id", None),
             )
             self.enqueue(env)
 
@@ -593,6 +675,8 @@ class DeliveryPlane:
                 env.sink,
                 lane.sink.to_wal(env.payload),
                 ts_ms=env.ts_ms,
+                lag0_ms=env.lag0_ms,
+                trace_id=env.trace_id,
             )
             # the gauge must move on PUTS too: during an outage acks stop
             # but backlog keeps growing — that growth IS the signal
@@ -701,6 +785,7 @@ class DeliveryPlane:
                     min(max(lane.breaker.cooldown_remaining(), 0.01), 1.0)
                 )
                 continue
+            t_attempt = time.perf_counter()
             try:
                 await asyncio.wait_for(
                     lane.sink.deliver(env.payload),
@@ -713,6 +798,9 @@ class DeliveryPlane:
                 lane.retries += 1
                 lane.breaker.record_failure()
                 DELIVERY_RETRIES.labels(sink=lane.sink.name).inc()
+                self._sink_span(
+                    lane, env, t_attempt, env.attempts, type(exc).__name__
+                )
                 if not durable and env.attempts >= self.retry_max:
                     self._shed(lane, "retries_exhausted")
                     log.warning(
@@ -731,8 +819,60 @@ class DeliveryPlane:
                 backoff = min(backoff * 2.0, self.backoff_max_s)
                 continue
             lane.breaker.record_success()
+            self._sink_span(lane, env, t_attempt, env.attempts + 1, "ok")
             self._ack(lane, env)
             return
+
+    def _sink_span(
+        self,
+        lane: _SinkLane,
+        env: Envelope,
+        t0: float,
+        attempt: int,
+        outcome: str,
+    ) -> None:
+        """One per-attempt sink span, joined to the originating tick by
+        the trace_id riding the envelope/WAL record (ISSUE 16 satellite).
+        The tick's trace completed at emit — its span tree is already in
+        the log — so these are standalone events tools/trace_report.py
+        grafts onto the matching waterfall, extending it past enqueue to
+        the ack. Gated like the lag accounting (health on + a trace id);
+        the event log never raises."""
+        if (
+            self.health is None
+            or not getattr(self.health, "enabled", False)
+            or not env.trace_id
+        ):
+            return
+        get_event_log().emit(
+            "sink_span",
+            trace_id=env.trace_id,
+            sink=lane.sink.name,
+            attempt=int(attempt),
+            ms=round((time.perf_counter() - t0) * 1000.0, 3),
+            outcome=outcome,
+            entry_id=env.entry_id,
+            replayed=env.replayed,
+        )
+
+    def _lag_ms(self, env: Envelope) -> float | None:
+        """End-to-end close→ack lag of one confirmed delivery. Live
+        entries: candle-close lag at dispatch + the monotonic dwell since.
+        Replayed entries: lag-at-put + the WALL-clock delta since the put
+        (the delta spans the process kill — exactly the lag a consumer
+        experienced). None when no anchors rode the envelope."""
+        if env.replayed:
+            if env.lag0_ms is None or env.put_wall_ms is None:
+                return None
+            return float(env.lag0_ms) + max(
+                time.time() * 1000.0 - float(env.put_wall_ms), 0.0
+            )
+        if env.dispatched_at is None or env.lag0_ms is None:
+            return None
+        return (
+            float(env.lag0_ms)
+            + (time.perf_counter() - env.dispatched_at) * 1000.0
+        )
 
     def _ack(self, lane: _SinkLane, env: Envelope) -> None:
         lane.acked += 1
@@ -752,7 +892,8 @@ class DeliveryPlane:
             )
             # ISSUE-11 loop closure: close→acked-through-the-queue.
             # Replayed entries predate this process's clock anchors — no
-            # stamp.
+            # stamp here (the ISSUE-16 lag histogram below covers them
+            # through the WAL wall-clock anchor instead).
             if (
                 self.freshness is not None
                 and getattr(self.freshness, "enabled", False)
@@ -763,6 +904,20 @@ class DeliveryPlane:
                     env.lag0_ms
                     + (time.perf_counter() - env.dispatched_at) * 1000.0
                 )
+            # ISSUE-16: the ack-side close→ack lag (to the FINAL
+            # successful ack — this runs once per envelope, after every
+            # retry) feeding bqt_delivery_lag_ms + the delivery SLO
+            if self.health is not None and getattr(
+                self.health, "enabled", False
+            ):
+                lag_ms = self._lag_ms(env)
+                if lag_ms is not None:
+                    self.health.on_ack(
+                        lane.sink.name,
+                        lag_ms,
+                        attempts=env.attempts + 1,
+                        replayed=env.replayed,
+                    )
         except Exception:  # pragma: no cover - observability-side failure
             # the sink confirmed and the WAL ack landed — a failing event
             # log or histogram must not turn a delivered entry into a
@@ -797,6 +952,69 @@ class DeliveryPlane:
     def lane(self, sink: str) -> _SinkLane:
         return self._lanes[sink]
 
+    def watermarks(self) -> dict:
+        """Outbox watermarks per consumer group (ISSUE 16): records
+        behind head (queued + inflight + WAL-deferred, i.e. accepted but
+        not yet acked in-process) and the oldest unacked WAL record's
+        age. Refreshes the bqt_delivery_cursor_lag /
+        bqt_delivery_oldest_unacked_ms gauges on read (snapshot-driven —
+        the watermarks are levels, not edges)."""
+        now_wall_ms = time.time() * 1000.0
+        groups: dict[str, dict] = {}
+        for name, lane in self._lanes.items():
+            cursor_lag = lane.queue.qsize() + lane.inflight + lane.deferred
+            DELIVERY_CURSOR_LAG.labels(group=name).set(cursor_lag)
+            cell: dict[str, Any] = {
+                "cursor_lag": cursor_lag,
+                "queue_depth": lane.queue.qsize(),
+                "inflight": lane.inflight,
+                "deferred": lane.deferred,
+            }
+            if (
+                self.wal is not None
+                and lane.sink.policy == AT_LEAST_ONCE
+            ):
+                oldest = self.wal.oldest_put_wall_ms(name)
+                age_ms = (
+                    max(now_wall_ms - oldest, 0.0)
+                    if oldest is not None
+                    else 0.0
+                )
+                DELIVERY_OLDEST_AGE.labels(sink=name).set(round(age_ms, 3))
+                cell["oldest_unacked_ms"] = round(age_ms, 3)
+            groups[name] = cell
+        return groups
+
+    # -- SLO-plane invariant probes (ISSUE 16) -------------------------------
+
+    def zero_loss_invariant(self) -> dict:
+        """PR 13 contract: the at-least-once class NEVER sheds (only
+        lossy lanes may drop under pressure)."""
+        shed = {
+            name: dict(lane.shed)
+            for name, lane in self._lanes.items()
+            if lane.sink.policy == AT_LEAST_ONCE and lane.shed
+        }
+        return {"ok": not shed, "durable_sheds": shed}
+
+    def zero_duplicate_invariant(self) -> dict:
+        """PR 13 contract: no entry acks twice (sink-side idempotency
+        keys make redelivery safe, but a double ack in-process would mean
+        the outbox double-delivered). No WAL → vacuously true."""
+        dups = self.wal.dup_acks if self.wal is not None else 0
+        return {"ok": dups == 0, "dup_acks": dups}
+
+    def breakers_closed_invariant(self) -> dict:
+        """An open (or half-open) breaker means a sink is DOWN — the
+        verdict must not read green while one is tripped, even if every
+        SLO window has since washed clean."""
+        open_ = {
+            name: lane.breaker.state
+            for name, lane in self._lanes.items()
+            if lane.breaker.state != "closed"
+        }
+        return {"ok": not open_, "open": open_}
+
     def snapshot(self) -> dict:
         """The /healthz ``delivery`` section: per-sink queue/breaker/
         counter state plus WAL occupancy. Attribute reads only — safe
@@ -808,13 +1026,20 @@ class DeliveryPlane:
                 "path": str(self.wal.path),
                 "puts": self.wal.puts,
                 "acks": self.wal.acks,
+                "dup_acks": self.wal.dup_acks,
                 "unacked": self.wal.unacked_count(),
                 "compactions": self.wal.compactions,
                 "replayed_at_boot": self.wal_replayed,
             }
-        return {
+        out = {
             "enabled": True,
             "started": self.started,
             "sinks": self._sink_counts(),
             "wal": wal,
+            "watermarks": self.watermarks(),
         }
+        if self.health is not None and getattr(
+            self.health, "enabled", False
+        ):
+            out["lag"] = self.health.snapshot()
+        return out

@@ -513,13 +513,34 @@ class SignalEngine:
             slow_ms=float(getattr(config, "trace_slow_ms", 50.0)),
             ring=int(getattr(config, "trace_ring", 256)),
         )
+        # unified SLO registry + verdict plane (ISSUE 16): every plane's
+        # SLO (freshness, staleness, per-sink delivery) judged behind one
+        # burn/recover event model, plus the delivery/fan-out invariant
+        # probes — folded into one machine-readable verdict at
+        # GET /debug/slo. Observation-driven: the owning monitors feed it
+        # from their existing paths; no per-tick dispatch of its own.
+        self.slo = None
+        if bool(getattr(config, "slo_enabled", False)):
+            from binquant_tpu.obs.slo import SloRegistry
+
+            self.slo = SloRegistry(
+                event_every=int(_knob(config, "slo_event_every", 256)),
+            )
         # latency observatory (ISSUE 11): candle-close→sink-ack freshness
         # stamps + the shared host-phase dwell taxonomy (obs/latency.py).
         # Host-only instruments — the device wire is untouched either way.
         self.freshness = FreshnessTracker(
             enabled=bool(getattr(config, "freshness_enabled", True)),
             slo_ms=float(getattr(config, "freshness_slo_ms", 0.0) or 0.0),
+            slo=self.slo,
         )
+        if (
+            self.slo is not None
+            and self.freshness.enabled
+            and self.freshness.slo_ms > 0
+        ):
+            # the PR 11 freshness SLO, re-homed into the unified registry
+            self.slo.register("freshness", "freshness", self.freshness.slo_ms)
         self.host_phase = PhaseAccountant(
             enabled=bool(getattr(config, "host_phase_enabled", True))
         )
@@ -553,12 +574,19 @@ class SignalEngine:
                 outbox_cap=int(_knob(config, "fanout_outbox_cap", 4096)),
                 conn_queue_max=int(_knob(config, "fanout_conn_queue", 256)),
             )
+            if self.slo is not None:
+                # PR 14 recipient-set integrity as a verdict invariant
+                self.slo.add_invariant(
+                    "fanout_recipient_set",
+                    self.fanout.recipient_set_invariant,
+                )
         # durable signal delivery plane (ISSUE 13): finalize enqueues and
         # returns; per-sink workers own retries/backoff/breakers, and the
         # autotrade class is WAL-durable at-least-once across a process
         # kill. BQT_DELIVERY=0 (the tier-1 lane's default) keeps the
         # pre-plane inline sink dispatch byte-identical.
         self.delivery = None
+        self.delivery_health = None
         if bool(getattr(config, "delivery_enabled", False)):
             from binquant_tpu.io.delivery import DeliveryPlane
             from binquant_tpu.io.emission import make_signal_sinks
@@ -573,6 +601,19 @@ class SignalEngine:
                 from binquant_tpu.fanout.plane import FanoutSink
 
                 sinks.append(FanoutSink(self.fanout))
+            # delivery-plane health collector (ISSUE 16): per-sink
+            # close→final-ack lag + the lazily-minted delivery.<sink>
+            # SLOs; disabled instances are allocation-free on the ack path
+            from binquant_tpu.obs.delivery_health import DeliveryHealth
+
+            self.delivery_health = DeliveryHealth(
+                enabled=bool(
+                    getattr(config, "delivery_health_enabled", False)
+                ),
+                window=int(_knob(config, "slo_window", 512)),
+                slo=self.slo,
+                slo_ms=float(_knob(config, "delivery_slo_ms", 0.0)),
+            )
             self.delivery = DeliveryPlane(
                 sinks=sinks,
                 wal_path=getattr(config, "delivery_wal_path", "") or None,
@@ -593,7 +634,23 @@ class SignalEngine:
                 ),
                 wal_compact_every=int(_knob(config, "wal_compact_every", 256)),
                 freshness=self.freshness,
+                health=self.delivery_health,
             )
+            if self.slo is not None:
+                # PR 13 zero-loss/zero-duplicate contracts + breaker
+                # state as verdict invariants (no false green while a
+                # sink is down)
+                self.slo.add_invariant(
+                    "delivery_zero_loss", self.delivery.zero_loss_invariant
+                )
+                self.slo.add_invariant(
+                    "delivery_zero_duplicate",
+                    self.delivery.zero_duplicate_invariant,
+                )
+                self.slo.add_invariant(
+                    "delivery_breakers_closed",
+                    self.delivery.breakers_closed_invariant,
+                )
         # tick_seq source for traces: advances on every dispatch ATTEMPT
         # (ticks_processed only counts successes — deriving the seq from
         # it would hand a failed tick's number to the retry, and tick_seq
@@ -748,7 +805,16 @@ class SignalEngine:
             enabled=self.ingest_digest,
             stale_budget=int(getattr(config, "ingest_stale_budget", 0) or 0),
             event_every=self.carry_audit_every or 256,
+            slo=self.slo,
         )
+        if self.slo is not None and self.ingest_monitor.enabled:
+            # the PR 15 staleness SLO, re-homed into the unified registry
+            self.slo.register(
+                "staleness",
+                "staleness",
+                float(self.ingest_monitor.stale_budget),
+                unit="rows",
+            )
         # device-side (8,) accumulator of the current tick's fold-slot
         # ingest counts (counted fold steps) — consumed (and reset) by the
         # next evaluated dispatch; a cached zeros array keeps the dispatch
@@ -3439,6 +3505,14 @@ class SignalEngine:
                 self.fanout.snapshot()
                 if self.fanout is not None
                 else {"enabled": False}
+            ),
+            # unified SLO verdict plane (ISSUE 16): every registered
+            # SLO's burn state + invariant probes folded to one ok —
+            # the full payload is GET /debug/slo
+            "slo": (
+                self.slo.verdict()
+                if self.slo is not None
+                else {"enabled": False, "ok": None}
             ),
         }
 

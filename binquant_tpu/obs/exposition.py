@@ -29,7 +29,12 @@ client) that serves:
 * ``GET /debug/symbols?offset=&limit=&prefix=&min_score=`` — the ingest
   monitor's paginated worst-first per-symbol stream-health scoreboard
   (health score, staleness ages, gap/rewrite/out-of-order/churn counts,
-  watermarks). Read-only — served to any peer like ``/metrics``.
+  watermarks). Read-only — served to any peer like ``/metrics``;
+* ``GET /debug/slo`` — the unified SLO verdict plane (ISSUE 16): every
+  registered SLO's burn state + every invariant probe folded into one
+  machine-readable pass/fail JSON
+  (:meth:`binquant_tpu.obs.slo.SloRegistry.snapshot`). Read-only —
+  served to any peer like ``/metrics``.
 
 Started from ``main.py`` when ``BQT_METRICS_PORT`` is set; ``port=0``
 binds an ephemeral port (tests), reported by :meth:`MetricsServer.start`.
@@ -118,6 +123,7 @@ class MetricsServer:
         profile_remote_ok: bool = False,
         ledger=None,
         ingest=None,
+        slo=None,
     ) -> None:
         self.registry = registry if registry is not None else REGISTRY
         self.health_fn = health_fn
@@ -131,6 +137,8 @@ class MetricsServer:
         # the engine's IngestHealthMonitor (GET /debug/symbols); None
         # keeps the route answering with a JSON not-configured no-op
         self.ingest = ingest
+        # the engine's SloRegistry (GET /debug/slo); same no-op contract
+        self.slo = slo
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -166,6 +174,8 @@ class MetricsServer:
             return self._route_profile(query, peer)
         if path == "/debug/symbols":
             return self._route_symbols(query)
+        if path == "/debug/slo":
+            return self._route_slo()
         if path == "/debug/executables":
             # read-only like /metrics; snapshot() is attribute reads under
             # a lock, safe inline on the event loop
@@ -240,6 +250,32 @@ class MetricsServer:
             return self._respond(
                 500, "Internal Server Error", "application/json",
                 json.dumps({"error": "symbols_report_failed"}),
+            )
+        return self._respond(
+            200, "OK", "application/json", json.dumps(payload)
+        )
+
+    def _route_slo(self) -> bytes:
+        """``/debug/slo`` — the unified verdict (ISSUE 16): SLO burn
+        states + invariant probes folded to one top-level ``ok``.
+        Read-only, served to any peer like ``/metrics``. A crashed
+        snapshot is a 500 — the judging surface must never read as
+        passing by accident."""
+        if self.slo is None or not getattr(self.slo, "enabled", False):
+            return self._respond(
+                200, "OK", "application/json",
+                json.dumps(
+                    {"enabled": False, "ok": None,
+                     "slos": {}, "invariants": {}}
+                ),
+            )
+        try:
+            payload = self.slo.snapshot()
+        except Exception:
+            log.exception("slo snapshot crashed")
+            return self._respond(
+                500, "Internal Server Error", "application/json",
+                json.dumps({"error": "slo_snapshot_failed"}),
             )
         return self._respond(
             200, "OK", "application/json", json.dumps(payload)

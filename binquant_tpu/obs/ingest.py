@@ -66,10 +66,16 @@ class IngestHealthMonitor:
         enabled: bool = True,
         stale_budget: int = 0,
         event_every: int = 256,
+        slo=None,
     ) -> None:
         self.registry = registry
         self.enabled = bool(enabled)
         self.stale_budget = int(stale_budget)
+        # the unified SloRegistry (ISSUE 16): the PR 15 staleness SLO
+        # re-homed — each digest also feeds the "staleness" SLO's
+        # burn/recover model; ingest_anomaly/ingest_recovered events keep
+        # firing untouched
+        self.slo = slo
         self.event_every = max(int(event_every), 1)
         cap = registry.capacity
         # per-row counters + watermarks (the row→symbol mapping is the
@@ -275,6 +281,13 @@ class IngestHealthMonitor:
                     ).inc(sect[field])
 
         burning = digest["stale_total"] > self.stale_budget
+        if self.slo is not None:
+            self.slo.observe(
+                "staleness",
+                ok=not burning,
+                stale_rows=digest["stale_total"],
+                budget=self.stale_budget,
+            )
         if burning:
             self.anomaly_ticks += 1
             self._burn_ticks += 1

@@ -547,3 +547,74 @@ INGEST_OOO = REGISTRY.counter(
     "mid-history corrections/drops).",
     labels=("interval",),
 )
+
+# -- delivery-plane observatory + unified SLO plane (ISSUE 16) ---------------
+
+DELIVERY_LAG = REGISTRY.histogram(
+    "bqt_delivery_lag_ms",
+    "End-to-end delivery lag per sink: candle close to the sink's FINAL "
+    "successful ack (queue dwell + every retry/backoff included; "
+    "WAL-replayed entries carry their original close anchor across the "
+    "process kill). bqt_sink_delivery_ms predates the plane and keeps "
+    "its freshness-gated semantics; this family is the ack-side truth.",
+    labels=("sink",),
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             2500.0, 10000.0, 60000.0),
+)
+DELIVERY_BREAKER_STATE = REGISTRY.gauge(
+    "bqt_delivery_breaker_state",
+    "Current circuit-breaker state per sink (0=closed, 1=half_open, "
+    "2=open) — the level companion to the "
+    "bqt_delivery_breaker_transitions_total edge counter.",
+    labels=("sink",),
+)
+DELIVERY_OLDEST_AGE = REGISTRY.gauge(
+    "bqt_delivery_oldest_unacked_ms",
+    "Age of the oldest unacked WAL record per at-least-once sink (wall "
+    "clock since its put) — the outbox watermark: sustained growth means "
+    "the head of the backlog is not moving.",
+    labels=("sink",),
+)
+DELIVERY_CURSOR_LAG = REGISTRY.gauge(
+    "bqt_delivery_cursor_lag",
+    "Records behind head per consumer group: the three sink workers "
+    "(queued + inflight + WAL-deferred entries not yet acked) and the "
+    "fan-out hub as a fourth group (broadcast frames the laggiest open "
+    "connection has not received).",
+    labels=("group",),
+)
+FANOUT_CONN_QUEUE_DEPTH = REGISTRY.histogram(
+    "bqt_fanout_conn_queue_depth",
+    "Per-connection frame-queue occupancy sampled at every broadcast "
+    "offer — the distribution (not just the max) of how far behind the "
+    "hub's consumers run.",
+    buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+)
+FANOUT_WRITE_LATENCY = REGISTRY.histogram(
+    "bqt_fanout_write_latency_ms",
+    "Subscriber match→socket-write latency per transport: the device "
+    "match dispatch that selected the recipient to the frame leaving "
+    "for that connection's socket.",
+    labels=("transport",),
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+             1000.0, 5000.0),
+)
+SLO_BURNING = REGISTRY.gauge(
+    "bqt_slo_burning",
+    "Whether the named SLO is currently burning (1) or clean (0) in the "
+    "unified registry (obs/slo.py) — freshness / staleness / "
+    "delivery.<sink>.",
+    labels=("slo",),
+)
+SLO_BREACHES = REGISTRY.counter(
+    "bqt_slo_breaches_total",
+    "Failing observations per registered SLO (burn entry force-emits an "
+    "slo_burn event; re-emits ride the BQT_SLO_EVENT_EVERY cadence).",
+    labels=("slo",),
+)
+SLO_RECOVERIES = REGISTRY.counter(
+    "bqt_slo_recoveries_total",
+    "Burn→clean transitions per registered SLO (each emits an "
+    "slo_recover event carrying the burn length).",
+    labels=("slo",),
+)

@@ -74,10 +74,17 @@ FRESHNESS_STAGES = (
 class FreshnessTracker:
     """Candle-close→sink-ack freshness accounting for one engine."""
 
-    def __init__(self, enabled: bool = True, slo_ms: float = 0.0) -> None:
+    def __init__(
+        self, enabled: bool = True, slo_ms: float = 0.0, slo=None
+    ) -> None:
         self.enabled = bool(enabled)
         # 0 disables the breach check (stamps still record when enabled)
         self.slo_ms = max(float(slo_ms), 0.0)
+        # the unified SloRegistry (ISSUE 16): the PR 11 freshness SLO
+        # re-homed — every observation also feeds the "freshness" SLO's
+        # burn/recover model; the breach event below keeps firing
+        # untouched
+        self.slo = slo
         self.signals = 0
         self.breaches = 0
         # last observed value per stage (healthz introspection)
@@ -116,7 +123,16 @@ class FreshnessTracker:
             SINK_DELIVERY.labels(sink=sink).observe(ms)
             worst = max(worst, ms)
         self.observe_stage("close_to_sink_ack", worst)
-        if self.slo_ms > 0 and worst >= self.slo_ms:
+        breached = self.slo_ms > 0 and worst >= self.slo_ms
+        if self.slo is not None and self.slo_ms > 0:
+            self.slo.observe(
+                "freshness",
+                ok=not breached,
+                worst_ms=round(worst, 3),
+                strategy=strategy,
+                symbol=symbol,
+            )
+        if breached:
             self.breaches += 1
             FRESHNESS_SLO_BREACHES.inc()
             get_event_log().emit(

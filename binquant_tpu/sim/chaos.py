@@ -425,13 +425,21 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
       silent);
     * finalize's ``emit`` host-phase dwell stayed bounded while the sink
       burned orders of magnitude more wall time (the tick thread never
-      blocks on a sink).
+      blocks on a sink);
+    * (ISSUE 16) the unified SLO plane judged the storm: the
+      delivery.autotrade SLO emitted ``slo_burn`` during the 5xx storm
+      and ``slo_recover`` after the post-restore clean soak, the
+      mid-storm ``slo_verdict()`` read NOT-ok while the breaker was open
+      (no false green), the post-recovery verdict read ok, and the
+      close→ack lag histograms populated for every sink.
     """
     import tempfile
     from pathlib import Path
 
     from binquant_tpu.io.checkpoint import load_state, save_state
     from binquant_tpu.io.replay import make_stub_engine, tick_seq
+    from binquant_tpu.obs.events import get_event_log
+    from binquant_tpu.obs.slo import slo_verdict
     from binquant_tpu.sim.scenarios import (
         Scenario,
         ScenarioSpec,
@@ -479,6 +487,19 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
         delivery_breaker_threshold=2,
         delivery_breaker_cooldown_s=0.05,
         wal_compact_every=0,  # the kill must find an uncompacted WAL
+        # unified SLO plane (ISSUE 16): judge the storm live. Tiny p99
+        # window so the post-restore clean soak deterministically washes
+        # the storm's lags back under budget (nearest-rank p99 over 4
+        # samples is the window max — one retained storm lag pins it)
+        slo_enabled=True,
+        delivery_health_enabled=True,
+        delivery_slo_ms=25.0,
+        slo_window=4,
+        slo_event_every=4,
+        # keep the verdict scoped to the delivery plane: a synthetic
+        # replay stream must not fold ingest staleness into the
+        # ok-after-recovery assertion
+        ingest_stale_budget=10_000,
     )
 
     def build(wal: Path):
@@ -556,11 +577,18 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
                     ts_ms=0,
                 )
             )
-        # give the breaker script room to complete its scripted cycle
+        # give the breaker script room to complete its scripted cycle,
+        # and catch one slo_verdict() WHILE the breaker is open (the
+        # storm keeps failing the still-unacked WAL entries, so the
+        # breaker re-opens after the scripted close) — the no-false-green
+        # probe (ISSUE 16)
         deadline = time.monotonic() + 8.0
         breaker = victim.delivery.breaker("autotrade")
+        storm_verdict: dict | None = None
         while time.monotonic() < deadline:
-            if len(breaker.transitions) >= 5:
+            if storm_verdict is None and breaker.state == "open":
+                storm_verdict = slo_verdict(victim.slo)
+            if len(breaker.transitions) >= 5 and storm_verdict is not None:
                 break
             await asyncio.sleep(0.01)
         # HARD KILL: cancel the workers mid-flight — no drain, no ack
@@ -580,6 +608,7 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
         victim.delivery.wal.close()
         return {
             "breaker_transitions": list(breaker.transitions),
+            "storm_verdict": storm_verdict,
             "analytics_shed": dict(
                 victim.delivery.lane("analytics").shed
             ),
@@ -594,32 +623,64 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
             ),
         }
 
-    victim_facts = asyncio.run(run_victim())
-    victim_keys = {_autotrade_key(p) for p in at_victim.delivered}
-    from binquant_tpu.io.delivery import DeliveryWal
+    # tap slo_burn/slo_recover off the event-log emit path (works even
+    # with the process log disabled, and without redirecting it away from
+    # a smoke run's BQT_EVENT_LOG file) — the same monkeypatch idiom as
+    # the fanout drill's on_fired spy
+    slo_events: list[dict] = []
+    _evlog = get_event_log()
+    _orig_emit = _evlog.emit
 
-    wal_probe = DeliveryWal(wal_path, fsync=False, compact_every=0)
-    unacked_at_kill = len(wal_probe.unacked())
-    wal_probe.close()
-    ckpt = workdir / "victim.ckpt.npz"
-    save_state(ckpt, victim.state, victim.registry, victim.host_carries())
+    def _tap_emit(event: str, **fields):
+        if event in ("slo_burn", "slo_recover"):
+            slo_events.append({"event": event, **fields})
+        return _orig_emit(event, **fields)
 
-    # -- restore: same WAL, healthy sink; replay then the stream tail --------
-    resumed = build(wal_path)
-    at_resumed = FlakySink(resumed.delivery.lane("autotrade").sink)
-    resumed.delivery.lane("autotrade").sink = at_resumed
-    state, carries = load_state(ckpt, resumed.state, resumed.registry)
-    resumed.state = state
-    resumed.restore_host_carries(carries)
-    resumed.note_state_restored(
-        migrated=bool(carries.get("_carry_rebuilt", False))
-    )
+    _evlog.emit = _tap_emit  # type: ignore[method-assign]
+    after_verdict: dict = {}
+    try:
+        victim_facts = asyncio.run(run_victim())
+        victim_keys = {_autotrade_key(p) for p in at_victim.delivered}
+        from binquant_tpu.io.delivery import DeliveryWal
 
-    async def run_resumed() -> None:
-        await drive(resumed, seq[split:])
-        await resumed.delivery.aclose(drain_s=10.0)
+        wal_probe = DeliveryWal(wal_path, fsync=False, compact_every=0)
+        unacked_at_kill = len(wal_probe.unacked())
+        wal_probe.close()
+        ckpt = workdir / "victim.ckpt.npz"
+        save_state(ckpt, victim.state, victim.registry, victim.host_carries())
 
-    asyncio.run(run_resumed())
+        # -- restore: same WAL, healthy sink; replay then the stream tail ----
+        resumed = build(wal_path)
+        at_resumed = FlakySink(resumed.delivery.lane("autotrade").sink)
+        resumed.delivery.lane("autotrade").sink = at_resumed
+        state, carries = load_state(ckpt, resumed.state, resumed.registry)
+        resumed.state = state
+        resumed.restore_host_carries(carries)
+        resumed.note_state_restored(
+            migrated=bool(carries.get("_carry_rebuilt", False))
+        )
+
+        async def run_resumed() -> None:
+            await drive(resumed, seq[split:])
+            await resumed.delivery.drain(timeout_s=10.0)
+            # post-storm clean soak THROUGH the collector path: replayed
+            # entries report their true cross-kill lag (seconds — they
+            # keep the delivery.autotrade SLO burning), so wash the tiny
+            # p99 window with in-budget acks to drive the recover edge
+            # deterministically (pulse 2 may deliver fewer fresh acks
+            # than the window holds). Every lane is washed: an event-loop
+            # stall (a jit compile mid-drive) can push ANY lane's queue
+            # dwell past the drill budget, and the final-verdict check
+            # is about the recover edge, not residual stall lag
+            for sink in ("autotrade", "telegram", "analytics"):
+                for _ in range(resumed.delivery_health.window):
+                    resumed.delivery_health.on_ack(sink, 1.0)
+            after_verdict.update(slo_verdict(resumed.slo))
+            await resumed.delivery.aclose(drain_s=10.0)
+
+        asyncio.run(run_resumed())
+    finally:
+        _evlog.emit = _orig_emit  # type: ignore[method-assign]
     resumed_keys = {_autotrade_key(p) for p in at_resumed.delivered}
 
     delivered = [
@@ -640,6 +701,18 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
         "analytics_shed": victim_facts["analytics_shed"],
         "emit_ms": round(victim_facts["emit_ms"], 3),
         "sink_wall_ms": round(victim_facts["sink_wall_ms"], 1),
+        "slo_burns": sum(
+            1 for e in slo_events if e["event"] == "slo_burn"
+        ),
+        "slo_recovers": sum(
+            1 for e in slo_events if e["event"] == "slo_recover"
+        ),
+        "storm_verdict_ok": (victim_facts["storm_verdict"] or {}).get("ok"),
+        "after_verdict_ok": after_verdict.get("ok"),
+        "lag_sinks": sorted(
+            set(victim.delivery_health.snapshot()["sinks"])
+            | set(resumed.delivery_health.snapshot()["sinks"])
+        ),
     }
     checks = {
         "zero_autotrade_loss": facts["lost_autotrade"] == 0
@@ -657,10 +730,61 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
         # the tick thread enqueues; the sinks burn wall time elsewhere
         "emit_dwell_bounded": facts["emit_ms"]
         < max(0.1 * facts["sink_wall_ms"], 250.0),
+        # unified SLO plane (ISSUE 16): the storm burned the autotrade
+        # delivery SLO, the clean soak recovered it, and the burn
+        # preceded the recover
+        "slo_burn_then_recover": _burn_then_recover(
+            slo_events, "delivery.autotrade"
+        ),
+        # no false green: the verdict caught mid-storm (breaker open)
+        # read NOT-ok, with the breaker invariant naming the sink
+        "no_false_green_breaker_open": (
+            (victim_facts["storm_verdict"] or {}).get("ok") is False
+            and not (victim_facts["storm_verdict"] or {})
+            .get("invariants", {})
+            .get("delivery_breakers_closed", {})
+            .get("ok", True)
+        ),
+        # ...and the post-recovery verdict folds back to one green ok
+        "verdict_ok_after_recovery": after_verdict.get("enabled") is True
+        and after_verdict.get("ok") is True,
+        # close→ack lag histograms populated for every sink in the drill
+        "lag_histograms_per_sink": {
+            "autotrade",
+            "telegram",
+            "analytics",
+        }
+        <= set(facts["lag_sinks"]),
     }
     facts["checks"] = checks
     facts["ok"] = all(checks.values())
     return facts
+
+
+def _burn_then_recover(slo_events: list[dict], slo_name: str) -> bool:
+    """True when ``slo_name`` emitted a burn AND a later recover — the
+    ISSUE-16 drill contract for the burn→recover event sequence."""
+    burn_at = next(
+        (
+            i
+            for i, e in enumerate(slo_events)
+            if e["event"] == "slo_burn" and e.get("slo") == slo_name
+        ),
+        None,
+    )
+    recover_at = next(
+        (
+            i
+            for i, e in enumerate(slo_events)
+            if e["event"] == "slo_recover" and e.get("slo") == slo_name
+        ),
+        None,
+    )
+    return (
+        burn_at is not None
+        and recover_at is not None
+        and burn_at < recover_at
+    )
 
 
 def fanout_chaos_drill(workdir: str | None = None) -> dict:
@@ -682,7 +806,13 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
     * the autotrade consumer group is unaffected: delivered set == the
       fanout-off oracle run's, zero loss, zero duplicates;
     * a reconnect presenting a cursor replays the stalled consumer's
-      whole gap from the broadcast outbox.
+      whole gap from the broadcast outbox;
+    * (ISSUE 16) the unified SLO plane judged the wedge: the hub's
+      cursor-lag watermark caught the sloth's backlog, a slow-ack probe
+      through the delivery-health collector burned the delivery.fanout
+      SLO (verdict NOT-ok while burning — no false green), the
+      post-replay clean soak recovered it, and the final verdict folded
+      back to one green ok with the recipient-set invariant passing.
     """
     import tempfile
     from pathlib import Path
@@ -690,6 +820,8 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
     from binquant_tpu.fanout.hub import _Connection, ws_read_frame
     from binquant_tpu.fanout.registry import Subscription
     from binquant_tpu.io.replay import make_stub_engine, tick_seq
+    from binquant_tpu.obs.events import get_event_log
+    from binquant_tpu.obs.slo import slo_verdict
     from binquant_tpu.sim.scenarios import (
         Scenario,
         ScenarioSpec,
@@ -740,7 +872,18 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
             host_phase=True,
             delivery=True,
             delivery_wal=str(wal),
-            delivery_overrides={"delivery_backoff_s": 0.005},
+            delivery_overrides={
+                "delivery_backoff_s": 0.005,
+                # unified SLO plane (ISSUE 16): same drill-scale knobs
+                # as delivery_chaos_drill (tiny p99 window so the clean
+                # soak deterministically recovers the burned SLO)
+                "slo_enabled": True,
+                "delivery_health_enabled": True,
+                "delivery_slo_ms": 25.0,
+                "slo_window": 4,
+                "slo_event_every": 4,
+                "ingest_stale_budget": 10_000,
+            },
             fanout=fanout,
             fanout_overrides=(
                 # small slot capacity so the churn storm forces plane
@@ -922,6 +1065,16 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
             and len(watcher_frames) < plane.published
         ):
             await asyncio.sleep(0.02)
+        # the cursor-lag watermark must catch the wedge WHILE the sloth
+        # is still registered: its never-drained 2-slot queue is the
+        # hub's laggiest consumer (ISSUE 16)
+        facts["wedged_cursor_lag"] = plane.hub.cursor_lag()
+        # wedge-period SLO probe through the delivery-health collector:
+        # four over-budget fanout acks burn the delivery.fanout SLO, and
+        # the verdict must read NOT-ok while it burns (no false green)
+        for _ in range(subject.delivery_health.window):
+            subject.delivery_health.on_ack("fanout", 500.0)
+        facts["wedged_verdict_ok"] = slo_verdict(subject.slo).get("ok")
         plane.hub._conns.discard(sloth)
 
         # reconnect-with-cursor: the sloth's gap replays from the outbox
@@ -957,6 +1110,16 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
         writer.close()
         w2.close()
         watch_task.cancel()
+        # post-replay clean soak: in-budget acks wash the tiny p99
+        # window and fire the recover edge; the final verdict must fold
+        # back to green with the recipient-set invariant passing. Every
+        # lane is washed — an event-loop stall (the flash mob's plane
+        # recompile) can push any lane's queue dwell past the drill
+        # budget, and this check is about the recover edge
+        for sink in ("autotrade", "telegram", "analytics", "fanout"):
+            for _ in range(subject.delivery_health.window):
+                subject.delivery_health.on_ack(sink, 1.0)
+        facts["final_verdict"] = slo_verdict(subject.slo)
         await subject.delivery.aclose(drain_s=5.0)
         await subject.aclose_fanout()
         facts["tick_p99_ms"] = float(np.percentile(tick_ms_list, 99))
@@ -966,7 +1129,22 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
         facts["sloth_dropped"] = sloth.dropped
         facts["sloth_gapped"] = sloth.gapped
 
-    asyncio.run(run_subject())
+    # tap slo_burn/slo_recover off the emit path (same idiom as the
+    # delivery drill — works with the process event log disabled)
+    slo_events: list[dict] = []
+    _evlog = get_event_log()
+    _orig_emit = _evlog.emit
+
+    def _tap_emit(event: str, **fields):
+        if event in ("slo_burn", "slo_recover"):
+            slo_events.append({"event": event, **fields})
+        return _orig_emit(event, **fields)
+
+    _evlog.emit = _tap_emit  # type: ignore[method-assign]
+    try:
+        asyncio.run(run_subject())
+    finally:
+        _evlog.emit = _orig_emit  # type: ignore[method-assign]
     subject_keys = {_autotrade_key(p) for p in at_subject.delivered}
     delivered = [_autotrade_key(p) for p in at_subject.delivered]
     watcher_seqs = sorted(f["seq"] for f in watcher_frames)
@@ -1018,6 +1196,27 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
         # reconnect-with-cursor replays the whole gap from the outbox
         "cursor_replayed_gap": facts["sloth_gap_replayed"]
         and facts["sloth_addressed"] > 0,
+        # unified SLO plane (ISSUE 16): the hub's cursor-lag watermark
+        # caught the sloth's wedged backlog (its 2-slot queue full)
+        "cursor_lag_caught_wedge": facts.get("wedged_cursor_lag", 0) >= 2,
+        # the wedge-period probe burned delivery.fanout and the
+        # post-replay soak recovered it, in that order
+        "slo_burn_then_recover": _burn_then_recover(
+            slo_events, "delivery.fanout"
+        ),
+        # no false green while the SLO burned...
+        "no_false_green_while_burning": facts.get("wedged_verdict_ok")
+        is False,
+        # ...and the final verdict folds back to one green ok with the
+        # recipient-set invariant passing
+        "verdict_ok_after_recovery": (
+            (facts.get("final_verdict") or {}).get("ok") is True
+            and (facts.get("final_verdict") or {})
+            .get("invariants", {})
+            .get("fanout_recipient_set", {})
+            .get("ok")
+            is True
+        ),
     }
     facts["checks"] = checks
     facts["ok"] = all(checks.values())

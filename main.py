@@ -205,6 +205,9 @@ async def run_live() -> None:
             # /debug/symbols: the ingest monitor's worst-first per-symbol
             # stream-health scoreboard (read-only, served like /metrics)
             ingest=engine.ingest_monitor,
+            # /debug/slo: the unified SLO verdict plane (ISSUE 16;
+            # read-only, served like /metrics)
+            slo=engine.slo,
         )
         await metrics_server.start()
 

@@ -88,6 +88,14 @@ os.environ.setdefault("BQT_DELIVERY", "0")
 # Production default stays ON (binquant_tpu/config.py); fanout coverage
 # opts in explicitly (tests/test_fanout.py via make_stub_engine(fanout=True)).
 os.environ.setdefault("BQT_FANOUT", "0")
+# Unified SLO plane + delivery health collector (ISSUE 16) default OFF
+# for the tier-1 lane, the same knob pattern: dozens of stub engines must
+# not each pay registry/ack-side bookkeeping, and several fixtures pin
+# pre-observatory /healthz and event shapes only additively. Production
+# defaults stay ON (binquant_tpu/config.py); SLO coverage opts in
+# explicitly (tests/test_slo.py and the chaos drills via overrides).
+os.environ.setdefault("BQT_SLO", "0")
+os.environ.setdefault("BQT_DELIVERY_HEALTH", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

@@ -1,0 +1,455 @@
+"""Unified SLO plane drills (ISSUE 16).
+
+Tier-1 keeps the cheap units: the registry's burn/recover hysteresis +
+event cadence, lazy vs re-parameterizing registration, verdict folding
+(including crashing invariant probes and the disabled ``ok: None``
+shape), the ``GET /debug/slo`` route, the delivery-health collector's
+p99 window + SLO feed, close→FINAL-ack lag accounting under
+retry/backoff, cursor-lag math across a WAL replay-at-boot and across
+the fan-out hub, and the golden-pinned slo_report / health_report
+renders. The slow lane (``make delivery-smoke`` / ``make scenarios``)
+adds the chaos drills asserting the burn→recover sequence and a sane
+verdict through a 5xx storm and a reconnect storm.
+"""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from binquant_tpu.io.delivery import (
+    AT_LEAST_ONCE,
+    LOSSY,
+    DeliveryPlane,
+    Envelope,
+)
+from binquant_tpu.obs.delivery_health import DeliveryHealth, _p99
+from binquant_tpu.obs.events import EventLog, set_event_log
+from binquant_tpu.obs.slo import SloRegistry, slo_verdict
+
+DISABLED_VERDICT = {"enabled": False, "ok": None, "slos": {}, "invariants": {}}
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    set_event_log(log)
+    yield path
+    log.close()
+    set_event_log(None)
+
+
+def _read_events(path) -> list[dict]:
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+class FakeSink:
+    """Scriptable SignalSink: fail the first ``fail_times`` attempts."""
+
+    def __init__(
+        self, name="analytics", policy=LOSSY, fail_times=0, latency_s=0.0
+    ):
+        self.name = name
+        self.policy = policy
+        self.fail_times = fail_times
+        self.latency_s = latency_s
+        self.attempts = 0
+        self.delivered = []
+
+    def encode(self, signal):
+        return {
+            "strategy": signal.strategy,
+            "symbol": signal.symbol,
+            "seq": getattr(signal, "tick_seq", 0),
+        }
+
+    def to_wal(self, payload):
+        return payload
+
+    def from_wal(self, data):
+        return data
+
+    async def deliver(self, payload):
+        self.attempts += 1
+        if self.latency_s:
+            await asyncio.sleep(self.latency_s)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("scripted sink failure")
+        self.delivered.append(payload)
+
+
+def make_plane(sinks, tmp_path=None, **kw):
+    kw.setdefault("queue_max", 8)
+    kw.setdefault("attempt_timeout_s", 1.0)
+    kw.setdefault("retry_max", 3)
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.005)
+    kw.setdefault("breaker_threshold", 10)
+    kw.setdefault("breaker_cooldown_s", 0.02)
+    kw.setdefault("wal_fsync", False)
+    if tmp_path is not None:
+        kw.setdefault("wal_path", tmp_path / "outbox.wal.jsonl")
+    return DeliveryPlane(sinks=sinks, **kw)
+
+
+def fake_signal(i=0, strategy="mrf"):
+    return SimpleNamespace(
+        strategy=strategy,
+        symbol=f"S{i:03d}USDT",
+        trace_id=f"trace{i}",
+        tick_seq=i,
+    )
+
+
+# -- registry hysteresis ------------------------------------------------------
+
+
+def test_registry_burn_recover_hysteresis(event_log):
+    reg = SloRegistry(event_every=3)
+    reg.register("freshness", "freshness", 100.0)
+    reg.observe("freshness", ok=True)
+    assert _read_events(event_log) == []
+
+    # burn ENTRY force-emits; the next two breaching obs stay silent
+    # until the cadence (burn_obs % 3 == 0) re-emits
+    for _ in range(4):
+        reg.observe("freshness", ok=False, worst_ms=250.0)
+    events = _read_events(event_log)
+    assert [e["event"] for e in events] == ["slo_burn", "slo_burn"]
+    assert events[0]["entering"] is True and events[0]["burn_obs"] == 1
+    assert events[1]["entering"] is False and events[1]["burn_obs"] == 3
+
+    # first clean observation: slo_recover with the burn length, state reset
+    reg.observe("freshness", ok=True)
+    events = _read_events(event_log)
+    assert events[-1]["event"] == "slo_recover"
+    assert events[-1]["burn_obs"] == 4
+    cell = reg.verdict()["slos"]["freshness"]
+    assert cell["ok"] is True and cell["burning"] is False
+    assert cell["breaches"] == 4 and cell["recoveries"] == 1
+    assert cell["burn_obs"] == 0
+
+    # re-entry is a NEW burn entry event (hysteresis, not a one-shot)
+    reg.observe("freshness", ok=False)
+    assert _read_events(event_log)[-1]["entering"] is True
+
+
+def test_register_reparameterizes_but_keeps_burn_state(event_log):
+    reg = SloRegistry()
+    reg.register("freshness", "freshness", 100.0)
+    reg.observe("freshness", ok=False)
+    assert reg.verdict()["slos"]["freshness"]["burning"] is True
+    # config reload: budget moves, the in-progress burn survives
+    cell = reg.register("freshness", "freshness", 200.0)
+    assert cell["budget"] == 200.0 and cell["burning"] is True
+    # ensure() never re-parameterizes (lazy per-sink minting)
+    cell = reg.ensure("freshness", "freshness", 999.0)
+    assert cell["budget"] == 200.0
+    # unregistered observations are ignored, not minted
+    reg.observe("nonesuch", ok=False)
+    assert "nonesuch" not in reg.verdict()["slos"]
+
+
+def test_verdict_folding_and_invariants(event_log):
+    reg = SloRegistry()
+    reg.register("a", "freshness", 1.0)
+    reg.register("b", "delivery", 2.0)
+    reg.observe("a", ok=True)
+    reg.observe("b", ok=True)
+    reg.add_invariant("good", lambda: {"ok": True, "detail": 7})
+    assert reg.verdict()["ok"] is True
+
+    # one burning SLO flips the fold
+    reg.observe("b", ok=False)
+    v = reg.verdict()
+    assert v["ok"] is False and v["slos"]["b"]["ok"] is False
+
+    # a failing invariant flips it even with every SLO green
+    reg.observe("b", ok=True)
+    reg.add_invariant("bad", lambda: {"ok": False, "count": 3})
+    v = reg.verdict()
+    assert v["ok"] is False
+    assert v["invariants"]["bad"] == {"ok": False, "count": 3}
+    assert v["invariants"]["good"]["detail"] == 7
+
+    # a CRASHING probe reads failed, never green; bare truthy coerces
+    reg.add_invariant(
+        "crash", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    reg.add_invariant("bare", lambda: True)
+    inv = reg.invariants_report()
+    assert inv["crash"]["ok"] is False and "boom" in inv["crash"]["error"]
+    assert inv["bare"] == {"ok": True}
+    # a dict without ok defaults to failed (no accidental green)
+    reg.add_invariant("shapeless", lambda: {"count": 1})
+    assert reg.invariants_report()["shapeless"]["ok"] is False
+
+
+def test_disabled_registry_and_missing_registry_verdict(event_log):
+    reg = SloRegistry(enabled=False)
+    reg.register("a", "freshness", 1.0)
+    reg.observe("a", ok=False)
+    assert reg.verdict() == DISABLED_VERDICT
+    assert _read_events(event_log) == []
+    assert slo_verdict(None) == DISABLED_VERDICT
+    assert slo_verdict(reg) == DISABLED_VERDICT
+
+
+# -- GET /debug/slo -----------------------------------------------------------
+
+
+def test_debug_slo_route(event_log):
+    from binquant_tpu.obs.exposition import MetricsServer
+
+    def get(server, target="/debug/slo"):
+        raw = server._route(target)
+        head, body = raw.split(b"\r\n\r\n", 1)
+        return head.decode().split()[1], json.loads(body)
+
+    # unconfigured: a JSON no-op at 200 — probes read disabled, not down
+    bare = MetricsServer(health_fn=lambda: {"status": "ok"})
+    status, payload = get(bare)
+    assert status == "200" and payload == DISABLED_VERDICT
+
+    reg = SloRegistry()
+    reg.register("freshness", "freshness", 100.0)
+    reg.observe("freshness", ok=False)
+    reg.add_invariant("zero_loss", lambda: {"ok": True})
+    server = MetricsServer(health_fn=lambda: {"status": "ok"}, slo=reg)
+    status, payload = get(server)
+    assert status == "200"
+    assert payload["enabled"] is True and payload["ok"] is False
+    assert payload["slos"]["freshness"]["burning"] is True
+    assert payload["invariants"]["zero_loss"]["ok"] is True
+    assert payload["event_every"] == reg.event_every
+
+    # a crashing snapshot must not read as success to probes
+    reg.snapshot = lambda: (_ for _ in ()).throw(RuntimeError())
+    status, payload = get(server)
+    assert status == "500" and payload == {"error": "slo_snapshot_failed"}
+
+
+# -- delivery-health collector ------------------------------------------------
+
+
+def test_p99_nearest_rank():
+    assert _p99([5.0]) == 5.0
+    assert _p99(list(range(1, 101))) == 99
+    assert _p99([1.0, 2.0, 3.0, 50.0]) == 50.0  # small window -> max
+
+
+def test_delivery_health_window_and_slo_feed(event_log):
+    reg = SloRegistry(event_every=1)
+    dh = DeliveryHealth(enabled=True, window=4, slo=reg, slo_ms=10.0)
+    for _ in range(4):
+        dh.on_ack("analytics", 2.0)
+    assert reg.verdict()["slos"]["delivery.analytics"]["ok"] is True
+
+    # one breaching lag pins the 4-sample p99 (= window max) over budget
+    dh.on_ack("analytics", 50.0, attempts=2)
+    v = reg.verdict()["slos"]["delivery.analytics"]
+    assert v["burning"] is True and v["last"]["p99_ms"] == 50.0
+    assert v["last"]["attempts"] == 2
+
+    # the breach washes out of the rolling window -> recover
+    for _ in range(4):
+        dh.on_ack("analytics", 1.0)
+    assert reg.verdict()["slos"]["delivery.analytics"]["ok"] is True
+    kinds = [e["event"] for e in _read_events(event_log)]
+    assert "slo_burn" in kinds and "slo_recover" in kinds
+
+    snap = dh.snapshot()
+    assert snap["sinks"]["analytics"]["acks"] == 9
+    assert snap["sinks"]["analytics"]["last_lag_ms"] == 1.0
+
+    # negative lag clamps (clock skew must not corrupt the window);
+    # disabled collectors are no-ops
+    dh.on_ack("analytics", -5.0)
+    assert dh.last_lag_ms["analytics"] == 0.0
+    off = DeliveryHealth(enabled=False, slo=reg, slo_ms=10.0)
+    off.on_ack("analytics", 1e9)
+    assert off.snapshot()["sinks"] == {}
+
+
+def test_lag_measured_to_final_ack_under_retry(tmp_path, event_log):
+    """Two scripted failures + backoff before the third attempt lands:
+    ONE on_ack per envelope, with the lag spanning every attempt — not
+    the first try's."""
+    at = FakeSink(
+        "autotrade", policy=AT_LEAST_ONCE, fail_times=2, latency_s=0.02
+    )
+    dh = DeliveryHealth(enabled=True, window=8)
+    plane = make_plane([at], tmp_path, health=dh)
+
+    async def go():
+        plane.start()
+        plane.enqueue_fired(fake_signal(0), tick_ms=1000)
+        assert await plane.drain(timeout_s=5.0)
+        await plane.aclose()
+
+    asyncio.run(go())
+    assert at.attempts == 3 and len(at.delivered) == 1
+    snap = dh.snapshot()["sinks"]["autotrade"]
+    assert snap["acks"] == 1  # final ack only, not one per attempt
+    # 3 attempts x 20ms sink latency (+ backoff) — first-attempt
+    # accounting would read ~20ms
+    assert snap["last_lag_ms"] >= 40.0
+    # per-attempt sink spans joined to the tick's trace rode the log
+    spans = [e for e in _read_events(event_log) if e["event"] == "sink_span"]
+    assert [s["attempt"] for s in spans] == [1, 2, 3]
+    assert {s["trace_id"] for s in spans} == {"trace0"}
+    assert [s["outcome"] for s in spans] == [
+        "ConnectionError", "ConnectionError", "ok",
+    ]
+
+
+# -- cursor lag ---------------------------------------------------------------
+
+
+def test_cursor_lag_across_replay_at_boot(tmp_path, event_log):
+    """Unacked WAL records from a killed process count behind head at
+    boot (queued + deferred), then drain to zero — and the replayed acks
+    report cross-process lag through the WAL wall-clock anchor."""
+    victim = make_plane(
+        [FakeSink("autotrade", policy=AT_LEAST_ONCE)], tmp_path
+    )
+    for i in range(3):
+        victim.enqueue(
+            Envelope(
+                entry_id=f"t{i}/{i}/mrf/S{i:03d}USDT",
+                sink="autotrade",
+                payload={"seq": i},
+                ts_ms=1000 + i,
+                lag0_ms=5.0,
+                trace_id=f"t{i}",
+            )
+        )
+    assert victim.watermarks()["autotrade"]["cursor_lag"] == 3
+    victim.wal.close()  # hard kill: nothing acked
+
+    # boot replay re-enqueues the backlog; probe the watermark BEFORE
+    # any worker runs (a separate never-started plane — start() would
+    # replay again)
+    probe = make_plane(
+        [FakeSink("autotrade", policy=AT_LEAST_ONCE)], tmp_path
+    )
+    probe._replay_wal()
+    marks = probe.watermarks()["autotrade"]
+    assert marks["cursor_lag"] == 3
+    assert marks["oldest_unacked_ms"] > 0.0
+    probe.wal.close()
+
+    at = FakeSink("autotrade", policy=AT_LEAST_ONCE)
+    dh = DeliveryHealth(enabled=True, window=8)
+    resumed = make_plane([at], tmp_path, health=dh)
+
+    async def go():
+        resumed.start()
+        assert await resumed.drain(timeout_s=5.0)
+        await resumed.aclose()
+
+    asyncio.run(go())
+    assert len(at.delivered) == 3
+    marks = resumed.watermarks()["autotrade"]
+    assert marks["cursor_lag"] == 0 and marks["oldest_unacked_ms"] == 0.0
+    # replayed acks carried the lag0 + wall-delta anchor (>= lag0, never
+    # the meaningless in-process perf_counter delta)
+    assert dh.snapshot()["sinks"]["autotrade"]["acks"] == 3
+    assert dh.last_lag_ms["autotrade"] >= 5.0
+
+
+def test_fanout_hub_cursor_lag_math():
+    from binquant_tpu.fanout.hub import FanoutHub, _Connection
+
+    hub = FanoutHub(slot_of=lambda u: None, conn_queue_max=4)
+    assert hub.cursor_lag() == 0  # no conns, no head
+
+    written = _Connection("u0", 0, "ws", 4)
+    written.last_seq = 6
+    fresh = _Connection("u1", 1, "ws", 4)  # connected, nothing written
+    fresh.queue.put_nowait((0, "{}", None))
+    fresh.queue.put_nowait((1, "{}", None))
+    hub._conns.update({written, fresh})
+    hub.head_seq = 10
+    # laggiest consumer wins: head - last_seq for writers, queued
+    # backlog for connections that have not written yet
+    assert hub.cursor_lag() == 4
+    written.last_seq = 1
+    assert hub.cursor_lag() == 9
+    assert hub.snapshot()["cursor_lag"] == 9
+
+
+# -- report goldens -----------------------------------------------------------
+
+
+SLO_EVENTS = [
+    {"event": "slo_burn", "slo": "delivery.autotrade", "kind": "delivery",
+     "budget": 25.0, "unit": "ms", "burn_obs": 1, "entering": True},
+    {"event": "slo_burn", "slo": "delivery.autotrade", "kind": "delivery",
+     "budget": 25.0, "unit": "ms", "burn_obs": 4, "entering": False},
+    {"event": "slo_recover", "slo": "delivery.autotrade",
+     "kind": "delivery", "burn_obs": 6},
+    {"event": "slo_burn", "slo": "staleness", "kind": "staleness",
+     "budget": 0.0, "unit": "rows", "burn_obs": 1, "entering": True},
+]
+
+
+def test_slo_report_golden(tmp_path):
+    from tools.slo_report import load_slo_events, render_report
+
+    log = tmp_path / "events.jsonl"
+    lines = [json.dumps(e) for e in SLO_EVENTS]
+    lines.insert(1, '{"torn')  # corrupt line skipped, not fatal
+    log.write_text("\n".join(lines) + "\n")
+    report = render_report(load_slo_events(log))
+    assert report == (
+        "burn     delivery.autotrade     kind=delivery budget=25.0ms\n"
+        "burning  delivery.autotrade     still breaching (obs 4)\n"
+        "recover  delivery.autotrade     after 6 breaching obs\n"
+        "burn     staleness              kind=staleness budget=0.0rows\n"
+        "\n"
+        "slo                    kind           budget  burns"
+        " recovers  longest  status\n"
+        "delivery.autotrade     delivery       25.0ms      1"
+        "        1        6  ok\n"
+        "staleness              staleness     0.0rows      1"
+        "        0        0  BURNING\n"
+        "verdict  BURNING (staleness)"
+    )
+    # the filter keeps only one SLO's history
+    filtered = render_report(load_slo_events(log), slo="delivery.autotrade")
+    assert "staleness" not in filtered
+    assert filtered.endswith("verdict  ok (1 slo clean at log tail)")
+
+
+def test_health_report_delivery_slo_section(tmp_path):
+    from tools.health_report import load_events, render, summarize
+
+    log = tmp_path / "events.jsonl"
+    records = [
+        {"event": "delivery_ack", "sink": "autotrade", "attempts": 2},
+        {"event": "delivery_ack", "sink": "telegram", "attempts": 1},
+        {"event": "delivery_shed", "sink": "analytics", "reason": "x"},
+        {"event": "delivery_breaker", "sink": "autotrade", "state": "open"},
+    ] + SLO_EVENTS
+    log.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    report = render(summarize(load_events(log)))
+    assert (
+        "== delivery / SLO ==\n"
+        "  acks autotrade=1 telegram=1  sheds 1  breaker_transitions 1\n"
+        "  slo delivery.autotrade     kind delivery   budget     25.0ms"
+        "  burns 1  recovers 1  status ok\n"
+        "  slo staleness              kind staleness  budget    0.0rows"
+        "  burns 1  recovers 0  status BURNING"
+    ) in report
+
+    # logs without delivery/SLO events render the section-free report
+    # byte-identically to the pre-ISSUE-16 format
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps({"event": "compile_summary"}) + "\n")
+    assert "delivery / SLO" not in render(summarize(load_events(bare)))

@@ -62,6 +62,10 @@ def summarize(events: list[dict]) -> dict:
     ingest_kind = None
     ingest_anomalies = 0
     ingest_recoveries = 0
+    slo: dict[str, dict] = {}
+    delivery_acks: dict[str, int] = {}
+    delivery_sheds = 0
+    breaker_transitions = 0
     for ev in events:
         kind = ev.get("event")
         if kind in ("numeric_digest", "numeric_anomaly") and "digest" in ev:
@@ -90,6 +94,32 @@ def summarize(events: list[dict]) -> dict:
             entry["cache"] = ev.get("cache", "unknown")
         elif kind == "compile_summary":
             summary = ev
+        elif kind in ("slo_burn", "slo_recover"):
+            cell = slo.setdefault(
+                ev.get("slo", "?"),
+                {
+                    "kind": ev.get("kind", "?"),
+                    "budget": ev.get("budget"),
+                    "unit": ev.get("unit", ""),
+                    "burns": 0,
+                    "recovers": 0,
+                    "burning": False,
+                },
+            )
+            if kind == "slo_burn":
+                cell["burning"] = True
+                if ev.get("entering"):
+                    cell["burns"] += 1
+            else:
+                cell["burning"] = False
+                cell["recovers"] += 1
+        elif kind == "delivery_ack":
+            name = ev.get("sink", "?")
+            delivery_acks[name] = delivery_acks.get(name, 0) + 1
+        elif kind == "delivery_shed":
+            delivery_sheds += 1
+        elif kind == "delivery_breaker":
+            breaker_transitions += 1
     return {
         "digest": digest,
         "digest_kind": digest_kind,
@@ -102,6 +132,10 @@ def summarize(events: list[dict]) -> dict:
         "ingest_kind": ingest_kind,
         "ingest_anomalies": ingest_anomalies,
         "ingest_recoveries": ingest_recoveries,
+        "slo": slo,
+        "delivery_acks": delivery_acks,
+        "delivery_sheds": delivery_sheds,
+        "breaker_transitions": breaker_transitions,
     }
 
 
@@ -178,6 +212,33 @@ def render(model: dict) -> str:
                 f"{_fmt(sect.get('max_age_s'))}s  covered "
                 f"{sect.get('covered', 0)}  min_bars "
                 f"{sect.get('min_bars', 0)}  fresh {sect.get('fresh', 0)}"
+            )
+    # delivery / SLO section (ISSUE 16) — rendered only when delivery or
+    # SLO events exist, so pre-observatory logs render byte-identically
+    if model.get("slo") or model.get("delivery_acks"):
+        lines.append("")
+        lines.append("== delivery / SLO ==")
+        acks = model.get("delivery_acks") or {}
+        if acks:
+            tally = " ".join(
+                f"{name}={acks[name]}" for name in sorted(acks)
+            )
+            lines.append(
+                f"  acks {tally}  sheds {model.get('delivery_sheds', 0)}  "
+                f"breaker_transitions {model.get('breaker_transitions', 0)}"
+            )
+        for name in sorted(model.get("slo") or {}):
+            cell = model["slo"][name]
+            budget = (
+                f"{cell['budget']}{cell['unit']}"
+                if cell.get("budget") is not None
+                else "?"
+            )
+            status = "BURNING" if cell.get("burning") else "ok"
+            lines.append(
+                f"  slo {name:<22} kind {cell.get('kind', '?'):<10} "
+                f"budget {budget:>10}  burns {cell['burns']}  "
+                f"recovers {cell['recovers']}  status {status}"
             )
     lines.append("")
     lines.append("== executable ledger ==")

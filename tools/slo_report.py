@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Render the SLO verdict + per-SLO burn history from the event log.
+
+The unified SLO registry (``binquant_tpu/obs/slo.py``) emits ``slo_burn``
+on burn entry (then at the sampling cadence while an outage sustains)
+and ``slo_recover`` with the burn length on the first clean observation.
+This tool reconstructs the burn/recover story — and the best verdict the
+log alone supports — without any service in the loop (golden-pinned like
+delivery_report — keep format changes deliberate):
+
+    python tools/slo_report.py /tmp/bqt_events.jsonl
+    python tools/slo_report.py events.jsonl --slo delivery.autotrade
+
+The live ``GET /debug/slo`` route is the authoritative verdict (it folds
+the in-process invariant probes too); this report is the post-mortem
+view — which SLOs burned, for how long, and whether the log ends with
+any still burning.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+SLO_EVENTS = ("slo_burn", "slo_recover")
+
+
+def load_slo_events(path: str | Path) -> list[dict]:
+    """All SLO events, in file order; corrupt lines (a torn write at
+    rotation) are skipped, not fatal."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") in SLO_EVENTS:
+                out.append(record)
+    return out
+
+
+def render_report(events: list[dict], slo: str | None = None) -> str:
+    """The deterministic report: a burn/recover timeline, then per-SLO
+    episode tallies and the log-tail verdict (BURNING when any SLO's
+    last event is a burn with no recover after it)."""
+    lines: list[str] = []
+    # name -> {"kind","budget","unit","burns","recovers","burn_obs_total",
+    #          "longest_burn","burning"}
+    tally: dict[str, dict] = {}
+    for e in events:
+        name = e.get("slo", "?")
+        if slo and name != slo:
+            continue
+        cell = tally.setdefault(
+            name,
+            {
+                "kind": e.get("kind", "?"),
+                "budget": e.get("budget"),
+                "unit": e.get("unit", ""),
+                "burns": 0,
+                "recovers": 0,
+                "burn_obs_total": 0,
+                "longest_burn": 0,
+                "burning": False,
+            },
+        )
+        if e.get("event") == "slo_burn":
+            cell["burning"] = True
+            if e.get("budget") is not None:
+                cell["budget"] = e["budget"]
+            if e.get("unit"):
+                cell["unit"] = e["unit"]
+            if e.get("entering"):
+                cell["burns"] += 1
+                lines.append(
+                    f"burn     {name:<22} kind={e.get('kind', '?')}"
+                    f" budget={e.get('budget')}{e.get('unit', '')}"
+                )
+            else:
+                lines.append(
+                    f"burning  {name:<22} still breaching"
+                    f" (obs {e.get('burn_obs', '?')})"
+                )
+        else:  # slo_recover
+            cell["burning"] = False
+            cell["recovers"] += 1
+            obs = int(e.get("burn_obs", 0) or 0)
+            cell["burn_obs_total"] += obs
+            cell["longest_burn"] = max(cell["longest_burn"], obs)
+            lines.append(
+                f"recover  {name:<22} after {obs} breaching obs"
+            )
+    if tally:
+        lines.append("")
+        lines.append(
+            f"{'slo':<22} {'kind':<10} {'budget':>10} {'burns':>6}"
+            f" {'recovers':>8} {'longest':>8}  status"
+        )
+        for name in sorted(tally):
+            cell = tally[name]
+            budget = (
+                f"{cell['budget']}{cell['unit']}"
+                if cell["budget"] is not None
+                else "?"
+            )
+            status = "BURNING" if cell["burning"] else "ok"
+            lines.append(
+                f"{name:<22} {cell['kind']:<10} {budget:>10}"
+                f" {cell['burns']:>6} {cell['recovers']:>8}"
+                f" {cell['longest_burn']:>8}  {status}"
+            )
+        burning = sorted(n for n, c in tally.items() if c["burning"])
+        lines.append(
+            "verdict  BURNING (" + ", ".join(burning) + ")"
+            if burning
+            else f"verdict  ok ({len(tally)} slo"
+            + ("s" if len(tally) != 1 else "")
+            + " clean at log tail)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", help="JSONL event log (BQT_EVENT_LOG file)")
+    parser.add_argument("--slo", help="render only this SLO's history")
+    args = parser.parse_args(argv)
+
+    events = load_slo_events(args.log)
+    if not events:
+        print(f"no slo events in {args.log}", file=sys.stderr)
+        return 1
+    print(render_report(events, slo=args.slo))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,13 @@ busy time, then the span's attributes — so a slow tick reads straight
 down from the dominant stage to the sink call (and, through
 ``trace_id``, across to the ``signal`` / ``autotrade_*`` / ``slow_tick``
 records carrying the same id).
+
+Since ISSUE 16 the delivery workers emit standalone ``sink_span``
+events (per-attempt sink call, joined by the trace_id riding the outbox
+WAL record) — when the log carries any for a rendered tick they are
+grafted below its span tree, extending the waterfall past enqueue to
+the sink ack. Logs without sink_span events render byte-identically to
+the pre-ISSUE-16 format.
 """
 
 from __future__ import annotations
@@ -43,15 +50,38 @@ def load_trace_events(path: str | Path) -> list[dict]:
     return out
 
 
+def load_sink_spans(path: str | Path) -> dict[str, list[dict]]:
+    """``sink_span`` events grouped by trace_id, in file order (which is
+    attempt order per worker — the grafted waterfall reads first attempt
+    to final ack top-down). Same torn-line tolerance as the trace
+    loader; an old log without sink spans returns an empty mapping."""
+    out: dict[str, list[dict]] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("event") == "sink_span" and record.get("trace_id"):
+                out.setdefault(record["trace_id"], []).append(record)
+    return out
+
+
 def _attr_str(attrs: dict | None) -> str:
     if not attrs:
         return ""
     return "  " + " ".join(f"{k}={v}" for k, v in attrs.items())
 
 
-def render_trace(event: dict) -> str:
+def render_trace(event: dict, sink_spans: list[dict] | None = None) -> str:
     """One trace event → a deterministic indented waterfall (pinned by
-    the golden test — keep format changes deliberate)."""
+    the golden test — keep format changes deliberate). ``sink_spans``
+    are this trace's delivery-side per-attempt events; when present they
+    graft below the tick's span tree (the tick's trace completed at
+    emit, so the workers' spans can only arrive as standalone events)."""
     busy = float(event.get("busy_ms") or 0.0)
     header = (
         f"trace {event['trace_id']}  tick {event['tick_seq']}  "
@@ -76,6 +106,19 @@ def render_trace(event: dict) -> str:
 
     for child in event["spans"].get("children", ()):
         walk(child, 1)
+    if sink_spans:
+        lines.append("  delivery (sink spans, enqueue -> ack):")
+        for s in sink_spans:
+            name = f"sink:{s.get('sink', '?')}#{s.get('attempt', '?')}"
+            outcome = s.get("outcome", "?")
+            mark = "" if outcome == "ok" else f" !{outcome}"
+            extra = f"  entry={s.get('entry_id')}"
+            if s.get("replayed"):
+                extra += " replayed"
+            lines.append(
+                f"    {name:<22} {float(s.get('ms') or 0.0):>9.3f}ms"
+                f"{mark}{extra}"
+            )
     return "\n".join(lines)
 
 
@@ -117,7 +160,13 @@ def main(argv: list[str] | None = None) -> int:
     else:
         chosen = [events[-1]]
 
-    print("\n\n".join(render_trace(e) for e in chosen))
+    spans_by_trace = load_sink_spans(args.log)
+    print(
+        "\n\n".join(
+            render_trace(e, sink_spans=spans_by_trace.get(e["trace_id"]))
+            for e in chosen
+        )
+    )
     return 0
 
 

@@ -39,12 +39,14 @@ help:
 	@echo "               re-run + supertrend carry-divergence pin + the"
 	@echo "               slow-marked alternate-seed A/B, then a small-shape"
 	@echo "               serial-vs-scanned throughput report"
-	@echo "  backtest-smoke- time-batched backtest lane (ISSUE 6): the"
+	@echo "  backtest-smoke- time-batched backtest lane (ISSUE 6/17): the"
 	@echo "               slow-marked backtest-vs-serial-FULL equality"
 	@echo "               drills (recorded 36h fixture, overflow burst,"
 	@echo "               rewrite chunk break) + the 64-combo vmapped grid"
-	@echo "               smoke, then a small-shape throughput + sweep"
-	@echo "               report (bench.py --backtest-throughput)"
+	@echo "               smoke + the ext-invariant parity/margin/batch-"
+	@echo "               decode drills (tests/test_backtest_ext.py), then"
+	@echo "               a small-shape throughput + sweep report"
+	@echo "               (bench.py --backtest-throughput)"
 	@echo "  ring-smoke - circular-cursor ring lane (ISSUE 9): cursor-vs-"
 	@echo "               shift bit-equality property suite, checkpoint"
 	@echo "               v3->v4 migration + mid-phase-cursor kill-and-"
@@ -229,8 +231,8 @@ replay-smoke:
 # bench is `python bench.py --backtest-throughput` (writes
 # BENCH_BACKTEST_CPU.json).
 backtest-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_backtest.py -q \
-		-p no:cacheprovider
+	JAX_PLATFORMS=cpu python -m pytest tests/test_backtest.py \
+		tests/test_backtest_ext.py -q -p no:cacheprovider
 	JAX_PLATFORMS=cpu python bench.py --backtest-throughput \
 		--symbols 64 --window 160 --ticks 32 --best-of 1
 

@@ -228,6 +228,20 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
                     ),
                 )
                 key = engine._wire_enabled_key()
+                # extension-invariant routing (ISSUE 17): the ext kernel's
+                # single-bench BTC block assumes ONE btc_row across the
+                # chunk, so only the ACTIVE rows count (pad rows carry the
+                # -1 defaults and are skipped by the scan's cond) — a
+                # mid-chunk registry move of the bench symbol falls back
+                # to the vmapped precompute
+                btc_rows = np.asarray(inputs_seq.btc_row)[
+                    np.asarray(active, bool)
+                ]
+                ext_invariant = bool(
+                    getattr(engine, "ext_invariant", False)
+                ) and bool(
+                    btc_rows.size == 0 or np.all(btc_rows == btc_rows[0])
+                )
                 chunk_args = (
                     (ext5_t, ext5_v),
                     (ext15_t, ext15_v),
@@ -247,6 +261,7 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
                     params=None if params is None else dynamic_params(params),
                     numeric_digest=engine.numeric_digest,
                     ingest_digest=engine.ingest_digest,
+                    ext_invariant=ext_invariant,
                 )
                 ledger_sig = (
                     f"S{engine.capacity}xW{W} T{tb}"
@@ -254,6 +269,7 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
                     f" ext15[{ext15_t.shape[1] - W}]"
                     f" digest={int(engine.numeric_digest)}"
                     + (" ingest=1" if engine.ingest_digest else "")
+                    + (" ext=1" if ext_invariant else "")
                 )
 
                 def cost_fn(
@@ -333,6 +349,20 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
     engine.backtest_chunks += 1
     BACKTEST_CHUNKS.inc()
 
+    # batch decode (ISSUE 17): one vectorized pass over the landed (T, L)
+    # wire block replaces T per-tick unpack_wire re-slices — finalize
+    # consumes the pre-decoded (WireFired, ctx) tuples
+    from binquant_tpu.engine.step import unpack_wire_block
+
+    t_dec0 = time.perf_counter()
+    seq = unpack_wire_block(
+        wires[:T], numeric_digest=engine.numeric_digest,
+        ingest_digest=engine.ingest_digest,
+    )
+    engine.host_phase.record(
+        "backtest", "decode", (time.perf_counter() - t_dec0) * 1000.0
+    )
+
     per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
     t_fin0 = time.perf_counter()
     try:
@@ -350,6 +380,7 @@ async def _flush_backtest_plan(engine, plan, params) -> list:
                 trace=NULL_TRACE,
                 drive="backtest",
                 ingest_mono=p.ingest_mono,
+                unpacked=seq[i],
             )
             fired_all.extend(await engine._finalize_tick(pending))
             engine.latency.record("tick_total", per_tick_ms)
@@ -491,6 +522,7 @@ def run_backtest(
     outcomes: bool | None = None,
     outcome_horizons: tuple[int, ...] | None = None,
     collect_outcomes: list | None = None,
+    ext_invariant: bool | None = None,
 ) -> dict:
     """Backtest a JSONL kline stream through the time-batched backend.
 
@@ -517,6 +549,7 @@ def run_backtest(
         donate=False,
         outcomes=outcomes,
         outcome_horizons=outcome_horizons,
+        ext_invariant=ext_invariant,
         # inline sinks: the backtest lane pins sink-visible effects
         # synchronously; the delivery + fan-out planes have their own
         # lanes
@@ -811,6 +844,24 @@ def _apply_host_updates(times, vals, filled, batches, window):
                     vals[row, match[0]] = np.asarray(v, np.float32)[i]
 
 
+def _auto_sweep_chunk(
+    base_chunk: int, P: int, capacity: int, budget_mb: int
+) -> int:
+    """Derive the sweep's per-dispatch chunk from a device-memory budget.
+
+    The sweep's dominant batched allocation is the outcome scorer's
+    quantile windows — P combos x S rows x ~80 window floats per chunk
+    tick (the PR 6 NOTE's P x S x n_out x 80 term, f32). A huge grid at
+    the configured ``backtest_chunk`` wedges on that product, so instead
+    of requiring callers to hand-tune ``chunk=`` per grid size, drop the
+    chunk until the product fits ``budget_mb`` (BQT_SWEEP_MEM_BUDGET_MB,
+    default 1024). Small grids are untouched: the budget divides out to
+    far more ticks than the configured chunk."""
+    per_tick_bytes = max(1, P * capacity * 80 * 4)
+    fit = int((int(budget_mb) << 20) // per_tick_bytes)
+    return max(1, min(int(base_chunk), fit))
+
+
 def run_param_sweep(
     path: str | Path,
     axes: dict,
@@ -863,7 +914,16 @@ def run_param_sweep(
     )
     key = engine._wire_enabled_key()
     _check_supported(key, window)
-    chunk = int(chunk or engine.backtest_chunk)
+    if not chunk:
+        # huge grids: derive the chunk from the memory budget instead of
+        # wedging at the configured backtest_chunk (ISSUE 17 satellite)
+        from binquant_tpu.config import Config
+
+        chunk = _auto_sweep_chunk(
+            engine.backtest_chunk, P, capacity,
+            int(getattr(Config(), "sweep_mem_budget_mb", 1024) or 1024),
+        )
+    chunk = int(chunk)
     S, W = capacity, window
 
     # host ring state shared by every combo (params never touch buffers)

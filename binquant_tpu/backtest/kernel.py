@@ -230,6 +230,121 @@ def _precompute_one(
     )
 
 
+def _precompute_ext(
+    ext5: tuple[jnp.ndarray, jnp.ndarray],
+    ext15: tuple[jnp.ndarray, jnp.ndarray],
+    counts5: jnp.ndarray,
+    counts15: jnp.ndarray,
+    filled0: tuple[jnp.ndarray, jnp.ndarray],
+    inputs_seq: HostInputs,  # (T, ...) leaves
+    sp,
+    window: int,
+    wire_enabled: tuple[str, ...],
+    times5_last: jnp.ndarray,  # (T, S) gathered last-bar open times
+    times15_last: jnp.ndarray,
+    filled5: jnp.ndarray,  # (T, S)
+    filled15: jnp.ndarray,
+) -> TickPre:
+    """The extension-invariant TickPre: every position-local kernel runs
+    ONCE over the (S, L = W + N) extended buffers instead of T times over
+    gathered (T, S, W) window views (``BQT_EXT_INVARIANT=1`` — the
+    governed twin of the vmapped ``_precompute_one``; see that docstring
+    and README §Backtest for the gate-margin tolerance contract).
+
+    Differences from the vmapped path, by design:
+
+    * feature packs + symbol features come from the ``*_ext`` kernels
+      (strategies/features.py, regime/context.py) — positional fields
+      bit-identical, windowed/EWM fields ulp/margin-governed;
+    * the (T, S, W, F) 5m view gather disappears entirely; the 15m views
+      are materialized ONLY for LSP's cumsum-anchored heavy core (which
+      stays vmapped — its means/extrema are not view-invariant in f32),
+      and only when the strategy is enabled;
+    * the BTC beta/corr block runs ONE ``rolling_beta_corr`` over the
+      (S, L) extension against the single extended bench row — valid
+      because the driver only routes chunks whose ``btc_row`` is constant
+      across ticks here (non-constant chunks fall back to the vmapped
+      precompute). The per-tick change_96/momentum closes are exact
+      positional gathers at the BTC row's own extension counts.
+    """
+    from binquant_tpu.regime.context import compute_symbol_features_ext
+    from binquant_tpu.strategies.features import (
+        compute_feature_pack_ext,
+        ext_gather,
+    )
+
+    fresh5 = (filled5 > 0) & (times5_last == inputs_seq.timestamp5_s[:, None])
+    fresh15 = (filled15 > 0) & (times15_last == inputs_seq.timestamp_s[:, None])
+
+    pack5 = compute_feature_pack_ext(
+        ext5[0], ext5[1], counts5, filled0[0], window
+    )
+    pack15 = compute_feature_pack_ext(
+        ext15[0], ext15[1], counts15, filled0[1], window
+    )
+    feats15 = compute_symbol_features_ext(
+        ext15[0], ext15[1], counts15, filled0[1], window,
+        fresh15 & inputs_seq.tracked,
+    )
+
+    T, S = counts15.shape
+    if "liquidation_sweep_pump" in wire_enabled:
+        # LSP's heavy core is the one per-tick residue: cumsum/view-anchored
+        # means/extrema (see _precompute_one). Gather the 15m views for it
+        # alone — the packs/feats above no longer need them.
+        views15 = _window_views(*ext15, counts15, filled0[1], window)
+        lsp_score_ok, lsp_score, lsp_thr, lsp_vol = jax.vmap(
+            lambda b15, oi: lsp_core(b15, oi, sp.lsp)
+        )(views15, inputs_seq.oi_growth)
+    else:
+        zeros = jnp.zeros((T, S), jnp.float32)
+        lsp_score_ok, lsp_score, lsp_thr, lsp_vol = (
+            jnp.zeros((T, S), bool), zeros, zeros, zeros,
+        )
+
+    # --- BTC-relative block over the extension (btc_row constant across
+    # the chunk — the driver's routing invariant for this path)
+    last15 = (counts15 + (window - 1)).astype(jnp.int32)
+    onehot_rows, btc_ok = _btc_row_mask(inputs_seq.btc_row[0], S)
+    close15 = ext15[1][:, :, Field.CLOSE]
+    rets = log_returns(close15)  # position-local → elementwise exact
+    btc_onehot = onehot_rows[:, None]
+    btc_rets_row = jnp.where(btc_onehot, rets, 0.0).sum(axis=0)  # (L,)
+    btc_close_row = jnp.where(btc_onehot, close15, 0.0).sum(axis=0)
+    btc_rets = jnp.where(btc_ok, btc_rets_row, jnp.nan)
+    bc = rolling_beta_corr(rets, btc_rets[None, :], window=BC_WINDOW)
+    beta_g = ext_gather(bc.beta, last15)
+    corr_g = ext_gather(bc.corr, last15)
+    btc_beta = jnp.where(jnp.isfinite(beta_g), beta_g, 0.0)
+    btc_corr = jnp.where(jnp.isfinite(corr_g), corr_g, 0.0)
+    btc_close = jnp.where(btc_ok, btc_close_row, jnp.nan)  # (L,)
+    btc_counts = (counts15 * onehot_rows[None, :]).sum(axis=1)  # (T,)
+    p = (btc_counts + (window - 1)).astype(jnp.int32)
+    if window > 96:
+        btc_change = _btc_change_96(btc_close[p], btc_close[p - 96], btc_ok)
+    else:
+        btc_change = jnp.zeros((T,), jnp.float32)
+    btc_mom = _btc_momentum_pair(btc_close[p], btc_close[p - 1])
+
+    return TickPre(
+        fresh5=fresh5,
+        fresh15=fresh15,
+        filled5=filled5,
+        filled15=filled15,
+        pack5=pack5,
+        pack15=pack15,
+        feats15=feats15,
+        lsp_score_ok=lsp_score_ok,
+        lsp_trigger_score=lsp_score,
+        lsp_threshold=lsp_thr,
+        lsp_volume_last=lsp_vol,
+        btc_beta=btc_beta,
+        btc_corr=btc_corr,
+        btc_mom=btc_mom,
+        btc_change_96=btc_change,
+    )
+
+
 def _evaluate_tick(
     pre: TickPre,
     abp_pre: tuple,
@@ -358,6 +473,10 @@ def _evaluate_tick(
             # classic/full-recompute semantics: the same wire-materialized
             # field subset the serial classic step counts (engine/step.py)
             wire_fields_only=True,
+            # margin-proximity fields (ISSUE 17): same sp/context the
+            # serial call site passes, so blocks stay backend-identical
+            sp=sp,
+            context=context,
         )
     else:
         digest = None
@@ -421,8 +540,10 @@ def _chunk_ingest_counts(
 
 
 def _chunk_ingest_blocks(
-    views5: MarketBuffer,  # (T, ...) stacked window views
-    views15: MarketBuffer,
+    times5_last: jnp.ndarray,  # (T, S) each tick's newest 5m bar time
+    filled5: jnp.ndarray,  # (T, S)
+    times15_last: jnp.ndarray,
+    filled15: jnp.ndarray,
     ext5,
     ext15,
     counts5: jnp.ndarray,
@@ -432,14 +553,18 @@ def _chunk_ingest_blocks(
 ) -> jnp.ndarray:
     """(T, INGEST_DIGEST_WIDTH) stacked ingest blocks — the same shared
     ``_ingest_interval_stats`` reductions the serial step runs, vmapped
-    over the tick axis (exact integer ops → bit-identical blocks)."""
+    over the tick axis (exact integer ops → bit-identical blocks). Takes
+    the per-tick (last-bar time, filled) arrays directly so BOTH
+    precompute paths feed it: the vmapped path from its window views'
+    last columns, the extension-invariant path from plain gathers (no
+    (T, S, W) view needed)."""
     from binquant_tpu.engine.step import (
         FIFTEEN_MIN_S,
         FIVE_MIN_S,
         _ingest_interval_stats,
     )
 
-    def stats(views, eval_ts_seq, interval_s):
+    def stats(latest_seq, filled_seq, eval_ts_seq, interval_s):
         def one(latest, filled, tracked, eval_ts):
             return jnp.stack(
                 _ingest_interval_stats(
@@ -447,21 +572,17 @@ def _chunk_ingest_blocks(
                 )
             )
 
-        # canonical views: each tick's newest bar sits in the last column
         return jax.vmap(one)(
-            views.times[:, :, -1],
-            views.filled,
-            inputs_seq.tracked,
-            eval_ts_seq,
+            latest_seq, filled_seq, inputs_seq.tracked, eval_ts_seq
         )
 
     tracked_ct = jnp.sum(inputs_seq.tracked, axis=1).astype(jnp.float32)
     return jnp.concatenate(
         [
             tracked_ct[:, None],
-            stats(views5, inputs_seq.timestamp5_s, FIVE_MIN_S),
+            stats(times5_last, filled5, inputs_seq.timestamp5_s, FIVE_MIN_S),
             _chunk_ingest_counts(ext5[0], counts5, window, FIVE_MIN_S),
-            stats(views15, inputs_seq.timestamp_s, FIFTEEN_MIN_S),
+            stats(times15_last, filled15, inputs_seq.timestamp_s, FIFTEEN_MIN_S),
             _chunk_ingest_counts(ext15[0], counts15, window, FIFTEEN_MIN_S),
         ],
         axis=1,
@@ -485,6 +606,7 @@ def _backtest_chunk_impl(
     params=None,
     numeric_digest: bool = False,
     ingest_digest: bool = False,
+    ext_invariant: bool = False,
 ):
     """T full-recompute ticks in one dispatch over the extended buffers.
 
@@ -492,6 +614,11 @@ def _backtest_chunk_impl(
     (trig_counts, autotrade_counts) (T, N))``. Ticks whose fired count
     exceeds ``WIRE_MAX_FIRED`` must be re-driven serially by the caller
     (pre-chunk state stays the anchor — nothing here is donated).
+
+    ``ext_invariant`` (static) selects the extension-invariant precompute
+    (``_precompute_ext``) over the default vmapped-views one — governed
+    by the gate-margin tolerance contract, never bit-pinned. The driver
+    only routes chunks here whose ``btc_row`` is constant across ticks.
     """
     from binquant_tpu.enums import MarketRegimeCode
 
@@ -509,11 +636,34 @@ def _backtest_chunk_impl(
     range_code = jnp.int32(int(MarketRegimeCode.RANGE))
     trans_code = jnp.int32(int(MarketRegimeCode.TRANSITIONAL))
 
-    views5 = _window_views(*ext5, counts5, filled0[0], window)
-    views15 = _window_views(*ext15, counts15, filled0[1], window)
-    pre = jax.vmap(
-        lambda b5, b15, inp: _precompute_one(b5, b15, inp, sp)
-    )(views5, views15, inputs_seq)
+    if ext_invariant:
+        from binquant_tpu.strategies.features import ext_gather
+
+        last5 = (counts5 + (window - 1)).astype(jnp.int32)
+        last15 = (counts15 + (window - 1)).astype(jnp.int32)
+        times5_last = ext_gather(ext5[0], last5)
+        times15_last = ext_gather(ext15[0], last15)
+        filled5 = jnp.minimum(filled0[0][None, :] + counts5, window).astype(
+            jnp.int32
+        )
+        filled15 = jnp.minimum(filled0[1][None, :] + counts15, window).astype(
+            jnp.int32
+        )
+        pre = _precompute_ext(
+            ext5, ext15, counts5, counts15, filled0, inputs_seq, sp,
+            window, wire_enabled, times5_last, times15_last,
+            filled5, filled15,
+        )
+    else:
+        views5 = _window_views(*ext5, counts5, filled0[0], window)
+        views15 = _window_views(*ext15, counts15, filled0[1], window)
+        pre = jax.vmap(
+            lambda b5, b15, inp: _precompute_one(b5, b15, inp, sp)
+        )(views5, views15, inputs_seq)
+        times5_last = views5.times[:, :, -1]
+        times15_last = views15.times[:, :, -1]
+        filled5 = views5.filled
+        filled15 = views15.filled
     # ABP's heavy core is position-local and sort-based, so the T
     # overlapping per-tick tails collapse into ONE extended-series pass
     # (bit-exact; the dominant precompute cost otherwise). Skipped at
@@ -528,8 +678,8 @@ def _backtest_chunk_impl(
 
     ing_seq = (
         _chunk_ingest_blocks(
-            views5, views15, ext5, ext15, counts5, counts15,
-            inputs_seq, window,
+            times5_last, filled5, times15_last, filled15,
+            ext5, ext15, counts5, counts15, inputs_seq, window,
         )
         if ingest_digest
         else None
@@ -591,13 +741,16 @@ backtest_chunk = partial(
     jax.jit,
     static_argnames=(
         "cfg", "wire_enabled", "window", "numeric_digest", "ingest_digest",
+        "ext_invariant",
     ),
 )(_backtest_chunk_impl)
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "wire_enabled", "window", "with_fired_slots"),
+    static_argnames=(
+        "cfg", "wire_enabled", "window", "with_fired_slots", "ext_invariant",
+    ),
 )
 def backtest_chunk_sweep(
     ext5,
@@ -615,6 +768,7 @@ def backtest_chunk_sweep(
     window: int = 400,
     params=None,  # DynamicParams with (P,) float leaves on swept axes
     with_fired_slots: bool = True,
+    ext_invariant: bool = False,
 ):
     """One dispatch scoring P strategy-parameter combos over the chunk.
 
@@ -645,6 +799,7 @@ def backtest_chunk_sweep(
             ext5, ext15, counts5, counts15, filled0, carries_one,
             inputs_seq, active, momentum_ok, policy_one,
             cfg, wire_enabled, window, p,
+            ext_invariant=ext_invariant,
         )
         if not with_fired_slots:
             return carries2, policy2, fired, tc, ac, None

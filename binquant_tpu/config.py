@@ -207,6 +207,27 @@ class Config:
         return int(self._get("BQT_BACKTEST_CHUNK", "16") or "16")
 
     @cached_property
+    def ext_invariant(self) -> bool:
+        """Extension-invariant chunk precompute (ISSUE 17): run the feature
+        packs, regime symbol features and the BTC beta/corr block ONCE over
+        the (S, W+T) extended buffers instead of T times over gathered
+        window views. Governed — windowed cumsum/EWM fields carry ulp-scale
+        drift vs the per-tick views, bounded by the strategies' declared
+        gate margins (strategies/params.py declared_gate_margins; README
+        §Backtest). BQT_EXT_INVARIANT=1 opts in; the default vmapped path
+        stays bit-identical to the serial drive."""
+        return self._get("BQT_EXT_INVARIANT", "0") == "1"
+
+    @cached_property
+    def sweep_mem_budget_mb(self) -> int:
+        """run_param_sweep's device-memory budget (MB) for auto-deriving
+        the per-dispatch chunk on large grids: the dominant batched term
+        scales as P x S x n_out x 80 quantile-window floats, so the chunk
+        is dropped until it fits (BQT_SWEEP_MEM_BUDGET_MB, default 1024).
+        An explicit ``chunk=`` argument bypasses the derivation."""
+        return int(self._get("BQT_SWEEP_MEM_BUDGET_MB", "1024") or "1024")
+
+    @cached_property
     def numeric_digest(self) -> bool:
         """Device-side numeric-health digest riding the wire: per-stage
         NaN/Inf leakage counts, per-strategy non-finite/fired counts, and

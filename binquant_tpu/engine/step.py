@@ -341,7 +341,15 @@ NUMERIC_SERIES: tuple[str, ...] = (
     "close5", "close15", "volume5", "volume15", "score",
 )
 NUMERIC_DIGEST_WIDTH = (
-    2 * len(NUMERIC_STAGES) + 2 * len(STRATEGY_ORDER) + 3 * len(NUMERIC_SERIES)
+    2 * len(NUMERIC_STAGES)
+    + 2 * len(STRATEGY_ORDER)
+    + 3 * len(NUMERIC_SERIES)
+    # margin-proximity tail (ISSUE 17): one gate-margin distance per
+    # strategy plus the regime-score top1-top2 spread — the governed
+    # interface for the extension-invariant precompute's tolerance
+    # contract (README §Backtest).
+    + len(STRATEGY_ORDER)
+    + 1
 )
 
 
@@ -354,6 +362,8 @@ def numeric_digest_layout() -> list[str]:
     names += [f"fired.{s}" for s in STRATEGY_ORDER]
     for series in NUMERIC_SERIES:
         names += [f"{series}.min", f"{series}.max", f"{series}.absmax"]
+    names += [f"margin.{s}" for s in STRATEGY_ORDER]
+    names += ["margin.market_regime"]
     return names
 
 
@@ -386,6 +396,8 @@ def _numeric_digest_block(
     fresh15: jnp.ndarray,
     beta_expected_nan: jnp.ndarray,
     wire_fields_only: bool = False,
+    sp=None,
+    context=None,
 ) -> jnp.ndarray:
     """The (NUMERIC_DIGEST_WIDTH,) f32 stats block.
 
@@ -464,6 +476,63 @@ def _numeric_digest_block(
     out += _series_stats(
         summary.score, jnp.broadcast_to(tracked, summary.score.shape)
     )
+
+    # --- margin-proximity tail (ISSUE 17): per-strategy minimum distance
+    # (indicator units) between any gated indicator and its threshold over
+    # eligible rows — NaN when no row is eligible. These are the fields
+    # the governed extension-invariant parity pins consult: a fired-set
+    # flip is only excusable when the tick's margin sits inside the
+    # strategy's declared_gate_margins() band.
+    from binquant_tpu.strategies.params import resolve_params
+
+    spv = resolve_params(sp)
+
+    def _margin_min(prox: jnp.ndarray, eligible: jnp.ndarray) -> jnp.ndarray:
+        m = eligible & jnp.isfinite(prox)
+        mn = jnp.min(jnp.where(m, prox, jnp.inf))
+        return jnp.where(jnp.any(m), mn, jnp.nan).astype(jnp.float32)
+
+    elig5 = suff5 & fresh5
+    elig15 = suff15 & fresh15
+    margins = {
+        "coinrule_price_tracker": _margin_min(
+            jnp.minimum(
+                jnp.abs(pack5.rsi - spv.pt.rsi_oversold),
+                jnp.abs(pack5.mfi - spv.pt.mfi_oversold),
+            ),
+            elig5,
+        ),
+        "mean_reversion_fade": _margin_min(
+            jnp.minimum(
+                jnp.abs(pack15.rsi_wilder - spv.mrf.rsi_long_max),
+                jnp.abs(pack15.rsi_wilder - spv.mrf.rsi_short_min),
+            ),
+            elig15,
+        ),
+        # inverse_price_tracker keeps its baked constants (dormant.py)
+        "inverse_price_tracker": _margin_min(
+            jnp.minimum(
+                jnp.abs(pack5.rsi - 30.0), jnp.abs(pack5.mfi - 20.0)
+            ),
+            elig5,
+        ),
+    }
+    nan32 = jnp.full((), jnp.nan, jnp.float32)
+    for name in STRATEGY_ORDER:
+        out.append(margins.get(name, nan32))
+    if context is not None:
+        scores = jnp.stack(
+            [
+                context.long_regime_score,
+                context.short_regime_score,
+                context.range_regime_score,
+                context.stress_regime_score,
+            ]
+        )
+        top2 = jax.lax.top_k(scores, 2)[0]
+        out.append((top2[0] - top2[1]).astype(jnp.float32))
+    else:
+        out.append(nan32)
     return jnp.stack(out)
 
 
@@ -499,12 +568,21 @@ def decode_numeric_digest(block) -> dict:
             "absmax": None if am != am else float(am),
         }
         i += 3
+    margin: dict[str, float | None] = {}
+    for name in STRATEGY_ORDER:
+        v = vec[i]
+        margin[name] = None if v != v else float(v)
+        i += 1
+    v = vec[i]
+    margin["market_regime"] = None if v != v else float(v)
+    i += 1
     return {
         "nan_rows": nan_rows,
         "inf_rows": inf_rows,
         "strategy_nonfinite": nonfinite,
         "fired": fired,
         "series": series,
+        "margin": margin,
         "nan_total": sum(nan_rows.values()) + sum(nonfinite.values()),
         "inf_total": sum(inf_rows.values()),
     }
@@ -761,6 +839,111 @@ def unpack_wire(
         payload=payload,
     )
     return fired, ctx
+
+
+def unpack_wire_block(
+    wires, numeric_digest: bool = False, ingest_digest: bool = False
+) -> list[tuple[WireFired, dict]]:
+    """Vectorized twin of :func:`unpack_wire` over a stacked ``(T, L)``
+    wire block — one numpy pass for the digest/scalar/fired-block/payload
+    slicing instead of T per-tick re-slices (ISSUE 17's batch decode; the
+    chunk drives' largest remaining per-tick host cost).
+
+    Returns the exact per-tick ``(WireFired, ctx)`` tuples
+    ``[unpack_wire(w, ...) for w in wires]`` would: the scalar dict is
+    built from ONE bulk f32→f64 widen (``astype(float64).tolist()`` is
+    bit-identical to per-element ``float()``), and the fired/payload/calib
+    arrays are row views of block-level reshapes, so downstream consumers
+    (``_finalize_tick``) see identical values and dtypes either way
+    (pinned by tests/test_backtest_ext.py).
+    """
+    import numpy as np
+
+    w = np.asarray(wires)
+    assert w.ndim == 2, w.shape
+    T = w.shape[0]
+    ingest = None
+    if ingest_digest:
+        ingest = w[:, -INGEST_DIGEST_WIDTH:]
+        w = w[:, :-INGEST_DIGEST_WIDTH]
+    digest = None
+    if numeric_digest:
+        digest = w[:, -NUMERIC_DIGEST_WIDTH:]
+        w = w[:, :-NUMERIC_DIGEST_WIDTH]
+    na, nb = len(WIRE_SCALARS_A), len(WIRE_SCALARS_B)
+    off = na + nb + 4
+    scal = w[:, :off].astype(np.float64).tolist()
+    K = WIRE_MAX_FIRED
+    ns = w[:, off]
+    blocks = w[:, off + 1 : off + 1 + 6 * K].reshape(T, 6, K)
+    strat_all = blocks[:, 0, :].astype(np.int32)
+    row_all = blocks[:, 1, :].astype(np.int32)
+    auto_all = blocks[:, 2, :] > 0.5
+    dir_all = blocks[:, 3, :].astype(np.int32)
+    payload_off = off + 1 + 6 * K
+    L = w.shape[1]
+    payload_all = None
+    calib_all = None
+    if L >= payload_off + K * EMISSION_SLOT_WIDTH:
+        payload_all = w[
+            :, payload_off : payload_off + K * EMISSION_SLOT_WIDTH
+        ].reshape(T, K, EMISSION_SLOT_WIDTH)
+        calib_off = payload_off + K * EMISSION_SLOT_WIDTH
+        rest = L - calib_off
+        if rest > 0 and rest % 3 == 0:
+            calib_all = w[:, calib_off:].reshape(T, 3, rest // 3)
+
+    int_keys = (
+        "market_regime",
+        "previous_market_regime",
+        "market_regime_transition",
+        "fresh_count",
+    )
+    out: list[tuple[WireFired, dict]] = []
+    for t in range(T):
+        vals = scal[t]
+        ctx = dict(zip(WIRE_SCALARS_A, vals))
+        ctx.update(zip(WIRE_SCALARS_B, vals[na:]))
+        ctx["timestamp"] = (
+            int(vals[na + nb]) * _WIRE_TS_BASE + int(vals[na + nb + 1])
+        )
+        ctx["regime_stable_since"] = (
+            int(vals[na + nb + 2]) * _WIRE_TS_BASE + int(vals[na + nb + 3])
+        )
+        for k in int_keys:
+            ctx[k] = int(ctx[k])
+        ctx["valid"] = ctx["valid"] > 0.5
+        ctx["regime_is_transitioning"] = ctx["regime_is_transitioning"] > 0.5
+        n = int(ns[t])
+        kept = min(n, K)
+        payload = None
+        if payload_all is not None:
+            payload = payload_all[t, :kept]
+            if calib_all is not None:
+                ctx["calib_valid"] = calib_all[t, 0] > 0.5
+                ctx["calib_close"] = calib_all[t, 1]
+                ctx["calib_atr_pct"] = calib_all[t, 2]
+        if digest is not None:
+            ctx["numeric_digest"] = digest[t]
+        if ingest is not None:
+            ctx["ingest_digest"] = ingest[t]
+        out.append(
+            (
+                WireFired(
+                    n=n,
+                    overflow=n > K,
+                    strategy_idx=strat_all[t, :kept],
+                    row=row_all[t, :kept],
+                    autotrade=auto_all[t, :kept],
+                    direction=dir_all[t, :kept],
+                    score=blocks[t, 4, :kept],
+                    stop_loss_pct=blocks[t, 5, :kept],
+                    payload=payload,
+                ),
+                ctx,
+            )
+        )
+    return out
 
 
 def default_host_inputs(num_symbols: int) -> HostInputs:
@@ -1672,6 +1855,8 @@ def _tick_step_impl(
             # are exactly where leakage matters most, and pay the wider
             # scan only once per BQT_CARRY_AUDIT_EVERY ticks.
             wire_fields_only=not incremental and not maintain_carry,
+            sp=sp,
+            context=context,
         )
     else:
         digest = None

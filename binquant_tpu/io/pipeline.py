@@ -331,6 +331,10 @@ class _PendingTick(NamedTuple):
     # perf_counter of the OLDEST pending candle this tick drained (None
     # when unknown) — the ingest→dispatch freshness anchor
     ingest_mono: Any = None
+    # batch-decoded (WireFired, ctx) from unpack_wire_block when the chunk
+    # drive already paid the decode in one vectorized pass (ISSUE 17);
+    # None → finalize decodes this wire itself
+    unpacked: Any = None
 
 
 def _pow2_bucket(m: int, floor: int = 4) -> int:
@@ -775,6 +779,12 @@ class SignalEngine:
         self.backtest_ticks = 0
         self.backtest_chunks = 0
         self.backtest_overflow_reruns = 0
+        # Extension-invariant chunk precompute (ISSUE 17, BQT_EXT_INVARIANT):
+        # feature packs / symbol features / BTC beta-corr run once over the
+        # (S, W+T) extension instead of per-tick over gathered views.
+        # Governed by the gate-margin tolerance contract — the default (off)
+        # keeps the chunk drives bit-identical to the serial step.
+        self.ext_invariant = bool(getattr(config, "ext_invariant", False))
         # Explicit StrategyParams override (None = the kernels' baked
         # defaults, the live graph). Set by the backtest driver when a run
         # carries non-default params so the SERIAL re-entries (cold start,
@@ -1737,6 +1747,20 @@ class SignalEngine:
         self.scan_chunks += 1
         SCAN_CHUNKS.inc()
 
+        # batch decode (ISSUE 17): one vectorized pass over the landed
+        # (T, L) wire block replaces T per-tick unpack_wire re-slices —
+        # finalize consumes the pre-decoded (WireFired, ctx) tuples
+        from binquant_tpu.engine.step import unpack_wire_block
+
+        t_dec0 = time.perf_counter()
+        seq = unpack_wire_block(
+            wires[:T], numeric_digest=self.numeric_digest,
+            ingest_digest=self.ingest_digest,
+        )
+        self.host_phase.record(
+            "scanned", "decode", (time.perf_counter() - t_dec0) * 1000.0
+        )
+
         per_tick_ms = (time.perf_counter() - t_chunk0) * 1000.0 / T
         t_fin0 = time.perf_counter()
         try:
@@ -1755,6 +1779,7 @@ class SignalEngine:
                     trace=NULL_TRACE,
                     drive="scanned",
                     ingest_mono=p.ingest_mono,
+                    unpacked=seq[i],
                 )
                 fired_all.extend(await self._finalize_tick(pending))
                 self.latency.record("tick_total", per_tick_ms)
@@ -2572,10 +2597,18 @@ class SignalEngine:
         # compacted fired entries). Everything host-side below reads it.
         t_fetch0 = time.perf_counter()
         with self.latency.stage("wire_fetch"), trace.span("wire_fetch") as sp_wire:
-            unpacked = unpack_wire(
-                pending.wire, numeric_digest=self.numeric_digest,
-                ingest_digest=self.ingest_digest,
-            )
+            pre_unpacked = getattr(pending, "unpacked", None)
+            if pre_unpacked is not None:
+                # chunk drives that batch-decoded the whole wire block in
+                # one vectorized pass (unpack_wire_block) hand the tick's
+                # (WireFired, ctx) here — its decode cost was already
+                # attributed at flush
+                unpacked = pre_unpacked
+            else:
+                unpacked = unpack_wire(
+                    pending.wire, numeric_digest=self.numeric_digest,
+                    ingest_digest=self.ingest_digest,
+                )
         t_fetch_end = time.perf_counter()
         if drive == "serial":
             # the serial drive's one blocking device interaction; on the

@@ -110,6 +110,7 @@ def make_stub_engine(
     fanout_overrides: dict | None = None,
     ingest_digest: bool | None = None,
     ingest_stale_budget: int | None = None,
+    ext_invariant: bool | None = None,
 ):
     """A SignalEngine wired entirely to stubs (no network).
 
@@ -154,6 +155,11 @@ def make_stub_engine(
         config.__dict__["scan_chunk"] = int(scan_chunk)
     if backtest_chunk is not None:
         config.__dict__["backtest_chunk"] = int(backtest_chunk)
+    # extension-invariant chunk precompute (ISSUE 17): BQT_EXT_INVARIANT
+    # override so the governed-parity drills pin the ext path on while the
+    # tier-1 default stays on the bit-identical vmapped path
+    if ext_invariant is not None:
+        config.__dict__["ext_invariant"] = bool(ext_invariant)
     if trace_sample is not None:
         config.__dict__["trace_sample"] = float(trace_sample)
     # ingest-health observatory (ISSUE 15): BQT_INGEST_DIGEST /

@@ -172,8 +172,11 @@ def initial_regime_carry(num_symbols: int) -> RegimeCarry:
 # ---------------------------------------------------------------------------
 
 
-def _assemble_symbol_features(
-    buf: MarketBuffer,
+def _assemble_symbol_feature_values(
+    latest_close: jnp.ndarray,
+    prev_close: jnp.ndarray,
+    times_last: jnp.ndarray,
+    filled: jnp.ndarray,
     eligible: jnp.ndarray,
     ema20: jnp.ndarray,
     ema50: jnp.ndarray,
@@ -181,23 +184,21 @@ def _assemble_symbol_features(
     mid: jnp.ndarray,
     std: jnp.ndarray,
 ) -> SymbolFeatureArrays:
-    """Derived per-symbol features from last-bar indicator values — shared
-    by the full-window path and the incremental-carry path so the two can
-    only diverge in the (parity-tested) indicator readouts themselves."""
-    close = buf.values[:, :, Field.CLOSE]
-    latest_close = close[:, -1]
-    prev_close = close[:, -2]
-
+    """Derived per-symbol features from last-bar indicator VALUES — shared
+    by the full-window path, the incremental-carry path, and the backtest
+    extension-invariant path so the three can only diverge in the
+    (parity-tested) indicator readouts themselves. Shape-agnostic: (S,)
+    per-tick inputs or (T, S) batched ones (compute_symbol_features_ext)."""
     bb_upper = mid + 2.0 * std
     bb_lower = mid - 2.0 * std
     atr_pct = jnp.where(latest_close != 0, jsafe_div(atr, latest_close), 0.0)
     bb_width = jnp.where(mid != 0, jsafe_div(bb_upper - bb_lower, jnp.abs(mid)), 0.0)
     trend_score = jnp.where(ema50 != 0, jsafe_div(ema20 - ema50, jnp.abs(ema50)), 0.0)
 
-    valid = eligible & (buf.filled >= 2)
+    valid = eligible & (filled >= 2)
     return SymbolFeatureArrays(
         valid=valid,
-        timestamp=buf.times[:, -1],
+        timestamp=times_last,
         close=latest_close,
         return_pct=jsafe_pct(latest_close, prev_close),
         ema20=ema20,
@@ -212,6 +213,23 @@ def _assemble_symbol_features(
         micro_regime_strength=jnp.zeros_like(latest_close),
         micro_transition=jnp.full(latest_close.shape, -1, dtype=jnp.int32),
         micro_transition_strength=jnp.zeros_like(latest_close),
+    )
+
+
+def _assemble_symbol_features(
+    buf: MarketBuffer,
+    eligible: jnp.ndarray,
+    ema20: jnp.ndarray,
+    ema50: jnp.ndarray,
+    atr: jnp.ndarray,
+    mid: jnp.ndarray,
+    std: jnp.ndarray,
+) -> SymbolFeatureArrays:
+    """Buffer-reading shim over :func:`_assemble_symbol_feature_values`."""
+    close = buf.values[:, :, Field.CLOSE]
+    return _assemble_symbol_feature_values(
+        close[:, -1], close[:, -2], buf.times[:, -1], buf.filled,
+        eligible, ema20, ema50, atr, mid, std,
     )
 
 
@@ -239,6 +257,48 @@ def compute_symbol_features(
     std = rolling_std_last(close, 20, min_periods=1, ddof=0)
     std = jnp.where(jnp.isfinite(std), std, 0.0)  # pandas .fillna(0.0)
     return _assemble_symbol_features(buf, eligible, ema20, ema50, atr, mid, std)
+
+
+def compute_symbol_features_ext(
+    ext_times: jnp.ndarray,  # (S, L) int32
+    ext_vals: jnp.ndarray,  # (S, L, F)
+    counts: jnp.ndarray,  # (T, S)
+    filled0: jnp.ndarray,  # (S,)
+    window: int,
+    eligible: jnp.ndarray,  # (T, S) fresh & tracked per tick
+) -> SymbolFeatureArrays:
+    """T ticks of :func:`compute_symbol_features` from ONE pass over the
+    backtest's (S, L = W + N) extended buffers (leaves (T, S)-leading).
+
+    Same numeric contract as ``compute_feature_pack_ext``: the derived
+    assembly is elementwise-identical; the indicator readouts anchor at
+    the series start instead of each view's window start (f32-ulp for the
+    rolling moments, ``(1-alpha)^W``-scale for the EMAs). The view path's
+    one structural quirk carries over exactly: its 15-bar TR tail's first
+    element (the prev-close-outside-slice h-l fallback) is excluded by the
+    trailing-14 mean, so the consumed TR positions match the full-series
+    true_range here."""
+    from binquant_tpu.ops.rolling import ewm_mean, rolling_mean, rolling_std
+    from binquant_tpu.strategies.features import ext_gather
+
+    close = ext_vals[:, :, Field.CLOSE]
+    high = ext_vals[:, :, Field.HIGH]
+    low = ext_vals[:, :, Field.LOW]
+    last = (counts + (window - 1)).astype(jnp.int32)
+    g = lambda s: ext_gather(s, last)
+
+    ema20 = g(ewm_mean(close, span=20, min_periods=1))
+    ema50 = g(ewm_mean(close, span=50, min_periods=1))
+    tr = true_range(high, low, close)
+    atr = g(rolling_mean(tr, 14, min_periods=1))
+    mid = g(rolling_mean(close, 20, min_periods=1))
+    std = g(rolling_std(close, 20, min_periods=1, ddof=0))
+    std = jnp.where(jnp.isfinite(std), std, 0.0)
+    filled = jnp.minimum(filled0[None, :] + counts, window).astype(jnp.int32)
+    return _assemble_symbol_feature_values(
+        g(close), ext_gather(close, last - 1), ext_gather(ext_times, last),
+        filled, eligible, ema20, ema50, atr, mid, std,
+    )
 
 
 def symbol_features_from_carry(

@@ -542,6 +542,150 @@ def _ratio_100(num: jnp.ndarray, den_other: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(jnp.isfinite(num) & jnp.isfinite(den_other), out, jnp.nan)
 
 
+def ext_gather(series: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Per-tick gather from an (S, L) extended series: ``out[t, s] =
+    series[s, idx[t, s]]`` — the (T, S) batch of what each backtest tick's
+    right-aligned window view would hold at the gathered column. The
+    broadcast is a view; only the (T, S) result materializes."""
+    T = idx.shape[0]
+    b = jnp.broadcast_to(series[None], (T,) + series.shape)
+    return jnp.take_along_axis(b, idx[:, :, None], axis=2)[..., 0]
+
+
+def compute_feature_pack_ext(
+    ext_times: jnp.ndarray,  # (S, L) int32, -1 pad
+    ext_vals: jnp.ndarray,  # (S, L, F) f32, NaN pad
+    counts: jnp.ndarray,  # (T, S) int32 bars applied through tick t
+    filled0: jnp.ndarray,  # (S,) pre-chunk fill
+    window: int,
+) -> FeaturePack:
+    """The T per-tick FeaturePacks from ONE pass over the extended series.
+
+    The extension-invariant twin of vmapping :func:`compute_feature_pack`
+    over T gathered (S, W) window views: every rolling/EWM kernel runs once
+    over the (S, L = W + N) extension, and tick t's pack is the gather at
+    ``last = counts[t] + window - 1``. Returns a FeaturePack whose leaves
+    are (T, S)-leading ((T, S, BB_WIDTH_HISTORY) for ``bb_widths``).
+
+    Numeric contract (the BQT_EXT_INVARIANT tolerance interface — see
+    README §Backtest): positional fields (bar values, times, filled) are
+    bit-identical to the view path. Windowed sums/means/stds anchor their
+    cumsum at the series start instead of each view's window start —
+    equal in exact arithmetic, f32-ulp apart. EWM fields additionally see
+    the pre-window prefix the view path truncates, a ``(1-alpha)^W``-scale
+    divergence for rows with more than ``window`` bars of history.
+    Strategies gating on these fields declare a gate margin
+    (strategies/params.py ``declared_gate_margins``) and parity is pinned
+    as set-equality outside that margin."""
+    from binquant_tpu.ops.rolling import rolling_std, rolling_sum
+
+    close = ext_vals[:, :, Field.CLOSE]
+    high = ext_vals[:, :, Field.HIGH]
+    low = ext_vals[:, :, Field.LOW]
+    open_ = ext_vals[:, :, Field.OPEN]
+    volume = ext_vals[:, :, Field.VOLUME]
+
+    last = (counts + (window - 1)).astype(jnp.int32)  # (T, S)
+    g = lambda s: ext_gather(s, last)
+
+    # --- RSI (both variants): the view's NaN gating survives unchanged —
+    # leading padding NaNs count as missing for both anchors
+    delta = close - shift(close, 1)
+    gain = jnp.maximum(delta, 0.0)
+    loss = jnp.maximum(-delta, 0.0)
+    rsi_wilder = _ratio_100(
+        g(ewm_mean(gain, alpha=1.0 / RSI_WINDOW, min_periods=RSI_WINDOW)),
+        g(ewm_mean(loss, alpha=1.0 / RSI_WINDOW, min_periods=RSI_WINDOW)),
+    )
+    rsi_sma = _ratio_100(
+        g(rolling_mean(gain, RSI_WINDOW)), g(rolling_mean(loss, RSI_WINDOW))
+    )
+
+    # --- MACD over the full extension (the vmapped path's dominant EWM
+    # matmul cost: T × O(W²) collapses to one O(L²))
+    macd_line = ewm_mean(close, span=MACD_FAST, min_periods=1) - ewm_mean(
+        close, span=MACD_SLOW, min_periods=1
+    )
+    macd_last = g(macd_line)
+    macd_signal = g(ewm_mean(macd_line, span=MACD_SIGNAL, min_periods=1))
+
+    # --- MFI: NaN-marked flow series + NaN-aware rolling sums reproduce
+    # the view's sum(flow_ok) >= 14 gate exactly (rolling_sum is NaN iff
+    # fewer than MFI_WINDOW finite deltas in the trailing window)
+    tp = (high + low + close) / 3.0
+    flow = tp * volume
+    tp_delta = tp - shift(tp, 1)
+    fin = jnp.isfinite(tp_delta)
+    pos_series = jnp.where(fin, jnp.where(tp_delta > 0, flow, 0.0), jnp.nan)
+    neg_series = jnp.where(fin, jnp.where(tp_delta < 0, flow, 0.0), jnp.nan)
+    mfi = _ratio_100(
+        g(rolling_sum(pos_series, MFI_WINDOW)),
+        g(rolling_sum(neg_series, MFI_WINDOW)),
+    )
+
+    # --- Bollinger: full-series rolling moments, width history via a
+    # trailing-k column gather (the view's last-k width positions)
+    mids = rolling_mean(close, BB_WINDOW)
+    stds = rolling_std(close, BB_WINDOW, ddof=0)
+    uppers = mids + 2.0 * stds
+    lowers = mids - 2.0 * stds
+    width_series = jsafe_div(uppers - lowers, mids)
+    k = BB_WIDTH_HISTORY
+    T = last.shape[0]
+    hist_cols = last[:, :, None] + jnp.arange(-(k - 1), 1, dtype=jnp.int32)
+    bb_widths = jnp.take_along_axis(
+        jnp.broadcast_to(width_series[None], (T,) + width_series.shape),
+        hist_cols,
+        axis=2,
+    )
+
+    # --- ATR: full-series TR + rolling means. The view path's dropped
+    # first TR (its prev_close outside the 35-slice) is never among the
+    # positions ``atr``/``atr_ma`` consume (deepest reach: last - 32), so
+    # the consumed value sets are identical.
+    tr = true_range(high, low, close)
+    atr_series = rolling_mean(tr, ATR_WINDOW)
+    atr = g(atr_series)
+    atr_ma = g(rolling_mean(atr_series, ATR_MA_WINDOW))
+
+    volume_ma = g(rolling_mean(volume, VOLUME_MA_WINDOW))
+    ema9 = g(ewm_mean(close, span=9, min_periods=1))
+    ema21 = g(ewm_mean(close, span=21, min_periods=1))
+
+    open_time = ext_gather(ext_times, last)
+    duration = g(ext_vals[:, :, Field.DURATION_S])
+    duration = jnp.where(jnp.isfinite(duration), duration, 0.0).astype(jnp.int32)
+    filled = jnp.minimum(filled0[None, :] + counts, window).astype(jnp.int32)
+    return FeaturePack(
+        open_time=open_time,
+        close_time=open_time + duration,
+        open=g(open_),
+        high=g(high),
+        low=g(low),
+        close=g(close),
+        prev_close=ext_gather(close, last - 1),
+        volume=g(volume),
+        quote_volume=g(ext_vals[:, :, Field.QUOTE_VOLUME]),
+        num_trades=g(ext_vals[:, :, Field.NUM_TRADES]),
+        rsi=rsi_sma,
+        rsi_wilder=rsi_wilder,
+        macd=macd_last,
+        macd_signal=macd_signal,
+        mfi=mfi,
+        bb_upper=g(uppers),
+        bb_mid=g(mids),
+        bb_lower=g(lowers),
+        bb_widths=bb_widths,
+        atr=atr,
+        atr_ma=atr_ma,
+        volume_ma=volume_ma,
+        ema9=ema9,
+        ema21=ema21,
+        filled=filled,
+        valid=filled > 0,
+    )
+
+
 def feature_pack_from_carry(
     buf: MarketBuffer, carry: FeatureCarry, stale: jnp.ndarray
 ) -> FeaturePack:

@@ -115,6 +115,32 @@ def resolve_params(params) -> StrategyParams:
     return params
 
 
+def declared_gate_margins() -> dict[str, float]:
+    """Per-strategy gate margins for the extension-invariant tolerance
+    contract (ISSUE 17, README §Backtest).
+
+    A strategy listed here declares: its entry gates compare indicator
+    values against thresholds, and a fired/not-fired flip between the
+    vmapped and extension-invariant precompute paths is only admissible
+    when the tick's margin-proximity digest field (the minimum distance,
+    in the indicator's own units, between any gated indicator and its
+    threshold over eligible rows) sits INSIDE this band. Outside the
+    band, the corpus pins assert exact fired-set equality — the extended
+    forms' cumsum/EWM ulp drift cannot legally cross a gate that far
+    from its threshold. Strategies absent here either have no governed
+    drift on their gated inputs (positional fields are bit-exact) or are
+    not evaluated by the backtest backend.
+
+    Units are RSI/MFI points (0-100 scale) — the gated indicators for
+    all three declared strategies.
+    """
+    return {
+        "coinrule_price_tracker": 0.25,
+        "mean_reversion_fade": 0.25,
+        "inverse_price_tracker": 0.25,
+    }
+
+
 def _leaf_path_items(params: StrategyParams):
     """Yield ("strategy.field", sub_name, field_name, value) per leaf of
     the two-level params pytree (ScorerWeights nests one level deeper and

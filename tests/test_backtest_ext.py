@@ -1,0 +1,527 @@
+"""Extension-invariant precompute + batch decode drills (ISSUE 17).
+
+The backtest chunk body's precompute historically vmapped every feature
+kernel T times over gathered (T, S, W) window views. ``BQT_EXT_INVARIANT=1``
+replaces that with ONE pass per kernel over the (S, W+T) extension
+(``_precompute_ext``), governed by the gate-margin tolerance contract
+(strategies/params.py ``declared_gate_margins``; README §Backtest):
+
+* positional fields (bar values, times, filled, BTC positional gathers)
+  must be BIT-identical between the two precompute paths;
+* windowed cumsum/EWM fields are ulp/margin-governed — same NaN pattern,
+  tight numeric tolerance, and fired-set flips only admissible inside the
+  declared margin band (pinned here at the chunk-kernel level and by the
+  end-to-end set-equality drill);
+* the batch wire decode (``unpack_wire_block``) must return exactly the
+  per-tick ``unpack_wire`` tuples, including the overflow flag and the
+  digest/ingest side blocks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from binquant_tpu.engine.buffer import Field, NUM_FIELDS
+
+
+def _make_ext(S, W, N, seed=0, spacing=900, t0=1_780_272_000):
+    """Synthetic (S, L) extension: right-aligned base with per-row history
+    depth ``filled0`` in columns [0, W), one append per tick per row in
+    columns [W, W+N). Bar times are tick-aligned across rows so freshness
+    gates engage; values are a positive random walk."""
+    rng = np.random.default_rng(seed)
+    L = W + N
+    ext_t = np.full((S, L), -1, np.int32)
+    ext_v = np.full((S, L, NUM_FIELDS), np.nan, np.float32)
+    # mixed history depth: warm rows (full window), partial rows, and one
+    # nearly-empty row — the parity taxonomy's three regimes
+    filled0 = np.full(S, W, np.int64)
+    filled0[S // 2 :] = rng.integers(3, max(4, W // 2), size=S - S // 2)
+    filled0[-1] = 1
+
+    px = 20.0 + rng.random(S) * 60.0
+    for j in range(L):
+        # column j holds the bar for "global step" j - (W - 1): base bars
+        # run back in time from column W-1, appends forward from column W
+        ts = t0 + (j - (W - 1)) * spacing
+        newpx = px * (1.0 + rng.normal(0.0, 0.004, S))
+        row = np.empty((S, NUM_FIELDS), np.float32)
+        row[:, Field.OPEN] = px
+        row[:, Field.HIGH] = np.maximum(px, newpx) * 1.001
+        row[:, Field.LOW] = np.minimum(px, newpx) * 0.999
+        row[:, Field.CLOSE] = newpx
+        row[:, Field.VOLUME] = 800.0 + 400.0 * rng.random(S)
+        row[:, Field.QUOTE_VOLUME] = row[:, Field.VOLUME] * newpx
+        row[:, Field.NUM_TRADES] = 300.0
+        row[:, Field.TAKER_BUY_BASE] = row[:, Field.VOLUME] * 0.5
+        row[:, Field.TAKER_BUY_QUOTE] = row[:, Field.QUOTE_VOLUME] * 0.5
+        row[:, Field.DURATION_S] = float(spacing)
+        px = newpx
+        # per-row history depth: row r's base occupies its TRAILING
+        # filled0[r] base columns
+        keep = (j >= W - filled0) | (j >= W)
+        ext_t[keep, j] = ts
+        ext_v[keep, j] = row[keep]
+    counts = np.tile(
+        np.arange(1, N + 1, dtype=np.int32)[:, None], (1, S)
+    )  # one append per row per tick
+    return ext_t, ext_v, counts, filled0.astype(np.int32), t0, spacing
+
+
+def _stack_host_inputs(S, N, t0, btc_row=0):
+    """(T,)-leading HostInputs matching _make_ext's tick-aligned times."""
+    from binquant_tpu.engine.step import default_host_inputs
+
+    per_tick = []
+    for t in range(N):
+        ts15 = t0 + (t + 1) * 900
+        ts5 = t0 + (t + 1) * 300
+        per_tick.append(
+            default_host_inputs(S)._replace(
+                tracked=jnp.ones((S,), bool),
+                btc_row=jnp.asarray(btc_row, jnp.int32),
+                timestamp_s=jnp.asarray(ts15, jnp.int32),
+                timestamp5_s=jnp.asarray(ts5, jnp.int32),
+                quiet_hours=jnp.asarray(False),
+                grid_policy_allows=jnp.asarray(False),
+                is_futures=jnp.asarray(True),
+                dominance_is_losers=jnp.asarray(False),
+                market_domination_reversal=jnp.asarray(False),
+            )
+        )
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_tick)
+
+
+POSITIONAL_PACK_FIELDS = (
+    "open_time", "close_time", "open", "high", "low", "close",
+    "prev_close", "volume", "quote_volume", "num_trades", "filled", "valid",
+)
+# cumsum-anchored: equal in exact arithmetic, f32-ulp apart (the anchor
+# moves from each view's window start to the series start)
+CUMSUM_PACK_FIELDS = (
+    "rsi", "mfi", "bb_upper", "bb_mid", "bb_lower", "bb_widths",
+    "atr", "atr_ma", "volume_ma",
+)
+# EWM-carrying: additionally see the pre-window prefix the view path
+# truncates — a (1-alpha)^W-scale divergence on rows with > W bars of
+# history (must stay WELL inside the 0.25-point declared gate margins)
+EWM_PACK_FIELDS = ("rsi_wilder", "macd", "macd_signal", "ema9", "ema21")
+
+
+def _assert_governed_close(name, a, b, rtol=5e-4, atol=5e-3):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    assert np.array_equal(np.isnan(a), np.isnan(b)), (
+        f"{name}: NaN pattern differs"
+    )
+    m = np.isfinite(a)
+    np.testing.assert_allclose(
+        a[m], b[m], rtol=rtol, atol=atol, err_msg=name
+    )
+
+
+def test_precompute_ext_parity_synthetic():
+    """Tentpole pin: the extension-invariant precompute vs the vmapped
+    window-view precompute on a mixed-history synthetic chunk — positional
+    fields bit-exact, governed cumsum/EWM fields NaN-pattern-identical and
+    numerically tight, BTC positional gathers bit-exact."""
+    from binquant_tpu.backtest.kernel import (
+        _precompute_ext,
+        _precompute_one,
+        _window_views,
+    )
+    from binquant_tpu.strategies.features import ext_gather
+    from binquant_tpu.strategies.params import resolve_params
+
+    S, W, N = 8, 120, 12
+    ext15_t, ext15_v, counts15, f0_15, t0, _ = _make_ext(
+        S, W, N, seed=1, spacing=900, t0=1_780_272_000 - 900
+    )
+    ext5_t, ext5_v, counts5, f0_5, _, _ = _make_ext(
+        S, W, N, seed=2, spacing=300, t0=1_780_272_000 - 300
+    )
+    # tick-aligned append times: tick t's 15m append is at t0 + (t+1)*900
+    inputs_seq = _stack_host_inputs(S, N, 1_780_272_000 - 900, btc_row=0)
+    # match the 5m clock to the 5m extension's own base
+    inputs_seq = inputs_seq._replace(
+        timestamp5_s=jnp.asarray(
+            [(1_780_272_000 - 300) + (t + 1) * 300 for t in range(N)],
+            jnp.int32,
+        )
+    )
+    sp = resolve_params(None)
+    wire_enabled = ("liquidation_sweep_pump",)
+
+    views5 = _window_views(ext5_t, ext5_v, counts5, f0_5, W)
+    views15 = _window_views(ext15_t, ext15_v, counts15, f0_15, W)
+    ref = jax.vmap(
+        lambda b5, b15, inp: _precompute_one(b5, b15, inp, sp)
+    )(views5, views15, inputs_seq)
+
+    last5 = (counts5 + (W - 1)).astype(jnp.int32)
+    last15 = (counts15 + (W - 1)).astype(jnp.int32)
+    got = _precompute_ext(
+        (ext5_t, ext5_v), (ext15_t, ext15_v), counts5, counts15,
+        (f0_5, f0_15), inputs_seq, sp, W, wire_enabled,
+        ext_gather(jnp.asarray(ext5_t), last5),
+        ext_gather(jnp.asarray(ext15_t), last15),
+        jnp.minimum(f0_5[None, :] + counts5, W).astype(jnp.int32),
+        jnp.minimum(f0_15[None, :] + counts15, W).astype(jnp.int32),
+    )
+
+    # freshness + fill accounting: bit-exact
+    for f in ("fresh5", "fresh15", "filled5", "filled15"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(got, f)), f
+        )
+    assert bool(np.asarray(got.fresh15).any())  # gates actually engage
+
+    for pname in ("pack5", "pack15"):
+        rp, gp = getattr(ref, pname), getattr(got, pname)
+        for f in POSITIONAL_PACK_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(rp, f)), np.asarray(getattr(gp, f)),
+                err_msg=f"{pname}.{f} must be bit-exact",
+            )
+        for f in CUMSUM_PACK_FIELDS:
+            _assert_governed_close(
+                f"{pname}.{f}", getattr(rp, f), getattr(gp, f)
+            )
+        for f in EWM_PACK_FIELDS:
+            _assert_governed_close(
+                f"{pname}.{f}", getattr(rp, f), getattr(gp, f),
+                rtol=2e-3, atol=0.15,
+            )
+
+    # regime symbol features: positional ints exact, floats governed
+    for f in ref.feats15._fields:
+        rv, gv = getattr(ref.feats15, f), getattr(got.feats15, f)
+        if np.asarray(rv).dtype.kind in "biu":
+            np.testing.assert_array_equal(
+                np.asarray(rv), np.asarray(gv), err_msg=f"feats15.{f}"
+            )
+        else:
+            # ema20/ema50 carry the EWM prefix divergence
+            _assert_governed_close(
+                f"feats15.{f}", rv, gv, rtol=2e-3, atol=0.15
+            )
+
+    # LSP stays the vmapped kernel in BOTH paths — bit-exact
+    for f in (
+        "lsp_score_ok", "lsp_trigger_score", "lsp_threshold",
+        "lsp_volume_last",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(got, f)), f
+        )
+
+    # BTC block: beta/corr governed (rolling cumsums), momentum/change_96
+    # positional gathers — bit-exact
+    _assert_governed_close("btc_beta", ref.btc_beta, got.btc_beta)
+    _assert_governed_close("btc_corr", ref.btc_corr, got.btc_corr)
+    np.testing.assert_array_equal(
+        np.asarray(ref.btc_mom), np.asarray(got.btc_mom), "btc_mom"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.btc_change_96), np.asarray(got.btc_change_96),
+        "btc_change_96",
+    )
+
+
+def test_backtest_chunk_ext_governed_fired_sets():
+    """The chunk-kernel contract: BQT_EXT_INVARIANT wires may only flip a
+    fired set when the tick's margin-proximity digest field sits inside
+    the strategy's declared gate margin — outside the band the sets are
+    exactly equal. Also pins that the margin fields actually populate."""
+    from binquant_tpu.backtest.kernel import backtest_chunk
+    from binquant_tpu.engine.step import STRATEGY_ORDER, unpack_wire
+    from binquant_tpu.regime.context import ContextConfig
+    from binquant_tpu.regime.context import initial_regime_carry
+    from binquant_tpu.strategies.params import declared_gate_margins
+
+    S, W, N = 8, 120, 12
+    ext15_t, ext15_v, counts15, f0_15, _, _ = _make_ext(
+        S, W, N, seed=5, spacing=900, t0=1_780_272_000 - 900
+    )
+    ext5_t, ext5_v, counts5, f0_5, _, _ = _make_ext(
+        S, W, N, seed=6, spacing=300, t0=1_780_272_000 - 300
+    )
+    inputs_seq = _stack_host_inputs(S, N, 1_780_272_000 - 900, btc_row=0)
+    inputs_seq = inputs_seq._replace(
+        timestamp5_s=jnp.asarray(
+            [(1_780_272_000 - 300) + (t + 1) * 300 for t in range(N)],
+            jnp.int32,
+        )
+    )
+    carries = (
+        initial_regime_carry(S),
+        jnp.full((S,), -1, jnp.int32),
+        jnp.full((S,), -1, jnp.int32),
+    )
+    active = jnp.ones((N,), bool)
+    momentum_ok = jnp.ones((N,), bool)
+    policy_prev = (jnp.asarray(False), jnp.asarray(-1, jnp.int32))
+    args = (
+        (jnp.asarray(ext5_t), jnp.asarray(ext5_v)),
+        (jnp.asarray(ext15_t), jnp.asarray(ext15_v)),
+        jnp.asarray(counts5), jnp.asarray(counts15),
+        (jnp.asarray(f0_5), jnp.asarray(f0_15)),
+        carries, inputs_seq, active, momentum_ok, policy_prev,
+    )
+    kwargs = dict(window=W, numeric_digest=True)
+
+    outs = {}
+    for ext in (False, True):
+        _, _, wires, _, _ = backtest_chunk(
+            *args, ContextConfig(), ext_invariant=ext, **kwargs
+        )
+        outs[ext] = [
+            unpack_wire(w, numeric_digest=True) for w in np.asarray(wires)
+        ]
+
+    margins = declared_gate_margins()
+    from binquant_tpu.engine.step import decode_numeric_digest
+
+    saw_margin_value = False
+    for t, ((fr_v, ctx_v), (fr_e, ctx_e)) in enumerate(
+        zip(outs[False], outs[True])
+    ):
+        set_v = set(
+            zip(fr_v.strategy_idx.tolist(), fr_v.row.tolist(),
+                fr_v.direction.tolist())
+        )
+        set_e = set(
+            zip(fr_e.strategy_idx.tolist(), fr_e.row.tolist(),
+                fr_e.direction.tolist())
+        )
+        dec = decode_numeric_digest(ctx_e["numeric_digest"])
+        if any(v is not None for v in dec["margin"].values()):
+            saw_margin_value = True
+        for sidx, _row, _dirn in set_v ^ set_e:
+            name = STRATEGY_ORDER[sidx]
+            band = margins.get(name)
+            prox = dec["margin"].get(name)
+            assert band is not None and prox is not None and prox <= band, (
+                f"tick {t}: fired-set flip on {name} outside its declared "
+                f"gate margin (proximity={prox}, band={band})"
+            )
+    assert saw_margin_value  # the digest's margin tail actually populates
+
+
+def _synthetic_wires(T, S, numeric_digest, ingest_digest, seed=0,
+                     overflow_tick=None):
+    """Random (T, L) wire blocks shaped like the real layout, with a
+    controllable fired count per tick (incl. a > WIRE_MAX_FIRED overflow
+    tick) and plausible scalar/calib/digest regions."""
+    from binquant_tpu.engine.step import (
+        INGEST_DIGEST_WIDTH,
+        NUMERIC_DIGEST_WIDTH,
+        WIRE_FIRED_COUNT_OFF,
+        WIRE_MAX_FIRED,
+        wire_length,
+    )
+
+    rng = np.random.default_rng(seed)
+    L = wire_length(
+        S, numeric_digest=numeric_digest, ingest_digest=ingest_digest
+    )
+    w = rng.random((T, L)).astype(np.float32) * 4.0
+    off = WIRE_FIRED_COUNT_OFF
+    K = WIRE_MAX_FIRED
+    for t in range(T):
+        n = int(rng.integers(0, 6))
+        if overflow_tick is not None and t == overflow_tick:
+            n = K + 7
+        w[t, off] = float(n)
+        blocks = w[t, off + 1 : off + 1 + 6 * K].reshape(6, K)
+        blocks[0] = rng.integers(0, 8, K)  # strategy_idx
+        blocks[1] = rng.integers(0, S, K)  # row
+    return w
+
+
+@pytest.mark.parametrize(
+    "numeric_digest,ingest_digest",
+    [(False, False), (True, False), (True, True)],
+)
+def test_unpack_wire_block_matches_per_tick(numeric_digest, ingest_digest):
+    """Batch decode pin: unpack_wire_block returns exactly the per-tick
+    unpack_wire tuples — values, dtypes, overflow flags, digest blocks —
+    including through a > WIRE_MAX_FIRED overflow tick."""
+    from binquant_tpu.engine.step import unpack_wire, unpack_wire_block
+
+    T, S = 7, 16
+    wires = _synthetic_wires(
+        T, S, numeric_digest, ingest_digest, seed=3, overflow_tick=4
+    )
+    batch = unpack_wire_block(
+        wires, numeric_digest=numeric_digest, ingest_digest=ingest_digest
+    )
+    assert len(batch) == T
+    for t in range(T):
+        ref_fired, ref_ctx = unpack_wire(
+            wires[t], numeric_digest=numeric_digest,
+            ingest_digest=ingest_digest,
+        )
+        got_fired, got_ctx = batch[t]
+        assert got_fired.n == ref_fired.n
+        assert got_fired.overflow == ref_fired.overflow
+        for f in ("strategy_idx", "row", "autotrade", "direction",
+                  "score", "stop_loss_pct"):
+            rv, gv = getattr(ref_fired, f), getattr(got_fired, f)
+            assert rv.dtype == gv.dtype, f
+            np.testing.assert_array_equal(rv, gv, err_msg=f)
+        if ref_fired.payload is None:
+            assert got_fired.payload is None
+        else:
+            np.testing.assert_array_equal(
+                ref_fired.payload, got_fired.payload
+            )
+        assert set(ref_ctx) == set(got_ctx)
+        for k, rv in ref_ctx.items():
+            gv = got_ctx[k]
+            if isinstance(rv, np.ndarray):
+                np.testing.assert_array_equal(rv, gv, err_msg=k)
+            else:
+                assert type(rv) is type(gv), (k, type(rv), type(gv))
+                assert rv == gv, k
+    assert batch[4][0].overflow  # the engineered overflow tick
+
+
+def test_margin_digest_unit():
+    """Margin-proximity digest unit: engineered packs with known RSI/MFI
+    distances must decode to the expected per-strategy minima, NaN (None)
+    when no row is eligible, and the regime top1-top2 spread."""
+    from binquant_tpu.engine.step import (
+        NUMERIC_DIGEST_WIDTH,
+        STRATEGY_ORDER,
+        _numeric_digest_block,
+        decode_numeric_digest,
+        numeric_digest_layout,
+    )
+
+    layout = numeric_digest_layout()
+    assert len(layout) == NUMERIC_DIGEST_WIDTH
+    assert layout[-1] == "margin.market_regime"
+    for s in STRATEGY_ORDER:
+        assert f"margin.{s}" in layout
+
+    S = 4
+    n = len(STRATEGY_ORDER)
+
+    class _Pack:
+        pass
+
+    def mk_pack(rsi, mfi, rsi_wilder):
+        p = _Pack()
+        for f in ("close", "volume", "bb_upper", "bb_mid", "bb_lower",
+                  "macd", "macd_signal", "atr", "ema9", "ema21"):
+            setattr(p, f, jnp.ones((S,), jnp.float32))
+        p.rsi = jnp.asarray(rsi, jnp.float32)
+        p.mfi = jnp.asarray(mfi, jnp.float32)
+        p.rsi_wilder = jnp.asarray(rsi_wilder, jnp.float32)
+        return p
+
+    class _Summary:
+        score = jnp.ones((n, S), jnp.float32)
+        stop_loss_pct = jnp.ones((n, S), jnp.float32)
+        trigger = jnp.zeros((n, S), bool)
+
+    class _Ctx:
+        long_regime_score = jnp.asarray(0.7, jnp.float32)
+        short_regime_score = jnp.asarray(0.1, jnp.float32)
+        range_regime_score = jnp.asarray(0.5, jnp.float32)
+        stress_regime_score = jnp.asarray(0.2, jnp.float32)
+
+    ones = jnp.ones((S,), bool)
+    # PT margin: defaults rsi_oversold=30 / mfi_oversold=20 → min distance
+    # over rows = min(|31-30|, |28.5-30|, |26-20|, ...) = 1.0 vs mfi row 1
+    # at |19.8-20| = 0.2
+    pack5 = mk_pack(
+        rsi=[31.0, 50.0, 60.0, 70.0],
+        mfi=[40.0, 19.8, 60.0, 70.0],
+        rsi_wilder=[50.0] * S,
+    )
+    # MRF margin: thresholds 25/75 → closest is |71-75| = 4
+    pack15 = mk_pack(
+        rsi=[50.0] * S, mfi=[50.0] * S,
+        rsi_wilder=[50.0, 60.0, 71.0, 40.0],
+    )
+    block = _numeric_digest_block(
+        pack5, pack15, _Summary(), jnp.zeros((S,)), jnp.zeros((S,)),
+        ones, ones, ones, ones, ones, jnp.zeros((S,), bool),
+        wire_fields_only=True, sp=None, context=_Ctx(),
+    )
+    dec = decode_numeric_digest(np.asarray(block))
+    m = dec["margin"]
+    assert m["coinrule_price_tracker"] == pytest.approx(0.2, abs=1e-5)
+    assert m["mean_reversion_fade"] == pytest.approx(4.0, abs=1e-5)
+    # IPT gates on the same 30/20 baked constants → same 0.2 proximity
+    assert m["inverse_price_tracker"] == pytest.approx(0.2, abs=1e-5)
+    # undeclared strategies stay None
+    assert m["activity_burst_pump"] is None
+    assert m["grid_ladder"] is None
+    assert m["market_regime"] == pytest.approx(0.2, abs=1e-5)  # 0.7 - 0.5
+
+    # no eligible rows → every margin decodes None
+    zeros = jnp.zeros((S,), bool)
+    block2 = _numeric_digest_block(
+        pack5, pack15, _Summary(), jnp.zeros((S,)), jnp.zeros((S,)),
+        zeros, zeros, zeros, zeros, zeros, jnp.zeros((S,), bool),
+        wire_fields_only=True, sp=None, context=None,
+    )
+    dec2 = decode_numeric_digest(np.asarray(block2))
+    assert all(v is None for v in dec2["margin"].values())
+
+
+def test_auto_sweep_chunk_derivation():
+    """Sweep memory-budget satellite: huge grids drop the chunk to fit the
+    P x S x 80 x 4B dominant term; small grids keep the configured chunk;
+    the floor is 1."""
+    from binquant_tpu.backtest.driver import _auto_sweep_chunk
+
+    # small grid: untouched
+    assert _auto_sweep_chunk(16, 4, 64, 1024) == 16
+    # huge grid: P*S*320B = 4096*512*320 = 671 MB/tick → 1 tick fits
+    assert _auto_sweep_chunk(16, 4096, 512, 1024) == 1
+    # mid grid scales between
+    mid = _auto_sweep_chunk(64, 256, 256, 1024)
+    assert 1 <= mid <= 64
+    assert mid == min(64, (1024 << 20) // (256 * 256 * 320))
+    # floor at 1 even when the budget is smaller than one tick
+    assert _auto_sweep_chunk(16, 10_000, 4096, 64) == 1
+
+
+@pytest.mark.slow
+def test_backtest_ext_end_to_end_set_equality(tmp_path):
+    """End-to-end governed pin: on a generated replay stream the
+    BQT_EXT_INVARIANT drive's emitted signal set equals the default
+    vmapped drive's (any legal divergence must hide inside declared gate
+    margins — none does on this stream), and the chunks actually batched.
+
+    Slow-marked (two full replay drives): runs via ``make backtest-smoke``
+    next to the PR 6 fixture/overflow/rewrite drills."""
+    from binquant_tpu.backtest import run_backtest
+    from binquant_tpu.io.replay import generate_replay_file
+
+    path = tmp_path / "ext.jsonl"
+    generate_replay_file(path, n_symbols=16, n_ticks=112)
+    default: list = []
+    d_stats = run_backtest(
+        path, capacity=32, window=120, collect=default, chunk=16,
+    )
+    ext: list = []
+    e_stats = run_backtest(
+        path, capacity=32, window=120, collect=ext, chunk=16,
+        ext_invariant=True,
+    )
+    assert set(default) == set(ext), {
+        "only_default": sorted(set(default) - set(ext))[:5],
+        "only_ext": sorted(set(ext) - set(default))[:5],
+    }
+    assert len(default) > 0
+    assert e_stats["backtest_chunks"] >= 2
+    assert e_stats["ticks"] == d_stats["ticks"]

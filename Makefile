@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke ingest-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke ingest-smoke soak soak-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -139,6 +139,27 @@ help:
 	@echo "               kernel number is 'python bench.py"
 	@echo "               --fanout-throughput' (writes"
 	@echo "               BENCH_FANOUT_CPU.json)"
+	@echo "  soak       - production-day soak observatory (ISSUE 18): ONE"
+	@echo "               compressed-time multi-exchange drill (binance +"
+	@echo "               live-format kucoin frames through the real"
+	@echo "               connector) against the FULL engine — delivery,"
+	@echo "               fan-out, every observability plane ON — with"
+	@echo "               seven overlapping fault kinds (listing churn,"
+	@echo "               kucoin-only + binance feed deaths, rewrite"
+	@echo "               storm, staggered pulse outage, wedged consumer"
+	@echo "               + cursor replay, autotrade 5xx storm, HARD"
+	@echo "               kill + checkpoint restore), judged concurrently"
+	@echo "               into one verdict JSON (freshness, staleness,"
+	@echo "               delivery, fanout, parity planes; every breach"
+	@echo "               attributed to its fault window, every fault"
+	@echo "               proven non-vacuous). Headline numbers are"
+	@echo "               git_sha-stamped into BENCH_SOAK_CPU.json,"
+	@echo "               merged into BENCH_TRAJECTORY.json and gated"
+	@echo "               (tools/bench_trajectory.py --gate); the"
+	@echo "               post-mortem renders via tools/soak_report.py"
+	@echo "  soak-smoke - the tier-1 soak pytest lane (judge folding,"
+	@echo "               probe latch, kucoin stream round trip, gate,"
+	@echo "               report golden) + the minutes-scale smoke drill"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run; gated"
 	@echo "               to ONE shard-compatible executable by default"
 	@echo "               (BQT_DRYRUN_PHASES=tick_step — the three-"
@@ -334,6 +355,36 @@ fanout-smoke:
 	print({k: v for k, v in facts.items() if k != 'checks'}); \
 	assert facts['ok'], facts['checks']"
 	python tools/fanout_report.py /tmp/bqt_fanout_events.jsonl --top 5
+
+# The production-day soak observatory (ISSUE 18): the full-scale drill
+# writes /tmp/bqt_soak/soak_verdict.json + BENCH_SOAK_CPU.json, the
+# PR 15 merger folds the headline numbers into BENCH_TRAJECTORY.json,
+# and the --gate tripwire fails the target if candles/s fell >50% or
+# the worst close->ack p99 more than doubled vs the previous record.
+soak:
+	rm -rf /tmp/bqt_soak
+	JAX_PLATFORMS=cpu python -c "from binquant_tpu.soak import soak_drill; \
+	facts = soak_drill(workdir='/tmp/bqt_soak', full=True, \
+	bench_path='BENCH_SOAK_CPU.json'); \
+	print({k: facts[k] for k in ('ok', 'candles_per_s', \
+	'close_ack_p99_ms', 'unacked_at_kill', 'wal_replayed')}); \
+	assert facts['ok'], facts['checks']"
+	python tools/bench_trajectory.py
+	python tools/bench_trajectory.py \
+		--gate soak_candles_per_s:up:0.5 \
+		--gate detail.close_ack_p99_ms:down:1.0
+	python tools/soak_report.py /tmp/bqt_soak/soak_verdict.json
+
+soak-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q \
+		-m 'not slow' -p no:cacheprovider
+	rm -rf /tmp/bqt_soak_smoke
+	JAX_PLATFORMS=cpu python -c "from binquant_tpu.soak import soak_drill; \
+	facts = soak_drill(workdir='/tmp/bqt_soak_smoke', full=False); \
+	print({k: facts[k] for k in ('ok', 'candles_per_s', \
+	'close_ack_p99_ms', 'unacked_at_kill', 'wal_replayed')}); \
+	assert facts['ok'], facts['checks']"
+	python tools/soak_report.py /tmp/bqt_soak_smoke/soak_verdict.json
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

@@ -214,9 +214,12 @@ class Config:
         window views. Governed — windowed cumsum/EWM fields carry ulp-scale
         drift vs the per-tick views, bounded by the strategies' declared
         gate margins (strategies/params.py declared_gate_margins; README
-        §Backtest). BQT_EXT_INVARIANT=1 opts in; the default vmapped path
-        stays bit-identical to the serial drive."""
-        return self._get("BQT_EXT_INVARIANT", "0") == "1"
+        §Backtest). Default ON since ISSUE 18: the margin contract is now
+        pinned per-scenario inside the soak bed (soak/drill.py ext-parity
+        stage), so the fast path is the default path. BQT_EXT_INVARIANT=0
+        opts back out to the per-tick gathered views, which stay
+        bit-identical to the serial drive."""
+        return self._get("BQT_EXT_INVARIANT", "1") == "1"
 
     @cached_property
     def sweep_mem_budget_mb(self) -> int:

@@ -108,6 +108,10 @@ class IngestHealthMonitor:
         self.churn_total = 0
         self.arrivals = 0
         self.feed_lag_last_ms: dict[str, float] = {}
+        # per-exchange newest candle close ever seen — the watermark a
+        # soak judge reads DURING an exchange-scoped outage, when
+        # feed_lag_last_ms (a per-arrival measurement) goes quiet
+        self.exchange_close_ms: dict[str, int] = {}
         # raw digest capture for equality drills (tests/scenarios only)
         self.record_history = False
         self.digests: list = []
@@ -128,6 +132,8 @@ class IngestHealthMonitor:
         lag = now_ms - float(close_ms)
         INGEST_FEED_LAG.labels(exchange=exchange).observe(max(lag, 0.0))
         self.feed_lag_last_ms[exchange] = lag
+        if int(close_ms) > self.exchange_close_ms.get(exchange, 0):
+            self.exchange_close_ms[exchange] = int(close_ms)
         self.arrivals += 1
         row = self.registry.row_of(symbol)
         if row is None:
@@ -135,6 +141,18 @@ class IngestHealthMonitor:
         if close_ms > self.last_event_ms[row]:
             self.last_event_ms[row] = int(close_ms)
         self.last_arrival_wall_ms[row] = now_ms
+
+    def exchange_watermarks(self, now_ms: float) -> dict[str, float]:
+        """Per-exchange feed-lag watermark vs NOW: how far behind ``now``
+        each exchange's newest candle close is. Unlike
+        ``feed_lag_last_ms`` (a measurement taken at arrival time, frozen
+        when a feed dies), this keeps growing through an exchange-scoped
+        outage — the surface a soak drill asserts diverges during a
+        kucoin-only feed death while binance stays fresh."""
+        return {
+            ex: float(now_ms) - float(close)
+            for ex, close in self.exchange_close_ms.items()
+        }
 
     def note_applied_batch(
         self,
@@ -447,5 +465,6 @@ class IngestHealthMonitor:
             "feed_lag_last_ms": {
                 k: round(v, 1) for k, v in self.feed_lag_last_ms.items()
             },
+            "exchange_close_ms": dict(self.exchange_close_ms),
             "last_digest": self.last,
         }

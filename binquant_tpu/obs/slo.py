@@ -49,6 +49,14 @@ class SloRegistry:
         self.event_every = max(int(event_every), 1)
         self._slos: dict[str, dict] = {}
         self._invariants: dict[str, Callable[[], Any]] = {}
+        # drill-phase window (ISSUE 18): while set, observations tally
+        # into per-phase windows and burn/recover events carry the label
+        self.phase: str | None = None
+        # mid-run invariant probes (ISSUE 18): failures LATCH — a
+        # transient violation that self-heals before shutdown must not
+        # read as a clean verdict
+        self._probes_run = 0
+        self._probe_failures: dict[str, int] = {}
 
     # -- registration ---------------------------------------------------------
 
@@ -95,6 +103,12 @@ class SloRegistry:
         bare truthy/falsy value."""
         self._invariants[name] = probe
 
+    def begin_phase(self, phase: str | None) -> None:
+        """Stamp subsequent observations and burn/recover events with a
+        drill-phase window label (the soak judge's attribution surface;
+        ``None`` turns stamping back off)."""
+        self.phase = str(phase) if phase is not None else None
+
     # -- observations (burn/recover hysteresis) -------------------------------
 
     def observe(self, name: str, ok: bool, **detail: Any) -> None:
@@ -109,6 +123,14 @@ class SloRegistry:
         slo["observations"] += 1
         if detail:
             slo["last"] = detail
+        if self.phase is not None:
+            window = slo.setdefault("phases", {}).setdefault(
+                self.phase, {"observations": 0, "breaches": 0}
+            )
+            window["observations"] += 1
+            if not ok:
+                window["breaches"] += 1
+        stamp = {"phase": self.phase} if self.phase is not None else {}
         if not ok:
             slo["breaches"] += 1
             slo["burn_obs"] += 1
@@ -119,16 +141,22 @@ class SloRegistry:
             if entering or slo["burn_obs"] % self.event_every == 0:
                 # force-emit on burn ENTRY, then at the sampling cadence
                 # (the IngestHealthMonitor pattern — a sustained outage
-                # must not flood one event per failing observation)
+                # must not flood one event per failing observation).
+                # Reserved event fields win over same-named detail keys
+                # (observe_digest passes its own `budget`) — an observer's
+                # detail vocabulary must never crash the registry.
                 get_event_log().emit(
                     "slo_burn",
-                    slo=name,
-                    kind=slo["kind"],
-                    budget=slo["budget"],
-                    unit=slo["unit"],
-                    burn_obs=slo["burn_obs"],
-                    entering=entering,
-                    **detail,
+                    **{
+                        **detail,
+                        **stamp,
+                        "slo": name,
+                        "kind": slo["kind"],
+                        "budget": slo["budget"],
+                        "unit": slo["unit"],
+                        "burn_obs": slo["burn_obs"],
+                        "entering": entering,
+                    },
                 )
         else:
             if slo["burning"]:
@@ -136,10 +164,13 @@ class SloRegistry:
                 SLO_RECOVERIES.labels(slo=name).inc()
                 get_event_log().emit(
                     "slo_recover",
-                    slo=name,
-                    kind=slo["kind"],
-                    burn_obs=slo["burn_obs"],
-                    **detail,
+                    **{
+                        **detail,
+                        **stamp,
+                        "slo": name,
+                        "kind": slo["kind"],
+                        "burn_obs": slo["burn_obs"],
+                    },
                 )
             slo["burning"] = False
             slo["burn_obs"] = 0
@@ -164,15 +195,47 @@ class SloRegistry:
                 out[name] = {"ok": bool(result)}
         return out
 
+    def probe_invariants(self) -> dict[str, dict]:
+        """Mid-run invariant probe cadence (ISSUE 18): run every probe
+        NOW and LATCH any failure into the registry. ``verdict()`` alone
+        probes only at read time — a transient zero-loss violation that
+        self-heals before shutdown would read as a clean verdict. A
+        failing mid-run probe also emits ``invariant_probe_failed``
+        (phase-stamped) so a concurrent judge can attribute it."""
+        report = self.invariants_report()
+        if not self.enabled:
+            return report
+        self._probes_run += 1
+        for name, result in report.items():
+            if not result.get("ok", False):
+                self._probe_failures[name] = (
+                    self._probe_failures.get(name, 0) + 1
+                )
+                get_event_log().emit(
+                    "invariant_probe_failed",
+                    invariant=name,
+                    probe=self._probes_run,
+                    **(
+                        {"phase": self.phase}
+                        if self.phase is not None
+                        else {}
+                    ),
+                    detail={k: v for k, v in result.items() if k != "ok"},
+                )
+        return report
+
     def verdict(self) -> dict:
         """THE machine-readable pass/fail JSON: every SLO's burn state +
         every invariant probe, folded into one top-level ``ok``. A
         disabled registry verdicts ``ok: None`` — neither a false green
-        nor a false alarm."""
+        nor a false alarm. Failures latched by a mid-run
+        :meth:`probe_invariants` cadence keep the fold red even after the
+        probed fact self-heals."""
         if not self.enabled:
             return {"enabled": False, "ok": None, "slos": {}, "invariants": {}}
-        slos = {
-            name: {
+        slos = {}
+        for name, slo in self._slos.items():
+            cell = {
                 "ok": not slo["burning"],
                 "kind": slo["kind"],
                 "budget": slo["budget"],
@@ -184,18 +247,29 @@ class SloRegistry:
                 "recoveries": slo["recoveries"],
                 "last": dict(slo["last"]),
             }
-            for name, slo in self._slos.items()
-        }
+            if slo.get("phases"):
+                cell["phases"] = {
+                    ph: dict(w) for ph, w in slo["phases"].items()
+                }
+            slos[name] = cell
         invariants = self.invariants_report()
-        ok = all(s["ok"] for s in slos.values()) and all(
-            inv.get("ok", False) for inv in invariants.values()
+        ok = (
+            all(s["ok"] for s in slos.values())
+            and all(inv.get("ok", False) for inv in invariants.values())
+            and not self._probe_failures
         )
-        return {
+        out = {
             "enabled": True,
             "ok": ok,
             "slos": slos,
             "invariants": invariants,
         }
+        if self._probes_run:
+            out["probes"] = {
+                "runs": self._probes_run,
+                "failures": dict(self._probe_failures),
+            }
+        return out
 
     def snapshot(self) -> dict:
         """The ``GET /debug/slo`` payload (and the /healthz ``slo``

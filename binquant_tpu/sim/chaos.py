@@ -592,19 +592,32 @@ def delivery_chaos_drill(workdir: str | None = None) -> dict:
                 break
             await asyncio.sleep(0.01)
         # HARD KILL: cancel the workers mid-flight — no drain, no ack
-        # flush, no WAL compaction (what SIGKILL leaves behind)
-        for lane in victim.delivery._lanes.values():
-            if lane.worker is not None:
-                lane.worker.cancel()
-        await asyncio.gather(
-            *(
-                lane.worker
-                for lane in victim.delivery._lanes.values()
-                if lane.worker is not None
-            ),
-            return_exceptions=True,
-        )
+        # flush, no WAL compaction (what SIGKILL leaves behind). closed
+        # goes up BEFORE the cancels and the join re-cancels on a short
+        # timeout — 3.10's wait_for can swallow a cancel that lands as
+        # the inner attempt completes (bpo-42130, same defense as
+        # DeliveryPlane.aclose); a swallow-survivor then parks on
+        # queue.get forever and a bare gather deadlocks the drill.
+        # Neither flag nor re-cancel acks or compacts anything, so the
+        # WAL state the restore finds is still exactly SIGKILL residue.
         victim.delivery.closed = True
+        workers = [
+            lane.worker
+            for lane in victim.delivery._lanes.values()
+            if lane.worker is not None
+        ]
+        for w in workers:
+            w.cancel()
+        for w in workers:
+            for _ in range(25):
+                done, _pending = await asyncio.wait({w}, timeout=0.2)
+                if done:
+                    try:
+                        await w
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                    break
+                w.cancel()
         victim.delivery.wal.close()
         return {
             "breaker_transitions": list(breaker.transitions),

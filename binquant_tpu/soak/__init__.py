@@ -1,0 +1,46 @@
+"""Production-day soak observatory (ISSUE 18).
+
+One compressed-time full-stack drill — multi-exchange stream, seven
+overlapping fault kinds including a kill/checkpoint-restore — judged
+concurrently by every SLO plane into a single machine-readable verdict.
+
+* :mod:`binquant_tpu.soak.judge` — fault schedule, per-plane episode
+  attribution, non-vacuity enforcement, THE verdict fold;
+* :mod:`binquant_tpu.soak.stream` — live-format kucoin frames through
+  the real connector seam, merged with the binance scenario stream;
+* :mod:`binquant_tpu.soak.drill` — the orchestrator behind ``make soak``
+  / ``make soak-smoke``.
+"""
+
+from binquant_tpu.soak.judge import (
+    FaultSchedule,
+    FaultWindow,
+    SoakJudge,
+    plane_of,
+)
+from binquant_tpu.soak.stream import (
+    kucoin_frame,
+    kucoin_scenario_stream,
+    merge_streams,
+    synthetic_klines,
+)
+
+__all__ = [
+    "FaultSchedule",
+    "FaultWindow",
+    "SoakJudge",
+    "plane_of",
+    "kucoin_frame",
+    "kucoin_scenario_stream",
+    "merge_streams",
+    "synthetic_klines",
+    "soak_drill",
+]
+
+
+def soak_drill(*args, **kwargs):
+    """Lazy forwarder — importing the package must not pull the engine
+    stack (jax) until a drill actually runs."""
+    from binquant_tpu.soak.drill import soak_drill as _drill
+
+    return _drill(*args, **kwargs)

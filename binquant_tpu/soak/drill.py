@@ -1,0 +1,681 @@
+"""The production-day soak orchestrator (ISSUE 18 tentpole).
+
+Every chaos lane so far exercised one plane in isolation. This drill
+scripts a compressed-time multi-exchange market (binance + live-format
+kucoin frames through the real connector seam) against a FULL engine —
+delivery outbox, fan-out hub, freshness/staleness/outcome observatories
+all pinned ON — while a fault scheduler overlaps seven fault kinds:
+
+* listing churn (a symbol claims its registry row mid-stream);
+* a kucoin-only feed death (the per-exchange watermarks must diverge);
+* a binance per-symbol feed death overlapping it;
+* a candle-rewrite correction storm;
+* a pulse outage recovering AT its capitulation hammer's bucket, the
+  catch-up tick processed ten minutes late in wall-clock — the drained
+  hammer's signal burns the freshness SLO and every delivery lane's
+  close→ack SLO organically;
+* a wedged fan-out consumer + cursor-replay reconnect, with a scripted
+  slow-ack probe burning ``delivery.fanout``;
+* an autotrade sink 5xx storm walking the breaker open, into
+* a HARD KILL (workers cancelled, WAL unacked) + checkpoint restore that
+  resumes the drill mid-storm.
+
+A :class:`~binquant_tpu.soak.judge.SoakJudge` rides the SLO registry's
+burn/recover/probe events the whole way, attributes every episode to its
+fault window, enforces non-vacuity, and folds ONE machine-readable
+verdict JSON. Headline numbers (candles/s, worst close→ack p99, max burn
+lengths per plane) are git_sha-stamped into a BENCH record for the PR 15
+trajectory merger, gated by ``tools/bench_trajectory.py --gate``.
+
+Run via ``make soak`` (full) / ``make soak-smoke`` (minutes-scale).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+from binquant_tpu.soak.judge import FaultSchedule, FaultWindow, SoakJudge
+from binquant_tpu.soak.stream import (
+    kucoin_scenario_stream,
+    merge_streams,
+    synthetic_klines,
+)
+
+FIFTEEN_MS = 900 * 1000
+
+#: every drill sink the wash + p99 sweep walks
+_SINKS = ("autotrade", "telegram", "analytics", "fanout")
+
+
+def fault_schedule(n_ticks: int) -> FaultSchedule:
+    """The soak's fault script, anchored to the stream length (early
+    faults land in the pre-arming soak region; the signal-bearing pulses
+    sit past MIN_BARS, stacked against the wedge/storm/kill endgame)."""
+    t = n_ticks
+    return FaultSchedule(
+        [
+            FaultWindow(
+                "listing_churn", "listing_churn", 28, 42,
+                may=("staleness", "freshness"), probe="churn_routing",
+            ),
+            FaultWindow(
+                "kucoin_outage", "feed_outage_kucoin", 49, 67,
+                may=("staleness", "freshness"), expect=("staleness",),
+            ),
+            FaultWindow(
+                "binance_feed_outage", "feed_outage", 59, 75,
+                may=("staleness", "freshness"), expect=("staleness",),
+            ),
+            FaultWindow(
+                "rewrite_storm", "rewrite_storm", 79, 88,
+                may=("staleness", "freshness"), probe="rewrite_routing",
+            ),
+            FaultWindow(
+                "pulse_outage", "feed_outage", t - 14, t - 5,
+                may=("staleness", "freshness", "delivery", "fanout"),
+                expect=("freshness", "staleness"),
+            ),
+            FaultWindow(
+                "wedged_consumer", "fanout_wedge", t - 11, t - 3,
+                may=("fanout", "delivery"), expect=("fanout",),
+                probe="wedge",
+            ),
+            FaultWindow(
+                "sink_5xx_storm", "sink_5xx", t - 7, t - 2,
+                may=("delivery",), probe="sink_storm",
+            ),
+            FaultWindow(
+                "kill_restore", "kill_restore", t - 5, t - 1,
+                may=("delivery", "fanout", "freshness", "staleness"),
+                probe="wal_replay",
+            ),
+        ]
+    )
+
+
+def build_soak_stream(
+    workdir: Path, n_ticks: int, n_binance: int, n_kucoin: int
+) -> tuple[Path, int, "object"]:
+    """Compose the two-exchange faulted stream; returns (path, line
+    count, the binance ScenarioSpec driving engine shapes)."""
+    from binquant_tpu.sim.scenarios import (
+        ScenarioSpec,
+        _bleed_then_hammer,
+        _bucket0,
+        _tick_of,
+        base_market,
+        emit_stream,
+        feed_outage,
+        listing_churn,
+        rewrite_storm,
+    )
+
+    t = n_ticks
+    spec = ScenarioSpec(
+        name="soak",
+        description="production-day soak: two exchanges, seven faults",
+        n_symbols=n_binance,
+        n_ticks=t,
+        seed=37,
+    )
+    closes, vols, _rng = base_market(spec)
+    shapes: dict = {}
+    # three MRF pulses: A evaluated LATE from the staggered catch-up
+    # drain (freshness + delivery burns), B fresh into the 5xx storm
+    # pre-kill, C fresh post-restore (signals on both sides of the kill)
+    _bleed_then_hammer(closes, vols, shapes, (2, 5, 8), t - 46, t - 9)
+    _bleed_then_hammer(closes, vols, shapes, (3, 6), t - 40, t - 4)
+    _bleed_then_hammer(closes, vols, shapes, (4, 7), t - 35, t - 1)
+    klines = emit_stream(spec, closes, vols, shapes)
+    # a symbol lists mid-stream (row claimed at its first drain)
+    listing_churn(klines, {n_binance - 1: 30}, {}, n_binance)
+    # correction storm: already-applied candles re-delivered shifted
+    rewrite_storm(klines, range(80, 84))
+    # binance per-symbol feed death overlapping the kucoin outage
+    feed_outage(klines, (5, 6), range(60, 73), 73, n_binance)
+    # the pulse outage: the feed dies through the bleed's last buckets
+    # and recovers AT the hammer bucket (t-9) — the backlog drains in the
+    # same tick as the hammer's own bar, so the hammer is the final,
+    # FRESH, evaluated sub-batch (the get_fresh_symbols gate sidelines
+    # any row whose newest bar is older than the evaluated bucket — a
+    # bar deferred past its own bucket can never fire). The drill stalls
+    # that tick's clock (soak_drill) so the drained signal is truly late.
+    feed_outage(klines, (2, 5, 8), range(t - 13, t - 9), t - 9, n_binance)
+
+    # the kucoin side: synthetic market → live ws frames → the REAL
+    # connector → exchange-tagged klines; then a kucoin-only outage
+    kc_names = [f"K{i:03d}USDT" for i in range(1, n_kucoin + 1)]
+    kc = kucoin_scenario_stream(synthetic_klines(kc_names, t))
+    b0 = _bucket0()
+    for k in kc:
+        if 50 <= _tick_of(k) <= 64:
+            k["_deliver_bucket"] = b0 + 65
+
+    path = workdir / "soak_stream.jsonl"
+    lines = merge_streams(path, klines, kc)
+    return path, lines, spec
+
+
+def _ext_parity(
+    workdir: Path, soak_stream: Path, spec, full: bool
+) -> dict:
+    """Satellite 2: the governed ext-path parity pins inside the soak
+    bed — default-vs-ext signal-set equality on the soak stream itself
+    (smoke) plus the registered scenario corpus (full)."""
+    from binquant_tpu.backtest.driver import run_backtest
+    from binquant_tpu.sim.scenarios import SCENARIOS, write_scenario_file
+
+    runs: dict[str, bool] = {}
+    errors: dict[str, str] = {}
+
+    def one(name: str, path: Path, sp) -> None:
+        try:
+            collected: dict[bool, list] = {}
+            for ext in (False, True):
+                out: list = []
+                run_backtest(
+                    path,
+                    capacity=sp.capacity,
+                    window=sp.window,
+                    breadth=sp.breadth,
+                    enabled_strategies=set(sp.enabled_strategies),
+                    chunk=16,
+                    collect=out,
+                    ext_invariant=ext,
+                )
+                collected[ext] = out
+            runs[name] = set(collected[False]) == set(collected[True])
+        except Exception as exc:  # a crash is a parity failure, loudly
+            runs[name] = False
+            errors[name] = repr(exc)
+
+    one("soak_stream", soak_stream, spec)
+    if full:
+        corpus_dir = workdir / "corpus"
+        corpus_dir.mkdir(exist_ok=True)
+        for name, scenario in SCENARIOS.items():
+            path = corpus_dir / f"{name}.jsonl"
+            write_scenario_file(scenario, path)
+            one(name, path, scenario.spec)
+    return {"ok": all(runs.values()), "runs": runs, "errors": errors}
+
+
+def soak_drill(
+    workdir: str | None = None,
+    full: bool = False,
+    bench_path: str | None = None,
+) -> dict:
+    """Run the soak; returns the facts dict (``facts["verdict"]`` is THE
+    machine-readable verdict, also written to ``soak_verdict.json``)."""
+    import tempfile
+
+    from binquant_tpu.fanout.hub import _Connection, ws_read_frame
+    from binquant_tpu.fanout.registry import Subscription
+    from binquant_tpu.io.checkpoint import load_state, save_state
+    from binquant_tpu.io.delivery import DeliveryWal
+    from binquant_tpu.io.replay import (
+        make_stub_engine,
+        signal_tuples,
+        tick_seq,
+    )
+    from binquant_tpu.sim.chaos import FlakySink, _autotrade_key
+
+    workdir = Path(workdir or tempfile.mkdtemp(prefix="bqt_soak_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    t = 224 if full else 112
+    n_binance, n_kucoin = (16, 4) if full else (12, 3)
+    stream, lines, spec = build_soak_stream(
+        workdir, t, n_binance, n_kucoin
+    )
+    seq = tick_seq(stream)
+    assert len(seq) == t, (len(seq), t)
+    # The pulse-outage recovery tick processes its catch-up drain LATE:
+    # +10 min inside the same bucket, so ts15/routing/signal-set parity
+    # are untouched, but close→emit and close→ack genuinely measure the
+    # stall — the drained hammer is a real 600 s late signal against the
+    # 120 s freshness budget, not a simulated breach. Applied to the ONE
+    # shared seq so the oracle sees identical signal tick_ms stamps.
+    stall = t - 9
+    seq[stall] = (seq[stall][0] + 600_000, seq[stall][1])
+    kill_after = t - 4  # last victim tick; resumed drives t-3 .. t-1
+    schedule = fault_schedule(t)
+    judge = SoakJudge(schedule, probe_every=2)
+    facts: dict = {"ticks": t, "lines": lines, "workdir": str(workdir)}
+
+    # ext parity runs OUTSIDE the judge tap (its throwaway backtest
+    # engines must not leak burns into the soak attribution)
+    ext = _ext_parity(workdir, stream, spec, full)
+    facts["ext_parity"] = ext
+
+    knobs = dict(
+        delivery_queue_max=64,
+        delivery_attempt_timeout_s=2.0,
+        delivery_retry_max=2,
+        delivery_backoff_s=0.01,
+        delivery_backoff_max_s=0.05,
+        delivery_breaker_threshold=2,
+        delivery_breaker_cooldown_s=0.05,
+        wal_compact_every=0,  # the kill must find an uncompacted WAL
+        slo_enabled=True,
+        delivery_health_enabled=True,
+        delivery_slo_ms=25.0,
+        slo_window=4,
+        slo_event_every=4,
+    )
+
+    def build(wal: Path, fanout: bool):
+        return make_stub_engine(
+            capacity=spec.capacity,
+            window=spec.window,
+            incremental=True,
+            scan_chunk=spec.scan_chunk,
+            enabled_strategies=set(spec.enabled_strategies),
+            host_phase=True,
+            freshness=True,
+            freshness_slo_ms=120_000.0,
+            outcomes=True,
+            outcome_horizons=(1, 4),
+            delivery=True,
+            delivery_wal=str(wal),
+            delivery_overrides=dict(knobs),
+            fanout=fanout,
+            fanout_overrides=(
+                {"fanout_capacity": 64, "fanout_outbox_cap": 4096}
+                if fanout
+                else None
+            ),
+            ingest_digest=True,
+            ingest_stale_budget=0,
+        )
+
+    async def drive(engine, ticks, faults=None, out=None):
+        for idx, (now_ms, klines) in ticks:
+            judge.note_tick(idx)
+            if faults is not None:
+                await faults(idx, now_ms)
+            for k in klines:
+                engine.ingest(k)
+            res = await engine.process_tick(now_ms=now_ms)
+            if out is not None:
+                out.extend(res)
+            # hand the loop to the delivery/fan-out workers every tick —
+            # a drive that never awaits real I/O starves them, deferring
+            # every broadcast and ack to the first socket await (which
+            # lands mid-endgame, AFTER the wedged consumer is replaced)
+            for _ in range(8):
+                await asyncio.sleep(0)
+        if out is not None:
+            out.extend(await engine.flush_pending())
+        else:
+            await engine.flush_pending()
+
+    # -- the uninterrupted oracle (no judge, healthy sinks) ------------------
+    oracle = build(workdir / "oracle.wal.jsonl", fanout=False)
+    at_oracle = FlakySink(oracle.delivery.lane("autotrade").sink)
+    oracle.delivery.lane("autotrade").sink = at_oracle
+    oracle_out: list = []
+
+    async def run_oracle() -> None:
+        oracle.delivery.start()
+        for now_ms, klines in seq:
+            for k in klines:
+                oracle.ingest(k)
+            oracle_out.extend(await oracle.process_tick(now_ms=now_ms))
+        oracle_out.extend(await oracle.flush_pending())
+        await oracle.delivery.aclose(drain_s=10.0)
+
+    asyncio.run(run_oracle())
+    oracle_keys = {_autotrade_key(p) for p in at_oracle.delivered}
+    oracle_matured = oracle.outcomes.matured_set()
+
+    # -- the victim under the judge ------------------------------------------
+    wal_path = workdir / "victim.wal.jsonl"
+    victim = build(wal_path, fanout=True)
+    at_victim = FlakySink(victim.delivery.lane("autotrade").sink)
+    victim.delivery.lane("autotrade").sink = at_victim
+    plane = victim.fanout
+    sloth_state: dict = {}
+    victim_out: list = []
+
+    judge.install()
+    judge.attach(victim.slo)
+
+    async def victim_faults(tick: int, now_ms: int) -> None:
+        # per-exchange watermark divergence, read mid-kucoin-outage
+        if tick == 62:
+            facts["watermarks_outage"] = (
+                victim.ingest_monitor.exchange_watermarks(now_ms)
+            )
+        if tick == t - 11:
+            # the wedged consumer: subscribed to everything, 2-slot
+            # queue, writer never drains (the fanout drill's chaos seam)
+            plane.subscribe(Subscription("sloth"))
+            sloth = _Connection(
+                "sloth",
+                plane.subscriptions.slot_of("sloth"),
+                "ws",
+                queue_max=2,
+            )
+            plane.hub._conns.add(sloth)
+            sloth_state["conn"] = sloth
+            sloth_state["port"] = await plane.serve(0, host="127.0.0.1")
+        if tick == t - 8:
+            # wedge-period slow-ack probe through the delivery-health
+            # collector: one 500 ms fanout ack pins the 4-sample p99
+            victim.delivery_health.on_ack("fanout", 500.0)
+        if tick == t - 5:
+            # the cursor-lag watermark must catch the wedge WHILE the
+            # sloth is registered; then the reconnect replays its gap
+            sloth_state["cursor_lag"] = plane.hub.cursor_lag()
+            await _cursor_replay(plane, sloth_state)
+        if tick == t - 6:
+            # autotrade sink 5xx storm until the kill
+            at_victim.plan[:] = ["5xx"] * 10_000
+
+    async def _cursor_replay(plane, st) -> None:
+        sloth = st.pop("conn")
+        plane.hub._conns.discard(sloth)
+        st["dropped"] = sloth.dropped
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", st["port"]
+        )
+        writer.write(
+            b"GET /ws?user=sloth&cursor=-1 HTTP/1.1\r\nHost: x\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Key: dGhlIHNhbXBsZQ==\r\n\r\n"
+        )
+        await writer.drain()
+        await reader.readline()
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+        slot = plane.subscriptions.slot_of("sloth")
+        addressed = [
+            f["seq"]
+            for f, words in plane.outbox.entries()
+            if (
+                slot >> 5 < len(words)
+                and (int(words[slot >> 5]) >> (slot & 31)) & 1
+            )
+        ]
+        replayed: list = []
+        try:
+            while len(replayed) < len(addressed):
+                opcode, payload = await asyncio.wait_for(
+                    ws_read_frame(reader), timeout=5.0
+                )
+                if opcode == 0x1:
+                    replayed.append(json.loads(payload)["seq"])
+        except (TimeoutError, asyncio.TimeoutError):
+            pass
+        writer.close()
+        st["addressed"] = len(addressed)
+        st["replayed_gap"] = replayed == addressed
+
+    async def run_victim() -> None:
+        victim.delivery.start()
+        await drive(
+            victim,
+            list(enumerate(seq))[: kill_after + 1],
+            faults=victim_faults,
+            out=victim_out,
+        )
+        # wait for the storm to walk the breaker open, then force one
+        # mid-run invariant probe: the open breaker LATCHES into the
+        # registry and lands on the judge attributed to the storm window
+        breaker = victim.delivery.breaker("autotrade")
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline and breaker.state != "open":
+            await asyncio.sleep(0.01)
+        facts["breaker_transitions"] = list(breaker.transitions)
+        victim.slo.probe_invariants()
+        # HARD KILL: cancel the workers mid-flight — no drain, no ack
+        # flush, no WAL compaction (what SIGKILL leaves behind)
+        for lane in victim.delivery._lanes.values():
+            if lane.worker is not None:
+                lane.worker.cancel()
+        await asyncio.gather(
+            *(
+                lane.worker
+                for lane in victim.delivery._lanes.values()
+                if lane.worker is not None
+            ),
+            return_exceptions=True,
+        )
+        victim.delivery.closed = True
+        victim.delivery.wal.close()
+        await victim.aclose_fanout()
+
+    wall0 = time.perf_counter()
+    asyncio.run(run_victim())
+    victim_wall = time.perf_counter() - wall0
+    victim_p99 = {
+        s: victim.delivery_health.p99(s) for s in _SINKS
+    }
+    wal_probe = DeliveryWal(wal_path, fsync=False, compact_every=0)
+    unacked_at_kill = len(wal_probe.unacked())
+    wal_probe.close()
+    ckpt = workdir / "victim.ckpt.npz"
+    save_state(ckpt, victim.state, victim.registry, victim.host_carries())
+
+    # -- restore: same WAL, healthy sinks; replay then the stream tail -------
+    resumed = build(wal_path, fanout=True)
+    at_resumed = FlakySink(resumed.delivery.lane("autotrade").sink)
+    resumed.delivery.lane("autotrade").sink = at_resumed
+    state, carries = load_state(ckpt, resumed.state, resumed.registry)
+    resumed.state = state
+    resumed.restore_host_carries(carries)
+    resumed.note_state_restored(
+        migrated=bool(carries.get("_carry_rebuilt", False))
+    )
+    judge.attach(resumed.slo)
+    resumed_out: list = []
+
+    async def run_resumed() -> None:
+        resumed.delivery.start()  # WAL replay re-enqueues the storm tail
+        await drive(
+            resumed,
+            list(enumerate(seq))[kill_after + 1:],
+            out=resumed_out,
+        )
+        await resumed.delivery.drain(timeout_s=10.0)
+        facts["resumed_p99"] = {
+            s: resumed.delivery_health.p99(s) for s in _SINKS
+        }
+        # post-storm clean soak: wash every lane's tiny p99 window with
+        # in-budget acks so the recover edge fires deterministically
+        # (replayed entries report their true cross-kill lag — seconds)
+        for sink in _SINKS:
+            for _ in range(resumed.delivery_health.window):
+                resumed.delivery_health.on_ack(sink, 1.0)
+        await resumed.delivery.aclose(drain_s=10.0)
+        await resumed.aclose_fanout()
+
+    wall1 = time.perf_counter()
+    asyncio.run(run_resumed())
+    resumed_wall = time.perf_counter() - wall1
+
+    # -- parity planes (PR 12's outcome contract + signal-set equality) ------
+    sig_union = set(signal_tuples(victim_out)) | set(
+        signal_tuples(resumed_out)
+    )
+    sig_ok = sig_union == set(signal_tuples(oracle_out))
+    matured_union = (
+        victim.outcomes.matured_set() | resumed.outcomes.matured_set()
+    )
+    out_ok = matured_union == oracle_matured
+    delivered = [
+        _autotrade_key(p)
+        for p in (*at_victim.delivered, *at_resumed.delivered)
+    ]
+    zero_loss = not (oracle_keys - set(delivered))
+    zero_dup = len(delivered) == len(set(delivered))
+    reg = resumed.slo
+    reg.register("signal_parity", "parity", 0.0, unit="diffs")
+    reg.register("outcome_parity", "parity", 0.0, unit="diffs")
+    reg.register("ext_parity", "parity", 0.0, unit="runs")
+    reg.observe(
+        "signal_parity",
+        ok=sig_ok and zero_loss and zero_dup,
+        diffs=len(sig_union ^ set(signal_tuples(oracle_out))),
+        lost=len(oracle_keys - set(delivered)),
+        duplicates=len(delivered) - len(set(delivered)),
+    )
+    reg.observe(
+        "outcome_parity",
+        ok=out_ok,
+        diffs=len(matured_union ^ oracle_matured),
+    )
+    reg.observe(
+        "ext_parity", ok=ext["ok"], runs=len(ext["runs"])
+    )
+    reg.probe_invariants()  # final end-state probe on the live registry
+
+    # watermark convergence after recovery (both feeds fresh again)
+    facts["watermarks_end"] = resumed.ingest_monitor.exchange_watermarks(
+        seq[-1][0]
+    )
+
+    # -- resolve the engine-side fault probes, then fold ---------------------
+    routing = victim.full_recompute_reasons
+    judge.resolve_probe("churn_routing", routing.get("churn", 0) >= 1)
+    judge.resolve_probe("rewrite_routing", routing.get("rewrite", 0) >= 1)
+    judge.resolve_probe(
+        "wedge",
+        sloth_state.get("dropped", 0) > 0
+        and sloth_state.get("cursor_lag", 0) >= 2
+        and bool(sloth_state.get("replayed_gap"))
+        and sloth_state.get("addressed", 0) > 0,
+    )
+    judge.resolve_probe(
+        "sink_storm",
+        len(facts.get("breaker_transitions", [])) >= 1
+        and unacked_at_kill > 0,
+    )
+    judge.resolve_probe(
+        "wal_replay",
+        resumed.delivery.wal_replayed > 0 and len(judge.attaches) == 2,
+    )
+    judge.finish()
+    verdict = judge.verdict()
+    judge.uninstall()
+
+    wm_out = facts.get("watermarks_outage", {})
+    wm_end = facts.get("watermarks_end", {})
+    diverged = (
+        wm_out.get("kucoin", 0.0) - wm_out.get("binance", float("inf"))
+        >= 5 * FIFTEEN_MS
+    )
+    converged = all(v <= 2 * FIFTEEN_MS for v in wm_end.values()) and {
+        "binance",
+        "kucoin",
+    } <= set(wm_end)
+    worst_p99 = max(
+        [v for v in victim_p99.values() if v is not None]
+        + [
+            v
+            for v in facts.get("resumed_p99", {}).values()
+            if v is not None
+        ]
+        + [0.0]
+    )
+    drive_wall = victim_wall + resumed_wall
+    checks = {
+        "judge_ok": bool(verdict["ok"]),
+        "signal_parity": sig_ok,
+        "outcome_parity": out_ok,
+        "zero_loss": zero_loss,
+        "zero_duplicate": zero_dup,
+        "ext_parity": ext["ok"],
+        "watermarks_diverged": bool(diverged),
+        "watermarks_converged": bool(converged),
+        "kill_left_unacked_wal": unacked_at_kill > 0,
+        "wal_replayed": resumed.delivery.wal_replayed > 0,
+        "fault_kinds": len({w.kind for w in schedule.windows}) >= 6,
+        "planes_judged": len(verdict["planes"]) >= 5,
+        "signals_both_sides": len(signal_tuples(victim_out)) > 0
+        and len(signal_tuples(resumed_out)) > 0,
+    }
+    facts.update(
+        ok=all(checks.values()),
+        checks=checks,
+        verdict=verdict,
+        candles_per_s=lines / drive_wall if drive_wall > 0 else 0.0,
+        close_ack_p99_ms=worst_p99,
+        drive_wall_s=drive_wall,
+        unacked_at_kill=unacked_at_kill,
+        wal_replayed=resumed.delivery.wal_replayed,
+        sloth=dict(sloth_state),
+        victim_p99=victim_p99,
+    )
+    (workdir / "soak_verdict.json").write_text(
+        json.dumps(
+            {
+                "ok": facts["ok"],
+                "checks": checks,
+                "mode": "full" if full else "smoke",
+                "headline": {
+                    "candles_per_s": facts["candles_per_s"],
+                    "close_ack_p99_ms": worst_p99,
+                    "max_burn_obs": {
+                        p: verdict["planes"][p]["max_burn_obs"]
+                        for p in verdict["planes"]
+                    },
+                },
+                "verdict": verdict,
+            },
+            indent=1,
+            default=str,
+        )
+        + "\n"
+    )
+    if bench_path:
+        _write_bench(Path(bench_path), facts, full)
+    return facts
+
+
+def _write_bench(path: Path, facts: dict, full: bool) -> None:
+    """The BENCH record the trajectory merger folds + --gate enforces."""
+    import subprocess
+
+    try:
+        sha = (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                text=True,
+                timeout=5,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:
+        sha = "unknown"
+    verdict = facts["verdict"]
+    record = {
+        "metric": "soak_candles_per_s",
+        "value": round(float(facts["candles_per_s"]), 1),
+        "unit": "candles/s",
+        "detail": {
+            "mode": "full" if full else "smoke",
+            "ticks": facts["ticks"],
+            "lines": facts["lines"],
+            "drive_wall_s": round(float(facts["drive_wall_s"]), 3),
+            "close_ack_p99_ms": round(
+                float(facts["close_ack_p99_ms"]), 1
+            ),
+            "verdict_ok": bool(verdict["ok"]),
+            "fault_windows": len(verdict["faults"]),
+            "episodes": len(verdict["episodes"]),
+            "max_burn_obs": {
+                p: verdict["planes"][p]["max_burn_obs"]
+                for p in verdict["planes"]
+            },
+            "unacked_at_kill": facts["unacked_at_kill"],
+            "wal_replayed": facts["wal_replayed"],
+        },
+        "measured_at_epoch_s": int(time.time()),
+        "git_sha": sha,
+    }
+    path.write_text(json.dumps(record, indent=1) + "\n")

@@ -1,0 +1,367 @@
+"""The concurrent soak judge (ISSUE 18).
+
+Every chaos lane so far judged one plane in isolation; the soak drill
+runs them all at once, so the verdict needs an attribution layer on top
+of the unified SLO registry (obs/slo.py): WHICH injected fault does each
+burn/recover episode belong to, and did every injected fault actually
+trip the plane it targets?
+
+* :class:`FaultWindow` / :class:`FaultSchedule` — the fault script: one
+  named tick window per injected fault, declaring the planes it MAY trip
+  (attribution set), the planes it MUST trip (non-vacuity set), and/or a
+  named engine probe the drill resolves at the end (faults whose
+  signature is a routing reason or a WAL fact, not an SLO burn).
+
+* :class:`SoakJudge` — rides the registry's ``slo_burn``/``slo_recover``
+  and ``invariant_probe_failed`` events (the same event-log tap idiom as
+  the chaos drills), accumulates per-plane burn/recover EPISODES, and
+  attributes each episode to the fault window(s) it overlaps. Folding
+  rules, per the ISSUE-18 contract:
+
+  - a burn whose ENTRY tick sits inside no matching fault window is an
+    **unattributed breach** → verdict failure;
+  - a fault window whose must-trip planes never burned (and whose probe,
+    if any, read false) is a **non-vacuity failure** → the drill proved
+    nothing about that fault → verdict failure;
+  - an episode still burning at drill end fails its plane;
+  - end-state invariants must all pass.
+
+  The judge survives the drill's kill/checkpoint-restore: the resumed
+  engine's fresh registry is re-:meth:`attach`-ed and an episode that was
+  open at the kill continues (a post-restore ``entering`` burn of the
+  same SLO extends it instead of opening a second one); an open episode
+  that never burns again after the restore is closed AT the restore —
+  the restart healed it.
+
+The judge is observation-driven and engine-free: tests feed it synthetic
+events through :meth:`on_event` directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from binquant_tpu.obs.events import get_event_log
+from binquant_tpu.obs.slo import SloRegistry
+
+#: canonical SLO/invariant name → judged plane
+_PLANES = ("freshness", "staleness", "delivery", "fanout", "parity")
+
+
+def plane_of(name: str) -> str:
+    """Map an SLO or invariant name to its judged plane."""
+    if name.startswith("delivery.fanout") or name.startswith("fanout"):
+        return "fanout"
+    if name.startswith("delivery"):
+        return "delivery"
+    if name == "freshness":
+        return "freshness"
+    if name == "staleness" or name.startswith("ingest"):
+        return "staleness"
+    if name.endswith("parity"):
+        return "parity"
+    return "other"
+
+
+@dataclass
+class FaultWindow:
+    """One injected fault's script entry: tick window + expectations."""
+
+    name: str
+    kind: str
+    start: int
+    end: int
+    #: planes whose burns inside [start, end] attribute to this fault
+    may: tuple[str, ...] = ()
+    #: planes that MUST burn (or the probe must pass) — non-vacuity
+    expect: tuple[str, ...] = ()
+    #: named engine probe the drill resolves at finish() (routing
+    #: reasons, WAL facts, cursor lag — fault signatures with no SLO)
+    probe: str | None = None
+    tripped: set = field(default_factory=set)
+
+    def covers(self, tick: int) -> bool:
+        return self.start <= tick <= self.end
+
+    def overlaps(self, start: int, end: int) -> bool:
+        return start <= self.end and end >= self.start
+
+
+class FaultSchedule:
+    """The drill's ordered fault script."""
+
+    def __init__(self, windows: list[FaultWindow]) -> None:
+        self.windows = list(windows)
+
+    def active(self, tick: int) -> list[FaultWindow]:
+        return [w for w in self.windows if w.covers(tick)]
+
+    def phase_label(self, tick: int) -> str:
+        """The registry phase label for one tick: the active fault names
+        joined (stable order), or ``clear``."""
+        names = [w.name for w in self.active(tick)]
+        return "+".join(names) if names else "clear"
+
+    def matching(self, plane: str, tick: int) -> list[FaultWindow]:
+        return [
+            w
+            for w in self.active(tick)
+            if plane in w.may or plane in w.expect
+        ]
+
+
+class SoakJudge:
+    """Concurrent per-plane/per-fault episode accumulator + verdict."""
+
+    def __init__(
+        self, schedule: FaultSchedule, probe_every: int = 2
+    ) -> None:
+        self.schedule = schedule
+        self.probe_every = max(int(probe_every), 1)
+        self.registry: SloRegistry | None = None
+        self.tick = -1
+        self.attaches: list[int] = []
+        self.episodes: list[dict] = []
+        self._open: dict[str, dict] = {}
+        self.probe_failures: list[dict] = []
+        self._probe_results: dict[str, bool] = {}
+        self._evlog = None
+        self._orig_emit = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def attach(self, registry: SloRegistry | None) -> None:
+        """Bind (or re-bind after a kill/restore) the engine's registry.
+        Episodes open at re-attach are marked pending: a post-restore
+        burn of the same SLO continues them; silence closes them at the
+        restore tick (the restart healed the plane)."""
+        self.registry = registry
+        self.attaches.append(self.tick)
+        if len(self.attaches) > 1:
+            for ep in self._open.values():
+                ep["pending_restore"] = self.tick
+                ep["segments"] = ep.get("segments", 1)
+
+    def install(self) -> None:
+        """Tap slo_burn/slo_recover/invariant_probe_failed off the event
+        log emit path (the chaos-drill idiom — works with the process
+        log disabled)."""
+        self._evlog = get_event_log()
+        self._orig_emit = self._evlog.emit
+
+        def _tap(event: str, **fields):
+            if event in (
+                "slo_burn",
+                "slo_recover",
+                "invariant_probe_failed",
+            ):
+                self.on_event(event, fields)
+            return self._orig_emit(event, **fields)
+
+        self._evlog.emit = _tap  # type: ignore[method-assign]
+
+    def uninstall(self) -> None:
+        if self._evlog is not None and self._orig_emit is not None:
+            self._evlog.emit = self._orig_emit  # type: ignore[method-assign]
+            self._evlog = None
+            self._orig_emit = None
+
+    def note_tick(self, tick: int) -> None:
+        """Advance the judge clock: stamp the registry's phase window and
+        run the mid-drill invariant probe cadence."""
+        self.tick = int(tick)
+        if self.registry is not None:
+            self.registry.begin_phase(self.schedule.phase_label(self.tick))
+            if self.tick % self.probe_every == 0:
+                self.registry.probe_invariants()
+
+    # -- event accumulation ----------------------------------------------------
+
+    def on_event(self, event: str, fields: dict) -> None:
+        if event == "slo_burn":
+            self._on_burn(fields)
+        elif event == "slo_recover":
+            self._on_recover(fields)
+        elif event == "invariant_probe_failed":
+            self._on_probe_failure(fields)
+
+    def _attribute(self, plane: str, tick: int) -> list[str]:
+        faults = self.schedule.matching(plane, tick)
+        for w in faults:
+            w.tripped.add(plane)
+        return [w.name for w in faults]
+
+    def _on_burn(self, fields: dict) -> None:
+        name = str(fields.get("slo", "?"))
+        ep = self._open.get(name)
+        if ep is not None:
+            # continuation: cadence re-emits extend the open episode, and
+            # a post-restore entering burn resumes it (episode continuity
+            # across the kill — the fresh registry forgot it was burning,
+            # so its burn_obs restarts; the carry keeps the true length)
+            if (
+                fields.get("entering")
+                and ep.pop("pending_restore", None) is not None
+            ):
+                ep["segments"] = ep.get("segments", 1) + 1
+                ep["carry"] = ep.get("burn_obs", 0)
+            ep["burn_obs"] = ep.get("carry", 0) + int(
+                fields.get("burn_obs", 1)
+            )
+            return
+        plane = plane_of(name)
+        ep = {
+            "slo": name,
+            "plane": plane,
+            "start_tick": self.tick,
+            "phase": fields.get("phase"),
+            "burn_obs": int(fields.get("burn_obs", 1)),
+            "faults": self._attribute(plane, self.tick),
+        }
+        self._open[name] = ep
+
+    def _on_recover(self, fields: dict) -> None:
+        name = str(fields.get("slo", "?"))
+        ep = self._open.pop(name, None)
+        if ep is None:
+            return
+        ep.pop("pending_restore", None)
+        carry = ep.pop("carry", 0)
+        ep["end_tick"] = self.tick
+        ep["burn_obs"] = max(
+            ep.get("burn_obs", 0), carry + int(fields.get("burn_obs", 0))
+        )
+        # recovery-overlap credit: a fault window the episode burned
+        # THROUGH counts as tripped even when the burn entered during an
+        # earlier overlapping fault (one global staleness SLO, two
+        # staggered outages → one long episode spanning both windows)
+        for w in self.schedule.windows:
+            if (
+                (ep["plane"] in w.may or ep["plane"] in w.expect)
+                and w.overlaps(ep["start_tick"], ep["end_tick"])
+            ):
+                w.tripped.add(ep["plane"])
+                if w.name not in ep["faults"]:
+                    ep["faults"].append(w.name)
+        self.episodes.append(ep)
+
+    def _on_probe_failure(self, fields: dict) -> None:
+        name = str(fields.get("invariant", "?"))
+        plane = plane_of(name)
+        self.probe_failures.append(
+            {
+                "invariant": name,
+                "plane": plane,
+                "tick": self.tick,
+                "phase": fields.get("phase"),
+                "faults": self._attribute(plane, self.tick),
+            }
+        )
+
+    # -- the fold --------------------------------------------------------------
+
+    def resolve_probe(self, name: str, ok: bool) -> None:
+        """Record one engine-side fault probe's outcome (the drill calls
+        this at the end for every FaultWindow.probe)."""
+        self._probe_results[name] = bool(ok)
+
+    def finish(self) -> None:
+        """Close the books: episodes still open either heal at a pending
+        restore boundary or stay open (= burning at drill end)."""
+        for name in list(self._open):
+            ep = self._open[name]
+            restored_at = ep.pop("pending_restore", None)
+            ep.pop("carry", None)
+            if restored_at is not None:
+                ep["end_tick"] = restored_at
+                ep["recovered_by"] = "restore"
+                self.episodes.append(ep)
+                del self._open[name]
+
+    def verdict(self) -> dict:
+        """Fold everything into ONE machine-readable soak verdict."""
+        episodes = sorted(
+            self.episodes + list(self._open.values()),
+            key=lambda e: (e["start_tick"], e["slo"]),
+        )
+        burning_at_end = sorted(self._open)
+        unattributed = [
+            e for e in episodes if not e.get("faults")
+        ] + [p for p in self.probe_failures if not p.get("faults")]
+        planes: dict[str, dict] = {}
+        for plane in _PLANES:
+            eps = [e for e in episodes if e["plane"] == plane]
+            pfails = [
+                p for p in self.probe_failures if p["plane"] == plane
+            ]
+            planes[plane] = {
+                "episodes": len(eps),
+                "max_burn_obs": max(
+                    (e.get("burn_obs", 0) for e in eps), default=0
+                ),
+                "probe_failures": len(pfails),
+                "unattributed": sum(
+                    1 for e in eps + pfails if not e.get("faults")
+                ),
+                "burning_at_end": sorted(
+                    e["slo"] for e in eps if e["slo"] in burning_at_end
+                ),
+                "ok": all(e.get("faults") for e in eps + pfails)
+                and not any(e["slo"] in burning_at_end for e in eps),
+            }
+        faults = []
+        vacuous: list[str] = []
+        for w in self.schedule.windows:
+            probe_ok = (
+                self._probe_results.get(w.probe)
+                if w.probe is not None
+                else None
+            )
+            satisfied = bool(set(w.expect) & w.tripped) or bool(probe_ok)
+            if (w.expect or w.probe is not None) and not satisfied:
+                vacuous.append(w.name)
+            faults.append(
+                {
+                    "name": w.name,
+                    "kind": w.kind,
+                    "window": [w.start, w.end],
+                    "may": list(w.may),
+                    "expect": list(w.expect),
+                    "probe": w.probe,
+                    "probe_ok": probe_ok,
+                    "tripped": sorted(w.tripped),
+                    "non_vacuous": w.name not in vacuous,
+                }
+            )
+        end_state = (
+            self.registry.verdict()
+            if self.registry is not None
+            else {"enabled": False, "ok": None, "slos": {}, "invariants": {}}
+        )
+        end_invariants_ok = all(
+            inv.get("ok", False)
+            for inv in end_state.get("invariants", {}).values()
+        ) and end_state.get("enabled") is True
+        ok = (
+            not unattributed
+            and not vacuous
+            and not burning_at_end
+            and end_invariants_ok
+            and all(p["ok"] for p in planes.values())
+        )
+        return {
+            "ok": ok,
+            "ticks": self.tick + 1,
+            "attaches": len(self.attaches),
+            "planes": planes,
+            "faults": faults,
+            "episodes": episodes,
+            "probe_failures": self.probe_failures,
+            "unattributed": [
+                {k: v for k, v in e.items() if k != "faults"}
+                for e in unattributed
+            ],
+            "non_vacuity_failures": vacuous,
+            "burning_at_end": burning_at_end,
+            "end_state": end_state,
+        }

@@ -96,6 +96,14 @@ os.environ.setdefault("BQT_FANOUT", "0")
 # explicitly (tests/test_slo.py and the chaos drills via overrides).
 os.environ.setdefault("BQT_SLO", "0")
 os.environ.setdefault("BQT_DELIVERY_HEALTH", "0")
+# Extension-invariant chunk precompute flipped default-ON in ISSUE 18
+# (the soak bed pins the governed margin contract per scenario). The
+# tier-1 lane pins it OFF: the backtest parity suites drive BOTH paths
+# explicitly via run_backtest(ext_invariant=...), and the serial-vs-
+# vmapped bit-identity fixtures assume the per-tick gathered views.
+# Ext coverage opts in explicitly (tests/test_backtest_ext.py, the soak
+# drill's ext-parity stage).
+os.environ.setdefault("BQT_EXT_INVARIANT", "0")
 # Persistent XLA compilation cache: jit compiles dominate the tier-1
 # lane's wall time (a classic wire executable alone is ~6-8 s of XLA on
 # this box), and the cache key covers the optimized HLO + compile options,

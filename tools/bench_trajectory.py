@@ -32,6 +32,17 @@ metric space.
 
     python tools/bench_trajectory.py            # repo root, writes the file
     python tools/bench_trajectory.py --dir X --dry-run
+
+Gate mode turns the trajectory into a regression tripwire: each
+repeatable ``--gate metric:direction:tolerance`` spec compares the
+NEWEST point in that metric's merged series against the one before it
+(direction ``up`` = bigger is better, ``down`` = smaller is better;
+``tolerance`` is the allowed fractional slack). Fewer than two points
+passes vacuously — a brand-new lane has no history to regress against.
+Any tripped gate exits nonzero, so CI and ``make soak`` can refuse a
+run whose headline numbers fell off the recorded trajectory::
+
+    python tools/bench_trajectory.py --gate soak_candles_per_s:up:0.5
 """
 
 from __future__ import annotations
@@ -145,6 +156,60 @@ def build_trajectory(
     }
 
 
+def parse_gate(spec: str) -> tuple[str, str, float]:
+    """``metric:direction:tolerance`` → validated triple. The metric name
+    may itself contain dots (flattened paths), so split from the right."""
+    parts = spec.rsplit(":", 2)
+    if len(parts) != 3:
+        raise ValueError(
+            f"gate spec {spec!r} is not metric:direction:tolerance"
+        )
+    metric, direction, tol_text = parts
+    if direction not in ("up", "down"):
+        raise ValueError(
+            f"gate {spec!r}: direction must be up|down, got {direction!r}"
+        )
+    try:
+        tolerance = float(tol_text)
+    except ValueError:
+        raise ValueError(f"gate {spec!r}: tolerance {tol_text!r} not a number")
+    if tolerance < 0:
+        raise ValueError(f"gate {spec!r}: tolerance must be >= 0")
+    return metric, direction, tolerance
+
+
+def check_gate(
+    trajectory: dict, metric: str, direction: str, tolerance: float
+) -> tuple[bool, str]:
+    """Newest point vs the previous one. ``up`` regresses when the new
+    value falls below ``prev * (1 - tolerance)``; ``down`` when it climbs
+    above ``prev * (1 + tolerance)``. Returns (ok, human verdict line)."""
+    series = trajectory["metrics"].get(metric)
+    if not series:
+        return True, f"gate {metric}: no series yet — vacuous pass"
+    if len(series) < 2:
+        return True, (
+            f"gate {metric}: single point "
+            f"({series[-1]['value']:g} from {series[-1]['source']}) — "
+            "vacuous pass"
+        )
+    prev, new = series[-2], series[-1]
+    if direction == "up":
+        bound = prev["value"] * (1.0 - tolerance)
+        ok = new["value"] >= bound
+        rel = "fell below" if not ok else "holds"
+    else:
+        bound = prev["value"] * (1.0 + tolerance)
+        ok = new["value"] <= bound
+        rel = "climbed past" if not ok else "holds"
+    return ok, (
+        f"gate {metric} [{direction}, tol={tolerance:g}]: "
+        f"{new['value']:g} ({new['source']}@{new['git_sha']}) vs "
+        f"{prev['value']:g} ({prev['source']}@{prev['git_sha']}) — "
+        f"{rel} bound {bound:g} → {'PASS' if ok else 'FAIL'}"
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -161,7 +226,19 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--metric", help="print just one metric's ordered series and exit"
     )
+    parser.add_argument(
+        "--gate", action="append", default=[], metavar="METRIC:DIR:TOL",
+        help="repeatable regression gate metric:up|down:tolerance — "
+        "compare the newest point against the previous one and exit "
+        "nonzero past the fractional tolerance (<2 points passes)",
+    )
     args = parser.parse_args(argv)
+
+    try:
+        gates = [parse_gate(spec) for spec in args.gate]
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
 
     bench_dir = Path(args.dir)
     trajectory = build_trajectory(
@@ -184,6 +261,14 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         print(json.dumps({args.metric: series}, indent=1))
         return 0
+    if gates:
+        failed = 0
+        for metric, direction, tolerance in gates:
+            ok, line = check_gate(trajectory, metric, direction, tolerance)
+            print(line)
+            if not ok:
+                failed += 1
+        return 1 if failed else 0
     if args.dry_run:
         print(json.dumps(trajectory, indent=1))
         return 0

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Render the soak verdict JSON into the human post-mortem view.
+
+``make soak`` / ``make soak-smoke`` write ``soak_verdict.json`` — the
+single machine-readable verdict the concurrent judge folds (per-plane
+pass/fail, fault windows with non-vacuity, episode timelines, end-state
+invariants, headline numbers). This tool renders it deterministically
+(golden-pinned like delivery_report — keep format changes deliberate):
+
+    python tools/soak_report.py /tmp/bqt_soak/soak_verdict.json
+    python tools/soak_report.py soak_verdict.json --plane delivery
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def _flag(ok) -> str:
+    if ok is None:
+        return "n/a "
+    return "PASS" if ok else "FAIL"
+
+
+def render_report(doc: dict, plane: str | None = None) -> str:
+    """The deterministic report: headline, per-plane table, fault-window
+    timeline (with non-vacuity), episode timeline, end-state fold."""
+    verdict = doc.get("verdict", {})
+    lines: list[str] = []
+    lines.append("SOAK OBSERVATORY VERDICT")
+    lines.append("========================")
+    lines.append(
+        f"mode={doc.get('mode', '?')} ticks={verdict.get('ticks', '?')} "
+        f"attaches={verdict.get('attaches', '?')} "
+        f"verdict={_flag(doc.get('ok')).strip()}"
+    )
+    head = doc.get("headline", {})
+    if head:
+        lines.append(
+            "headline: "
+            f"candles/s={head.get('candles_per_s', 0.0):.1f} "
+            f"close->ack p99={head.get('close_ack_p99_ms', 0.0):.1f}ms"
+        )
+    lines.append("")
+    lines.append("planes")
+    lines.append("------")
+    lines.append(
+        f"{'plane':<11} {'ok':<5} {'episodes':>8} {'max_burn':>8} "
+        f"{'probe_fails':>11} {'unattributed':>12}"
+    )
+    for name, cell in sorted(verdict.get("planes", {}).items()):
+        if plane and name != plane:
+            continue
+        lines.append(
+            f"{name:<11} {_flag(cell.get('ok')):<5} "
+            f"{cell.get('episodes', 0):>8} "
+            f"{cell.get('max_burn_obs', 0):>8} "
+            f"{cell.get('probe_failures', 0):>11} "
+            f"{cell.get('unattributed', 0):>12}"
+        )
+    lines.append("")
+    lines.append("fault windows")
+    lines.append("-------------")
+    for w in verdict.get("faults", []):
+        tripped = ",".join(w.get("tripped", [])) or "-"
+        probe = w.get("probe")
+        probe_txt = (
+            f" probe[{probe}]={_flag(w.get('probe_ok')).strip()}"
+            if probe
+            else ""
+        )
+        vac = "" if w.get("non_vacuous", True) else "  ** VACUOUS **"
+        win = w.get("window", ["?", "?"])
+        lines.append(
+            f"[{win[0]:>4},{win[1]:>4}] {w.get('name', '?'):<20} "
+            f"kind={w.get('kind', '?'):<18} tripped={tripped}"
+            f"{probe_txt}{vac}"
+        )
+    episodes = [
+        e
+        for e in verdict.get("episodes", [])
+        if not plane or e.get("plane") == plane
+    ]
+    lines.append("")
+    lines.append("episodes")
+    lines.append("--------")
+    if not episodes:
+        lines.append("(none)")
+    for e in episodes:
+        end = e.get("end_tick", "OPEN")
+        via = (
+            f" via={e['recovered_by']}" if e.get("recovered_by") else ""
+        )
+        segs = (
+            f" segments={e['segments']}" if e.get("segments", 1) > 1 else ""
+        )
+        faults = ",".join(e.get("faults", [])) or "UNATTRIBUTED"
+        lines.append(
+            f"[{e.get('start_tick', '?'):>4},{end:>4}] "
+            f"{e.get('slo', '?'):<20} plane={e.get('plane', '?'):<10} "
+            f"burn_obs={e.get('burn_obs', 0):<4} faults={faults}"
+            f"{segs}{via}"
+        )
+    lines.append("")
+    lines.append("fold")
+    lines.append("----")
+    for key in ("unattributed", "non_vacuity_failures", "burning_at_end"):
+        vals = verdict.get(key, [])
+        shown = (
+            ",".join(
+                v if isinstance(v, str) else v.get("slo", v.get("invariant", "?"))
+                for v in vals
+            )
+            or "-"
+        )
+        lines.append(f"{key}: {shown}")
+    end_state = verdict.get("end_state", {})
+    inv = end_state.get("invariants", {})
+    bad = sorted(k for k, v in inv.items() if not v.get("ok", False))
+    lines.append(
+        f"end-state invariants: {len(inv)} probed, "
+        + (f"FAILING: {','.join(bad)}" if bad else "all ok")
+    )
+    checks = doc.get("checks", {})
+    failing = sorted(k for k, v in checks.items() if not v)
+    lines.append(
+        f"drill checks: {len(checks)} run, "
+        + (f"FAILING: {','.join(failing)}" if failing else "all ok")
+    )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="soak_verdict.json from the drill")
+    parser.add_argument(
+        "--plane", help="only this plane's rows/episodes"
+    )
+    args = parser.parse_args(argv)
+    path = Path(args.path)
+    if not path.exists():
+        print(f"no such file: {path}", file=sys.stderr)
+        return 2
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError as e:
+        print(f"unreadable verdict {path}: {e}", file=sys.stderr)
+        return 2
+    print(render_report(doc, plane=args.plane))
+    return 0 if doc.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

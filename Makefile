@@ -1,4 +1,4 @@
-.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke ingest-smoke soak soak-smoke
+.PHONY: help test bench smoke replay ab config4 dryrun lint obs-smoke incr-smoke strat-smoke trace-smoke replay-smoke backtest-smoke ring-smoke scenarios latency-smoke outcome-smoke delivery-smoke fanout-smoke ingest-smoke soak soak-smoke shard-smoke
 
 help:
 	@echo "binquant_tpu targets:"
@@ -160,6 +160,19 @@ help:
 	@echo "  soak-smoke - the tier-1 soak pytest lane (judge folding,"
 	@echo "               probe latch, kucoin stream round trip, gate,"
 	@echo "               report golden) + the minutes-scale smoke drill"
+	@echo "  shard-smoke- sharded execution lane (ISSUE 19): the slow-"
+	@echo "               marked mesh drills (4-shard-vs-unsharded signal-"
+	@echo "               set equality on a rewrite+churn pinned stream,"
+	@echo "               save@4/restore@2 reshard resume with bit-"
+	@echo "               identical restored state), then a small-shape"
+	@echo "               1/2/4/8-shard scaling report. The 2048x400"
+	@echo "               acceptance number is 'python bench.py"
+	@echo "               --shard-throughput' (writes BENCH_SHARD_CPU.json;"
+	@echo "               on a core-starved CPU host it records the"
+	@echo "               measured sharding-tax floor analysis instead of"
+	@echo "               a multiplier — rerun on silicon for the scaling"
+	@echo "               claim); the trajectory gate pins the 4-shard"
+	@echo "               wall speedup against the previous record"
 	@echo "  dryrun     - 8-device virtual-mesh multichip dry run; gated"
 	@echo "               to ONE shard-compatible executable by default"
 	@echo "               (BQT_DRYRUN_PHASES=tick_step — the three-"
@@ -385,6 +398,24 @@ soak-smoke:
 	'close_ack_p99_ms', 'unacked_at_kill', 'wal_replayed')}); \
 	assert facts['ok'], facts['checks']"
 	python tools/soak_report.py /tmp/bqt_soak_smoke/soak_verdict.json
+
+# The sharded-execution lane (ISSUE 19): tier-1 keeps the cheap units
+# (shard_bounds math, sharded checkpoint round-trip/torn-save rejection,
+# outbox partition routing + retired-partition folding); this target
+# adds the slow-marked mesh drills — the 4-shard-vs-unsharded signal-set
+# equality pin on a rewrite+churn stream and the save@4/restore@2
+# reshard resume — then a small-shape 1/2/4/8 scaling report and the
+# trajectory gate on the 4-shard wall speedup. The 2048x400 acceptance
+# number is `python bench.py --shard-throughput` (BENCH_SHARD_CPU.json).
+shard-smoke:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -m pytest tests/test_sharded.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+	python bench.py --shard-throughput --smoke
+	python tools/bench_trajectory.py
+	python tools/bench_trajectory.py \
+		--gate shard_wall_speedup_at_4_x:up:0.5
 
 replay:
 	python -c "from binquant_tpu.io.replay import generate_replay_file; generate_replay_file('/tmp/replay.jsonl')"

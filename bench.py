@@ -1013,6 +1013,286 @@ def run_fanout_connection_sweep(
     }
 
 
+def _shard_worker(
+    n_devices: int, symbols: int, window: int, ticks: int, warmup: int
+) -> None:
+    """Child body for --shard-throughput: time the sharded wire step.
+
+    Runs in a subprocess whose XLA_FLAGS pinned ``n_devices`` virtual CPU
+    devices before jax import (``__graft_entry__._subprocess_env``). The
+    state is assembled per-shard the way the production engine does it
+    (``shard_engine_state`` → ``jax.make_array_from_single_device_arrays``),
+    updates cover every row so the ingest H2D cost is the full-fat one,
+    and the wire is fetched to host each tick (measurement epoch 2 sync).
+    Prints ONE JSON line for the parent to collect."""
+    import jax
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.buffer import NUM_FIELDS, Field
+    from binquant_tpu.engine.step import (
+        FIFTEEN_MIN_S,
+        FIVE_MIN_S,
+        default_host_inputs,
+        initial_engine_state,
+        tick_step_wire,
+    )
+    from binquant_tpu.parallel import (
+        make_mesh,
+        shard_engine_state,
+        shard_host_inputs,
+    )
+    from binquant_tpu.parallel.mesh import assemble_sharded
+    from binquant_tpu.regime.context import ContextConfig
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, (
+        f"need {n_devices} devices, found {len(devices)}"
+    )
+    mesh = make_mesh(devices)
+    cfg = ContextConfig()
+    rng = np.random.default_rng(19)
+    t0 = 1_753_000_200
+
+    def full_ring(interval_s: int):
+        # host-built canonical ring (cursor 0, right-aligned full): the
+        # seeding that matters for throughput is the FULL window of
+        # indicator input, not how the bars got there
+        times = (
+            t0
+            + (np.arange(window, dtype=np.int64) - window) * interval_s
+        ).astype(np.int32)
+        times = np.broadcast_to(times, (symbols, window)).copy()
+        px = 20.0 + rng.random((symbols, 1)).astype(np.float32) * 100
+        walk = 1 + rng.normal(0, 0.004, (symbols, window)).astype(np.float32)
+        closes = (px * np.cumprod(walk, axis=1)).astype(np.float32)
+        vals = np.zeros((symbols, window, NUM_FIELDS), dtype=np.float32)
+        vals[:, :, Field.OPEN] = closes
+        vals[:, :, Field.CLOSE] = closes
+        vals[:, :, Field.HIGH] = closes * 1.002
+        vals[:, :, Field.LOW] = closes * 0.998
+        vals[:, :, Field.VOLUME] = np.abs(
+            rng.normal(1000, 150, (symbols, window))
+        ).astype(np.float32)
+        vals[:, :, Field.QUOTE_VOLUME] = vals[:, :, Field.VOLUME] * closes
+        vals[:, :, Field.NUM_TRADES] = 150
+        vals[:, :, Field.DURATION_S] = interval_s
+        return times, vals
+
+    state = initial_engine_state(symbols, window=window)
+    t5, v5 = full_ring(FIVE_MIN_S)
+    t15, v15 = full_ring(FIFTEEN_MIN_S)
+    state = state._replace(
+        buf5=state.buf5._replace(
+            times=jnp.asarray(t5),
+            values=jnp.asarray(v5),
+            filled=jnp.full((symbols,), window, jnp.int32),
+        ),
+        buf15=state.buf15._replace(
+            times=jnp.asarray(t15),
+            values=jnp.asarray(v15),
+            filled=jnp.full((symbols,), window, jnp.int32),
+        ),
+    )
+    state = shard_engine_state(state, mesh)
+
+    ts_now = t0
+    inputs = default_host_inputs(symbols)._replace(
+        tracked=np.ones(symbols, dtype=bool),
+        btc_row=np.int32(0),
+        timestamp_s=np.int32(ts_now),
+        timestamp5_s=np.int32(ts_now),
+    )
+    inputs = shard_host_inputs(inputs, mesh)
+
+    rows_np = np.arange(symbols, dtype=np.int32)
+    last_close = v15[:, -1, Field.CLOSE].copy()
+
+    def make_upd(ts_s: int):
+        closes = last_close * (
+            1 + rng.normal(0, 0.004, symbols).astype(np.float32)
+        )
+        vals = np.zeros((symbols, NUM_FIELDS), dtype=np.float32)
+        vals[:, Field.OPEN] = last_close
+        vals[:, Field.CLOSE] = closes
+        vals[:, Field.HIGH] = np.maximum(last_close, closes) * 1.002
+        vals[:, Field.LOW] = np.minimum(last_close, closes) * 0.998
+        vals[:, Field.VOLUME] = np.abs(
+            rng.normal(1000, 150, symbols)
+        ).astype(np.float32)
+        vals[:, Field.QUOTE_VOLUME] = vals[:, Field.VOLUME] * closes
+        vals[:, Field.NUM_TRADES] = 150
+        vals[:, Field.DURATION_S] = FIFTEEN_MIN_S
+        last_close[:] = closes
+        return np.full(symbols, ts_s, dtype=np.int32), vals
+
+    place_s: list[float] = []
+    step_s: list[float] = []
+
+    for i in range(warmup + ticks):
+        ts_now += FIFTEEN_MIN_S
+        ts, vals = make_upd(ts_now)
+        t_place = time.perf_counter()
+        # shard-local ingest boundary: every update array lands as
+        # per-shard slices, never a full-array device_put
+        upd = tuple(
+            assemble_sharded(mesh, a) for a in (rows_np, ts, vals)
+        )
+        inputs = inputs._replace(
+            timestamp_s=np.int32(ts_now), timestamp5_s=np.int32(ts_now)
+        )
+        t_step = time.perf_counter()
+        state, wire = tick_step_wire(state, upd, upd, inputs, cfg)
+        np.asarray(wire)  # production sync: packed-wire D2H fetch
+        t_done = time.perf_counter()
+        if i >= warmup:
+            place_s.append(t_step - t_place)
+            step_s.append(t_done - t_step)
+
+    wall = np.asarray(place_s) + np.asarray(step_s)
+    print(
+        json.dumps(
+            {
+                "n_devices": n_devices,
+                "symbols": symbols,
+                "window": window,
+                "ticks": ticks,
+                "wall_ms_per_tick": round(float(np.mean(wall)) * 1000, 3),
+                "wall_p50_ms": round(
+                    float(np.percentile(wall, 50)) * 1000, 3
+                ),
+                "wall_p99_ms": round(
+                    float(np.percentile(wall, 99)) * 1000, 3
+                ),
+                "ingest_place_ms": round(
+                    float(np.mean(place_s)) * 1000, 3
+                ),
+                "step_fetch_ms": round(float(np.mean(step_s)) * 1000, 3),
+                "mesh": str(dict(mesh.shape)),
+            }
+        ),
+        flush=True,
+    )
+
+
+def run_shard_throughput(
+    symbols: int = 2048,
+    window: int = 400,
+    ticks: int = 24,
+    warmup: int = 4,
+    counts: tuple = (1, 2, 4, 8),
+) -> dict:
+    """Virtual-device scaling of the sharded wire step (ISSUE 19).
+
+    One subprocess per device count (XLA fixes the host-platform device
+    count at process start), each timing the identical sharded drive via
+    :func:`_shard_worker`. The headline is wall speedup at 4 shards vs
+    the 1-shard rung; on a host with fewer physical cores than shards the
+    CPU model FLOORS the scaling (every virtual device multiplexes onto
+    the same cores), so the record carries a measured floor analysis
+    attributing where the scaling went instead of a fake speedup — the
+    PR 5 precedent. Silicon reruns replace the analysis with the real
+    multiplier."""
+    import subprocess
+
+    from __graft_entry__ import _subprocess_env
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sweep: list[dict] = []
+    for n in counts:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                (
+                    "import bench; bench._shard_worker("
+                    f"{int(n)}, {int(symbols)}, {int(window)}, "
+                    f"{int(ticks)}, {int(warmup)})"
+                ),
+            ],
+            env=_subprocess_env(n),
+            cwd=repo,
+            capture_output=True,
+            text=True,
+            timeout=1800,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"shard worker n={n} failed rc={proc.returncode}:\n"
+                + proc.stderr[-2000:]
+            )
+        line = [
+            ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+        ][-1]
+        rung = json.loads(line)
+        sweep.append(rung)
+        print(
+            f"  shards={n}: {rung['wall_ms_per_tick']} ms/tick "
+            f"(ingest {rung['ingest_place_ms']} ms, "
+            f"step+fetch {rung['step_fetch_ms']} ms)",
+            file=sys.stderr,
+        )
+
+    base = sweep[0]["wall_ms_per_tick"]
+    for rung in sweep:
+        rung["speedup_vs_1shard_x"] = (
+            round(base / rung["wall_ms_per_tick"], 3)
+            if rung["wall_ms_per_tick"]
+            else None
+        )
+    by_n = {r["n_devices"]: r for r in sweep}
+    at4 = by_n.get(4)
+    speedup_at_4 = at4["speedup_vs_1shard_x"] if at4 else None
+    host_cores = os.cpu_count() or 1
+
+    floor = None
+    if (
+        speedup_at_4 is not None
+        and speedup_at_4 < 1.6
+        and host_cores < 4
+    ):
+        overhead_ms = {
+            f"{r['n_devices']}_shards": round(
+                r["wall_ms_per_tick"] - base, 3
+            )
+            for r in sweep[1:]
+        }
+        floor = {
+            "host_physical_cores": host_cores,
+            "partition_overhead_ms_vs_1shard": overhead_ms,
+            "ingest_place_ms_by_shards": {
+                f"{r['n_devices']}_shards": r["ingest_place_ms"]
+                for r in sweep
+            },
+            "note": (
+                f"CPU-model floor: this host exposes {host_cores} "
+                "physical core(s), so the N virtual devices created by "
+                "xla_force_host_platform_device_count all multiplex onto "
+                "the same core — the per-shard compute (S/N rows each) "
+                "runs SEQUENTIALLY and wall/tick cannot drop below the "
+                "1-shard compute time. The sweep therefore measures the "
+                "sharding TAX, not the multiplier: wall_n - wall_1 above "
+                "is the per-tick cost of the partitioned executable "
+                "(GSPMD collectives for the market-context reductions + "
+                "wire compaction, per-shard dispatch fan-out, and the "
+                "per-shard H2D assembly in ingest_place_ms). The "
+                "multiplier needs >= N real cores or chips: per-shard "
+                "compute shrinks ~1/N while the measured tax stays "
+                "fixed — rerun bench.py --shard-throughput on silicon."
+            ),
+        }
+
+    return {
+        "symbols": symbols,
+        "window": window,
+        "ticks": ticks,
+        "counts": list(counts),
+        "sweep": sweep,
+        "wall_speedup_at_4_shards_x": speedup_at_4,
+        "host_physical_cores": host_cores,
+        "cpu_model_floor": floor,
+    }
+
+
 def run_ring_traffic(
     num_symbols: int = 2048, window: int = 400, ticks: int = 64
 ) -> dict:
@@ -2515,6 +2795,21 @@ def main() -> int | None:
         "print-only smoke)",
     )
     parser.add_argument(
+        "--shard-throughput",
+        action="store_true",
+        help="virtual-device scaling of the sharded wire step (ISSUE 19): "
+        "one subprocess per device count in {1,2,4,8}, identical drive, "
+        "wall speedup at 4 shards vs 1 (>=1.6x acceptance, or a measured "
+        "floor analysis when the host's core count floors the CPU model); "
+        "writes BENCH_SHARD_CPU.json at 2048x400 on the CPU model",
+    )
+    parser.add_argument(
+        "--shard-counts",
+        type=str,
+        default="1,2,4,8",
+        help="comma list of device counts for --shard-throughput",
+    )
+    parser.add_argument(
         "--backtest-throughput",
         action="store_true",
         help="time-batched backtest backend vs the serial full-recompute "
@@ -2623,6 +2918,55 @@ def main() -> int | None:
         if jax.default_backend() == "cpu" and record_shape:
             with open("BENCH_BACKTEST_CPU.json", "w") as f:
                 json.dump(record, f, indent=1)
+        return
+
+    if args.shard_throughput:
+        import jax
+
+        counts = tuple(
+            int(c) for c in args.shard_counts.split(",") if c.strip()
+        )
+        if args.smoke:
+            symbols, window, ticks, warmup = 64, 120, 6, 2
+        else:
+            symbols, window, ticks, warmup = (
+                args.symbols,
+                args.window,
+                min(args.ticks, 24),
+                min(args.warmup, 4),
+            )
+        r = run_shard_throughput(
+            symbols, window, ticks=ticks, warmup=warmup, counts=counts
+        )
+        floored = r["cpu_model_floor"] is not None
+        record = {
+            "metric": "shard_wall_speedup_at_4_x",
+            "value": r["wall_speedup_at_4_shards_x"],
+            "unit": "x",
+            # ISSUE 19 acceptance: >=1.6x wall at 4 shards — or the
+            # measured floor analysis when the host cannot express it
+            "vs_baseline": (
+                round(r["wall_speedup_at_4_shards_x"] / 1.6, 3)
+                if r["wall_speedup_at_4_shards_x"]
+                else None
+            ),
+            "detail": r,
+        }
+        print(json.dumps(_stamped(record)))
+        record_shape = (
+            symbols == parser.get_default("symbols")
+            and window == parser.get_default("window")
+            and set(counts) >= {1, 2, 4, 8}
+        )
+        if jax.default_backend() == "cpu" and record_shape:
+            with open("BENCH_SHARD_CPU.json", "w") as f:
+                json.dump(record, f, indent=1)
+            if floored:
+                print(
+                    "4-shard speedup floored by host core count — "
+                    "cpu_model_floor analysis recorded",
+                    file=sys.stderr,
+                )
         return
 
     if args.fanout_throughput:

@@ -168,6 +168,24 @@ class Config:
         return int(self._get("BQT_MESH_DEVICES", "0") or 0)
 
     @cached_property
+    def ckpt_shards(self) -> int:
+        """Shard count for checkpoint archives (io/checkpoint.py
+        ``save_state_sharded``). 0 (default) = auto: match the symbol
+        mesh size (monolithic when unsharded); an explicit N forces N
+        per-shard archives regardless of the mesh — restore accepts any
+        saved count and re-slices at the restoring engine's own mesh."""
+        return int(self._get("BQT_CKPT_SHARDS", "0") or 0)
+
+    @cached_property
+    def fanout_outbox_shards(self) -> int:
+        """Partition count of the fan-out delivery outbox (fanout/hub.py
+        ``ShardedBroadcastOutbox``). 0 (default) = auto: match the symbol
+        mesh size (single-file outbox when unsharded). Partitions split
+        the append load by the firing symbol's shard while the hub still
+        serves ONE merged, seq-ordered stream under the global cursor."""
+        return int(self._get("BQT_FANOUT_OUTBOX_SHARDS", "0") or 0)
+
+    @cached_property
     def incremental_enabled(self) -> bool:
         """Incremental indicator fast path: advance carried EMA/Wilder/
         rolling-sum state by the newest bar instead of recomputing full
@@ -180,11 +198,14 @@ class Config:
         """Donate the engine state to the live wire step: the ring buffers
         update IN PLACE instead of the functional allocate+copy scatter
         (~0.23 GB/tick of the incremental tick's residual bytes at
-        2048×400). The pipeline engages it only when safe — pipeline depth
-        <= 1 and single chip — and re-derives the rare overflow-fallback
-        outputs from the post-tick state plus pre-tick small-carry
-        snapshots, never from the donated buffers. BQT_DONATE=0 pins the
-        copying step (the pre-ISSUE-4 behavior)."""
+        2048×400). Depth <= 1 donates the input state itself; depth >= 2
+        rotates double-buffered spare slots. Composes with the symbol
+        mesh (ISSUE 19): GSPMD donation aliases each per-device shard,
+        with spares created sharded and generation stamps invalidated on
+        restore. The overflow-fallback outputs re-derive from the
+        post-tick state plus pre-tick small-carry snapshots, never from
+        the donated buffers. BQT_DONATE=0 pins the copying step (the
+        pre-ISSUE-4 behavior)."""
         return self._get("BQT_DONATE", "1") != "0"
 
     @cached_property

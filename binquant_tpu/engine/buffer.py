@@ -201,29 +201,20 @@ def _scatter_updates(buf: MarketBuffer, row_idx, ts, vals):
     return routing, upd_vals
 
 
-@jax.jit
-def apply_updates(
+def apply_updates_routed(
     buf: MarketBuffer,
-    row_idx: jnp.ndarray,  # (U,) int32 registry rows; out-of-range rows ignored
-    ts: jnp.ndarray,  # (U,) int32 open-time seconds
-    vals: jnp.ndarray,  # (U, F) float32
+    r: UpdateRouting,
+    upd_vals: jnp.ndarray,  # (S, F) float32 scattered update values
 ) -> MarketBuffer:
-    """Apply one tick's worth of closed candles in a single fused update.
+    """Scatter core of :func:`apply_updates` over a PRECOMPUTED routing.
 
-    Circular-cursor layout: an append writes ONE column at the cursor and
-    bumps it — O(update) bytes instead of the original O(capacity)
-    shift-append (kept as :func:`apply_updates_shift`); a rewrite
-    overwrites the (unique) slot already holding that timestamp via a
-    second one-column scatter. In state-threading loops (``lax.scan``,
-    the donated live step) XLA aliases the buffer and the scatters run in
-    place — the ring's bytes/tick drop from ~144 MB to the update itself
-    at 2048×400 (``bench.py --ring-traffic``).
-
-    Duplicate rows within a batch must be pre-deduped host-side (keep last) —
-    the IngestBatcher does this; scatter order on duplicates is undefined.
+    Callers that also consume the routing (the ingest digest's batch
+    classifier in ``engine/step.py``) compute it once via
+    :func:`_scatter_updates` and pass it here, so the (S, W) int32
+    times-plane rewrite scan is shared by construction instead of by
+    XLA common-subexpression elimination.
     """
     S, W = buf.times.shape
-    r, upd_vals = _scatter_updates(buf, row_idx, ts, vals)
     rows = jnp.arange(S)
 
     # Append: one column at the cursor (index W = dropped for non-appends).
@@ -251,6 +242,31 @@ def apply_updates(
         r.is_append, (buf.cursor + 1) % W, buf.cursor
     ).astype(jnp.int32)
     return MarketBuffer(times=times, values=values, filled=filled, cursor=cursor)
+
+
+@jax.jit
+def apply_updates(
+    buf: MarketBuffer,
+    row_idx: jnp.ndarray,  # (U,) int32 registry rows; out-of-range rows ignored
+    ts: jnp.ndarray,  # (U,) int32 open-time seconds
+    vals: jnp.ndarray,  # (U, F) float32
+) -> MarketBuffer:
+    """Apply one tick's worth of closed candles in a single fused update.
+
+    Circular-cursor layout: an append writes ONE column at the cursor and
+    bumps it — O(update) bytes instead of the original O(capacity)
+    shift-append (kept as :func:`apply_updates_shift`); a rewrite
+    overwrites the (unique) slot already holding that timestamp via a
+    second one-column scatter. In state-threading loops (``lax.scan``,
+    the donated live step) XLA aliases the buffer and the scatters run in
+    place — the ring's bytes/tick drop from ~144 MB to the update itself
+    at 2048×400 (``bench.py --ring-traffic``).
+
+    Duplicate rows within a batch must be pre-deduped host-side (keep last) —
+    the IngestBatcher does this; scatter order on duplicates is undefined.
+    """
+    r, upd_vals = _scatter_updates(buf, row_idx, ts, vals)
+    return apply_updates_routed(buf, r, upd_vals)
 
 
 @jax.jit

@@ -29,7 +29,10 @@ import jax.numpy as jnp
 from binquant_tpu.engine.buffer import (
     Field,
     MarketBuffer,
+    UpdateRouting,
+    _scatter_updates,
     apply_updates,
+    apply_updates_routed,
     fresh_mask,
     materialize,
     materialize_tail,
@@ -657,26 +660,24 @@ def _ingest_interval_stats(
     ]
 
 
-def _ingest_batch_counts(
-    buf: MarketBuffer,
-    row_idx: jnp.ndarray,
-    ts: jnp.ndarray,
+def _ingest_counts_from_routing(
+    r: UpdateRouting,
+    filled: jnp.ndarray,  # (S,) int32 PRE-update fill counts
     interval_s: int,
 ) -> jnp.ndarray:
-    """(4,) f32 ``(appends, rewrites, gap_appends, dropped)`` — one update
-    sub-batch classified against the PRE-update ring through the SAME
-    ``route_updates`` the apply scatters resolve (one copy of the rules —
-    the digest cannot drift from the actual routing). A gap append is a
-    new bar that skipped at least one whole bucket past the row's
-    previous newest bar (clean next-bucket appends advance by exactly
+    """(4,) f32 ``(appends, rewrites, gap_appends, dropped)`` from an
+    already-resolved :class:`UpdateRouting` — the reductions alone, so a
+    caller that also applies the batch shares the (S, W) int32
+    times-plane rewrite scan with ``apply_updates_routed`` explicitly
+    (one ``_scatter_updates`` call feeds both) instead of leaning on XLA
+    CSE to merge two ``route_updates`` traces. A gap append is a new bar
+    that skipped at least one whole bucket past the row's previous
+    newest bar (clean next-bucket appends advance by exactly
     ``interval``); dropped updates are stale mid-history inserts
     ``apply_updates`` discards."""
-    from binquant_tpu.engine.buffer import route_updates
-
-    r = route_updates(buf, row_idx, ts)
     dropped = r.has_update & ~r.is_append & ~r.is_rewrite
     gap = (
-        r.is_append & (buf.filled > 0) & (r.upd_ts - r.last_ts > interval_s)
+        r.is_append & (filled > 0) & (r.upd_ts - r.last_ts > interval_s)
     )
     return jnp.stack(
         [
@@ -686,6 +687,24 @@ def _ingest_batch_counts(
             jnp.sum(dropped).astype(jnp.float32),
         ]
     )
+
+
+def _ingest_batch_counts(
+    buf: MarketBuffer,
+    row_idx: jnp.ndarray,
+    ts: jnp.ndarray,
+    interval_s: int,
+) -> jnp.ndarray:
+    """(4,) f32 batch counts classified against the PRE-update ring
+    through the SAME ``route_updates`` the apply scatters resolve (one
+    copy of the rules — the digest cannot drift from the actual
+    routing). Standalone form for callers that do not apply the batch;
+    the step/fold paths use :func:`_ingest_counts_from_routing` over the
+    shared ``_scatter_updates`` routing instead."""
+    from binquant_tpu.engine.buffer import route_updates
+
+    r = route_updates(buf, row_idx, ts)
+    return _ingest_counts_from_routing(r, buf.filled, interval_s)
 
 
 def _ingest_digest_block(
@@ -744,6 +763,27 @@ def _ingest_pair_counts(state, upd5, upd15) -> jnp.ndarray:
             _ingest_batch_counts(state.buf15, upd15[0], upd15[1], FIFTEEN_MIN_S),
         ]
     )
+
+
+def _counted_fold_bufs(state, upd5, upd15, counts):
+    """Shared-routing counted fold core: ONE ``_scatter_updates`` per ring
+    feeds both the digest count reductions and the apply scatter, so the
+    (S, W) int32 times-plane rewrite scan is materialized once per
+    sub-batch by construction (the ISSUE 15/16 CSE reliance, retired).
+    Returns ``(buf5, buf15, counts)`` with the (8,) accumulator advanced."""
+    r5, uv5 = _scatter_updates(state.buf5, *upd5)
+    r15, uv15 = _scatter_updates(state.buf15, *upd15)
+    counts = counts + jnp.concatenate(
+        [
+            _ingest_counts_from_routing(r5, state.buf5.filled, FIVE_MIN_S),
+            _ingest_counts_from_routing(
+                r15, state.buf15.filled, FIFTEEN_MIN_S
+            ),
+        ]
+    )
+    buf5 = apply_updates_routed(state.buf5, r5, uv5)
+    buf15 = apply_updates_routed(state.buf15, r15, uv15)
+    return buf5, buf15, counts
 
 
 class WireFired(NamedTuple):
@@ -1494,17 +1534,23 @@ def _tick_step_impl(
 
     sp = resolve_params(params)
     if ingest_digest:
-        # classify the evaluated batch against the PRE-update rings (the
-        # same routing _scatter_updates resolves below)
-        icnt5 = _ingest_batch_counts(state.buf5, upd5[0], upd5[1], FIVE_MIN_S)
-        icnt15 = _ingest_batch_counts(
-            state.buf15, upd15[0], upd15[1], FIFTEEN_MIN_S
+        # one _scatter_updates per ring feeds BOTH the digest's batch
+        # classifier and the apply scatter — the (S, W) rewrite slot-match
+        # is shared by construction, not by CSE
+        r5, uv5 = _scatter_updates(state.buf5, *upd5)
+        r15, uv15 = _scatter_updates(state.buf15, *upd15)
+        icnt5 = _ingest_counts_from_routing(r5, state.buf5.filled, FIVE_MIN_S)
+        icnt15 = _ingest_counts_from_routing(
+            r15, state.buf15.filled, FIFTEEN_MIN_S
         )
         if ingest_fold_counts is not None:
             icnt5 = icnt5 + ingest_fold_counts[:4]
             icnt15 = icnt15 + ingest_fold_counts[4:]
-    ring5 = apply_updates(state.buf5, *upd5)
-    ring15 = apply_updates(state.buf15, *upd15)
+        ring5 = apply_updates_routed(state.buf5, r5, uv5)
+        ring15 = apply_updates_routed(state.buf15, r15, uv15)
+    else:
+        ring5 = apply_updates(state.buf5, *upd5)
+        ring15 = apply_updates(state.buf15, *upd15)
 
     # Circular-ring materialization (ISSUE 9): the scatter above moved
     # O(update) bytes; time-ordered views for window consumers are gathered
@@ -2124,9 +2170,13 @@ def _fold_and_step_wire(
         u5 = tuple(x[d] for x in upd5_slots)
         u15 = tuple(x[d] for x in upd15_slots)
         if ingest_digest:
-            fold_counts = fold_counts + _ingest_pair_counts(state, u5, u15)
-        buf5 = apply_updates(state.buf5, *u5)
-        buf15 = apply_updates(state.buf15, *u15)
+            # shared routing: one scatter feeds the counts and the apply
+            buf5, buf15, fold_counts = _counted_fold_bufs(
+                state, u5, u15, fold_counts
+            )
+        else:
+            buf5 = apply_updates(state.buf5, *u5)
+            buf15 = apply_updates(state.buf15, *u15)
         if incremental:
             # the carry advance reads only the shallow canonical tail —
             # one small gather per fold slot instead of the ring shift
@@ -2381,14 +2431,8 @@ def apply_updates_step_counted(
     upd15,
     counts: jnp.ndarray,
 ) -> tuple[EngineState, jnp.ndarray]:
-    counts = counts + _ingest_pair_counts(state, upd5, upd15)
-    return (
-        state._replace(
-            buf5=apply_updates(state.buf5, *upd5),
-            buf15=apply_updates(state.buf15, *upd15),
-        ),
-        counts,
-    )
+    buf5, buf15, counts = _counted_fold_bufs(state, upd5, upd15, counts)
+    return state._replace(buf5=buf5, buf15=buf15), counts
 
 
 @jax.jit
@@ -2399,8 +2443,17 @@ def _apply_updates_carry_counted_impl(
     btc_row: jnp.ndarray,
     counts: jnp.ndarray,
 ) -> tuple[EngineState, jnp.ndarray]:
-    counts = counts + _ingest_pair_counts(state, upd5, upd15)
-    return _apply_updates_carry_impl(state, upd5, upd15, btc_row), counts
+    buf5, buf15, counts = _counted_fold_bufs(state, upd5, upd15, counts)
+    carry, _, _ = advance_indicator_carry(
+        materialize_tail(buf5, min(buf5.window, INCR_TAIL_WINDOW)),
+        materialize_tail(buf15, min(buf15.window, INCR_TAIL_WINDOW)),
+        state.indicator_carry,
+        btc_row,
+    )
+    return (
+        state._replace(buf5=buf5, buf15=buf15, indicator_carry=carry),
+        counts,
+    )
 
 
 def apply_updates_carry_step_counted(
@@ -2435,17 +2488,8 @@ def apply_updates_scan_counted(
     def body(carry, xs):
         st, c = carry
         u5, u15 = xs
-        c = c + _ingest_pair_counts(st, u5, u15)
-        return (
-            (
-                st._replace(
-                    buf5=apply_updates(st.buf5, *u5),
-                    buf15=apply_updates(st.buf15, *u15),
-                ),
-                c,
-            ),
-            None,
-        )
+        buf5, buf15, c = _counted_fold_bufs(st, u5, u15, c)
+        return (st._replace(buf5=buf5, buf15=buf15), c), None
 
     (new_state, counts), _ = jax.lax.scan(
         body, (state, counts), (upd5_seq, upd15_seq)

@@ -244,6 +244,144 @@ class BroadcastOutbox:
             pass
 
 
+class ShardedBroadcastOutbox:
+    """``BroadcastOutbox`` split into per-shard partitions under ONE
+    global cursor (ISSUE 19). Appends route by the firing symbol's shard
+    (``shard_of(frame)``) into ``<path>.pK-of-N`` partition logs — on a
+    pod each process would append only the frames of rows it owns — while
+    every read-side method (``entries``/``last_seq``/``resolve_cursor``/
+    ``replay_after``) serves the MERGED, seq-ordered stream, so the
+    fan-out hub sees one coherent subscriber population and cursors from
+    unsharded deployments keep resolving unchanged.
+
+    Reshard story mirrors the checkpoint's: partition files from a
+    PREVIOUS partition count (and any legacy single-file log at ``path``
+    itself) are folded in read-only as "retired" sources — their frames
+    stay cursor-replayable and seed ``last_seq`` so new frames never
+    collide — while appends go only to the current N live partitions.
+    Retired files are bounded by their own old rotation caps and age out
+    when their retention window ends.
+
+    Duck-typed drop-in for :class:`BroadcastOutbox` (the hub and plane
+    consume only the shared interface); ``cap`` bounds EACH partition,
+    keeping total retention ``N × cap .. 2N × cap``."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        n_shards: int,
+        cap: int = 4096,
+        shard_of=None,
+    ) -> None:
+        self.path = Path(path)
+        self.n_shards = max(int(n_shards), 1)
+        self.cap = max(int(cap), 1)
+        self._shard_of = shard_of
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._parts = [
+            BroadcastOutbox(
+                self.path.with_name(
+                    f"{self.path.name}.p{k}-of-{self.n_shards}"
+                ),
+                cap=self.cap,
+            )
+            for k in range(self.n_shards)
+        ]
+        # retired read-only sources: a legacy single-file outbox at the
+        # base path (+ its .1 generation) and partitions of a different
+        # previous count
+        self._retired: list[Path] = []
+        for p in (
+            self.path.with_name(self.path.name + ".1"),
+            self.path,
+        ):
+            if p.exists() and p.is_file():
+                self._retired.append(p)
+        live = {part.path.name for part in self._parts} | {
+            part._gen1.name for part in self._parts
+        }
+        for p in sorted(self.path.parent.glob(f"{self.path.name}.p*-of-*")):
+            if p.name not in live and p.is_file():
+                self._retired.append(p)
+
+    @property
+    def appends(self) -> int:
+        return sum(p.appends for p in self._parts)
+
+    @property
+    def rotations(self) -> int:
+        return sum(p.rotations for p in self._parts)
+
+    def _route(self, frame: dict) -> int:
+        if self._shard_of is not None:
+            try:
+                k = int(self._shard_of(frame))
+                if 0 <= k < self.n_shards:
+                    return k
+            except Exception:
+                pass
+        # stable fallback: hash the symbol name (deterministic across
+        # restarts — routing only balances load, merge order is by seq)
+        sym = str(frame.get("symbol", ""))
+        return sum(sym.encode()) % self.n_shards
+
+    def append(self, frame: dict, words: np.ndarray) -> None:
+        self._parts[self._route(frame)].append(frame, words)
+
+    def _retired_entries(self) -> list[tuple[dict, np.ndarray]]:
+        out = []
+        for p in self._retired:
+            if not p.exists():
+                continue
+            try:
+                with open(p, encoding="utf-8") as f:
+                    lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+            except OSError:
+                continue
+            for raw in lines:
+                try:
+                    rec = json.loads(raw)
+                    words = np.frombuffer(
+                        base64.b64decode(rec["w"]), np.uint32
+                    )
+                    out.append((rec["frame"], words))
+                except (ValueError, KeyError):
+                    continue
+        return out
+
+    def entries(self) -> list[tuple[dict, np.ndarray]]:
+        """Every partition's (frame, words) pairs merged into ONE stream
+        ordered by the plane's global seq — the single coherent cursor
+        timeline subscribers replay against."""
+        out = self._retired_entries()
+        for part in self._parts:
+            out.extend(part.entries())
+        out.sort(key=lambda e: int(e[0].get("seq", -1)))
+        return out
+
+    def last_seq(self) -> int:
+        best = -1
+        for frame, _ in self.entries():
+            best = max(best, int(frame.get("seq", -1)))
+        return best
+
+    def resolve_cursor(
+        self, cursor: str, entries: list | None = None
+    ) -> int | None:
+        ents = entries if entries is not None else self.entries()
+        return BroadcastOutbox.resolve_cursor(self, cursor, ents)
+
+    def replay_after(
+        self, seq: int, slot: int, entries: list | None = None
+    ) -> list[dict]:
+        ents = entries if entries is not None else self.entries()
+        return BroadcastOutbox.replay_after(self, seq, slot, ents)
+
+    def close(self) -> None:
+        for part in self._parts:
+            part.close()
+
+
 # -- connections -------------------------------------------------------------
 
 

@@ -65,17 +65,43 @@ class FanoutPlane:
         outbox_path: str | None = None,
         outbox_cap: int = 4096,
         conn_queue_max: int = 256,
+        outbox_shards: int = 1,
     ) -> None:
         self.engine_registry = engine_registry
         self.subscriptions = SubscriptionRegistry(
             symbol_capacity=engine_registry.capacity, capacity=capacity
         )
         self._device = DevicePlanes(self.subscriptions)
-        self.outbox = (
-            BroadcastOutbox(outbox_path, cap=outbox_cap)
-            if outbox_path
-            else None
-        )
+        self.outbox_shards = int(outbox_shards) if outbox_path else 0
+        if outbox_path and int(outbox_shards) > 1:
+            # per-shard partitions under one global cursor (ISSUE 19):
+            # frames route by the firing row's symbol shard — the same
+            # contiguous blocks the engine mesh owns — while the hub
+            # reads one merged seq-ordered stream
+            from binquant_tpu.fanout.hub import ShardedBroadcastOutbox
+            from binquant_tpu.parallel.mesh import shard_of_row
+
+            cap_rows = engine_registry.capacity
+            n = int(outbox_shards)
+
+            def _frame_shard(frame, _n=n, _cap=cap_rows):
+                row = frame.get("row")
+                if row is None:
+                    raise KeyError("row")
+                return shard_of_row(int(row), _cap, _n)
+
+            self.outbox = ShardedBroadcastOutbox(
+                outbox_path,
+                n_shards=n,
+                cap=outbox_cap,
+                shard_of=_frame_shard,
+            )
+        else:
+            self.outbox = (
+                BroadcastOutbox(outbox_path, cap=outbox_cap)
+                if outbox_path
+                else None
+            )
         # per-slot minimum frame seq: slots are RECYCLED on unsubscribe,
         # and outbox frames / in-flight delivery-worker frames encode
         # recipients as slot bits — a new claimant must never receive (or
@@ -237,6 +263,7 @@ class FanoutPlane:
                 "tick_ms": tick_ms,
                 "strategy": signal.strategy,
                 "symbol": signal.symbol,
+                "row": int(getattr(signal, "row", -1)),
                 "direction": str(signal.value.direction),
                 "score": float(signal.value.score or 0.0),
                 "autotrade": bool(signal.value.autotrade),

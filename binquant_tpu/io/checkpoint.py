@@ -12,6 +12,21 @@ cold-start, no backfill burst.
 Format: the EngineState's flattened leaves in tree order (the treedef is
 code-defined, so only shapes/count are validated), plus JSON blobs for the
 registry mapping and host carries.
+
+Sharded archives (ISSUE 19): under a symbol mesh the snapshot splits into
+one npz per shard — ``<path>`` holds shard 0 plus the manifest (registry,
+host carries, shard roster, a per-save nonce) and ``<path>.shardK-of-N``
+hold the rest. Each shard archives only the symbol-axis SLICES its
+devices own (a pod process would write exactly its addressable rows);
+replicated leaves ride shard 0 once. The resharding story — save at N,
+restore at M — is deliberately boring: archives are canonical (cursor →
+0, cursor leaves stripped), so restore concatenates the slices back to
+full host arrays and the engine re-slices at its OWN mesh size via
+``shard_engine_state``. No state migration exists because none is
+needed: slice-rebalance on registry churn or a different M is a
+host-side re-slice of canonical arrays. Torn multi-file saves are
+detected by the nonce (every shard echoes the manifest's) and fail the
+restore into a cold start rather than mixing generations.
 """
 
 from __future__ import annotations
@@ -96,19 +111,206 @@ def save_state(
         raise
 
 
+def _shard_path(path: Path, k: int, n: int) -> Path:
+    """Sibling archive holding shard ``k`` of ``n`` (shard 0 IS ``path``)."""
+    if k == 0:
+        return path
+    return path.with_name(f"{path.name}.shard{k}-of-{n}")
+
+
+def _symbol_leaf_flags(leaves, capacity: int) -> list[bool]:
+    """Which archive leaves carry the symbol axis (leading dim ==
+    capacity) — the same shape rule ``parallel.mesh._shard_carry`` places
+    by, so the archive splits exactly where the mesh does."""
+    assert capacity != 4, "capacity of 4 is ambiguous with score vectors"
+    return [
+        np.ndim(leaf) >= 1 and np.shape(leaf)[0] == capacity
+        for leaf in leaves
+    ]
+
+
+def save_state_sharded(
+    path: str | Path,
+    state,
+    registry,
+    n_shards: int,
+    host_carries: dict | None = None,
+) -> None:
+    """Write the snapshot as ``n_shards`` per-shard archives (see module
+    docstring). Symbol-axis leaves are sliced with ``shard_bounds`` — the
+    identical contiguous blocks NamedSharding assigns — so on a real pod
+    each process's ``np.asarray`` would pull only locally-resident rows.
+    Commit order: sibling shards first (atomic tmp+rename each), the
+    manifest shard 0 at ``path`` last; a torn save leaves a stale or
+    nonce-mismatched roster, which the loader rejects into a cold start.
+    """
+    from binquant_tpu.engine.step import canonicalize_state
+    from binquant_tpu.parallel.mesh import shard_bounds
+
+    n_shards = int(n_shards)
+    if n_shards <= 1:
+        return save_state(path, state, registry, host_carries=host_carries)
+    leaves = _archive_leaves(canonicalize_state(state))
+    capacity = int(np.shape(state.buf15.filled)[0])
+    flags = _symbol_leaf_flags(leaves, capacity)
+    bounds = shard_bounds(capacity, n_shards)
+    nonce = os.urandom(8).hex()
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+
+    def _write(target: Path, arrays: dict, meta: dict) -> None:
+        fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".ckpt.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(
+                    f,
+                    __meta=np.frombuffer(
+                        json.dumps(meta).encode(), np.uint8
+                    ),
+                    **arrays,
+                )
+            os.replace(tmp, target)
+        except BaseException:
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(tmp)
+            raise
+
+    host = [np.asarray(leaf) for leaf in leaves]
+    for k in range(n_shards - 1, -1, -1):  # manifest (k=0) commits last
+        lo, hi = bounds[k]
+        arrays = {
+            f"leaf_{i}": (host[i][lo:hi] if flags[i] else host[i])
+            for i in range(len(host))
+            if flags[i] or k == 0  # replicated leaves ride shard 0 once
+        }
+        meta = {
+            "version": CKPT_VERSION,
+            "n_leaves": len(leaves),
+            "shard_count": n_shards,
+            "shard_index": k,
+            "rows": [lo, hi],
+            "nonce": nonce,
+        }
+        if k == 0:
+            meta["registry"] = registry.to_mapping()
+            meta["host_carries"] = host_carries or {}
+            meta["symbol_leaves"] = [
+                i for i, f in enumerate(flags) if f
+            ]
+        _write(_shard_path(path, k, n_shards), arrays, meta)
+
+
+def _load_sharded(path: Path, meta: dict, data, template_state, registry):
+    """Reassemble a sharded archive set: concatenate each symbol leaf's
+    per-shard slices back to the full host array (replicated leaves come
+    from the manifest shard), validate shapes against the template, and
+    return the same ``(state, carries)`` contract as a monolithic load.
+    The caller re-shards at its own mesh — restore@M is this concat plus
+    ``shard_engine_state``, nothing else."""
+    import jax
+    import jax.numpy as jnp
+
+    n = int(meta["shard_count"])
+    sym = set(meta["symbol_leaves"])
+    t_leaves, treedef = jax.tree_util.tree_flatten(
+        _sans_cursor(template_state)
+    )
+    if meta["n_leaves"] != len(t_leaves):
+        raise ValueError(
+            f"sharded checkpoint has {meta['n_leaves']} leaves, "
+            f"engine expects {len(t_leaves)}"
+        )
+    parts: dict[int, list] = {i: [] for i in sym}
+    rep: dict[int, np.ndarray] = {}
+    for i in range(len(t_leaves)):
+        if i not in sym:
+            rep[i] = data[f"leaf_{i}"]
+    for k in range(n):
+        sp = _shard_path(path, k, n)
+        if k == 0:
+            sd, smeta = data, meta
+            for i in sym:
+                parts[i].append(sd[f"leaf_{i}"])
+        else:
+            if not sp.exists():
+                raise ValueError(
+                    f"shard file {sp.name} missing (torn save) — start cold"
+                )
+            with np.load(sp) as sd:
+                smeta = json.loads(bytes(sd["__meta"].tobytes()).decode())
+                if smeta.get("nonce") != meta.get("nonce"):
+                    raise ValueError(
+                        f"shard {k} nonce mismatch (torn save) — start cold"
+                    )
+                if smeta.get("shard_index") != k or smeta.get(
+                    "shard_count"
+                ) != n:
+                    raise ValueError(
+                        f"shard file {sp.name} roster mismatch — start cold"
+                    )
+                for i in sym:
+                    parts[i].append(sd[f"leaf_{i}"])
+    leaves = []
+    for i, t in enumerate(t_leaves):
+        arr = (
+            np.concatenate(parts[i], axis=0) if i in sym else rep[i]
+        )
+        if tuple(arr.shape) != tuple(np.shape(t)):
+            raise ValueError(
+                f"checkpoint leaf {i} shape {arr.shape} != {np.shape(t)} "
+                "(capacity/window changed — start cold)"
+            )
+        leaves.append(arr)
+    state = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(a) for a in leaves]
+    )
+    state = _reattach_cursors(state)
+    registry.restore(meta["registry"])
+    return state, dict(meta.get("host_carries", {}))
+
+
+def _reattach_cursors(state):
+    """Re-attach the canonical (zero) cursors the archive strips."""
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.buffer import MarketBuffer
+
+    def _with_cursor(triple):
+        times, values, filled = triple
+        return MarketBuffer(
+            times=times, values=values, filled=filled,
+            cursor=jnp.zeros(filled.shape, jnp.int32),
+        )
+
+    return state._replace(
+        buf5=_with_cursor(state.buf5), buf15=_with_cursor(state.buf15)
+    )
+
+
 def load_state(path: str | Path, template_state, registry):
     """Restore (state, host_carries) from ``path`` into the template's
     pytree structure; the registry is rebuilt row-accurately in place.
+    A manifest written by :func:`save_state_sharded` transparently loads
+    the whole shard roster and reassembles (restore@M = this + the
+    engine's own re-shard).
 
     Raises ValueError on shape/count mismatch (capacity or window changed
     — start cold instead).
     """
     import jax
 
+    path = Path(path)
     with np.load(path) as data:
         meta = json.loads(bytes(data["__meta"].tobytes()).decode())
         if meta["version"] not in (1, 2, 3, CKPT_VERSION):
             raise ValueError(f"checkpoint version {meta['version']} unsupported")
+        if int(meta.get("shard_count", 1)) > 1:
+            if int(meta.get("shard_index", 0)) != 0:
+                raise ValueError(
+                    f"{path.name} is a non-manifest shard file — restore "
+                    "from the manifest path"
+                )
+            return _load_sharded(path, meta, data, template_state, registry)
         # v3 and v4 share one leaf layout (the cursor is never archived);
         # flatten the cursor-stripped template for counting and order
         t_leaves, treedef = jax.tree_util.tree_flatten(
@@ -161,19 +363,7 @@ def load_state(path: str | Path, template_state, registry):
     state = jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(a) for a in leaves]
     )
-    # re-attach the canonical (zero) cursors the archive strips
-    from binquant_tpu.engine.buffer import MarketBuffer
-
-    def _with_cursor(triple):
-        times, values, filled = triple
-        return MarketBuffer(
-            times=times, values=values, filled=filled,
-            cursor=jnp.zeros(filled.shape, jnp.int32),
-        )
-
-    state = state._replace(
-        buf5=_with_cursor(state.buf5), buf15=_with_cursor(state.buf15)
-    )
+    state = _reattach_cursors(state)
     registry.restore(meta["registry"])
     carries = dict(meta.get("host_carries", {}))
     if migrated:
@@ -204,10 +394,12 @@ class CheckpointManager:
             return False
         t0 = time.perf_counter()
         try:
-            save_state(
+            n_shards = self.shard_count_for(engine)
+            save_state_sharded(
                 self.path,
                 engine.state,
                 engine.registry,
+                n_shards,
                 host_carries=engine.host_carries(),
             )
             CHECKPOINT_SAVES.labels(outcome="ok").inc()
@@ -215,6 +407,7 @@ class CheckpointManager:
                 "checkpoint_save",
                 path=str(self.path),
                 ticks=engine.ticks_processed,
+                shards=n_shards,
                 duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
             )
             return True
@@ -222,6 +415,18 @@ class CheckpointManager:
             CHECKPOINT_SAVES.labels(outcome="error").inc()
             logging.exception("checkpoint save failed; continuing")
             return False
+
+    @staticmethod
+    def shard_count_for(engine) -> int:
+        """How many shard archives this engine saves: the explicit
+        ``BQT_CKPT_SHARDS`` knob when set, else the mesh size (1 when
+        unsharded). Restore accepts ANY saved count regardless."""
+        cfg = getattr(engine, "config", None)
+        explicit = int(getattr(cfg, "ckpt_shards", 0) or 0)
+        if explicit > 0:
+            return explicit
+        mesh = getattr(engine, "mesh", None)
+        return mesh.devices.size if mesh is not None else 1
 
     def try_restore(self, engine) -> bool:
         if not self.path.exists():
@@ -232,10 +437,18 @@ class CheckpointManager:
             logging.exception("checkpoint restore failed; starting cold")
             return False
         if getattr(engine, "mesh", None) is not None:
+            # restore@M: the loader reassembled full canonical arrays
+            # whatever shard count saved them; re-slice at THIS engine's
+            # mesh — the entire resharding story
             from binquant_tpu.parallel.mesh import shard_engine_state
 
             state = shard_engine_state(state, engine.mesh)
         engine.state = state
+        if hasattr(engine, "_invalidate_spares"):
+            # a restored state is a new lineage — no donation spare from
+            # the pre-restore lineage (or a different shard count) may
+            # ever be donated into it
+            engine._invalidate_spares("checkpoint restore")
         engine.restore_host_carries(carries)
         if hasattr(engine, "note_state_restored"):
             # refresh the host-side latest-ts mirror and carry sync state

@@ -270,7 +270,13 @@ def _unique_buffers(state):
         try:
             ptr = leaf.unsafe_buffer_pointer()
         except Exception:
-            ptr = None
+            # sharded arrays have no single buffer pointer; two leaves
+            # alias iff their per-device shards do, so the first
+            # addressable shard's pointer is a sufficient identity
+            try:
+                ptr = leaf.addressable_shards[0].data.unsafe_buffer_pointer()
+            except Exception:
+                ptr = None
         if ptr is not None:
             if ptr in seen:
                 leaf = jnp.copy(leaf)
@@ -569,6 +575,12 @@ class SignalEngine:
         if bool(getattr(config, "fanout_enabled", False)):
             from binquant_tpu.fanout.plane import FanoutPlane
 
+            # outbox partition count: explicit knob, else the symbol
+            # mesh size (per-shard delivery partitions merged under one
+            # global cursor; 1 = the classic single-file outbox)
+            _ob_shards = int(
+                _knob(config, "fanout_outbox_shards", 0) or 0
+            ) or (self.mesh.devices.size if self.mesh is not None else 1)
             self.fanout = FanoutPlane(
                 self.registry,
                 capacity=int(_knob(config, "fanout_capacity", 1024)),
@@ -577,6 +589,7 @@ class SignalEngine:
                 ),
                 outbox_cap=int(_knob(config, "fanout_outbox_cap", 4096)),
                 conn_queue_max=int(_knob(config, "fanout_conn_queue", 256)),
+                outbox_shards=_ob_shards,
             )
             if self.slo is not None:
                 # PR 14 recipient-set integrity as a verdict invariant
@@ -688,6 +701,12 @@ class SignalEngine:
         # p99 spikes (GC) on the 50 ms budget
         self._scalar_cache: dict[str, tuple[Any, Any]] = {}
         self._tracked_cache: tuple[int, Any] | None = None
+        # Per-tick tracked-mask snapshot set by _redrive_serial: a scan
+        # plan broken by registry churn re-drives its buffered ticks AFTER
+        # the churn already mutated the registry, so each re-driven tick
+        # must dispatch with the mask captured when it was planned, not
+        # the live one (digest `tracked` parity with a never-scanned run).
+        self._tracked_override: Any = None
         self._nan_oi_cache: Any = None
         # -- incremental indicator fast path (engine/step.py incremental=True)
         # The host decides per tick: carried state is only valid when every
@@ -1558,7 +1577,13 @@ class SignalEngine:
         to its plan-start snapshot first so the serial pass re-judges every
         tick exactly as the original stream did — each stays on the
         incremental route, keeping the emitted set identical to a
-        never-scanned drive."""
+        never-scanned drive. Each tick also dispatches with ITS OWN
+        plan-time ``tracked`` snapshot (not the live registry mask): a
+        churn break drains the registry claim BEFORE the re-drive runs,
+        so without the snapshot the re-driven ticks would read ``tracked``
+        one claim early — zero signal impact (an empty row cannot fire)
+        but a spurious per-tick diff in the ingest digest's tracked
+        count (the PR 16 wrinkle, now closed)."""
         self._host_latest = {
             key: arr.copy() for key, arr in plan["host_latest"].items()
         }
@@ -1566,7 +1591,11 @@ class SignalEngine:
         fired: list = []
         for p in plan["ticks"]:
             self._requeue_batches(p.batches5, p.batches15)
-            fired.extend(await self.process_tick(now_ms=p.now_ms))
+            self._tracked_override = p.tracked
+            try:
+                fired.extend(await self.process_tick(now_ms=p.now_ms))
+            finally:
+                self._tracked_override = None
         return fired
 
     async def _flush_scan_plan(self, plan: dict) -> list:
@@ -2250,9 +2279,7 @@ class SignalEngine:
                     self._spare_slots.pop() if self._spare_slots else None
                 )
                 if scratch is None or scratch is prev_state:
-                    scratch = initial_engine_state(
-                        self.capacity, window=self.window
-                    )
+                    scratch = self._fresh_state()
                 # donation rejects internally-aliased buffers (zero-fill
                 # dedup in a fresh state, XLA output dedup in a recycled
                 # one) — split them before handing the slot over
@@ -2481,8 +2508,11 @@ class SignalEngine:
             import threading
 
             if donate:
+                # the throwaway state matches the live one's placement —
+                # under a mesh an unsharded warm state would compile (and
+                # warm) a different executable than the real fallback uses
                 warm_args = (
-                    initial_engine_state(self.capacity, window=self.window),
+                    self._fresh_state(),
                     empty, empty, inputs, cfg, key, incr_args,
                 )
             else:
@@ -2998,8 +3028,24 @@ class SignalEngine:
     def _donation_mode(self) -> str | None:
         """How THIS dispatch donates the engine state (BQT_DONATE).
 
-        * ``None`` — copying step (donation off, or a sharded mesh, whose
-          executables keep the copying layout).
+        Donation COMPOSES with the symbol mesh (the ISSUE 19 decision):
+        GSPMD compiles one executable spanning every shard, so donating a
+        sharded input aliases each per-device buffer with the matching
+        output shard — the rotation logic below is unchanged, it just
+        rotates sharded states. Two mesh-specific obligations: spare
+        slots must be CREATED sharded (a fresh unsharded scratch would
+        change the jit signature and silently recompile the db step per
+        dispatch), and the generation stamp is scoped to the state
+        lineage *including its placement* — ``_invalidate_spares`` bumps
+        it on cold resets AND on checkpoint restores (which may install a
+        state saved at a different shard count), so no spare from a
+        pre-restore lineage can ever be donated into the new one.
+        Per-shard spare rotation/generations collapse to this single
+        rotation because one process drives one executable over all
+        shards; a per-process pod runtime would instantiate one rotation
+        per process, which is this exact code.
+
+        * ``None`` — copying step (donation off).
         * ``"single"`` — ``pipeline_depth <= 1``: the classic ISSUE-4
           scheme donating the input state itself. Safe because
           process_tick finalizes tick i before dispatching i+1, so the
@@ -3024,7 +3070,7 @@ class SignalEngine:
         needed (the slot is simply re-allocated next dispatch). Host-side
         errors before the launch leave state intact either way.
         """
-        if not self._donate_cfg or self.mesh is not None:
+        if not self._donate_cfg:
             return None
         return "single" if self.pipeline_depth <= 1 else "double"
 
@@ -3032,10 +3078,41 @@ class SignalEngine:
         """Back-compat boolean view of :meth:`_donation_mode`."""
         return self._donation_mode() is not None
 
+    def _fresh_state(self):
+        """A cold empty EngineState carrying the engine's placement —
+        sharded over the symbol mesh when one is active, so spares,
+        scratch slots, and warm-up states always match the live state's
+        jit signature."""
+        state = initial_engine_state(self.capacity, window=self.window)
+        if self.mesh is not None:
+            from binquant_tpu.parallel.mesh import shard_engine_state
+
+            state = shard_engine_state(state, self.mesh)
+        return state
+
+    def _invalidate_spares(self, why: str) -> None:
+        """Retire every donation spare of the current state lineage —
+        cold resets AND checkpoint restores route through here, so a
+        state installed from a different lineage (possibly saved at a
+        different shard count and re-sliced) can never receive a donated
+        spare that aliases the old lineage's buffers."""
+        self._spare_slots.clear()
+        self._deferred_spare = None
+        self._state_generation += 1
+        logging.info(
+            "donation spares invalidated (%s); state generation now %d",
+            why,
+            self._state_generation,
+        )
+
     def _reset_state_cold(self, why: str) -> None:
         """Replace an unrecoverable engine state with a cold empty one —
         the engine recovers like a restart without a checkpoint
-        (strategy-blind until buffers refill). Logged loudly, counted."""
+        (strategy-blind until buffers refill). Logged loudly, counted.
+        The replacement carries the mesh sharding when one is active — an
+        unsharded replacement would silently repin the whole ~66
+        MB-per-copy state on one chip (and force a fresh
+        sharding-signature recompile) for the rest of the process."""
         self.donated_state_resets += 1
         logging.error(
             "%s; resetting engine state cold (reset #%d — buffers must "
@@ -3043,21 +3120,11 @@ class SignalEngine:
             why,
             self.donated_state_resets,
         )
-        self.state = initial_engine_state(self.capacity, window=self.window)
+        self.state = self._fresh_state()
         # drop the double-buffer slots too — they may alias buffers the
         # failed computation produced — and invalidate any spare still
         # riding a pending tick of the failed lineage
-        self._spare_slots.clear()
-        self._deferred_spare = None
-        self._state_generation += 1
-        if self.mesh is not None:
-            # re-apply the symbol-axis sharding __init__ installed — an
-            # unsharded replacement state would silently repin the whole
-            # ~66 MB-per-copy state on one chip (and force a fresh
-            # sharding-signature recompile) for the rest of the process
-            from binquant_tpu.parallel.mesh import shard_engine_state
-
-            self.state = shard_engine_state(self.state, self.mesh)
+        self._invalidate_spares(f"cold reset: {why}")
         for latest in self._host_latest.values():
             latest[:] = -1
         self._carry_desync_reason = "cold_start"
@@ -3124,20 +3191,30 @@ class SignalEngine:
 
     def _place_symbol_array(self, arr):
         """Host (S,) array → device, split over the symbol mesh when one is
-        active (pre-placing avoids a per-tick resharding inside jit)."""
-        import jax
+        active (pre-placing avoids a per-tick resharding inside jit).
 
+        Under a mesh this is the shard-local ingest boundary: the host
+        array is sliced per shard and each slice ships straight to the
+        device that owns those rows (``assemble_sharded`` →
+        ``make_array_from_single_device_arrays``) — no full-array
+        ``device_put`` on the hot path, and the identical construction a
+        multi-host pod performs per process."""
         if self.mesh is None:
             import jax.numpy as jnp
 
             return jnp.asarray(arr)
-        from binquant_tpu.parallel.mesh import symbol_sharding
+        from binquant_tpu.parallel.mesh import assemble_sharded
 
-        return jax.device_put(arr, symbol_sharding(self.mesh, 1))
+        return assemble_sharded(self.mesh, np.asarray(arr))
 
     def _tracked_mask(self):
         """Device-resident occupied-rows mask, rebuilt only on registry
-        membership changes."""
+        membership changes. During a serial re-drive the plan-time
+        snapshot wins over the live registry (see _redrive_serial)."""
+        if self._tracked_override is not None:
+            return self._place_symbol_array(
+                np.asarray(self._tracked_override)
+            )
         cached = self._tracked_cache
         if cached is not None and cached[0] == self.registry.version:
             return cached[1]
@@ -3417,6 +3494,36 @@ class SignalEngine:
             ),
         }
 
+    def _mesh_snapshot(self) -> dict:
+        """Sharded-plane section for /healthz: geometry + per-shard live
+        row counts (host-side reads only — the registry mask, never a
+        device fetch)."""
+        if self.mesh is None:
+            return {"enabled": False}
+        from binquant_tpu.parallel.mesh import shard_bounds
+
+        n = int(self.mesh.devices.size)
+        bounds = shard_bounds(self.registry.capacity, n)
+        active = self.registry.active_rows
+        return {
+            "enabled": True,
+            "devices": n,
+            "shards": [
+                {
+                    "shard": k,
+                    "rows": [lo, hi],
+                    "tracked_rows": int(active[lo:hi].sum()),
+                }
+                for k, (lo, hi) in enumerate(bounds)
+            ],
+            "state_generation": self._state_generation,
+            "outbox_shards": (
+                getattr(self.fanout, "outbox_shards", None)
+                if self.fanout is not None
+                else None
+            ),
+        }
+
     def health_snapshot(self, max_age_s: float = 1500.0) -> dict:
         """Liveness JSON for the /healthz endpoint (obs.exposition).
 
@@ -3506,6 +3613,11 @@ class SignalEngine:
             # host monitor's churn/arrival tallies; per-symbol detail is
             # the paginated GET /debug/symbols route
             "ingest": ingest,
+            # sharded execution plane (ISSUE 19): mesh geometry + which
+            # contiguous row block each shard owns and how many of those
+            # rows are live — the per-shard operating surface PR 15's
+            # observatory was built to report through
+            "mesh": self._mesh_snapshot(),
             # event-log drops (write failures / emit-after-close) — zero
             # in a healthy deployment
             "eventlog_dropped": get_event_log().dropped,

@@ -11,17 +11,25 @@ fully parallel.
 Capacity S must be a multiple of the mesh size (the registry pads — S is a
 static config knob, BQT_MAX_SYMBOLS).
 
-SCOPE — single host only. ``shard_host_inputs``/``shard_engine_state``
-build full arrays on the host and ``jax.device_put`` them against a
-NamedSharding, which requires every mesh device to be addressable from
-this process. That covers the production target (one v5e chip) and
-multi-chip single-host meshes (the 8-device dryrun), NOT a multi-host pod:
-there each process must construct only its addressable shards
-(``jax.make_array_from_single_device_arrays`` from per-host slices of the
-symbol axis, with the ingest path routing each symbol's klines to the host
-that owns its rows) and the checkpoint restore must re-slice per process.
-``make_mesh`` fails fast under multi-process JAX rather than letting
-device_put raise mid-tick.
+ASSEMBLY — pod-shaped everywhere. Every placement routes through
+``jax.make_array_from_single_device_arrays``: the host slices each leaf
+along the symbol axis with the sharding's own device→index map and ships
+each shard's bytes straight to the device that owns it, then stitches the
+global ``jax.Array`` from those single-device pieces. On one host that is
+exactly the multi-host construction with *all* shards addressable, so the
+CPU virtual mesh (``--xla_force_host_platform_device_count``, the dryrun
+lane) validates the identical code path a real pod runs per process —
+no full-array ``device_put`` + GSPMD redistribution anywhere, including
+the per-tick ``HostInputs`` hot path (``shard_host_inputs`` and the
+pipeline's ``_place_symbol_array``).
+
+``make_mesh`` still fails fast under multi-process JAX: the assembly is
+process-local by construction, but the *control plane* around it (one
+registry claiming rows, one ingest batcher, one outbox cursor) has not
+been split per process yet. A pod additionally needs each process to run
+ingest for only its own row range (``shard_bounds``/``shard_of_row`` are
+the routing primitives) and the checkpoint restore to re-slice per
+process (``io/checkpoint.py`` sharded archives).
 """
 
 from __future__ import annotations
@@ -39,10 +47,11 @@ from binquant_tpu.regime.context import RegimeCarry
 def make_mesh(devices: list | None = None, axis: str = "symbols") -> Mesh:
     if jax.process_count() > 1:
         raise NotImplementedError(
-            "binquant_tpu's mesh mode is single-host: shard_host_inputs "
-            "device_puts full host arrays, which requires all mesh devices "
-            "addressable from one process (see module docstring for the "
-            "process-local construction a pod would need)"
+            "binquant_tpu's mesh mode is single-host: the per-shard "
+            "assembly (make_array_from_single_device_arrays) is already "
+            "pod-shaped, but the registry/ingest/outbox control plane is "
+            "one process (see module docstring for the per-process split "
+            "a pod would need)"
         )
     devs = np.array(devices if devices is not None else jax.devices())
     return Mesh(devs, axis_names=(axis,))
@@ -57,15 +66,91 @@ def _replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def shard_bounds(capacity: int, n_shards: int) -> list[tuple[int, int]]:
+    """Contiguous ``[lo, hi)`` row range each shard owns.
+
+    NamedSharding over a 1-D mesh splits the leading axis into equal
+    contiguous blocks in mesh-device order — shard ``k`` owns rows
+    ``[k·S/N, (k+1)·S/N)``. This is the single source of truth the ingest
+    router, the sharded checkpoint archives, and the per-shard outbox
+    partitions all derive from.
+    """
+    if capacity % n_shards:
+        raise ValueError(
+            f"capacity {capacity} not divisible by {n_shards} shards"
+        )
+    block = capacity // n_shards
+    return [(k * block, (k + 1) * block) for k in range(n_shards)]
+
+
+def shard_of_row(row: int, capacity: int, n_shards: int) -> int:
+    """Which shard owns registry row ``row`` (see :func:`shard_bounds`)."""
+    block = capacity // n_shards
+    if row < 0 or row >= capacity:
+        raise ValueError(f"row {row} outside capacity {capacity}")
+    return row // block
+
+
+def assemble_sharded(mesh: Mesh, host, sharding: NamedSharding | None = None):
+    """Build a global ``jax.Array`` from per-shard host slices.
+
+    ``host`` is a full host-side array (numpy or convertible); each
+    device's slice is taken via the sharding's device→index map and put
+    on that device alone, then the global array is stitched with
+    ``jax.make_array_from_single_device_arrays``. No full-array
+    ``device_put`` happens: shard k's bytes travel only to device k.
+    On a multi-host pod the identical call works per process — the index
+    map yields only addressable devices, so each process slices just the
+    rows it owns.
+    """
+    host = np.asarray(host)
+    if sharding is None:
+        sharding = symbol_sharding(mesh, max(host.ndim, 1))
+    if host.ndim == 0:
+        sharding = _replicated(mesh)
+    dmap = sharding.addressable_devices_indices_map(host.shape)
+    leaves = [jax.device_put(host[idx], d) for d, idx in dmap.items()]
+    return jax.make_array_from_single_device_arrays(
+        host.shape, sharding, leaves
+    )
+
+
+def assemble_from_slices(mesh: Mesh, slices: list, sharding: NamedSharding):
+    """Pod-primitive twin of :func:`assemble_sharded` for callers that
+    already hold per-shard slices (ingest routing, sharded checkpoint
+    restore): ``slices[k]`` goes to mesh device ``k`` verbatim — the host
+    never materializes the concatenated array at all."""
+    devs = list(mesh.devices.flat)
+    if len(slices) != len(devs):
+        raise ValueError(
+            f"{len(slices)} slices for {len(devs)} mesh devices"
+        )
+    lead = sum(np.asarray(s).shape[0] for s in slices)
+    trailing = np.asarray(slices[0]).shape[1:]
+    leaves = [jax.device_put(np.asarray(s), d) for s, d in zip(slices, devs)]
+    return jax.make_array_from_single_device_arrays(
+        (lead, *trailing), sharding, leaves
+    )
+
+
+def _put(mesh: Mesh, x, sharding: NamedSharding):
+    """Place one leaf through the per-shard assembly, skipping leaves that
+    already carry the target sharding (idempotent re-shard on restore)."""
+    if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
+        if getattr(x, "sharding", None) == sharding:
+            return x
+    return assemble_sharded(mesh, x, sharding)
+
+
 def _shard_buffer(buf: MarketBuffer, mesh: Mesh) -> MarketBuffer:
     s2 = symbol_sharding(mesh, 2)
     s3 = symbol_sharding(mesh, 3)
     s1 = symbol_sharding(mesh, 1)
     return MarketBuffer(
-        times=jax.device_put(buf.times, s2),
-        values=jax.device_put(buf.values, s3),
-        filled=jax.device_put(buf.filled, s1),
-        cursor=jax.device_put(buf.cursor, s1),
+        times=_put(mesh, buf.times, s2),
+        values=_put(mesh, buf.values, s3),
+        filled=_put(mesh, buf.filled, s1),
+        cursor=_put(mesh, buf.cursor, s1),
     )
 
 
@@ -77,12 +162,13 @@ def _shard_carry(carry, mesh: Mesh, num_symbols: int):
     here (every IndicatorCarry leaf is (S,) or (S, k))."""
     # the (4,) market-score vectors must not be mistaken for a symbol axis
     assert num_symbols != 4, "capacity of 4 is ambiguous with score vectors"
-    s1 = symbol_sharding(mesh, 1)
     r = _replicated(mesh)
 
     def place(x):
+        x = jnp.asarray(x) if not hasattr(x, "ndim") else x
         is_symbol_axis = x.ndim >= 1 and x.shape[0] == num_symbols
-        return jax.device_put(x, s1 if is_symbol_axis else r)
+        sh = symbol_sharding(mesh, x.ndim) if is_symbol_axis else r
+        return _put(mesh, x, sh)
 
     return jax.tree_util.tree_map(place, carry)
 
@@ -97,8 +183,8 @@ def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
         regime_carry=_shard_carry(
             state.regime_carry, mesh, state.buf15.capacity
         ),
-        mrf_last_emitted=jax.device_put(state.mrf_last_emitted, s1),
-        pt_last_signal_close=jax.device_put(state.pt_last_signal_close, s1),
+        mrf_last_emitted=_put(mesh, state.mrf_last_emitted, s1),
+        pt_last_signal_close=_put(mesh, state.pt_last_signal_close, s1),
         indicator_carry=_shard_carry(
             state.indicator_carry, mesh, state.buf15.capacity
         ),
@@ -106,27 +192,31 @@ def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
 
 
 def shard_host_inputs(inputs: HostInputs, mesh: Mesh) -> HostInputs:
-    """(S,) inputs split over symbols; scalars replicated."""
+    """(S,) inputs split over symbols via per-shard slices; scalars
+    replicated (one tiny put per device — pod-safe)."""
     s1 = symbol_sharding(mesh, 1)
     r = _replicated(mesh)
+
+    def sym(x):
+        return assemble_sharded(mesh, np.asarray(x), s1)
+
+    def rep(x):
+        return assemble_sharded(mesh, np.asarray(x), r)
+
     return HostInputs(
-        tracked=jax.device_put(jnp.asarray(inputs.tracked), s1),
-        btc_row=jax.device_put(jnp.asarray(inputs.btc_row), r),
-        timestamp_s=jax.device_put(jnp.asarray(inputs.timestamp_s), r),
-        timestamp5_s=jax.device_put(jnp.asarray(inputs.timestamp5_s), r),
-        oi_growth=jax.device_put(jnp.asarray(inputs.oi_growth), s1),
-        adp_latest=jax.device_put(jnp.asarray(inputs.adp_latest), r),
-        adp_prev=jax.device_put(jnp.asarray(inputs.adp_prev), r),
-        adp_diff=jax.device_put(jnp.asarray(inputs.adp_diff), r),
-        adp_diff_prev=jax.device_put(jnp.asarray(inputs.adp_diff_prev), r),
-        breadth_momentum_points=jax.device_put(
-            jnp.asarray(inputs.breadth_momentum_points), r
-        ),
-        quiet_hours=jax.device_put(jnp.asarray(inputs.quiet_hours), r),
-        grid_policy_allows=jax.device_put(jnp.asarray(inputs.grid_policy_allows), r),
-        is_futures=jax.device_put(jnp.asarray(inputs.is_futures), r),
-        dominance_is_losers=jax.device_put(jnp.asarray(inputs.dominance_is_losers), r),
-        market_domination_reversal=jax.device_put(
-            jnp.asarray(inputs.market_domination_reversal), r
-        ),
+        tracked=sym(inputs.tracked),
+        btc_row=rep(inputs.btc_row),
+        timestamp_s=rep(inputs.timestamp_s),
+        timestamp5_s=rep(inputs.timestamp5_s),
+        oi_growth=sym(inputs.oi_growth),
+        adp_latest=rep(inputs.adp_latest),
+        adp_prev=rep(inputs.adp_prev),
+        adp_diff=rep(inputs.adp_diff),
+        adp_diff_prev=rep(inputs.adp_diff_prev),
+        breadth_momentum_points=rep(inputs.breadth_momentum_points),
+        quiet_hours=rep(inputs.quiet_hours),
+        grid_policy_allows=rep(inputs.grid_policy_allows),
+        is_futures=rep(inputs.is_futures),
+        dominance_is_losers=rep(inputs.dominance_is_losers),
+        market_domination_reversal=rep(inputs.market_domination_reversal),
     )

@@ -659,14 +659,16 @@ def test_churn_rewrite_stream_drill(tmp_path):
     closes, vols, _ = base_market(spec)
     klines = emit_stream(spec, closes, vols)
     rewrite_storm(klines, [spec.n_ticks - 6, spec.n_ticks - 4], per_tick=2)
-    # the listing lands on a chunk boundary: a churn break that strands a
-    # TOO-SHORT plan (< _SCAN_MIN_TICKS) re-drives it serially AFTER the
-    # churn drain already claimed the newcomer's row, so those re-driven
-    # ticks read `tracked` one registry claim early — a pre-existing
-    # fidelity wrinkle with zero signal impact (an empty row can't fire)
-    # that the digest's tracked count would surface as a spurious diff
+    # the listing lands MID-chunk on purpose: the churn break strands a
+    # too-short plan (3 buffered ticks < _SCAN_MIN_TICKS) that re-drives
+    # serially AFTER the churn drain already claimed the newcomer's row.
+    # Each re-driven tick now dispatches with its plan-time `tracked`
+    # snapshot (_redrive_serial), so the digest's tracked count stays
+    # bit-identical to the serial drive — this drill used to pin the
+    # listing onto the chunk boundary (empty stranded plan) to dodge
+    # exactly that diff
     listing_churn(
-        klines, listings={8: 25}, delistings={}, n_symbols=spec.n_symbols
+        klines, listings={8: 28}, delistings={}, n_symbols=spec.n_symbols
     )
     path = tmp_path / "churny.jsonl"
     with open(path, "w") as f:

@@ -1,0 +1,373 @@
+"""Sharded execution plane (ISSUE 19): shard geometry, sharded
+checkpoints (save@N/restore@M), the per-shard outbox merge, and the
+sharded-vs-single-shard equality drills.
+
+Tier-1 keeps the host-side units: shard bounds/row mapping, the sharded
+checkpoint file roster + reassembly + torn-save rejection, and the
+sharded outbox's merged cursor timeline across a partition-count change.
+The engine-scale equality drills (a full replay stream with a rewrite
+storm + a churn tick driven sharded vs unsharded, and the reshard
+round-trip that RESUMES both engines) compile mesh executables and are
+slow-marked into ``make shard-smoke``.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__ as graft
+
+multi = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 devices (virtual CPU mesh)"
+)
+
+_T0 = 1_753_000_200
+
+
+# -- shard geometry ----------------------------------------------------------
+
+
+def test_shard_bounds_and_row_mapping():
+    from binquant_tpu.parallel.mesh import shard_bounds, shard_of_row
+
+    assert shard_bounds(16, 4) == [(0, 4), (4, 8), (8, 12), (12, 16)]
+    assert shard_bounds(16, 1) == [(0, 16)]
+    for row in range(16):
+        k = shard_of_row(row, 16, 4)
+        lo, hi = shard_bounds(16, 4)[k]
+        assert lo <= row < hi
+    with pytest.raises(ValueError):
+        shard_bounds(10, 4)  # symbol axis must divide evenly
+    with pytest.raises(ValueError):
+        shard_of_row(16, 16, 4)  # out of range
+    with pytest.raises(ValueError):
+        shard_of_row(-1, 16, 4)
+
+
+# -- sharded checkpoints -----------------------------------------------------
+
+
+def _synthetic_state(capacity: int = 16, window: int = 64):
+    import jax.numpy as jnp
+
+    from binquant_tpu.engine.buffer import NUM_FIELDS
+    from binquant_tpu.engine.step import initial_engine_state
+
+    rng = np.random.default_rng(19)
+    state = initial_engine_state(capacity, window=window)
+    times = (
+        _T0 + (np.arange(window, dtype=np.int64) - window) * 900
+    ).astype(np.int32)
+    times = np.broadcast_to(times, (capacity, window)).copy()
+    vals = rng.random((capacity, window, NUM_FIELDS)).astype(np.float32)
+    full = np.full((capacity,), window, np.int32)
+    return state._replace(
+        buf5=state.buf5._replace(
+            times=jnp.asarray(times), values=jnp.asarray(vals),
+            filled=jnp.asarray(full),
+        ),
+        buf15=state.buf15._replace(
+            times=jnp.asarray(times), values=jnp.asarray(vals * 2),
+            filled=jnp.asarray(full),
+        ),
+    )
+
+
+def _fresh_registry(capacity: int = 16, n: int = 10):
+    from binquant_tpu.engine.buffer import SymbolRegistry
+
+    reg = SymbolRegistry(capacity)
+    reg.rows_for([f"S{i:03d}USDT" for i in range(n)])
+    return reg
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    """save@4 writes the manifest + 3 sibling files; load reassembles
+    every leaf bit-identically to what an UNSHARDED save restores."""
+    from binquant_tpu.engine.step import initial_engine_state
+    from binquant_tpu.io.checkpoint import (
+        load_state,
+        save_state,
+        save_state_sharded,
+    )
+
+    state = _synthetic_state()
+    reg = _fresh_registry()
+    p_sh = tmp_path / "sharded.npz"
+    p_plain = tmp_path / "plain.npz"
+    save_state_sharded(p_sh, state, reg, 4, host_carries={"tick": 7})
+    save_state(p_plain, state, reg, host_carries={"tick": 7})
+
+    assert p_sh.exists()
+    for k in range(1, 4):
+        assert (tmp_path / f"sharded.npz.shard{k}-of-4").exists()
+
+    template = initial_engine_state(16, window=64)
+    reg_a = _fresh_registry(n=0)
+    reg_b = _fresh_registry(n=0)
+    st_sh, carries_sh = load_state(p_sh, template, reg_a)
+    st_plain, carries_plain = load_state(p_plain, template, reg_b)
+    assert carries_sh == carries_plain == {"tick": 7}
+    assert reg_a.to_mapping() == reg_b.to_mapping()
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(st_sh),
+        jax.tree_util.tree_leaves(st_plain),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_sharded_checkpoint_rejects_torn_and_partial(tmp_path):
+    """A sibling from a DIFFERENT save (nonce mismatch), a missing
+    sibling, and a direct load of a non-manifest shard file all reject
+    into the cold-start path (ValueError) instead of restoring a
+    half-updated universe."""
+    from binquant_tpu.engine.step import initial_engine_state
+    from binquant_tpu.io.checkpoint import load_state, save_state_sharded
+
+    state = _synthetic_state()
+    reg = _fresh_registry()
+    p = tmp_path / "ckpt.npz"
+    save_state_sharded(p, state, reg, 2)
+    template = initial_engine_state(16, window=64)
+
+    # loading the sibling directly is a user error, not a manifest
+    sib = tmp_path / "ckpt.npz.shard1-of-2"
+    with pytest.raises(ValueError, match="non-manifest"):
+        load_state(sib, template, _fresh_registry(n=0))
+
+    # torn save: sibling belongs to a different save generation
+    other = tmp_path / "other.npz"
+    save_state_sharded(other, state, reg, 2)
+    sib.unlink()
+    (tmp_path / "other.npz.shard1-of-2").rename(sib)
+    with pytest.raises(ValueError, match="nonce"):
+        load_state(p, template, _fresh_registry(n=0))
+
+    # missing sibling
+    sib.unlink()
+    with pytest.raises(ValueError):
+        load_state(p, template, _fresh_registry(n=0))
+
+
+def test_shard_count_auto_from_config_and_mesh():
+    """CheckpointManager.shard_count_for: explicit BQT_CKPT_SHARDS wins,
+    else the engine's mesh size, else 1 (plain single-file save)."""
+    from types import SimpleNamespace
+
+    from binquant_tpu.io.checkpoint import CheckpointManager
+
+    class FakeMesh:
+        devices = np.empty((4,), dtype=object)
+
+    eng = SimpleNamespace(config=SimpleNamespace(ckpt_shards=0), mesh=None)
+    assert CheckpointManager.shard_count_for(eng) == 1
+    eng.mesh = FakeMesh()
+    assert CheckpointManager.shard_count_for(eng) == 4
+    eng.config = SimpleNamespace(ckpt_shards=2)
+    assert CheckpointManager.shard_count_for(eng) == 2
+    assert CheckpointManager.shard_count_for(object()) == 1
+
+
+# -- sharded outbox ----------------------------------------------------------
+
+
+def _frame(seq: int, row: int, sym: str = "BTCUSDT") -> dict:
+    return {"seq": seq, "symbol": sym, "strategy": "s", "row": row}
+
+
+def test_sharded_outbox_partitions_and_merged_cursor(tmp_path):
+    from binquant_tpu.fanout.hub import ShardedBroadcastOutbox
+    from binquant_tpu.parallel.mesh import shard_of_row
+
+    words = np.asarray([0b1], np.uint32)
+    ob = ShardedBroadcastOutbox(
+        tmp_path / "outbox.jsonl", n_shards=4,
+        shard_of=lambda f: shard_of_row(int(f["row"]), 16, 4),
+    )
+    # interleave appends across shards, seq strictly increasing
+    rows = [0, 5, 10, 15, 1, 6, 11, 12]
+    for seq, row in enumerate(rows):
+        ob.append(_frame(seq, row), words)
+    # partition files exist and only own their shard's frames
+    for k in range(4):
+        part = tmp_path / f"outbox.jsonl.p{k}-of-4"
+        assert part.exists()
+        for line in part.read_text().splitlines():
+            rec = json.loads(line)
+            assert shard_of_row(int(rec["frame"]["row"]), 16, 4) == k
+    # the merged stream is the ONE global cursor timeline
+    ents = ob.entries()
+    assert [f["seq"] for f, _ in ents] == list(range(len(rows)))
+    assert ob.last_seq() == len(rows) - 1
+    assert ob.resolve_cursor("3") == 3
+    assert [f["seq"] for f in ob.replay_after(3, slot=0)] == [4, 5, 6, 7]
+    # a row the mapper rejects falls back to the symbol hash, still lands
+    ob.append(_frame(8, row=-1), words)
+    assert ob.last_seq() == 8
+    ob.close()
+
+
+def test_sharded_outbox_reshard_folds_retired_partitions(tmp_path):
+    """Reopening at a different partition count keeps every retained
+    frame cursor-replayable: old-count partitions (and a legacy
+    single-file log) are read-only retired sources merged under the same
+    global seq order; new appends go to the new live partitions."""
+    from binquant_tpu.fanout.hub import BroadcastOutbox, ShardedBroadcastOutbox
+
+    words = np.asarray([0b1], np.uint32)
+    # era 0: legacy unsharded outbox
+    legacy = BroadcastOutbox(tmp_path / "outbox.jsonl")
+    for seq in range(2):
+        legacy.append(_frame(seq, row=0), words)
+    legacy.close()
+    # era 1: 4 partitions
+    ob4 = ShardedBroadcastOutbox(tmp_path / "outbox.jsonl", n_shards=4)
+    for seq in range(2, 5):
+        ob4.append(_frame(seq, row=seq), words)
+    ob4.close()
+    # era 2: resharded down to 2 partitions
+    ob2 = ShardedBroadcastOutbox(tmp_path / "outbox.jsonl", n_shards=2)
+    assert ob2.last_seq() == 4  # retired frames seed the seq floor
+    for seq in range(5, 7):
+        ob2.append(_frame(seq, row=seq), words)
+    ents = ob2.entries()
+    assert [f["seq"] for f, _ in ents] == list(range(7))
+    assert [f["seq"] for f in ob2.replay_after(1, slot=0)] == [2, 3, 4, 5, 6]
+    # appends landed only in the live 2-partition set
+    assert sum(p.appends for p in ob2._parts) == 2
+    ob2.close()
+
+
+# -- engine-scale equality drills (make shard-smoke) -------------------------
+
+
+def _pinned_stream(tmp_path, n_ticks: int = 24):
+    """Replay stream with a rewrite storm AND a mid-chunk listing-churn
+    tick — the adversarial shapes the sharded drive must survive."""
+    from binquant_tpu.sim.scenarios import (
+        ScenarioSpec,
+        base_market,
+        emit_stream,
+        listing_churn,
+        rewrite_storm,
+    )
+
+    spec = ScenarioSpec(
+        name="_shard", description="", n_symbols=10, n_ticks=n_ticks,
+        capacity=16, window=112, scan_chunk=8,
+    )
+    closes, vols, _ = base_market(spec)
+    klines = emit_stream(spec, closes, vols)
+    rewrite_storm(klines, [n_ticks - 6], per_tick=2)
+    listing_churn(
+        klines, listings={8: n_ticks // 2}, delistings={},
+        n_symbols=spec.n_symbols,
+    )
+    path = tmp_path / "pinned.jsonl"
+    with open(path, "w") as f:
+        for k in klines:
+            f.write(json.dumps(k) + "\n")
+    return path
+
+
+def _drive_serial(path, mesh_devices: int | None, monkeypatch, **kw):
+    from binquant_tpu.io.replay import make_stub_engine, tick_seq
+
+    if mesh_devices:
+        monkeypatch.setenv("BQT_MESH_DEVICES", str(mesh_devices))
+    else:
+        monkeypatch.delenv("BQT_MESH_DEVICES", raising=False)
+    eng = make_stub_engine(capacity=16, window=112, scan_chunk=8, **kw)
+
+    async def go():
+        out = []
+        for now_ms, klines in tick_seq(path):
+            for k in klines:
+                eng.ingest(k)
+            out.extend(await eng.process_tick(now_ms=now_ms))
+        out.extend(await eng.flush_pending())
+        return out
+
+    return eng, asyncio.run(go())
+
+
+@multi
+@pytest.mark.slow
+def test_sharded_signal_set_matches_single_shard(tmp_path, monkeypatch):
+    """THE acceptance drill: the 4-shard engine emits the identical
+    signal set as the unsharded oracle on a pinned stream that includes
+    a rewrite storm and a listing-churn tick, and its carried state stays
+    on the mesh throughout."""
+    from binquant_tpu.io.replay import signal_tuples
+
+    path = _pinned_stream(tmp_path)
+    oracle, sig_o = _drive_serial(path, None, monkeypatch)
+    sharded, sig_s = _drive_serial(path, 4, monkeypatch)
+
+    assert sharded.mesh is not None
+    assert sharded.state.buf15.values.sharding.spec[0] == "symbols"
+    assert set(signal_tuples(sig_s)) == set(signal_tuples(sig_o))
+    assert len(sig_s) == len(sig_o)
+    # the drives saw the same universe shape
+    assert (
+        sharded.registry.to_mapping() == oracle.registry.to_mapping()
+    )
+
+
+@multi
+@pytest.mark.slow
+def test_reshard_save4_restore2_resumes_identical(tmp_path, monkeypatch):
+    """save@4 → restore@2 round-trip: the restored engine's state is
+    bit-identical to the saver's, and BOTH engines driven over the same
+    remaining stream emit the same signal set (the resume is seamless
+    across the reshard)."""
+    from binquant_tpu.io.checkpoint import CheckpointManager
+    from binquant_tpu.io.replay import make_stub_engine, signal_tuples, tick_seq
+
+    path = _pinned_stream(tmp_path)
+    seq = tick_seq(path)
+    cut = len(seq) // 2
+    ckpt_path = tmp_path / "reshard.npz"
+
+    async def drive(eng, ticks):
+        out = []
+        for now_ms, klines in ticks:
+            for k in klines:
+                eng.ingest(k)
+            out.extend(await eng.process_tick(now_ms=now_ms))
+        out.extend(await eng.flush_pending())
+        return out
+
+    monkeypatch.setenv("BQT_MESH_DEVICES", "4")
+    a = make_stub_engine(capacity=16, window=112, scan_chunk=8)
+    asyncio.run(drive(a, seq[:cut]))
+    ckpt = CheckpointManager(ckpt_path, every_ticks=1)
+    assert ckpt.maybe_save(a)
+    # 4-shard manifest + siblings on disk (mesh size drives the roster)
+    assert (tmp_path / "reshard.npz.shard3-of-4").exists()
+
+    monkeypatch.setenv("BQT_MESH_DEVICES", "2")
+    b = make_stub_engine(capacity=16, window=112, scan_chunk=8)
+    b.checkpoint = CheckpointManager(ckpt_path, every_ticks=10_000)
+    assert b.checkpoint.try_restore(b)
+    assert b.mesh is not None and b.mesh.devices.size == 2
+    assert b.state.buf15.values.sharding.spec[0] == "symbols"
+    for (leaf_path, la), lb in zip(
+        jax.tree_util.tree_leaves_with_path(a.state),
+        jax.tree_util.tree_leaves(b.state),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=jax.tree_util.keystr(leaf_path),
+        )
+
+    sig_a = asyncio.run(drive(a, seq[cut:]))
+    sig_b = asyncio.run(drive(b, seq[cut:]))
+    assert set(signal_tuples(sig_b)) == set(signal_tuples(sig_a))
+    assert b.ticks_processed == a.ticks_processed

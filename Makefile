@@ -122,22 +122,28 @@ help:
 	@echo "               (no false green while a breaker is open), the"
 	@echo "               lane runs tests/test_slo.py, and the burn"
 	@echo "               history renders via tools/slo_report.py"
-	@echo "  fanout-smoke- subscription fan-out plane lane (ISSUE 14):"
-	@echo "               the pytest drills (bitset pack/unpack props,"
-	@echo "               device-match-vs-Python-oracle equality, churn"
-	@echo "               plane correctness + incremental-resync kinds,"
-	@echo "               replayed-burst recipient parity across all four"
-	@echo "               drives, WS/SSE hub shed + cursor resume over"
-	@echo "               real sockets, report golden; slow adds the"
-	@echo "               1M-subscription single-dispatch smoke + the"
-	@echo "               churn-storm chaos drill), then the standalone"
-	@echo "               drill with the event log on — churn storm mid-"
-	@echo "               stream, stalled consumer shedding counted, the"
-	@echo "               autotrade group untouched, reconnect-with-"
-	@echo "               cursor replaying the gap — rendered by"
-	@echo "               tools/fanout_report.py. The 1M-population"
-	@echo "               kernel number is 'python bench.py"
-	@echo "               --fanout-throughput' (writes"
+	@echo "  fanout-smoke- subscription fan-out plane lane (ISSUE 14 +"
+	@echo "               the ISSUE 20 churn/boot surfaces): the pytest"
+	@echo "               drills (bitset pack/unpack props, device-match-"
+	@echo "               vs-Python-oracle equality, churn plane"
+	@echo "               correctness + incremental-resync kinds, the"
+	@echo "               randomized delta-stream-vs-bulk-oracle property"
+	@echo "               incl. growth wraps + compaction, snapshot"
+	@echo "               roundtrip/4-shard/torn-save rejection, the"
+	@echo "               replay-exclusion misdelivery guard, tail-ring"
+	@echo "               resume proven scan-free, replayed-burst"
+	@echo "               recipient parity across all four drives, WS/SSE"
+	@echo "               hub shed + cursor resume over real sockets,"
+	@echo "               report golden; slow adds the 1M-subscription"
+	@echo "               single-dispatch smoke + the churn-storm chaos"
+	@echo "               drill with its six-way reconnect lane), then"
+	@echo "               the standalone drill with the event log on —"
+	@echo "               rendered by tools/fanout_report.py — then the"
+	@echo "               smoke-scale bench arms (connection sweep,"
+	@echo "               churn-scale, snapshot-warm drill) and the"
+	@echo "               trajectory gates on snapshot-warm speedup +"
+	@echo "               per-delta flatness. The full 1M numbers are"
+	@echo "               'python bench.py --fanout-throughput' (writes"
 	@echo "               BENCH_FANOUT_CPU.json)"
 	@echo "  soak       - production-day soak observatory (ISSUE 18): ONE"
 	@echo "               compressed-time multi-exchange drill (binance +"
@@ -351,12 +357,18 @@ delivery-smoke:
 	python tools/delivery_report.py /tmp/bqt_delivery_events.jsonl
 	python tools/slo_report.py /tmp/bqt_delivery_events.jsonl
 
-# The subscription fan-out lane (ISSUE 14): tier-1 keeps the cheap
-# drills (pack/unpack props, oracle equality, churn correctness, the
-# four-drive recipient parity, hub sockets, report golden); this target
-# adds the slow 1M-subscription single-dispatch smoke + the chaos drill,
-# then re-runs the drill standalone with the event log on so the report
-# renders the churn/shed/resume story. The 1M-population acceptance
+# The subscription fan-out lane (ISSUE 14 + the ISSUE 20 churn/boot
+# surfaces): tier-1 keeps the cheap drills (pack/unpack props, oracle
+# equality, churn correctness, the four-drive recipient parity, hub
+# sockets, report golden, the delta-stream property, snapshot
+# roundtrip/torn rejection, tail resume); this target adds the slow
+# 1M-subscription single-dispatch smoke + the chaos drill (now with the
+# churn-storm reconnect lane), re-runs the drill standalone with the
+# event log on so the report renders the churn/shed/resume story, then
+# runs the smoke-scale bench arms (connection sweep + churn-scale +
+# snapshot-warm drill) and gates the recorded 1M trajectory: the
+# snapshot-warm speedup must not fall >50% and the per-delta flatness
+# ratio must not double vs the previous record. The full 1M acceptance
 # bench is `python bench.py --fanout-throughput` (BENCH_FANOUT_CPU.json).
 fanout-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fanout.py -q \
@@ -368,6 +380,10 @@ fanout-smoke:
 	print({k: v for k, v in facts.items() if k != 'checks'}); \
 	assert facts['ok'], facts['checks']"
 	python tools/fanout_report.py /tmp/bqt_fanout_events.jsonl --top 5
+	JAX_PLATFORMS=cpu python bench.py --fanout-throughput --smoke
+	python tools/bench_trajectory.py \
+		--gate detail.snapshot_warm.speedup_x:up:0.5 \
+		--gate detail.churn_scale.per_delta_flatness_1m_vs_10k_x:down:1.0
 
 # The production-day soak observatory (ISSUE 18): the full-scale drill
 # writes /tmp/bqt_soak/soak_verdict.json + BENCH_SOAK_CPU.json, the

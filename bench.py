@@ -912,9 +912,233 @@ def run_fanout_throughput(
     }
 
 
+def run_fanout_churn_scale(
+    sizes: tuple = (10_000, 100_000, 1_000_000),
+    bursts: int = 24,
+    ops_per_burst: int = 32,
+) -> dict:
+    """Sustained-churn scaling (ISSUE 20 tentpole): at each resident
+    population size, apply ``bursts`` ticks of paired subscription churn
+    (add + remove + modify per op, population held stable) and sync the
+    device after each burst — the per-delta apply cost must stay FLAT
+    from 10k to 1M residents (the delta plane patches one word per dirty
+    cell; the pre-ISSUE-20 column scatter re-shipped O(symbol) columns
+    per op, and before that the bulk path re-packed the whole plane).
+    Asserts zero bulk rebuilds after the initial full push: any ``full``
+    resync during the churn phase means the incremental plane leaked a
+    capacity bump or a dirty-tracking hole."""
+    from binquant_tpu.engine.step import STRATEGY_ORDER
+    from binquant_tpu.enums import MarketRegimeCode
+    from binquant_tpu.fanout.kernel import DevicePlanes
+    from binquant_tpu.fanout.registry import Subscription, SubscriptionRegistry
+
+    sym_rows = {f"S{j:03d}USDT": j for j in range(64)}
+    symbols = list(sym_rows)
+    n_regimes = len(MarketRegimeCode)
+
+    def make_sub(uid: str, i: int) -> Subscription:
+        return Subscription(
+            uid,
+            symbols=(
+                frozenset({symbols[i % len(symbols)]})
+                if i % 4 == 0
+                else None
+            ),
+            strategies=frozenset({STRATEGY_ORDER[i % len(STRATEGY_ORDER)]}),
+            regimes=(frozenset({i % n_regimes}) if i % 8 == 0 else None),
+            min_strength=(i % 100) / 100.0,
+        )
+
+    rungs: list[dict] = []
+    for n in sizes:
+        reg = SubscriptionRegistry(symbol_capacity=64, capacity=n)
+        reg.bulk_load(
+            [make_sub(f"u{i}", i) for i in range(n)], row_of=sym_rows.get
+        )
+        dev = DevicePlanes(reg)
+        assert dev.sync() == "full"
+        # warm the delta-kernel pad buckets for this burst size so the
+        # timed loop measures steady-state patches, not the first trace
+        rng = np.random.default_rng(20)
+        syncs = {"incremental": 0, "full": 0, None: 0}
+        burst_ms: list[float] = []
+        delta_words: list[int] = []
+        victim = 0
+        for b in range(bursts):
+            t0 = time.perf_counter()
+            for op in range(ops_per_burst):
+                i = victim % n
+                victim += 1
+                uid = f"u{i}"
+                # paired churn keeps the population (and capacity)
+                # stable: remove an existing resident, re-add it with a
+                # rotated criteria set, modify another in place
+                reg.remove(uid)
+                reg.add(
+                    make_sub(uid, i + 7 * (b + 1)), row_of=sym_rows.get
+                )
+                j = int(rng.integers(0, n))
+                reg.update(
+                    make_sub(f"u{j}", j + 13 * (b + 1)),
+                    row_of=sym_rows.get,
+                )
+            kind = dev.sync()
+            burst_ms.append((time.perf_counter() - t0) * 1000.0)
+            syncs[kind] = syncs.get(kind, 0) + 1
+            delta_words.append(dev.last_delta_words)
+        arr = np.asarray(burst_ms[2:] or burst_ms)  # drop trace warmup
+        per_delta = arr / (3 * ops_per_burst)  # 3 registry ops per op
+        rungs.append(
+            {
+                "residents": n,
+                "bursts": bursts,
+                "ops_per_burst": 3 * ops_per_burst,
+                "incremental_syncs": syncs.get("incremental", 0),
+                "full_syncs_during_churn": syncs.get("full", 0),
+                "delta_words_mean": round(float(np.mean(delta_words)), 1),
+                "burst_ms_p50": round(float(np.percentile(arr, 50)), 3),
+                "burst_ms_p99": round(float(np.percentile(arr, 99)), 3),
+                "ms_per_delta_p50": round(
+                    float(np.percentile(per_delta, 50)), 5
+                ),
+                "ms_per_delta_p99": round(
+                    float(np.percentile(per_delta, 99)), 5
+                ),
+            }
+        )
+    flat = (
+        round(
+            rungs[-1]["ms_per_delta_p50"] / rungs[0]["ms_per_delta_p50"], 2
+        )
+        if rungs and rungs[0]["ms_per_delta_p50"]
+        else None
+    )
+    return {
+        "rungs": rungs,
+        # O(1)-per-delta acceptance: the biggest rung's per-delta p50
+        # over the smallest's — ~1.0 means resident count doesn't tax
+        # churn at all (a bulk path would scale linearly, 100x here)
+        "per_delta_flatness_1m_vs_10k_x": flat,
+        "zero_bulk_rebuilds": all(
+            r["full_syncs_during_churn"] == 0 for r in rungs
+        ),
+    }
+
+
+def run_fanout_snapshot_warm(n_subs: int = 1_000_000) -> dict:
+    """Snapshot-warm cold start (ISSUE 20 tentpole b): measure the full
+    cold boot at ``n_subs`` (build population + bulk compile + device
+    push) against the sidecar restore (archive load + column adopt +
+    device push) — the restart path must come in ≥10x faster, killing
+    the ~20 s fan-out outage the ROADMAP tracks."""
+    import tempfile
+    from pathlib import Path
+
+    from binquant_tpu.engine.step import STRATEGY_ORDER
+    from binquant_tpu.enums import MarketRegimeCode
+    from binquant_tpu.fanout.kernel import DevicePlanes
+    from binquant_tpu.fanout.registry import Subscription, SubscriptionRegistry
+    from binquant_tpu.fanout.snapshot import load_snapshot, save_snapshot
+
+    sym_rows = {f"S{j:03d}USDT": j for j in range(64)}
+    symbols = list(sym_rows)
+    n_regimes = len(MarketRegimeCode)
+
+    def make_sub(i: int) -> Subscription:
+        return Subscription(
+            f"u{i}",
+            symbols=(
+                frozenset({symbols[i % len(symbols)]})
+                if i % 4 == 0
+                else None
+            ),
+            strategies=frozenset({STRATEGY_ORDER[i % len(STRATEGY_ORDER)]}),
+            regimes=(frozenset({i % n_regimes}) if i % 8 == 0 else None),
+            min_strength=(i % 100) / 100.0,
+        )
+
+    # -- the cold boot being killed: build + compile + push -----------------
+    t0 = time.perf_counter()
+    subs = [make_sub(i) for i in range(n_subs)]
+    build_s = time.perf_counter() - t0
+    cold = SubscriptionRegistry(symbol_capacity=64, capacity=n_subs)
+    t0 = time.perf_counter()
+    cold.bulk_load(subs, row_of=sym_rows.get)
+    bulk_s = time.perf_counter() - t0
+    dev = DevicePlanes(cold)
+    t0 = time.perf_counter()
+    assert dev.sync() == "full"
+    push_s = time.perf_counter() - t0
+    cold_boot_s = build_s + bulk_s + push_s
+
+    # -- archive it (the save runs at checkpoint cadence, off the boot) -----
+    path = Path(tempfile.mkdtemp(prefix="bqt_snapwarm_")) / "fanout.snap.npz"
+    columns = cold.export_columns()
+    columns["min_seq_slots"] = np.zeros(0, np.int64)
+    columns["min_seq_vals"] = np.zeros(0, np.int64)
+    planes = {
+        "sym_plane": cold.sym_plane,
+        "strat_plane": cold.strat_plane,
+        "regime_plane": cold.regime_plane,
+        "any_masks": cold.any_masks,
+        "floors": cold.floors,
+    }
+    meta = {
+        "capacity": cold.capacity,
+        "symbol_capacity": 64,
+        "strategy_order": list(STRATEGY_ORDER),
+        "regime_rows": n_regimes + 1,
+        "n_users": len(cold),
+        "next_slot": cold._next_slot,
+        "seq": 0,
+        "fingerprint": "bench",
+    }
+    t0 = time.perf_counter()
+    save_snapshot(path, planes, columns, meta, n_shards=1)
+    save_s = time.perf_counter() - t0
+
+    # -- the warm boot: load + adopt + push ---------------------------------
+    t0 = time.perf_counter()
+    warm = SubscriptionRegistry(symbol_capacity=64, capacity=1024)
+    lplanes, lcolumns, lmeta = load_snapshot(path)
+    users = warm.restore_columns(
+        lplanes,
+        lcolumns,
+        capacity=int(lmeta["capacity"]),
+        next_slot=int(lmeta["next_slot"]),
+        rows_version=0,
+    )
+    wdev = DevicePlanes(warm)
+    assert wdev.sync() == "full"
+    warm_boot_s = time.perf_counter() - t0
+    assert users == n_subs, (users, n_subs)
+
+    # restored planes must be bit-identical to the cold build's
+    planes_equal = all(
+        np.array_equal(getattr(warm, k), getattr(cold, k))
+        for k in (
+            "sym_plane", "strat_plane", "regime_plane", "any_masks",
+            "floors",
+        )
+    )
+    archive_bytes = path.stat().st_size
+    return {
+        "subscriptions": n_subs,
+        "cold_boot_s": round(cold_boot_s, 3),
+        "cold_build_population_s": round(build_s, 3),
+        "cold_bulk_load_s": round(bulk_s, 3),
+        "cold_device_push_s": round(push_s, 3),
+        "snapshot_save_s": round(save_s, 3),
+        "snapshot_bytes": archive_bytes,
+        "warm_boot_s": round(warm_boot_s, 3),
+        "speedup_x": round(cold_boot_s / warm_boot_s, 1),
+        "planes_bit_equal": bool(planes_equal),
+    }
+
+
 def run_fanout_connection_sweep(
-    counts: tuple = (10_000, 50_000, 100_000),
-    frames: int = 64,
+    counts: tuple = (10_000, 100_000, 1_000_000),
+    frames: int | tuple = (64, 32, 8),
     match_density: float = 0.2,
     slow_fraction: float = 0.01,
     conn_queue_max: int = 8,
@@ -933,12 +1157,20 @@ def run_fanout_connection_sweep(
     frames shed through the counted slow-consumer path, so each rung
     reports a real shed rate. Match→write latency is the ISSUE-16
     definition — ``t_pub`` stamped at frame mint through drain-side
-    ``note_delivered`` — quoted at p50/p99 per rung."""
+    ``note_delivered`` — quoted at p50/p99 per rung. ``frames`` may be a
+    per-rung tuple: the 1M rung (ISSUE 20's connection-scale ceiling)
+    drives fewer frames so the sweep stays minutes-scale while still
+    measuring the per-frame fan-out loop at that population."""
     from binquant_tpu.fanout.hub import FanoutHub, _Connection
 
     rng = np.random.default_rng(16)
     sweep: list[dict] = []
-    for n_conns in counts:
+    for rung_idx, n_conns in enumerate(counts):
+        n_frames = (
+            int(frames[min(rung_idx, len(frames) - 1)])
+            if isinstance(frames, (tuple, list))
+            else int(frames)
+        )
         hub = FanoutHub(slot_of=lambda u: None, conn_queue_max=conn_queue_max)
         conns = [
             _Connection(f"u{i}", i, "ws", conn_queue_max)
@@ -952,7 +1184,7 @@ def run_fanout_connection_sweep(
         addressed = 0
         bcast_s: list[float] = []
         lags_ms: list[float] = []
-        for seq in range(frames):
+        for seq in range(n_frames):
             mask = rng.random(n_conns) < match_density
             addressed += int(mask.sum())
             packed = np.packbits(mask, bitorder="little")
@@ -982,6 +1214,7 @@ def run_fanout_connection_sweep(
         sweep.append(
             {
                 "connections": n_conns,
+                "frames": n_frames,
                 "slow_consumers": n_slow,
                 "addressed": addressed,
                 "delivered": delivered,
@@ -995,7 +1228,7 @@ def run_fanout_connection_sweep(
                 "broadcast_ms_per_frame": round(
                     float(np.mean(bcast_s)) * 1000, 3
                 ),
-                "frames_per_s": round(frames / sum(bcast_s)),
+                "frames_per_s": round(n_frames / sum(bcast_s)),
                 "match_write_p50_ms": round(
                     float(np.percentile(lags, 50)), 3
                 ),
@@ -1005,7 +1238,7 @@ def run_fanout_connection_sweep(
             }
         )
     return {
-        "frames": frames,
+        "frames": list(frames) if isinstance(frames, (tuple, list)) else frames,
         "match_density": match_density,
         "slow_fraction": slow_fraction,
         "conn_queue_max": conn_queue_max,
@@ -2974,12 +3207,25 @@ def main() -> int | None:
 
         n_subs = 10_000 if args.smoke else args.fanout_subs
         r = run_fanout_throughput(n_subs=n_subs)
-        # connection-scale arm (ISSUE 16): the hub's broadcast tier from
-        # 10k to 100k simulated consumers — shed rate + match->write p99
+        # connection-scale arm (ISSUE 16 + the ISSUE 20 1M rung): the
+        # hub's broadcast tier from 10k to 1M simulated consumers —
+        # shed rate + match->write p99 per rung
         r["connection_sweep"] = run_fanout_connection_sweep(
             counts=(1_000, 2_000) if args.smoke
-            else (10_000, 50_000, 100_000),
-            frames=8 if args.smoke else 64,
+            else (10_000, 100_000, 1_000_000),
+            frames=(8, 4) if args.smoke else (64, 32, 8),
+        )
+        # sustained-churn arm (ISSUE 20 tentpole): per-delta apply cost
+        # must stay flat 10k -> 1M residents, zero bulk rebuilds
+        r["churn_scale"] = run_fanout_churn_scale(
+            sizes=(1_000, 10_000) if args.smoke
+            else (10_000, 100_000, 1_000_000),
+            bursts=6 if args.smoke else 24,
+        )
+        # snapshot-warm arm (ISSUE 20 tentpole b): restart-by-load vs
+        # the full cold rebuild at the same population
+        r["snapshot_warm"] = run_fanout_snapshot_warm(
+            n_subs=10_000 if args.smoke else args.fanout_subs
         )
         record = {
             "metric": "fanout_match_sub_signals_per_s",

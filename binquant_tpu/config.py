@@ -579,6 +579,39 @@ class Config:
         only the retained tail — the shed is visible as a seq gap)."""
         return int(self._get("BQT_FANOUT_OUTBOX_CAP", "4096") or "4096")
 
+    @cached_property
+    def fanout_snapshot_path(self) -> str:
+        """Snapshot-warm boot sidecar (fanout/snapshot.py, ISSUE 20):
+        compiled subscription planes + columnar index archived at
+        checkpoint cadence so a restart restores by array load instead of
+        the ~20 s 1M-population rebuild. Empty disables (tier-1 default;
+        the production pipeline points it next to the delivery WAL)."""
+        return self._get("BQT_FANOUT_SNAPSHOT", "")
+
+    @cached_property
+    def fanout_snapshot_shards(self) -> int:
+        """Snapshot sibling-archive count (sym_plane rows split at the
+        engine mesh's shard bounds). 0 = follow the checkpoint's own
+        shard rule (the PR 19 mesh size)."""
+        return int(self._get("BQT_FANOUT_SNAPSHOT_SHARDS", "0") or 0)
+
+    @cached_property
+    def fanout_compact_frac(self) -> float:
+        """Tombstone-folding threshold: compact the subscription planes
+        when freed/claimed slots crosses this fraction (unsubscribe-heavy
+        churn otherwise leaks capacity forever — matches and device syncs
+        keep paying for dead slots). 0 disables (tier-1 pins it off; the
+        compaction tests drive it explicitly)."""
+        return float(self._get("BQT_FANOUT_COMPACT_FRAC", "0.5") or 0.0)
+
+    @cached_property
+    def fanout_resume_tail(self) -> int:
+        """In-memory broadcast tail ring (hub-side): reconnects whose
+        cursor lands inside the last N frames replay from memory instead
+        of a full outbox scan (bqt_fanout_resume_fallback_total counts
+        the misses). 0 disables the ring."""
+        return int(self._get("BQT_FANOUT_RESUME_TAIL", "1024") or 0)
+
     # -- unified SLO / delivery observatory plane (obs/slo.py, ISSUE 16) -----
 
     @cached_property

@@ -37,6 +37,7 @@ import json
 import logging
 import os
 import time
+from collections import deque
 from pathlib import Path
 from urllib.parse import parse_qs
 
@@ -48,6 +49,7 @@ from binquant_tpu.obs.instruments import (
     FANOUT_CONN_QUEUE_DEPTH,
     FANOUT_CONNECTIONS,
     FANOUT_FRAMES,
+    FANOUT_RESUME_FALLBACK,
     FANOUT_RESUME_REPLAYED,
     FANOUT_SHED,
     FANOUT_WRITE_LATENCY,
@@ -464,6 +466,7 @@ class FanoutHub:
         host: str = "0.0.0.0",
         port: int = 0,
         min_seq_of=None,
+        tail_cap: int = 0,
     ) -> None:
         self.slot_of = slot_of
         # slot → lowest frame seq the slot's CURRENT owner may receive
@@ -476,6 +479,25 @@ class FanoutHub:
         self.port = int(port)
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_Connection] = set()
+        # in-memory ring of the last `tail_cap` broadcast frames (seq,
+        # encoded payload, packed recipient words): a reconnect whose
+        # numeric cursor lands inside the retained window replays from
+        # here instead of re-parsing the whole outbox — the hot path for
+        # fresh cursors (ISSUE 20 satellite: the "full outbox scan on
+        # every reconnect" bug). Cursors the ring can't serve fall back
+        # to the outbox scan with a counted reason.
+        self.tail_cap = max(int(tail_cap), 0)
+        self._tail: deque | None = (
+            deque(maxlen=self.tail_cap) if self.tail_cap else None
+        )
+        self.tail_resumes = 0
+        self.resume_fallbacks: dict[str, int] = {}
+        # seq range [lo, hi] excluded from every replay: frames published
+        # between a fanout snapshot save and the crash were addressed by
+        # a registry whose post-save churn a restore cannot reconstruct
+        # (slot may have changed hands) — replaying them against the
+        # restored layout risks cross-user misdelivery
+        self.replay_excluded: tuple[int, int] | None = None
         self.frames_sent = 0
         self.shed = 0
         self.resumed = 0
@@ -526,6 +548,32 @@ class FanoutHub:
             self._conns.discard(conn)
         return len(victims)
 
+    def rebind_slots(self, reason: str = "compaction") -> int:
+        """Re-resolve every open connection's slot after the registry
+        re-packed (compaction moves users to new slots); a connection
+        whose user vanished closes. The tail ring resets too — its packed
+        recipient words address the OLD slot layout and would misdeliver
+        against the new one."""
+        rebound = 0
+        for conn in list(self._conns):
+            slot = self.slot_of(conn.user_id)
+            if slot is None:
+                conn.closed.set()
+                self._conns.discard(conn)
+                continue
+            if int(slot) != conn.slot:
+                conn.slot = int(slot)
+                rebound += 1
+        self.reset_tail()
+        get_event_log().emit(
+            "fanout_rebind", reason=reason, rebound=rebound
+        )
+        return rebound
+
+    def reset_tail(self) -> None:
+        if self._tail is not None:
+            self._tail.clear()
+
     def cursor_lag(self) -> int:
         """Records-behind-head for the hub's LAGGIEST open connection —
         the fan-out plane's entry in the per-consumer-group cursor-lag
@@ -551,6 +599,15 @@ class FanoutHub:
             "frames_sent": self.frames_sent,
             "shed": self.shed,
             "resumed": self.resumed,
+            "tail_resumes": self.tail_resumes,
+            "tail_retained": len(self._tail) if self._tail is not None else 0,
+            "tail_cap": self.tail_cap,
+            "resume_fallbacks": dict(self.resume_fallbacks),
+            "replay_excluded": (
+                list(self.replay_excluded)
+                if self.replay_excluded is not None
+                else None
+            ),
             "head_seq": self.head_seq,
             "cursor_lag": self.cursor_lag(),
             "outbox": (
@@ -572,12 +629,28 @@ class FanoutHub:
         """Offer one matched frame to every connected recipient — bounded
         ``put_nowait`` per connection, never blocks. Packed-word bit test
         per connection: O(connections), independent of the user count."""
-        if not self._conns:
-            return
-        data = json.dumps(frame, separators=(",", ":"))
         seq = int(frame.get("seq", 0))
+        data: str | None = None
+        if self._tail is not None:
+            # tail ring feeds BEFORE the no-connections early return: the
+            # retained window must cover frames broadcast while nobody
+            # was connected, or the first reconnect after a quiet spell
+            # would always fall back to the outbox scan
+            data = json.dumps(frame, separators=(",", ":"))
+            if self._tail and seq <= self._tail[-1][0]:
+                # seq went backwards (restore/reshard seam): the ring's
+                # in-order invariant broke — reset rather than serve a
+                # spliced window
+                self._tail.clear()
+            self._tail.append(
+                (seq, data, np.ascontiguousarray(words, np.uint32).copy())
+            )
         if seq > self.head_seq:
             self.head_seq = seq
+        if not self._conns:
+            return
+        if data is None:
+            data = json.dumps(frame, separators=(",", ":"))
         for conn in list(self._conns):
             w = conn.slot >> 5
             if w >= len(words) or not (
@@ -655,13 +728,19 @@ class FanoutHub:
             cursor_raw = (params.get("cursor") or [""])[0]
             if path == "/sse" and not cursor_raw:
                 cursor_raw = headers.get("last-event-id", "")
-            # outbox scan happens OFF-LOOP and BEFORE registration (a
-            # reconnect burst must not freeze broadcast under full-file
-            # JSON+base64 parses); the appends-stability loop guarantees
-            # no frame lands between the accepted scan and registration
+            # resume source, cheapest first: a numeric cursor inside the
+            # tail ring's window replays from memory (no I/O at all);
+            # anything else falls back to the outbox scan — OFF-LOOP and
+            # BEFORE registration (a reconnect burst must not freeze
+            # broadcast under full-file JSON+base64 parses); the appends-
+            # stability loop guarantees no frame lands between the
+            # accepted scan and registration
             entries = None
+            tail = None
             if cursor_raw and self.outbox is not None:
-                entries = await self._scan_outbox_stable()
+                tail = self._tail_window_for(cursor_raw)
+                if tail is None:
+                    entries = await self._scan_outbox_stable()
             conn = _Connection(
                 user, slot, "ws" if path == "/ws" else "sse",
                 self.conn_queue_max,
@@ -673,7 +752,7 @@ class FanoutHub:
             FANOUT_CONNECTIONS.labels(transport=conn.transport).set(
                 sum(1 for c in self._conns if c.transport == conn.transport)
             )
-            self._replay_cursor(conn, cursor_raw, entries)
+            self._replay_cursor(conn, cursor_raw, entries, tail=tail)
             if path == "/ws":
                 await self._serve_ws(conn, reader, writer, headers)
             else:
@@ -707,33 +786,99 @@ class FanoutHub:
         # append mid-scan, so the no-lost-frame guarantee still holds
         return self.outbox.entries()
 
+    def _count_fallback(self, reason: str) -> None:
+        self.resume_fallbacks[reason] = (
+            self.resume_fallbacks.get(reason, 0) + 1
+        )
+        FANOUT_RESUME_FALLBACK.labels(reason=reason).inc()
+
+    def _tail_window_for(self, cursor_raw: str) -> list | None:
+        """The reconnect fast path: a numeric cursor whose resume point
+        lies inside the tail ring's retained window is served from
+        memory — return the ``(seq, data, words)`` window to replay.
+        ``None`` means the ring can't serve it and the caller takes the
+        outbox scan, with the reason counted
+        (``bqt_fanout_resume_fallback_total``): ``trace_cursor`` (a
+        trace-id cursor needs the log to resolve), ``tail_off`` (ring
+        not configured), ``tail_cold`` (ring empty), ``cursor_gap``
+        (cursor older than the ring's first retained frame — the ring
+        can't prove it would replay the full gap)."""
+        try:
+            cursor_seq = int(cursor_raw.strip())
+        except ValueError:
+            self._count_fallback("trace_cursor")
+            return None
+        if self._tail is None:
+            self._count_fallback("tail_off")
+            return None
+        if not self._tail:
+            self._count_fallback("tail_cold")
+            return None
+        if cursor_seq < self._tail[0][0] - 1:
+            self._count_fallback("cursor_gap")
+            return None
+        return [t for t in self._tail if t[0] > cursor_seq]
+
     def _replay_cursor(
-        self, conn: _Connection, cursor_raw: str, entries: list | None
+        self,
+        conn: _Connection,
+        cursor_raw: str,
+        entries: list | None,
+        tail: list | None = None,
     ) -> None:
-        if not cursor_raw or entries is None or self.outbox is None:
+        if not cursor_raw:
             return
-        seq = self.outbox.resolve_cursor(cursor_raw, entries=entries)
-        if seq is None:
-            return
-        # frames below the slot's min-seq floor were addressed to the
-        # slot's previous owner — never replayed to the new claimant
-        seq = max(seq, self.min_seq_of(conn.slot) - 1)
         overflow = 0
-        for frame in self.outbox.replay_after(
-            seq, conn.slot, entries=entries
-        ):
-            data = json.dumps(frame, separators=(",", ":"))
-            if conn.offer((int(frame.get("seq", 0)), data, None)):
-                conn.replayed += 1
-                self.resumed += 1
-                FANOUT_RESUME_REPLAYED.inc()
-            else:
-                # a gap larger than the connection queue: the shed is
-                # counted and the client must re-cursor from its last
-                # received seq (at-least-once, never silent)
-                self.shed += 1
-                overflow += 1
-                FANOUT_SHED.labels(reason="resume_overflow").inc()
+        excl = self.replay_excluded
+
+        def _excluded(fseq: int) -> bool:
+            return excl is not None and excl[0] <= fseq <= excl[1]
+
+        if tail is not None:
+            # in-memory window: same floor + recipient-bit discipline as
+            # the outbox path, zero parse cost
+            floor = self.min_seq_of(conn.slot) - 1
+            w, bitpos = conn.slot >> 5, conn.slot & 31
+            for fseq, data, words in tail:
+                if fseq <= floor or _excluded(fseq):
+                    continue
+                if w >= len(words) or not (int(words[w]) >> bitpos & 1):
+                    continue
+                if conn.offer((fseq, data, None)):
+                    conn.replayed += 1
+                    self.resumed += 1
+                    self.tail_resumes += 1
+                    FANOUT_RESUME_REPLAYED.inc()
+                else:
+                    self.shed += 1
+                    overflow += 1
+                    FANOUT_SHED.labels(reason="resume_overflow").inc()
+        elif entries is not None and self.outbox is not None:
+            seq = self.outbox.resolve_cursor(cursor_raw, entries=entries)
+            if seq is None:
+                return
+            # frames below the slot's min-seq floor were addressed to the
+            # slot's previous owner — never replayed to the new claimant
+            seq = max(seq, self.min_seq_of(conn.slot) - 1)
+            for frame in self.outbox.replay_after(
+                seq, conn.slot, entries=entries
+            ):
+                if _excluded(int(frame.get("seq", -1))):
+                    continue
+                data = json.dumps(frame, separators=(",", ":"))
+                if conn.offer((int(frame.get("seq", 0)), data, None)):
+                    conn.replayed += 1
+                    self.resumed += 1
+                    FANOUT_RESUME_REPLAYED.inc()
+                else:
+                    # a gap larger than the connection queue: the shed is
+                    # counted and the client must re-cursor from its last
+                    # received seq (at-least-once, never silent)
+                    self.shed += 1
+                    overflow += 1
+                    FANOUT_SHED.labels(reason="resume_overflow").inc()
+        else:
+            return
         if overflow:
             get_event_log().emit(
                 "fanout_shed",
@@ -748,6 +893,7 @@ class FanoutHub:
             transport=conn.transport,
             cursor=cursor_raw,
             replayed=conn.replayed,
+            source="tail" if tail is not None else "outbox",
         )
 
     def _close_conn(self, conn: _Connection) -> None:

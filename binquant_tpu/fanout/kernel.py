@@ -8,7 +8,8 @@ recipient words — matching a million subscriptions rides the existing
 wire as one extra kernel, never a Python loop.
 
 Shapes are stable across churn (the planes are fixed ``(·, U32)`` arrays
-the host updates in place via :func:`apply_word_columns`), so the kernel
+the host updates in place; the device copy is patched by
+:func:`apply_subscription_deltas` one-word scatters), so the kernel
 retraces only when the user capacity doubles or the fired bucket ``K``
 steps to a new power of two — the tick step executable is untouched
 either way.
@@ -111,24 +112,30 @@ def _match_impl(
 
 
 @jax.jit
-def _apply_cols_impl(
+def apply_subscription_deltas(
     sym_plane, strat_plane, regime_plane, any_masks, floors,
-    idx,          # (D,) int32 dirty word columns (pad = repeat of idx[0])
-    sym_cols,     # (S, D) uint32
-    strat_cols,   # (N, D) uint32
-    regime_cols,  # (R+1, D) uint32
-    any_cols,     # (3, D) uint32
-    floor_cols,   # (D, 32) f32
+    sym_r, sym_w, sym_v,        # (B,) int32/int32/uint32 sym_plane cells
+    strat_r, strat_w, strat_v,  # (B,) strat_plane cells
+    reg_r, reg_w, reg_v,        # (B,) regime_plane cells
+    any_r, any_w, any_v,        # (B,) any_masks cells
+    floor_idx,                  # (F,) int32 dirty floor words
+    floor_vals,                 # (F, 32) f32
 ):
-    """Scatter the dirty word columns into the device planes — the
-    incremental churn resync (duplicate pad indices write identical
-    values, so the scatter order is immaterial)."""
+    """The churn resync: scatter ONE WORD per dirty (plane, row, word)
+    cell into the device planes — O(cells touched) per dispatch,
+    independent of both the resident population and the symbol count
+    (the previous column scatter shipped whole ``(S, D)`` columns, an
+    O(S) cost per delta). All four cell groups pad to one shared bucket
+    ``B`` and floors to ``F`` (power-of-two — bounded retraces); pad
+    entries point at cell (0, 0) carrying the HOST's current value
+    there, so duplicates always write identical values and the scatter
+    order is immaterial."""
     return (
-        sym_plane.at[:, idx].set(sym_cols),
-        strat_plane.at[:, idx].set(strat_cols),
-        regime_plane.at[:, idx].set(regime_cols),
-        any_masks.at[:, idx].set(any_cols),
-        floors.reshape(-1, _BITS).at[idx].set(floor_cols).reshape(-1),
+        sym_plane.at[sym_r, sym_w].set(sym_v),
+        strat_plane.at[strat_r, strat_w].set(strat_v),
+        regime_plane.at[reg_r, reg_w].set(reg_v),
+        any_masks.at[any_r, any_w].set(any_v),
+        floors.reshape(-1, _BITS).at[floor_idx].set(floor_vals).reshape(-1),
     )
 
 
@@ -144,15 +151,19 @@ def bucket(n: int, floor: int = 4) -> int:
 class DevicePlanes:
     """Device-resident copy of a :class:`SubscriptionRegistry`'s planes
     with the lazy sync policy: a capacity change (or first use) pushes
-    everything (``kind="full"``), churn pushes only the dirty word columns
-    through ONE jit'd scatter (``kind="incremental"``). Returns the sync
-    kind performed (None = already current)."""
+    everything (``kind="full"``), churn patches only the dirty
+    (plane, row, word) cells through ONE jit'd
+    :func:`apply_subscription_deltas` dispatch (``kind="incremental"``).
+    Returns the sync kind performed (None = already current);
+    ``last_delta_words`` holds the patched word count of the most recent
+    incremental sync (the plane's churn-cost metric)."""
 
     def __init__(self, registry) -> None:
         self.registry = registry
         self._arrays = None
         self._synced_version: int | None = None
         self._synced_generation: int | None = None
+        self.last_delta_words = 0
 
     def sync(self) -> str | None:
         reg = self.registry
@@ -164,7 +175,7 @@ class DevicePlanes:
         full = (
             self._arrays is None
             or self._synced_generation != reg.capacity_generation
-            or not reg.dirty_words
+            or not (reg.dirty_cells or reg.dirty_floor_words)
         )
         if full:
             self._arrays = tuple(
@@ -176,21 +187,46 @@ class DevicePlanes:
             )
             kind = "full"
         else:
-            dirty = sorted(reg.dirty_words)
-            d = bucket(len(dirty))
-            idx = np.full(d, dirty[0], np.int32)
-            idx[: len(dirty)] = dirty
-            self._arrays = _apply_cols_impl(
+            # group the dirty cells by plane; all four groups share ONE
+            # power-of-two bucket (one trace key per (B, F) pair, not
+            # four independent bucket axes)
+            per: list[list[tuple[int, int]]] = [[], [], [], []]
+            for pid, r, w in reg.dirty_cells:
+                per[pid].append((r, w))
+            planes = (
+                reg.sym_plane, reg.strat_plane, reg.regime_plane,
+                reg.any_masks,
+            )
+            b = bucket(max(max(len(g) for g in per), 1))
+            args: list = []
+            for pid in range(4):
+                rows = np.zeros(b, np.int32)
+                words = np.zeros(b, np.int32)
+                g = per[pid]
+                if g:
+                    cells = np.asarray(g, np.int32)
+                    rows[: len(g)] = cells[:, 0]
+                    words[: len(g)] = cells[:, 1]
+                # values gathered from the HOST planes (the post-churn
+                # truth): pad entries read cell (0, 0), so a pad write
+                # is always a no-op rewrite of the current value
+                vals = planes[pid][rows, words]
+                args += [rows, words, vals]
+            fw = sorted(reg.dirty_floor_words)
+            fb = bucket(max(len(fw), 1))
+            fidx = np.zeros(fb, np.int32)
+            fidx[: len(fw)] = fw
+            fvals = reg.floors.reshape(-1, _BITS)[fidx]
+            self.last_delta_words = len(reg.dirty_cells) + len(fw)
+            self._arrays = apply_subscription_deltas(
                 *self._arrays,
-                jnp.asarray(idx),
-                jnp.asarray(reg.sym_plane[:, idx]),
-                jnp.asarray(reg.strat_plane[:, idx]),
-                jnp.asarray(reg.regime_plane[:, idx]),
-                jnp.asarray(reg.any_masks[:, idx]),
-                jnp.asarray(reg.floors.reshape(-1, _BITS)[idx]),
+                *(jnp.asarray(a) for a in args),
+                jnp.asarray(fidx),
+                jnp.asarray(fvals),
             )
             kind = "incremental"
-        reg.dirty_words.clear()
+        reg.dirty_cells.clear()
+        reg.dirty_floor_words.clear()
         self._synced_version = reg.version
         self._synced_generation = reg.capacity_generation
         return kind

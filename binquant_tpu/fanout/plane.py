@@ -20,15 +20,18 @@ from __future__ import annotations
 
 import logging
 import time
+from pathlib import Path
 from typing import Any
 
 import numpy as np
 
+from binquant_tpu.engine.step import STRATEGY_ORDER
 from binquant_tpu.enums import MarketRegimeCode
 from binquant_tpu.fanout.hub import BroadcastOutbox, FanoutHub
 from binquant_tpu.fanout.kernel import DevicePlanes, popcount_words
 from binquant_tpu.fanout.registry import (
     INVALID_REGIME_ROW,
+    REGIME_ROWS,
     _STRAT_IDX,
     Subscription,
     SubscriptionRegistry,
@@ -36,11 +39,14 @@ from binquant_tpu.fanout.registry import (
 from binquant_tpu.io.emission import SignalSink
 from binquant_tpu.obs.events import get_event_log
 from binquant_tpu.obs.instruments import (
+    FANOUT_COMPACTIONS,
+    FANOUT_DELTA_WORDS,
     FANOUT_MATCH_DISPATCHES,
     FANOUT_PUBLISHED,
     FANOUT_RECIPIENTS,
     FANOUT_RECOMPILES,
     FANOUT_SHED,
+    FANOUT_SNAPSHOT,
     FANOUT_SUBSCRIPTIONS,
 )
 
@@ -66,11 +72,24 @@ class FanoutPlane:
         outbox_cap: int = 4096,
         conn_queue_max: int = 256,
         outbox_shards: int = 1,
+        snapshot_path: str | None = None,
+        snapshot_shards: int = 0,
+        compact_frac: float = 0.0,
+        resume_tail: int = 0,
     ) -> None:
         self.engine_registry = engine_registry
         self.subscriptions = SubscriptionRegistry(
             symbol_capacity=engine_registry.capacity, capacity=capacity
         )
+        # snapshot-warm boot sidecar (ISSUE 20): when a path is set,
+        # restarts restore the compiled planes + subscription index by
+        # load instead of rebuild; 0 shards = follow the checkpoint rule
+        self.snapshot_path = Path(snapshot_path) if snapshot_path else None
+        self.snapshot_shards = int(snapshot_shards)
+        # tombstone-folding threshold: compact when free/claimed slots
+        # crosses this fraction (0 = off; tier-1 conftest pins it off)
+        self.compact_frac = float(compact_frac)
+        self.compactions = 0
         self._device = DevicePlanes(self.subscriptions)
         self.outbox_shards = int(outbox_shards) if outbox_path else 0
         if outbox_path and int(outbox_shards) > 1:
@@ -116,6 +135,7 @@ class FanoutPlane:
             outbox=self.outbox,
             conn_queue_max=conn_queue_max,
             min_seq_of=lambda slot: self._slot_min_seq.get(slot, 0),
+            tail_cap=int(resume_tail),
         )
         self._served = False
         # behind-the-delivery-plane handoff (FanoutSink attached): the
@@ -166,6 +186,9 @@ class FanoutPlane:
             # receive the next claimant's frames (cross-user misdelivery)
             self.hub.close_user(user_id)
             self._note_churn("unsubscribe", user_id, slot)
+            # unsubscribe is the only op that mints tombstones, so the
+            # fragmentation check rides here (amortized O(1))
+            self.maybe_compact()
         return slot
 
     def bulk_load(self, subs) -> int:
@@ -196,7 +219,229 @@ class FanoutPlane:
         if kind is not None:
             self.recompiles[kind] = self.recompiles.get(kind, 0) + 1
             FANOUT_RECOMPILES.labels(kind=kind).inc()
+            if kind == "incremental":
+                FANOUT_DELTA_WORDS.observe(self._device.last_delta_words)
         return kind
+
+    # -- compaction (ISSUE 20) -----------------------------------------------
+
+    def maybe_compact(self) -> bool:
+        """Fold tombstones when fragmentation (free / claimed slots)
+        crosses ``compact_frac``. Cheap check on the churn path; the
+        pass itself is a counted heavyweight (one full device resync)."""
+        frac = self.compact_frac
+        reg = self.subscriptions
+        if frac <= 0.0 or reg._next_slot < 64:
+            return False
+        if reg.fragmentation() < frac:
+            return False
+        self.compact()
+        return True
+
+    def compact(self) -> dict[str, tuple[int, int]]:
+        """Re-pack live slots dense + shrink capacity (see
+        :meth:`SubscriptionRegistry.compact`), then repair every
+        slot-addressed structure around the registry:
+
+        * moved users' min-seq floors advance to the CURRENT seq —
+          outbox frames and the hub's tail ring address recipients by
+          their OLD slot bits, so pre-compaction frames must never
+          deliver or replay against the new layout (documented replay
+          gap for moved users; unmoved slots keep their floors and
+          their full replay window);
+        * live hub connections re-bind to their users' new slots;
+        * the hub's tail ring resets (its packed words are old-layout).
+        """
+        t0 = time.perf_counter()
+        reg = self.subscriptions
+        before = reg.snapshot()
+        moved = reg.compact()
+        for _uid, (old_slot, new_slot) in moved.items():
+            self._slot_min_seq.pop(old_slot, None)
+            self._slot_min_seq[new_slot] = self.seq
+        # slots past the compacted range no longer exist; drop floors
+        self._slot_min_seq = {
+            s: q for s, q in self._slot_min_seq.items()
+            if s < reg._next_slot
+        }
+        self.hub.rebind_slots(reason="compaction")
+        self.compactions += 1
+        FANOUT_COMPACTIONS.inc()
+        get_event_log().emit(
+            "fanout_compact",
+            users=len(reg),
+            moved=len(moved),
+            capacity_before=before["capacity"],
+            capacity_after=reg.capacity,
+            freed_slots=before["free_slots"],
+            duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+        )
+        return moved
+
+    # -- snapshot-warm boot (ISSUE 20) ---------------------------------------
+
+    def _engine_fingerprint(self) -> str:
+        """Hash of the engine registry's symbol→row mapping — archived
+        rows are valid verbatim only against the same mapping."""
+        import hashlib
+        import json
+
+        blob = json.dumps(
+            self.engine_registry.to_mapping(), sort_keys=True
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def maybe_save_snapshot(self, default_shards: int = 1) -> bool:
+        """Sidecar save when a snapshot path is configured — failures
+        counted, never propagated (the lossy-tier contract)."""
+        if self.snapshot_path is None:
+            return False
+        try:
+            self.save_snapshot(n_shards=self.snapshot_shards or default_shards)
+            return True
+        except Exception:
+            FANOUT_SNAPSHOT.labels(op="save", outcome="error").inc()
+            log.exception("fanout snapshot save failed; continuing")
+            return False
+
+    def save_snapshot(
+        self, path: str | Path | None = None, n_shards: int = 1
+    ) -> dict:
+        """Archive the compiled planes + columnar subscription index +
+        per-slot min-seq floors as the versioned sidecar (see
+        :mod:`binquant_tpu.fanout.snapshot`). Returns the manifest meta.
+        """
+        from binquant_tpu.fanout.snapshot import save_snapshot
+
+        target = Path(path) if path is not None else self.snapshot_path
+        assert target is not None, "no snapshot path configured"
+        reg = self.subscriptions
+        t0 = time.perf_counter()
+        n_shards = max(int(n_shards), 1)
+        if n_shards > 1 and reg.symbol_capacity % n_shards:
+            # shard_bounds needs even blocks; an odd mesh falls back to
+            # one monolithic archive rather than failing the save
+            n_shards = 1
+        columns = reg.export_columns()
+        ms = sorted(self._slot_min_seq.items())
+        columns["min_seq_slots"] = np.asarray([s for s, _ in ms], np.int64)
+        columns["min_seq_vals"] = np.asarray([q for _, q in ms], np.int64)
+        planes = {
+            "sym_plane": reg.sym_plane,
+            "strat_plane": reg.strat_plane,
+            "regime_plane": reg.regime_plane,
+            "any_masks": reg.any_masks,
+            "floors": reg.floors,
+        }
+        meta = {
+            "capacity": reg.capacity,
+            "symbol_capacity": reg.symbol_capacity,
+            "strategy_order": list(STRATEGY_ORDER),
+            "regime_rows": REGIME_ROWS,
+            "n_users": len(reg),
+            "next_slot": reg._next_slot,
+            "seq": self.seq,
+            "fingerprint": self._engine_fingerprint(),
+            "saved_unix": time.time(),
+        }
+        info = save_snapshot(
+            target, planes, columns, meta, n_shards=n_shards
+        )
+        FANOUT_SNAPSHOT.labels(op="save", outcome="ok").inc()
+        get_event_log().emit(
+            "fanout_snapshot_save",
+            path=str(target),
+            users=len(reg),
+            shards=n_shards,
+            duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+        )
+        return info
+
+    def try_restore_snapshot(self, path: str | Path | None = None) -> bool:
+        """Warm boot: adopt the sidecar archive if present and valid —
+        planes restore by load (lazy record materialization), the device
+        takes one full push at the next sync, and cursor replay across
+        the restart stays sound:
+
+        * per-slot min-seq floors restore with the planes, so a RETAINED
+          outbox's pre-snapshot frames replay correctly (slot layout is
+          the snapshot's own);
+        * frames published AFTER the snapshot was taken (seq in
+          [archived seq, boot head]) were addressed by a registry whose
+          post-save churn this restore cannot see — the hub excludes
+          that range from replay (cross-user misdelivery guard).
+
+        Returns False (cold start) on any rejection: torn/missing/
+        version-mismatched archive, or plane geometry that disagrees
+        with the running engine.
+        """
+        from binquant_tpu.fanout.snapshot import load_snapshot
+
+        target = Path(path) if path is not None else self.snapshot_path
+        if target is None or not target.exists():
+            return False
+        reg = self.subscriptions
+        t0 = time.perf_counter()
+        try:
+            planes, columns, meta = load_snapshot(target)
+            if int(meta["symbol_capacity"]) != reg.symbol_capacity:
+                raise ValueError(
+                    f"snapshot symbol capacity {meta['symbol_capacity']} "
+                    f"!= engine {reg.symbol_capacity} — start cold"
+                )
+            if list(meta["strategy_order"]) != list(STRATEGY_ORDER):
+                raise ValueError(
+                    "snapshot strategy order differs from this build — "
+                    "strat_plane rows unsound, start cold"
+                )
+            if int(meta["regime_rows"]) != REGIME_ROWS:
+                raise ValueError(
+                    "snapshot regime row count differs — start cold"
+                )
+        except Exception:
+            FANOUT_SNAPSHOT.labels(op="restore", outcome="rejected").inc()
+            log.warning(
+                "fanout snapshot %s rejected; starting cold",
+                target,
+                exc_info=True,
+            )
+            return False
+        fingerprint_ok = meta.get("fingerprint") == self._engine_fingerprint()
+        users = reg.restore_columns(
+            planes,
+            columns,
+            capacity=int(meta["capacity"]),
+            next_slot=int(meta["next_slot"]),
+            # matching fingerprint: archived symbol rows are valid
+            # verbatim; otherwise the next sync's refresh_rows rebuilds
+            # sym_plane against the CURRENT engine mapping (slow, safe)
+            rows_version=(
+                self.engine_registry.version if fingerprint_ok else None
+            ),
+        )
+        self._slot_min_seq = {
+            int(s): int(q)
+            for s, q in zip(
+                columns["min_seq_slots"], columns["min_seq_vals"]
+            )
+        }
+        saved_seq = int(meta["seq"])
+        boot_head = self.seq - 1  # ctor seeded past the retained outbox
+        if boot_head >= saved_seq:
+            self.hub.replay_excluded = (saved_seq, boot_head)
+        self.seq = max(self.seq, saved_seq)
+        FANOUT_SUBSCRIPTIONS.set(len(reg))
+        FANOUT_SNAPSHOT.labels(op="restore", outcome="ok").inc()
+        get_event_log().emit(
+            "fanout_snapshot_restore",
+            path=str(target),
+            users=users,
+            fingerprint_ok=fingerprint_ok,
+            shards=int(meta.get("shard_count", 1)),
+            seq=self.seq,
+            duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+        )
+        return True
 
     # -- the per-tick join ---------------------------------------------------
 
@@ -358,6 +603,10 @@ class FanoutPlane:
             "recompiles": dict(self.recompiles),
             "behind_delivery": self.sink_attached,
             "outbox_errors": self.outbox_errors,
+            "compactions": self.compactions,
+            "snapshot_path": (
+                str(self.snapshot_path) if self.snapshot_path else None
+            ),
             "hub": self.hub.snapshot(),
         }
 

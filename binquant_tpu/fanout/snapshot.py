@@ -1,0 +1,185 @@
+"""Fan-out snapshot sidecar: restart-warm subscription planes (ISSUE 20).
+
+The cold-start problem this kills: rebuilding a 1M-user population is a
+~20 s fan-out outage (ROADMAP item 2 — ``build_population_s`` +
+``bulk_load_s``), paid on every restart. The sidecar archives the
+COMPILED bitset planes plus a columnar image of the subscription index
+(:meth:`~binquant_tpu.fanout.registry.SubscriptionRegistry
+.export_columns`) so a restart restores by array load instead of
+recompile — the registry re-attaches the columns as a lazy record base
+and the device takes one full push.
+
+Checkpoint-v4 idioms, deliberately shared with
+:mod:`binquant_tpu.io.checkpoint`:
+
+* one ``np.savez`` archive per shard, written by the same
+  :func:`~binquant_tpu.io.checkpoint.atomic_savez` (tmp + rename);
+* a per-save ``nonce`` echoed by every shard — siblings commit FIRST,
+  the manifest last, so a torn multi-file save is detected (stale or
+  mismatched nonce/roster) and rejected into a cold rebuild;
+* shard-aware splitting that composes with the PR 19 mesh: ``sym_plane``
+  rows split at ``shard_bounds(symbol_capacity, n)`` — the identical
+  contiguous blocks the engine mesh owns — into ``<path>.shardK-of-N``
+  siblings; the no-row tail bucket and every other (row-count-bounded or
+  per-user) array ride the manifest. Restore at ANY mesh size
+  reassembles the full arrays (restore@M = concat, the checkpoint's own
+  resharding story).
+
+Version rules: ``FANOUT_SNAP_VERSION`` gates the archive layout; the
+plane additionally rejects archives whose ``symbol_capacity`` /
+``strategy_order`` disagree with the running engine (plane row meaning
+changed — cold rebuild), and an engine-registry ``fingerprint`` mismatch
+keeps the archive but forces a symbol-row refresh on first sync.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from binquant_tpu.io.checkpoint import _shard_path, atomic_savez
+
+FANOUT_SNAP_VERSION = 1
+
+# archive keys holding the raw plane arrays (sym_plane handled apart —
+# it is the sharded one)
+_PLANE_KEYS = ("strat_plane", "regime_plane", "any_masks", "floors")
+_COLUMN_KEYS = (
+    "uid_blob", "slots",
+    "sym_counts", "sym_blob",
+    "strat_counts", "strat_blob",
+    "reg_counts", "reg_flat",
+    "row_counts", "rows_flat",
+    "free_slots",
+    "min_seq_slots", "min_seq_vals",
+)
+
+
+def save_snapshot(
+    path: str | Path,
+    planes: dict[str, np.ndarray],
+    columns: dict[str, np.ndarray],
+    meta: dict,
+    n_shards: int = 1,
+) -> dict:
+    """Write the sidecar archive set; returns the manifest meta.
+
+    ``planes`` must hold ``sym_plane`` (``(S+1, U32)`` — the trailing
+    no-row bucket stays on the manifest) plus ``_PLANE_KEYS``;
+    ``columns`` is :meth:`SubscriptionRegistry.export_columns` output;
+    ``meta`` carries the plane-level fields (capacity, seq, fingerprint,
+    …) echoed back at load.
+    """
+    from binquant_tpu.parallel.mesh import shard_bounds
+
+    path = Path(path)
+    n_shards = max(int(n_shards), 1)
+    sym_plane = np.ascontiguousarray(planes["sym_plane"], np.uint32)
+    s = sym_plane.shape[0] - 1  # body rows; the tail bucket rides shard 0
+    nonce = os.urandom(8).hex()
+    manifest_meta = dict(meta)
+    manifest_meta.update(
+        version=FANOUT_SNAP_VERSION,
+        nonce=nonce,
+        shard_count=n_shards,
+        shard_index=0,
+        symbol_rows=s,
+    )
+    if n_shards == 1:
+        arrays = {
+            "sym_body": sym_plane[:s],
+            "sym_tail": sym_plane[s:],
+            **{k: planes[k] for k in _PLANE_KEYS},
+            **{k: columns[k] for k in _COLUMN_KEYS},
+        }
+        atomic_savez(path, arrays, manifest_meta)
+        return manifest_meta
+    bounds = shard_bounds(s, n_shards)
+    manifest_meta["shard_bounds"] = [list(b) for b in bounds]
+    # commit order mirrors the checkpoint: siblings first, manifest last
+    # — a crash mid-save leaves a roster the loader rejects by nonce
+    for k in range(n_shards - 1, 0, -1):
+        lo, hi = bounds[k]
+        atomic_savez(
+            _shard_path(path, k, n_shards),
+            {"sym_body": sym_plane[lo:hi]},
+            {
+                "version": FANOUT_SNAP_VERSION,
+                "nonce": nonce,
+                "shard_count": n_shards,
+                "shard_index": k,
+                "rows": [int(lo), int(hi)],
+            },
+        )
+    lo, hi = bounds[0]
+    arrays = {
+        "sym_body": sym_plane[lo:hi],
+        "sym_tail": sym_plane[s:],
+        **{k: planes[k] for k in _PLANE_KEYS},
+        **{k: columns[k] for k in _COLUMN_KEYS},
+    }
+    atomic_savez(path, arrays, manifest_meta)
+    return manifest_meta
+
+
+def load_snapshot(
+    path: str | Path,
+) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], dict]:
+    """Load + reassemble the archive set → ``(planes, columns, meta)``.
+
+    Raises ``ValueError`` on any torn-save signature (missing sibling,
+    nonce/roster mismatch) or unsupported version — the caller starts
+    cold instead. Arrays come back writable (fresh decompress buffers).
+    """
+    path = Path(path)
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta"].tobytes()).decode())
+        if meta.get("version") != FANOUT_SNAP_VERSION:
+            raise ValueError(
+                f"fanout snapshot version {meta.get('version')} "
+                f"unsupported (want {FANOUT_SNAP_VERSION})"
+            )
+        if int(meta.get("shard_index", 0)) != 0:
+            raise ValueError(
+                f"{path.name} is a non-manifest shard file — restore "
+                "from the manifest path"
+            )
+        n = int(meta.get("shard_count", 1))
+        parts = [np.asarray(data["sym_body"])]
+        tail = np.asarray(data["sym_tail"])
+        planes = {k: np.asarray(data[k]) for k in _PLANE_KEYS}
+        columns = {k: np.asarray(data[k]) for k in _COLUMN_KEYS}
+    for k in range(1, n):
+        sp = _shard_path(path, k, n)
+        if not sp.exists():
+            raise ValueError(
+                f"fanout snapshot shard {sp.name} missing (torn save) — "
+                "start cold"
+            )
+        with np.load(sp) as sd:
+            smeta = json.loads(bytes(sd["__meta"].tobytes()).decode())
+            if smeta.get("nonce") != meta.get("nonce"):
+                raise ValueError(
+                    f"fanout snapshot shard {k} nonce mismatch "
+                    "(torn save) — start cold"
+                )
+            if (
+                smeta.get("shard_index") != k
+                or smeta.get("shard_count") != n
+            ):
+                raise ValueError(
+                    f"fanout snapshot shard {sp.name} roster mismatch — "
+                    "start cold"
+                )
+            parts.append(np.asarray(sd["sym_body"]))
+    sym_plane = np.concatenate(parts + [tail], axis=0)
+    if sym_plane.shape[0] != int(meta["symbol_rows"]) + 1:
+        raise ValueError(
+            f"fanout snapshot reassembled {sym_plane.shape[0]} symbol "
+            f"rows, manifest says {int(meta['symbol_rows']) + 1}"
+        )
+    planes["sym_plane"] = sym_plane
+    return planes, columns, meta

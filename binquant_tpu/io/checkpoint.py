@@ -63,6 +63,27 @@ import numpy as np
 CKPT_VERSION = 4
 
 
+def atomic_savez(target: str | Path, arrays: dict, meta: dict) -> None:
+    """Atomically write one ``np.savez`` archive with a ``__meta`` JSON
+    blob (tmp file + rename — the torn-save discipline every archive in
+    this repo shares; the fan-out snapshot sidecar reuses it too)."""
+    target = Path(target)
+    target.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=target.parent, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f,
+                __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                **arrays,
+            )
+        os.replace(tmp, target)
+    except BaseException:
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(tmp)
+        raise
+
+
 def _sans_cursor(state):
     """``state`` with each MarketBuffer replaced by its (times, values,
     filled) triple — the v3-compatible leaf sequence (plain tuples flatten
@@ -98,17 +119,7 @@ def save_state(
         "registry": registry.to_mapping(),
         "host_carries": host_carries or {},
     }
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".ckpt.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, __meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays)
-        os.replace(tmp, path)
-    except BaseException:
-        with contextlib.suppress(FileNotFoundError):
-            os.unlink(tmp)
-        raise
+    atomic_savez(path, arrays, meta)
 
 
 def _shard_path(path: Path, k: int, n: int) -> Path:
@@ -156,25 +167,6 @@ def save_state_sharded(
     bounds = shard_bounds(capacity, n_shards)
     nonce = os.urandom(8).hex()
     path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-
-    def _write(target: Path, arrays: dict, meta: dict) -> None:
-        fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".ckpt.tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(
-                    f,
-                    __meta=np.frombuffer(
-                        json.dumps(meta).encode(), np.uint8
-                    ),
-                    **arrays,
-                )
-            os.replace(tmp, target)
-        except BaseException:
-            with contextlib.suppress(FileNotFoundError):
-                os.unlink(tmp)
-            raise
-
     host = [np.asarray(leaf) for leaf in leaves]
     for k in range(n_shards - 1, -1, -1):  # manifest (k=0) commits last
         lo, hi = bounds[k]
@@ -197,7 +189,7 @@ def save_state_sharded(
             meta["symbol_leaves"] = [
                 i for i, f in enumerate(flags) if f
             ]
-        _write(_shard_path(path, k, n_shards), arrays, meta)
+        atomic_savez(_shard_path(path, k, n_shards), arrays, meta)
 
 
 def _load_sharded(path: Path, meta: dict, data, template_state, registry):
@@ -410,6 +402,14 @@ class CheckpointManager:
                 shards=n_shards,
                 duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
             )
+            fan = getattr(engine, "fanout", None)
+            if fan is not None:
+                # the fan-out snapshot sidecar rides the same cadence and
+                # shard rule as the engine checkpoint, so a restart is
+                # warm on both planes or neither (no-op when the plane
+                # has no snapshot path configured; failures are counted
+                # inside, never aborting the engine save)
+                fan.maybe_save_snapshot(default_shards=n_shards)
             return True
         except Exception:
             CHECKPOINT_SAVES.labels(outcome="error").inc()

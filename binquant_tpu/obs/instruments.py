@@ -480,6 +480,39 @@ FANOUT_RESUME_REPLAYED = REGISTRY.counter(
     "Frames replayed from the broadcast outbox to reconnecting clients "
     "presenting a cursor.",
 )
+FANOUT_RESUME_FALLBACK = REGISTRY.counter(
+    "bqt_fanout_resume_fallback_total",
+    "Cursor reconnects that could NOT be served from the hub's in-memory "
+    "tail ring and fell back to a full outbox scan, by reason "
+    "(tail_off: ring disabled; tail_cold: nothing broadcast yet this "
+    "boot / ring invalidated by compaction; cursor_gap: cursor older "
+    "than the retained ring; trace_cursor: provenance cursors resolve "
+    "through the outbox).",
+    labels=("reason",),
+)
+FANOUT_DELTA_WORDS = REGISTRY.histogram(
+    "bqt_fanout_delta_words",
+    "Words patched per incremental apply_subscription_deltas dispatch — "
+    "the per-tick device cost of subscription churn (O(cells touched), "
+    "independent of the resident population).",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+             1024.0, 4096.0),
+)
+FANOUT_COMPACTIONS = REGISTRY.counter(
+    "bqt_fanout_compactions_total",
+    "Tombstone-folding plane compactions (fragmentation crossed the "
+    "fanout_compact_frac threshold): live slots re-packed dense, "
+    "capacity shrunk toward the initial allocation, one counted FULL "
+    "device resync.",
+)
+FANOUT_SNAPSHOT = REGISTRY.counter(
+    "bqt_fanout_snapshot_total",
+    "Fan-out snapshot sidecar operations by op (save / restore) and "
+    "outcome (ok / rejected / error): the restart-warm boot path — "
+    "rejected restores (torn save, version or plane-shape mismatch) "
+    "fall back to a cold rebuild.",
+    labels=("op", "outcome"),
+)
 
 # -- ingest-health observatory (ISSUE 15) -------------------------------------
 

@@ -901,8 +901,19 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
             fanout_overrides=(
                 # small slot capacity so the churn storm forces plane
                 # growth (the match kernel's one legitimate retrace);
-                # roomy outbox so the stalled user's whole gap replays
-                {"fanout_capacity": 64, "fanout_outbox_cap": 4096}
+                # roomy outbox so the stalled user's whole gap replays;
+                # a small tail ring so the reconnect storm exercises BOTH
+                # resume sources (in-window cursors from memory, stale /
+                # trace cursors falling back to the outbox scan);
+                # compaction pinned off — the dedicated compaction tests
+                # own that seam, and a mid-storm slot re-pack would
+                # invalidate the storm's replay oracle
+                {
+                    "fanout_capacity": 64,
+                    "fanout_outbox_cap": 4096,
+                    "fanout_resume_tail": 64,
+                    "fanout_compact_frac": 0.0,
+                }
                 if fanout
                 else {}
             ),
@@ -942,9 +953,15 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
     rng = np.random.default_rng(spec.seed)
     strategies = list(spec.enabled_strategies)
 
-    # standing population: the watcher and the sloth subscribe to all
+    # standing population: the watcher and the sloth subscribe to all,
+    # plus the reconnect-storm cohort (ISSUE 20) — subscribed up front so
+    # every published frame addresses them and their post-drive cursor
+    # replays have a full gap to prove against
     plane.subscribe(Subscription("watcher"))
     plane.subscribe(Subscription("sloth"))
+    storm_cohort = [f"storm{i}" for i in range(6)]
+    for uid in storm_cohort:
+        plane.subscribe(Subscription(uid))
     churn_pool: list[str] = []
     churn_ops = {"subscribe": 0, "update": 0, "unsubscribe": 0}
     next_id = 0
@@ -1123,6 +1140,121 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
         writer.close()
         w2.close()
         watch_task.cancel()
+
+        # -- churn × reconnect storm (ISSUE 20): the cohort reconnects
+        # with fresh cursors WHILE a 100-op subscription churn burst
+        # races the handshakes on the same loop. Replay must stay
+        # bit-exact per user whichever source serves it — the in-memory
+        # tail ring for in-window cursors, the outbox scan for stale and
+        # trace-id cursors (fallbacks counted by reason, never silent).
+        entries_pre = plane.outbox.entries()
+        head = plane.seq - 1
+
+        def _addressed_after(user: str, after_seq: int) -> list[int]:
+            s = plane.subscriptions.slot_of(user)
+            return [
+                int(f["seq"])
+                for f, words in entries_pre
+                if int(f["seq"]) > after_seq
+                and s >> 5 < len(words)
+                and (int(words[s >> 5]) >> (s & 31)) & 1
+            ]
+
+        # cursor mix: in-window numerics (tail ring), a stale numeric
+        # (outbox path when the ring has evicted past it), and one
+        # trace-id cursor (always an outbox resolution → counted fallback)
+        tr_frame = entries_pre[-1][0]
+        trace_cursor = f"{tr_frame['trace_id']}/{tr_frame['tick_seq']}"
+        trace_resolved = max(
+            int(f["seq"])
+            for f, _ in entries_pre
+            if f.get("trace_id") == tr_frame["trace_id"]
+            and f.get("tick_seq") == tr_frame["tick_seq"]
+        )
+        cursor_of = {
+            "storm0": str(max(head - 3, -1)),
+            "storm1": str(max(head - 5, -1)),
+            "storm2": str(max(head - 2, -1)),
+            "storm3": "0",
+            "storm4": "-1",
+            "storm5": trace_cursor,
+        }
+        expected_of = {
+            u: _addressed_after(
+                u,
+                trace_resolved if u == "storm5" else int(cursor_of[u]),
+            )
+            for u in storm_cohort
+        }
+
+        def _storm_burst(n: int) -> None:
+            nonlocal next_id
+            for _ in range(n):
+                r = rng.random()
+                if r < 0.4 or not churn_pool:
+                    uid = f"burst{next_id:05d}"
+                    next_id += 1
+                    plane.subscribe(_random_sub(uid))
+                    churn_pool.append(uid)
+                elif r < 0.7:
+                    plane.update(_random_sub(str(rng.choice(churn_pool))))
+                else:
+                    uid = str(rng.choice(churn_pool))
+                    churn_pool.remove(uid)
+                    plane.unsubscribe(uid)
+                facts["storm_churn_ops"] = (
+                    facts.get("storm_churn_ops", 0) + 1
+                )
+
+        async def _storm_reconnect(user: str) -> tuple[str, list[int]]:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(
+                (
+                    f"GET /ws?user={user}&cursor={cursor_of[user]} "
+                    "HTTP/1.1\r\nHost: x\r\n"
+                    "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                    "Sec-WebSocket-Key: dGhlIHNhbXBsZQ==\r\n\r\n"
+                ).encode()
+            )
+            await w.drain()
+            await r.readline()
+            while (await r.readline()) not in (b"\r\n", b""):
+                pass
+            got: list[int] = []
+            try:
+                while len(got) < len(expected_of[user]):
+                    opcode, payload = await asyncio.wait_for(
+                        ws_read_frame(r), timeout=5.0
+                    )
+                    if opcode == 0x1:
+                        got.append(json.loads(payload)["seq"])
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+            w.close()
+            return user, got
+
+        storm_tasks = [
+            asyncio.ensure_future(_storm_reconnect(u)) for u in storm_cohort
+        ]
+        # interleave the churn burst with the in-flight handshakes: every
+        # yield lets accept/upgrade/replay steps run between ops
+        for _ in range(10):
+            _storm_burst(10)
+            await asyncio.sleep(0)
+        storm_got = dict(await asyncio.gather(*storm_tasks))
+        facts["storm_replays"] = {
+            u: {"got": len(storm_got[u]), "want": len(expected_of[u])}
+            for u in storm_cohort
+        }
+        facts["storm_replay_exact"] = all(
+            storm_got[u] == expected_of[u] for u in storm_cohort
+        )
+        facts["storm_expected_total"] = sum(
+            len(v) for v in expected_of.values()
+        )
+        facts["tail_resumes"] = plane.hub.tail_resumes
+        facts["resume_fallbacks"] = dict(plane.hub.resume_fallbacks)
+
         # post-replay clean soak: in-budget acks wash the tiny p99
         # window and fire the recover edge; the final verdict must fold
         # back to green with the recipient-set invariant passing. Every
@@ -1209,6 +1341,18 @@ def fanout_chaos_drill(workdir: str | None = None) -> dict:
         # reconnect-with-cursor replays the whole gap from the outbox
         "cursor_replayed_gap": facts["sloth_gap_replayed"]
         and facts["sloth_addressed"] > 0,
+        # churn × reconnect storm (ISSUE 20): every cohort reconnect
+        # replayed its exact gap while 100 churn ops raced the handshakes
+        "storm_replay_exact": bool(facts.get("storm_replay_exact"))
+        and facts.get("storm_expected_total", 0) > 0
+        and facts.get("storm_churn_ops", 0) >= 100,
+        # in-window cursors resumed from the tail ring (no outbox scan)...
+        "storm_tail_resume_engaged": facts.get("tail_resumes", 0) > 0,
+        # ...and the cursors the ring can't serve fell back with a
+        # counted reason (the trace cursor always needs the log)
+        "storm_fallback_counted": (
+            facts.get("resume_fallbacks", {}).get("trace_cursor", 0) >= 1
+        ),
         # unified SLO plane (ISSUE 16): the hub's cursor-lag watermark
         # caught the sloth's wedged backlog (its 2-slot queue full)
         "cursor_lag_caught_wedge": facts.get("wedged_cursor_lag", 0) >= 2,

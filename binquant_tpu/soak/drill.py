@@ -16,6 +16,10 @@ all pinned ON — while a fault scheduler overlaps seven fault kinds:
   close→ack SLO organically;
 * a wedged fan-out consumer + cursor-replay reconnect, with a scripted
   slow-ack probe burning ``delivery.fanout``;
+* a subscription churn storm riding the hammer window (ISSUE 20):
+  adds/updates/removes every tick between live matches, with recipient
+  sets pinned to the oracle and the device patched incrementally — zero
+  bulk plane rebuilds;
 * an autotrade sink 5xx storm walking the breaker open, into
 * a HARD KILL (workers cancelled, WAL unacked) + checkpoint restore that
   resumes the drill mid-storm.
@@ -82,6 +86,10 @@ def fault_schedule(n_ticks: int) -> FaultSchedule:
                 "wedged_consumer", "fanout_wedge", t - 11, t - 3,
                 may=("fanout", "delivery"), expect=("fanout",),
                 probe="wedge",
+            ),
+            FaultWindow(
+                "subscription_churn_storm", "fanout_churn", t - 10, t - 3,
+                may=("fanout",), probe="churn_storm",
             ),
             FaultWindow(
                 "sink_5xx_storm", "sink_5xx", t - 7, t - 2,
@@ -340,6 +348,45 @@ def soak_drill(
     sloth_state: dict = {}
     victim_out: list = []
 
+    # churn-storm state + the per-fired-tick oracle equality spy
+    # (ISSUE 20): every match during the soak — including the storm's —
+    # must produce the exact recipient set the pure-Python oracle does
+    churn_state = {
+        "next": 0, "pool": [], "ops": 0,
+        "mismatches": 0, "fired_checked": 0,
+    }
+    _orig_on_fired = plane.on_fired
+
+    def _fanout_spy(fired, ctx_scalars, tick_ms=None):
+        import numpy as np
+
+        from binquant_tpu.enums import MarketRegimeCode
+        from binquant_tpu.fanout.kernel import unpack_words_np
+
+        stats = _orig_on_fired(fired, ctx_scalars, tick_ms=tick_ms)
+        regime = int(ctx_scalars.get("market_regime", -1))
+        valid = bool(ctx_scalars.get("valid", False))
+        want = plane.subscriptions.match_oracle(
+            [
+                (s.strategy, s.symbol, float(s.value.score or 0.0))
+                for s in fired
+            ],
+            regime if valid and 0 <= regime < len(MarketRegimeCode) else None,
+        )
+        churn_state["fired_checked"] += 1
+        for s, w in zip(fired, want):
+            _frame, words, _t = s.fanout_frame
+            got = set(
+                plane.subscriptions.users_of_slots(
+                    np.flatnonzero(unpack_words_np(words))
+                )
+            )
+            if got != w:
+                churn_state["mismatches"] += 1
+        return stats
+
+    plane.on_fired = _fanout_spy
+
     judge.install()
     judge.attach(victim.slo)
 
@@ -362,6 +409,26 @@ def soak_drill(
             plane.hub._conns.add(sloth)
             sloth_state["conn"] = sloth
             sloth_state["port"] = await plane.serve(0, host="127.0.0.1")
+        if t - 10 <= tick <= t - 5:
+            # the churn storm (ninth fault, ISSUE 20): adds/updates/
+            # removes every tick bracketing the hammer matches, so the
+            # t-9 match runs first-use full against a churned population
+            # and the t-4 match syncs the accumulated deltas
+            # INCREMENTALLY (one-word scatters, no bulk rebuild)
+            for _ in range(4):
+                uid = f"churn{churn_state['next']:04d}"
+                churn_state["next"] += 1
+                plane.subscribe(
+                    Subscription(uid, min_strength=0.05 * (tick % 4))
+                )
+                churn_state["pool"].append(uid)
+                churn_state["ops"] += 1
+            if len(churn_state["pool"]) > 2:
+                plane.update(
+                    Subscription(churn_state["pool"][0], min_strength=0.2)
+                )
+                plane.unsubscribe(churn_state["pool"].pop())
+                churn_state["ops"] += 2
         if tick == t - 8:
             # wedge-period slow-ack probe through the delivery-health
             # collector: one 500 ms fanout ack pins the 4-sample p99
@@ -550,6 +617,17 @@ def soak_drill(
         and sloth_state.get("addressed", 0) > 0,
     )
     judge.resolve_probe(
+        "churn_storm",
+        churn_state["ops"] >= 20
+        and churn_state["fired_checked"] >= 1
+        and churn_state["mismatches"] == 0
+        # the storm's deltas synced as one-word patches: exactly one
+        # full push (first device use at the t-9 hammer), the t-4
+        # hammer's resync incremental
+        and plane.recompiles.get("incremental", 0) >= 1
+        and plane.recompiles.get("full", 0) <= 1,
+    )
+    judge.resolve_probe(
         "sink_storm",
         len(facts.get("breaker_transitions", [])) >= 1
         and unacked_at_kill > 0,
@@ -593,7 +671,9 @@ def soak_drill(
         "watermarks_converged": bool(converged),
         "kill_left_unacked_wal": unacked_at_kill > 0,
         "wal_replayed": resumed.delivery.wal_replayed > 0,
-        "fault_kinds": len({w.kind for w in schedule.windows}) >= 6,
+        "fault_kinds": len({w.kind for w in schedule.windows}) >= 8,
+        "churn_storm_clean": churn_state["ops"] >= 20
+        and churn_state["mismatches"] == 0,
         "planes_judged": len(verdict["planes"]) >= 5,
         "signals_both_sides": len(signal_tuples(victim_out)) > 0
         and len(signal_tuples(resumed_out)) > 0,

@@ -88,6 +88,14 @@ os.environ.setdefault("BQT_DELIVERY", "0")
 # Production default stays ON (binquant_tpu/config.py); fanout coverage
 # opts in explicitly (tests/test_fanout.py via make_stub_engine(fanout=True)).
 os.environ.setdefault("BQT_FANOUT", "0")
+# ISSUE 20 fan-out churn/boot knobs pin OFF for tier-1: no background
+# compaction mid-fixture (tests drive compact() explicitly), no snapshot
+# sidecar writes, no hub tail ring (the resume fixtures pin the outbox
+# scan path; tail coverage opts in via fanout_overrides). Production
+# defaults stay ON (binquant_tpu/config.py).
+os.environ.setdefault("BQT_FANOUT_SNAPSHOT", "")
+os.environ.setdefault("BQT_FANOUT_COMPACT_FRAC", "0")
+os.environ.setdefault("BQT_FANOUT_RESUME_TAIL", "0")
 # Unified SLO plane + delivery health collector (ISSUE 16) default OFF
 # for the tier-1 lane, the same knob pattern: dozens of stub engines must
 # not each pay registry/ack-side bookkeeping, and several fixtures pin

@@ -592,7 +592,7 @@ async def _sse_read_json(reader):
             return sid, data
 
 
-def _mk_plane(tmp_path, conn_queue_max=256):
+def _mk_plane(tmp_path, conn_queue_max=256, **kw):
     from binquant_tpu.fanout.plane import FanoutPlane
 
     class _Rows:
@@ -603,11 +603,16 @@ def _mk_plane(tmp_path, conn_queue_max=256):
         def row_of(name):
             return {"BTCUSDT": 0}.get(name)
 
+        @staticmethod
+        def to_mapping():
+            return {"BTCUSDT": 0}
+
     return FanoutPlane(
         _Rows(),
         capacity=64,
         outbox_path=str(tmp_path / "outbox.jsonl"),
         conn_queue_max=conn_queue_max,
+        **kw,
     )
 
 
@@ -1041,3 +1046,390 @@ def test_fanout_chaos_drill():
     assert facts["ok"], {
         k: v for k, v in facts["checks"].items() if not v
     }
+
+
+# -- delta plane, compaction, snapshot-warm boot (ISSUE 20) -------------------
+
+
+def _devices_bit_equal(a: DevicePlanes, b: DevicePlanes) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(a._arrays, b._arrays)
+    )
+
+
+def test_delta_stream_device_equals_bulk_oracle():
+    """ISSUE 20 acceptance core: a randomized delta stream — adds,
+    updates, removes, duplicate re-adds, capacity growth past the
+    initial allocation, and an explicit mid-stream compaction — leaves
+    the incrementally-patched device planes BIT-IDENTICAL to a device
+    freshly bulk-rebuilt from the surviving population after every
+    burst."""
+    rng = np.random.default_rng(1234)
+    symbols = [f"S{i:03d}USDT" for i in range(8)]
+    rows = {s: i for i, s in enumerate(symbols)}
+    reg = SubscriptionRegistry(symbol_capacity=8, capacity=32)
+    dev = DevicePlanes(reg)
+    assert dev.sync() == "full"
+    live: dict[str, Subscription] = {}
+    next_id = 0
+    kinds: list[str] = []
+    for burst in range(12):
+        for _ in range(25):
+            op = rng.random()
+            if op < 0.45 or not live:
+                proto = _random_population(rng, 1, symbols, rows)[0]
+                sub = Subscription(
+                    f"d{next_id:05d}",
+                    symbols=proto.symbols,
+                    strategies=proto.strategies,
+                    regimes=proto.regimes,
+                    min_strength=proto.min_strength,
+                )
+                next_id += 1
+                reg.add(sub, row_of=rows.get)
+                live[sub.user_id] = sub
+            elif op < 0.55:
+                # duplicate: re-adding the identical record keeps the
+                # slot and must leave the delta stream coherent
+                uid = str(rng.choice(sorted(live)))
+                slot = reg.slot_of(uid)
+                assert reg.add(live[uid], row_of=rows.get) == slot
+            elif op < 0.8:
+                uid = str(rng.choice(sorted(live)))
+                old = live[uid]
+                new = Subscription(
+                    uid,
+                    symbols=old.symbols,
+                    strategies=old.strategies,
+                    regimes=old.regimes,
+                    min_strength=float(np.float32(rng.random())),
+                )
+                reg.update(new, row_of=rows.get)
+                live[uid] = new
+            else:
+                uid = str(rng.choice(sorted(live)))
+                reg.remove(uid)
+                del live[uid]
+        if burst == 6:
+            reg.compact()  # tombstone fold mid-stream
+        kind = dev.sync()
+        if kind is not None:
+            kinds.append(kind)
+        fresh = SubscriptionRegistry(
+            symbol_capacity=8, capacity=reg.capacity
+        )
+        for uid, sub in sorted(
+            live.items(), key=lambda kv: reg.slot_of(kv[0])
+        ):
+            fresh._next_slot = reg.slot_of(uid)
+            fresh.add(sub, row_of=rows.get)
+        fresh_dev = DevicePlanes(fresh)
+        assert fresh_dev.sync() == "full"
+        assert _devices_bit_equal(dev, fresh_dev), burst
+    # the stream exercised BOTH sync kinds: steady churn patches
+    # incrementally; growth wraps + the compaction force full pushes
+    assert "incremental" in kinds
+    assert kinds.count("full") >= 2
+    assert reg.capacity > 32
+
+
+def test_compaction_threshold_and_match_preserved(tmp_path):
+    """maybe_compact honors the fragmentation threshold; the pass
+    re-packs slots, shrinks capacity, advances moved users' replay
+    floors to the current seq, and the next device sync (full) matches
+    exactly the surviving population."""
+    plane = _mk_plane(tmp_path, compact_frac=0.0)
+    for i in range(80):
+        plane.subscribe(Subscription(f"u{i:03d}", min_strength=0.1))
+    assert plane.sync_device() == "full"
+    plane.seq = 7
+    for i in range(0, 80, 2):
+        plane.unsubscribe(f"u{i:03d}")
+    assert not plane.maybe_compact()  # frac 0 = compaction off
+    plane.compact_frac = 0.6
+    assert not plane.maybe_compact()  # 40/80 tombstones < 0.6
+    plane.compact_frac = 0.0  # keep the churn path from firing it early
+    for i in range(1, 21, 2):
+        plane.unsubscribe(f"u{i:03d}")
+    plane.compact_frac = 0.6
+    assert plane.maybe_compact()  # 50/80 crosses the threshold
+    reg = plane.subscriptions
+    assert plane.compactions == 1
+    assert reg._next_slot == 30 and reg.fragmentation() == 0.0
+    assert reg.capacity == 64  # shrunk back from the growth to 128
+    survivors = {f"u{i:03d}" for i in range(21, 80, 2)}
+    for uid in survivors:
+        slot = reg.slot_of(uid)
+        assert slot < 30
+        # every survivor moved: pre-compaction frames must not replay
+        # against the new layout
+        assert plane._slot_min_seq[slot] == 7
+    assert plane.sync_device() == "full"
+    words = plane._device.match(
+        np.array([0], np.int32),
+        np.array([0], np.int32),
+        np.array([0.5], np.float32),
+        INVALID_REGIME_ROW,
+    )
+    oracle = reg.match_oracle([(STRATEGY_ORDER[0], "BTCUSDT", 0.5)], None)
+    assert _match_users(reg, words[0]) == oracle[0] == survivors
+
+
+def _mk_snap_plane(tmp_path, rows_map=None, sym_capacity=8, **kw):
+    from binquant_tpu.fanout.plane import FanoutPlane
+
+    mapping = dict(rows_map or {"BTCUSDT": 0, "ETHUSDT": 1})
+
+    class _Rows:
+        capacity = sym_capacity
+        version = 1
+
+        @staticmethod
+        def row_of(name):
+            return mapping.get(name)
+
+        @staticmethod
+        def to_mapping():
+            return dict(mapping)
+
+    kw.setdefault("snapshot_path", str(tmp_path / "fanout.snap.npz"))
+    return FanoutPlane(_Rows(), capacity=64, **kw)
+
+
+def test_snapshot_roundtrip_restores_planes_lazily(tmp_path):
+    """Warm boot is an array load: the restored registry's planes are
+    bit-identical to the donor's, records stay columnar until touched,
+    per-slot replay floors survive, and the device takes exactly one
+    full push before matching like the donor."""
+    rng = np.random.default_rng(11)
+    symbols = ["BTCUSDT", "ETHUSDT", "XRPUSDT", "ADAUSDT"]
+    rows_map = {s: i for i, s in enumerate(symbols)}
+    plane = _mk_snap_plane(tmp_path, rows_map=rows_map)
+    subs = _random_population(rng, 40, symbols, rows_map)
+    assert plane.bulk_load(subs) == 40
+    plane.seq = 5
+    for i in range(10):
+        plane.unsubscribe(f"user{i:04d}")
+    plane.subscribe(Subscription("late", min_strength=0.25))  # floor 5
+    assert plane.sync_device() == "full"
+    assert plane.maybe_save_snapshot()
+    reg = plane.subscriptions
+
+    cold = _mk_snap_plane(tmp_path, rows_map=rows_map)
+    assert cold.try_restore_snapshot()
+    creg = cold.subscriptions
+    assert len(creg) == len(reg) == 31
+    for name in (
+        "sym_plane", "strat_plane", "regime_plane", "any_masks", "floors"
+    ):
+        assert np.array_equal(getattr(creg, name), getattr(reg, name)), name
+    assert creg._records.lazy_count == 31  # nothing materialized yet
+    assert creg.slot_of("late") == reg.slot_of("late")
+    got = creg.get("late")
+    assert got is not None and got.min_strength == 0.25
+    assert creg._records.lazy_count == 30  # one record touched
+    assert cold._slot_min_seq == plane._slot_min_seq
+    assert cold.seq >= plane.seq
+    # matching engine fingerprint: archived symbol rows adopted verbatim
+    assert creg._rows_version == cold.engine_registry.version
+    assert cold.sync_device() == "full"
+    words = cold._device.match(
+        np.array([0], np.int32),
+        np.array([1], np.int32),
+        np.array([0.6], np.float32),
+        INVALID_REGIME_ROW,
+    )
+    oracle = reg.match_oracle([(STRATEGY_ORDER[1], "BTCUSDT", 0.6)], None)
+    assert _match_users(creg, words[0]) == oracle[0]
+
+
+def test_snapshot_shards_roundtrip_and_torn_rejection(tmp_path):
+    """The sharded sidecar reassembles exactly; a missing sibling or a
+    sibling from an older save generation (stale nonce) is a torn save —
+    rejected into a cold start. An odd mesh falls back to one archive."""
+    from binquant_tpu.io.checkpoint import _shard_path
+
+    plane = _mk_snap_plane(tmp_path)
+    for i in range(20):
+        plane.subscribe(
+            Subscription(
+                f"s{i:02d}",
+                symbols=frozenset({"ETHUSDT"}) if i % 3 else None,
+                min_strength=0.1 * (i % 4),
+            )
+        )
+    info = plane.save_snapshot(n_shards=4)
+    assert info["shard_count"] == 4
+    manifest = plane.snapshot_path
+    sibs = [_shard_path(manifest, k, 4) for k in range(1, 4)]
+    assert all(p.exists() for p in sibs)
+
+    warm = _mk_snap_plane(tmp_path)
+    assert warm.try_restore_snapshot()
+    wreg, reg = warm.subscriptions, plane.subscriptions
+    for name in (
+        "sym_plane", "strat_plane", "regime_plane", "any_masks", "floors"
+    ):
+        assert np.array_equal(getattr(wreg, name), getattr(reg, name)), name
+
+    # torn: missing sibling
+    stale = sibs[1].read_bytes()
+    sibs[1].unlink()
+    cold = _mk_snap_plane(tmp_path)
+    assert not cold.try_restore_snapshot()
+    assert len(cold.subscriptions) == 0
+
+    # torn: sibling left over from a previous save (nonce mismatch)
+    plane.save_snapshot(n_shards=4)
+    sibs[1].write_bytes(stale)
+    cold2 = _mk_snap_plane(tmp_path)
+    assert not cold2.try_restore_snapshot()
+
+    # a mesh that doesn't divide the symbol axis saves monolithic
+    assert plane.save_snapshot(n_shards=3)["shard_count"] == 1
+
+
+def test_snapshot_version_geometry_and_torn_manifest_rejection(tmp_path):
+    """Restore rejects (cold start, never a crash): a future layout
+    version, plane geometry that disagrees with the running engine, and
+    a truncated manifest archive."""
+    import binquant_tpu.fanout.snapshot as snap_mod
+
+    plane = _mk_snap_plane(tmp_path)
+    plane.subscribe(Subscription("amy"))
+    plane.save_snapshot()
+    target = plane.snapshot_path
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(snap_mod, "FANOUT_SNAP_VERSION", 99)
+        cold = _mk_snap_plane(tmp_path)
+        assert not cold.try_restore_snapshot()
+
+    # engine grew its symbol axis while we were down: row meaning changed
+    cold = _mk_snap_plane(
+        tmp_path, rows_map={"BTCUSDT": 0, "ETHUSDT": 1, "XRPUSDT": 2},
+        sym_capacity=16,
+    )
+    assert not cold.try_restore_snapshot()
+    assert len(cold.subscriptions) == 0
+
+    target.write_bytes(target.read_bytes()[:100])  # torn manifest
+    cold2 = _mk_snap_plane(tmp_path)
+    assert not cold2.try_restore_snapshot()
+
+
+def test_snapshot_fingerprint_mismatch_forces_row_refresh(tmp_path):
+    """Listing churn while the process was down: the archive restores
+    (population + floors are still sound) but its symbol rows were
+    compiled against a different engine mapping — the first sync
+    re-resolves every explicit subscription against the CURRENT rows."""
+    donor = _mk_snap_plane(tmp_path)  # BTC->0, ETH->1
+    donor.subscribe(Subscription("pin", symbols=frozenset({"BTCUSDT"})))
+    slot = donor.subscriptions.slot_of("pin")
+    assert (donor.subscriptions.sym_plane[0, slot >> 5] >> (slot & 31)) & 1
+    donor.save_snapshot()
+
+    swapped = _mk_snap_plane(
+        tmp_path, rows_map={"ETHUSDT": 0, "BTCUSDT": 1}
+    )
+    assert swapped.try_restore_snapshot()
+    reg = swapped.subscriptions
+    assert reg._rows_version is None  # archived rows marked unsound
+    assert swapped.sync_device() == "full"
+    assert (reg.sym_plane[1, slot >> 5] >> (slot & 31)) & 1  # re-homed
+    assert not (reg.sym_plane[0, slot >> 5] >> (slot & 31)) & 1
+
+
+def test_snapshot_restore_excludes_post_save_frames_from_replay(tmp_path):
+    """Misdelivery guard across a restart: frames published AFTER the
+    snapshot was taken were addressed by post-save churn the restored
+    registry can't see (a recycled slot points at a different user) —
+    the hub excludes that seq range from every cursor replay."""
+    snap = str(tmp_path / "fan.snap.npz")
+    plane = _mk_plane(tmp_path, snapshot_path=snap)
+    for u in ("amy", "ben"):
+        plane.subscribe(Subscription(u))
+    for i in range(6):
+        _frame(plane, {"amy", "ben"} if i % 2 else {"amy"}, i)
+    saved_seq = plane.seq
+    assert plane.maybe_save_snapshot()
+    # post-save churn: ben leaves, cal claims his slot, frames for cal
+    ben_slot = plane.subscriptions.slot_of("ben")
+    plane.unsubscribe("ben")
+    assert plane.subscribe(Subscription("cal")) == ben_slot
+    for i in range(6, 9):
+        _frame(plane, {"cal"}, i)
+    plane.outbox.close()
+
+    warm = _mk_plane(tmp_path, snapshot_path=snap)
+    assert warm.try_restore_snapshot()
+    assert warm.subscriptions.slot_of("ben") == ben_slot
+    assert "cal" not in warm.subscriptions
+    assert warm.hub.replay_excluded == (saved_seq, 8)
+    assert warm.seq == 9
+
+    async def go():
+        port = await warm.serve(0, host="127.0.0.1")
+        reader, writer, st = await _ws_connect(port, "ben", cursor="0")
+        assert "101" in st
+        seqs = []
+        for _ in range(3):  # pre-save frames only — 6..8 are excluded
+            got = await asyncio.wait_for(_ws_read_json(reader), 5)
+            seqs.append(got["seq"])
+        assert seqs == [1, 3, 5]
+        # prove nothing from the excluded range follows: the next frame
+        # ben reads is a live one published after the reconnect
+        _frame(warm, {"ben"}, 99)
+        got = await asyncio.wait_for(_ws_read_json(reader), 5)
+        assert got["seq"] == 9
+        writer.close()
+        await warm.aclose()
+
+    asyncio.run(go())
+
+
+def test_hub_tail_resume_skips_outbox_and_counts_eviction(tmp_path):
+    """Satellite 6: a reconnect whose cursor lands inside the retained
+    tail ring replays from memory — the persistent outbox is never
+    scanned — while an evicted cursor falls back to the outbox with a
+    counted reason and still replays in full."""
+    plane = _mk_plane(tmp_path, resume_tail=8)
+    for u in ("amy", "ben"):
+        plane.subscribe(Subscription(u))
+    for i in range(12):
+        _frame(plane, {"amy", "ben"} if i % 2 else {"ben"}, i)
+    assert plane.hub.snapshot()["tail_retained"] == 8
+
+    async def go():
+        port = await plane.serve(0, host="127.0.0.1")
+
+        async def _no_scan():
+            raise AssertionError("outbox scanned on an in-window cursor")
+
+        real_scan = plane.hub._scan_outbox_stable
+        plane.hub._scan_outbox_stable = _no_scan
+        reader, writer, st = await _ws_connect(port, "amy", cursor="7")
+        assert "101" in st
+        seqs = []
+        for _ in range(2):  # amy frames past 7 in the ring: 9, 11
+            got = await asyncio.wait_for(_ws_read_json(reader), 5)
+            seqs.append(got["seq"])
+        assert seqs == [9, 11]
+        assert plane.hub.tail_resumes == 2  # counted per replayed frame
+        assert not plane.hub.resume_fallbacks
+        plane.hub._scan_outbox_stable = real_scan
+
+        r2, w2, st = await _ws_connect(port, "ben", cursor="0")
+        assert "101" in st
+        ben_seqs = []
+        for _ in range(11):  # ben rides every frame; floor skips seq 0
+            got = await asyncio.wait_for(_ws_read_json(r2), 5)
+            ben_seqs.append(got["seq"])
+        assert ben_seqs == list(range(1, 12))
+        assert plane.hub.resume_fallbacks.get("cursor_gap") == 1
+        writer.close()
+        w2.close()
+        await plane.aclose()
+
+    asyncio.run(go())
